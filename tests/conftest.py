@@ -1,0 +1,40 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's local[2] Spark strategy (utils/.../test/
+TestSparkContext.scala:50): all algorithms are shard-order-invariant, so a
+small local mesh exercises the same code paths as real hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from transmogrifai_tpu.utils import uid as uid_util  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_uids():
+    uid_util.reset()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+TITANIC_CSV = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
+@pytest.fixture(scope="session")
+def titanic_path():
+    if not os.path.exists(TITANIC_CSV):
+        pytest.skip("Titanic test data not available")
+    return TITANIC_CSV

@@ -1,0 +1,138 @@
+"""Feature graph + DAG assembly tests (parity: FeatureLike/FitStagesUtil tests)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder, from_dataset
+from transmogrifai_tpu.readers import infer_csv_dataset
+from transmogrifai_tpu.readers.core import DatasetReader, SimpleReader
+from transmogrifai_tpu.stages.base import Transformer
+from transmogrifai_tpu.types.columns import NumericColumn, column_from_values
+from transmogrifai_tpu.workflow.dag import compute_dag, raw_features_of, validate_stages
+
+
+class _AddOne(Transformer):
+    input_types = (T.Real,)
+    output_type = T.Real
+
+    def __init__(self):
+        super().__init__("addOne")
+
+    def transform_columns(self, col, *, num_rows):
+        return NumericColumn(T.Real, col.values + 1.0, col.mask)
+
+
+class _Sum2(Transformer):
+    input_types = (T.Real, T.Real)
+    output_type = T.Real
+
+    def __init__(self):
+        super().__init__("sum2")
+
+    def transform_columns(self, a, b, *, num_rows):
+        return NumericColumn(T.Real, a.values + b.values, a.mask & b.mask)
+
+
+def test_feature_builder_typed():
+    age = FeatureBuilder.Real("age").extract(lambda p: p["age"]).as_predictor()
+    assert age.ftype is T.Real and not age.is_response and age.is_raw
+    surv = FeatureBuilder.RealNN("survived").extract(lambda p: p["s"]).as_response()
+    assert surv.is_response and surv.ftype is T.RealNN
+
+
+def test_transform_with_builds_lineage():
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    c = a.transform_with(_AddOne())
+    d = c.transform_with(_Sum2(), b)
+    assert d.parents == (c, b)
+    assert {f.name for f in d.raw_features()} == {"a", "b"}
+    stages = d.parent_stages()
+    assert {s.operation_name: dist for s, dist in stages.items() if s.operation_name != "featureGen_a" and s.operation_name != "featureGen_b"} == {"sum2": 0, "addOne": 1}
+
+
+def test_compute_dag_layers_deepest_first():
+    a = FeatureBuilder.Real("a").as_predictor()
+    s1 = _AddOne()
+    s2 = _AddOne()
+    s3 = _Sum2()
+    x = a.transform_with(s1)         # depth 2
+    y = x.transform_with(s2)         # depth 1
+    z = y.transform_with(s3, x)      # depth 0  (x used at two depths)
+    layers = compute_dag([z])
+    assert [s.operation_name for layer in layers for s in layer] == [
+        "addOne", "addOne", "sum2"
+    ]
+    assert layers[0] == [s1] and layers[1] == [s2] and layers[2] == [s3]
+    validate_stages(layers)
+
+
+def test_diamond_dag_max_distance():
+    a = FeatureBuilder.Real("a").as_predictor()
+    left = a.transform_with(_AddOne())
+    right = a.transform_with(_AddOne())
+    top = left.transform_with(_Sum2(), right)
+    layers = compute_dag([top])
+    assert len(layers) == 2
+    assert len(layers[0]) == 2 and layers[1][0].operation_name == "sum2"
+
+
+def test_transform_columns_via_reader():
+    ds = Dataset.of({
+        "a": column_from_values(T.Real, [1.0, 2.0]),
+        "b": column_from_values(T.Real, [10.0, None]),
+    })
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    out = a.transform_with(_Sum2(), b)
+    raw = DatasetReader(ds).generate_dataset(raw_features_of([out]))
+    stage = out.origin_stage
+    result = stage.transform(raw)
+    assert result[out.name].to_list() == [11.0, None]
+
+
+def test_from_dataset_infers_types():
+    ds = Dataset.of({
+        "label": column_from_values(T.Integral, [0, 1, 1]),
+        "x": column_from_values(T.Real, [0.1, None, 2.2]),
+        "s": column_from_values(T.Text, ["a", "b", None]),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    assert resp.is_response and resp.ftype is T.RealNN
+    assert {p.name: p.ftype for p in preds} == {"x": T.Real, "s": T.Text}
+
+
+def test_from_dataset_rejects_null_response():
+    ds = Dataset.of({"label": column_from_values(T.Real, [0.0, None])})
+    with pytest.raises(ValueError):
+        from_dataset(ds, response="label")
+
+
+def test_csv_inference_titanic(titanic_path):
+    ds = infer_csv_dataset(titanic_path)
+    assert ds.num_rows == 891
+    assert ds["Survived"].feature_type is T.Integral
+    assert ds["Fare"].feature_type is T.Real
+    assert ds["Sex"].feature_type is T.Text
+    assert ds["Age"].to_list()[0] == pytest.approx(22.0)
+    # missing Age values must be masked, not zero
+    age = ds["Age"]
+    assert (~age.mask).sum() == 177  # well-known Titanic missing-age count
+
+
+def test_simple_reader_extract_fns():
+    records = [{"age": 10}, {"age": None}, {"age": 30}]
+    age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    ds = SimpleReader(records).generate_dataset([age])
+    assert ds["age"].to_list() == [10.0, None, 30.0]
+
+
+def test_uid_uniqueness_and_reset():
+    from transmogrifai_tpu.utils import uid as uid_util
+
+    s1, s2 = _AddOne(), _AddOne()
+    assert s1.uid != s2.uid
+    uid_util.reset()
+    s3 = _AddOne()
+    assert s3.uid.endswith("000000000001")

@@ -1,0 +1,93 @@
+"""Feature type system tests (parity: features/.../types tests)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.types import columns as C
+
+
+def test_registry_matches_reference():
+    # FeatureType.scala:265-325 registers 53 concrete types (the README's
+    # "45 types" count excludes some map types).
+    assert len(T.ALL_FEATURE_TYPES) == 53
+    assert len(set(T.ALL_FEATURE_TYPES)) == 53
+
+
+def test_lookup_by_name():
+    assert T.feature_type_by_name("RealNN") is T.RealNN
+    assert T.feature_type_by_name("GeolocationMap") is T.GeolocationMap
+    with pytest.raises(ValueError):
+        T.feature_type_by_name("NotAType")
+
+
+def test_hierarchy():
+    assert issubclass(T.RealNN, T.Real)
+    assert issubclass(T.Currency, T.Real)
+    assert issubclass(T.DateTime, T.Date) and issubclass(T.Date, T.Integral)
+    assert issubclass(T.PickList, T.Text) and issubclass(T.PickList, T.Categorical)
+    assert issubclass(T.Geolocation, T.Location)
+    assert issubclass(T.CountryMap, T.OPMap) and T.CountryMap.value_type is T.Country
+    assert issubclass(T.Prediction, T.NonNullable)
+
+
+def test_nullability():
+    assert T.Real.is_nullable and not T.RealNN.is_nullable
+    assert not T.OPVector.is_nullable
+    assert T.Text.is_nullable
+
+
+def test_numeric_column_roundtrip():
+    col = C.column_from_values(T.Real, [1.5, None, 3.0])
+    assert isinstance(col, C.NumericColumn)
+    assert col.to_list() == [1.5, None, 3.0]
+    assert col.mask.tolist() == [True, False, True]
+
+
+def test_numeric_column_coerces_strings():
+    col = C.column_from_values(T.Integral, ["7", None, " 42 ", ""])
+    assert col.to_list() == [7, None, 42, None]
+    assert col.values.dtype == np.int64
+
+
+def test_binary_column_parses_tokens():
+    col = C.column_from_values(T.Binary, ["true", "false", None, 1, 0.0])
+    assert col.to_list() == [True, False, None, True, False]
+
+
+def test_text_column():
+    col = C.column_from_values(T.PickList, ["a", None, "", "b"])
+    assert col.to_list() == ["a", None, None, "b"]
+
+
+def test_set_list_map_columns():
+    s = C.column_from_values(T.MultiPickList, [{"x", "y"}, None, set()])
+    assert s.to_list() == [frozenset({"x", "y"}), frozenset(), frozenset()]
+    l = C.column_from_values(T.TextList, [["a", "b"], None])
+    assert l.to_list() == [["a", "b"], []]
+    m = C.column_from_values(T.RealMap, [{"k": 1.0}, None])
+    assert m.to_list() == [{"k": 1.0}, {}]
+
+
+def test_vector_column():
+    v = C.column_from_values(T.OPVector, [[1, 2], [3, 4]])
+    assert v.dim == 2 and len(v) == 2
+    assert v.values.dtype == np.float32
+
+
+def test_prediction_column_keys():
+    p = C.PredictionColumn(
+        T.Prediction,
+        prediction=np.array([1.0]),
+        probability=np.array([[0.2, 0.8]]),
+        raw=np.array([[-1.0, 1.0]]),
+    )
+    row = p.to_list()[0]
+    assert row["prediction"] == 1.0
+    assert row["probability_1"] == pytest.approx(0.8)
+    assert row["rawPrediction_0"] == -1.0
+
+
+def test_take():
+    col = C.column_from_values(T.Real, [1.0, None, 3.0, 4.0])
+    taken = col.take(np.array([2, 0]))
+    assert taken.to_list() == [3.0, 1.0]

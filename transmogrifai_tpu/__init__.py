@@ -1,0 +1,14 @@
+"""transmogrifai_tpu — a TPU-native AutoML framework for structured data.
+
+A ground-up JAX/XLA rebuild of the capabilities of TransmogrifAI (Scala/Spark
+reference at /root/reference): typed features, a lineage-traced feature DAG,
+type-directed automated feature engineering, automated feature validation and
+model selection with cross-validation, evaluators, insights, persistence, and
+local scoring — with the numeric plane compiled to XLA and sharded over TPU
+meshes instead of Spark executors.
+"""
+from . import types  # noqa: F401
+from .dataset import Dataset  # noqa: F401
+from .features import Feature, FeatureBuilder, from_dataset  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,80 @@
+"""Columnar Dataset — the DataFrame equivalent flowing through the DAG.
+
+The reference materializes a Spark DataFrame with one column per feature
+(readers/.../DataReader.scala:173). Here a Dataset is an ordered mapping
+feature-name -> Column plus a row count. Transformers append columns;
+estimators reduce columns to small summaries. All columns share length.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from .types.columns import Column
+
+
+@dataclasses.dataclass
+class Dataset:
+    columns: dict[str, Column]
+    num_rows: int
+
+    @staticmethod
+    def of(columns: dict[str, Column]) -> "Dataset":
+        lengths = {name: len(c) for name, c in columns.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"Ragged dataset: {lengths}")
+        n = next(iter(lengths.values())) if lengths else 0
+        return Dataset(dict(columns), n)
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.columns)
+
+    def with_column(self, name: str, col: Column) -> "Dataset":
+        if len(col) != self.num_rows and self.columns:
+            raise ValueError(
+                f"Column '{name}' has {len(col)} rows, dataset has {self.num_rows}"
+            )
+        cols = dict(self.columns)
+        cols[name] = col
+        return Dataset(cols, self.num_rows if self.num_rows else len(col))
+
+    def with_columns(self, new: dict[str, Column]) -> "Dataset":
+        ds = self
+        for name, col in new.items():
+            ds = ds.with_column(name, col)
+        return ds
+
+    def select(self, names: list[str]) -> "Dataset":
+        return Dataset({n: self.columns[n] for n in names}, self.num_rows)
+
+    def drop(self, names: list[str]) -> "Dataset":
+        keep = {n: c for n, c in self.columns.items() if n not in set(names)}
+        return Dataset(keep, self.num_rows)
+
+    def take(self, indices: np.ndarray) -> "Dataset":
+        indices = np.asarray(indices)
+        return Dataset(
+            {n: c.take(indices) for n, c in self.columns.items()}, len(indices)
+        )
+
+    def filter_mask(self, mask: np.ndarray) -> "Dataset":
+        return self.take(np.nonzero(np.asarray(mask))[0])
+
+    def rows(self, names: list[str] | None = None) -> list[dict]:
+        """Row-wise dict view (tests / local scoring)."""
+        names = list(self.columns) if names is None else names
+        cols = {n: self.columns[n].to_list() for n in names}
+        return [
+            {n: cols[n][i] for n in names} for i in range(self.num_rows)
+        ]

@@ -1,0 +1,136 @@
+"""FeatureBuilder — typed raw-feature declaration.
+
+Reference: features/.../features/FeatureBuilder.scala:48 (per-type factories)
+and :232 (``fromDataFrame``: infer one feature per column, split response vs
+predictors).
+
+Usage (mirrors the reference's fluent API):
+
+    age  = FeatureBuilder.Real("age").extract(lambda p: p["age"]).as_predictor()
+    surv = FeatureBuilder.RealNN("survived").extract(lambda p: p["survived"]).as_response()
+
+    # or columnar auto-inference:
+    response, predictors = from_dataset(ds, response="survived")
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from .. import types as T
+from ..dataset import Dataset
+from ..types.columns import (
+    Column,
+    ListColumn,
+    MapColumn,
+    NumericColumn,
+    SetColumn,
+    TextColumn,
+    VectorColumn,
+)
+from .feature import Feature, FeatureGeneratorStage
+
+
+class _TypedBuilder:
+    def __init__(self, name: str, ftype: type):
+        self.name = name
+        self.ftype = ftype
+        self._extract_fn: Callable[[Any], Any] | None = None
+        self._aggregate_fn: Callable[[Iterable[Any]], Any] | None = None
+
+    def extract(self, fn: Callable[[Any], Any]) -> "_TypedBuilder":
+        self._extract_fn = fn
+        return self
+
+    def aggregate(self, fn: Callable[[Iterable[Any]], Any]) -> "_TypedBuilder":
+        """Custom monoid aggregator for event-grouped readers
+        (FeatureBuilder aggregate; aggregators/MonoidAggregatorDefaults.scala)."""
+        self._aggregate_fn = fn
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        stage = FeatureGeneratorStage(
+            name=self.name,
+            ftype=self.ftype,
+            extract_fn=self._extract_fn,
+            aggregate_fn=self._aggregate_fn,
+            is_response=is_response,
+        )
+        return stage.get_output()
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        return self._build(is_response=True)
+
+
+class _FeatureBuilderMeta(type):
+    def __getattr__(cls, type_name: str) -> Callable[[str], _TypedBuilder]:
+        ftype = T.FEATURE_TYPES_BY_NAME.get(type_name)
+        if ftype is None:
+            raise AttributeError(f"FeatureBuilder.{type_name}: unknown feature type")
+
+        def factory(name: str) -> _TypedBuilder:
+            return _TypedBuilder(name, ftype)
+
+        return factory
+
+
+class FeatureBuilder(metaclass=_FeatureBuilderMeta):
+    """``FeatureBuilder.<TypeName>(name)`` for all 53 feature types."""
+
+
+def infer_feature_type(col: Column) -> type:
+    """Physical column -> feature type, for auto-inference from data.
+
+    Mirrors FeatureBuilder.fromDataFrame's schema-directed mapping
+    (FeatureBuilder.scala:232): numerics stay Real/Integral/Binary, strings
+    become Text (refined to PickList downstream by the smart vectorizers).
+    """
+    if isinstance(col, NumericColumn):
+        return col.feature_type
+    if isinstance(col, TextColumn):
+        return col.feature_type
+    if isinstance(col, SetColumn):
+        return T.MultiPickList
+    if isinstance(col, ListColumn):
+        return col.feature_type
+    if isinstance(col, MapColumn):
+        return col.feature_type
+    if isinstance(col, VectorColumn):
+        return T.OPVector
+    raise TypeError(f"Cannot infer feature type for {type(col).__name__}")
+
+
+def from_dataset(
+    dataset: Dataset,
+    response: str,
+    response_type: type = T.RealNN,
+) -> tuple[Feature, list[Feature]]:
+    """(response, predictors) from a columnar dataset — the
+    ``FeatureBuilder.fromDataFrame`` equivalent (FeatureBuilder.scala:232).
+
+    The response must be numeric and non-null; predictors get one raw feature
+    per remaining column with types inferred from physical storage.
+    """
+    if response not in dataset:
+        raise ValueError(
+            f"Response feature '{response}' not found in columns {list(dataset)}"
+        )
+    resp_col = dataset[response]
+    if not isinstance(resp_col, NumericColumn):
+        raise TypeError(
+            f"Response '{response}' must be numeric, got {type(resp_col).__name__}"
+        )
+    if not resp_col.mask.all():
+        raise ValueError(f"Response '{response}' contains missing values")
+
+    resp = FeatureGeneratorStage(response, response_type, is_response=True).get_output()
+    predictors = [
+        FeatureGeneratorStage(name, infer_feature_type(col)).get_output()
+        for name, col in dataset.columns.items()
+        if name != response
+    ]
+    return resp, predictors

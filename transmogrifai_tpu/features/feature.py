@@ -1,0 +1,146 @@
+"""Feature — a node in the lineage-traced feature DAG.
+
+Reference: features/.../features/Feature.scala:52 and FeatureLike.scala:48.
+A Feature is a typed, named handle produced by an origin stage from parent
+features. The user never builds a pipeline forward: they declare result
+features and the workflow walks ``parents``/``origin_stage`` backwards to
+reconstruct the stage DAG (core/.../OpWorkflow.scala:90-110).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable
+
+from ..types import FeatureType
+from ..types.columns import Column, column_from_values
+from ..stages.base import PipelineStage, Transformer
+from ..utils import uid as uid_util
+
+
+@dataclasses.dataclass(eq=False)
+class Feature:
+    name: str
+    ftype: type
+    origin_stage: PipelineStage | None = None
+    parents: tuple["Feature", ...] = ()
+    is_response: bool = False
+    uid: str = ""
+    #: feature distributions attached by RawFeatureFilter
+    distributions: tuple = ()
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = uid_util.make_uid("Feature")
+
+    # ----------------------------------------------------------- lineage ops
+    @property
+    def is_raw(self) -> bool:
+        return isinstance(self.origin_stage, FeatureGeneratorStage)
+
+    def transform_with(self, stage: PipelineStage, *others: "Feature") -> Any:
+        """Apply a 1..4-ary stage to this feature (+ others)
+        (FeatureLike.transformWith, FeatureLike.scala:210-283)."""
+        stage.set_input(self, *others)
+        return stage.get_output()
+
+    def parent_stages(self) -> dict[PipelineStage, int]:
+        """All ancestor stages mapped to their distance from this feature
+        (FeatureLike.parentStages, FeatureLike.scala:363). Distance is the
+        LONGEST path so a stage is fitted only after everything it needs."""
+        dists: dict[PipelineStage, int] = {}
+
+        def visit(feature: "Feature", depth: int) -> None:
+            stage = feature.origin_stage
+            if stage is None:
+                return
+            if dists.get(stage, -1) >= depth:
+                return  # already visited at this depth or deeper
+            dists[stage] = depth
+            for p in feature.parents:
+                visit(p, depth + 1)
+
+        visit(self, 0)
+        return dists
+
+    def raw_features(self) -> list["Feature"]:
+        """All raw-feature leaves under this feature."""
+        seen: dict[str, Feature] = {}
+
+        def visit(f: "Feature") -> None:
+            if f.is_raw or f.origin_stage is None:
+                seen.setdefault(f.name, f)
+            for p in f.parents:
+                visit(p)
+
+        visit(self)
+        return list(seen.values())
+
+    def history(self) -> dict[str, Any]:
+        """Originating raw features + stage operation path (FeatureLike.history)."""
+        stages = sorted(
+            (s for s in self.parent_stages()), key=lambda s: s.uid
+        )
+        return {
+            "originFeatures": sorted(f.name for f in self.raw_features()),
+            "stages": [s.operation_name for s in stages],
+        }
+
+    def copy_with_origin(self, stage: PipelineStage, parents: tuple["Feature", ...]) -> "Feature":
+        return dataclasses.replace(self, origin_stage=stage, parents=parents)
+
+    def __repr__(self) -> str:
+        kind = "response" if self.is_response else "predictor"
+        return f"Feature[{self.ftype.__name__}]({self.name!r}, {kind})"
+
+    def __hash__(self) -> int:
+        return hash(self.uid)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Feature) and other.uid == self.uid
+
+
+class FeatureGeneratorStage(Transformer):
+    """DAG leaf: extracts one raw feature from user records
+    (features/.../stages/FeatureGeneratorStage.scala:67-115).
+
+    ``extract_fn`` maps one source record (any Python object) to a raw value;
+    ``aggregate_fn`` optionally monoid-combines multiple events per key
+    (aggregate readers). When data already arrives columnar (from_dataset),
+    ``extract_fn`` is None and the column passes through by name.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        ftype: type,
+        extract_fn: Callable[[Any], Any] | None = None,
+        aggregate_fn: Callable[[Iterable[Any]], Any] | None = None,
+        is_response: bool = False,
+        uid: str | None = None,
+    ):
+        super().__init__(operation_name=f"featureGen_{name}", uid=uid)
+        self.feature_name = name
+        self.ftype = ftype
+        self.extract_fn = extract_fn
+        self.aggregate_fn = aggregate_fn
+        self.is_response = is_response
+
+    @property
+    def output_name(self) -> str:  # type: ignore[override]
+        return self.feature_name
+
+    def get_output(self) -> Feature:
+        return Feature(
+            name=self.feature_name,
+            ftype=self.ftype,
+            origin_stage=self,
+            parents=(),
+            is_response=self.is_response,
+        )
+
+    def extract_column(self, records: Iterable[Any]) -> Column:
+        values = [self.extract_fn(r) for r in records] if self.extract_fn else list(records)
+        return column_from_values(self.ftype, values)
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> Column:
+        raise TypeError("FeatureGeneratorStage runs in the reader, not the DAG")

@@ -1,0 +1,3 @@
+"""Data plane (reference: readers module)."""
+from .csv import CsvReader, infer_csv_dataset, read_csv_auto  # noqa: F401
+from .core import DataReader, SimpleReader  # noqa: F401

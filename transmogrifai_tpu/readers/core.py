@@ -1,0 +1,79 @@
+"""DataReader core: records -> raw-feature columns.
+
+Reference: readers/.../DataReader.scala:57-203 — ``generateDataFrame`` reads
+source records, keys them, applies each raw feature's ``extract_fn`` (+
+aggregator for event data), and produces one row per entity. The columnar
+equivalent produces one Column per raw feature.
+
+Simple readers: one record per row. Aggregate/Conditional readers (event
+grouping with cutoff-time semantics, DataReader.scala:206-360) live in
+transmogrifai_tpu.readers.aggregate.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Sequence
+
+from ..dataset import Dataset
+from ..features.feature import Feature, FeatureGeneratorStage
+
+
+class DataReader:
+    """Base reader (DataReader.scala:57)."""
+
+    def __init__(self, key_fn: Callable[[Any], str] | None = None):
+        self.key_fn = key_fn
+
+    def read_records(self) -> Iterable[Any]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        """readDataset + generateRow (DataReader.scala:106,190)."""
+        records = list(self.read_records())
+        cols = {}
+        for f in raw_features:
+            stage = f.origin_stage
+            assert isinstance(stage, FeatureGeneratorStage), (
+                f"Raw feature {f.name} must originate from a FeatureGeneratorStage"
+            )
+            cols[f.name] = stage.extract_column(records)
+        return Dataset.of(cols)
+
+
+class SimpleReader(DataReader):
+    """One record per row (DataReaders.Simple, DataReaders.scala:44)."""
+
+    def __init__(self, records: Iterable[Any], key_fn: Callable[[Any], str] | None = None):
+        super().__init__(key_fn)
+        self._records = records
+
+    def read_records(self) -> Iterable[Any]:
+        return self._records
+
+
+class DatasetReader(DataReader):
+    """Pass-through reader over an already-columnar Dataset (the
+    ``setInputDataset`` path, core/.../OpWorkflowCore.scala)."""
+
+    def __init__(self, dataset: Dataset):
+        super().__init__(None)
+        self.dataset = dataset
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        cols = {}
+        rows = None  # row-wise view materialized at most once
+        for f in raw_features:
+            stage = f.origin_stage
+            if (
+                isinstance(stage, FeatureGeneratorStage)
+                and stage.extract_fn is not None
+            ):
+                if rows is None:
+                    rows = self.dataset.rows()
+                cols[f.name] = stage.extract_column(rows)
+            else:
+                if f.name not in self.dataset:
+                    raise KeyError(
+                        f"Raw feature '{f.name}' missing from input dataset"
+                    )
+                cols[f.name] = self.dataset[f.name]
+        return Dataset.of(cols)

@@ -1,0 +1,7 @@
+"""Stage system (reference: features/.../stages/OpPipelineStages.scala)."""
+from .base import (  # noqa: F401
+    Estimator,
+    Model,
+    PipelineStage,
+    Transformer,
+)

@@ -1,0 +1,179 @@
+"""Stage ABI: PipelineStage / Transformer / Estimator / Model.
+
+Reference design (features/.../stages/OpPipelineStages.scala:55,169,218-524):
+stages declare typed input features and produce output feature(s); estimators
+``fit`` data into models; transformers are pure functions of their inputs.
+Arity is explicit there (OpPipelineStage1..2N); here arity is simply
+``len(input_features)`` with input/output types validated dynamically.
+
+TPU-native contract (SURVEY.md §7 step 3):
+  * ``Transformer.transform_columns(*cols, num_rows)`` is columnar — it maps
+    whole columns (numpy host-side for text, jax/XLA for the numeric/vector
+    plane), not rows. Local per-row scoring reuses it with length-1 columns.
+  * ``Estimator.fit(dataset)`` computes a (small) summary — implemented as
+    map/monoid-reduce so it is shard-order-invariant — returns a ``Model``
+    and records a JSON-able summary into ``self.metadata`` (the
+    stage-metadata-as-ledger pattern, SURVEY.md §5.5).
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from ..types import FeatureType, is_subtype
+from ..types.columns import Column
+from ..utils import uid as uid_util
+from ..dataset import Dataset
+
+
+class PipelineStage:
+    """Base of every stage (OpPipelineStageBase, OpPipelineStages.scala:55)."""
+
+    #: (input feature types, output feature type(s)) — overridden by subclasses
+    input_types: tuple[type, ...] | None = None
+    output_type: type = FeatureType
+
+    def __init__(self, operation_name: str, uid: str | None = None):
+        self.operation_name = operation_name
+        self.uid = uid or uid_util.make_uid(type(self))
+        self.input_features: tuple[Any, ...] = ()  # tuple[Feature, ...]
+        #: fitted-stage summary ledger — JSON-able dict, written at fit time
+        self.metadata: dict[str, Any] = {}
+
+    # ---------------------------------------------------------------- wiring
+    def set_input(self, *features: Any) -> "PipelineStage":
+        """Declare input features; validates arity/types (transformSchema)."""
+        self._validate_inputs(features)
+        self.input_features = tuple(features)
+        return self
+
+    def _validate_inputs(self, features: Sequence[Any]) -> None:
+        if self.input_types is not None:
+            if len(features) != len(self.input_types):
+                raise ValueError(
+                    f"{self}: expected {len(self.input_types)} inputs, "
+                    f"got {len(features)}"
+                )
+            for f, expected in zip(features, self.input_types):
+                if not is_subtype(f.ftype, expected):
+                    raise TypeError(
+                        f"{self}: input '{f.name}' has type {f.ftype.__name__}, "
+                        f"expected {expected.__name__}"
+                    )
+
+    @property
+    def input_names(self) -> tuple[str, ...]:
+        return tuple(f.name for f in self.input_features)
+
+    # --------------------------------------------------------------- outputs
+    @property
+    def output_name(self) -> str:
+        """Derived output column name (OpPipelineStages makeOutputName)."""
+        _, suffix = uid_util.from_string(self.uid)
+        base = "-".join(self.input_names) if self.input_features else "out"
+        if len(base) > 80:
+            base = base[:80]
+        return f"{base}_{self.operation_name}_{suffix}"
+
+    def get_output(self) -> Any:
+        """The output Feature, with this stage as origin."""
+        from ..features.feature import Feature
+
+        if not self.input_features:
+            raise ValueError(f"{self}: set_input must be called before get_output")
+        return Feature(
+            name=self.output_name,
+            ftype=self.output_type,
+            origin_stage=self,
+            parents=tuple(self.input_features),
+            is_response=any(f.is_response for f in self.input_features),
+        )
+
+    # ----------------------------------------------------------- persistence
+    def get_params(self) -> dict[str, Any]:
+        """JSON-able constructor params for stage serialization
+        (OpPipelineStageReaderWriter.scala:131-196 equivalent). Subclasses
+        override; default takes no extra params."""
+        return {}
+
+    def set_params(self, **params: Any) -> "PipelineStage":
+        """Apply config-file overrides reflectively (OpWorkflow.setStageParameters,
+        core/.../OpWorkflow.scala:179-201)."""
+        for k, v in params.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"{self} has no param '{k}'")
+            setattr(self, k, v)
+        return self
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.uid})"
+
+
+class Transformer(PipelineStage):
+    """A pure columnar function of its input features (OpTransformer)."""
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> Column:
+        raise NotImplementedError
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        """Append this stage's output column to the dataset."""
+        cols = [dataset[name] for name in self.input_names]
+        out = self.transform_columns(*cols, num_rows=dataset.num_rows)
+        return dataset.with_column(self.output_name, out)
+
+    def transform_row(self, row: dict[str, Any]) -> Any:
+        """Per-row scoring hook (OpTransformer.transformRow) implemented via
+        length-1 columns, so there is exactly one transform semantics."""
+        from ..types.columns import column_from_values
+
+        cols = []
+        for f in self.input_features:
+            v = row[f.name]
+            col_cls_val = v if isinstance(v, Column) else None
+            if col_cls_val is not None:
+                cols.append(v)
+            else:
+                cols.append(column_from_values(f.ftype, [v]))
+        out = self.transform_columns(*cols, num_rows=1)
+        return out.to_list()[0]
+
+
+class Model(Transformer):
+    """A fitted transformer (UnaryModel etc.). Carries the uid of the
+    estimator that produced it so the workflow can swap fitted stages in by
+    uid (warm start, OpWorkflow.scala:468)."""
+
+    def __init__(self, operation_name: str, uid: str | None = None, parent_uid: str = ""):
+        super().__init__(operation_name, uid=uid)
+        self.parent_uid = parent_uid or self.uid
+
+    def get_arrays(self) -> dict[str, Any]:
+        """Fitted numpy/jax arrays for checkpointing (orbax-style). Subclasses
+        override when they hold learned arrays."""
+        return {}
+
+
+class Estimator(PipelineStage):
+    """Learns a Model from data (OpPipelineStage fit)."""
+
+    def fit(self, dataset: Dataset) -> Model:
+        model = self.fit_model(dataset)
+        model.input_features = self.input_features
+        model.parent_uid = self.uid
+        model.operation_name = self.operation_name
+        # the model's output must replace the estimator's declared output name
+        model._fixed_output_name = self.output_name  # type: ignore[attr-defined]
+        model.metadata = dict(self.metadata)
+        return model
+
+    def fit_model(self, dataset: Dataset) -> Model:
+        raise NotImplementedError
+
+
+def _model_output_name(self: Model) -> str:
+    fixed = getattr(self, "_fixed_output_name", None)
+    if fixed is not None:
+        return fixed
+    return PipelineStage.output_name.fget(self)  # type: ignore[attr-defined]
+
+
+Model.output_name = property(_model_output_name)  # type: ignore[assignment]

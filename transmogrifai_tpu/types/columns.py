@@ -1,0 +1,303 @@
+"""Columnar physical data model.
+
+The reference stores data as Spark DataFrames of boxed per-row values
+(features/.../types/FeatureTypeSparkConverter.scala). TPU-native equivalent:
+each feature is a *column*. Numeric-family columns are (values, validity-mask)
+ndarray pairs that ship straight to device; text/set/list/map columns live
+host-side as Python/numpy objects until a vectorizer encodes them; the vector
+plane is a dense float32 [N, D] matrix carrying provenance metadata
+(OpVectorMetadata equivalent, see transmogrifai_tpu.stages.metadata).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from . import (
+    FeatureType,
+    OPMap,
+    Prediction,
+    Storage,
+)
+
+
+class Column:
+    """Base class for all physical columns."""
+
+    feature_type: type
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def to_list(self) -> list:  # pragma: no cover - abstract
+        """Row-wise view (None for missing) — for tests and local scoring."""
+        raise NotImplementedError
+
+    def take(self, indices: np.ndarray) -> "Column":  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class NumericColumn(Column):
+    """Real/Integral/Binary/Date columns: dense values + validity mask.
+
+    Missing entries have mask=False and value 0 (the value under a False mask
+    is unspecified and must never be read without consulting the mask).
+    """
+
+    feature_type: type
+    values: np.ndarray  # [N] float64 / int64 / bool
+    mask: np.ndarray    # [N] bool, True = present
+
+    def __post_init__(self) -> None:
+        assert self.values.shape == self.mask.shape, (
+            self.values.shape,
+            self.mask.shape,
+        )
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def to_list(self) -> list:
+        return [
+            (v.item() if m else None)
+            for v, m in zip(self.values, self.mask)
+        ]
+
+    def take(self, indices: np.ndarray) -> "NumericColumn":
+        return NumericColumn(self.feature_type, self.values[indices], self.mask[indices])
+
+    @staticmethod
+    def from_values(
+        feature_type: type, raw: Iterable[Any], dtype: Any = np.float64
+    ) -> "NumericColumn":
+        vals, mask = [], []
+        for v in raw:
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                vals.append(0)
+                mask.append(False)
+            else:
+                vals.append(v)
+                mask.append(True)
+        return NumericColumn(
+            feature_type,
+            np.asarray(vals, dtype=dtype),
+            np.asarray(mask, dtype=bool),
+        )
+
+
+@dataclasses.dataclass
+class TextColumn(Column):
+    """Text-family column: object ndarray of str | None (host-side)."""
+
+    feature_type: type
+    values: np.ndarray  # [N] object: str | None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def to_list(self) -> list:
+        return list(self.values)
+
+    def take(self, indices: np.ndarray) -> "TextColumn":
+        return TextColumn(self.feature_type, self.values[indices])
+
+    @staticmethod
+    def from_values(feature_type: type, raw: Iterable[Any]) -> "TextColumn":
+        out = np.empty(0, dtype=object)
+        lst = [None if v is None or v == "" else str(v) for v in raw]
+        out = np.empty(len(lst), dtype=object)
+        out[:] = lst
+        return TextColumn(feature_type, out)
+
+
+@dataclasses.dataclass
+class SetColumn(Column):
+    """MultiPickList column: per-row frozenset[str] (empty set = missing)."""
+
+    feature_type: type
+    values: list  # list[frozenset[str]]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def to_list(self) -> list:
+        return list(self.values)
+
+    def take(self, indices: np.ndarray) -> "SetColumn":
+        return SetColumn(self.feature_type, [self.values[i] for i in indices])
+
+
+@dataclasses.dataclass
+class ListColumn(Column):
+    """TextList/DateList/DateTimeList/Geolocation: per-row Python list."""
+
+    feature_type: type
+    values: list  # list[list]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def to_list(self) -> list:
+        return list(self.values)
+
+    def take(self, indices: np.ndarray) -> "ListColumn":
+        return ListColumn(self.feature_type, [self.values[i] for i in indices])
+
+
+@dataclasses.dataclass
+class MapColumn(Column):
+    """Map-family column: per-row dict (empty dict = missing)."""
+
+    feature_type: type
+    values: list  # list[dict[str, Any]]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def to_list(self) -> list:
+        return list(self.values)
+
+    def take(self, indices: np.ndarray) -> "MapColumn":
+        return MapColumn(self.feature_type, [self.values[i] for i in indices])
+
+
+@dataclasses.dataclass
+class VectorColumn(Column):
+    """OPVector column: dense float32 [N, D] + column provenance metadata.
+
+    ``metadata`` is a transmogrifai_tpu.stages.metadata.VectorMetadata (kept
+    untyped here to avoid a circular import).
+    """
+
+    feature_type: type
+    values: np.ndarray  # [N, D] float32 (may also be a jax Array)
+    metadata: Any = None
+
+    def __len__(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.values.shape[1])
+
+    def to_list(self) -> list:
+        return [np.asarray(row) for row in self.values]
+
+    def take(self, indices: np.ndarray) -> "VectorColumn":
+        return VectorColumn(self.feature_type, np.asarray(self.values)[indices], self.metadata)
+
+
+@dataclasses.dataclass
+class PredictionColumn(Column):
+    """Prediction column (types/Maps.scala:339): dense arrays instead of a
+    per-row RealMap. ``probability``/``raw`` are [N, C]; regression has C=0."""
+
+    feature_type: type
+    prediction: np.ndarray            # [N] float64
+    probability: np.ndarray | None = None  # [N, C] float64
+    raw: np.ndarray | None = None          # [N, C] float64
+
+    def __len__(self) -> int:
+        return len(self.prediction)
+
+    def to_list(self) -> list:
+        """Row-wise Prediction maps with reference key names."""
+        out = []
+        for i in range(len(self.prediction)):
+            m = {Prediction.KEY_PREDICTION: float(self.prediction[i])}
+            if self.probability is not None:
+                for j, p in enumerate(np.asarray(self.probability[i])):
+                    m[f"{Prediction.KEY_PROB}_{j}"] = float(p)
+            if self.raw is not None:
+                for j, p in enumerate(np.asarray(self.raw[i])):
+                    m[f"{Prediction.KEY_RAW}_{j}"] = float(p)
+            out.append(m)
+        return out
+
+    def take(self, indices: np.ndarray) -> "PredictionColumn":
+        return PredictionColumn(
+            self.feature_type,
+            self.prediction[indices],
+            None if self.probability is None else self.probability[indices],
+            None if self.raw is None else self.raw[indices],
+        )
+
+
+_STORAGE_TO_COLUMN = {
+    Storage.REAL: NumericColumn,
+    Storage.INTEGRAL: NumericColumn,
+    Storage.BINARY: NumericColumn,
+    Storage.DATE: NumericColumn,
+    Storage.TEXT: TextColumn,
+    Storage.TEXT_SET: SetColumn,
+    Storage.TEXT_LIST: ListColumn,
+    Storage.DATE_LIST: ListColumn,
+    Storage.GEO: ListColumn,
+    Storage.MAP: MapColumn,
+    Storage.VECTOR: VectorColumn,
+}
+
+_STORAGE_DTYPE = {
+    Storage.REAL: np.float64,
+    Storage.INTEGRAL: np.int64,
+    Storage.DATE: np.int64,
+    Storage.BINARY: bool,
+}
+
+
+def column_from_values(feature_type: type, raw: Sequence[Any]) -> Column:
+    """Build the right physical column for ``feature_type`` from row values.
+
+    Mirrors FeatureTypeFactory (types/FeatureTypeFactory.scala): the single
+    place that knows how each feature family is physically represented.
+    """
+    storage = feature_type.storage
+    if storage in _STORAGE_DTYPE:
+        def _coerce(v: Any) -> Any:
+            if isinstance(v, bool) or v is None:
+                return v
+            if isinstance(v, float) and np.isnan(v):
+                return None
+            if storage is Storage.BINARY:
+                if isinstance(v, str):
+                    return v.strip().lower() in ("true", "1", "1.0", "yes", "t")
+                return bool(v)
+            if isinstance(v, str):
+                v = v.strip()
+                if v == "":
+                    return None
+                if storage is Storage.REAL:
+                    return float(v)
+                try:
+                    return int(v)  # exact — int(float(s)) corrupts ints > 2^53
+                except ValueError:
+                    return int(float(v))
+            return v
+
+        return NumericColumn.from_values(
+            feature_type, (_coerce(v) for v in raw), dtype=_STORAGE_DTYPE[storage]
+        )
+    if storage is Storage.TEXT:
+        return TextColumn.from_values(feature_type, raw)
+    if storage is Storage.TEXT_SET:
+        return SetColumn(feature_type, [frozenset(v) if v else frozenset() for v in raw])
+    if storage in (Storage.TEXT_LIST, Storage.DATE_LIST, Storage.GEO):
+        return ListColumn(feature_type, [list(v) if v else [] for v in raw])
+    if storage is Storage.MAP:
+        if feature_type is Prediction or (
+            isinstance(raw, list) and raw and isinstance(raw[0], PredictionColumn)
+        ):
+            raise TypeError("Prediction columns are built by models, not from raw values")
+        assert issubclass(feature_type, OPMap)
+        return MapColumn(feature_type, [dict(v) if v else {} for v in raw])
+    if storage is Storage.VECTOR:
+        return VectorColumn(feature_type, np.asarray(raw, dtype=np.float32))
+    raise ValueError(f"No physical column for storage {storage}")
+
+
+def empty_like(feature_type: type, n: int) -> Column:
+    """An all-missing column of length n."""
+    return column_from_values(feature_type, [None] * n)

@@ -1,0 +1,1 @@
+"""Utility layer (reference: utils module — UID, stats, tables, json helpers)."""

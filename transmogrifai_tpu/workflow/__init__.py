@@ -1,0 +1,2 @@
+"""Workflow layer (reference: core/.../OpWorkflow.scala)."""
+from .dag import compute_dag  # noqa: F401

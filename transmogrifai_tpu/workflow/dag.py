@@ -1,0 +1,56 @@
+"""DAG assembly from result features.
+
+Reference: core/.../utils/stages/FitStagesUtil.scala:173-198 (computeDAG):
+walk result features' lineage, map every stage to its max distance from a
+result feature, and group into layers — deepest layer first, so a stage is
+fitted only after all its ancestors. FeatureGeneratorStages (raw leaves) are
+excluded: they run in the reader, not the fitted DAG.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..features.feature import Feature, FeatureGeneratorStage
+from ..stages.base import Estimator, PipelineStage, Transformer
+
+
+def compute_dag(result_features: Iterable[Feature]) -> list[list[PipelineStage]]:
+    """Layers of stages, deepest (furthest from results) first."""
+    dists: dict[PipelineStage, int] = {}
+    for rf in result_features:
+        for stage, d in rf.parent_stages().items():
+            if isinstance(stage, FeatureGeneratorStage):
+                continue
+            if dists.get(stage, -1) < d:
+                dists[stage] = d
+    by_depth: dict[int, list[PipelineStage]] = {}
+    for stage, d in dists.items():
+        by_depth.setdefault(d, []).append(stage)
+    layers = []
+    for d in sorted(by_depth, reverse=True):
+        layers.append(sorted(by_depth[d], key=lambda s: s.uid))
+    return layers
+
+
+def validate_stages(layers: list[list[PipelineStage]]) -> None:
+    """Workflow-level stage validation (OpWorkflow.scala:280-338): distinct
+    uids; every stage is an Estimator or Transformer; inputs wired."""
+    seen: dict[str, PipelineStage] = {}
+    for layer in layers:
+        for s in layer:
+            if s.uid in seen and seen[s.uid] is not s:
+                raise ValueError(f"Duplicate stage uid {s.uid}")
+            seen[s.uid] = s
+            if not isinstance(s, (Estimator, Transformer)):
+                raise TypeError(f"{s} is neither Estimator nor Transformer")
+            if not s.input_features:
+                raise ValueError(f"{s} has no inputs wired")
+
+
+def raw_features_of(result_features: Iterable[Feature]) -> list[Feature]:
+    """All distinct raw-feature leaves required by the result features."""
+    seen: dict[str, Feature] = {}
+    for rf in result_features:
+        for f in rf.raw_features():
+            seen.setdefault(f.name, f)
+    return list(seen.values())
