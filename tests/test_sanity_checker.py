@@ -1,0 +1,197 @@
+"""SanityChecker tests — mini BadFeatureZoo (parity: core/.../preparators/
+BadFeatureZooTest.scala approach: construct known-leaky/known-junk features
+and assert they are caught)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.stages.metadata import (
+    NULL_STRING,
+    OTHER_STRING,
+    ColumnMeta,
+    VectorMetadata,
+)
+from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+from transmogrifai_tpu.utils import stats as S
+
+
+def _vec_ds(x, metas, y, name="vec", label="label"):
+    meta = VectorMetadata(name, tuple(
+        ColumnMeta(**{**m, "index": i}) for i, m in enumerate(metas)
+    ))
+    return Dataset.of({
+        label: NumericColumn(T.RealNN, np.asarray(y, dtype=np.float64),
+                             np.ones(len(y), dtype=bool)),
+        name: VectorColumn(T.OPVector, np.asarray(x, dtype=np.float32), meta),
+    })
+
+
+def _checker_inputs(name="vec", label="label"):
+    lbl = FeatureBuilder.RealNN(label).as_response()
+    vec = FeatureBuilder.OPVector(name).as_predictor()
+    return lbl, vec
+
+
+def _col(parent, **kw):
+    return {"parent_names": (parent,), "parent_type": "Real", **kw}
+
+
+# ------------------------------ stats plane ---------------------------------
+def test_correlation_matrix_basic():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=500)
+    b = 2 * a + 0.001 * rng.normal(size=500)
+    c = rng.normal(size=500)
+    corr = S.correlation_matrix(np.stack([a, b, c], axis=1))
+    assert corr[0, 1] > 0.999
+    assert abs(corr[0, 2]) < 0.2
+    np.testing.assert_allclose(np.diag(corr), 1.0)
+
+
+def test_correlation_zero_variance_is_zero():
+    x = np.stack([np.ones(10), np.arange(10.0)], axis=1)
+    corr = S.correlation_matrix(x)
+    assert corr[0, 1] == 0.0
+
+
+def test_cramers_v_perfect_and_independent():
+    perfect = np.array([[50.0, 0.0], [0.0, 50.0]])
+    assert S.cramers_v(perfect) == pytest.approx(1.0)
+    indep = np.array([[25.0, 25.0], [25.0, 25.0]])
+    assert S.cramers_v(indep) == pytest.approx(0.0)
+
+
+def test_spearman_monotonic():
+    x = np.arange(100.0)
+    y = np.exp(x / 10.0)  # monotonic, nonlinear
+    corr = S.spearman_correlation_matrix(x[:, None], y)
+    assert corr[0, 1] == pytest.approx(1.0)
+
+
+def test_association_rule_confidence():
+    cont = np.array([[30.0, 0.0], [10.0, 10.0]])
+    conf, support = S.association_rule_confidence(cont)
+    assert conf[0] == pytest.approx(1.0)
+    assert support[0] == pytest.approx(0.6)
+
+
+# --------------------------- sanity checker zoo -----------------------------
+def test_leaky_label_copy_dropped(rng):
+    y = rng.integers(0, 2, 400).astype(float)
+    good = rng.normal(size=400)
+    x = np.stack([y, good], axis=1)  # col 0 IS the label
+    ds = _vec_ds(x, [_col("leak"), _col("good")], y)
+    lbl, vec = _checker_inputs()
+    est = SanityChecker(remove_bad_features=True).set_input(lbl, vec)
+    model = est.fit(ds)
+    assert model.indices_to_keep == [1]
+    summary = est.metadata["sanityCheckerSummary"]
+    assert summary["numDropped"] == 1
+    dropped = [c for c in summary["columns"] if c["dropped"]][0]
+    assert any("corrLabel" in r for r in dropped["reasons"])
+
+
+def test_constant_column_dropped(rng):
+    y = rng.integers(0, 2, 300).astype(float)
+    x = np.stack([np.full(300, 7.0), rng.normal(size=300)], axis=1)
+    ds = _vec_ds(x, [_col("const"), _col("ok")], y)
+    lbl, vec = _checker_inputs()
+    model = SanityChecker(remove_bad_features=True).set_input(lbl, vec).fit(ds)
+    assert model.indices_to_keep == [1]
+
+
+def test_duplicate_feature_drops_later(rng):
+    y = rng.integers(0, 2, 300).astype(float)
+    a = rng.normal(size=300)
+    x = np.stack([a, a.copy(), rng.normal(size=300)], axis=1)
+    ds = _vec_ds(x, [_col("a"), _col("a2"), _col("b")], y)
+    lbl, vec = _checker_inputs()
+    model = SanityChecker(remove_bad_features=True).set_input(lbl, vec).fit(ds)
+    assert model.indices_to_keep == [0, 2]
+
+
+def test_categorical_leak_drops_whole_group(rng):
+    n = 400
+    y = rng.integers(0, 2, n).astype(float)
+    # pivot group "cat" perfectly encodes the label
+    cat_a = (y == 0).astype(float)
+    cat_b = (y == 1).astype(float)
+    other = np.zeros(n)
+    good = rng.normal(size=n)
+    x = np.stack([cat_a, cat_b, other, good], axis=1)
+    metas = [
+        _col("cat", grouping="cat", indicator_value="A", parent_type="PickList"),
+        _col("cat", grouping="cat", indicator_value="B", parent_type="PickList"),
+        _col("cat", grouping="cat", indicator_value=OTHER_STRING, parent_type="PickList"),
+        _col("good"),
+    ]
+    ds = _vec_ds(x, metas, y)
+    lbl, vec = _checker_inputs()
+    est = SanityChecker(remove_bad_features=True).set_input(lbl, vec)
+    model = est.fit(ds)
+    assert model.indices_to_keep == [3]  # whole group removed
+
+
+def test_good_features_kept(rng):
+    n = 500
+    y = rng.integers(0, 2, n).astype(float)
+    x = np.stack([
+        y * 0.4 + rng.normal(size=n),  # informative, not leaky
+        rng.normal(size=n),
+    ], axis=1)
+    ds = _vec_ds(x, [_col("f1"), _col("f2")], y)
+    lbl, vec = _checker_inputs()
+    model = SanityChecker(remove_bad_features=True).set_input(lbl, vec).fit(ds)
+    assert model.indices_to_keep == [0, 1]
+
+
+def test_remove_bad_features_false_keeps_all(rng):
+    y = rng.integers(0, 2, 200).astype(float)
+    x = np.stack([y, rng.normal(size=200)], axis=1)
+    ds = _vec_ds(x, [_col("leak"), _col("good")], y)
+    lbl, vec = _checker_inputs()
+    est = SanityChecker(remove_bad_features=False).set_input(lbl, vec)
+    model = est.fit(ds)
+    out = model.transform(ds)[est.output_name]
+    assert out.values.shape[1] == 2  # reported but not removed
+    assert est.metadata["sanityCheckerSummary"]["numDropped"] == 1
+
+
+def test_transform_removes_and_subsets_metadata(rng):
+    y = rng.integers(0, 2, 200).astype(float)
+    x = np.stack([y, rng.normal(size=200)], axis=1)
+    ds = _vec_ds(x, [_col("leak"), _col("good")], y)
+    lbl, vec = _checker_inputs()
+    est = SanityChecker(remove_bad_features=True).set_input(lbl, vec)
+    out = est.fit(ds).transform(ds)[est.output_name]
+    assert out.values.shape == (200, 1)
+    assert [c.parent_names for c in out.metadata.columns] == [("good",)]
+    assert out.metadata.columns[0].index == 0
+
+
+def test_titanic_transmogrify_plus_sanity_check(titanic_path):
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.readers import infer_csv_dataset
+    from transmogrifai_tpu.readers.core import DatasetReader
+    from transmogrifai_tpu.workflow.dag import raw_features_of
+    from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+
+    ds = infer_csv_dataset(titanic_path)
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checker = SanityChecker(remove_bad_features=True)
+    checked = resp.transform_with(checker, vector)
+    raw = DatasetReader(ds).generate_dataset(raw_features_of([checked]))
+    data, fitted = fit_and_transform_dag(raw, [checked])
+    out = data[checked.name]
+    before = data[vector.name].values.shape[1]
+    after = out.values.shape[1]
+    assert 0 < after <= before
+    assert np.isfinite(np.asarray(out.values)).all()
+    summary = checker.metadata["sanityCheckerSummary"]
+    assert summary["numColumns"] == before

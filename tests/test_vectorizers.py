@@ -1,0 +1,261 @@
+"""Vectorizer tests (parity: core/.../stages/impl/feature tests)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.categorical import OneHotVectorizer, top_values
+from transmogrifai_tpu.ops.dates import DateVectorizer, unit_circle
+from transmogrifai_tpu.ops.numeric import (
+    BinaryVectorizer,
+    IntegralVectorizer,
+    RealNNVectorizer,
+    RealVectorizer,
+)
+from transmogrifai_tpu.ops.text import (
+    HASH,
+    IGNORE,
+    PIVOT,
+    SmartTextVectorizer,
+    TextStats,
+    decide_method,
+)
+from transmogrifai_tpu.stages.metadata import NULL_STRING, OTHER_STRING
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils.text import clean_string, murmur3_32, tokenize
+from collections import Counter
+
+
+def _ds(**cols):
+    return Dataset.of({k: column_from_values(t, v) for k, (t, v) in cols.items()})
+
+
+# ------------------------------ text utils ---------------------------------
+def test_clean_string_reference_semantics():
+    # TextUtils.cleanString: lowercase, punct out, capitalize, join
+    assert clean_string("hello-world!") == "HelloWorld"
+    assert clean_string("MALE") == "Male"
+    assert clean_string("  a  b ") == "AB"
+
+
+def test_murmur3_deterministic_and_spread():
+    h1, h2 = murmur3_32("abc"), murmur3_32("abd")
+    assert h1 == murmur3_32("abc")
+    assert h1 != h2
+    # reference vector for murmur3_32 x86 seed 0
+    assert murmur3_32("", seed=0) == 0
+    assert murmur3_32("hello", seed=0) == 0x248BFA47
+
+
+def test_tokenize():
+    assert tokenize("Braund, Mr. Owen Harris") == ["braund", "mr", "owen", "harris"]
+    assert tokenize("a-b c", min_token_length=2) == []
+
+
+# --------------------------- numeric vectorizers ----------------------------
+def test_real_vectorizer_mean_impute_and_null_indicator():
+    age = FeatureBuilder.Real("age").as_predictor()
+    est = RealVectorizer().set_input(age)
+    ds = _ds(age=(T.Real, [10.0, None, 30.0]))
+    model = est.fit(ds)
+    out = model.transform(ds)[est.output_name]
+    np.testing.assert_allclose(
+        out.values, [[10.0, 0.0], [20.0, 1.0], [30.0, 0.0]]
+    )
+    metas = out.metadata.columns
+    assert metas[0].indicator_value is None
+    assert metas[1].is_null_indicator and metas[1].grouping == "age"
+    assert est.metadata["fills"] == [20.0]
+
+
+def test_integral_vectorizer_mode():
+    x = FeatureBuilder.Integral("x").as_predictor()
+    est = IntegralVectorizer().set_input(x)
+    ds = _ds(x=(T.Integral, [3, 3, 7, None]))
+    out = est.fit(ds).transform(ds)[est.output_name]
+    np.testing.assert_allclose(out.values[:, 0], [3, 3, 7, 3])
+    np.testing.assert_allclose(out.values[:, 1], [0, 0, 0, 1])
+
+
+def test_binary_and_realnn():
+    b = FeatureBuilder.Binary("b").as_predictor()
+    ds = _ds(b=(T.Binary, [True, None, False]))
+    t = BinaryVectorizer().set_input(b)
+    out = t.transform(ds)[t.output_name]
+    np.testing.assert_allclose(out.values, [[1, 0], [0, 1], [0, 0]])
+
+    r = FeatureBuilder.RealNN("r").as_predictor()
+    ds2 = _ds(r=(T.RealNN, [1.0, 2.0]))
+    t2 = RealNNVectorizer().set_input(r)
+    out2 = t2.transform(ds2)[t2.output_name]
+    assert out2.values.shape == (2, 1)
+
+
+def test_multiple_numerics_one_stage():
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    est = RealVectorizer().set_input(a, b)
+    ds = _ds(a=(T.Real, [1.0, None]), b=(T.Real, [None, 4.0]))
+    out = est.fit(ds).transform(ds)[est.output_name]
+    assert out.values.shape == (2, 4)
+    assert out.metadata.size == 4
+
+
+# ------------------------------ one-hot pivot -------------------------------
+def test_top_values_sorting_and_min_support():
+    counts = Counter({"b": 5, "a": 5, "c": 2, "d": 1})
+    assert top_values(counts, top_k=3, min_support=2) == ["a", "b", "c"]
+
+
+def test_one_hot_vectorizer_other_and_null():
+    p = FeatureBuilder.PickList("p").as_predictor()
+    est = OneHotVectorizer(top_k=2, min_support=1).set_input(p)
+    vals = ["x", "x", "y", "z", None]
+    ds = _ds(p=(T.PickList, vals))
+    model = est.fit(ds)
+    out = model.transform(ds)[est.output_name]
+    # vocab = [X, Y] (cleaned), then OTHER, then null
+    assert [m.indicator_value for m in out.metadata.columns] == [
+        "X", "Y", OTHER_STRING, NULL_STRING
+    ]
+    np.testing.assert_allclose(
+        out.values,
+        [
+            [1, 0, 0, 0],
+            [1, 0, 0, 0],
+            [0, 1, 0, 0],
+            [0, 0, 1, 0],
+            [0, 0, 0, 1],
+        ],
+    )
+
+
+def test_one_hot_min_support_filters():
+    p = FeatureBuilder.PickList("p").as_predictor()
+    est = OneHotVectorizer(top_k=10, min_support=3).set_input(p)
+    ds = _ds(p=(T.PickList, ["a"] * 3 + ["b"] * 2))
+    model = est.fit(ds)
+    assert est.metadata["vocabs"] == [["A"]]  # "b" below support -> OTHER
+    out = model.transform(ds)[est.output_name]
+    assert out.values[:, 1].sum() == 2  # two OTHER rows
+
+
+def test_one_hot_multipicklist_counts():
+    m = FeatureBuilder.MultiPickList("m").as_predictor()
+    est = OneHotVectorizer(top_k=5, min_support=1, clean_text=False).set_input(m)
+    ds = _ds(m=(T.MultiPickList, [{"a", "b"}, {"a"}, set()]))
+    out = est.fit(ds).transform(ds)[est.output_name]
+    vocab = [c.indicator_value for c in out.metadata.columns]
+    ia, ib = vocab.index("a"), vocab.index("b")
+    assert out.values[0, ia] == 1 and out.values[0, ib] == 1
+    assert out.values[2, vocab.index(NULL_STRING)] == 1
+
+
+# ------------------------------- smart text ---------------------------------
+def test_smart_text_decision_rules():
+    lo = TextStats.empty(30)
+    for i in range(10):
+        lo.add(f"v{i % 3}", ["tok"])
+    assert decide_method(lo, 30, 20, 1, 0.9, 0.0) == PIVOT
+
+    hi = TextStats.empty(30)
+    for i in range(200):
+        hi.add(f"unique{i}", [f"tok{i}", "abcdef"])
+    assert decide_method(hi, 30, 20, 1, 0.9, 0.0) == HASH
+    # same-length tokens below stddev threshold -> ignore
+    flat = TextStats.empty(30)
+    for i in range(200):
+        flat.add(f"u{i:04d}", ["abcde"])
+    assert decide_method(flat, 30, 20, 1, 0.9, 10.0) == IGNORE
+
+
+def test_smart_text_vectorizer_pivots_low_cardinality():
+    s = FeatureBuilder.Text("sex").as_predictor()
+    est = SmartTextVectorizer(min_support=1, top_k=5).set_input(s)
+    ds = _ds(sex=(T.Text, ["male", "female", "male", None]))
+    model = est.fit(ds)
+    assert est.metadata["textStats"][0]["method"] == PIVOT
+    out = model.transform(ds)[est.output_name]
+    assert [m.indicator_value for m in out.metadata.columns] == [
+        "Male", "Female", OTHER_STRING, NULL_STRING
+    ]
+
+
+def test_smart_text_vectorizer_hashes_high_cardinality():
+    s = FeatureBuilder.Text("name").as_predictor()
+    est = SmartTextVectorizer(max_cardinality=5, num_hashes=16, min_support=2).set_input(s)
+    names = [f"person {i} name{i}" for i in range(50)]
+    ds = _ds(name=(T.Text, names))
+    model = est.fit(ds)
+    assert est.metadata["textStats"][0]["method"] == HASH
+    out = model.transform(ds)[est.output_name]
+    assert out.values.shape == (50, 17)  # 16 hash buckets + null indicator
+    assert out.metadata.columns[-1].is_null_indicator
+    assert out.values[:, :16].sum() > 0
+
+
+# --------------------------------- dates ------------------------------------
+def test_unit_circle_known_timestamp():
+    # 2020-01-01T06:00:00Z = hour 6 -> angle pi/2 -> sin 1, cos 0
+    ms = np.array([1577858400000], dtype=np.int64)
+    mask = np.array([True])
+    out = unit_circle(ms, mask, "HourOfDay")
+    np.testing.assert_allclose(out, [[1.0, 0.0]], atol=1e-12)
+    # missing -> zeros
+    out2 = unit_circle(ms, np.array([False]), "HourOfDay")
+    np.testing.assert_allclose(out2, [[0.0, 0.0]])
+
+
+def test_date_vectorizer_shapes_and_since_last():
+    d = FeatureBuilder.Date("d").as_predictor()
+    ref = 1577858400000  # fixed reference
+    t = DateVectorizer(reference_date_ms=ref).set_input(d)
+    one_day_before = ref - 86_400_000
+    ds = _ds(d=(T.Date, [one_day_before, None]))
+    out = t.transform(ds)[t.output_name]
+    # 4 periods * 2 + SinceLast + null = 10 columns
+    assert out.values.shape == (2, 10)
+    since = out.values[0, 8]
+    assert since == pytest.approx(1.0)
+    assert out.values[1, 9] == 1.0  # null indicator
+
+
+# ----------------------------- transmogrify ---------------------------------
+def test_transmogrify_titanic_end_to_end(titanic_path):
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.readers import infer_csv_dataset
+    from transmogrifai_tpu.readers.core import DatasetReader
+    from transmogrifai_tpu.workflow.dag import raw_features_of
+    from transmogrifai_tpu.workflow.fit import (
+        apply_transformations_dag,
+        fit_and_transform_dag,
+    )
+
+    ds = infer_csv_dataset(titanic_path)
+    resp, preds = from_dataset(ds, response="Survived")
+    # drop the row-id column as a modeler would
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    raw = DatasetReader(ds).generate_dataset(raw_features_of([vector, resp]))
+    data, fitted = fit_and_transform_dag(raw, [vector])
+    vec = data[vector.name]
+    assert vec.values.shape[0] == 891
+    assert vec.metadata is not None and vec.metadata.size == vec.values.shape[1]
+    assert vec.values.shape[1] > 10
+    assert np.isfinite(vec.values).all()
+    # every column traces back to a raw feature
+    parents = {p for c in vec.metadata.columns for p in c.parent_names}
+    assert "Sex" in parents and "Age" in parents and "Pclass" in parents
+    # scoring path reproduces the training transform
+    rescored = apply_transformations_dag(raw, [vector], fitted)
+    np.testing.assert_allclose(rescored[vector.name].values, vec.values)
+
+
+def test_transmogrify_unsupported_type_clear_error():
+    from transmogrifai_tpu.ops import transmogrify
+
+    g = FeatureBuilder.Geolocation("g").as_predictor()
+    with pytest.raises(NotImplementedError):
+        transmogrify([g])

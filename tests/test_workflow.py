@@ -1,0 +1,164 @@
+"""End-to-end workflow tests — Titanic / Iris / Boston, the reference's
+helloworld trio (parity: OpWorkflowTest, OpTitanicSimple/OpIrisSimple/
+OpBostonSimple)."""
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.readers import infer_csv_dataset
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector,
+    MultiClassificationModelSelector,
+    RegressionModelSelector,
+)
+from transmogrifai_tpu.types.columns import NumericColumn, column_from_values
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+IRIS = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.csv"
+BOSTON = "/root/reference/helloworld/src/main/resources/BostonDataset/housingData.csv"
+
+
+@pytest.fixture(scope="module")
+def titanic_model(request):
+    titanic = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+    if not os.path.exists(titanic):
+        pytest.skip("no titanic data")
+    ds = infer_csv_dataset(titanic)
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(
+        SanityChecker(remove_bad_features=True), vector
+    )
+    selector = BinaryClassificationModelSelector(seed=7)
+    pred = selector.set_input(resp, checked).get_output()
+    model = (
+        Workflow()
+        .set_result_features(pred)
+        .set_input_dataset(ds)
+        .train()
+    )
+    return ds, resp, pred, selector, model
+
+
+def test_titanic_workflow_trains_and_scores(titanic_model):
+    ds, resp, pred, selector, model = titanic_model
+    summary = model.summary_json()
+    sel = summary["modelSelectorSummary"]
+    assert sel["problemKind"] == "BinaryClassification"
+    assert len(sel["validationResults"]) == 8  # LR grid 4 reg x 2 elasticnet
+    # train AuPR should beat random (positive rate ~0.38)
+    assert sel["trainEvaluation"]["AuPR"] > 0.6
+    assert sel["holdoutEvaluation"] is not None
+    assert sel["holdoutEvaluation"]["AuPR"] > 0.5
+
+    scores = model.score(dataset=ds)
+    assert scores.num_rows == ds.num_rows
+    pcol = scores[pred.name]
+    probs = np.asarray(pcol.probability)
+    assert probs.shape == (891, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-9)
+    assert set(np.unique(pcol.prediction)) <= {0.0, 1.0}
+
+
+def test_titanic_score_without_label(titanic_model):
+    ds, resp, pred, selector, model = titanic_model
+    no_label = ds.drop(["Survived"])
+    scores = model.score(dataset=no_label)
+    assert scores.num_rows == ds.num_rows
+
+
+def test_titanic_evaluate_and_summary_pretty(titanic_model):
+    ds, resp, pred, selector, model = titanic_model
+    metrics = model.evaluate(ds)
+    assert metrics["AuROC"] > 0.7  # full-data eval of the selected model
+    pretty = model.summary_pretty()
+    assert "LogisticRegression" in pretty
+    assert "AuPR" in pretty and "Holdout" in pretty
+
+
+def test_iris_multiclass_workflow():
+    if not os.path.exists(IRIS):
+        pytest.skip("no iris data")
+    ds = infer_csv_dataset(
+        IRIS,
+        headers=["id", "sepal_l", "sepal_w", "petal_l", "petal_w", "species"],
+    )
+    species = ds["species"].to_list()
+    classes = sorted(set(species))
+    label = column_from_values(T.Integral, [classes.index(s) for s in species])
+    ds = ds.drop(["species", "id"]).with_column("label", label)
+    resp, preds = from_dataset(ds, response="label")
+    vector = transmogrify(preds)
+    selector = MultiClassificationModelSelector(seed=3)
+    pred = selector.set_input(resp, vector).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    sel = model.summary_json()["modelSelectorSummary"]
+    assert sel["trainEvaluation"]["F1"] > 0.9  # iris is easy
+    scores = model.score(dataset=ds)
+    assert np.asarray(scores[pred.name].probability).shape[1] == 3
+
+
+def test_boston_regression_workflow():
+    if not os.path.exists(BOSTON):
+        pytest.skip("no boston data")
+    headers = [
+        "rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age",
+        "dis", "rad", "tax", "ptratio", "b", "lstat", "medv",
+    ]
+    ds = infer_csv_dataset(BOSTON, headers=headers)
+    ds = ds.drop(["rowId"])
+    resp, preds = from_dataset(ds, response="medv")
+    vector = transmogrify(preds)
+    selector = RegressionModelSelector(seed=11)
+    pred = selector.set_input(resp, vector).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    sel = model.summary_json()["modelSelectorSummary"]
+    assert sel["problemKind"] == "Regression"
+    assert sel["trainEvaluation"]["R2"] > 0.6
+    assert sel["holdoutEvaluation"]["RMSE"] < 10
+
+
+def test_workflow_rejects_two_selectors(titanic_model):
+    ds, resp, *_ = titanic_model
+    _, preds = from_dataset(ds, response="Survived")
+    vector = transmogrify([p for p in preds if p.name != "PassengerId"])
+    s1 = BinaryClassificationModelSelector()
+    s2 = BinaryClassificationModelSelector()
+    p1 = s1.set_input(resp, vector).get_output()
+    p2 = s2.set_input(resp, vector).get_output()
+    with pytest.raises(ValueError, match="ModelSelector"):
+        Workflow().set_result_features(p1, p2).set_input_dataset(ds).train()
+
+
+def test_stage_parameter_overrides(titanic_model):
+    ds, *_ = titanic_model
+    resp, preds = from_dataset(ds, response="Survived")
+    vector = transmogrify([p for p in preds if p.name != "PassengerId"])
+    checker = SanityChecker(remove_bad_features=False)
+    checked = resp.transform_with(checker, vector)
+    wf = (
+        Workflow()
+        .set_result_features(checked)
+        .set_input_dataset(ds)
+        .set_stage_parameters({"SanityChecker": {"remove_bad_features": True}})
+    )
+    wf.train()
+    assert checker.remove_bad_features is True
+
+
+def test_empty_training_data_rejected(titanic_model):
+    ds, *_ = titanic_model
+    resp, preds = from_dataset(ds, response="Survived")
+    vector = transmogrify([p for p in preds if p.name != "PassengerId"])
+    sel = BinaryClassificationModelSelector()
+    pred = sel.set_input(resp, vector).get_output()
+    tiny = ds.take(np.array([], dtype=int))
+    with pytest.raises(ValueError, match="empty"):
+        Workflow().set_result_features(pred).set_input_dataset(tiny).train()

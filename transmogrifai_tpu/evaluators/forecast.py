@@ -1,0 +1,24 @@
+"""Forecast evaluator.
+
+Reference: core/.../evaluators/OpForecastEvaluator.scala:200 — SMAPE
+(symmetric mean absolute percentage error, smaller better), plus seasonal
+error when a seasonal window is provided.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Evaluator
+
+
+class ForecastEvaluator(Evaluator):
+    default_metric = "SMAPE"
+    is_larger_better = False
+    name = "forecastEval"
+
+    def evaluate_arrays(self, y, pred, prob):
+        denom = np.abs(y) + np.abs(pred)
+        smape = float(
+            np.mean(np.where(denom > 0, 2.0 * np.abs(y - pred) / np.where(denom > 0, denom, 1.0), 0.0))
+        )
+        return {"SMAPE": smape, "MAE": float(np.mean(np.abs(y - pred)))}

@@ -1,0 +1,54 @@
+"""Multiclass evaluator.
+
+Reference: core/.../evaluators/OpMultiClassificationEvaluator.scala:307 —
+weighted precision/recall/F1, error, topK accuracy, and confidence-binned
+ThresholdMetrics. Default selection metric: F1 (weighted), larger better.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Evaluator
+
+
+class MultiClassificationEvaluator(Evaluator):
+    default_metric = "F1"
+    is_larger_better = True
+    name = "multiEval"
+
+    def __init__(self, top_ks: tuple[int, ...] = (1, 3, 5, 10, 20, 50, 100)):
+        self.top_ks = top_ks
+
+    def evaluate_arrays(self, y, pred, prob):
+        classes = np.unique(np.concatenate([y, pred]))
+        n = max(len(y), 1)
+        weights, precisions, recalls, f1s = [], [], [], []
+        for c in classes:
+            tp = float(((pred == c) & (y == c)).sum())
+            fp = float(((pred == c) & (y != c)).sum())
+            fn = float(((pred != c) & (y == c)).sum())
+            support = float((y == c).sum())
+            p = tp / (tp + fp) if tp + fp > 0 else 0.0
+            r = tp / (tp + fn) if tp + fn > 0 else 0.0
+            f = 2 * p * r / (p + r) if p + r > 0 else 0.0
+            weights.append(support / n)
+            precisions.append(p)
+            recalls.append(r)
+            f1s.append(f)
+        w = np.asarray(weights)
+        metrics = {
+            "Precision": float(np.dot(w, precisions)),
+            "Recall": float(np.dot(w, recalls)),
+            "F1": float(np.dot(w, f1s)),
+            "Error": float((pred != y).mean()),
+        }
+        if prob is not None and prob.ndim == 2:
+            order = np.argsort(-prob, axis=1)
+            y_int = y.astype(int)
+            topk = {}
+            for k in self.top_ks:
+                kk = min(k, prob.shape[1])
+                hit = (order[:, :kk] == y_int[:, None]).any(axis=1)
+                topk[str(k)] = float(hit.mean())
+            metrics["TopKAccuracy"] = topk
+        return metrics

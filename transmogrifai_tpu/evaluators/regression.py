@@ -1,0 +1,28 @@
+"""Regression evaluator.
+
+Reference: core/.../evaluators/OpRegressionEvaluator.scala — RMSE (default,
+smaller better), MSE, R2, MAE.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Evaluator
+
+
+class RegressionEvaluator(Evaluator):
+    default_metric = "RMSE"
+    is_larger_better = False
+    name = "regEval"
+
+    def evaluate_arrays(self, y, pred, prob):
+        err = y - pred
+        mse = float(np.mean(err**2))
+        ss_tot = float(np.sum((y - y.mean()) ** 2))
+        ss_res = float(np.sum(err**2))
+        return {
+            "RMSE": float(np.sqrt(mse)),
+            "MSE": mse,
+            "R2": 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0,
+            "MAE": float(np.mean(np.abs(err))),
+        }

@@ -63,12 +63,19 @@ class Feature:
         return dists
 
     def raw_features(self) -> list["Feature"]:
-        """All raw-feature leaves under this feature."""
+        """All raw-feature leaves under this feature. Two distinct raw
+        features sharing a name is an error — they would silently read each
+        other's data in the materialized dataset."""
         seen: dict[str, Feature] = {}
 
         def visit(f: "Feature") -> None:
             if f.is_raw or f.origin_stage is None:
-                seen.setdefault(f.name, f)
+                prior = seen.get(f.name)
+                if prior is not None and prior.uid != f.uid:
+                    raise ValueError(
+                        f"Two distinct raw features named '{f.name}' in one DAG"
+                    )
+                seen[f.name] = f
             for p in f.parents:
                 visit(p)
 

@@ -1,0 +1,4 @@
+"""Model catalog (reference: core/.../stages/impl/{classification,regression})."""
+from .base import PredictorEstimator, PredictorModel  # noqa: F401
+from .logistic import LogisticRegression  # noqa: F401
+from .linear import LinearRegression  # noqa: F401

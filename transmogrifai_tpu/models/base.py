@@ -1,0 +1,88 @@
+"""Predictor stage bases.
+
+Reference: core/.../stages/sparkwrappers/specific/OpPredictorWrapper.scala:70 —
+every model family is an Estimator[(RealNN label, OPVector features)] ->
+Prediction, producing a model whose transform emits the Prediction column
+(prediction + probability_* + rawPrediction_*).
+
+TPU design: ``fit_arrays(x, y, row_mask)`` is the whole training step — a
+pure jitted function of dense arrays, so fold masks and hyperparameter grids
+become vmap axes in the model selector rather than driver threads.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..stages.base import Estimator, Model
+from ..types import OPVector, Prediction, RealNN
+from ..types.columns import Column, NumericColumn, PredictionColumn, VectorColumn
+
+
+class PredictorModel(Model):
+    output_type = Prediction
+
+    def predict_arrays(
+        self, x: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray | None, np.ndarray | None]:
+        """(prediction [N], probability [N,C]|None, raw [N,C]|None)."""
+        raise NotImplementedError
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> PredictionColumn:
+        vec = cols[-1]
+        assert isinstance(vec, VectorColumn), "predictor expects (label, features)"
+        pred, prob, raw = self.predict_arrays(np.asarray(vec.values, dtype=np.float32))
+        return PredictionColumn(
+            Prediction,
+            np.asarray(pred, dtype=np.float64),
+            None if prob is None else np.asarray(prob, dtype=np.float64),
+            None if raw is None else np.asarray(raw, dtype=np.float64),
+        )
+
+
+class PredictorEstimator(Estimator):
+    """Base for model-family estimators. Subclasses implement
+    ``fit_arrays(x, y, row_mask) -> PredictorModel`` and expose their
+    hyperparameters as attributes + ``get_params``."""
+
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+
+    def extract_xy(self, dataset: Dataset) -> tuple[np.ndarray, np.ndarray]:
+        label_name, vec_name = self.input_names
+        label = dataset[label_name]
+        vec = dataset[vec_name]
+        assert isinstance(label, NumericColumn) and isinstance(vec, VectorColumn)
+        return (
+            np.asarray(vec.values, dtype=np.float32),
+            label.values.astype(np.float32),
+        )
+
+    def fit_model(self, dataset: Dataset) -> PredictorModel:
+        x, y = self.extract_xy(dataset)
+        mask = np.ones(len(y), dtype=np.float32)
+        return self.fit_arrays(x, y, mask)
+
+    def fit_arrays(
+        self, x: np.ndarray, y: np.ndarray, row_mask: np.ndarray
+    ) -> PredictorModel:
+        raise NotImplementedError
+
+    # ---- grid support ----------------------------------------------------
+    def with_params(self, **params: Any) -> "PredictorEstimator":
+        """A copy of this estimator with hyperparameters overridden (used by
+        the model selector's grid expansion)."""
+        import copy
+
+        c = copy.copy(self)
+        from ..utils import uid as uid_util
+
+        c.uid = uid_util.make_uid(type(self))
+        c.metadata = {}
+        for k, v in params.items():
+            if not hasattr(c, k):
+                raise AttributeError(f"{type(self).__name__} has no param {k}")
+            setattr(c, k, v)
+        return c

@@ -1,0 +1,65 @@
+"""Linear regression with elastic net.
+
+Reference: core/.../stages/impl/regression/OpLinearRegression.scala (wraps
+Spark LinearRegression / WLS). XLA-native solver in models/solvers.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import PredictorEstimator, PredictorModel
+from .solvers import fit_linear
+
+
+class LinearRegressionModel(PredictorModel):
+    def __init__(self, weights: np.ndarray, intercept: float, uid: str | None = None):
+        super().__init__("linreg", uid=uid)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.intercept = float(intercept)
+
+    def get_arrays(self):
+        return {"weights": self.weights, "intercept": np.float64(self.intercept)}
+
+    def predict_arrays(self, x: np.ndarray):
+        pred = x @ self.weights + self.intercept
+        return pred, None, None
+
+
+class LinearRegression(PredictorEstimator):
+    model_type = "OpLinearRegression"
+
+    def __init__(
+        self,
+        reg_param: float = 0.0,
+        elastic_net_param: float = 0.0,
+        max_iter: int = 100,
+        fit_intercept: bool = True,
+        uid: str | None = None,
+    ):
+        super().__init__("linreg", uid=uid)
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.max_iter = max_iter
+        self.fit_intercept = fit_intercept
+
+    def get_params(self):
+        return {
+            "reg_param": self.reg_param,
+            "elastic_net_param": self.elastic_net_param,
+            "max_iter": self.max_iter,
+            "fit_intercept": self.fit_intercept,
+        }
+
+    def fit_arrays(self, x, y, row_mask):
+        params = fit_linear(
+            x,
+            y,
+            row_mask,
+            float(self.reg_param),
+            float(self.elastic_net_param),
+            num_iters=max(self.max_iter * 4, 200),
+            fit_intercept=self.fit_intercept,
+        )
+        return LinearRegressionModel(
+            np.asarray(params.weights), float(params.intercept)
+        )

@@ -1,0 +1,164 @@
+"""Logistic regression (binary + multinomial).
+
+Reference: core/.../stages/impl/classification/OpLogisticRegression.scala —
+wraps Spark LR (L-BFGS/OWL-QN over native BLAS). Here training is the pure
+XLA solver in models/solvers.py; gradients over a sharded batch reduce with
+``psum`` when the data axis is sharded over a mesh.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .base import PredictorEstimator, PredictorModel
+from .solvers import fit_logistic_binary, fit_logistic_multinomial
+
+
+class LogisticRegressionModel(PredictorModel):
+    def __init__(
+        self,
+        weights: np.ndarray,       # [D] binary or [D, C] multinomial
+        intercept: np.ndarray,     # scalar or [C]
+        num_classes: int,
+        uid: str | None = None,
+    ):
+        super().__init__("logreg", uid=uid)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.intercept = np.asarray(intercept, dtype=np.float64)
+        self.num_classes = num_classes
+
+    def get_arrays(self):
+        return {"weights": self.weights, "intercept": self.intercept}
+
+    def get_params(self):
+        return {"num_classes": self.num_classes}
+
+    def predict_arrays(self, x: np.ndarray):
+        if self.num_classes == 2:
+            margin = x @ self.weights + self.intercept
+            p1 = 1.0 / (1.0 + np.exp(-margin))
+            prob = np.stack([1.0 - p1, p1], axis=1)
+            raw = np.stack([-margin, margin], axis=1)
+        else:
+            logits = x @ self.weights + self.intercept
+            logits -= logits.max(axis=1, keepdims=True)
+            e = np.exp(logits)
+            prob = e / e.sum(axis=1, keepdims=True)
+            raw = logits
+        pred = prob.argmax(axis=1).astype(np.float64)
+        return pred, prob, raw
+
+
+class LogisticRegression(PredictorEstimator):
+    """Params mirror Spark LR defaults (regParam=0, elasticNetParam=0,
+    maxIter=100, standardization=true, fitIntercept=true)."""
+
+    model_type = "OpLogisticRegression"
+
+    def __init__(
+        self,
+        reg_param: float = 0.0,
+        elastic_net_param: float = 0.0,
+        max_iter: int = 100,
+        fit_intercept: bool = True,
+        standardization: bool = True,
+        uid: str | None = None,
+    ):
+        super().__init__("logreg", uid=uid)
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.max_iter = max_iter
+        self.fit_intercept = fit_intercept
+        self.standardization = standardization
+
+    def get_params(self):
+        return {
+            "reg_param": self.reg_param,
+            "elastic_net_param": self.elastic_net_param,
+            "max_iter": self.max_iter,
+            "fit_intercept": self.fit_intercept,
+            "standardization": self.standardization,
+        }
+
+    def fit_arrays(self, x, y, row_mask):
+        present = y[row_mask > 0]
+        num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
+        # FISTA needs more iterations than Newton for tight convergence;
+        # scale the budget (maxIter is the Spark-semantic knob).
+        iters = max(self.max_iter * 4, 200)
+        if num_classes == 2:
+            params = fit_logistic_binary(
+                x,
+                y,
+                row_mask,
+                float(self.reg_param),
+                float(self.elastic_net_param),
+                num_iters=iters,
+                fit_intercept=self.fit_intercept,
+            )
+        else:
+            params = fit_logistic_multinomial(
+                x,
+                y,
+                row_mask,
+                float(self.reg_param),
+                float(self.elastic_net_param),
+                num_classes=num_classes,
+                num_iters=iters,
+                fit_intercept=self.fit_intercept,
+            )
+        return LogisticRegressionModel(
+            np.asarray(params.weights), np.asarray(params.intercept), num_classes
+        )
+
+    def fit_arrays_batched(self, x, y, row_mask, grid_points):
+        """Train the whole hyperparameter grid as ONE vmapped XLA computation
+        (SURVEY.md §2.6: the reference's driver thread pool becomes a vmap
+        axis). Grid points sharing this estimator's static params (max_iter,
+        fit_intercept) vmap over (reg_param, elastic_net); stragglers fall
+        back to sequential fits."""
+        def _is_vmappable(p):
+            # only reg/elastic-net vary inside the vmap; any other overridden
+            # param must match this estimator's static value
+            return all(
+                k in ("reg_param", "elastic_net_param") or v == getattr(self, k)
+                for k, v in p.items()
+            )
+
+        vmappable = [i for i, p in enumerate(grid_points) if _is_vmappable(p)]
+        rest = [i for i in range(len(grid_points)) if i not in vmappable]
+        present = y[row_mask > 0]
+        num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
+        iters = max(self.max_iter * 4, 200)
+        models: dict[int, LogisticRegressionModel] = {}
+        if vmappable:
+            regs = np.asarray(
+                [grid_points[i].get("reg_param", self.reg_param) for i in vmappable],
+                dtype=np.float32,
+            )
+            ens = np.asarray(
+                [
+                    grid_points[i].get("elastic_net_param", self.elastic_net_param)
+                    for i in vmappable
+                ],
+                dtype=np.float32,
+            )
+            if num_classes == 2:
+                fn = lambda r, e: fit_logistic_binary(  # noqa: E731
+                    x, y, row_mask, r, e, num_iters=iters,
+                    fit_intercept=self.fit_intercept,
+                )
+            else:
+                fn = lambda r, e: fit_logistic_multinomial(  # noqa: E731
+                    x, y, row_mask, r, e, num_classes=num_classes,
+                    num_iters=iters, fit_intercept=self.fit_intercept,
+                )
+            stacked = jax.vmap(fn)(regs, ens)
+            w = np.asarray(stacked.weights)
+            b = np.asarray(stacked.intercept)
+            for j, i in enumerate(vmappable):
+                models[i] = LogisticRegressionModel(w[j], b[j], num_classes)
+        for i in rest:
+            models[i] = self.with_params(**grid_points[i]).fit_arrays(x, y, row_mask)
+        return [models[i] for i in range(len(grid_points))]

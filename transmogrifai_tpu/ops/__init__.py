@@ -1,0 +1,4 @@
+"""Feature-engineering stages (reference: core/.../stages/impl/feature/)."""
+from .defaults import TransmogrifierDefaults  # noqa: F401
+from .transmogrify import transmogrify  # noqa: F401
+from .combiner import VectorsCombiner  # noqa: F401

@@ -1,0 +1,70 @@
+"""Shared machinery for vectorizer stages.
+
+Reference pattern (features/.../stages/base/sequence/SequenceEstimator.scala):
+same-typed features are grouped into ONE sequence stage whose fit computes
+per-feature summaries and whose model emits one block of vector columns per
+input feature; blocks concatenate into the stage's OPVector output with
+column-provenance metadata.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..types import OPVector
+from ..types.columns import Column, VectorColumn
+from ..stages.base import Estimator, Model, Transformer
+from ..stages.metadata import ColumnMeta, VectorMetadata
+
+
+def assemble_vector(
+    name: str,
+    blocks: Sequence[np.ndarray],
+    metas: Sequence[Sequence[ColumnMeta]],
+) -> VectorColumn:
+    """Concatenate per-feature blocks [N, d_i] into one VectorColumn with
+    flattened, reindexed metadata."""
+    parts = [VectorMetadata(name, tuple(m)) for m in metas]
+    metadata = VectorMetadata.flatten(name, parts)
+    if blocks:
+        values = np.concatenate([np.asarray(b, dtype=np.float32) for b in blocks], axis=1)
+    else:
+        values = np.zeros((0, 0), dtype=np.float32)
+    assert values.shape[1] == metadata.size, (values.shape, metadata.size)
+    return VectorColumn(OPVector, values, metadata)
+
+
+class VectorizerModel(Model):
+    """Base fitted vectorizer: subclasses implement ``blocks_for`` returning
+    (block matrix [N, d], column metas) per input feature column."""
+
+    output_type = OPVector
+
+    def blocks_for(
+        self, cols: Sequence[Column], num_rows: int
+    ) -> tuple[list[np.ndarray], list[list[ColumnMeta]]]:
+        raise NotImplementedError
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        blocks, metas = self.blocks_for(cols, num_rows)
+        return assemble_vector(self.output_name, blocks, metas)
+
+
+class VectorizerEstimator(Estimator):
+    output_type = OPVector
+
+
+class VectorizerTransformer(Transformer):
+    """Fit-free vectorizer (pure transformer)."""
+
+    output_type = OPVector
+
+    def blocks_for(
+        self, cols: Sequence[Column], num_rows: int
+    ) -> tuple[list[np.ndarray], list[list[ColumnMeta]]]:
+        raise NotImplementedError
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        blocks, metas = self.blocks_for(cols, num_rows)
+        return assemble_vector(self.output_name, blocks, metas)

@@ -1,0 +1,186 @@
+"""One-hot pivot vectorizers for categorical text and sets.
+
+Reference: core/.../stages/impl/feature/OpOneHotVectorizer.scala (topK +
+minSupport pivot with OTHER and null-indicator columns). Semantics mirrored
+from SmartTextVectorizer.scala:93-120 / OpSetVectorizer:
+  * values are cleaned (TextUtils.cleanString) when clean_text is set;
+  * top values = counts filtered to >= min_support, sorted by (-count, value),
+    first top_k kept;
+  * transform emits one 0/1 column per top value, an OTHER column counting
+    any present-but-not-top value, and a null-indicator column when
+    track_nulls.
+
+The pivot transform is a vocabulary lookup (host-side, string -> index) plus
+a one-hot scatter — the scatter half is what runs on device in the compiled
+scoring path.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..stages.metadata import NULL_STRING, OTHER_STRING, ColumnMeta
+from ..types.columns import Column, SetColumn, TextColumn
+from ..utils.text import clean_string
+from .base import VectorizerEstimator, VectorizerModel
+
+
+def top_values(
+    counts: Counter, top_k: int, min_support: int
+) -> list[str]:
+    """Pivot vocabulary (SmartTextVectorizer.scala:116-119: sort by
+    (-count, value), keep top_k of those with count >= min_support)."""
+    filtered = [(v, c) for v, c in counts.items() if c >= min_support]
+    filtered.sort(key=lambda vc: (-vc[1], vc[0]))
+    return [v for v, _ in filtered[:top_k]]
+
+
+def _clean(v: str | None, clean_text: bool) -> str | None:
+    if v is None:
+        return None
+    return clean_string(v) if clean_text else v
+
+
+def pivot_block(
+    values: list,  # per-row: str | None  OR  iterable[str] (sets)
+    vocab: list[str],
+    track_nulls: bool,
+    clean_text: bool,
+    is_set: bool,
+) -> np.ndarray:
+    """[N, len(vocab) + 1 (+1 if track_nulls)] pivot block."""
+    n = len(values)
+    width = len(vocab) + 1 + (1 if track_nulls else 0)
+    out = np.zeros((n, width), dtype=np.float64)
+    index = {v: i for i, v in enumerate(vocab)}
+    other_col = len(vocab)
+    null_col = other_col + 1
+    for r, raw in enumerate(values):
+        if is_set:
+            members = [_clean(m, clean_text) for m in raw] if raw else []
+            if not members:
+                if track_nulls:
+                    out[r, null_col] = 1.0
+                continue
+            for m in members:
+                j = index.get(m)
+                if j is None:
+                    out[r, other_col] += 1.0
+                else:
+                    out[r, j] += 1.0
+        else:
+            v = _clean(raw, clean_text)
+            if v is None:
+                if track_nulls:
+                    out[r, null_col] = 1.0
+            elif v in index:
+                out[r, index[v]] = 1.0
+            else:
+                out[r, other_col] = 1.0
+    return out
+
+
+def pivot_metas(
+    name: str, parent_type: type, vocab: list[str], track_nulls: bool
+) -> list[ColumnMeta]:
+    metas = [
+        ColumnMeta((name,), parent_type.__name__, grouping=name, indicator_value=v)
+        for v in vocab
+    ]
+    metas.append(
+        ColumnMeta(
+            (name,), parent_type.__name__, grouping=name, indicator_value=OTHER_STRING
+        )
+    )
+    if track_nulls:
+        metas.append(
+            ColumnMeta(
+                (name,), parent_type.__name__, grouping=name, indicator_value=NULL_STRING
+            )
+        )
+    return metas
+
+
+class OneHotModel(VectorizerModel):
+    def __init__(
+        self,
+        vocabs: list[list[str]],
+        track_nulls: bool,
+        clean_text: bool,
+        **kw,
+    ):
+        super().__init__("pivot", **kw)
+        self.vocabs = vocabs
+        self.track_nulls = track_nulls
+        self.clean_text = clean_text
+
+    def get_params(self):
+        return {
+            "vocabs": self.vocabs,
+            "track_nulls": self.track_nulls,
+            "clean_text": self.clean_text,
+        }
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for col, vocab, feat in zip(cols, self.vocabs, self.input_features):
+            is_set = isinstance(col, SetColumn)
+            blocks.append(
+                pivot_block(
+                    col.to_list(), vocab, self.track_nulls, self.clean_text, is_set
+                )
+            )
+            metas.append(pivot_metas(feat.name, feat.ftype, vocab, self.track_nulls))
+        return blocks, metas
+
+
+class OneHotVectorizer(VectorizerEstimator):
+    """Sequence estimator pivoting categorical text features
+    (OpOneHotVectorizer.scala:438 LoC; defaults TopK=20, MinSupport=10)."""
+
+    def __init__(
+        self,
+        top_k: int = 20,
+        min_support: int = 10,
+        clean_text: bool = True,
+        track_nulls: bool = True,
+        uid: str | None = None,
+    ):
+        super().__init__("pivotText", uid=uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.clean_text = clean_text
+        self.track_nulls = track_nulls
+
+    def get_params(self):
+        return {
+            "top_k": self.top_k,
+            "min_support": self.min_support,
+            "clean_text": self.clean_text,
+            "track_nulls": self.track_nulls,
+        }
+
+    def fit_model(self, dataset: Dataset) -> OneHotModel:
+        vocabs = []
+        for name in self.input_names:
+            col = dataset[name]
+            counts: Counter = Counter()
+            if isinstance(col, SetColumn):
+                for members in col.values:
+                    for m in members:
+                        m2 = _clean(m, self.clean_text)
+                        if m2 is not None:
+                            counts[m2] += 1
+            elif isinstance(col, TextColumn):
+                for v in col.values:
+                    v2 = _clean(v, self.clean_text)
+                    if v2 is not None:
+                        counts[v2] += 1
+            else:
+                raise TypeError(f"OneHotVectorizer cannot pivot {type(col).__name__}")
+            vocabs.append(top_values(counts, self.top_k, self.min_support))
+        self.metadata["vocabs"] = vocabs
+        return OneHotModel(vocabs, self.track_nulls, self.clean_text)

@@ -1,0 +1,45 @@
+"""VectorsCombiner — merge OPVector features into one.
+
+Reference: core/.../stages/impl/feature/VectorsCombiner.scala:51,82 — the
+final transmogrification step concatenates every per-type vector into the
+single feature vector fed to SanityChecker / models, flattening metadata.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..types import OPVector
+from ..types.columns import Column, VectorColumn
+from ..stages.base import Transformer
+from ..stages.metadata import VectorMetadata
+
+
+class VectorsCombiner(Transformer):
+    output_type = OPVector
+
+    def __init__(self, uid: str | None = None):
+        super().__init__("vecsCombine", uid=uid)
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        vecs = []
+        metas = []
+        for c in cols:
+            assert isinstance(c, VectorColumn), f"combine expects vectors, got {type(c)}"
+            vecs.append(np.asarray(c.values, dtype=np.float32))
+            metas.append(
+                c.metadata
+                if c.metadata is not None
+                else VectorMetadata("anon", ())
+            )
+        values = (
+            np.concatenate(vecs, axis=1)
+            if vecs
+            else np.zeros((num_rows, 0), dtype=np.float32)
+        )
+        metadata = VectorMetadata.flatten(self.output_name, metas)
+        if metadata.size != values.shape[1]:
+            # tolerate missing metadata on inputs by padding unknown columns
+            metadata = None
+        return VectorColumn(OPVector, values, metadata)

@@ -1,0 +1,126 @@
+"""Date/DateTime vectorizer: circular encodings + days-since-reference.
+
+Reference: dsl/RichDateFeature.scala:108-120 — vectorize = per-period unit
+circle (DateToUnitCircleTransformer.scala, sin/cos pairs for HourOfDay,
+DayOfWeek, DayOfMonth, DayOfYear) combined with DateList SinceLast pivot
+(days from the value to the reference date) + null indicator. Date values are
+epoch milliseconds (joda convention).
+
+Missing dates encode as (0, 0) on the unit circle (the reference maps empty
+to the zero vector) and 0 days-since with the null indicator set.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Sequence
+
+import numpy as np
+
+from ..stages.metadata import NULL_STRING, ColumnMeta
+from ..types.columns import Column, NumericColumn
+from .base import VectorizerTransformer
+from .defaults import DEFAULTS
+
+_MS_PER_DAY = 86_400_000.0
+
+_PERIOD_SIZE = {
+    "HourOfDay": 24.0,
+    "DayOfWeek": 7.0,
+    "DayOfMonth": 31.0,
+    "DayOfYear": 366.0,
+}
+
+
+def _period_values(ms: np.ndarray, period: str) -> np.ndarray:
+    """Extract the integer time-period component from epoch-ms values."""
+    if period == "HourOfDay":
+        return (ms // 3_600_000) % 24
+    if period == "DayOfWeek":
+        days = ms // 86_400_000
+        return ((days + 3) % 7) + 1  # epoch day 0 = Thursday; joda Mon=1
+    dts = [
+        _dt.datetime.fromtimestamp(m / 1000.0, tz=_dt.timezone.utc) for m in ms
+    ]
+    if period == "DayOfMonth":
+        return np.array([d.day for d in dts], dtype=np.float64)
+    if period == "DayOfYear":
+        return np.array([d.timetuple().tm_yday for d in dts], dtype=np.float64)
+    raise ValueError(f"Unknown time period {period}")
+
+
+def unit_circle(ms: np.ndarray, mask: np.ndarray, period: str) -> np.ndarray:
+    """[N, 2] (sin, cos) encoding; missing -> (0, 0)
+    (DateToUnitCircleTransformer.scala)."""
+    vals = _period_values(ms.astype(np.int64), period).astype(np.float64)
+    radians = 2.0 * np.pi * vals / _PERIOD_SIZE[period]
+    out = np.stack([np.sin(radians), np.cos(radians)], axis=1)
+    out[~mask] = 0.0
+    return out
+
+
+class DateVectorizer(VectorizerTransformer):
+    """Sequence transformer for Date/DateTime features."""
+
+    def __init__(
+        self,
+        reference_date_ms: int | None = None,
+        circular_reps: Sequence[str] = DEFAULTS.CircularDateRepresentations,
+        track_nulls: bool = True,
+        uid: str | None = None,
+    ):
+        super().__init__("vecDate", uid=uid)
+        if reference_date_ms is None:
+            # Fixed at stage construction (TransmogrifierDefaults.ReferenceDate
+            # = DateTimeUtils.now()).
+            reference_date_ms = int(
+                _dt.datetime.now(tz=_dt.timezone.utc).timestamp() * 1000
+            )
+        self.reference_date_ms = reference_date_ms
+        self.circular_reps = tuple(circular_reps)
+        self.track_nulls = track_nulls
+
+    def get_params(self):
+        return {
+            "reference_date_ms": self.reference_date_ms,
+            "circular_reps": list(self.circular_reps),
+            "track_nulls": self.track_nulls,
+        }
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for col, feat in zip(cols, self.input_features):
+            assert isinstance(col, NumericColumn)
+            parts = []
+            metas_f: list[ColumnMeta] = []
+            for period in self.circular_reps:
+                parts.append(unit_circle(col.values, col.mask, period))
+                for comp in ("x", "y"):
+                    metas_f.append(
+                        ColumnMeta(
+                            (feat.name,),
+                            feat.ftype.__name__,
+                            descriptor_value=f"{comp}_{period}",
+                        )
+                    )
+            # SinceLast: days from value to reference date (DateListPivot)
+            days = (self.reference_date_ms - col.values.astype(np.float64)) / _MS_PER_DAY
+            days = np.where(col.mask, days, 0.0)
+            parts.append(days[:, None])
+            metas_f.append(
+                ColumnMeta(
+                    (feat.name,), feat.ftype.__name__, descriptor_value="SinceLast"
+                )
+            )
+            if self.track_nulls:
+                parts.append((~col.mask).astype(np.float64)[:, None])
+                metas_f.append(
+                    ColumnMeta(
+                        (feat.name,),
+                        feat.ftype.__name__,
+                        grouping=feat.name,
+                        indicator_value=NULL_STRING,
+                    )
+                )
+            blocks.append(np.concatenate(parts, axis=1))
+            metas.append(metas_f)
+        return blocks, metas

@@ -1,0 +1,185 @@
+"""Numeric vectorizers: Real/Currency/Percent (mean imputation), Integral
+(mode imputation), Binary (constant fill), RealNN (passthrough).
+
+Reference: core/.../stages/impl/feature/{RealVectorizer (fillWithMean),
+IntegralVectorizer (fillWithMode), BinaryVectorizer, RealNNVectorizer} —
+dispatch defaults at Transmogrifier.scala:252-273. Each nullable feature
+contributes [imputed value, null-indicator] columns (trackNulls on by
+default); RealNN contributes a single passthrough column.
+
+Fit is a monoid reduction (sum/count for mean; value counts for mode), so the
+statistics are shard-order-invariant and map onto ``psum`` when the column is
+sharded over a device mesh.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..stages.metadata import NULL_STRING, ColumnMeta
+from ..types import Binary, Integral, OPNumeric, Real, RealNN
+from ..types.columns import Column, NumericColumn
+from .base import VectorizerEstimator, VectorizerModel, VectorizerTransformer
+
+
+def _value_and_null_meta(
+    name: str, parent_type: type, track_nulls: bool
+) -> list[ColumnMeta]:
+    metas = [ColumnMeta(parent_names=(name,), parent_type=parent_type.__name__)]
+    if track_nulls:
+        metas.append(
+            ColumnMeta(
+                parent_names=(name,),
+                parent_type=parent_type.__name__,
+                grouping=name,
+                indicator_value=NULL_STRING,
+            )
+        )
+    return metas
+
+
+def _impute_block(
+    col: NumericColumn, fill: float, track_nulls: bool
+) -> np.ndarray:
+    vals = np.where(col.mask, col.values.astype(np.float64), fill)
+    if track_nulls:
+        return np.stack([vals, (~col.mask).astype(np.float64)], axis=1)
+    return vals[:, None]
+
+
+class NumericVectorizerModel(VectorizerModel):
+    def __init__(self, fills: list[float], track_nulls: bool, **kw):
+        super().__init__("vecNumeric", **kw)
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for col, fill, feat in zip(cols, self.fills, self.input_features):
+            assert isinstance(col, NumericColumn)
+            blocks.append(_impute_block(col, fill, self.track_nulls))
+            metas.append(
+                _value_and_null_meta(feat.name, feat.ftype, self.track_nulls)
+            )
+        return blocks, metas
+
+    def get_arrays(self):
+        return {"fills": np.asarray(self.fills, dtype=np.float64)}
+
+    def get_params(self):
+        return {"fills": list(map(float, self.fills)), "track_nulls": self.track_nulls}
+
+
+class RealVectorizer(VectorizerEstimator):
+    """Mean-imputing vectorizer for Real/Currency/Percent
+    (RealVectorizer.scala; fillWithMean=true, trackNulls=true defaults)."""
+
+    def __init__(
+        self,
+        fill_with_mean: bool = True,
+        fill_value: float = 0.0,
+        track_nulls: bool = True,
+        uid: str | None = None,
+    ):
+        super().__init__("vecReal", uid=uid)
+        self.fill_with_mean = fill_with_mean
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def get_params(self):
+        return {
+            "fill_with_mean": self.fill_with_mean,
+            "fill_value": self.fill_value,
+            "track_nulls": self.track_nulls,
+        }
+
+    def fit_model(self, dataset: Dataset) -> NumericVectorizerModel:
+        fills = []
+        for name in self.input_names:
+            col = dataset[name]
+            assert isinstance(col, NumericColumn)
+            if self.fill_with_mean:
+                # monoid (sum, count) reduction — psum-compatible
+                cnt = int(col.mask.sum())
+                mean = float(col.values[col.mask].sum() / cnt) if cnt else 0.0
+                fills.append(mean)
+            else:
+                fills.append(float(self.fill_value))
+        self.metadata["fills"] = fills
+        return NumericVectorizerModel(fills, self.track_nulls)
+
+
+class IntegralVectorizer(VectorizerEstimator):
+    """Mode-imputing vectorizer for Integral (IntegralVectorizer.scala;
+    fillWithMode=true default). Mode ties break on smallest value."""
+
+    def __init__(
+        self,
+        fill_with_mode: bool = True,
+        fill_value: float = 0.0,
+        track_nulls: bool = True,
+        uid: str | None = None,
+    ):
+        super().__init__("vecIntegral", uid=uid)
+        self.fill_with_mode = fill_with_mode
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def get_params(self):
+        return {
+            "fill_with_mode": self.fill_with_mode,
+            "fill_value": self.fill_value,
+            "track_nulls": self.track_nulls,
+        }
+
+    def fit_model(self, dataset: Dataset) -> NumericVectorizerModel:
+        fills = []
+        for name in self.input_names:
+            col = dataset[name]
+            assert isinstance(col, NumericColumn)
+            present = col.values[col.mask]
+            if self.fill_with_mode and len(present):
+                vals, counts = np.unique(present, return_counts=True)
+                fills.append(float(vals[np.argmax(counts)]))
+            else:
+                fills.append(float(self.fill_value))
+        self.metadata["fills"] = fills
+        return NumericVectorizerModel(fills, self.track_nulls)
+
+
+class BinaryVectorizer(VectorizerTransformer):
+    """Binary -> [0/1 value (missing filled with fillValue), null indicator]
+    (BinaryVectorizer.scala; fillValue=false, trackNulls=true)."""
+
+    def __init__(self, fill_value: bool = False, track_nulls: bool = True, uid=None):
+        super().__init__("vecBinary", uid=uid)
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def get_params(self):
+        return {"fill_value": self.fill_value, "track_nulls": self.track_nulls}
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for col, feat in zip(cols, self.input_features):
+            assert isinstance(col, NumericColumn)
+            blocks.append(_impute_block(col, float(self.fill_value), self.track_nulls))
+            metas.append(_value_and_null_meta(feat.name, feat.ftype, self.track_nulls))
+        return blocks, metas
+
+
+class RealNNVectorizer(VectorizerTransformer):
+    """RealNN passthrough (no nulls possible) — Transmogrifier.scala:271."""
+
+    def __init__(self, uid=None):
+        super().__init__("vecRealNN", uid=uid)
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for col, feat in zip(cols, self.input_features):
+            assert isinstance(col, NumericColumn)
+            blocks.append(col.values.astype(np.float64)[:, None])
+            metas.append([ColumnMeta((feat.name,), feat.ftype.__name__)])
+        return blocks, metas

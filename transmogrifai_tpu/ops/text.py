@@ -1,0 +1,319 @@
+"""Text hashing + SmartText vectorizers.
+
+Reference:
+  * OPCollectionHashingVectorizer.scala:405 — MurmurHash3 feature hashing of
+    token streams, shared-vs-separate hash-space strategy (Auto: separate
+    spaces unless num_inputs * num_features > MaxNumOfFeatures).
+  * SmartTextVectorizer.scala:79-132 — per-field TextStats (value counts with
+    cardinality cap + token-length distribution, monoid-merged), then a
+    per-field decision: Pivot / Hash / Ignore.
+
+Decision rule (SmartTextVectorizer.scala:104-120), with transmogrify defaults
+max_cardinality=30, top_k=20, coverage_pct=0.90, min_length_std_dev=0:
+  1. card > max_cardinality and card > top_k and coverage(topK) >= coverage_pct -> Pivot
+  2. card <= max_cardinality -> Pivot
+  3. token-length stddev < min_length_std_dev -> Ignore
+  4. otherwise -> Hash
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..stages.metadata import NULL_STRING, ColumnMeta
+from ..types.columns import Column, TextColumn
+from ..utils.text import clean_string, hash_to_index, tokenize
+from .base import VectorizerEstimator, VectorizerModel
+from .categorical import pivot_block, pivot_metas, top_values
+from .defaults import DEFAULTS
+
+
+@dataclasses.dataclass
+class TextStats:
+    """Monoid summary of one text field (SmartTextVectorizer.scala TextStats):
+    value counts (cardinality-capped) + token-length distribution."""
+
+    value_counts: Counter
+    length_counts: Counter
+    cardinality_cap: int
+
+    @staticmethod
+    def empty(cap: int) -> "TextStats":
+        return TextStats(Counter(), Counter(), cap)
+
+    def add(self, cleaned: str, tokens: list[str]) -> None:
+        # cap: once cardinality exceeds the cap, new keys are not added
+        # (existing keys keep counting) — keeps the monoid bounded.
+        if cleaned in self.value_counts or len(self.value_counts) <= self.cardinality_cap:
+            self.value_counts[cleaned] += 1
+        for t in tokens:
+            self.length_counts[len(t)] += 1
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.value_counts)
+
+    def length_std(self) -> float:
+        total = sum(self.length_counts.values())
+        if total == 0:
+            return 0.0
+        mean = sum(k * c for k, c in self.length_counts.items()) / total
+        var = sum(c * (k - mean) ** 2 for k, c in self.length_counts.items()) / total
+        return float(np.sqrt(var))
+
+    def coverage(self, top_k: int, min_support: int) -> float:
+        total = sum(self.value_counts.values())
+        if total == 0:
+            return 0.0
+        filtered = sorted(
+            (c for c in self.value_counts.values() if c >= min_support), reverse=True
+        )
+        return sum(filtered[:top_k]) / total
+
+
+PIVOT, HASH, IGNORE = "Pivot", "Hash", "Ignore"
+
+
+def decide_method(
+    stats: TextStats,
+    max_cardinality: int,
+    top_k: int,
+    min_support: int,
+    coverage_pct: float,
+    min_length_std_dev: float,
+) -> str:
+    card = stats.cardinality
+    if card > max_cardinality and card > top_k and stats.coverage(top_k, min_support) >= coverage_pct:
+        return PIVOT
+    if card <= max_cardinality:
+        return PIVOT
+    if stats.length_std() < min_length_std_dev:
+        return IGNORE
+    return HASH
+
+
+def hash_block(
+    values: list,
+    num_features: int,
+    feature_slot: int,
+    shared: bool,
+    binary_freq: bool,
+    to_lowercase: bool,
+    min_token_length: int,
+    seed: int,
+    track_nulls: bool,
+) -> np.ndarray:
+    """Feature-hash one text column into ``num_features`` buckets.
+
+    With separate hash spaces each feature occupies its own block; with a
+    shared space every feature hashes into the same buckets (the caller then
+    emits a single block). Always appends the null-indicator column when
+    track_nulls (SmartTextVectorizer trackNulls semantics).
+    """
+    n = len(values)
+    out = np.zeros((n, num_features + (1 if track_nulls else 0)), dtype=np.float64)
+    for r, raw in enumerate(values):
+        if raw is None:
+            if track_nulls:
+                out[r, num_features] = 1.0
+            continue
+        toks = tokenize(raw, to_lowercase=to_lowercase, min_token_length=min_token_length)
+        for t in toks:
+            key = t if not shared else f"{feature_slot}_{t}"
+            j = hash_to_index(key, num_features, seed)
+            if binary_freq:
+                out[r, j] = 1.0
+            else:
+                out[r, j] += 1.0
+    return out
+
+
+def hash_metas(
+    name: str, parent_type: type, num_features: int, track_nulls: bool
+) -> list[ColumnMeta]:
+    metas = [
+        ColumnMeta((name,), parent_type.__name__, grouping=None,
+                   descriptor_value=f"hash_{j}")
+        for j in range(num_features)
+    ]
+    if track_nulls:
+        metas.append(
+            ColumnMeta((name,), parent_type.__name__, grouping=name,
+                       indicator_value=NULL_STRING)
+        )
+    return metas
+
+
+class SmartTextModel(VectorizerModel):
+    def __init__(
+        self,
+        methods: list[str],
+        vocabs: list[list[str]],
+        num_hashes: int,
+        clean_text: bool,
+        track_nulls: bool,
+        to_lowercase: bool = DEFAULTS.ToLowercase,
+        min_token_length: int = DEFAULTS.MinTokenLength,
+        binary_freq: bool = DEFAULTS.BinaryFreq,
+        seed: int = DEFAULTS.HashSeed,
+        **kw,
+    ):
+        super().__init__("smartTxt", **kw)
+        self.methods = methods
+        self.vocabs = vocabs
+        self.num_hashes = num_hashes
+        self.clean_text = clean_text
+        self.track_nulls = track_nulls
+        self.to_lowercase = to_lowercase
+        self.min_token_length = min_token_length
+        self.binary_freq = binary_freq
+        self.seed = seed
+
+    def get_params(self):
+        return {
+            "methods": self.methods,
+            "vocabs": self.vocabs,
+            "num_hashes": self.num_hashes,
+            "clean_text": self.clean_text,
+            "track_nulls": self.track_nulls,
+            "to_lowercase": self.to_lowercase,
+            "min_token_length": self.min_token_length,
+            "binary_freq": self.binary_freq,
+            "seed": self.seed,
+        }
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for slot, (col, method, vocab, feat) in enumerate(
+            zip(cols, self.methods, self.vocabs, self.input_features)
+        ):
+            values = col.to_list()
+            if method == PIVOT:
+                blocks.append(
+                    pivot_block(values, vocab, self.track_nulls, self.clean_text, False)
+                )
+                metas.append(pivot_metas(feat.name, feat.ftype, vocab, self.track_nulls))
+            elif method == HASH:
+                blocks.append(
+                    hash_block(
+                        values,
+                        self.num_hashes,
+                        slot,
+                        shared=False,
+                        binary_freq=self.binary_freq,
+                        to_lowercase=self.to_lowercase,
+                        min_token_length=self.min_token_length,
+                        seed=self.seed,
+                        track_nulls=self.track_nulls,
+                    )
+                )
+                metas.append(
+                    hash_metas(feat.name, feat.ftype, self.num_hashes, self.track_nulls)
+                )
+            else:  # IGNORE: null tracking only
+                if self.track_nulls:
+                    null = np.array(
+                        [1.0 if v is None else 0.0 for v in values], dtype=np.float64
+                    )[:, None]
+                    blocks.append(null)
+                    metas.append(
+                        [
+                            ColumnMeta(
+                                (feat.name,),
+                                feat.ftype.__name__,
+                                grouping=feat.name,
+                                indicator_value=NULL_STRING,
+                            )
+                        ]
+                    )
+        return blocks, metas
+
+
+class SmartTextVectorizer(VectorizerEstimator):
+    """Decides pivot vs hash vs ignore per text field, then vectorizes
+    (SmartTextVectorizer.scala:79-132)."""
+
+    def __init__(
+        self,
+        max_cardinality: int = DEFAULTS.MaxCategoricalCardinality,
+        top_k: int = DEFAULTS.TopK,
+        min_support: int = DEFAULTS.MinSupport,
+        coverage_pct: float = DEFAULTS.CoveragePct,
+        min_length_std_dev: float = 0.0,
+        num_hashes: int = DEFAULTS.DefaultNumOfFeatures,
+        clean_text: bool = DEFAULTS.CleanText,
+        track_nulls: bool = DEFAULTS.TrackNulls,
+        uid: str | None = None,
+    ):
+        super().__init__("smartTxtVec", uid=uid)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.coverage_pct = coverage_pct
+        self.min_length_std_dev = min_length_std_dev
+        self.num_hashes = num_hashes
+        self.clean_text = clean_text
+        self.track_nulls = track_nulls
+
+    def get_params(self):
+        return {
+            "max_cardinality": self.max_cardinality,
+            "top_k": self.top_k,
+            "min_support": self.min_support,
+            "coverage_pct": self.coverage_pct,
+            "min_length_std_dev": self.min_length_std_dev,
+            "num_hashes": self.num_hashes,
+            "clean_text": self.clean_text,
+            "track_nulls": self.track_nulls,
+        }
+
+    def compute_stats(self, col: TextColumn) -> TextStats:
+        stats = TextStats.empty(self.max_cardinality)
+        for v in col.values:
+            if v is None:
+                continue
+            cleaned = clean_string(v) if self.clean_text else v
+            stats.add(cleaned, tokenize(v))
+        return stats
+
+    def fit_model(self, dataset: Dataset) -> SmartTextModel:
+        methods, vocabs, summaries = [], [], []
+        for name in self.input_names:
+            col = dataset[name]
+            assert isinstance(col, TextColumn), f"{name} is not a text column"
+            stats = self.compute_stats(col)
+            method = decide_method(
+                stats,
+                self.max_cardinality,
+                self.top_k,
+                self.min_support,
+                self.coverage_pct,
+                self.min_length_std_dev,
+            )
+            vocab = (
+                top_values(stats.value_counts, self.top_k, self.min_support)
+                if method == PIVOT
+                else []
+            )
+            methods.append(method)
+            vocabs.append(vocab)
+            summaries.append(
+                {
+                    "feature": name,
+                    "method": method,
+                    "cardinality": stats.cardinality,
+                    "lengthStdDev": stats.length_std(),
+                }
+            )
+        self.metadata["textStats"] = summaries
+        return SmartTextModel(
+            methods,
+            vocabs,
+            self.num_hashes,
+            self.clean_text,
+            self.track_nulls,
+        )

@@ -1,0 +1,129 @@
+"""Transmogrifier — type-directed automated feature engineering.
+
+Reference: core/.../stages/impl/feature/Transmogrifier.scala:92-340 — group
+features by exact type (sorted by type name for determinism), apply each
+type's default vectorizer as ONE sequence stage per type, then combine all
+resulting vectors with VectorsCombiner into the single feature vector.
+
+Dispatch parity map (defaults at Transmogrifier.scala:52-88):
+  OPVector                  passthrough
+  Real/Currency/Percent     RealVectorizer (fillWithMean, trackNulls)
+  RealNN                    RealNNVectorizer (passthrough)
+  Integral                  IntegralVectorizer (fillWithMode, trackNulls)
+  Binary                    BinaryVectorizer (fill false, trackNulls)
+  Date/DateTime             DateVectorizer (unit circles + SinceLast)
+  Text/TextArea             SmartTextVectorizer (pivot/hash/ignore)
+  PickList/ComboBox/ID/Email/URL/Base64/Country/State/City/PostalCode/Street
+                            OneHotVectorizer (TopK=20, MinSupport=10)
+  MultiPickList             OneHotVectorizer over sets
+  (lists, maps, geolocation, phone: later milestone — clear error for now)
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from .. import types as T
+from ..features.feature import Feature
+from .categorical import OneHotVectorizer
+from .combiner import VectorsCombiner
+from .dates import DateVectorizer
+from .defaults import DEFAULTS, TransmogrifierDefaults
+from .numeric import (
+    BinaryVectorizer,
+    IntegralVectorizer,
+    RealNNVectorizer,
+    RealVectorizer,
+)
+from .text import SmartTextVectorizer
+
+_ONE_HOT_TYPES = (
+    T.PickList,
+    T.ComboBox,
+    T.ID,
+    T.Email,
+    T.URL,
+    T.Base64,
+    T.Country,
+    T.State,
+    T.City,
+    T.PostalCode,
+    T.Street,
+)
+_SMART_TEXT_TYPES = (T.Text, T.TextArea)
+
+
+def _vectorizer_for(ftype: type, d: TransmogrifierDefaults):
+    if ftype is T.RealNN:
+        return RealNNVectorizer()
+    if ftype in (T.Real, T.Currency, T.Percent):
+        return RealVectorizer(
+            fill_with_mean=d.FillWithMean,
+            fill_value=d.FillValue,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype is T.Integral:
+        return IntegralVectorizer(
+            fill_with_mode=d.FillWithMode,
+            fill_value=d.FillValue,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype is T.Binary:
+        return BinaryVectorizer(fill_value=d.BinaryFillValue, track_nulls=d.TrackNulls)
+    if ftype in (T.Date, T.DateTime):
+        return DateVectorizer(
+            reference_date_ms=d.ReferenceDateMs,
+            circular_reps=d.CircularDateRepresentations,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype in _SMART_TEXT_TYPES:
+        return SmartTextVectorizer(
+            max_cardinality=d.MaxCategoricalCardinality,
+            top_k=d.TopK,
+            min_support=d.MinSupport,
+            coverage_pct=d.CoveragePct,
+            num_hashes=d.DefaultNumOfFeatures,
+            clean_text=d.CleanText,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype in _ONE_HOT_TYPES or ftype is T.MultiPickList:
+        return OneHotVectorizer(
+            top_k=d.TopK,
+            min_support=d.MinSupport,
+            clean_text=d.CleanText,
+            track_nulls=d.TrackNulls,
+        )
+    raise NotImplementedError(
+        f"No default vectorizer for feature type {ftype.__name__} yet "
+        f"(Transmogrifier parity gap — lists/maps/geolocation/phone pending)"
+    )
+
+
+def transmogrify(
+    features: Sequence[Feature],
+    label: Feature | None = None,
+    defaults: TransmogrifierDefaults = DEFAULTS,
+) -> Feature:
+    """Vectorize features by type and combine into one OPVector feature
+    (dsl ``.transmogrify()``, core/.../dsl/RichFeaturesCollection.scala:69)."""
+    if not features:
+        raise ValueError("transmogrify requires at least one feature")
+    by_type: dict[str, list[Feature]] = {}
+    for f in features:
+        by_type.setdefault(f.ftype.__name__, []).append(f)
+
+    vector_features: list[Feature] = []
+    for type_name in sorted(by_type):
+        group = by_type[type_name]
+        ftype = group[0].ftype
+        if ftype is T.OPVector:
+            vector_features.extend(group)
+            continue
+        stage = _vectorizer_for(ftype, defaults)
+        stage.set_input(*group)
+        vector_features.append(stage.get_output())
+
+    if len(vector_features) == 1:
+        return vector_features[0]
+    combiner = VectorsCombiner()
+    combiner.set_input(*vector_features)
+    return combiner.get_output()

@@ -1,0 +1,2 @@
+"""Data preparation: SanityChecker, RawFeatureFilter, splitters."""
+from .sanity_checker import SanityChecker  # noqa: F401

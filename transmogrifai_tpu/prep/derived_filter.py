@@ -1,0 +1,59 @@
+"""Column-removal model shared by SanityChecker (and later derived-feature
+filters).
+
+Reference: DerivedFeatureFilterUtils.removeFeatures
+(core/.../preparators/DerivedFeatureFilterUtils.scala) — the fitted model is
+just an index-keep mask applied to the feature vector, with metadata subset
+to match (SanityChecker.scala:544-559).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..stages.metadata import VectorMetadata
+from ..types import OPVector
+from ..types.columns import Column, VectorColumn
+from ..stages.base import Model
+
+
+class FeatureRemovalModel(Model):
+    output_type = OPVector
+
+    def __init__(
+        self,
+        indices_to_keep: Sequence[int],
+        remove_bad_features: bool,
+        new_metadata: VectorMetadata | None,
+        operation_name: str = "featureRemoval",
+        uid: str | None = None,
+    ):
+        super().__init__(operation_name, uid=uid)
+        self.indices_to_keep = list(indices_to_keep)
+        self.remove_bad_features = remove_bad_features
+        self.new_metadata = new_metadata
+
+    def get_params(self):
+        return {
+            "indices_to_keep": self.indices_to_keep,
+            "remove_bad_features": self.remove_bad_features,
+            "new_metadata": (
+                self.new_metadata.to_json() if self.new_metadata else None
+            ),
+        }
+
+    def get_arrays(self):
+        return {"indices_to_keep": np.asarray(self.indices_to_keep, dtype=np.int64)}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        # inputs are (label, vector); the vector is always the last input
+        vec = cols[-1]
+        assert isinstance(vec, VectorColumn)
+        if not self.remove_bad_features:
+            return vec
+        values = np.asarray(vec.values)[:, self.indices_to_keep]
+        meta = self.new_metadata
+        if meta is None and vec.metadata is not None:
+            meta = vec.metadata.select(self.indices_to_keep)
+        return VectorColumn(OPVector, values, meta)

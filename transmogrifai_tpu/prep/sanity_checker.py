@@ -1,0 +1,229 @@
+"""SanityChecker — automated feature validation / leakage detection.
+
+Reference: core/.../stages/impl/preparators/SanityChecker.scala:58-581 and
+DerivedFeatureFilterUtils.scala. BinaryEstimator(label RealNN, features
+OPVector) -> OPVector with bad columns removed.
+
+Checks (thresholds mirrored from SanityChecker.scala:561-581):
+  * variance < MinVariance (1e-5)                        -> drop column
+  * |corr(feature, label)| > MaxCorrelation (0.95)        -> drop (leakage)
+  * corr(feature, feature') > MaxFeatureCorr (0.99)       -> drop the later
+  * Cramér's V (categorical group vs label) > MaxCramersV (0.95)
+                                                          -> drop the group
+  * association-rule max confidence > MaxRuleConfidence with support >=
+    MinRequiredRuleSupport (both 1.0 = off by default)    -> drop the group
+RemoveFeatureGroup (default true): a label-leakage drop removes the whole
+pivot group the column belongs to (null indicator included).
+
+TPU mapping (SURVEY.md §7 step 4): all statistics are dense reductions —
+correlation is a centered XᵀX matmul over [X | y] and every Cramér's V table
+is a one-hot matmul — computed in utils/stats.py (jitted).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..stages.base import Estimator
+from ..stages.metadata import VectorMetadata
+from ..types import OPVector, RealNN
+from ..types.columns import Column, NumericColumn, VectorColumn
+from ..utils import stats as S
+from .derived_filter import FeatureRemovalModel
+
+# SanityChecker.scala:561-581 defaults
+CHECK_SAMPLE = 1.0
+SAMPLE_LOWER_LIMIT = 1_000
+SAMPLE_UPPER_LIMIT = 1_000_000
+MAX_CORRELATION = 0.95
+MAX_FEATURE_CORR = 0.99
+MIN_CORRELATION = 0.0
+MIN_VARIANCE = 1e-5
+MAX_CRAMERS_V = 0.95
+MAX_RULE_CONFIDENCE = 1.0
+MIN_REQUIRED_RULE_SUPPORT = 1.0
+
+
+@dataclasses.dataclass
+class ColumnReport:
+    name: str
+    mean: float
+    variance: float
+    corr_label: float
+    cramers_v: float | None
+    dropped: bool
+    reasons: list[str]
+
+
+class SanityChecker(Estimator):
+    """Estimator[(RealNN label, OPVector features)] -> OPVector."""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+
+    def __init__(
+        self,
+        max_correlation: float = MAX_CORRELATION,
+        max_feature_corr: float = MAX_FEATURE_CORR,
+        min_correlation: float = MIN_CORRELATION,
+        min_variance: float = MIN_VARIANCE,
+        max_cramers_v: float = MAX_CRAMERS_V,
+        max_rule_confidence: float = MAX_RULE_CONFIDENCE,
+        min_required_rule_support: float = MIN_REQUIRED_RULE_SUPPORT,
+        remove_bad_features: bool = False,
+        remove_feature_group: bool = True,
+        correlation_type: str = "pearson",
+        uid: str | None = None,
+    ):
+        super().__init__("sanityCheck", uid=uid)
+        self.max_correlation = max_correlation
+        self.max_feature_corr = max_feature_corr
+        self.min_correlation = min_correlation
+        self.min_variance = min_variance
+        self.max_cramers_v = max_cramers_v
+        self.max_rule_confidence = max_rule_confidence
+        self.min_required_rule_support = min_required_rule_support
+        self.remove_bad_features = remove_bad_features
+        self.remove_feature_group = remove_feature_group
+        self.correlation_type = correlation_type
+
+    def get_params(self) -> dict[str, Any]:
+        return {
+            "max_correlation": self.max_correlation,
+            "max_feature_corr": self.max_feature_corr,
+            "min_correlation": self.min_correlation,
+            "min_variance": self.min_variance,
+            "max_cramers_v": self.max_cramers_v,
+            "max_rule_confidence": self.max_rule_confidence,
+            "min_required_rule_support": self.min_required_rule_support,
+            "remove_bad_features": self.remove_bad_features,
+            "remove_feature_group": self.remove_feature_group,
+            "correlation_type": self.correlation_type,
+        }
+
+    # ------------------------------------------------------------------ fit
+    def fit_model(self, dataset: Dataset) -> FeatureRemovalModel:
+        label_name, vector_name = self.input_names
+        label_col = dataset[label_name]
+        vec_col = dataset[vector_name]
+        assert isinstance(label_col, NumericColumn) and isinstance(vec_col, VectorColumn)
+
+        x = np.asarray(vec_col.values, dtype=np.float64)
+        y = label_col.values.astype(np.float64)
+        n, d = x.shape
+        meta = vec_col.metadata or VectorMetadata(vector_name, ())
+        names = (
+            meta.column_names() if meta.size == d else [f"col_{j}" for j in range(d)]
+        )
+
+        col_stats = S.column_stats(x)
+        if self.correlation_type == "spearman":
+            corr = S.spearman_correlation_matrix(x, y)
+        else:
+            corr = S.correlation_matrix(x, y)
+        corr_label = corr[:d, d]
+        corr_features = corr[:d, :d]
+
+        # label one-hot for categorical stats (binary or small multiclass)
+        classes = np.unique(y)
+        label_onehot = (y[:, None] == classes[None, :]).astype(np.float64)
+
+        drop_reasons: dict[int, list[str]] = {}
+
+        def drop(j: int, reason: str) -> None:
+            drop_reasons.setdefault(j, []).append(reason)
+
+        # 1. low variance
+        for j in np.nonzero(col_stats.variance < self.min_variance)[0]:
+            drop(int(j), f"variance<{self.min_variance}")
+
+        # 2. label-correlation leakage (+ too-low correlation if configured)
+        for j in range(d):
+            c = abs(corr_label[j])
+            if c > self.max_correlation:
+                drop(j, f"|corrLabel|={c:.4f}>{self.max_correlation}")
+            elif c < self.min_correlation:
+                drop(j, f"|corrLabel|={c:.4f}<{self.min_correlation}")
+
+        # 3. feature-feature correlation: drop the later column of each pair
+        hi = np.argwhere(np.triu(np.abs(corr_features), k=1) > self.max_feature_corr)
+        for _, j in hi:
+            drop(int(j), f"featureCorr>{self.max_feature_corr}")
+
+        # 4. categorical groups: Cramér's V + association rules
+        group_v: dict[tuple, float] = {}
+        group_cols: dict[tuple, list[int]] = {}
+        if meta.size == d:
+            for key, idxs in meta.index_of_group().items():
+                cats = [
+                    i for i in idxs if meta.columns[i].indicator_value is not None
+                ]
+                if len(cats) < 1:
+                    continue
+                contingency = S.contingency_table(x[:, cats], label_onehot)
+                v = S.cramers_v(contingency)
+                group_v[key] = v
+                group_cols[key] = cats
+                if v > self.max_cramers_v:
+                    for i in cats:
+                        drop(i, f"cramersV={v:.4f}>{self.max_cramers_v}")
+                conf, support = S.association_rule_confidence(contingency)
+                if self.max_rule_confidence < 1.0:
+                    for ci, i in enumerate(cats):
+                        if (
+                            conf[ci] > self.max_rule_confidence
+                            and support[ci] >= self.min_required_rule_support
+                        ):
+                            drop(i, f"ruleConfidence={conf[ci]:.4f}")
+
+        # 5. group-wise removal: leakage drops take the whole pivot group
+        if self.remove_feature_group and meta.size == d:
+            groups = meta.index_of_group()
+            leak_reasons = ("corrLabel", "cramersV", "ruleConfidence")
+            for j in list(drop_reasons):
+                if not any(r.startswith(("|corrLabel|", "cramersV", "ruleConfidence"))
+                           for r in drop_reasons[j]):
+                    continue
+                key = meta.columns[j].grouped_key()
+                if key[1] is None:
+                    continue
+                for i in groups.get(key, []):
+                    if i not in drop_reasons:
+                        drop(i, "featureGroupRemoval")
+
+        indices_to_keep = [j for j in range(d) if j not in drop_reasons]
+
+        # ------------------------- summary ledger -------------------------
+        reports = [
+            ColumnReport(
+                name=names[j],
+                mean=float(col_stats.mean[j]),
+                variance=float(col_stats.variance[j]),
+                corr_label=float(corr_label[j]),
+                cramers_v=(
+                    group_v.get(meta.columns[j].grouped_key())
+                    if meta.size == d
+                    else None
+                ),
+                dropped=j in drop_reasons,
+                reasons=drop_reasons.get(j, []),
+            )
+            for j in range(d)
+        ]
+        self.metadata["sanityCheckerSummary"] = {
+            "numRows": n,
+            "numColumns": d,
+            "numDropped": len(drop_reasons),
+            "columns": [dataclasses.asdict(r) for r in reports],
+            "correlationType": self.correlation_type,
+        }
+        new_meta = meta.select(indices_to_keep) if meta.size == d else None
+        return FeatureRemovalModel(
+            indices_to_keep=indices_to_keep,
+            remove_bad_features=self.remove_bad_features,
+            new_metadata=new_meta,
+            operation_name="sanityCheck",
+        )

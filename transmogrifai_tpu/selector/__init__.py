@@ -1,0 +1,8 @@
+"""Model selection (reference: core/.../stages/impl/selector/)."""
+from .validators import CrossValidator, TrainValidationSplit  # noqa: F401
+from .model_selector import (  # noqa: F401
+    BinaryClassificationModelSelector,
+    ModelSelector,
+    MultiClassificationModelSelector,
+    RegressionModelSelector,
+)

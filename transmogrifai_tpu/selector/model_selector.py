@@ -1,0 +1,244 @@
+"""ModelSelector — automated model selection with CV over model families ×
+hyperparameter grids.
+
+Reference: core/.../stages/impl/selector/ModelSelector.scala:72-264 and the
+problem-specific factories (BinaryClassificationModelSelector.scala,
+MultiClassificationModelSelector.scala, RegressionModelSelector.scala).
+Flow (ModelSelector.scala:116-208): validator.validate over candidates ->
+best estimator -> splitter.validationPrepare -> refit winner on prepared
+train -> train metrics -> SelectedModel with ModelSelectorSummary metadata.
+
+Default binary candidates are LogisticRegression + RandomForest + XGBoost
+(BinaryClassificationModelSelector.scala:61-63); tree families join the
+default list here once the histogram-GBDT milestone lands.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..evaluators import (
+    BinaryClassificationEvaluator,
+    Evaluator,
+    MultiClassificationEvaluator,
+    RegressionEvaluator,
+)
+from ..models.base import PredictorEstimator, PredictorModel
+from ..models.linear import LinearRegression
+from ..models.logistic import LogisticRegression
+from ..prep.splitters import DataBalancer, DataCutter, DataSplitter
+from .validators import CrossValidator, TrainValidationSplit, Validator
+
+log = logging.getLogger(__name__)
+
+# DefaultSelectorParams.scala:37-49
+REGULARIZATION = [0.001, 0.01, 0.1, 0.2]
+ELASTIC_NET = [0.1, 0.5]
+MAX_ITER_LIN = [50]
+FIT_INTERCEPT = [True]
+
+
+def _lr_grid() -> dict[str, Sequence[Any]]:
+    return {
+        "fit_intercept": FIT_INTERCEPT,
+        "elastic_net_param": ELASTIC_NET,
+        "max_iter": MAX_ITER_LIN,
+        "reg_param": REGULARIZATION,
+    }
+
+
+class SelectedModel(PredictorModel):
+    """The fitted winner (SelectedModel in ModelSelector.scala) — delegates
+    to the best inner model and carries the selection summary."""
+
+    def __init__(self, best_model: PredictorModel, summary: dict[str, Any], uid=None):
+        super().__init__("modelSelector", uid=uid)
+        self.best_model = best_model
+        self.metadata["modelSelectorSummary"] = summary
+
+    def predict_arrays(self, x: np.ndarray):
+        return self.best_model.predict_arrays(x)
+
+    def get_arrays(self):
+        return {f"best__{k}": v for k, v in self.best_model.get_arrays().items()}
+
+    def get_params(self):
+        return {
+            "best_model_class": type(self.best_model).__name__,
+            "best_model_params": self.best_model.get_params(),
+            "summary": self.metadata.get("modelSelectorSummary", {}),
+        }
+
+    @property
+    def summary(self) -> dict[str, Any]:
+        return self.metadata["modelSelectorSummary"]
+
+    def evaluate_holdout(self, x: np.ndarray, y: np.ndarray, evaluator: Evaluator):
+        pred, prob, _ = self.predict_arrays(x)
+        metrics = evaluator.evaluate_arrays(y, pred, prob)
+        self.metadata["modelSelectorSummary"]["holdoutEvaluation"] = metrics
+        return metrics
+
+
+class ModelSelector(PredictorEstimator):
+    """Estimator[(RealNN, OPVector)] -> Prediction that finds, refits, and
+    wraps the best model family × grid point."""
+
+    def __init__(
+        self,
+        validator: Validator,
+        splitter: DataSplitter | None,
+        models: Sequence[tuple[PredictorEstimator, dict[str, Sequence[Any]]]],
+        evaluator: Evaluator,
+        extra_evaluators: Sequence[Evaluator] = (),
+        problem_kind: str = "unknown",
+        uid: str | None = None,
+    ):
+        super().__init__("modelSelector", uid=uid)
+        self.validator = validator
+        self.splitter = splitter
+        self.models = list(models)
+        self.evaluator = evaluator
+        self.extra_evaluators = list(extra_evaluators)
+        self.problem_kind = problem_kind
+
+    def get_params(self):
+        return {
+            "problem_kind": self.problem_kind,
+            "evaluator": self.evaluator.name,
+            "validator": type(self.validator).__name__,
+            "splitter": type(self.splitter).__name__ if self.splitter else None,
+        }
+
+    def fit_arrays(self, x, y, row_mask) -> SelectedModel:
+        train_idx = np.nonzero(row_mask > 0)[0]
+        xt, yt = x[train_idx], y[train_idx]
+
+        # pre-validation prepare (DataCutter removes rare labels up front)
+        if isinstance(self.splitter, DataCutter):
+            keep = self.splitter.prepare(yt)
+            xt, yt = xt[keep], yt[keep]
+
+        results = self.validator.validate(self.models, xt, yt, self.evaluator)
+        best = Validator.best(results, self.evaluator)
+        log.info(
+            "ModelSelector best: %s %s (%s=%.4f over %d candidates)",
+            best.model_name,
+            best.grid,
+            self.evaluator.default_metric,
+            best.metric_mean,
+            len(results),
+        )
+
+        family = next(
+            est for est, _ in self.models if est.uid == best.model_uid
+        )
+        final_est = family.with_params(**best.grid)
+
+        # validation prepare: balancing / down-sampling before the final refit
+        final_mask = np.ones(len(yt), dtype=np.float32)
+        splitter_summary = None
+        if self.splitter is not None and not isinstance(self.splitter, DataCutter):
+            final_mask = self.splitter.prepare(yt).astype(np.float32)
+        if self.splitter is not None and self.splitter.summary is not None:
+            splitter_summary = self.splitter.summary.to_json()
+
+        best_model = final_est.fit_arrays(xt, yt, final_mask)
+
+        pred, prob, _ = best_model.predict_arrays(xt)
+        train_metrics = self.evaluator.evaluate_arrays(yt, pred, prob)
+        extra_train = {
+            ev.name: ev.evaluate_arrays(yt, pred, prob)
+            for ev in self.extra_evaluators
+        }
+
+        summary = {
+            "problemKind": self.problem_kind,
+            "validationType": type(self.validator).__name__,
+            "evaluationMetric": self.evaluator.default_metric,
+            "bestModelName": f"{best.model_name}_{best.model_uid}",
+            "bestModelType": best.model_name,
+            "bestGrid": best.grid,
+            "validationResults": [r.to_json() for r in results],
+            "trainEvaluation": train_metrics,
+            "extraTrainEvaluations": extra_train,
+            "holdoutEvaluation": None,
+            "splitterSummary": splitter_summary,
+        }
+        self.metadata["modelSelectorSummary"] = summary
+        return SelectedModel(best_model, summary)
+
+
+def BinaryClassificationModelSelector(
+    validator: Validator | None = None,
+    splitter: DataSplitter | None = None,
+    models: Sequence[tuple[PredictorEstimator, dict[str, Sequence[Any]]]] | None = None,
+    evaluator: Evaluator | None = None,
+    num_folds: int = 3,
+    seed: int = 42,
+) -> ModelSelector:
+    """CV binary selector (BinaryClassificationModelSelector.scala; default
+    3-fold CV, DataBalancer, AuPR metric)."""
+    if models is None:
+        models = [(LogisticRegression(), _lr_grid())]
+    return ModelSelector(
+        validator=validator or CrossValidator(num_folds=num_folds, seed=seed),
+        splitter=splitter if splitter is not None else DataBalancer(seed=seed),
+        models=models,
+        evaluator=evaluator or BinaryClassificationEvaluator(),
+        extra_evaluators=(),
+        problem_kind="BinaryClassification",
+    )
+
+
+def MultiClassificationModelSelector(
+    validator: Validator | None = None,
+    splitter: DataSplitter | None = None,
+    models: Sequence[tuple[PredictorEstimator, dict[str, Sequence[Any]]]] | None = None,
+    evaluator: Evaluator | None = None,
+    num_folds: int = 3,
+    seed: int = 42,
+) -> ModelSelector:
+    """Multiclass selector (MultiClassificationModelSelector.scala; default
+    LR candidates, DataCutter, weighted F1)."""
+    if models is None:
+        models = [(LogisticRegression(), _lr_grid())]
+    return ModelSelector(
+        validator=validator or CrossValidator(num_folds=num_folds, seed=seed),
+        splitter=splitter if splitter is not None else DataCutter(seed=seed),
+        models=models,
+        evaluator=evaluator or MultiClassificationEvaluator(),
+        problem_kind="MultiClassification",
+    )
+
+
+def RegressionModelSelector(
+    validator: Validator | None = None,
+    splitter: DataSplitter | None = None,
+    models: Sequence[tuple[PredictorEstimator, dict[str, Sequence[Any]]]] | None = None,
+    evaluator: Evaluator | None = None,
+    seed: int = 42,
+) -> ModelSelector:
+    """Regression selector (RegressionModelSelector.scala; default
+    train/validation split .75, DataSplitter, RMSE)."""
+    if models is None:
+        models = [
+            (
+                LinearRegression(),
+                {
+                    "fit_intercept": FIT_INTERCEPT,
+                    "elastic_net_param": ELASTIC_NET,
+                    "max_iter": MAX_ITER_LIN,
+                    "reg_param": REGULARIZATION,
+                },
+            )
+        ]
+    return ModelSelector(
+        validator=validator or TrainValidationSplit(seed=seed),
+        splitter=splitter if splitter is not None else DataSplitter(seed=seed),
+        models=models,
+        evaluator=evaluator or RegressionEvaluator(),
+        problem_kind="Regression",
+    )

@@ -1,0 +1,178 @@
+"""Validators: k-fold cross validation and train/validation split.
+
+Reference: core/.../stages/impl/tuning/{OpCrossValidation,OpTrainValidationSplit,
+OpValidator}.scala. Defaults (OpValidator.scala:371-379): 3 folds, train ratio
+0.75, candidate-fit parallelism 8, per-candidate failure tolerance (a failed
+model/grid is logged and skipped; error only if ALL fail).
+
+TPU mapping (SURVEY.md §2.6): folds are row masks and hyperparameter grids are
+stacked arrays — when a model family implements ``fit_arrays_batched`` the
+whole folds × grid sweep trains as one vmapped XLA computation instead of a
+driver thread pool.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..evaluators.base import Evaluator
+from ..models.base import PredictorEstimator, PredictorModel
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CandidateResult:
+    model_name: str
+    model_uid: str
+    grid: dict[str, Any]
+    metric_values: list[float]
+
+    @property
+    def metric_mean(self) -> float:
+        return float(np.mean(self.metric_values)) if self.metric_values else float("nan")
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "modelName": self.model_name,
+            "modelUID": self.model_uid,
+            "grid": {k: v for k, v in self.grid.items()},
+            "metricValues": self.metric_values,
+            "metricMean": self.metric_mean,
+        }
+
+
+def expand_grid(grid: dict[str, Sequence[Any]]) -> list[dict[str, Any]]:
+    """Cartesian product of param value lists (ParamGridBuilder.build)."""
+    points: list[dict[str, Any]] = [{}]
+    for key, values in grid.items():
+        points = [{**p, key: v} for p in points for v in values]
+    return points
+
+
+class Validator:
+    """Shared candidate-sweep logic; subclasses provide the fold masks."""
+
+    def __init__(self, seed: int = 42):
+        self.seed = seed
+
+    def split_masks(self, y: np.ndarray) -> list[tuple[np.ndarray, np.ndarray]]:
+        raise NotImplementedError
+
+    def validate(
+        self,
+        candidates: Sequence[tuple[PredictorEstimator, dict[str, Sequence[Any]]]],
+        x: np.ndarray,
+        y: np.ndarray,
+        evaluator: Evaluator,
+    ) -> list[CandidateResult]:
+        """Fit every model family x grid point on every fold; returns results
+        with per-fold metric values. Failed families are skipped
+        (OpValidator.scala:318-357); raises only if everything failed."""
+        folds = self.split_masks(y)
+        results: list[CandidateResult] = []
+        errors: list[str] = []
+        for est, grid in candidates:
+            points = expand_grid(grid)
+            try:
+                results.extend(
+                    self._sweep_family(est, points, folds, x, y, evaluator)
+                )
+            except Exception as e:  # candidate-level isolation
+                log.warning("Model %s failed validation: %s", type(est).__name__, e)
+                errors.append(f"{type(est).__name__}: {e}")
+        if not results:
+            raise RuntimeError(
+                f"All model candidates failed validation: {errors}"
+            )
+        return results
+
+    def _sweep_family(
+        self,
+        est: PredictorEstimator,
+        points: list[dict[str, Any]],
+        folds: list[tuple[np.ndarray, np.ndarray]],
+        x: np.ndarray,
+        y: np.ndarray,
+        evaluator: Evaluator,
+    ) -> list[CandidateResult]:
+        batched = getattr(est, "fit_arrays_batched", None)
+        per_point_values: list[list[float]] = [[] for _ in points]
+        for train_mask, val_mask in folds:
+            if batched is not None:
+                models = batched(x, y, train_mask.astype(np.float32), points)
+            else:
+                models = [
+                    est.with_params(**p).fit_arrays(
+                        x, y, train_mask.astype(np.float32)
+                    )
+                    for p in points
+                ]
+            val_idx = np.nonzero(val_mask)[0]
+            for gi, model in enumerate(models):
+                pred, prob, _ = model.predict_arrays(x[val_idx])
+                metrics = evaluator.evaluate_arrays(y[val_idx], pred, prob)
+                per_point_values[gi].append(evaluator.metric_of(metrics))
+        return [
+            CandidateResult(
+                model_name=type(est).__name__,
+                model_uid=est.uid,
+                grid=points[gi],
+                metric_values=per_point_values[gi],
+            )
+            for gi in range(len(points))
+        ]
+
+    @staticmethod
+    def best(
+        results: Sequence[CandidateResult], evaluator: Evaluator
+    ) -> CandidateResult:
+        key = lambda r: r.metric_mean  # noqa: E731
+        finite = [r for r in results if np.isfinite(r.metric_mean)]
+        pool = finite or list(results)
+        return max(pool, key=key) if evaluator.is_larger_better else min(pool, key=key)
+
+
+class CrossValidator(Validator):
+    """k-fold CV (OpCrossValidation.scala:42-190; default 3 folds, optional
+    label-stratified folds)."""
+
+    def __init__(self, num_folds: int = 3, stratify: bool = False, seed: int = 42):
+        super().__init__(seed)
+        if num_folds < 2:
+            raise ValueError("num_folds must be >= 2")
+        self.num_folds = num_folds
+        self.stratify = stratify
+
+    def split_masks(self, y: np.ndarray):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        assignment = np.empty(n, dtype=np.int64)
+        if self.stratify:
+            for cls in np.unique(y):
+                idx = np.nonzero(y == cls)[0]
+                assignment[idx] = rng.permutation(len(idx)) % self.num_folds
+        else:
+            assignment = rng.permutation(n) % self.num_folds
+        folds = []
+        for f in range(self.num_folds):
+            val = assignment == f
+            folds.append((~val, val))
+        return folds
+
+
+class TrainValidationSplit(Validator):
+    """Single random split (OpTrainValidationSplit.scala; default ratio .75)."""
+
+    def __init__(self, train_ratio: float = 0.75, seed: int = 42):
+        super().__init__(seed)
+        self.train_ratio = train_ratio
+
+    def split_masks(self, y: np.ndarray):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        train = rng.random(n) < self.train_ratio
+        return [(train, ~train)]

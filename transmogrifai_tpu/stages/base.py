@@ -41,7 +41,16 @@ class PipelineStage:
 
     # ---------------------------------------------------------------- wiring
     def set_input(self, *features: Any) -> "PipelineStage":
-        """Declare input features; validates arity/types (transformSchema)."""
+        """Declare input features; validates arity/types (transformSchema).
+
+        Rewiring an already-wired stage to different features is an error —
+        it would corrupt the first output feature's lineage (the reference
+        enforces this via immutable stage/feature construction)."""
+        if self.input_features and tuple(features) != self.input_features:
+            raise ValueError(
+                f"{self} is already wired to {self.input_names}; create a new "
+                "stage instance instead of rewiring"
+            )
         self._validate_inputs(features)
         self.input_features = tuple(features)
         return self
@@ -151,6 +160,15 @@ class Model(Transformer):
         override when they hold learned arrays."""
         return {}
 
+    @property
+    def output_name(self) -> str:  # type: ignore[override]
+        # a model fitted by an estimator takes over that estimator's output
+        # column name (set by Estimator.fit)
+        fixed = getattr(self, "_fixed_output_name", None)
+        if fixed is not None:
+            return fixed
+        return PipelineStage.output_name.fget(self)  # type: ignore[attr-defined]
+
 
 class Estimator(PipelineStage):
     """Learns a Model from data (OpPipelineStage fit)."""
@@ -167,13 +185,3 @@ class Estimator(PipelineStage):
 
     def fit_model(self, dataset: Dataset) -> Model:
         raise NotImplementedError
-
-
-def _model_output_name(self: Model) -> str:
-    fixed = getattr(self, "_fixed_output_name", None)
-    if fixed is not None:
-        return fixed
-    return PipelineStage.output_name.fget(self)  # type: ignore[attr-defined]
-
-
-Model.output_name = property(_model_output_name)  # type: ignore[assignment]

@@ -1,0 +1,134 @@
+"""Vector column provenance metadata — the ledger that makes SanityChecker,
+ModelInsights, and LOCO possible.
+
+Reference: features/.../utils/spark/OpVectorColumnMetadata.scala:67 and
+OpVectorMetadata.scala:51. Every column of every feature vector records which
+raw feature(s) it came from, the parent feature type, an optional grouping
+(e.g. the pivot group or map key), an optional indicator value (the pivoted
+categorical value, OTHER, or the null-indicator marker), and an optional
+descriptor (e.g. circular-date component). In the reference this rides Spark
+column Metadata; here it is a static structure attached to VectorColumn and
+computed at trace/fit time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Sequence
+
+#: OpVectorColumnMetadata.NullString — marks null-indicator columns
+NULL_STRING = "NullIndicatorValue"
+#: OpVectorColumnMetadata.OtherString — marks the other/rest pivot bucket
+OTHER_STRING = "OTHER"
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnMeta:
+    """One vector column's provenance (OpVectorColumnMetadata.scala:67)."""
+
+    parent_names: tuple[str, ...]
+    parent_type: str
+    grouping: str | None = None
+    indicator_value: str | None = None
+    descriptor_value: str | None = None
+    index: int = 0
+
+    @property
+    def is_null_indicator(self) -> bool:
+        return self.indicator_value == NULL_STRING
+
+    @property
+    def is_other_indicator(self) -> bool:
+        return self.indicator_value == OTHER_STRING
+
+    def make_name(self) -> str:
+        """Human-readable column name (OpVectorColumnMetadata.makeColName)."""
+        parts = ["_".join(self.parent_names)]
+        if self.grouping:
+            parts.append(self.grouping)
+        if self.descriptor_value:
+            parts.append(self.descriptor_value)
+        if self.indicator_value:
+            parts.append(self.indicator_value)
+        return "_".join(parts) + f"_{self.index}"
+
+    def grouped_key(self) -> tuple:
+        """Key identifying the pivot group this column belongs to — columns
+        sharing a group are dropped together by the SanityChecker
+        (OpVectorColumnMetadata.grouping semantics)."""
+        return (self.parent_names, self.grouping)
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "ColumnMeta":
+        d = dict(d)
+        d["parent_names"] = tuple(d["parent_names"])
+        return ColumnMeta(**d)
+
+
+@dataclasses.dataclass
+class VectorMetadata:
+    """Provenance for a whole feature vector (OpVectorMetadata.scala:51)."""
+
+    name: str
+    columns: tuple[ColumnMeta, ...] = ()
+
+    @property
+    def size(self) -> int:
+        return len(self.columns)
+
+    def column_names(self) -> list[str]:
+        return [c.make_name() for c in self.columns]
+
+    @staticmethod
+    def flatten(name: str, parts: Sequence["VectorMetadata"]) -> "VectorMetadata":
+        """Concatenate per-vectorizer metadata, reindexing columns
+        (OpVectorMetadata.flatten — used by VectorsCombiner)."""
+        cols: list[ColumnMeta] = []
+        for part in parts:
+            for c in part.columns:
+                cols.append(dataclasses.replace(c, index=len(cols)))
+        return VectorMetadata(name, tuple(cols))
+
+    def select(self, indices: Iterable[int]) -> "VectorMetadata":
+        """Keep a subset of columns, reindexed (SanityChecker drop mask)."""
+        cols = [
+            dataclasses.replace(self.columns[i], index=j)
+            for j, i in enumerate(indices)
+        ]
+        return VectorMetadata(self.name, tuple(cols))
+
+    def index_of_group(self) -> dict[tuple, list[int]]:
+        """Map pivot-group key -> column indices (group-wise removal)."""
+        groups: dict[tuple, list[int]] = {}
+        for i, c in enumerate(self.columns):
+            groups.setdefault(c.grouped_key(), []).append(i)
+        return groups
+
+    def to_json(self) -> dict[str, Any]:
+        return {"name": self.name, "columns": [c.to_json() for c in self.columns]}
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "VectorMetadata":
+        return VectorMetadata(
+            d["name"], tuple(ColumnMeta.from_json(c) for c in d["columns"])
+        )
+
+
+def indicator_columns(
+    parent_name: str,
+    parent_type: str,
+    values: Sequence[str],
+    grouping: str | None = None,
+) -> list[ColumnMeta]:
+    """Pivot columns for categorical values (one per value)."""
+    return [
+        ColumnMeta(
+            parent_names=(parent_name,),
+            parent_type=parent_type,
+            grouping=grouping if grouping is not None else parent_name,
+            indicator_value=v,
+        )
+        for v in values
+    ]

@@ -106,7 +106,6 @@ class TextColumn(Column):
 
     @staticmethod
     def from_values(feature_type: type, raw: Iterable[Any]) -> "TextColumn":
-        out = np.empty(0, dtype=object)
         lst = [None if v is None or v == "" else str(v) for v in raw]
         out = np.empty(len(lst), dtype=object)
         out[:] = lst
@@ -274,7 +273,13 @@ def column_from_values(feature_type: type, raw: Sequence[Any]) -> Column:
                 try:
                     return int(v)  # exact — int(float(s)) corrupts ints > 2^53
                 except ValueError:
-                    return int(float(v))
+                    f = float(v)  # accept "3.0"-style strings only
+                    if not f.is_integer():
+                        raise ValueError(
+                            f"Non-integral value {v!r} for "
+                            f"{feature_type.__name__} column"
+                        ) from None
+                    return int(f)
             return v
 
         return NumericColumn.from_values(
@@ -283,21 +288,36 @@ def column_from_values(feature_type: type, raw: Sequence[Any]) -> Column:
     if storage is Storage.TEXT:
         return TextColumn.from_values(feature_type, raw)
     if storage is Storage.TEXT_SET:
-        return SetColumn(feature_type, [frozenset(v) if v else frozenset() for v in raw])
+        # a bare string is one member, not a character collection
+        return SetColumn(
+            feature_type,
+            [
+                frozenset((v,)) if isinstance(v, str)
+                else frozenset(v) if v else frozenset()
+                for v in raw
+            ],
+        )
     if storage in (Storage.TEXT_LIST, Storage.DATE_LIST, Storage.GEO):
         return ListColumn(feature_type, [list(v) if v else [] for v in raw])
     if storage is Storage.MAP:
-        if feature_type is Prediction or (
-            isinstance(raw, list) and raw and isinstance(raw[0], PredictionColumn)
-        ):
+        if feature_type is Prediction:
             raise TypeError("Prediction columns are built by models, not from raw values")
         assert issubclass(feature_type, OPMap)
         return MapColumn(feature_type, [dict(v) if v else {} for v in raw])
     if storage is Storage.VECTOR:
-        return VectorColumn(feature_type, np.asarray(raw, dtype=np.float32))
+        arr = np.asarray(raw, dtype=np.float32)
+        if arr.ndim != 2:
+            raise ValueError(
+                f"OPVector values must be [N, D], got shape {arr.shape}"
+            )
+        return VectorColumn(feature_type, arr)
     raise ValueError(f"No physical column for storage {storage}")
 
 
 def empty_like(feature_type: type, n: int) -> Column:
     """An all-missing column of length n."""
+    if feature_type.storage is Storage.VECTOR:
+        return VectorColumn(feature_type, np.zeros((n, 0), dtype=np.float32))
+    if feature_type is Prediction:
+        return PredictionColumn(Prediction, np.zeros(n, dtype=np.float64))
     return column_from_values(feature_type, [None] * n)

@@ -1,0 +1,188 @@
+"""Statistics plane: column stats, correlation, Cramér's V.
+
+Reference: utils/.../stats/OpStatistics.scala:1-384 (chi-sq / Cramér's V /
+PMI / association-rule confidence) and SanityChecker's use of
+``Statistics.colStats`` + ``Statistics.corr``.
+
+TPU-first design: everything here is a dense-matrix reduction —
+  * column stats: per-column sum / sumsq / min / max (psum-able);
+  * the full correlation matrix of [X | y] is a centered XᵀX matmul
+    (MXU-friendly; shard rows over the mesh, psum the partial products);
+  * Cramér's V contingency tables are one-hot matmuls Gᵀ·onehot(y).
+The jitted implementations live here so the SanityChecker estimator stays a
+thin policy layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class ColumnStats:
+    count: int
+    mean: np.ndarray      # [D]
+    variance: np.ndarray  # [D]
+    min: np.ndarray       # [D]
+    max: np.ndarray       # [D]
+
+
+#: below this element count the numpy path wins — jit compile time dwarfs the
+#: matmul for small stats problems (tests, tiny datasets); above it the jitted
+#: kernel runs on the accelerator.
+_DEVICE_THRESHOLD = 1 << 22
+
+
+@partial(jax.jit, static_argnames=())
+def _colstats_kernel(x: jax.Array):
+    n = x.shape[0]
+    mean = jnp.mean(x, axis=0)
+    var = jnp.sum((x - mean) ** 2, axis=0) / jnp.maximum(n - 1, 1)
+    return mean, var, jnp.min(x, axis=0), jnp.max(x, axis=0)
+
+
+def column_stats(x: np.ndarray) -> ColumnStats:
+    """Per-column count/mean/variance/min/max (mllib colStats parity:
+    sample variance, n-1 denominator)."""
+    if x.size < _DEVICE_THRESHOLD:
+        x64 = np.asarray(x, dtype=np.float64)
+        mean = x64.mean(axis=0)
+        var = ((x64 - mean) ** 2).sum(axis=0) / max(x.shape[0] - 1, 1)
+        mn, mx = x64.min(axis=0), x64.max(axis=0)
+    else:
+        mean, var, mn, mx = _colstats_kernel(jnp.asarray(x))
+    return ColumnStats(
+        count=int(x.shape[0]),
+        mean=np.asarray(mean, dtype=np.float64),
+        variance=np.asarray(var, dtype=np.float64),
+        min=np.asarray(mn, dtype=np.float64),
+        max=np.asarray(mx, dtype=np.float64),
+    )
+
+
+@jax.jit
+def _corr_kernel(m: jax.Array):
+    n = m.shape[0]
+    mean = jnp.mean(m, axis=0)
+    c = m - mean
+    cov = (c.T @ c) / jnp.maximum(n - 1, 1)
+    std = jnp.sqrt(jnp.diag(cov))
+    denom = jnp.outer(std, std)
+    return cov / jnp.where(denom == 0, 1.0, denom), std
+
+
+def _corr_numpy(m: np.ndarray):
+    n = m.shape[0]
+    c = m - m.mean(axis=0)
+    cov = (c.T @ c) / max(n - 1, 1)
+    std = np.sqrt(np.diag(cov))
+    denom = np.outer(std, std)
+    return cov / np.where(denom == 0, 1.0, denom), std
+
+
+def correlation_matrix(x: np.ndarray, y: np.ndarray | None = None) -> np.ndarray:
+    """Pearson correlation matrix of [X | y] via centered XᵀX.
+
+    Zero-variance columns yield 0 correlation (mllib returns NaN; we
+    normalize to 0 and flag them via the variance rule instead).
+    """
+    m = np.column_stack([x, y]) if y is not None else x
+    if m.size < _DEVICE_THRESHOLD:
+        corr, std = _corr_numpy(np.asarray(m, dtype=np.float64))
+    else:
+        corr, std = _corr_kernel(jnp.asarray(m, dtype=jnp.float32))
+    corr = np.asarray(corr, dtype=np.float64)
+    std = np.asarray(std, dtype=np.float64)
+    corr[std == 0, :] = 0.0
+    corr[:, std == 0] = 0.0
+    np.fill_diagonal(corr, 1.0)
+    return np.clip(corr, -1.0, 1.0)
+
+
+def spearman_correlation_matrix(x: np.ndarray, y: np.ndarray | None = None) -> np.ndarray:
+    """Spearman = Pearson on fractional ranks (CorrelationType.Spearman)."""
+    m = np.column_stack([x, y]) if y is not None else x
+    ranks = np.empty_like(m, dtype=np.float64)
+    for j in range(m.shape[1]):
+        col = m[:, j]
+        order = np.argsort(col, kind="stable")
+        r = np.empty(len(col), dtype=np.float64)
+        r[order] = np.arange(len(col), dtype=np.float64)
+        # average ties
+        _, inv, counts = np.unique(col, return_inverse=True, return_counts=True)
+        sums = np.zeros(len(counts))
+        np.add.at(sums, inv, r)
+        ranks[:, j] = sums[inv] / counts[inv]
+    return correlation_matrix(ranks)
+
+
+def contingency_table(group_cols: np.ndarray, label_onehot: np.ndarray) -> np.ndarray:
+    """[K, C] contingency of K category-indicator columns vs C label classes —
+    a single matmul Gᵀ·Y (OpStatistics.contingencyStats input)."""
+    if group_cols.size + label_onehot.size < _DEVICE_THRESHOLD:
+        return np.asarray(group_cols, dtype=np.float64).T @ np.asarray(
+            label_onehot, dtype=np.float64
+        )
+    return np.asarray(
+        jnp.asarray(group_cols).T @ jnp.asarray(label_onehot), dtype=np.float64
+    )
+
+
+def chi_squared(contingency: np.ndarray) -> float:
+    """Pearson chi-squared statistic of a contingency table."""
+    total = contingency.sum()
+    if total == 0:
+        return 0.0
+    rows = contingency.sum(axis=1, keepdims=True)
+    cols = contingency.sum(axis=0, keepdims=True)
+    expected = rows @ cols / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = np.where(expected > 0, (contingency - expected) ** 2 / expected, 0.0)
+    return float(terms.sum())
+
+
+def cramers_v(contingency: np.ndarray) -> float:
+    """Cramér's V (OpStatistics.cramersV): sqrt(chi2 / (n * (min(r,c)-1))).
+    Degenerate tables (a single row/column) give 0."""
+    # drop all-zero rows/cols — categories absent from the sample
+    c = contingency[contingency.sum(axis=1) > 0][:, contingency.sum(axis=0) > 0]
+    if c.size == 0:
+        return 0.0
+    r, k = c.shape
+    denom_df = min(r - 1, k - 1)
+    n = c.sum()
+    if denom_df <= 0 or n == 0:
+        return 0.0
+    return float(np.sqrt(chi_squared(c) / (n * denom_df)))
+
+
+def pointwise_mutual_information(contingency: np.ndarray) -> np.ndarray:
+    """PMI matrix log2(P(x,y)/(P(x)P(y))) per cell (OpStatistics PMI);
+    zero cells give 0."""
+    total = contingency.sum()
+    if total == 0:
+        return np.zeros_like(contingency)
+    p = contingency / total
+    px = p.sum(axis=1, keepdims=True)
+    py = p.sum(axis=0, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pmi = np.where(p > 0, np.log2(p / (px @ py)), 0.0)
+    return pmi
+
+
+def association_rule_confidence(contingency: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-category (max rule confidence, support): confidence = max_c
+    P(label=c | category), support = category count / total
+    (OpStatistics confidence/support used by maxRuleConfidence check)."""
+    totals = contingency.sum(axis=1)
+    n = contingency.sum()
+    with np.errstate(divide="ignore", invalid="ignore"):
+        conf = np.where(
+            totals[:, None] > 0, contingency / totals[:, None], 0.0
+        ).max(axis=1)
+    support = totals / n if n else np.zeros_like(totals)
+    return conf, support

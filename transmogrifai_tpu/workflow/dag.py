@@ -48,9 +48,16 @@ def validate_stages(layers: list[list[PipelineStage]]) -> None:
 
 
 def raw_features_of(result_features: Iterable[Feature]) -> list[Feature]:
-    """All distinct raw-feature leaves required by the result features."""
+    """All distinct raw-feature leaves required by the result features.
+    Distinct raw features sharing a name across result features is an error
+    (they would silently read each other's data)."""
     seen: dict[str, Feature] = {}
     for rf in result_features:
         for f in rf.raw_features():
-            seen.setdefault(f.name, f)
+            prior = seen.get(f.name)
+            if prior is not None and prior.uid != f.uid:
+                raise ValueError(
+                    f"Two distinct raw features named '{f.name}' in one workflow"
+                )
+            seen[f.name] = f
     return list(seen.values())

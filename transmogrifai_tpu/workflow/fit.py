@@ -1,0 +1,61 @@
+"""DAG fitting engine — fit estimators layer by layer, transform through.
+
+Reference: core/.../utils/stages/FitStagesUtil.scala:212-290
+(fitAndTransformDAG / fitAndTransformLayer): per layer, fit every estimator
+on the current dataset, then apply all of the layer's (fitted) transformers.
+The reference bulk-applies row-level transformers in one RDD map; here a
+layer's transforms append columns to the columnar Dataset (the numeric plane
+stays in arrays; XLA fusion happens in the compiled scoring path).
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..dataset import Dataset
+from ..features.feature import Feature
+from ..stages.base import Estimator, Model, PipelineStage, Transformer
+from .dag import compute_dag
+
+
+def fit_and_transform_dag(
+    dataset: Dataset,
+    result_features: Iterable[Feature],
+) -> tuple[Dataset, dict[str, PipelineStage]]:
+    """Fit the whole DAG; returns (transformed dataset, fitted stage by
+    original-stage uid). Fitted models replace their estimators keyed by the
+    estimator uid (FitStagesUtil.scala:251-290)."""
+    layers = compute_dag(list(result_features))
+    fitted: dict[str, PipelineStage] = {}
+    for layer in layers:
+        transformers: list[Transformer] = []
+        for stage in layer:
+            if isinstance(stage, Estimator):
+                model = stage.fit(dataset)
+                fitted[stage.uid] = model
+                transformers.append(model)
+            elif isinstance(stage, Transformer):
+                fitted[stage.uid] = stage
+                transformers.append(stage)
+            else:
+                raise TypeError(f"Cannot fit {stage}")
+        for t in transformers:
+            dataset = t.transform(dataset)
+    return dataset, fitted
+
+
+def apply_transformations_dag(
+    dataset: Dataset,
+    result_features: Iterable[Feature],
+    fitted: dict[str, PipelineStage],
+) -> Dataset:
+    """Scoring path: apply the fitted DAG (OpWorkflowCore.applyTransformationsDAG,
+    core/.../OpWorkflowCore.scala:324)."""
+    layers = compute_dag(list(result_features))
+    for layer in layers:
+        for stage in layer:
+            t = fitted.get(stage.uid, stage)
+            if isinstance(t, Estimator):
+                raise ValueError(f"Stage {t} was never fitted")
+            assert isinstance(t, Transformer)
+            dataset = t.transform(dataset)
+    return dataset

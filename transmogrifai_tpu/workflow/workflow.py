@@ -1,0 +1,270 @@
+"""Workflow + WorkflowModel: result-feature-driven training and scoring.
+
+Reference: core/.../OpWorkflow.scala (train :347, DAG assembly :90-110,
+validation :280-338) and core/.../OpWorkflowModel.scala (score :259,
+summary :187-223).
+
+The user declares result features; the workflow reconstructs the stage DAG
+from lineage, materializes raw data through a reader, reserves a holdout via
+the model selector's splitter (OpWorkflow.scala:380-384), fits the DAG layer
+by layer, evaluates the selected model on the holdout, and returns a fitted
+WorkflowModel that can score/evaluate/summarize/save.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features.feature import Feature
+from ..readers.core import DataReader, DatasetReader
+from ..selector.model_selector import ModelSelector, SelectedModel
+from ..stages.base import Estimator, PipelineStage
+from ..types.columns import NumericColumn, VectorColumn
+from .dag import compute_dag, raw_features_of, validate_stages
+from .fit import apply_transformations_dag, fit_and_transform_dag
+
+log = logging.getLogger(__name__)
+
+
+class Workflow:
+    def __init__(self):
+        self.result_features: tuple[Feature, ...] = ()
+        self.reader: DataReader | None = None
+        self._stage_overrides: dict[str, dict[str, Any]] = {}
+
+    # ----------------------------------------------------------- configure
+    def set_result_features(self, *features: Feature) -> "Workflow":
+        self.result_features = tuple(features)
+        return self
+
+    def set_input_dataset(self, dataset: Dataset) -> "Workflow":
+        self.reader = DatasetReader(dataset)
+        return self
+
+    def set_reader(self, reader: DataReader) -> "Workflow":
+        self.reader = reader
+        return self
+
+    def set_stage_parameters(self, overrides: dict[str, dict[str, Any]]) -> "Workflow":
+        """Per-stage param overrides keyed by stage uid or class name,
+        applied reflectively before fit (OpWorkflow.setStageParameters,
+        OpWorkflow.scala:179-201)."""
+        self._stage_overrides.update(overrides)
+        return self
+
+    # --------------------------------------------------------------- train
+    def _stages(self) -> list[PipelineStage]:
+        layers = compute_dag(self.result_features)
+        validate_stages(layers)
+        return [s for layer in layers for s in layer]
+
+    def _apply_overrides(self, stages: Sequence[PipelineStage]) -> None:
+        for stage in stages:
+            for key in (stage.uid, type(stage).__name__):
+                if key in self._stage_overrides:
+                    stage.set_params(**self._stage_overrides[key])
+
+    def train(self) -> "WorkflowModel":
+        if not self.result_features:
+            raise ValueError("setResultFeatures must be called before train")
+        if self.reader is None:
+            raise ValueError("No input data: call set_input_dataset or set_reader")
+        stages = self._stages()
+        self._apply_overrides(stages)
+        selectors = [s for s in stages if isinstance(s, ModelSelector)]
+        if len(selectors) > 1:
+            raise ValueError(
+                "Only one ModelSelector is allowed per workflow "
+                f"(found {len(selectors)})"  # FitStagesUtil.cutDAG:310 parity
+            )
+        selector = selectors[0] if selectors else None
+
+        raw_features = raw_features_of(self.result_features)
+        raw = self.reader.generate_dataset(raw_features)
+        if raw.num_rows == 0:
+            raise ValueError("Input dataset cannot be empty")
+        log.info("Generated raw data: %d rows, %d features", raw.num_rows, len(raw_features))
+
+        train_data, holdout_data = raw, None
+        if selector is not None and selector.splitter is not None:
+            train_idx, holdout_idx = selector.splitter.split(raw.num_rows)
+            if len(holdout_idx):
+                train_data = raw.take(train_idx)
+                holdout_data = raw.take(holdout_idx)
+
+        fitted_data, fitted = fit_and_transform_dag(train_data, self.result_features)
+
+        holdout_metrics = None
+        if selector is not None and holdout_data is not None:
+            sel_model = fitted[selector.uid]
+            assert isinstance(sel_model, SelectedModel)
+            transformed = apply_transformations_dag(
+                holdout_data, self.result_features, fitted
+            )
+            label_name, vec_name = selector.input_names
+            label = transformed[label_name]
+            vec = transformed[vec_name]
+            assert isinstance(label, NumericColumn) and isinstance(vec, VectorColumn)
+            holdout_metrics = sel_model.evaluate_holdout(
+                np.asarray(vec.values, dtype=np.float32),
+                label.values.astype(np.float64),
+                selector.evaluator,
+            )
+            log.info("Holdout metrics: %s", holdout_metrics)
+
+        return WorkflowModel(
+            result_features=self.result_features,
+            raw_features=tuple(raw_features),
+            fitted=fitted,
+            selector=selector,
+            train_rows=train_data.num_rows,
+            holdout_rows=0 if holdout_data is None else holdout_data.num_rows,
+        )
+
+
+class WorkflowModel:
+    def __init__(
+        self,
+        result_features: tuple[Feature, ...],
+        raw_features: tuple[Feature, ...],
+        fitted: dict[str, PipelineStage],
+        selector: ModelSelector | None,
+        train_rows: int = 0,
+        holdout_rows: int = 0,
+    ):
+        self.result_features = result_features
+        self.raw_features = raw_features
+        self.fitted = fitted
+        self.selector = selector
+        self.train_rows = train_rows
+        self.holdout_rows = holdout_rows
+
+    # --------------------------------------------------------------- score
+    def _prepare_raw(self, dataset: Dataset | None, reader: DataReader | None) -> Dataset:
+        if dataset is not None:
+            reader = DatasetReader(self._with_missing_response(dataset))
+        if reader is None:
+            raise ValueError("score requires a dataset or reader")
+        return reader.generate_dataset(list(self.raw_features))
+
+    def _with_missing_response(self, dataset: Dataset) -> Dataset:
+        """Scoring data often lacks the response column; synthesize zeros
+        (the reference reader produces null labels at score time)."""
+        for f in self.raw_features:
+            if f.is_response and f.name not in dataset:
+                col = NumericColumn(
+                    f.ftype,
+                    np.zeros(dataset.num_rows, dtype=np.float64),
+                    np.ones(dataset.num_rows, dtype=bool),
+                )
+                dataset = dataset.with_column(f.name, col)
+        return dataset
+
+    def score(
+        self,
+        dataset: Dataset | None = None,
+        reader: DataReader | None = None,
+        keep_raw_features: bool = False,
+        keep_intermediate_features: bool = False,
+    ) -> Dataset:
+        """Apply the fitted DAG (OpWorkflowModel.score, OpWorkflowModel.scala:259)."""
+        raw = self._prepare_raw(dataset, reader)
+        transformed = apply_transformations_dag(raw, self.result_features, self.fitted)
+        if keep_intermediate_features:
+            return transformed
+        keep = [f.name for f in self.result_features if f.name in transformed]
+        if keep_raw_features:
+            keep = [f.name for f in self.raw_features] + keep
+        return transformed.select(keep)
+
+    def score_and_evaluate(
+        self, dataset: Dataset, evaluator=None
+    ) -> tuple[Dataset, dict[str, Any]]:
+        scores = self.score(dataset, keep_intermediate_features=True)
+        metrics = self._evaluate_transformed(scores, evaluator)
+        keep = [f.name for f in self.result_features if f.name in scores]
+        return scores.select(keep), metrics
+
+    def evaluate(self, dataset: Dataset, evaluator=None) -> dict[str, Any]:
+        """Score + evaluate against the true labels present in ``dataset``."""
+        transformed = self.score(dataset, keep_intermediate_features=True)
+        return self._evaluate_transformed(transformed, evaluator)
+
+    def _evaluate_transformed(self, transformed: Dataset, evaluator=None) -> dict[str, Any]:
+        if self.selector is None:
+            raise ValueError("evaluate requires a ModelSelector in the workflow")
+        evaluator = evaluator or self.selector.evaluator
+        label_name = self.selector.input_names[0]
+        pred_name = self.selector.output_name
+        label = transformed[label_name]
+        pred = transformed[pred_name]
+        return evaluator.evaluate(label, pred)
+
+    # ------------------------------------------------------------- summary
+    def summary_json(self) -> dict[str, Any]:
+        sel_summary = None
+        if self.selector is not None:
+            model = self.fitted.get(self.selector.uid)
+            if isinstance(model, SelectedModel):
+                sel_summary = model.summary
+        stage_meta = {
+            uid: s.metadata
+            for uid, s in self.fitted.items()
+            if s.metadata
+        }
+        return {
+            "trainRows": self.train_rows,
+            "holdoutRows": self.holdout_rows,
+            "rawFeatures": [f.name for f in self.raw_features],
+            "resultFeatures": [f.name for f in self.result_features],
+            "modelSelectorSummary": sel_summary,
+            "stageMetadata": stage_meta,
+        }
+
+    def summary_pretty(self) -> str:
+        """Human-readable training summary (OpWorkflowModel.summaryPretty,
+        rendered like the reference README tables)."""
+        from ..utils.table import render_table
+
+        s = self.summary_json()
+        lines: list[str] = []
+        sel = s.get("modelSelectorSummary")
+        if sel:
+            lines.append("Evaluated model candidates (CV means):")
+            by_family: dict[str, list[float]] = {}
+            for r in sel["validationResults"]:
+                by_family.setdefault(r["modelName"], []).append(r["metricMean"])
+            rows = [
+                [name, str(len(vals)),
+                 f"[{min(vals):.4f}, {max(vals):.4f}]"]
+                for name, vals in sorted(by_family.items())
+            ]
+            lines.append(
+                render_table(
+                    ["Model", "Candidates", f"{sel['evaluationMetric']} range"], rows
+                )
+            )
+            lines.append(f"Selected model: {sel['bestModelType']} {sel['bestGrid']}")
+            for split_name, key in (
+                ("Train", "trainEvaluation"),
+                ("Holdout", "holdoutEvaluation"),
+            ):
+                m = sel.get(key)
+                if m:
+                    scalars = {
+                        k: v for k, v in m.items() if isinstance(v, (int, float))
+                    }
+                    lines.append(
+                        render_table(
+                            ["Metric", split_name],
+                            [[k, f"{v:.4f}"] for k, v in scalars.items()],
+                        )
+                    )
+        lines.append(
+            f"Trained on {s['trainRows']} rows (holdout {s['holdoutRows']}); "
+            f"{len(s['rawFeatures'])} raw features"
+        )
+        return "\n".join(lines)
