@@ -1,0 +1,104 @@
+"""ModelInsights + LOCO tests (parity: ModelInsightsTest.scala 974 LoC,
+RecordInsightsLOCOTest)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder, from_dataset
+from transmogrifai_tpu.insights import RecordInsightsLOCO, model_insights
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.readers import infer_csv_dataset
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import VectorColumn, column_from_values
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+LR_MODELS = [(LogisticRegression(), {"reg_param": [0.01, 0.1]})]
+
+
+@pytest.fixture(scope="module")
+def titanic_trained():
+    ds = infer_csv_dataset(
+        "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+    )
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+    sel = BinaryClassificationModelSelector(seed=9, models=LR_MODELS)
+    pred = sel.set_input(resp, checked).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    return ds, vector, pred, model
+
+
+def test_model_insights_structure(titanic_trained):
+    ds, vector, pred, model = titanic_trained
+    ins = model_insights(model)
+    assert ins["label"]["labelName"] == "Survived"
+    assert ins["label"]["problemKind"] == "BinaryClassification"
+    assert ins["selectedModelInfo"]["bestModelType"] == "LogisticRegression"
+    feats = {f["featureName"]: f for f in ins["features"]}
+    assert "Sex" in feats and "Age" in feats
+    sex_cols = feats["Sex"]["derivedFeatures"]
+    assert any(c.get("indicatorValue") == "Male" for c in sex_cols)
+    # every kept derived column has a contribution and correlation
+    kept = [c for f in ins["features"] for c in f["derivedFeatures"] if not c["excluded"]]
+    assert all(c["contribution"] is not None for c in kept)
+    assert any(abs(c["corr"] or 0) > 0.3 for c in kept)  # Sex correlates
+
+
+def test_model_insights_contributions_nonzero(titanic_trained):
+    _, _, _, model = titanic_trained
+    ins = model_insights(model)
+    contribs = [
+        c["contribution"]
+        for f in ins["features"]
+        for c in f["derivedFeatures"]
+        if not c["excluded"]
+    ]
+    assert sum(1 for c in contribs if c > 0) > 5
+
+
+def test_loco_identifies_driving_feature(rng):
+    # column 0 drives the model; LOCO must rank it first
+    n = 300
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    lbl = FeatureBuilder.RealNN("label").as_response()
+    vecf = FeatureBuilder.OPVector("vec").as_predictor()
+    est = LogisticRegression().set_input(lbl, vecf)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, y.tolist()),
+        "vec": VectorColumn(T.OPVector, x),
+    })
+    lr_model = est.fit(ds)
+    loco = RecordInsightsLOCO(lr_model, top_k=3).set_input(vecf)
+    out = loco.transform(ds)[loco.output_name]
+    maps = out.to_list()
+    assert len(maps) == n
+    top_keys = [max(m, key=lambda k: abs(m[k])) for m in maps]
+    frac_col0 = sum(1 for k in top_keys if k == "col_0") / n
+    assert frac_col0 > 0.8
+
+
+def test_loco_on_titanic_groups_text(titanic_trained):
+    ds, vector, pred, model = titanic_trained
+    sel_model = next(
+        s for s in model.fitted.values()
+        if type(s).__name__ == "SelectedModel"
+    )
+    scored = model.score(dataset=ds.take(np.arange(20)), keep_intermediate_features=True)
+    vec_name = model.selector_info["vectorName"]
+    vec_col = scored[vec_name]
+    vecf = FeatureBuilder.OPVector(vec_name).as_predictor()
+    loco = RecordInsightsLOCO(sel_model, top_k=5).set_input(vecf)
+    small = Dataset.of({vec_name: vec_col})
+    out = loco.transform(small)[loco.output_name]
+    maps = out.to_list()
+    assert all(len(m) == 5 for m in maps)
+    # hashed text columns must be aggregated per parent, not 512 hash entries
+    keys = {k for m in maps for k in m}
+    assert not any(k.startswith("hash_") or "_hash_" in k for k in keys)
+    assert any(k.endswith("(text)") for k in keys)

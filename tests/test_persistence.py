@@ -1,0 +1,121 @@
+"""Model save/load round-trip + local scoring tests (parity:
+OpWorkflowModelReaderWriterTest, OpWorkflowModelLocalTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.local import score_function
+from transmogrifai_tpu.models import LogisticRegression, XGBoostClassifier
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.readers import infer_csv_dataset
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+
+LR_MODELS = [(LogisticRegression(), {"reg_param": [0.01, 0.1]})]
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    ds = infer_csv_dataset(
+        "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+    )
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+    sel = BinaryClassificationModelSelector(seed=5, models=LR_MODELS)
+    pred = sel.set_input(resp, checked).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    return ds, pred, model
+
+
+def test_save_load_scores_identically(trained, tmp_path):
+    ds, pred, model = trained
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    s1 = model.score(dataset=ds)
+    s2 = loaded.score(dataset=ds)
+    np.testing.assert_allclose(
+        np.asarray(s1[pred.name].probability),
+        np.asarray(s2[pred.name].probability),
+        atol=1e-7,
+    )
+    np.testing.assert_array_equal(
+        s1[pred.name].prediction, s2[pred.name].prediction
+    )
+
+
+def test_loaded_model_summary_and_evaluate(trained, tmp_path):
+    ds, pred, model = trained
+    path = str(tmp_path / "model2")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    s = loaded.summary_json()
+    assert s["modelSelectorSummary"]["problemKind"] == "BinaryClassification"
+    assert s["trainRows"] == model.train_rows
+    metrics = loaded.evaluate(ds)
+    assert metrics["AuROC"] > 0.7
+    assert "LogisticRegression" in loaded.summary_pretty()
+
+
+def test_save_load_tree_model(tmp_path, rng):
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.types.columns import NumericColumn, column_from_values
+
+    n = 400
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    y = ((x0**2 + x1**2) < 1.0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.Integral, y.astype(int)),
+        "a": column_from_values(T.Real, x0),
+        "b": column_from_values(T.Real, x1),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vector = transmogrify(preds)
+    sel = BinaryClassificationModelSelector(
+        seed=2, models=[(XGBoostClassifier(num_round=10, max_depth=3), {})]
+    )
+    pred = sel.set_input(resp, vector).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    path = str(tmp_path / "treemodel")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    np.testing.assert_allclose(
+        np.asarray(model.score(dataset=ds)[pred.name].probability),
+        np.asarray(loaded.score(dataset=ds)[pred.name].probability),
+        atol=1e-7,
+    )
+
+
+def test_local_score_function(trained):
+    ds, pred, model = trained
+    fn = score_function(model)
+    row = ds.rows()[0]
+    out = fn(row)
+    assert pred.name in out
+    pmap = out[pred.name]
+    assert "prediction" in pmap and "probability_1" in pmap
+    # matches batch scoring
+    batch_probs = np.asarray(model.score(dataset=ds)[pred.name].probability)
+    assert pmap["probability_1"] == pytest.approx(batch_probs[0, 1], abs=1e-9)
+
+
+def test_local_score_function_batch(trained):
+    ds, pred, model = trained
+    fn = score_function(model)
+    rows = ds.rows()[:10]
+    outs = fn.batch(rows)
+    assert len(outs) == 10
+    assert all(pred.name in o for o in outs)
+
+
+def test_local_score_missing_label(trained):
+    ds, pred, model = trained
+    fn = score_function(model)
+    row = {k: v for k, v in ds.rows()[3].items() if k != "Survived"}
+    out = fn(row)
+    assert 0.0 <= out[pred.name]["probability_1"] <= 1.0

@@ -1,0 +1,112 @@
+"""RawFeatureFilter tests (parity: RawFeatureFilterTest.scala, 1,065 LoC —
+known-bad features must be excluded, good ones kept)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep.raw_feature_filter import (
+    RawFeatureFilter,
+    compute_distribution,
+)
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+
+def _ds(n, rng, **extra):
+    cols = {
+        "label": column_from_values(T.Integral, rng.integers(0, 2, n).tolist()),
+        "good": column_from_values(T.Real, rng.normal(size=n).tolist()),
+    }
+    cols.update(extra)
+    return Dataset.of(cols)
+
+
+def test_distribution_fill_rate_and_js(rng):
+    a = column_from_values(T.Real, [1.0, 2.0, None, 4.0])
+    d = compute_distribution("a", a, bins=10)
+    assert d.fill_rate == 0.75
+    same = compute_distribution("a", a, bins=10)
+    assert d.js_divergence(same) == pytest.approx(0.0, abs=1e-12)
+    shifted = column_from_values(T.Real, [100.0, 200.0, 300.0, 400.0])
+    d2 = compute_distribution(
+        "a", shifted, bins=10, numeric_range=(d.summary["min"], d.summary["max"])
+    )
+    # out-of-range values clip into the edge bin, which train also occupies,
+    # so divergence is high but not maximal
+    assert d.js_divergence(d2) > 0.4
+
+
+def test_low_fill_feature_excluded(rng):
+    n = 1000
+    mostly_null = [None] * (n - 1) + [1.0]
+    ds = _ds(n, rng, sparse=column_from_values(T.Real, mostly_null))
+    resp, preds = from_dataset(ds, response="label")
+    rff = RawFeatureFilter(min_fill=0.01)
+    excl = rff.compute_exclusions(ds, preds, label_name="label")
+    assert "sparse" in excl and "good" not in excl
+    reasons = rff.results.excluded["sparse"]
+    assert any("fillRate" in r for r in reasons)
+
+
+def test_train_score_drift_excluded(rng):
+    n = 1000
+    train = _ds(n, rng, drifty=column_from_values(T.Real, rng.normal(0, 1, n).tolist()))
+    score = Dataset.of({
+        "good": train["good"],
+        "drifty": column_from_values(T.Real, rng.normal(100, 1, n).tolist()),
+    })
+    resp, preds = from_dataset(train, response="label")
+    rff = RawFeatureFilter(max_js_divergence=0.5)
+    excl = rff.compute_exclusions(train, preds, score=score, label_name="label")
+    assert "drifty" in excl and "good" not in excl
+
+
+def test_null_label_leakage_excluded(rng):
+    n = 600
+    y = rng.integers(0, 2, n)
+    leaky = [None if yi == 1 else 1.0 for yi in y]  # missingness == label
+    ds = Dataset.of({
+        "label": column_from_values(T.Integral, y.tolist()),
+        "good": column_from_values(T.Real, rng.normal(size=n).tolist()),
+        "leaky_nulls": column_from_values(T.Real, leaky),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    rff = RawFeatureFilter()
+    excl = rff.compute_exclusions(ds, preds, label_name="label")
+    assert "leaky_nulls" in excl
+
+
+def test_workflow_with_rff_rewrites_dag(rng):
+    n = 800
+    y = rng.integers(0, 2, n)
+    x = rng.normal(size=n) + y  # informative
+    sparse = [None] * (n - 2) + [1.0, 2.0]
+    ds = Dataset.of({
+        "label": column_from_values(T.Integral, y.tolist()),
+        "good": column_from_values(T.Real, x.tolist()),
+        "sparse": column_from_values(T.Real, sparse),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vector = transmogrify(preds)
+    sel = BinaryClassificationModelSelector(
+        seed=1, models=[(LogisticRegression(), {"reg_param": [0.01]})]
+    )
+    pred = sel.set_input(resp, vector).get_output()
+    model = (
+        Workflow()
+        .set_result_features(pred)
+        .set_input_dataset(ds)
+        .with_raw_feature_filter(min_fill=0.01)
+        .train()
+    )
+    s = model.summary_json()
+    assert "sparse" in s["blocklistedFeatures"]
+    assert s["rawFeatureFilterResults"]["exclusionReasons"]["sparse"]
+    # the fitted vectorizer no longer references the dropped feature
+    scores = model.score(dataset=ds.drop(["sparse"]))
+    assert scores.num_rows == n

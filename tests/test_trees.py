@@ -1,0 +1,209 @@
+"""Histogram tree / forest / boosting tests (parity: XGBoost/RF/GBT/DT
+classification + regression test suites)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.evaluators import (
+    BinaryClassificationEvaluator,
+    RegressionEvaluator,
+)
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import (
+    DecisionTreeClassifier,
+    GBTRegressor,
+    MLPClassifier,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    XGBoostClassifier,
+    XGBoostRegressor,
+)
+from transmogrifai_tpu.models import trees as TR
+from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+
+
+def _pred_ds(x, y):
+    n = len(y)
+    return Dataset.of({
+        "label": NumericColumn(T.RealNN, np.asarray(y, dtype=np.float64),
+                               np.ones(n, dtype=bool)),
+        "vec": VectorColumn(T.OPVector, np.asarray(x, dtype=np.float32)),
+    })
+
+
+def _wire(est):
+    lbl = FeatureBuilder.RealNN("label").as_response()
+    vec = FeatureBuilder.OPVector("vec").as_predictor()
+    return est.set_input(lbl, vec)
+
+
+# ------------------------------ primitives ----------------------------------
+def test_quantile_binning_roundtrip(rng):
+    x = rng.normal(size=(1000, 3)).astype(np.float32)
+    thr = TR.quantile_thresholds(x, max_bins=8)
+    assert thr.shape == (3, 7)
+    binned = np.asarray(TR.bin_data(jnp.asarray(x), jnp.asarray(thr)))
+    assert binned.min() >= 0 and binned.max() <= 7
+    # roughly uniform occupancy
+    counts = np.bincount(binned[:, 0], minlength=8)
+    assert counts.min() > 50
+
+
+def test_grow_tree_single_split(rng):
+    # one feature perfectly separates the target at a known threshold
+    n = 512
+    x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = (x[:, 0] > 0.25).astype(np.float32)
+    thr = TR.quantile_thresholds(x, max_bins=16)
+    binned = TR.bin_data(jnp.asarray(x), jnp.asarray(thr))
+    tree = TR.grow_tree(
+        binned, jnp.asarray(-y), jnp.ones(n), jnp.ones(n), jnp.ones(2),
+        max_depth=2, num_bins=16, reg_lambda=0.0,
+    )
+    feat0 = int(np.asarray(tree.split_feat)[0, 0])
+    assert feat0 == 0  # must pick the separating feature at the root
+    leaf = np.asarray(TR.predict_tree(binned, tree))
+    acc = ((leaf > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.97
+
+
+def test_grow_tree_no_split_when_pure(rng):
+    n = 128
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    y = np.ones(n, dtype=np.float32)  # constant target -> no gain anywhere
+    thr = TR.quantile_thresholds(x, max_bins=8)
+    binned = TR.bin_data(jnp.asarray(x), jnp.asarray(thr))
+    tree = TR.grow_tree(
+        binned, jnp.asarray(-y), jnp.ones(n), jnp.ones(n), jnp.ones(2),
+        max_depth=3, num_bins=8, reg_lambda=0.0, min_info_gain=1e-6,
+    )
+    assert (np.asarray(tree.split_feat)[0] == -1).all()
+    np.testing.assert_allclose(
+        np.asarray(TR.predict_tree(binned, tree)), 1.0, atol=1e-6
+    )
+
+
+def test_min_child_weight_blocks_tiny_splits(rng):
+    n = 100
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    y = np.zeros(n, dtype=np.float32)
+    y[x[:, 0].argmax()] = 1.0  # a single positive outlier
+    thr = TR.quantile_thresholds(x, max_bins=32)
+    binned = TR.bin_data(jnp.asarray(x), jnp.asarray(thr))
+    tree = TR.grow_tree(
+        binned, jnp.asarray(-y), jnp.ones(n), jnp.ones(n), jnp.ones(1),
+        max_depth=1, num_bins=32, reg_lambda=0.0, min_child_weight=60.0,
+    )
+    # both children must carry >= 60 of 100 rows: impossible -> leaf
+    assert int(np.asarray(tree.split_feat)[0, 0]) == -1
+    # sanity: with a permissive threshold the same data does split
+    tree2 = TR.grow_tree(
+        binned, jnp.asarray(-y), jnp.ones(n), jnp.ones(n), jnp.ones(1),
+        max_depth=1, num_bins=32, reg_lambda=0.0, min_child_weight=1.0,
+    )
+    assert int(np.asarray(tree2.split_feat)[0, 0]) == 0
+
+
+# ------------------------------- ensembles ----------------------------------
+@pytest.fixture
+def circles(rng):
+    """Nonlinear binary problem trees should crack and linear models can't."""
+    n = 1200
+    x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = ((x[:, 0] ** 2 + x[:, 1] ** 2) < 0.4).astype(np.float32)
+    return x, y
+
+
+def test_xgboost_classifier_nonlinear(circles):
+    x, y = circles
+    model = _wire(XGBoostClassifier(num_round=30, max_depth=3)).fit(_pred_ds(x, y))
+    pred, prob, raw = model.predict_arrays(x)
+    assert (pred == y).mean() > 0.93
+    m = BinaryClassificationEvaluator().evaluate_arrays(y, pred, prob)
+    assert m["AuROC"] > 0.97
+
+
+def test_random_forest_classifier_nonlinear(circles):
+    x, y = circles
+    model = _wire(
+        RandomForestClassifier(num_trees=30, max_depth=6, seed=5)
+    ).fit(_pred_ds(x, y))
+    pred, prob, _ = model.predict_arrays(x)
+    assert (pred == y).mean() > 0.9
+    assert prob.shape == (len(y), 2)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-9)
+
+
+def test_decision_tree_classifier(circles):
+    x, y = circles
+    model = _wire(DecisionTreeClassifier(max_depth=6)).fit(_pred_ds(x, y))
+    pred, prob, _ = model.predict_arrays(x)
+    assert (pred == y).mean() > 0.85
+
+
+def test_xgboost_regressor_friedman(rng):
+    n = 2000
+    x = rng.uniform(size=(n, 5)).astype(np.float32)
+    y = (
+        10 * np.sin(np.pi * x[:, 0] * x[:, 1])
+        + 20 * (x[:, 2] - 0.5) ** 2
+        + 10 * x[:, 3]
+        + 5 * x[:, 4]
+    ).astype(np.float32)
+    model = _wire(XGBoostRegressor(num_round=50, max_depth=4)).fit(_pred_ds(x, y))
+    pred, _, _ = model.predict_arrays(x)
+    r2 = RegressionEvaluator().evaluate_arrays(y, pred, None)["R2"]
+    assert r2 > 0.9
+
+
+def test_gbt_and_rf_regressors(rng):
+    n = 1000
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (np.abs(x[:, 0]) + x[:, 1] ** 2).astype(np.float32)
+    for est in (GBTRegressor(max_iter=30, max_depth=4),
+                RandomForestRegressor(num_trees=30, max_depth=6)):
+        model = _wire(est).fit(_pred_ds(x, y))
+        pred, _, _ = model.predict_arrays(x)
+        r2 = RegressionEvaluator().evaluate_arrays(y, pred, None)["R2"]
+        assert r2 > 0.7, type(est).__name__
+
+
+def test_xgboost_multiclass(rng):
+    n = 900
+    y = rng.integers(0, 3, n)
+    centers = np.array([[2.0, 0], [-2, 1], [0, -2]])
+    x = (centers[y] + rng.normal(size=(n, 2)) * 0.4).astype(np.float32)
+    model = _wire(XGBoostClassifier(num_round=20, max_depth=3)).fit(
+        _pred_ds(x, y.astype(float))
+    )
+    pred, prob, _ = model.predict_arrays(x)
+    assert (pred == y).mean() > 0.9
+    assert prob.shape == (n, 3)
+
+
+def test_row_mask_respected_by_trees(rng):
+    n = 600
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    y_corrupt = y.copy()
+    y_corrupt[300:] = 1 - y[300:]  # adversarial labels outside the mask
+    mask = np.zeros(n, dtype=np.float32)
+    mask[:300] = 1.0
+    model = _wire(XGBoostClassifier(num_round=10, max_depth=3)).fit_arrays(
+        x, y_corrupt, mask
+    )
+    pred, _, _ = model.predict_arrays(x[:300])
+    assert (pred == y[:300]).mean() > 0.95
+
+
+# --------------------------------- MLP --------------------------------------
+def test_mlp_classifier_nonlinear(circles):
+    x, y = circles
+    model = _wire(MLPClassifier(hidden_layers=(16, 16), max_iter=400)).fit(
+        _pred_ds(x, y)
+    )
+    pred, prob, _ = model.predict_arrays(x)
+    assert (pred == y).mean() > 0.9
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-6)

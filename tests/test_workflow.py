@@ -17,11 +17,26 @@ from transmogrifai_tpu.selector import (
     MultiClassificationModelSelector,
     RegressionModelSelector,
 )
+from transmogrifai_tpu.models import LinearRegression, LogisticRegression
 from transmogrifai_tpu.types.columns import NumericColumn, column_from_values
 from transmogrifai_tpu.workflow.workflow import Workflow
 
 IRIS = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.csv"
 BOSTON = "/root/reference/helloworld/src/main/resources/BostonDataset/housingData.csv"
+
+# small, fast candidate lists for CPU tests (defaults add RF/XGB tree grids)
+LR_MODELS = [
+    (
+        LogisticRegression(),
+        {"reg_param": [0.001, 0.01, 0.1, 0.2], "elastic_net_param": [0.1, 0.5]},
+    )
+]
+LINREG_MODELS = [
+    (
+        LinearRegression(),
+        {"reg_param": [0.001, 0.01, 0.1, 0.2], "elastic_net_param": [0.1, 0.5]},
+    )
+]
 
 
 @pytest.fixture(scope="module")
@@ -36,7 +51,7 @@ def titanic_model(request):
     checked = resp.transform_with(
         SanityChecker(remove_bad_features=True), vector
     )
-    selector = BinaryClassificationModelSelector(seed=7)
+    selector = BinaryClassificationModelSelector(seed=7, models=LR_MODELS)
     pred = selector.set_input(resp, checked).get_output()
     model = (
         Workflow()
@@ -96,7 +111,7 @@ def test_iris_multiclass_workflow():
     ds = ds.drop(["species", "id"]).with_column("label", label)
     resp, preds = from_dataset(ds, response="label")
     vector = transmogrify(preds)
-    selector = MultiClassificationModelSelector(seed=3)
+    selector = MultiClassificationModelSelector(seed=3, models=LR_MODELS)
     pred = selector.set_input(resp, vector).get_output()
     model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
     sel = model.summary_json()["modelSelectorSummary"]
@@ -116,7 +131,7 @@ def test_boston_regression_workflow():
     ds = ds.drop(["rowId"])
     resp, preds = from_dataset(ds, response="medv")
     vector = transmogrify(preds)
-    selector = RegressionModelSelector(seed=11)
+    selector = RegressionModelSelector(seed=11, models=LINREG_MODELS)
     pred = selector.set_input(resp, vector).get_output()
     model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
     sel = model.summary_json()["modelSelectorSummary"]
@@ -162,3 +177,44 @@ def test_empty_training_data_rejected(titanic_model):
     tiny = ds.take(np.array([], dtype=int))
     with pytest.raises(ValueError, match="empty"):
         Workflow().set_result_features(pred).set_input_dataset(tiny).train()
+
+
+def test_default_selector_candidate_families():
+    # reference modelTypesToUse parity (BinaryClassificationModelSelector.scala:61-63,
+    # MultiClassificationModelSelector.scala:61-63, RegressionModelSelector.scala:61-63)
+    b = BinaryClassificationModelSelector()
+    assert [type(e).__name__ for e, _ in b.models] == [
+        "LogisticRegression", "RandomForestClassifier", "XGBoostClassifier",
+    ]
+    m = MultiClassificationModelSelector()
+    assert [type(e).__name__ for e, _ in m.models] == [
+        "LogisticRegression", "RandomForestClassifier",
+    ]
+    r = RegressionModelSelector()
+    assert [type(e).__name__ for e, _ in r.models] == [
+        "LinearRegression", "RandomForestRegressor", "GBTRegressor",
+    ]
+
+
+def test_selector_with_tree_candidates_small(titanic_model):
+    # a mixed LR + small-tree sweep end-to-end through the workflow
+    from transmogrifai_tpu.models import RandomForestClassifier, XGBoostClassifier
+
+    ds, *_ = titanic_model
+    resp, preds = from_dataset(ds, response="Survived")
+    vector = transmogrify([p for p in preds if p.name != "PassengerId"])
+    models = [
+        (LogisticRegression(), {"reg_param": [0.01]}),
+        (RandomForestClassifier(num_trees=10), {"max_depth": [3, 5]}),
+        (XGBoostClassifier(num_round=15), {"max_depth": [3]}),
+    ]
+    sel = BinaryClassificationModelSelector(models=models, seed=2)
+    pred = sel.set_input(resp, vector).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    s = model.summary_json()["modelSelectorSummary"]
+    assert len(s["validationResults"]) == 4
+    families = {r["modelName"] for r in s["validationResults"]}
+    assert families == {
+        "LogisticRegression", "RandomForestClassifier", "XGBoostClassifier",
+    }
+    assert s["holdoutEvaluation"]["AuROC"] > 0.6

@@ -43,6 +43,15 @@ class Feature:
         stage.set_input(self, *others)
         return stage.get_output()
 
+    def _live_parents(self) -> tuple["Feature", ...]:
+        """Current upstream features. Traversals follow the origin stage's
+        LIVE wiring (not the frozen ``parents`` tuple) so DAG rewrites — e.g.
+        the RawFeatureFilter blocklist — propagate to lineage queries."""
+        stage = self.origin_stage
+        if stage is not None and not isinstance(stage, FeatureGeneratorStage):
+            return tuple(stage.input_features)
+        return self.parents
+
     def parent_stages(self) -> dict[PipelineStage, int]:
         """All ancestor stages mapped to their distance from this feature
         (FeatureLike.parentStages, FeatureLike.scala:363). Distance is the
@@ -56,7 +65,7 @@ class Feature:
             if dists.get(stage, -1) >= depth:
                 return  # already visited at this depth or deeper
             dists[stage] = depth
-            for p in feature.parents:
+            for p in feature._live_parents():
                 visit(p, depth + 1)
 
         visit(self, 0)
@@ -76,7 +85,7 @@ class Feature:
                         f"Two distinct raw features named '{f.name}' in one DAG"
                     )
                 seen[f.name] = f
-            for p in f.parents:
+            for p in f._live_parents():
                 visit(p)
 
         visit(self)

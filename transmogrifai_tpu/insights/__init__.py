@@ -1,0 +1,3 @@
+"""Model interpretability (reference: ModelInsights, RecordInsightsLOCO)."""
+from .model_insights import model_insights  # noqa: F401
+from .loco import RecordInsightsLOCO  # noqa: F401

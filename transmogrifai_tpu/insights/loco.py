@@ -1,0 +1,109 @@
+"""RecordInsightsLOCO — per-row leave-one-covariate-out explanations.
+
+Reference: core/.../stages/impl/insights/RecordInsightsLOCO.scala:45-347.
+For each derived vector column (text-hash and date columns aggregated per
+parent feature, strategy LeaveOutVector), zero it out, re-score, and report
+the top-K score differences as a map column.
+
+TPU improvement over the reference (SURVEY.md §7 step 7): the reference
+loops per row re-scoring one modified vector at a time; here the whole
+(rows × groups) sweep is BATCHED — one model call per column group over all
+rows at once.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..models.base import PredictorModel
+from ..stages.base import Transformer
+from ..stages.metadata import VectorMetadata
+from ..types import OPVector, TextMap
+from ..types.columns import Column, MapColumn, VectorColumn
+
+ABS = "abs"
+POSITIVE_NEGATIVE = "positive_negative"
+
+
+def _column_groups(meta: VectorMetadata | None, dim: int) -> list[tuple[str, list[int]]]:
+    """Group hashed-text/date columns by parent feature; pivot/numeric
+    columns stay individual (RecordInsightsLOCO text aggregation)."""
+    if meta is None or meta.size != dim:
+        return [(f"col_{j}", [j]) for j in range(dim)]
+    groups: dict[str, list[int]] = {}
+    order: list[str] = []
+    for j, cm in enumerate(meta.columns):
+        if cm.descriptor_value is not None and cm.descriptor_value.startswith("hash_"):
+            key = f"{'_'.join(cm.parent_names)}(text)"
+        elif cm.descriptor_value is not None:
+            key = "_".join(cm.parent_names)  # date components aggregate
+        else:
+            key = cm.make_name()
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(j)
+    return [(k, groups[k]) for k in order]
+
+
+class RecordInsightsLOCO(Transformer):
+    """Transformer[OPVector] -> TextMap of top-K column contributions."""
+
+    input_types = (OPVector,)
+    output_type = TextMap
+
+    def __init__(
+        self,
+        model: PredictorModel,
+        top_k: int = 20,
+        strategy: str = ABS,
+        uid: str | None = None,
+    ):
+        super().__init__("recordInsightsLOCO", uid=uid)
+        self.model = model
+        self.top_k = top_k
+        self.strategy = strategy
+
+    def get_params(self):
+        return {"top_k": self.top_k, "strategy": self.strategy}
+
+    def _score(self, x: np.ndarray, base_class: np.ndarray | None = None):
+        """Per-row score tracked against the BASE prediction's class
+        (RecordInsightsLOCO tracks the original class's probability, so
+        perturbed scores of different classes are never compared)."""
+        pred, prob, raw = self.model.predict_arrays(x)
+        if prob is None:
+            return pred, None
+        if base_class is None:
+            base_class = prob.argmax(axis=1)
+        rows = np.arange(len(prob))
+        return prob[rows, base_class], base_class
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        vec = cols[-1]
+        assert isinstance(vec, VectorColumn)
+        x = np.asarray(vec.values, dtype=np.float32)
+        base, base_class = self._score(x)
+        groups = _column_groups(vec.metadata, x.shape[1])
+
+        diffs = np.zeros((num_rows, len(groups)), dtype=np.float64)
+        for gi, (_, idxs) in enumerate(groups):
+            x2 = x.copy()
+            x2[:, idxs] = 0.0
+            diffs[:, gi] = base - self._score(x2, base_class)[0]
+
+        names = [name for name, _ in groups]
+        values: list[dict] = []
+        k = min(self.top_k, len(groups))
+        for i in range(num_rows):
+            row = diffs[i]
+            order = (
+                np.argsort(-np.abs(row))
+                if self.strategy == ABS
+                else np.argsort(-row)
+            )
+            values.append(
+                {names[j]: float(row[j]) for j in order[:k]}
+            )
+        return MapColumn(TextMap, values)

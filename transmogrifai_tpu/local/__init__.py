@@ -1,0 +1,2 @@
+"""Local (cluster-free) scoring."""
+from .scoring import score_function  # noqa: F401

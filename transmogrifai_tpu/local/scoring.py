@@ -1,0 +1,54 @@
+"""Per-row local scoring — `Map[String, Any] => Map[String, Any]`.
+
+Reference: local/.../OpWorkflowModelLocal.scala:43-126 — the fitted workflow
+exports a plain closure that scores one record dict at a time without any
+cluster runtime (there via MLeap; here the fitted DAG is already a pure
+function, so local scoring is just the columnar transform on length-1
+columns — no separate serving runtime needed, SURVEY.md §2.5 item 4).
+
+For throughput, ``score_function(..., batch=True)`` accepts a list of dicts
+and scores them as one columnar batch.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..dataset import Dataset
+from ..types.columns import column_from_values
+from ..workflow.workflow import WorkflowModel
+
+
+def _rows_to_dataset(model: WorkflowModel, rows: list[dict[str, Any]]) -> Dataset:
+    cols = {}
+    for f in model.raw_features:
+        vals = [r.get(f.name) for r in rows]
+        if f.is_response and all(v is None for v in vals):
+            vals = [0] * len(rows)  # score-time null labels
+        cols[f.name] = column_from_values(f.ftype, vals)
+    return Dataset.of(cols)
+
+
+def score_function(
+    model: WorkflowModel,
+) -> Callable[[dict[str, Any]], dict[str, Any]]:
+    """Returns ``row_dict -> result_dict`` (model.scoreFunction,
+    OpWorkflowModelLocal.scala:79). Result keys are the result-feature names;
+    Prediction features expand to their reference map keys
+    (prediction/probability_*/rawPrediction_*)."""
+
+    def score_one(row: dict[str, Any]) -> dict[str, Any]:
+        return score_batch([row])[0]
+
+    def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        ds = _rows_to_dataset(model, rows)
+        scored = model.score(dataset=ds)
+        out: list[dict[str, Any]] = [{} for _ in rows]
+        for name in scored:
+            # to_list already renders Prediction columns as reference-keyed
+            # maps (prediction/probability_*/rawPrediction_*)
+            for i, v in enumerate(scored[name].to_list()):
+                out[i][name] = v
+        return out
+
+    score_one.batch = score_batch  # type: ignore[attr-defined]
+    return score_one

@@ -2,3 +2,14 @@
 from .base import PredictorEstimator, PredictorModel  # noqa: F401
 from .logistic import LogisticRegression  # noqa: F401
 from .linear import LinearRegression  # noqa: F401
+from .mlp import MLPClassifier  # noqa: F401
+from .gbdt import (  # noqa: F401
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GBTClassifier,
+    GBTRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    XGBoostClassifier,
+    XGBoostRegressor,
+)

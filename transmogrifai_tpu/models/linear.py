@@ -20,6 +20,10 @@ class LinearRegressionModel(PredictorModel):
     def get_arrays(self):
         return {"weights": self.weights, "intercept": np.float64(self.intercept)}
 
+    @classmethod
+    def from_params(cls, params, arrays):
+        return cls(arrays["weights"], float(arrays["intercept"]))
+
     def predict_arrays(self, x: np.ndarray):
         pred = x @ self.weights + self.intercept
         return pred, None, None

@@ -34,6 +34,10 @@ class LogisticRegressionModel(PredictorModel):
     def get_params(self):
         return {"num_classes": self.num_classes}
 
+    @classmethod
+    def from_params(cls, params, arrays):
+        return cls(arrays["weights"], arrays["intercept"], params["num_classes"])
+
     def predict_arrays(self, x: np.ndarray):
         if self.num_classes == 2:
             margin = x @ self.weights + self.intercept

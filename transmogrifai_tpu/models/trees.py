@@ -1,0 +1,322 @@
+"""Histogram-based decision-tree machinery — the XLA-native replacement for
+libxgboost (JNI/C++) and Spark MLlib's JVM tree ensembles (SURVEY.md §2.5
+item 1, the largest native-parity item).
+
+Design (TPU-first, static shapes throughout — SURVEY.md §7 hard-part 1):
+  * features are quantile-binned once into int32 codes [N, F] (host-side
+    thresholds, in-graph binning);
+  * a tree grows LEVEL-WISE to a fixed ``max_depth``: level d has exactly
+    2^d node slots; nodes that stop splitting carry split_feat = -1 and
+    route every row left, so shapes never depend on data;
+  * per-level histograms hist[node, feature, bin] of (grad, hess) are ONE
+    scatter-add over flattened keys — the XLA analog of XGBoost's C++
+    histogram build, and the reduction is a psum when rows are sharded
+    over the mesh 'data' axis;
+  * split gain is the XGBoost second-order formula
+    0.5*(GL²/(HL+λ) + GR²/(HR+λ) − G²/(H+λ)) − γ, with
+    min_child_weight / min_info_gain masks; with h ≡ 1 and λ=0 this is
+    exactly CART variance reduction, so the same learner serves
+    RandomForest/GBT (Spark semantics) and XGBoost;
+  * whole forests train under ``vmap`` over bootstrap/feature masks; boosting
+    runs as ``lax.scan`` over rounds.
+
+Leaf values are -G/(H+λ) (Newton step). For plain mean-target trees (random
+forest leaves) pass g = -target, h = 1: the leaf value becomes mean(target).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Tree(NamedTuple):
+    """Dense perfect-binary-tree arrays. Level d uses slots [0, 2^d)."""
+
+    split_feat: jax.Array  # [depth, 2^depth] int32, -1 = leaf (route left)
+    split_bin: jax.Array   # [depth, 2^depth] int32, go right when bin > split_bin
+    leaf_value: jax.Array  # [2^depth] float32
+
+
+def quantile_thresholds(x: np.ndarray, max_bins: int = 32) -> np.ndarray:
+    """Per-feature quantile bin edges [F, max_bins-1] (XGBoost 'hist' sketch
+    equivalent; computed host-side once per dataset)."""
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    thr = np.nanquantile(np.asarray(x, dtype=np.float64), qs, axis=0).T
+    # make strictly non-decreasing; duplicate edges simply yield empty bins
+    return np.ascontiguousarray(thr, dtype=np.float32)
+
+
+def bin_data(x: jax.Array, thresholds: jax.Array) -> jax.Array:
+    """int32 bin codes [N, F]: number of thresholds strictly below x."""
+    return (x[:, :, None] > thresholds[None, :, :]).sum(axis=2).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "num_bins"),
+)
+def grow_tree(
+    binned: jax.Array,     # [N, F] int32 codes in [0, num_bins)
+    grad: jax.Array,       # [N] float32
+    hess: jax.Array,       # [N] float32
+    row_mask: jax.Array,   # [N] float32
+    feat_mask: jax.Array,  # [F] float32 (0 disables a feature — RF colsample)
+    max_depth: int,
+    num_bins: int,
+    reg_lambda: float | jax.Array = 1.0,
+    gamma: float | jax.Array = 0.0,
+    min_child_weight: float | jax.Array = 1.0,
+    min_info_gain: float | jax.Array = 0.0,
+) -> Tree:
+    n, f = binned.shape
+    b = num_bins
+    max_nodes = 1 << max_depth
+    g = grad * row_mask
+    h = hess * row_mask
+    col_ids = jnp.arange(f, dtype=jnp.int32)[None, :]
+    gh = jnp.stack([g, h], axis=1)  # [N, 2]
+
+    # ---- node chunking: bound per-level histogram memory (the Spark
+    # maxMemoryInMB node-group equivalent). Deep trees on wide matrices would
+    # otherwise allocate [2^depth, F, B] gain tensors (GBs); instead each
+    # level processes `chunk_nodes` node slots at a time with static shapes,
+    # and chunks beyond the level's live node range are skipped via lax.cond.
+    budget_elems = 1 << 22  # ~4M f32 per histogram tensor (~16 MB)
+    chunk_nodes = max(1, budget_elems // max(f * b, 1))
+    while chunk_nodes & (chunk_nodes - 1):  # round down to a power of two
+        chunk_nodes &= chunk_nodes - 1
+    chunk_nodes = min(chunk_nodes, max_nodes)
+    num_chunks = max_nodes // chunk_nodes
+
+    def chunk_stats(node, c0):
+        """Best (gain, feat, bin) for node slots [c0, c0 + chunk_nodes)."""
+        active = (node >= c0) & (node < c0 + chunk_nodes)
+        w = active.astype(jnp.float32)
+        local = jnp.where(active, node - c0, 0)
+        flat = ((local[:, None] * f + col_ids) * b + binned).reshape(-1)
+        vals = jnp.repeat((gh * w[:, None])[:, None, :], f, axis=1).reshape(-1, 2)
+        hist = jnp.zeros((chunk_nodes * f * b, 2), dtype=jnp.float32)
+        hist = hist.at[flat].add(vals).reshape(chunk_nodes, f, b, 2)
+        hg, hh = hist[..., 0], hist[..., 1]
+
+        gl = jnp.cumsum(hg, axis=2)[:, :, :-1]  # left = bins <= t
+        hl = jnp.cumsum(hh, axis=2)[:, :, :-1]
+        gt = hg.sum(axis=2, keepdims=True)
+        ht = hh.sum(axis=2, keepdims=True)
+        gr = gt - gl
+        hr = ht - hl
+        parent = (gt**2) / (ht + reg_lambda)
+        gain = 0.5 * (
+            gl**2 / (hl + reg_lambda) + gr**2 / (hr + reg_lambda) - parent
+        ) - gamma
+        valid = (
+            (hl >= min_child_weight)
+            & (hr >= min_child_weight)
+            & (feat_mask[None, :, None] > 0)
+        )
+        gain = jnp.where(valid, gain, -jnp.inf)
+
+        flat_gain = gain.reshape(chunk_nodes, -1)
+        best = jnp.argmax(flat_gain, axis=1)
+        best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=1)[:, 0]
+        best_feat = (best // (b - 1)).astype(jnp.int32)
+        best_bin = (best % (b - 1)).astype(jnp.int32)
+        do_split = best_gain > jnp.maximum(min_info_gain, 0.0)
+        return (
+            jnp.where(do_split, best_feat, -1),
+            jnp.where(do_split, best_bin, 0),
+        )
+
+    def level(d, carry):
+        # one compiled level body reused for every depth (lax.fori_loop)
+        node, feats, bins = carry
+        n_nodes = jnp.left_shift(jnp.int32(1), d)
+
+        def chunk_body(ci, fb):
+            feats_d, bins_d = fb
+            c0 = ci * chunk_nodes
+
+            def run(_):
+                cf, cb = chunk_stats(node, c0)
+                return (
+                    jax.lax.dynamic_update_slice(feats_d, cf, (c0,)),
+                    jax.lax.dynamic_update_slice(bins_d, cb, (c0,)),
+                )
+
+            return jax.lax.cond(c0 < n_nodes, run, lambda _: (feats_d, bins_d), None)
+
+        feats_d0 = jnp.full(max_nodes, -1, dtype=jnp.int32)
+        bins_d0 = jnp.zeros(max_nodes, dtype=jnp.int32)
+        feats_d, bins_d = jax.lax.fori_loop(
+            0, num_chunks, chunk_body, (feats_d0, bins_d0)
+        )
+        feats = feats.at[d].set(feats_d)
+        bins = bins.at[d].set(bins_d)
+
+        # ---- route rows to children
+        row_feat = feats_d[node]             # [N]
+        row_thr = bins_d[node]
+        code = jnp.take_along_axis(
+            binned, jnp.maximum(row_feat, 0)[:, None], axis=1
+        )[:, 0]
+        go_right = (row_feat >= 0) & (code > row_thr)
+        node = node * 2 + go_right.astype(jnp.int32)
+        return node, feats, bins
+
+    node0 = jnp.zeros(n, dtype=jnp.int32)
+    feats0 = jnp.full((max_depth, max_nodes), -1, dtype=jnp.int32)
+    bins0 = jnp.zeros((max_depth, max_nodes), dtype=jnp.int32)
+    node, feats, bins = jax.lax.fori_loop(
+        0, max_depth, level, (node0, feats0, bins0)
+    )
+
+    # ---- leaf values: Newton step -G/(H+λ) per final node
+    leaf_g = jnp.zeros(max_nodes, dtype=jnp.float32).at[node].add(g)
+    leaf_h = jnp.zeros(max_nodes, dtype=jnp.float32).at[node].add(h)
+    leaf_value = -leaf_g / (leaf_h + reg_lambda)
+    return Tree(split_feat=feats, split_bin=bins, leaf_value=leaf_value)
+
+
+def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
+    """Leaf value per row — a static unrolled depth loop of gathers."""
+    n = binned.shape[0]
+    node = jnp.zeros(n, dtype=jnp.int32)
+    depth = tree.split_feat.shape[0]
+    for d in range(depth):
+        feat = tree.split_feat[d][node]
+        thr = tree.split_bin[d][node]
+        code = jnp.take_along_axis(
+            binned, jnp.maximum(feat, 0)[:, None], axis=1
+        )[:, 0]
+        go_right = (feat >= 0) & (code > thr)
+        node = node * 2 + go_right.astype(jnp.int32)
+    return tree.leaf_value[node]
+
+
+# --------------------------------------------------------------------------
+# forests (bagged, vmapped) and boosting (scanned)
+# --------------------------------------------------------------------------
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "num_bins", "num_trees", "bootstrap"),
+)
+def fit_forest(
+    binned: jax.Array,
+    target: jax.Array,      # [N] regression target (or one-vs-rest indicator)
+    row_mask: jax.Array,    # [N]
+    num_trees: int,
+    max_depth: int,
+    num_bins: int,
+    subsample_rate: float | jax.Array = 1.0,
+    colsample_rate: float | jax.Array = 1.0,
+    min_instances: float | jax.Array = 1.0,
+    min_info_gain: float | jax.Array = 0.0,
+    seed: int | jax.Array = 42,
+    bootstrap: bool = True,
+) -> Tree:
+    """Random forest of mean-target trees: bootstrap row weights + feature
+    subsampling, all trees trained in one vmap (Spark RandomForest parity:
+    variance impurity == gain formula with h=1, λ=0)."""
+    n, f = binned.shape
+    key = jax.random.PRNGKey(seed)
+    tkeys = jax.random.split(key, num_trees)
+
+    def one_tree(tkey):
+        k1, k2 = jax.random.split(tkey)
+        if bootstrap:
+            # bootstrap: Poisson(rate) counts ≈ sampling with replacement
+            counts = jax.random.poisson(k1, subsample_rate, (n,)).astype(jnp.float32)
+        else:
+            counts = jnp.ones(n, dtype=jnp.float32)
+        rmask = row_mask * counts
+        fmask = (
+            jax.random.uniform(k2, (f,)) < colsample_rate
+        ).astype(jnp.float32)
+        # ensure at least one feature stays on
+        fmask = jnp.where(fmask.sum() == 0, jnp.ones(f), fmask)
+        return grow_tree(
+            binned,
+            -target,  # g = -target, h = 1 -> leaf = mean(target)
+            jnp.ones(n, dtype=jnp.float32),
+            rmask,
+            fmask,
+            max_depth=max_depth,
+            num_bins=num_bins,
+            reg_lambda=0.0,
+            gamma=0.0,
+            min_child_weight=min_instances,
+            min_info_gain=min_info_gain,
+        )
+
+    # sequential lax.map keeps peak memory at ONE tree's histograms (a deep
+    # forest vmap would multiply the [max_nodes, F, B] buffers by num_trees);
+    # each tree's histogram build already saturates the chip.
+    return jax.lax.map(one_tree, tkeys)  # stacked Tree arrays [T, ...]
+
+
+def predict_forest(binned: jax.Array, trees: Tree) -> jax.Array:
+    """Mean leaf value across the stacked forest -> [N]."""
+    preds = jax.vmap(lambda t: predict_tree(binned, t))(trees)  # [T, N]
+    return preds.mean(axis=0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "num_bins", "num_rounds", "objective"),
+)
+def fit_boosted(
+    binned: jax.Array,
+    y: jax.Array,          # [N] labels (0/1 binary, float regression)
+    row_mask: jax.Array,
+    num_rounds: int,
+    max_depth: int,
+    num_bins: int,
+    eta: float | jax.Array = 0.3,
+    reg_lambda: float | jax.Array = 1.0,
+    gamma: float | jax.Array = 0.0,
+    min_child_weight: float | jax.Array = 1.0,
+    min_info_gain: float | jax.Array = 0.0,
+    base_score: float | jax.Array = 0.0,
+    objective: str = "binary:logistic",
+) -> tuple[Tree, jax.Array]:
+    """Gradient boosting (XGBoost/Spark-GBT parity): lax.scan over rounds,
+    second-order gradients, shrinkage eta. Returns stacked trees [R, ...]
+    and the training margin."""
+    n, f = binned.shape
+    feat_mask = jnp.ones(f, dtype=jnp.float32)
+
+    def grads(margin):
+        if objective == "binary:logistic":
+            p = jax.nn.sigmoid(margin)
+            return p - y, p * (1.0 - p)
+        # reg:squarederror
+        return margin - y, jnp.ones_like(margin)
+
+    def round_step(margin, _):
+        g, h = grads(margin)
+        tree = grow_tree(
+            binned, g, h, row_mask, feat_mask,
+            max_depth=max_depth, num_bins=num_bins,
+            reg_lambda=reg_lambda, gamma=gamma,
+            min_child_weight=min_child_weight, min_info_gain=min_info_gain,
+        )
+        margin = margin + eta * predict_tree(binned, tree)
+        return margin, tree
+
+    margin0 = jnp.full(n, base_score, dtype=jnp.float32)
+    margin, trees = jax.lax.scan(round_step, margin0, None, length=num_rounds)
+    return trees, margin
+
+
+def predict_boosted(
+    binned: jax.Array,
+    trees: Tree,
+    eta: float,
+    base_score: float = 0.0,
+) -> jax.Array:
+    preds = jax.vmap(lambda t: predict_tree(binned, t))(trees)  # [R, N]
+    return base_score + eta * preds.sum(axis=0)

@@ -46,6 +46,17 @@ class FeatureRemovalModel(Model):
     def get_arrays(self):
         return {"indices_to_keep": np.asarray(self.indices_to_keep, dtype=np.int64)}
 
+    @classmethod
+    def from_params(cls, params, arrays):
+        meta_json = params.get("new_metadata")
+        return cls(
+            indices_to_keep=[int(i) for i in arrays["indices_to_keep"]],
+            remove_bad_features=params["remove_bad_features"],
+            new_metadata=(
+                VectorMetadata.from_json(meta_json) if meta_json else None
+            ),
+        )
+
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
         # inputs are (label, vector); the vector is always the last input
         vec = cols[-1]

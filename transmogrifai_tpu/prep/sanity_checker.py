@@ -50,6 +50,7 @@ MIN_REQUIRED_RULE_SUPPORT = 1.0
 @dataclasses.dataclass
 class ColumnReport:
     name: str
+    parent: str | None
     mean: float
     variance: float
     corr_label: float
@@ -200,6 +201,11 @@ class SanityChecker(Estimator):
         reports = [
             ColumnReport(
                 name=names[j],
+                parent=(
+                    meta.columns[j].parent_names[0]
+                    if meta.size == d and meta.columns[j].parent_names
+                    else None
+                ),
                 mean=float(col_stats.mean[j]),
                 variance=float(col_stats.variance[j]),
                 corr_label=float(corr_label[j]),

@@ -26,6 +26,12 @@ from ..evaluators import (
     RegressionEvaluator,
 )
 from ..models.base import PredictorEstimator, PredictorModel
+from ..models.gbdt import (
+    GBTRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    XGBoostClassifier,
+)
 from ..models.linear import LinearRegression
 from ..models.logistic import LogisticRegression
 from ..prep.splitters import DataBalancer, DataCutter, DataSplitter
@@ -33,11 +39,21 @@ from .validators import CrossValidator, TrainValidationSplit, Validator
 
 log = logging.getLogger(__name__)
 
-# DefaultSelectorParams.scala:37-49
+# DefaultSelectorParams.scala:37-75
 REGULARIZATION = [0.001, 0.01, 0.1, 0.2]
 ELASTIC_NET = [0.1, 0.5]
 MAX_ITER_LIN = [50]
 FIT_INTERCEPT = [True]
+MAX_DEPTH = [3, 6, 12]
+MIN_INSTANCES = [10, 100]
+MIN_INFO_GAIN = [0.001, 0.01, 0.1]
+MAX_TREES = [50]
+MAX_ITER_TREE = [20]
+XGB_NUM_ROUND = [200]
+XGB_ETA = [0.02]
+XGB_MIN_CHILD_WEIGHT = [1.0, 10.0]
+XGB_MAX_DEPTH_BINARY = [10]
+XGB_GAMMA_BINARY = [0.8]
 
 
 def _lr_grid() -> dict[str, Sequence[Any]]:
@@ -46,6 +62,34 @@ def _lr_grid() -> dict[str, Sequence[Any]]:
         "elastic_net_param": ELASTIC_NET,
         "max_iter": MAX_ITER_LIN,
         "reg_param": REGULARIZATION,
+    }
+
+
+def _rf_grid() -> dict[str, Sequence[Any]]:
+    return {
+        "max_depth": MAX_DEPTH,
+        "min_info_gain": MIN_INFO_GAIN,
+        "min_instances_per_node": MIN_INSTANCES,
+        "num_trees": MAX_TREES,
+    }
+
+
+def _gbt_grid() -> dict[str, Sequence[Any]]:
+    return {
+        "max_depth": MAX_DEPTH,
+        "min_info_gain": MIN_INFO_GAIN,
+        "min_instances_per_node": MIN_INSTANCES,
+        "max_iter": MAX_ITER_TREE,
+    }
+
+
+def _xgb_binary_grid() -> dict[str, Sequence[Any]]:
+    return {
+        "num_round": XGB_NUM_ROUND,
+        "eta": XGB_ETA,
+        "gamma": XGB_GAMMA_BINARY,
+        "max_depth": XGB_MAX_DEPTH_BINARY,
+        "min_child_weight": XGB_MIN_CHILD_WEIGHT,
     }
 
 
@@ -70,6 +114,20 @@ class SelectedModel(PredictorModel):
             "best_model_params": self.best_model.get_params(),
             "summary": self.metadata.get("modelSelectorSummary", {}),
         }
+
+    @classmethod
+    def from_params(cls, params, arrays):
+        from ..workflow.persistence import construct_stage
+
+        inner_arrays = {
+            k[len("best__"):]: v
+            for k, v in arrays.items()
+            if k.startswith("best__")
+        }
+        inner = construct_stage(
+            params["best_model_class"], params["best_model_params"], inner_arrays
+        )
+        return cls(inner, params.get("summary", {}))
 
     @property
     def summary(self) -> dict[str, Any]:
@@ -180,9 +238,14 @@ def BinaryClassificationModelSelector(
     seed: int = 42,
 ) -> ModelSelector:
     """CV binary selector (BinaryClassificationModelSelector.scala; default
-    3-fold CV, DataBalancer, AuPR metric)."""
+    3-fold CV, DataBalancer, AuPR metric; default candidates LR + RF + XGB
+    per modelTypesToUse :61-63)."""
     if models is None:
-        models = [(LogisticRegression(), _lr_grid())]
+        models = [
+            (LogisticRegression(), _lr_grid()),
+            (RandomForestClassifier(), _rf_grid()),
+            (XGBoostClassifier(), _xgb_binary_grid()),
+        ]
     return ModelSelector(
         validator=validator or CrossValidator(num_folds=num_folds, seed=seed),
         splitter=splitter if splitter is not None else DataBalancer(seed=seed),
@@ -202,9 +265,12 @@ def MultiClassificationModelSelector(
     seed: int = 42,
 ) -> ModelSelector:
     """Multiclass selector (MultiClassificationModelSelector.scala; default
-    LR candidates, DataCutter, weighted F1)."""
+    candidates LR + RF (:61-63), DataCutter, weighted F1)."""
     if models is None:
-        models = [(LogisticRegression(), _lr_grid())]
+        models = [
+            (LogisticRegression(), _lr_grid()),
+            (RandomForestClassifier(), _rf_grid()),
+        ]
     return ModelSelector(
         validator=validator or CrossValidator(num_folds=num_folds, seed=seed),
         splitter=splitter if splitter is not None else DataCutter(seed=seed),
@@ -222,7 +288,8 @@ def RegressionModelSelector(
     seed: int = 42,
 ) -> ModelSelector:
     """Regression selector (RegressionModelSelector.scala; default
-    train/validation split .75, DataSplitter, RMSE)."""
+    train/validation split .75, DataSplitter, RMSE; default candidates
+    LinearRegression + RF + GBT per :61-63)."""
     if models is None:
         models = [
             (
@@ -233,7 +300,9 @@ def RegressionModelSelector(
                     "max_iter": MAX_ITER_LIN,
                     "reg_param": REGULARIZATION,
                 },
-            )
+            ),
+            (RandomForestRegressor(), _rf_grid()),
+            (GBTRegressor(), _gbt_grid()),
         ]
     return ModelSelector(
         validator=validator or TrainValidationSplit(seed=seed),

@@ -76,7 +76,12 @@ class PipelineStage:
     # --------------------------------------------------------------- outputs
     @property
     def output_name(self) -> str:
-        """Derived output column name (OpPipelineStages makeOutputName)."""
+        """Derived output column name (OpPipelineStages makeOutputName).
+        A fixed name (set by Estimator.fit on models, or by the loader)
+        takes precedence."""
+        fixed = getattr(self, "_fixed_output_name", None)
+        if fixed is not None:
+            return fixed
         _, suffix = uid_util.from_string(self.uid)
         base = "-".join(self.input_names) if self.input_features else "out"
         if len(base) > 80:
@@ -84,13 +89,16 @@ class PipelineStage:
         return f"{base}_{self.operation_name}_{suffix}"
 
     def get_output(self) -> Any:
-        """The output Feature, with this stage as origin."""
+        """The output Feature, with this stage as origin. The output name is
+        frozen here so later input rewiring (e.g. the RawFeatureFilter
+        blocklist rewrite) cannot silently rename the output column."""
         from ..features.feature import Feature
 
         if not self.input_features:
             raise ValueError(f"{self}: set_input must be called before get_output")
+        self._fixed_output_name = self.output_name
         return Feature(
-            name=self.output_name,
+            name=self._fixed_output_name,
             ftype=self.output_type,
             origin_stage=self,
             parents=tuple(self.input_features),
@@ -159,15 +167,6 @@ class Model(Transformer):
         """Fitted numpy/jax arrays for checkpointing (orbax-style). Subclasses
         override when they hold learned arrays."""
         return {}
-
-    @property
-    def output_name(self) -> str:  # type: ignore[override]
-        # a model fitted by an estimator takes over that estimator's output
-        # column name (set by Estimator.fit)
-        fixed = getattr(self, "_fixed_output_name", None)
-        if fixed is not None:
-            return fixed
-        return PipelineStage.output_name.fget(self)  # type: ignore[attr-defined]
 
 
 class Estimator(PipelineStage):

@@ -1,0 +1,193 @@
+"""Workflow model persistence.
+
+Reference: core/.../OpWorkflowModelWriter.scala:54-212 / OpWorkflowModelReader
+(JSON manifest + per-stage JSON + MLeap bundles) and features/.../
+OpPipelineStageReaderWriter.scala:131-196 (ctor params by reflection).
+
+TPU-native format (SURVEY.md §5.4): ONE directory with
+  * ``manifest.json`` — features (name/uid/type/response/lineage), stages in
+    topological order (class, uid, ctor params, wiring), selector info,
+    summary metadata;
+  * ``arrays.npz`` — every fitted array, keyed ``<stage_uid>__<name>``.
+No MLeap equivalent is needed: the fitted DAG is already a pure function of
+arrays + params.
+
+Stages participate via ``get_params()`` / ``get_arrays()`` and a
+``from_params(params, arrays)`` classmethod (default: ctor(**params)).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from .. import types as T
+from ..features.feature import Feature, FeatureGeneratorStage
+from ..stages.base import Model, PipelineStage, Transformer
+from ..utils import uid as uid_util
+
+#: class-name -> class registry for stage reconstruction
+_REGISTRY: dict[str, type] = {}
+_BUILTINS_POPULATED = False
+
+
+def register_stage(cls: type) -> type:
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _registry() -> dict[str, type]:
+    """Populate lazily from the known stage modules (avoids import cycles)."""
+    global _BUILTINS_POPULATED
+    if _BUILTINS_POPULATED:
+        return _REGISTRY
+    _BUILTINS_POPULATED = True
+    from ..models import gbdt, linear, logistic, mlp
+    from ..models.base import PredictorModel
+    from ..ops import categorical, combiner, dates, numeric, text
+    from ..prep import derived_filter, sanity_checker
+    from ..selector import model_selector
+
+    for module in (
+        gbdt, linear, logistic, mlp, categorical, combiner, dates, numeric,
+        text, derived_filter, sanity_checker, model_selector,
+    ):
+        for name in dir(module):
+            obj = getattr(module, name)
+            if isinstance(obj, type) and issubclass(obj, (PipelineStage,)):
+                _REGISTRY.setdefault(name, obj)
+    return _REGISTRY
+
+
+def construct_stage(
+    class_name: str, params: dict[str, Any], arrays: dict[str, np.ndarray]
+) -> PipelineStage:
+    cls = _registry().get(class_name)
+    if cls is None:
+        raise ValueError(f"Unknown stage class '{class_name}' at load time")
+    from_params = getattr(cls, "from_params", None)
+    if from_params is not None:
+        return from_params(params, arrays)
+    return cls(**params)
+
+
+def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F821
+    from .workflow import WorkflowModel  # noqa: F401
+
+    os.makedirs(path, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    stages_json: list[dict[str, Any]] = []
+    for est_uid, stage in model.fitted.items():
+        entry = {
+            "estimatorUid": est_uid,
+            "class": type(stage).__name__,
+            "uid": stage.uid,
+            "operationName": stage.operation_name,
+            "params": stage.get_params(),
+            "inputFeatures": [f.name for f in stage.input_features],
+            "outputName": stage.output_name,
+            "metadata": stage.metadata,
+        }
+        if isinstance(stage, Model):
+            for k, v in stage.get_arrays().items():
+                arrays[f"{stage.uid}__{k}"] = np.asarray(v)
+        stages_json.append(entry)
+
+    manifest = {
+        "version": 1,
+        "rawFeatures": [
+            {
+                "name": f.name,
+                "type": f.ftype.__name__,
+                "isResponse": f.is_response,
+                "uid": f.uid,
+            }
+            for f in model.raw_features
+        ],
+        "resultFeatures": [f.name for f in model.result_features],
+        # stage application order = DAG order, which fitted-dict insertion
+        # order already reflects (fit_and_transform_dag walks layers)
+        "stages": stages_json,
+        "selectorInfo": model.selector_info,
+        "trainRows": model.train_rows,
+        "holdoutRows": model.holdout_rows,
+        "rffResults": model.rff_results,
+        "blocklisted": model.blocklisted,
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=2, default=_json_default)
+    np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
+
+
+def _json_default(o: Any):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
+    from .workflow import WorkflowModel
+
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    npz = np.load(os.path.join(path, "arrays.npz"), allow_pickle=False)
+
+    raw_features = []
+    feature_by_name: dict[str, Feature] = {}
+    for rf in manifest["rawFeatures"]:
+        ftype = T.feature_type_by_name(rf["type"])
+        stage = FeatureGeneratorStage(
+            rf["name"], ftype, is_response=rf["isResponse"]
+        )
+        feat = stage.get_output()
+        feat.uid = rf["uid"]
+        raw_features.append(feat)
+        feature_by_name[feat.name] = feat
+
+    fitted: dict[str, PipelineStage] = {}
+    for entry in manifest["stages"]:
+        prefix = f"{entry['uid']}__"
+        stage_arrays = {
+            k[len(prefix):]: npz[k] for k in npz.files if k.startswith(prefix)
+        }
+        stage = construct_stage(entry["class"], entry["params"], stage_arrays)
+        stage.uid = entry["uid"]
+        stage.operation_name = entry["operationName"]
+        stage.metadata = entry.get("metadata", {})
+        inputs = []
+        for name in entry["inputFeatures"]:
+            if name not in feature_by_name:
+                raise ValueError(f"Stage {entry['uid']} references unknown feature {name}")
+            inputs.append(feature_by_name[name])
+        stage.input_features = tuple(inputs)
+        stage._fixed_output_name = entry["outputName"]  # type: ignore[attr-defined]
+        out_feat = stage.get_output()
+        out_feat = type(out_feat)(
+            name=entry["outputName"],
+            ftype=out_feat.ftype,
+            origin_stage=stage,
+            parents=tuple(inputs),
+            is_response=out_feat.is_response,
+        )
+        feature_by_name[entry["outputName"]] = out_feat
+        fitted[entry["estimatorUid"]] = stage
+
+    result_features = tuple(
+        feature_by_name[name] for name in manifest["resultFeatures"]
+    )
+    return WorkflowModel(
+        result_features=result_features,
+        raw_features=tuple(raw_features),
+        fitted=fitted,
+        selector_info=manifest.get("selectorInfo"),
+        train_rows=manifest.get("trainRows", 0),
+        holdout_rows=manifest.get("holdoutRows", 0),
+        rff_results=manifest.get("rffResults"),
+        blocklisted=manifest.get("blocklisted", []),
+    )

@@ -34,6 +34,9 @@ class Workflow:
         self.result_features: tuple[Feature, ...] = ()
         self.reader: DataReader | None = None
         self._stage_overrides: dict[str, dict[str, Any]] = {}
+        self._raw_feature_filter = None
+        self._rff_score_reader: DataReader | None = None
+        self.blocklisted_features: list[str] = []
 
     # ----------------------------------------------------------- configure
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -54,6 +57,53 @@ class Workflow:
         OpWorkflow.scala:179-201)."""
         self._stage_overrides.update(overrides)
         return self
+
+    def with_raw_feature_filter(
+        self,
+        score_dataset: Dataset | None = None,
+        score_reader: DataReader | None = None,
+        **params: Any,
+    ) -> "Workflow":
+        """Attach a RawFeatureFilter (OpWorkflow.withRawFeatureFilter):
+        before fitting, raw features failing fill/drift/leakage rules are
+        blocklisted and the DAG is rewritten without them."""
+        from ..prep.raw_feature_filter import RawFeatureFilter
+
+        self._raw_feature_filter = RawFeatureFilter(**params)
+        if score_dataset is not None:
+            score_reader = DatasetReader(score_dataset)
+        self._rff_score_reader = score_reader
+        return self
+
+    def _apply_blocklist(self, blocklist: list[str]) -> None:
+        """DAG rewrite minus blocklisted raw features (OpWorkflow.setBlocklist,
+        OpWorkflow.scala:118-167): stages lose blocklisted inputs; stages with
+        no inputs left are dropped and their outputs blocklisted in turn."""
+        if not blocklist:
+            return
+        dead = set(blocklist)
+        layers = compute_dag(self.result_features)
+        for layer in layers:
+            for stage in layer:
+                kept = tuple(
+                    f for f in stage.input_features if f.name not in dead
+                )
+                if len(kept) == len(stage.input_features):
+                    continue
+                if not kept or stage.input_types is not None:
+                    # variable-arity (sequence) stages shrink; fixed-arity
+                    # stages cannot lose a positional input — they die and
+                    # their output is blocklisted in turn
+                    dead.add(stage.output_name)
+                else:
+                    stage.input_features = kept
+        for rf in self.result_features:
+            if rf.name in dead:
+                raise ValueError(
+                    f"RawFeatureFilter removed everything feeding result "
+                    f"feature '{rf.name}'"
+                )
+        self.blocklisted_features = sorted(dead)
 
     # --------------------------------------------------------------- train
     def _stages(self) -> list[PipelineStage]:
@@ -88,6 +138,30 @@ class Workflow:
             raise ValueError("Input dataset cannot be empty")
         log.info("Generated raw data: %d rows, %d features", raw.num_rows, len(raw_features))
 
+        rff_results = None
+        if self._raw_feature_filter is not None:
+            label_names = [f.name for f in raw_features if f.is_response]
+            score_data = (
+                self._rff_score_reader.generate_dataset(
+                    [f for f in raw_features if not f.is_response]
+                )
+                if self._rff_score_reader is not None
+                else None
+            )
+            blocklist = self._raw_feature_filter.compute_exclusions(
+                raw,
+                raw_features,
+                score=score_data,
+                label_name=label_names[0] if label_names else None,
+            )
+            rff_results = self._raw_feature_filter.results
+            if blocklist:
+                log.info("RawFeatureFilter blocklisted: %s", blocklist)
+                self._apply_blocklist(blocklist)
+                raw_features = raw_features_of(self.result_features)
+                raw = raw.drop(blocklist)
+                validate_stages(compute_dag(self.result_features))
+
         train_data, holdout_data = raw, None
         if selector is not None and selector.splitter is not None:
             train_idx, holdout_idx = selector.splitter.split(raw.num_rows)
@@ -97,7 +171,17 @@ class Workflow:
 
         fitted_data, fitted = fit_and_transform_dag(train_data, self.result_features)
 
-        holdout_metrics = None
+        selector_info = None
+        if selector is not None:
+            selector_info = {
+                "estimatorUid": selector.uid,
+                "labelName": selector.input_names[0],
+                "vectorName": selector.input_names[1],
+                "predName": selector.output_name,
+                "evaluator": selector.evaluator.name,
+                "problemKind": selector.problem_kind,
+            }
+
         if selector is not None and holdout_data is not None:
             sel_model = fitted[selector.uid]
             assert isinstance(sel_model, SelectedModel)
@@ -115,14 +199,21 @@ class Workflow:
             )
             log.info("Holdout metrics: %s", holdout_metrics)
 
-        return WorkflowModel(
+        model = WorkflowModel(
             result_features=self.result_features,
             raw_features=tuple(raw_features),
             fitted=fitted,
-            selector=selector,
+            selector_info=selector_info,
             train_rows=train_data.num_rows,
             holdout_rows=0 if holdout_data is None else holdout_data.num_rows,
+            rff_results=None if rff_results is None else rff_results.to_json(),
+            blocklisted=list(self.blocklisted_features),
         )
+        if selector is not None:
+            # keep the live evaluator object so custom evaluators keep working
+            # on the in-memory model (the name in selector_info covers load)
+            model._live_evaluator = selector.evaluator
+        return model
 
 
 class WorkflowModel:
@@ -131,16 +222,34 @@ class WorkflowModel:
         result_features: tuple[Feature, ...],
         raw_features: tuple[Feature, ...],
         fitted: dict[str, PipelineStage],
-        selector: ModelSelector | None,
+        selector_info: dict[str, Any] | None,
         train_rows: int = 0,
         holdout_rows: int = 0,
+        rff_results: dict[str, Any] | None = None,
+        blocklisted: list[str] | None = None,
     ):
         self.result_features = result_features
         self.raw_features = raw_features
         self.fitted = fitted
-        self.selector = selector
+        self.selector_info = selector_info
         self.train_rows = train_rows
         self.holdout_rows = holdout_rows
+        self.rff_results = rff_results
+        self.blocklisted = blocklisted or []
+
+    # --------------------------------------------------------- persistence
+    def save(self, path: str) -> None:
+        """OpWorkflowModelWriter equivalent: manifest.json + arrays.npz."""
+        from .persistence import save_workflow_model
+
+        save_workflow_model(self, path)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        """Standalone load (OpWorkflowModel.load, OpWorkflowModel.scala:456)."""
+        from .persistence import load_workflow_model
+
+        return load_workflow_model(path)
 
     # --------------------------------------------------------------- score
     def _prepare_raw(self, dataset: Dataset | None, reader: DataReader | None) -> Dataset:
@@ -194,20 +303,43 @@ class WorkflowModel:
         return self._evaluate_transformed(transformed, evaluator)
 
     def _evaluate_transformed(self, transformed: Dataset, evaluator=None) -> dict[str, Any]:
-        if self.selector is None:
+        if self.selector_info is None:
             raise ValueError("evaluate requires a ModelSelector in the workflow")
-        evaluator = evaluator or self.selector.evaluator
-        label_name = self.selector.input_names[0]
-        pred_name = self.selector.output_name
-        label = transformed[label_name]
-        pred = transformed[pred_name]
+        if evaluator is None:
+            evaluator = getattr(self, "_live_evaluator", None)
+        if evaluator is None:
+            from ..evaluators import (
+                BinaryClassificationEvaluator,
+                ForecastEvaluator,
+                MultiClassificationEvaluator,
+                RegressionEvaluator,
+            )
+
+            by_name = {
+                e.name: e
+                for e in (
+                    BinaryClassificationEvaluator(),
+                    MultiClassificationEvaluator(),
+                    RegressionEvaluator(),
+                    ForecastEvaluator(),
+                )
+            }
+            name = self.selector_info["evaluator"]
+            if name not in by_name:
+                raise ValueError(
+                    f"Evaluator '{name}' is not a builtin; pass the evaluator "
+                    "object explicitly to evaluate()/score_and_evaluate()"
+                )
+            evaluator = by_name[name]
+        label = transformed[self.selector_info["labelName"]]
+        pred = transformed[self.selector_info["predName"]]
         return evaluator.evaluate(label, pred)
 
     # ------------------------------------------------------------- summary
     def summary_json(self) -> dict[str, Any]:
         sel_summary = None
-        if self.selector is not None:
-            model = self.fitted.get(self.selector.uid)
+        if self.selector_info is not None:
+            model = self.fitted.get(self.selector_info["estimatorUid"])
             if isinstance(model, SelectedModel):
                 sel_summary = model.summary
         stage_meta = {
@@ -220,6 +352,8 @@ class WorkflowModel:
             "holdoutRows": self.holdout_rows,
             "rawFeatures": [f.name for f in self.raw_features],
             "resultFeatures": [f.name for f in self.result_features],
+            "blocklistedFeatures": self.blocklisted,
+            "rawFeatureFilterResults": self.rff_results,
             "modelSelectorSummary": sel_summary,
             "stageMetadata": stage_meta,
         }
