@@ -1,0 +1,255 @@
+"""Map/list/phone vectorizer tests (parity: TextMapPivotVectorizerTest,
+OPMapVectorizerTest, DateListVectorizerTest, GeolocationVectorizerTest,
+PhoneNumberParserTest in core/src/test)."""
+import numpy as np
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.lists import (
+    MODE_DAY,
+    SINCE_FIRST,
+    DateListVectorizer,
+    GeolocationVectorizer,
+    TextListVectorizer,
+)
+from transmogrifai_tpu.ops.maps import (
+    DateMapVectorizer,
+    GeolocationMapVectorizer,
+    PhoneMapVectorizer,
+    RealMapVectorizer,
+    SmartTextMapVectorizer,
+    TextMapPivotVectorizer,
+)
+from transmogrifai_tpu.ops.phone import PhoneVectorizer, is_valid_phone
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.stages.metadata import NULL_STRING, OTHER_STRING
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+
+_DAY_MS = 86_400_000
+
+
+def _ds(**cols):
+    return Dataset.of({k: column_from_values(t, v) for k, (t, v) in cols.items()})
+
+
+# ------------------------------- phone --------------------------------------
+def test_phone_validation():
+    assert is_valid_phone("(555) 123-4567") is True          # 10-digit US
+    assert is_valid_phone("1-555-123-4567") is True          # with country code
+    assert is_valid_phone("+15551234567") is True            # E.164 US
+    assert is_valid_phone("+44 20 7946 0958") is True        # GB, 10-digit national
+    assert is_valid_phone("+1234") is False                  # too short for E.164
+    assert is_valid_phone("12345") is False
+    assert is_valid_phone("not a phone") is False
+    assert is_valid_phone(None) is None
+
+
+def test_phone_vectorizer_block():
+    f = FeatureBuilder.Phone("p").as_predictor()
+    stage = PhoneVectorizer().set_input(f)
+    ds = _ds(p=(T.Phone, ["5551234567", "123", None]))
+    out = stage.transform(ds)[stage.output_name]
+    np.testing.assert_allclose(
+        np.asarray(out.values), [[1, 0], [0, 0], [0, 1]]
+    )
+    assert out.metadata.columns[1].indicator_value == NULL_STRING
+
+
+# ------------------------------- lists ---------------------------------------
+def test_text_list_hashing_tf():
+    f = FeatureBuilder.TextList("toks").as_predictor()
+    stage = TextListVectorizer(num_terms=8, track_nulls=True).set_input(f)
+    ds = _ds(toks=(T.TextList, [["a", "b", "a"], [], ["c"]]))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    vals = np.asarray(out.values)
+    assert vals.shape == (3, 9)
+    assert vals[0].sum() == 3.0      # tf counts: a,b,a
+    assert vals[1, 8] == 1.0         # empty list -> null indicator
+    assert vals[2, :8].sum() == 1.0
+
+
+def test_date_list_since_first_and_mode_day():
+    f = FeatureBuilder.DateList("dates").as_predictor()
+    ref = 10 * _DAY_MS
+    stage = DateListVectorizer(
+        pivot=SINCE_FIRST, reference_date_ms=ref
+    ).set_input(f)
+    ds = _ds(dates=(T.DateList, [[2 * _DAY_MS, 5 * _DAY_MS], []]))
+    out = stage.transform(ds)[stage.output_name]
+    vals = np.asarray(out.values)
+    assert vals[0, 0] == 8.0  # since earliest (day 2) to day 10
+    assert vals[1, 1] == 1.0  # null indicator
+
+    f2 = FeatureBuilder.DateList("d2").as_predictor()
+    stage2 = DateListVectorizer(pivot=MODE_DAY).set_input(f2)
+    # epoch day 0 = Thursday 1970-01-01; weekday() Thursday = 3
+    ds2 = _ds(d2=(T.DateList, [[0, 0, _DAY_MS]]))
+    out2 = stage2.transform(ds2)[stage2.output_name]
+    vals2 = np.asarray(out2.values)
+    assert vals2.shape == (1, 8)  # 7 days + null
+    assert vals2[0, 3] == 1.0     # Thursday is the mode
+    assert out2.metadata.columns[3].indicator_value == "Thursday"
+
+
+def test_geolocation_vectorizer_mean_fill():
+    f = FeatureBuilder.Geolocation("geo").as_predictor()
+    stage = GeolocationVectorizer().set_input(f)
+    ds = _ds(geo=(T.Geolocation, [[10.0, 20.0, 1.0], [30.0, 40.0, 3.0], None]))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    vals = np.asarray(out.values)
+    np.testing.assert_allclose(vals[2, :3], [20.0, 30.0, 2.0])  # mean fill
+    assert vals[2, 3] == 1.0  # null indicator
+
+
+# -------------------------------- maps ---------------------------------------
+def test_real_map_vectorizer_mean_fill_per_key():
+    f = FeatureBuilder.RealMap("m").as_predictor()
+    stage = RealMapVectorizer(fill="mean").set_input(f)
+    ds = _ds(m=(T.RealMap, [{"a": 1.0, "b": 5.0}, {"a": 3.0}, {}]))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    vals = np.asarray(out.values)
+    # keys sorted: a, b; layout per key: [value, null]
+    np.testing.assert_allclose(vals[:, 0], [1.0, 3.0, 2.0])  # a mean=2
+    np.testing.assert_allclose(vals[:, 1], [0.0, 0.0, 1.0])  # a null flags
+    np.testing.assert_allclose(vals[:, 2], [5.0, 5.0, 5.0])  # b mean=5 fills
+    np.testing.assert_allclose(vals[:, 3], [0.0, 1.0, 1.0])
+    assert out.metadata.columns[0].grouping == "a"
+
+
+def test_integral_map_mode_fill():
+    f = FeatureBuilder.IntegralMap("m").as_predictor()
+    stage = RealMapVectorizer(fill="mode").set_input(f)
+    ds = _ds(m=(T.IntegralMap, [{"k": 2}, {"k": 2}, {"k": 7}, {}]))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    assert np.asarray(out.values)[3, 0] == 2.0  # mode fill
+
+
+def test_text_map_pivot_vectorizer():
+    f = FeatureBuilder.PickListMap("m").as_predictor()
+    stage = TextMapPivotVectorizer(top_k=2, min_support=1).set_input(f)
+    rows = [{"color": "red"}, {"color": "red", "size": "L"},
+            {"color": "blue"}, {}]
+    ds = _ds(m=(T.PickListMap, rows))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    meta = out.metadata
+    # keys sorted: color (Red, Blue by count desc then name), size
+    groupings = {c.grouping for c in meta.columns}
+    assert groupings == {"color", "size"}
+    color_cols = [i for i, c in enumerate(meta.columns) if c.grouping == "color"]
+    vals = np.asarray(out.values)
+    # row 3 ({}): color null indicator set
+    null_idx = [i for i in color_cols
+                if meta.columns[i].indicator_value == NULL_STRING][0]
+    assert vals[3, null_idx] == 1.0
+
+
+def test_multipicklist_map_pivot_sets():
+    f = FeatureBuilder.MultiPickListMap("m").as_predictor()
+    stage = TextMapPivotVectorizer(top_k=3, min_support=1).set_input(f)
+    rows = [{"tags": {"x", "y"}}, {"tags": {"x"}}, {}]
+    ds = _ds(m=(T.MultiPickListMap, rows))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    meta = out.metadata
+    x_idx = [i for i, c in enumerate(meta.columns)
+             if c.indicator_value == "X"][0]
+    vals = np.asarray(out.values)
+    np.testing.assert_allclose(vals[:, x_idx], [1.0, 1.0, 0.0])
+
+
+def test_smart_text_map_vectorizer_decides_per_key():
+    f = FeatureBuilder.TextMap("m").as_predictor()
+    stage = SmartTextMapVectorizer(
+        max_cardinality=3, top_k=2, min_support=1, num_hashes=16
+    ).set_input(f)
+    rows = []
+    for i in range(40):
+        rows.append({
+            "cat": "yes" if i % 2 else "no",          # low card -> pivot
+            "free": f"unique text value number {i}",  # high card -> hash
+        })
+    ds = _ds(m=(T.TextMap, rows))
+    model = stage.fit(ds)
+    assert model.methods[0][0] == "Pivot"  # cat
+    assert model.methods[0][1] == "Hash"   # free
+    out = model.transform(ds)[stage.output_name]
+    assert np.asarray(out.values).shape[0] == 40
+
+
+def test_date_map_vectorizer():
+    f = FeatureBuilder.DateMap("m").as_predictor()
+    ref = 10 * _DAY_MS
+    stage = DateMapVectorizer(
+        reference_date_ms=ref, circular_reps=("DayOfWeek",)
+    ).set_input(f)
+    ds = _ds(m=(T.DateMap, [{"start": 3 * _DAY_MS}, {}]))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    vals = np.asarray(out.values)
+    # per key: x_DayOfWeek, y_DayOfWeek, SinceLast, null
+    assert vals.shape == (2, 4)
+    assert vals[0, 2] == 7.0
+    assert vals[1, 3] == 1.0
+
+
+def test_geolocation_map_vectorizer():
+    f = FeatureBuilder.GeolocationMap("m").as_predictor()
+    stage = GeolocationMapVectorizer().set_input(f)
+    ds = _ds(m=(T.GeolocationMap, [{"home": [1.0, 2.0, 3.0]}, {}]))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    vals = np.asarray(out.values)
+    np.testing.assert_allclose(vals[0], [1.0, 2.0, 3.0, 0.0])
+    np.testing.assert_allclose(vals[1], [0.0, 0.0, 0.0, 1.0])
+
+
+def test_phone_map_vectorizer():
+    f = FeatureBuilder.PhoneMap("m").as_predictor()
+    stage = PhoneMapVectorizer().set_input(f)
+    ds = _ds(m=(T.PhoneMap, [{"cell": "5551234567"}, {"cell": "12"}, {}]))
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    vals = np.asarray(out.values)
+    np.testing.assert_allclose(vals[:, 0], [1.0, 0.0, 0.0])
+    np.testing.assert_allclose(vals[:, 1], [0.0, 0.0, 1.0])
+
+
+# --------------------------- transmogrify dispatch ---------------------------
+def test_transmogrify_covers_lists_maps_phone():
+    feats = [
+        FeatureBuilder.Phone("phone").as_predictor(),
+        FeatureBuilder.TextList("toks").as_predictor(),
+        FeatureBuilder.DateList("dates").as_predictor(),
+        FeatureBuilder.Geolocation("geo").as_predictor(),
+        FeatureBuilder.RealMap("rm").as_predictor(),
+        FeatureBuilder.PickListMap("plm").as_predictor(),
+        FeatureBuilder.TextMap("tm").as_predictor(),
+        FeatureBuilder.BinaryMap("bm").as_predictor(),
+        FeatureBuilder.GeolocationMap("gm").as_predictor(),
+    ]
+    vector = transmogrify(feats)
+    ds = _ds(
+        phone=(T.Phone, ["5551234567", None]),
+        toks=(T.TextList, [["a"], ["b", "c"]]),
+        dates=(T.DateList, [[_DAY_MS], []]),
+        geo=(T.Geolocation, [[1.0, 2.0, 0.0], None]),
+        rm=(T.RealMap, [{"a": 1.0}, {}]),
+        plm=(T.PickListMap, [{"k": "v"}, {}]),
+        tm=(T.TextMap, [{"t": "hello"}, {}]),
+        bm=(T.BinaryMap, [{"b": True}, {}]),
+        gm=(T.GeolocationMap, [{"g": [1.0, 2.0, 0.0]}, {}]),
+    )
+    data, _ = fit_and_transform_dag(ds, [vector])
+    out = data[vector.name]
+    assert np.asarray(out.values).shape[0] == 2
+    assert out.metadata.size == np.asarray(out.values).shape[1]
+    # every input feature contributed columns
+    parents = {p for c in out.metadata.columns for p in c.parent_names}
+    assert parents == {f.name for f in feats}

@@ -253,9 +253,13 @@ def test_transmogrify_titanic_end_to_end(titanic_path):
     np.testing.assert_allclose(rescored[vector.name].values, vec.values)
 
 
-def test_transmogrify_unsupported_type_clear_error():
-    from transmogrifai_tpu.ops import transmogrify
+def test_transmogrify_dispatch_covers_all_feature_types():
+    """Every concrete feature type except Prediction (model output) has a
+    default vectorizer (Transmogrifier.scala:92-340 full dispatch parity)."""
+    from transmogrifai_tpu.ops.defaults import DEFAULTS
+    from transmogrifai_tpu.ops.transmogrify import _vectorizer_for
 
-    g = FeatureBuilder.Geolocation("g").as_predictor()
-    with pytest.raises(NotImplementedError):
-        transmogrify([g])
+    for ftype in T.ALL_FEATURE_TYPES:
+        if ftype in (T.Prediction, T.OPVector):  # OPVector is passthrough
+            continue
+        assert _vectorizer_for(ftype, DEFAULTS) is not None, ftype

@@ -17,7 +17,7 @@ from typing import Sequence
 import numpy as np
 
 from ..models.base import PredictorModel
-from ..stages.base import Transformer
+from ..stages.base import Model
 from ..stages.metadata import VectorMetadata
 from ..types import OPVector, TextMap
 from ..types.columns import Column, MapColumn, VectorColumn
@@ -47,8 +47,13 @@ def _column_groups(meta: VectorMetadata | None, dim: int) -> list[tuple[str, lis
     return [(k, groups[k]) for k in order]
 
 
-class RecordInsightsLOCO(Transformer):
-    """Transformer[OPVector] -> TextMap of top-K column contributions."""
+class RecordInsightsLOCO(Model):
+    """Transformer[OPVector] -> TextMap of top-K column contributions.
+
+    A ``Model`` (not a plain Transformer) so workflow persistence saves the
+    wrapped predictor's arrays; the nested model round-trips via
+    class-name + params in ``get_params`` and namespaced arrays.
+    """
 
     input_types = (OPVector,)
     output_type = TextMap
@@ -66,7 +71,28 @@ class RecordInsightsLOCO(Transformer):
         self.strategy = strategy
 
     def get_params(self):
-        return {"top_k": self.top_k, "strategy": self.strategy}
+        return {
+            "top_k": self.top_k,
+            "strategy": self.strategy,
+            "model_class": type(self.model).__name__,
+            "model_params": self.model.get_params(),
+        }
+
+    def get_arrays(self):
+        return {f"model__{k}": v for k, v in self.model.get_arrays().items()}
+
+    @classmethod
+    def from_params(cls, params: dict, arrays: dict) -> "RecordInsightsLOCO":
+        from ..workflow.persistence import construct_stage
+
+        params = dict(params)
+        model = construct_stage(
+            params.pop("model_class"),
+            params.pop("model_params"),
+            {k[len("model__"):]: v for k, v in arrays.items()
+             if k.startswith("model__")},
+        )
+        return cls(model=model, **params)
 
     def _score(self, x: np.ndarray, base_class: np.ndarray | None = None):
         """Per-row score tracked against the BASE prediction's class
@@ -98,12 +124,14 @@ class RecordInsightsLOCO(Transformer):
         k = min(self.top_k, len(groups))
         for i in range(num_rows):
             row = diffs[i]
-            order = (
-                np.argsort(-np.abs(row))
-                if self.strategy == ABS
-                else np.argsort(-row)
-            )
-            values.append(
-                {names[j]: float(row[j]) for j in order[:k]}
-            )
+            if self.strategy == ABS:
+                picked = list(np.argsort(-np.abs(row))[:k])
+            else:
+                # topK most positive AND topK most negative
+                # (RecordInsightsLOCO.scala:91 PositiveNegative strategy)
+                order = np.argsort(-row)
+                pos = [j for j in order[:k] if row[j] > 0]
+                neg = [j for j in order[::-1][:k] if row[j] < 0]
+                picked = pos + [j for j in neg if j not in pos]
+            values.append({names[j]: float(row[j]) for j in picked})
         return MapColumn(TextMap, values)

@@ -1,0 +1,120 @@
+"""Phone validation + vectorization.
+
+Reference: core/.../stages/impl/feature/PhoneNumberParser.scala (566 LoC,
+libphonenumber-backed). The Transmogrifier default for Phone features is
+``f.vectorize(defaultRegion)`` — parse against the default region and emit a
+single is-valid indicator column (+ null indicator).
+
+The JVM libphonenumber dependency is replaced with a self-contained validator
+with the same observable behavior on well-formed input: strip formatting,
+honor an explicit +country prefix (E.164 length rules), otherwise validate
+against the default region's national number plan length (US/NANP: 10 digits,
+optionally prefixed with the country code 1).
+"""
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import numpy as np
+
+from ..stages.metadata import NULL_STRING, ColumnMeta
+from ..types.columns import Column
+from .base import VectorizerTransformer
+from .defaults import DEFAULTS
+
+DEFAULT_REGION = "US"
+
+#: national significant-number lengths per region (subset; E.164 fallback)
+_REGION_RULES: dict[str, tuple[str, tuple[int, ...]]] = {
+    # region -> (country calling code, allowed national lengths)
+    "US": ("1", (10,)),
+    "CA": ("1", (10,)),
+    "GB": ("44", (9, 10)),
+    "DE": ("49", (6, 7, 8, 9, 10, 11)),
+    "FR": ("33", (9,)),
+    "IN": ("91", (10,)),
+    "JP": ("81", (9, 10)),
+    "BR": ("55", (10, 11)),
+    "MX": ("52", (10,)),
+    "AU": ("61", (9,)),
+}
+
+_NON_DIGIT = re.compile(r"[^\d+]")
+
+
+def is_valid_phone(value: str | None, region: str = DEFAULT_REGION) -> bool | None:
+    """None for missing; True/False validity against ``region``.
+
+    Mirrors PhoneNumberParser.validate semantics: formatting characters are
+    ignored; a leading ``+`` switches to international (E.164: 7-15 digits
+    with a known country code when recognizable); otherwise the national
+    length rules of the default region apply.
+    """
+    if value is None:
+        return None
+    s = _NON_DIGIT.sub("", value.strip())
+    if not s or s.count("+") > (1 if s.startswith("+") else 0):
+        return False
+    if s.startswith("+"):
+        digits = s[1:]
+        if not digits.isdigit() or not 7 <= len(digits) <= 15:
+            return False
+        for _, (cc, lengths) in _REGION_RULES.items():
+            if digits.startswith(cc) and len(digits) - len(cc) in lengths:
+                return True
+        # unknown country code: accept E.164-plausible numbers
+        return 8 <= len(digits) <= 15
+    if not s.isdigit():
+        return False
+    cc, lengths = _REGION_RULES.get(region.upper(), ("", (7, 8, 9, 10, 11)))
+    if len(s) in lengths:
+        return True
+    # national number with its own country code prefix (e.g. 1-555-...)
+    return bool(cc) and s.startswith(cc) and len(s) - len(cc) in lengths
+
+
+class PhoneVectorizer(VectorizerTransformer):
+    """One is-valid indicator column per phone feature (+ null indicator)."""
+
+    def __init__(
+        self,
+        default_region: str = DEFAULT_REGION,
+        track_nulls: bool = DEFAULTS.TrackNulls,
+        uid: str | None = None,
+    ):
+        super().__init__("vecPhone", uid=uid)
+        self.default_region = default_region
+        self.track_nulls = track_nulls
+
+    def get_params(self):
+        return {
+            "default_region": self.default_region,
+            "track_nulls": self.track_nulls,
+        }
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for col, feat in zip(cols, self.input_features):
+            out = np.zeros(
+                (num_rows, 1 + (1 if self.track_nulls else 0)), dtype=np.float64
+            )
+            for r, v in enumerate(col.to_list()):
+                valid = is_valid_phone(v, self.default_region)
+                if valid is None:
+                    if self.track_nulls:
+                        out[r, 1] = 1.0
+                elif valid:
+                    out[r, 0] = 1.0
+            blocks.append(out)
+            metas_f = [
+                ColumnMeta((feat.name,), feat.ftype.__name__,
+                           descriptor_value="isValidPhone")
+            ]
+            if self.track_nulls:
+                metas_f.append(
+                    ColumnMeta((feat.name,), feat.ftype.__name__,
+                               grouping=feat.name, indicator_value=NULL_STRING)
+                )
+            metas.append(metas_f)
+        return blocks, metas

@@ -16,7 +16,16 @@ Dispatch parity map (defaults at Transmogrifier.scala:52-88):
   PickList/ComboBox/ID/Email/URL/Base64/Country/State/City/PostalCode/Street
                             OneHotVectorizer (TopK=20, MinSupport=10)
   MultiPickList             OneHotVectorizer over sets
-  (lists, maps, geolocation, phone: later milestone — clear error for now)
+  Phone                     PhoneVectorizer (is-valid vs DefaultRegion)
+  TextList                  TextListVectorizer (hashing TF, 512 terms)
+  DateList/DateTimeList     DateListVectorizer (SinceLast)
+  Geolocation               GeolocationVectorizer (fillWithMean)
+  numeric maps              RealMapVectorizer (mean/mode/constant per type)
+  Date/DateTimeMap          DateMapVectorizer (unit circles + SinceLast)
+  categorical maps          TextMapPivotVectorizer (per-key topK pivot)
+  TextMap/TextAreaMap       SmartTextMapVectorizer (per-key pivot/hash)
+  PhoneMap                  PhoneMapVectorizer
+  GeolocationMap            GeolocationMapVectorizer
 """
 from __future__ import annotations
 
@@ -28,12 +37,22 @@ from .categorical import OneHotVectorizer
 from .combiner import VectorsCombiner
 from .dates import DateVectorizer
 from .defaults import DEFAULTS, TransmogrifierDefaults
+from .lists import DateListVectorizer, GeolocationVectorizer, TextListVectorizer
+from .maps import (
+    DateMapVectorizer,
+    GeolocationMapVectorizer,
+    PhoneMapVectorizer,
+    RealMapVectorizer,
+    SmartTextMapVectorizer,
+    TextMapPivotVectorizer,
+)
 from .numeric import (
     BinaryVectorizer,
     IntegralVectorizer,
     RealNNVectorizer,
     RealVectorizer,
 )
+from .phone import PhoneVectorizer
 from .text import SmartTextVectorizer
 
 _ONE_HOT_TYPES = (
@@ -50,6 +69,24 @@ _ONE_HOT_TYPES = (
     T.Street,
 )
 _SMART_TEXT_TYPES = (T.Text, T.TextArea)
+
+#: categorical maps pivoted per key (Transmogrifier.scala maps dispatch)
+_PIVOT_MAP_TYPES = (
+    T.Base64Map,
+    T.ComboBoxMap,
+    T.EmailMap,
+    T.IDMap,
+    T.MultiPickListMap,
+    T.PickListMap,
+    T.URLMap,
+    T.CountryMap,
+    T.StateMap,
+    T.CityMap,
+    T.PostalCodeMap,
+    T.StreetMap,
+    T.NameStats,
+)
+_MEAN_MAP_TYPES = (T.CurrencyMap, T.PercentMap, T.RealMap)
 
 
 def _vectorizer_for(ftype: type, d: TransmogrifierDefaults):
@@ -92,9 +129,80 @@ def _vectorizer_for(ftype: type, d: TransmogrifierDefaults):
             clean_text=d.CleanText,
             track_nulls=d.TrackNulls,
         )
+    if ftype is T.Phone:
+        return PhoneVectorizer(track_nulls=d.TrackNulls)
+    if ftype is T.TextList:
+        return TextListVectorizer(
+            num_terms=d.DefaultNumOfFeatures,
+            binary_freq=d.BinaryFreq,
+            min_doc_freq=d.MinDocFrequency,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype in (T.DateList, T.DateTimeList):
+        return DateListVectorizer(
+            reference_date_ms=d.ReferenceDateMs, track_nulls=d.TrackNulls
+        )
+    if ftype is T.Geolocation:
+        return GeolocationVectorizer(
+            fill_with_mean=d.FillWithMean, track_nulls=d.TrackNulls
+        )
+    if ftype in _PIVOT_MAP_TYPES:
+        return TextMapPivotVectorizer(
+            top_k=d.TopK,
+            min_support=d.MinSupport,
+            clean_text=d.CleanText,
+            clean_keys=d.CleanKeys,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype in _MEAN_MAP_TYPES:
+        return RealMapVectorizer(
+            fill="mean" if d.FillWithMean else "constant",
+            fill_value=d.FillValue,
+            clean_keys=d.CleanKeys,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype is T.IntegralMap:
+        return RealMapVectorizer(
+            fill="mode" if d.FillWithMode else "constant",
+            fill_value=d.FillValue,
+            clean_keys=d.CleanKeys,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype is T.BinaryMap:
+        return RealMapVectorizer(
+            fill="constant",
+            fill_value=float(d.BinaryFillValue),
+            clean_keys=d.CleanKeys,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype in (T.DateMap, T.DateTimeMap):
+        return DateMapVectorizer(
+            reference_date_ms=d.ReferenceDateMs,
+            circular_reps=d.CircularDateRepresentations,
+            clean_keys=d.CleanKeys,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype in (T.TextMap, T.TextAreaMap):
+        return SmartTextMapVectorizer(
+            max_cardinality=d.MaxCategoricalCardinality,
+            top_k=d.TopK,
+            min_support=d.MinSupport,
+            coverage_pct=d.CoveragePct,
+            num_hashes=d.DefaultNumOfFeatures,
+            clean_text=d.CleanText,
+            clean_keys=d.CleanKeys,
+            track_nulls=d.TrackNulls,
+        )
+    if ftype is T.PhoneMap:
+        return PhoneMapVectorizer(
+            clean_keys=d.CleanKeys, track_nulls=d.TrackNulls
+        )
+    if ftype is T.GeolocationMap:
+        return GeolocationMapVectorizer(
+            clean_keys=d.CleanKeys, track_nulls=d.TrackNulls
+        )
     raise NotImplementedError(
-        f"No default vectorizer for feature type {ftype.__name__} yet "
-        f"(Transmogrifier parity gap — lists/maps/geolocation/phone pending)"
+        f"No default vectorizer for feature type {ftype.__name__}"
     )
 
 

@@ -199,10 +199,14 @@ class RawFeatureFilter:
         excluded: dict[str, list[str]] = {}
         metrics: dict[str, dict[str, Any]] = {}
         label = None
+        label_valid = None
         if label_name is not None and label_name in train:
             lc = train[label_name]
             if isinstance(lc, NumericColumn):
+                # unlabeled rows (mask False) hold an unspecified fill value —
+                # restrict the null↔label correlation to labeled rows
                 label = lc.values.astype(np.float64)
+                label_valid = lc.mask
 
         for f in raw_features:
             if f.is_response or f.name in self.protected_features:
@@ -243,9 +247,10 @@ class RawFeatureFilter:
                     reasons.append(f"jsDivergence={js:.3f}")
 
             if label is not None:
-                nulls = _null_mask(col).astype(np.float64)
-                if nulls.std() > 0 and label.std() > 0:
-                    corr = float(np.corrcoef(nulls, label)[0, 1])
+                nulls = _null_mask(col).astype(np.float64)[label_valid]
+                lbl = label[label_valid]
+                if len(lbl) > 1 and nulls.std() > 0 and lbl.std() > 0:
+                    corr = float(np.corrcoef(nulls, lbl)[0, 1])
                     m["nullLabelCorrelation"] = corr
                     if abs(corr) > self.max_null_label_corr:
                         reasons.append(f"nullLabelCorr={corr:.3f}")

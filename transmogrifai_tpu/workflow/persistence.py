@@ -26,7 +26,6 @@ import numpy as np
 from .. import types as T
 from ..features.feature import Feature, FeatureGeneratorStage
 from ..stages.base import Model, PipelineStage, Transformer
-from ..utils import uid as uid_util
 
 #: class-name -> class registry for stage reconstruction
 _REGISTRY: dict[str, type] = {}
@@ -44,15 +43,19 @@ def _registry() -> dict[str, type]:
     if _BUILTINS_POPULATED:
         return _REGISTRY
     _BUILTINS_POPULATED = True
+    from ..insights import loco
     from ..models import gbdt, linear, logistic, mlp
     from ..models.base import PredictorModel
-    from ..ops import categorical, combiner, dates, numeric, text
+    from ..ops import (
+        categorical, combiner, dates, lists, maps, numeric, phone, text,
+    )
     from ..prep import derived_filter, sanity_checker
     from ..selector import model_selector
 
     for module in (
-        gbdt, linear, logistic, mlp, categorical, combiner, dates, numeric,
-        text, derived_filter, sanity_checker, model_selector,
+        gbdt, linear, logistic, mlp, categorical, combiner, dates, lists,
+        maps, numeric, phone, text, derived_filter, sanity_checker,
+        model_selector, loco,
     ):
         for name in dir(module):
             obj = getattr(module, name)
