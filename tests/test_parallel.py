@@ -1,0 +1,154 @@
+"""parallel/ module tests on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8) — sharded monoid reductions must
+match their single-device numpy equivalents exactly (order invariance,
+SURVEY.md §2.6)."""
+import jax
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.parallel import (
+    auto_mesh,
+    data_parallel_fit,
+    grid_parallel_fit,
+    make_mesh,
+    pcolumn_stats,
+    pcontingency,
+    phistogram,
+    pxtx,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    return make_mesh(n_data=8)
+
+
+def test_auto_mesh_present_on_multidevice():
+    m = auto_mesh()
+    assert m is not None and m.shape["data"] == len(jax.devices())
+
+
+def test_pcolumn_stats_matches_numpy(mesh, rng):
+    x = rng.normal(size=(1001, 7))  # deliberately not divisible by 8
+    r = pcolumn_stats(x, mesh)
+    assert r["count"] == 1001
+    # f32 on-device accumulation: compare at f32 precision
+    np.testing.assert_allclose(r["mean"], x.mean(axis=0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        r["m2"], ((x - x.mean(axis=0)) ** 2).sum(axis=0), rtol=1e-3
+    )
+    np.testing.assert_allclose(r["min"], x.min(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(r["max"], x.max(axis=0), rtol=1e-6)
+
+
+def test_pcolumn_stats_large_mean_no_cancellation(mesh, rng):
+    """Columns with |mean| >> std must not lose their variance to float32
+    raw-moment cancellation (centered two-pass reduction)."""
+    x = rng.normal(loc=2e4, scale=1.0, size=(640, 3))
+    r = pcolumn_stats(x, mesh)
+    var = r["m2"] / (r["count"] - 1)
+    np.testing.assert_allclose(var, x.var(axis=0, ddof=1), rtol=5e-2)
+
+
+def test_pcentered_gram_large_mean_correlation(mesh, rng):
+    """Distributed correlation path must recover correlations for
+    large-offset features (the review's reproduced failure case)."""
+    from transmogrifai_tpu.parallel.reductions import pcentered_gram
+
+    n = 640
+    base = rng.normal(size=n)
+    x = np.stack([base + 2e4, 0.5 * base + rng.normal(size=n) + 1e4], axis=1)
+    g, mean, cnt = pcentered_gram(x, mesh)
+    cov = g / (cnt - 1)
+    corr = cov[0, 1] / np.sqrt(cov[0, 0] * cov[1, 1])
+    expect = np.corrcoef(x[:, 0], x[:, 1])[0, 1]
+    assert abs(corr - expect) < 0.05 and expect > 0.3
+
+
+def test_pxtx_matches_numpy(mesh, rng):
+    x = rng.normal(size=(130, 5)).astype(np.float32)
+    np.testing.assert_allclose(pxtx(x, mesh), x.T @ x, rtol=2e-4, atol=1e-5)
+
+
+def test_phistogram_matches_bincount(mesh, rng):
+    codes = rng.integers(0, 16, size=(333, 4)).astype(np.int32)
+    hist = phistogram(codes, 16, mesh)
+    for f in range(4):
+        np.testing.assert_allclose(
+            hist[f], np.bincount(codes[:, f], minlength=16)
+        )
+
+
+def test_phistogram_weighted(mesh, rng):
+    codes = rng.integers(0, 8, size=(100, 2)).astype(np.int32)
+    w = rng.random(100).astype(np.float32)
+    hist = phistogram(codes, 8, mesh, weights=w)
+    expect = np.zeros((2, 8))
+    for f in range(2):
+        np.add.at(expect[f], codes[:, f], w)
+    np.testing.assert_allclose(hist, expect, rtol=1e-5)
+
+
+def test_pcontingency_matches_matmul(mesh, rng):
+    g = (rng.random((97, 6)) > 0.5).astype(np.float64)
+    y = np.eye(3)[rng.integers(0, 3, 97)]
+    np.testing.assert_allclose(pcontingency(g, y, mesh), g.T @ y, rtol=1e-5)
+
+
+def test_stats_plane_uses_mesh_path(monkeypatch, rng):
+    """column_stats / correlation_matrix give identical answers through the
+    sharded path (threshold dropped so small inputs route through the mesh)."""
+    import transmogrifai_tpu.utils.stats as S
+
+    x = rng.normal(size=(200, 6))
+    base_cs = S.column_stats(x)
+    base_corr = S.correlation_matrix(x)
+    monkeypatch.setattr(S, "_DEVICE_THRESHOLD", 0)
+    cs = S.column_stats(x)
+    corr = S.correlation_matrix(x)
+    np.testing.assert_allclose(cs.mean, base_cs.mean, rtol=1e-5)
+    np.testing.assert_allclose(cs.variance, base_cs.variance, rtol=1e-4)
+    np.testing.assert_allclose(cs.min, base_cs.min, rtol=1e-6)
+    np.testing.assert_allclose(cs.max, base_cs.max, rtol=1e-6)
+    np.testing.assert_allclose(corr, base_corr, atol=1e-4)
+
+
+def test_data_parallel_fit_logistic(mesh, rng):
+    from transmogrifai_tpu.models.solvers import fit_logistic_binary
+
+    n, d = 200, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (x @ w_true > 0).astype(np.float32)
+    mask = np.ones(n, dtype=np.float32)
+    params = data_parallel_fit(
+        fit_logistic_binary, mesh, x, y, mask, 0.0, 0.0, num_iters=60
+    )
+    w = np.asarray(params.weights)
+    assert np.isfinite(w).all()
+    # sharded fit equals the single-device fit
+    ref = fit_logistic_binary(x, y, mask, 0.0, 0.0, num_iters=60)
+    np.testing.assert_allclose(w, np.asarray(ref.weights), atol=1e-3)
+
+
+def test_grid_parallel_fit_shards_grid_axis(rng):
+    from transmogrifai_tpu.models.solvers import fit_logistic_binary
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = make_mesh(n_data=2, n_model=4)
+    n, d, g = 64, 4, 6  # grid of 6 pads up to 8 over 4 model shards
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    mask = np.ones(n, dtype=np.float32)
+    regs = np.linspace(0.0, 0.3, g).astype(np.float32)
+    ens = np.zeros(g, dtype=np.float32)
+    out = grid_parallel_fit(
+        fit_logistic_binary, mesh, x, y, mask, [regs, ens], num_iters=20
+    )
+    w = np.asarray(out.weights)
+    assert w.shape == (g, d) and np.isfinite(w).all()
+    # stronger regularization shrinks weights
+    assert np.linalg.norm(w[-1]) < np.linalg.norm(w[0])

@@ -84,21 +84,29 @@ def pivot_block(
 
 
 def pivot_metas(
-    name: str, parent_type: type, vocab: list[str], track_nulls: bool
+    name: str,
+    parent_type: type,
+    vocab: list[str],
+    track_nulls: bool,
+    grouping: str | None = None,
 ) -> list[ColumnMeta]:
+    """Metas for one pivot group: vocab columns + OTHER (+ null indicator).
+    ``grouping`` defaults to the feature name; map vectorizers pass the map
+    key so per-key groups drop together in the SanityChecker."""
+    group = grouping if grouping is not None else name
     metas = [
-        ColumnMeta((name,), parent_type.__name__, grouping=name, indicator_value=v)
+        ColumnMeta((name,), parent_type.__name__, grouping=group, indicator_value=v)
         for v in vocab
     ]
     metas.append(
         ColumnMeta(
-            (name,), parent_type.__name__, grouping=name, indicator_value=OTHER_STRING
+            (name,), parent_type.__name__, grouping=group, indicator_value=OTHER_STRING
         )
     )
     if track_nulls:
         metas.append(
             ColumnMeta(
-                (name,), parent_type.__name__, grouping=name, indicator_value=NULL_STRING
+                (name,), parent_type.__name__, grouping=group, indicator_value=NULL_STRING
             )
         )
     return metas

@@ -254,6 +254,19 @@ class DateListVectorizer(VectorizerTransformer):
 _GEO_COMPONENTS = ("lat", "lon", "accuracy")
 
 
+def parse_geo(geo) -> tuple[float, float, float] | None:
+    """One raw geolocation value -> (lat, lon, accuracy) or None for missing.
+    Accuracy defaults to 0.0 (GeolocationAccuracy.Unknown) — the single
+    shared parse so scalar and map geolocation features encode identically."""
+    if not geo or len(geo) < 2:
+        return None
+    return (
+        float(geo[0]),
+        float(geo[1]),
+        float(geo[2]) if len(geo) > 2 else 0.0,
+    )
+
+
 class GeolocationModel(VectorizerModel):
     def __init__(self, fills: list[list[float]], track_nulls: bool, **kw):
         super().__init__("vecGeo", **kw)
@@ -271,10 +284,9 @@ class GeolocationModel(VectorizerModel):
                 (num_rows, 3 + (1 if self.track_nulls else 0)), dtype=np.float64
             )
             for r, geo in enumerate(col.to_list()):
-                if geo and len(geo) >= 2:
-                    lat, lon = float(geo[0]), float(geo[1])
-                    acc = float(geo[2]) if len(geo) > 2 else 0.0
-                    out[r, :3] = (lat, lon, acc)
+                parsed = parse_geo(geo)
+                if parsed is not None:
+                    out[r, :3] = parsed
                 else:
                     out[r, :3] = fill
                     if self.track_nulls:
@@ -323,10 +335,9 @@ class GeolocationVectorizer(VectorizerEstimator):
                 acc = np.zeros(3, dtype=np.float64)
                 cnt = 0
                 for geo in col.to_list():
-                    if geo and len(geo) >= 2:
-                        acc[0] += float(geo[0])
-                        acc[1] += float(geo[1])
-                        acc[2] += float(geo[2]) if len(geo) > 2 else 0.0
+                    parsed = parse_geo(geo)
+                    if parsed is not None:
+                        acc += parsed
                         cnt += 1
                 fills.append((acc / max(cnt, 1)).tolist())
             else:

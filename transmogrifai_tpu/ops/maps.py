@@ -26,8 +26,9 @@ from ..stages.metadata import NULL_STRING, ColumnMeta
 from ..types.columns import Column, MapColumn
 from ..utils.text import clean_string
 from .base import VectorizerEstimator, VectorizerModel
-from .categorical import pivot_block, top_values
+from .categorical import pivot_block, pivot_metas, top_values
 from .dates import unit_circle
+from .lists import _GEO_COMPONENTS, parse_geo
 from .defaults import DEFAULTS
 from .phone import DEFAULT_REGION, is_valid_phone
 from .text import HASH, IGNORE, PIVOT, TextStats, decide_method, hash_block
@@ -268,22 +269,7 @@ class DateMapVectorizer(VectorizerEstimator):
 
 def _pivot_key_metas(name: str, parent_type: type, key: str, vocab: list[str],
                      track_nulls: bool) -> list[ColumnMeta]:
-    from ..stages.metadata import OTHER_STRING
-
-    metas = [
-        ColumnMeta((name,), parent_type.__name__, grouping=key, indicator_value=v)
-        for v in vocab
-    ]
-    metas.append(
-        ColumnMeta((name,), parent_type.__name__, grouping=key,
-                   indicator_value=OTHER_STRING)
-    )
-    if track_nulls:
-        metas.append(
-            ColumnMeta((name,), parent_type.__name__, grouping=key,
-                       indicator_value=NULL_STRING)
-        )
-    return metas
+    return pivot_metas(name, parent_type, vocab, track_nulls, grouping=key)
 
 
 class TextMapPivotModel(VectorizerModel):
@@ -563,9 +549,6 @@ class SmartTextMapVectorizer(VectorizerEstimator):
         )
 
 
-_GEO_COMPONENTS = ("lat", "lon", "accuracy")
-
-
 class GeolocationMapModel(VectorizerModel):
     def __init__(self, keys: list[list[str]], clean_keys: bool,
                  track_nulls: bool, **kw):
@@ -590,12 +573,10 @@ class GeolocationMapModel(VectorizerModel):
             out = np.zeros((num_rows, len(keys) * per_key), dtype=np.float64)
             for r, m in enumerate(rows):
                 for j, k in enumerate(keys):
-                    geo = m.get(k)
+                    parsed = parse_geo(m.get(k))
                     base = j * per_key
-                    if geo and len(geo) >= 2:
-                        out[r, base] = float(geo[0])
-                        out[r, base + 1] = float(geo[1])
-                        out[r, base + 2] = float(geo[2]) if len(geo) > 2 else 0.0
+                    if parsed is not None:
+                        out[r, base:base + 3] = parsed
                     elif self.track_nulls:
                         out[r, base + 3] = 1.0
             metas_f: list[ColumnMeta] = []

@@ -1,0 +1,38 @@
+"""Distributed execution plane: device meshes, sharded monoid reductions,
+and data/model-parallel fit wrappers.
+
+The reference's distributed substrate is Apache Spark (SURVEY.md §5.8):
+row-partition data parallelism, shuffle-based map-reduce aggregation, and a
+driver thread pool for concurrent model×grid fits. The TPU-native mapping
+(SURVEY.md §2.6):
+
+  Spark mechanism                      here
+  ---------------------------------    ----------------------------------
+  RDD row partitions over executors    batch-dim sharding over mesh axis
+                                       "data" (`shard_rows`)
+  monoid reduceByKey / treeAggregate   `shard_map` + `lax.psum` reductions
+                                       (`pcolumn_stats`, `pxtx`, ...)
+  driver pool for model×grid fits      mesh axis "model" + vmap over stacked
+                                       hyperparams (`grid_parallel_fit`)
+  XGBoost Rabit allreduce              `psum` inside the training step
+
+Everything is expressed against a `jax.sharding.Mesh`, so the same code runs
+on one chip, a v5e pod slice over ICI, or a multi-host DCN mesh — XLA inserts
+the collectives.
+"""
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    auto_mesh,
+    make_mesh,
+    pad_rows,
+    shard_grid,
+    shard_rows,
+)
+from .reductions import (  # noqa: F401
+    pcolumn_stats,
+    pcontingency,
+    phistogram,
+    pxtx,
+)
+from .fit import data_parallel_fit, grid_parallel_fit  # noqa: F401

@@ -1,0 +1,94 @@
+"""Data- and model-parallel fit wrappers.
+
+`data_parallel_fit` runs one solver over rows sharded across the mesh's
+"data" axis — the jit/GSPMD path: inputs carry NamedShardings, XLA propagates
+them through the solver and inserts psum for the gradient reductions (the
+scaling-book recipe; replaces Spark's row-partitioned fits).
+
+`grid_parallel_fit` vmaps a solver over stacked hyperparameter arrays and
+shards the stacked axis over "model" — the reference's 8-thread candidate
+pool (OpValidator.scala:363-367) becomes one compiled sweep.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .mesh import MODEL_AXIS, pad_rows, shard_grid, shard_rows
+
+
+def data_parallel_fit(
+    fit_fn: Callable[..., Any],
+    mesh,
+    x: np.ndarray,
+    y: np.ndarray,
+    row_mask: np.ndarray,
+    *args: Any,
+    **kwargs: Any,
+):
+    """Run ``fit_fn(x, y, row_mask, *args, **kwargs)`` with rows sharded over
+    the mesh's data axis. Padding rows get row_mask 0, so any solver that
+    weights by row_mask (all of models/solvers.py) is unaffected."""
+    import jax
+
+    n_shards = int(np.prod(list(mesh.shape.values()))) // mesh.shape[MODEL_AXIS]
+    xp, n = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
+    yp, _ = pad_rows(np.asarray(y, dtype=np.float32), n_shards)
+    mp, _ = pad_rows(np.asarray(row_mask, dtype=np.float32), n_shards)
+    with mesh:
+        return jax.jit(fit_fn, static_argnames=tuple(kwargs))(
+            shard_rows(mesh, xp),
+            shard_rows(mesh, yp),
+            shard_rows(mesh, mp),
+            *args,
+            **kwargs,
+        )
+
+
+def grid_parallel_fit(
+    fit_fn: Callable[..., Any],
+    mesh,
+    x: np.ndarray,
+    y: np.ndarray,
+    row_mask: np.ndarray,
+    grid_arrays: Sequence[np.ndarray],
+    **static_kwargs: Any,
+):
+    """vmap ``fit_fn`` over stacked hyperparameter arrays, sharding the grid
+    axis over the mesh's "model" axis (and rows over "data").
+
+    grid_arrays: per-hyperparam stacked values, each [G, ...]. G must divide
+    the model-axis size or vice versa; G is padded up by repeating the last
+    point (extra fits are discarded)."""
+    import jax
+
+    n_model = mesh.shape[MODEL_AXIS]
+    n_data = int(np.prod(list(mesh.shape.values()))) // n_model
+    g = grid_arrays[0].shape[0]
+    pad = (-g) % n_model
+    padded = []
+    for a in grid_arrays:
+        a = np.asarray(a, dtype=np.float32)
+        if pad:
+            a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+        padded.append(a)
+    xp, _ = pad_rows(np.asarray(x, dtype=np.float32), n_data)
+    yp, _ = pad_rows(np.asarray(y, dtype=np.float32), n_data)
+    mp, _ = pad_rows(np.asarray(row_mask, dtype=np.float32), n_data)
+
+    def sweep(xx, yy, mm, *grid):
+        return jax.vmap(
+            lambda *gp: fit_fn(xx, yy, mm, *gp, **static_kwargs)
+        )(*grid)
+
+    with mesh:
+        out = jax.jit(sweep, static_argnames=())(
+            shard_rows(mesh, xp),
+            shard_rows(mesh, yp),
+            shard_rows(mesh, mp),
+            *[shard_grid(mesh, a) for a in padded],
+        )
+    if pad:
+        out = jax.tree_util.tree_map(lambda t: t[:g], out)
+    return out

@@ -1,0 +1,85 @@
+"""Mesh construction + sharding helpers.
+
+A 2-D ("data", "model") mesh covers every parallelism the reference has
+(SURVEY.md §2.6): rows shard over "data" (Spark's RDD partitions), model
+candidates / hyperparameter grid points shard over "model" (the driver
+thread pool, OpValidator.scala:363-367). On one chip both axes have size 1
+and everything degenerates to plain jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: int | None = None, n_model: int = 1, devices=None):
+    """A ("data", "model") Mesh over ``devices`` (default: all available)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_model
+    n = n_data * n_model
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {n} devices, have {len(devices)}"
+        )
+    return Mesh(
+        np.asarray(devices[:n]).reshape(n_data, n_model),
+        (DATA_AXIS, MODEL_AXIS),
+    )
+
+
+def auto_mesh(min_devices: int = 2):
+    """The all-devices data-parallel mesh, or None on a single device.
+
+    The None return is the one-chip fast path: callers fall back to plain
+    jit (no shard_map overhead, no padding).
+    """
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < min_devices:
+        return None
+    return make_mesh(n_data=len(devices), n_model=1, devices=devices)
+
+
+def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
+    """Zero-pad axis 0 to a multiple of ``multiple`` (static shard shapes).
+
+    Returns (padded, original_n). Zero rows are monoid-neutral for the
+    sum-style reductions in transmogrifai_tpu.parallel.reductions; reductions
+    that are not (min/max) mask padding explicitly via the returned count.
+    """
+    n = x.shape[0]
+    rem = n % multiple
+    if rem == 0:
+        return x, n
+    pad = multiple - rem
+    padded = np.concatenate(
+        [x, np.zeros((pad,) + x.shape[1:], dtype=x.dtype)], axis=0
+    )
+    return padded, n
+
+
+def shard_rows(mesh, x):
+    """Place ``x`` row-sharded over the data axis (rows must divide evenly —
+    use pad_rows first)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(DATA_AXIS, *([None] * (np.ndim(x) - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def shard_grid(mesh, x):
+    """Place stacked per-candidate arrays sharded over the model axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(MODEL_AXIS, *([None] * (np.ndim(x) - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))

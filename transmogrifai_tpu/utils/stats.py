@@ -37,6 +37,16 @@ class ColumnStats:
 _DEVICE_THRESHOLD = 1 << 22
 
 
+def _stats_mesh(size: int):
+    """The all-device data mesh for multi-chip stats reductions, or None for
+    the single-device / small-problem fast path."""
+    if size < _DEVICE_THRESHOLD:
+        return None
+    from ..parallel.mesh import auto_mesh
+
+    return auto_mesh()
+
+
 @partial(jax.jit, static_argnames=())
 def _colstats_kernel(x: jax.Array):
     n = x.shape[0]
@@ -47,8 +57,18 @@ def _colstats_kernel(x: jax.Array):
 
 def column_stats(x: np.ndarray) -> ColumnStats:
     """Per-column count/mean/variance/min/max (mllib colStats parity:
-    sample variance, n-1 denominator)."""
-    if x.size < _DEVICE_THRESHOLD:
+    sample variance, n-1 denominator). Large inputs on a multi-device mesh
+    reduce via shard_map + psum (parallel.reductions.pcolumn_stats)."""
+    mesh = _stats_mesh(x.size)
+    if mesh is not None:
+        from ..parallel.reductions import pcolumn_stats
+
+        r = pcolumn_stats(x, mesh)
+        n = float(r["count"])
+        mean = r["mean"]
+        var = r["m2"] / max(n - 1.0, 1.0)
+        mn, mx = r["min"], r["max"]
+    elif x.size < _DEVICE_THRESHOLD:
         x64 = np.asarray(x, dtype=np.float64)
         mean = x64.mean(axis=0)
         var = ((x64 - mean) ** 2).sum(axis=0) / max(x.shape[0] - 1, 1)
@@ -91,7 +111,18 @@ def correlation_matrix(x: np.ndarray, y: np.ndarray | None = None) -> np.ndarray
     normalize to 0 and flag them via the variance rule instead).
     """
     m = np.column_stack([x, y]) if y is not None else x
-    if m.size < _DEVICE_THRESHOLD:
+    mesh = _stats_mesh(m.size)
+    if mesh is not None:
+        # distributed: centered gram matrix via shard_map + psum (centering
+        # before the f32 matmul avoids raw-moment cancellation)
+        from ..parallel.reductions import pcentered_gram
+
+        g, _, n = pcentered_gram(m, mesh)
+        cov = g / max(n - 1.0, 1.0)
+        std = np.sqrt(np.maximum(np.diag(cov), 0.0))
+        denom = np.outer(std, std)
+        corr = cov / np.where(denom == 0, 1.0, denom)
+    elif m.size < _DEVICE_THRESHOLD:
         corr, std = _corr_numpy(np.asarray(m, dtype=np.float64))
     else:
         corr, std = _corr_kernel(jnp.asarray(m, dtype=jnp.float32))
@@ -123,6 +154,11 @@ def spearman_correlation_matrix(x: np.ndarray, y: np.ndarray | None = None) -> n
 def contingency_table(group_cols: np.ndarray, label_onehot: np.ndarray) -> np.ndarray:
     """[K, C] contingency of K category-indicator columns vs C label classes —
     a single matmul Gᵀ·Y (OpStatistics.contingencyStats input)."""
+    mesh = _stats_mesh(group_cols.size + label_onehot.size)
+    if mesh is not None:
+        from ..parallel.reductions import pcontingency
+
+        return pcontingency(group_cols, label_onehot, mesh)
     if group_cols.size + label_onehot.size < _DEVICE_THRESHOLD:
         return np.asarray(group_cols, dtype=np.float64).T @ np.asarray(
             label_onehot, dtype=np.float64
