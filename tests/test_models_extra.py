@@ -1,0 +1,221 @@
+"""NaiveBayes / LinearSVC / GLM / Isotonic / BinScore tests.
+
+Parity model: core/src/test/.../classification/OpNaiveBayesTest.scala,
+OpLinearSVCTest.scala, regression/OpGeneralizedLinearRegressionTest.scala,
+IsotonicRegressionCalibratorTest.scala, evaluators/OpBinScoreEvaluatorTest.scala.
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.evaluators import BinScoreEvaluator
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import (
+    GeneralizedLinearRegression,
+    IsotonicRegressionCalibrator,
+    LinearSVC,
+    NaiveBayes,
+)
+from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+
+
+def _sep_data(n=200, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (x @ w > 0).astype(np.float32)
+    return x, y
+
+
+def test_linear_svc_separable():
+    x, y = _sep_data()
+    m = LinearSVC(reg_param=0.01).fit_arrays(x, y, np.ones(len(y), np.float32))
+    pred, prob, raw = m.predict_arrays(x)
+    assert prob is None and raw.shape == (len(y), 2)
+    assert (pred == y).mean() > 0.95
+
+
+def test_naive_bayes_multinomial():
+    rng = np.random.default_rng(1)
+    n = 300
+    y = rng.integers(0, 2, n).astype(np.float32)
+    # class-dependent count features (non-negative)
+    rates = np.array([[5.0, 1.0, 1.0], [1.0, 1.0, 5.0]])
+    x = rng.poisson(rates[y.astype(int)]).astype(np.float32)
+    m = NaiveBayes().fit_arrays(x, y, np.ones(n, np.float32))
+    pred, prob, raw = m.predict_arrays(x)
+    assert prob.shape == (n, 2)
+    np.testing.assert_allclose(prob.sum(1), 1.0, atol=1e-9)
+    assert (pred == y).mean() > 0.85
+
+
+def test_naive_bayes_rejects_negative():
+    x = np.array([[1.0, -1.0]], dtype=np.float32)
+    y = np.array([0.0], dtype=np.float32)
+    with pytest.raises(ValueError, match="non-negative"):
+        NaiveBayes().fit_arrays(x, y, np.ones(1, np.float32))
+
+
+def test_naive_bayes_bernoulli():
+    rng = np.random.default_rng(2)
+    n = 400
+    y = rng.integers(0, 2, n).astype(np.float32)
+    p = np.where(y[:, None] > 0, 0.8, 0.2)
+    x = (rng.random((n, 3)) < p).astype(np.float32)
+    m = NaiveBayes(model_kind="bernoulli").fit_arrays(x, y, np.ones(n, np.float32))
+    pred, prob, _ = m.predict_arrays(x)
+    assert (pred == y).mean() > 0.8
+
+
+@pytest.mark.parametrize("family,link", [
+    ("gaussian", "identity"),
+    ("poisson", "log"),
+    ("gamma", "log"),
+    ("binomial", "logit"),
+])
+def test_glm_families_recover_signal(family, link):
+    rng = np.random.default_rng(3)
+    n, d = 3000, 3
+    x = rng.normal(size=(n, d)).astype(np.float32) * 0.3
+    w = np.array([0.5, -0.4, 0.3])
+    eta = x @ w + 0.2
+    if family == "gaussian":
+        y = eta + rng.normal(scale=0.05, size=n)
+    elif family == "poisson":
+        y = rng.poisson(np.exp(eta)).astype(np.float64)
+    elif family == "gamma":
+        mu = np.exp(eta)
+        y = rng.gamma(shape=20.0, scale=mu / 20.0)
+    else:
+        y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(np.float64)
+    est = GeneralizedLinearRegression(family=family, link=link)
+    m = est.fit_arrays(x.astype(np.float32), y.astype(np.float32),
+                       np.ones(n, np.float32))
+    mu_hat, _, _ = m.predict_arrays(x)
+    assert np.isfinite(mu_hat).all()
+    corr = np.corrcoef(mu_hat, eta)[0, 1]
+    assert corr > 0.8, f"{family}/{link} fit failed: corr={corr}"
+
+
+def test_glm_gaussian_matches_ols():
+    rng = np.random.default_rng(4)
+    n, d = 200, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5])
+    y = (x @ w + 3.0).astype(np.float32)
+    m = GeneralizedLinearRegression().fit_arrays(x, y, np.ones(n, np.float32))
+    np.testing.assert_allclose(m.weights, w, atol=1e-3)
+    assert abs(m.intercept - 3.0) < 1e-3
+
+
+def test_isotonic_calibrator_monotone():
+    # classic: noisy monotone scores; PAV output must be non-decreasing
+    rng = np.random.default_rng(5)
+    n = 100
+    score = np.sort(rng.random(n))
+    label = (score + rng.normal(scale=0.2, size=n) > 0.5).astype(np.float64)
+    ds = Dataset.of({
+        "label": NumericColumn(T.RealNN, label, np.ones(n, bool)),
+        "score": NumericColumn(T.RealNN, score, np.ones(n, bool)),
+    })
+    lbl = FeatureBuilder.RealNN("label").as_response()
+    sc = FeatureBuilder.RealNN("score").as_predictor()
+    est = IsotonicRegressionCalibrator().set_input(lbl, sc)
+    model = est.fit(ds)
+    out = model.transform(ds)[model.output_name]
+    vals = out.values
+    assert (np.diff(vals) >= -1e-12).all()
+    assert vals.min() >= 0.0 and vals.max() <= 1.0
+
+
+def test_isotonic_simple_pav():
+    # Spark IsotonicRegressionTest-style fixture: y = (1,2,3) with violation
+    y = np.array([3.0, 1.0, 2.0])
+    s = np.array([1.0, 2.0, 3.0])
+    ds = Dataset.of({
+        "label": NumericColumn(T.RealNN, y, np.ones(3, bool)),
+        "score": NumericColumn(T.RealNN, s, np.ones(3, bool)),
+    })
+    lbl = FeatureBuilder.RealNN("label").as_response()
+    sc = FeatureBuilder.RealNN("score").as_predictor()
+    model = IsotonicRegressionCalibrator().set_input(lbl, sc).fit(ds)
+    out = model.transform(ds)[model.output_name].values
+    assert (np.diff(out) >= -1e-12).all()
+    np.testing.assert_allclose(out.sum(), y.sum(), atol=1e-9)
+
+
+def test_antitonic_calibrator():
+    n = 50
+    score = np.linspace(0, 1, n)
+    label = 1.0 - score  # perfectly decreasing
+    ds = Dataset.of({
+        "label": NumericColumn(T.RealNN, label, np.ones(n, bool)),
+        "score": NumericColumn(T.RealNN, score, np.ones(n, bool)),
+    })
+    lbl = FeatureBuilder.RealNN("label").as_response()
+    sc = FeatureBuilder.RealNN("score").as_predictor()
+    model = IsotonicRegressionCalibrator(isotonic=False).set_input(lbl, sc).fit(ds)
+    out = model.transform(ds)[model.output_name].values
+    assert (np.diff(out) <= 1e-12).all()
+    np.testing.assert_allclose(out, label, atol=1e-9)
+
+
+def test_bin_score_evaluator():
+    # OpBinScoreEvaluatorTest.scala-style: 4 points, 4 bins
+    y = np.array([1.0, 0.0, 1.0, 0.0])
+    prob = np.array([[0.01, 0.99], [0.99, 0.01], [0.3, 0.7], [0.6, 0.4]])
+    ev = BinScoreEvaluator(num_bins=4)
+    m = ev.evaluate_arrays(y, prob[:, 1] > 0.5, prob)
+    assert m["BrierScore"] == pytest.approx(
+        np.mean((prob[:, 1] - y) ** 2)
+    )
+    assert len(m["binCenters"]) == 4
+    assert sum(m["numberOfDataPoints"]) == 4
+    assert not ev.is_larger_better
+
+
+def test_bin_score_constant_scores():
+    y = np.array([1.0, 0.0])
+    prob = np.array([[0.5, 0.5], [0.5, 0.5]])
+    m = BinScoreEvaluator(num_bins=10).evaluate_arrays(y, y, prob)
+    assert m["numberOfDataPoints"][0] == 2
+
+
+def test_persistence_roundtrip_new_models(tmp_path):
+    """New model families survive the manifest+npz round trip."""
+    from transmogrifai_tpu.workflow.persistence import construct_stage
+
+    x, y = _sep_data()
+    svc = LinearSVC(reg_param=0.01).fit_arrays(x, y, np.ones(len(y), np.float32))
+    re = construct_stage("LinearSVCModel", svc.get_params(), svc.get_arrays())
+    np.testing.assert_allclose(re.weights, svc.weights)
+
+    glm = GeneralizedLinearRegression(family="poisson").fit_arrays(
+        x, np.abs(y).astype(np.float32), np.ones(len(y), np.float32))
+    re2 = construct_stage("GeneralizedLinearRegressionModel",
+                          glm.get_params(), glm.get_arrays())
+    assert re2.family == "poisson" and re2.link == "log"
+
+
+def test_make_candidates_expands_names():
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, make_candidates,
+    )
+    cands = make_candidates("BinaryClassification", ["OpNaiveBayes", "OpLinearSVC"])
+    assert len(cands) == 2
+    est, grid = cands[1]
+    assert isinstance(est, LinearSVC) and "reg_param" in grid
+    sel = BinaryClassificationModelSelector(models=cands)
+    assert len(sel.models) == 2
+    with pytest.raises(ValueError, match="not a Regression model"):
+        make_candidates("Regression", ["OpNaiveBayes"])
+
+
+def test_svc_standardization_flag_changes_fit():
+    x, y = _sep_data()
+    x = x * np.array([10.0, 0.1, 1.0, 1.0], dtype=np.float32)  # uneven scales
+    m_std = LinearSVC(reg_param=0.5).fit_arrays(x, y, np.ones(len(y), np.float32))
+    m_raw = LinearSVC(reg_param=0.5, standardization=False).fit_arrays(
+        x, y, np.ones(len(y), np.float32))
+    assert not np.allclose(m_std.weights, m_raw.weights)

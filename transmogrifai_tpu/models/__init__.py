@@ -2,7 +2,11 @@
 from .base import PredictorEstimator, PredictorModel  # noqa: F401
 from .logistic import LogisticRegression  # noqa: F401
 from .linear import LinearRegression  # noqa: F401
+from .glm import GeneralizedLinearRegression  # noqa: F401
 from .mlp import MLPClassifier  # noqa: F401
+from .naive_bayes import NaiveBayes  # noqa: F401
+from .svc import LinearSVC  # noqa: F401
+from .isotonic import IsotonicRegressionCalibrator  # noqa: F401
 from .gbdt import (  # noqa: F401
     DecisionTreeClassifier,
     DecisionTreeRegressor,

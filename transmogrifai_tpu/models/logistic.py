@@ -90,7 +90,7 @@ class LogisticRegression(PredictorEstimator):
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         # FISTA needs more iterations than Newton for tight convergence;
         # scale the budget (maxIter is the Spark-semantic knob).
-        iters = max(self.max_iter * 4, 200)
+        iters = self.max_iter * 4
         if num_classes == 2:
             params = fit_logistic_binary(
                 x,
@@ -134,7 +134,7 @@ class LogisticRegression(PredictorEstimator):
         rest = [i for i in range(len(grid_points)) if i not in vmappable]
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
-        iters = max(self.max_iter * 4, 200)
+        iters = self.max_iter * 4
         models: dict[int, LogisticRegressionModel] = {}
         if vmappable:
             regs = np.asarray(

@@ -1,0 +1,105 @@
+"""Naive Bayes classifier (multinomial + bernoulli).
+
+Reference: core/.../stages/impl/classification/OpNaiveBayes.scala wraps Spark
+NaiveBayes (modelType multinomial|bernoulli, smoothing=1.0). The fit is one
+matmul on the MXU: per-class feature sums are ``one_hot(y).T @ x`` — the
+Spark ``treeAggregate`` becomes an XLA reduction that psums over the data
+mesh axis when sharded.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import PredictorEstimator, PredictorModel
+
+
+@partial(jax.jit, static_argnames=("num_classes", "bernoulli"))
+def _fit_nb(x, y, row_mask, smoothing, num_classes: int, bernoulli: bool):
+    row_mask = row_mask.astype(x.dtype)
+    y1h = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=x.dtype)
+    y1h = y1h * row_mask[:, None]
+    class_count = y1h.sum(0)                       # [C]
+    pi = jnp.log(class_count + smoothing) - jnp.log(
+        class_count.sum() + smoothing * num_classes
+    )
+    xb = (x > 0).astype(x.dtype) if bernoulli else x
+    feat_sum = y1h.T @ xb                          # [C, D]
+    if bernoulli:
+        theta = jnp.log(feat_sum + smoothing) - jnp.log(
+            (class_count + 2.0 * smoothing)[:, None]
+        )
+    else:
+        theta = jnp.log(feat_sum + smoothing) - jnp.log(
+            (feat_sum.sum(1) + smoothing * x.shape[1])[:, None]
+        )
+    return pi, theta
+
+
+class NaiveBayesModel(PredictorModel):
+    def __init__(self, pi, theta, model_kind: str = "multinomial", uid=None):
+        super().__init__("naiveBayes", uid=uid)
+        self.pi = np.asarray(pi, dtype=np.float64)        # [C]
+        self.theta = np.asarray(theta, dtype=np.float64)  # [C, D]
+        self.model_kind = model_kind
+
+    def get_arrays(self):
+        return {"pi": self.pi, "theta": self.theta}
+
+    def get_params(self):
+        return {"model_kind": self.model_kind}
+
+    @classmethod
+    def from_params(cls, params, arrays):
+        return cls(arrays["pi"], arrays["theta"], params.get("model_kind", "multinomial"))
+
+    def predict_arrays(self, x: np.ndarray):
+        if self.model_kind == "bernoulli":
+            # Spark bernoulli scoring: x must be 0/1; score = pi + x·theta +
+            # (1-x)·log(1 - e^theta)
+            xb = (x > 0).astype(np.float64)
+            neg = np.log1p(-np.minimum(np.exp(self.theta), 1.0 - 1e-12))
+            raw = self.pi + xb @ self.theta.T + (1.0 - xb) @ neg.T
+        else:
+            raw = self.pi + x @ self.theta.T
+        shifted = raw - raw.max(axis=1, keepdims=True)
+        e = np.exp(shifted)
+        prob = e / e.sum(axis=1, keepdims=True)
+        pred = raw.argmax(axis=1).astype(np.float64)
+        return pred, prob, raw
+
+
+class NaiveBayes(PredictorEstimator):
+    """Spark defaults: smoothing=1.0, modelType='multinomial'
+    (OpNaiveBayes.scala). Features must be non-negative (count-like)."""
+
+    model_type = "OpNaiveBayes"
+
+    def __init__(self, smoothing: float = 1.0, model_kind: str = "multinomial",
+                 uid: str | None = None):
+        super().__init__("naiveBayes", uid=uid)
+        if model_kind not in ("multinomial", "bernoulli"):
+            raise ValueError(f"unknown modelType {model_kind}")
+        self.smoothing = smoothing
+        self.model_kind = model_kind
+
+    def get_params(self):
+        return {"smoothing": self.smoothing, "model_kind": self.model_kind}
+
+    def fit_arrays(self, x, y, row_mask):
+        present = y[row_mask > 0]
+        num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
+        if np.any(x[np.asarray(row_mask) > 0] < 0):
+            raise ValueError(
+                "NaiveBayes requires non-negative feature values "
+                "(Spark NaiveBayes semantics)"
+            )
+        pi, theta = _fit_nb(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(row_mask),
+            jnp.asarray(self.smoothing, dtype=jnp.float32),
+            num_classes=num_classes, bernoulli=self.model_kind == "bernoulli",
+        )
+        return NaiveBayesModel(pi, theta, self.model_kind)

@@ -11,11 +11,35 @@ pool (OpValidator.scala:363-367) becomes one compiled sweep.
 """
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
 from .mesh import MODEL_AXIS, pad_rows, shard_grid, shard_rows
+
+
+# one jitted wrapper per (solver, mesh, static kwargs) — rebuilding jax.jit
+# per call would recompile every fit (see reductions.py kernel caches)
+@lru_cache(maxsize=None)
+def _jitted_fit(fit_fn, _mesh, static_names: tuple):
+    import jax
+
+    return jax.jit(fit_fn, static_argnames=static_names)
+
+
+@lru_cache(maxsize=None)
+def _jitted_sweep(fit_fn, _mesh, static_items: tuple):
+    import jax
+
+    static_kwargs = dict(static_items)
+
+    def sweep(xx, yy, mm, *grid):
+        return jax.vmap(
+            lambda *gp: fit_fn(xx, yy, mm, *gp, **static_kwargs)
+        )(*grid)
+
+    return jax.jit(sweep)
 
 
 def data_parallel_fit(
@@ -30,14 +54,12 @@ def data_parallel_fit(
     """Run ``fit_fn(x, y, row_mask, *args, **kwargs)`` with rows sharded over
     the mesh's data axis. Padding rows get row_mask 0, so any solver that
     weights by row_mask (all of models/solvers.py) is unaffected."""
-    import jax
-
     n_shards = int(np.prod(list(mesh.shape.values()))) // mesh.shape[MODEL_AXIS]
     xp, n = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
     yp, _ = pad_rows(np.asarray(y, dtype=np.float32), n_shards)
     mp, _ = pad_rows(np.asarray(row_mask, dtype=np.float32), n_shards)
     with mesh:
-        return jax.jit(fit_fn, static_argnames=tuple(kwargs))(
+        return _jitted_fit(fit_fn, mesh, tuple(kwargs))(
             shard_rows(mesh, xp),
             shard_rows(mesh, yp),
             shard_rows(mesh, mp),
@@ -77,13 +99,9 @@ def grid_parallel_fit(
     yp, _ = pad_rows(np.asarray(y, dtype=np.float32), n_data)
     mp, _ = pad_rows(np.asarray(row_mask, dtype=np.float32), n_data)
 
-    def sweep(xx, yy, mm, *grid):
-        return jax.vmap(
-            lambda *gp: fit_fn(xx, yy, mm, *gp, **static_kwargs)
-        )(*grid)
-
+    sweep = _jitted_sweep(fit_fn, mesh, tuple(sorted(static_kwargs.items())))
     with mesh:
-        out = jax.jit(sweep, static_argnames=())(
+        out = sweep(
             shard_rows(mesh, xp),
             shard_rows(mesh, yp),
             shard_rows(mesh, mp),

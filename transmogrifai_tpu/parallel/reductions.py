@@ -12,7 +12,7 @@ padding is either monoid-neutral (zeros for sums) or masked via ``n_valid``.
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -25,26 +25,16 @@ def _data_spec(*trailing):
     return P(DATA_AXIS, *trailing)
 
 
-def pcolumn_stats(x: np.ndarray, mesh) -> dict[str, np.ndarray]:
-    """Per-column count/mean/centered-M2/min/max over a row-sharded matrix.
-
-    Mirrors Statistics.colStats (used by SanityChecker.scala:464) as a
-    psum/pmin/pmax tree over the mesh's data axis. Two passes — sums first,
-    then CENTERED squared deviations — because device arithmetic is float32
-    and raw-moment variance (sumsq - n·mean²) catastrophically cancels for
-    columns with |mean| >> std. Padding rows are excluded via the
-    row-validity weight column appended internally.
-    """
+# Jitted shard_map kernels are built once per mesh (jax.sharding.Mesh is
+# hashable) and reused — a fresh closure + jax.jit per call would retrace and
+# recompile on every reduction, costing SanityChecker/RawFeatureFilter
+# hundreds of ms per stats call. jit's own cache handles per-shape variants.
+@lru_cache(maxsize=None)
+def _stats_kernels(mesh):
     import jax
     import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
-
-    n_shards = mesh.shape[DATA_AXIS]
-    xp, n = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
-    valid = np.zeros((xp.shape[0], 1), dtype=np.float32)
-    valid[:n] = 1.0
-    xp = np.concatenate([xp, valid], axis=1)
 
     @partial(
         shard_map,
@@ -79,11 +69,31 @@ def pcolumn_stats(x: np.ndarray, mesh) -> dict[str, np.ndarray]:
         c = (xs[:, :-1] - mean[None, :]) * v
         return jax.lax.psum((c * c).sum(axis=0), DATA_AXIS)
 
+    return jax.jit(pass1), jax.jit(pass2)
+
+
+def pcolumn_stats(x: np.ndarray, mesh) -> dict[str, np.ndarray]:
+    """Per-column count/mean/centered-M2/min/max over a row-sharded matrix.
+
+    Mirrors Statistics.colStats (used by SanityChecker.scala:464) as a
+    psum/pmin/pmax tree over the mesh's data axis. Two passes — sums first,
+    then CENTERED squared deviations — because device arithmetic is float32
+    and raw-moment variance (sumsq - n·mean²) catastrophically cancels for
+    columns with |mean| >> std. Padding rows are excluded via the
+    row-validity weight column appended internally.
+    """
+    n_shards = mesh.shape[DATA_AXIS]
+    xp, n = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
+    valid = np.zeros((xp.shape[0], 1), dtype=np.float32)
+    valid[:n] = 1.0
+    xp = np.concatenate([xp, valid], axis=1)
+
+    pass1, pass2 = _stats_kernels(mesh)
     xs = shard_rows(mesh, xp)
-    cnt, s, mn, mx = jax.jit(pass1)(xs)
+    cnt, s, mn, mx = pass1(xs)
     cnt_f = float(np.asarray(cnt))
     mean = np.asarray(s, dtype=np.float64) / max(cnt_f, 1.0)
-    m2 = jax.jit(pass2)(xs, mean.astype(np.float32))
+    m2 = pass2(xs, mean.astype(np.float32))
     return {
         "count": np.asarray(cnt),
         "mean": mean,
@@ -101,15 +111,25 @@ def pcentered_gram(x: np.ndarray, mesh) -> tuple[np.ndarray, np.ndarray, float]:
     raw-moment XᵀX would cancel (see pcolumn_stats). One MXU matmul + psum
     per pass over ICI.
     """
-    import jax
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
     n_shards = mesh.shape[DATA_AXIS]
     xp, n = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
     valid = np.zeros((xp.shape[0], 1), dtype=np.float32)
     valid[:n] = 1.0
     xp = np.concatenate([xp, valid], axis=1)
+
+    sums, gram = _gram_kernels(mesh)
+    xs = shard_rows(mesh, xp)
+    s = np.asarray(sums(xs), dtype=np.float64)
+    mean = s / max(n, 1)
+    g = np.asarray(gram(xs, mean.astype(np.float32)), dtype=np.float64)
+    return g, mean, float(n)
+
+
+@lru_cache(maxsize=None)
+def _gram_kernels(mesh):
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
 
     @partial(
         shard_map,
@@ -134,11 +154,7 @@ def pcentered_gram(x: np.ndarray, mesh) -> tuple[np.ndarray, np.ndarray, float]:
         c = (xs[:, :-1] - mean[None, :]) * v
         return jax.lax.psum(c.T @ c, DATA_AXIS)
 
-    xs = shard_rows(mesh, xp)
-    s = np.asarray(jax.jit(sums)(xs), dtype=np.float64)
-    mean = s / max(n, 1)
-    g = np.asarray(jax.jit(gram)(xs, mean.astype(np.float32)), dtype=np.float64)
-    return g, mean, float(n)
+    return jax.jit(sums), jax.jit(gram)
 
 
 def pxtx(x: np.ndarray, mesh) -> np.ndarray:
@@ -148,12 +164,16 @@ def pxtx(x: np.ndarray, mesh) -> np.ndarray:
     and feature-feature correlation matrix, SanityChecker.scala:464-470).
     Zero padding rows are monoid-neutral.
     """
+    n_shards = mesh.shape[DATA_AXIS]
+    xp, _ = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
+    return np.asarray(_xtx_kernel(mesh)(shard_rows(mesh, xp)), dtype=np.float64)
+
+
+@lru_cache(maxsize=None)
+def _xtx_kernel(mesh):
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
-
-    n_shards = mesh.shape[DATA_AXIS]
-    xp, _ = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
 
     @partial(
         shard_map,
@@ -165,7 +185,7 @@ def pxtx(x: np.ndarray, mesh) -> np.ndarray:
     def body(xs):
         return jax.lax.psum(xs.T @ xs, DATA_AXIS)
 
-    return np.asarray(jax.jit(body)(shard_rows(mesh, xp)), dtype=np.float64)
+    return jax.jit(body)
 
 
 def phistogram(
@@ -175,11 +195,6 @@ def phistogram(
     psum (RawFeatureFilter's FeatureDistribution bins, the GBDT histogram
     primitive). codes [N, F] int32 in [0, num_bins); rows with code < 0 are
     skipped (doubles as the padding mask)."""
-    import jax
-    import jax.numpy as jnp
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
     n_shards = mesh.shape[DATA_AXIS]
     codes = np.asarray(codes, dtype=np.int32)
     cp, n = pad_rows(codes + 1, n_shards)  # padding rows become code 0 = skip
@@ -189,6 +204,16 @@ def phistogram(
     else:
         w = np.asarray(weights, dtype=np.float32)
     wp, _ = pad_rows(w, n_shards)
+    body = _hist_kernel(mesh, num_bins)
+    return np.asarray(body(shard_rows(mesh, cp), shard_rows(mesh, wp)))
+
+
+@lru_cache(maxsize=None)
+def _hist_kernel(mesh, num_bins: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
 
     @partial(
         shard_map,
@@ -203,7 +228,7 @@ def phistogram(
         hist = jnp.einsum("nf,nfb->fb", valid, onehot)
         return jax.lax.psum(hist, DATA_AXIS)
 
-    return np.asarray(jax.jit(body)(shard_rows(mesh, cp), shard_rows(mesh, wp)))
+    return jax.jit(body)
 
 
 #: rows per device round for pcontingency: float32 cell counts are exact up
@@ -223,23 +248,8 @@ def pcontingency(
     Counts within one device round stay below float32's 2^24 integer limit;
     rounds are summed in float64 host-side, so large-N tables are exact.
     """
-    import jax
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
     n_shards = mesh.shape[DATA_AXIS]
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(_data_spec(None), _data_spec(None)),
-        out_specs=P(),
-        check_vma=False,
-    )
-    def body(gs, ls):
-        return jax.lax.psum(gs.T @ ls, DATA_AXIS)
-
-    fn = jax.jit(body)
+    fn = _contingency_kernel(mesh)
     total = np.zeros(
         (group_onehot.shape[1], label_onehot.shape[1]), dtype=np.float64
     )
@@ -253,3 +263,22 @@ def pcontingency(
         )
         total += np.asarray(fn(shard_rows(mesh, gp), shard_rows(mesh, lp)))
     return total
+
+
+@lru_cache(maxsize=None)
+def _contingency_kernel(mesh):
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(_data_spec(None), _data_spec(None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def body(gs, ls):
+        return jax.lax.psum(gs.T @ ls, DATA_AXIS)
+
+    return jax.jit(body)

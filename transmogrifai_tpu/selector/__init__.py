@@ -1,8 +1,12 @@
 """Model selection (reference: core/.../stages/impl/selector/)."""
 from .validators import CrossValidator, TrainValidationSplit  # noqa: F401
 from .model_selector import (  # noqa: F401
+    BINARY_CLASSIFICATION_MODELS,
     BinaryClassificationModelSelector,
     ModelSelector,
+    MULTI_CLASSIFICATION_MODELS,
     MultiClassificationModelSelector,
+    REGRESSION_MODELS,
     RegressionModelSelector,
+    make_candidates,
 )

@@ -27,13 +27,21 @@ from ..evaluators import (
 )
 from ..models.base import PredictorEstimator, PredictorModel
 from ..models.gbdt import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GBTClassifier,
     GBTRegressor,
     RandomForestClassifier,
     RandomForestRegressor,
     XGBoostClassifier,
+    XGBoostRegressor,
 )
+from ..models.glm import GeneralizedLinearRegression
 from ..models.linear import LinearRegression
 from ..models.logistic import LogisticRegression
+from ..models.mlp import MLPClassifier
+from ..models.naive_bayes import NaiveBayes
+from ..models.svc import LinearSVC
 from ..prep.splitters import DataBalancer, DataCutter, DataSplitter
 from .validators import CrossValidator, TrainValidationSplit, Validator
 
@@ -54,6 +62,96 @@ XGB_ETA = [0.02]
 XGB_MIN_CHILD_WEIGHT = [1.0, 10.0]
 XGB_MAX_DEPTH_BINARY = [10]
 XGB_GAMMA_BINARY = [0.8]
+
+
+# Full candidate enums (BinaryClassificationModelsToTry / MultiClassification /
+# RegressionModelsToTry — *ModelSelector.scala full enums; entries beyond the
+# defaults are opt-in via ``make_candidates(problem, names)``, which expands
+# each name to an (estimator instance, default grid) pair accepted by the
+# selectors' ``models=`` argument).
+BINARY_CLASSIFICATION_MODELS = {
+    "OpLogisticRegression": LogisticRegression,
+    "OpRandomForestClassifier": RandomForestClassifier,
+    "OpXGBoostClassifier": XGBoostClassifier,
+    "OpGBTClassifier": GBTClassifier,
+    "OpDecisionTreeClassifier": DecisionTreeClassifier,
+    "OpNaiveBayes": NaiveBayes,
+    "OpLinearSVC": LinearSVC,
+    "OpMultilayerPerceptronClassifier": MLPClassifier,
+}
+MULTI_CLASSIFICATION_MODELS = {
+    "OpLogisticRegression": LogisticRegression,
+    "OpRandomForestClassifier": RandomForestClassifier,
+    "OpXGBoostClassifier": XGBoostClassifier,
+    "OpDecisionTreeClassifier": DecisionTreeClassifier,
+    "OpNaiveBayes": NaiveBayes,
+    "OpMultilayerPerceptronClassifier": MLPClassifier,
+}
+REGRESSION_MODELS = {
+    "OpLinearRegression": LinearRegression,
+    "OpRandomForestRegressor": RandomForestRegressor,
+    "OpGBTRegressor": GBTRegressor,
+    "OpXGBoostRegressor": XGBoostRegressor,
+    "OpDecisionTreeRegressor": DecisionTreeRegressor,
+    "OpGeneralizedLinearRegression": GeneralizedLinearRegression,
+}
+
+
+def make_candidates(
+    problem_kind: str, names: Sequence[str]
+) -> list[tuple["PredictorEstimator", dict[str, Sequence[Any]]]]:
+    """Expand reference model-enum names into (estimator, default grid) pairs
+    for the selectors' ``models=`` argument, e.g.
+    ``BinaryClassificationModelSelector(models=make_candidates(
+    "BinaryClassification", ["OpNaiveBayes", "OpLinearSVC"]))``."""
+    catalog = {
+        "BinaryClassification": BINARY_CLASSIFICATION_MODELS,
+        "MultiClassification": MULTI_CLASSIFICATION_MODELS,
+        "Regression": REGRESSION_MODELS,
+    }.get(problem_kind)
+    if catalog is None:
+        raise ValueError(f"unknown problem kind {problem_kind!r}")
+    out = []
+    for name in names:
+        cls = catalog.get(name)
+        if cls is None:
+            raise ValueError(
+                f"{name!r} is not a {problem_kind} model; choose from "
+                f"{sorted(catalog)}"
+            )
+        out.append((cls(), _default_grid_for(cls)))
+    return out
+
+
+def _default_grid_for(cls: type) -> dict[str, Sequence[Any]]:
+    grids: dict[type, dict[str, Sequence[Any]]] = {
+        LogisticRegression: _lr_grid(),
+        LinearRegression: _lr_grid(),
+        RandomForestClassifier: _rf_grid(),
+        RandomForestRegressor: _rf_grid(),
+        GBTClassifier: _gbt_grid(),
+        GBTRegressor: _gbt_grid(),
+        XGBoostClassifier: _xgb_binary_grid(),
+        XGBoostRegressor: _xgb_binary_grid(),
+        DecisionTreeClassifier: {
+            "max_depth": MAX_DEPTH,
+            "min_info_gain": MIN_INFO_GAIN,
+            "min_instances_per_node": MIN_INSTANCES,
+        },
+        DecisionTreeRegressor: {
+            "max_depth": MAX_DEPTH,
+            "min_info_gain": MIN_INFO_GAIN,
+            "min_instances_per_node": MIN_INSTANCES,
+        },
+        NaiveBayes: {"smoothing": [1.0]},
+        LinearSVC: {"reg_param": REGULARIZATION, "max_iter": MAX_ITER_LIN},
+        MLPClassifier: {},
+        GeneralizedLinearRegression: {
+            "family": ["gaussian", "poisson", "gamma"],
+            "reg_param": REGULARIZATION,
+        },
+    }
+    return grids.get(cls, {})
 
 
 def _lr_grid() -> dict[str, Sequence[Any]]:

@@ -44,7 +44,7 @@ def _registry() -> dict[str, type]:
         return _REGISTRY
     _BUILTINS_POPULATED = True
     from ..insights import loco
-    from ..models import gbdt, linear, logistic, mlp
+    from ..models import glm, gbdt, isotonic, linear, logistic, mlp, naive_bayes, svc
     from ..models.base import PredictorModel
     from ..ops import (
         categorical, combiner, dates, lists, maps, numeric, phone, text,
@@ -53,7 +53,8 @@ def _registry() -> dict[str, type]:
     from ..selector import model_selector
 
     for module in (
-        gbdt, linear, logistic, mlp, categorical, combiner, dates, lists,
+        glm, gbdt, isotonic, linear, logistic, mlp, naive_bayes, svc,
+        categorical, combiner, dates, lists,
         maps, numeric, phone, text, derived_filter, sanity_checker,
         model_selector, loco,
     ):
