@@ -1,0 +1,303 @@
+"""Aggregate/Conditional/Joined reader + monoid aggregator tests.
+
+Mirrors the reference's reader suites (readers/src/test/.../DataReaderTest,
+JoinedDataReaderDataGenerationTest) and aggregator semantics
+(features/src/test/.../aggregators/*)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.features.aggregators import (
+    CombineVector,
+    ConcatList,
+    ConcatText,
+    CustomMonoidAggregator,
+    FirstAggregator,
+    GeolocationMidpoint,
+    LastAggregator,
+    LogicalOr,
+    MaxNumeric,
+    MeanNumeric,
+    ModeText,
+    SumNumeric,
+    SumVector,
+    UnionMap,
+    UnionSet,
+    aggregator_of,
+)
+from transmogrifai_tpu.readers import (
+    AggregateParams,
+    AggregateReader,
+    ConditionalParams,
+    ConditionalReader,
+    CutOffTime,
+    JoinKeys,
+    JoinType,
+    SimpleReader,
+    TimeStampToKeep,
+    join_datasets,
+)
+
+
+# ---------------------------------------------------------------- aggregators
+class TestAggregatorDefaults:
+    def test_registry_families(self):
+        assert isinstance(aggregator_of(T.Real), SumNumeric)
+        assert isinstance(aggregator_of(T.RealNN), SumNumeric)
+        assert isinstance(aggregator_of(T.Currency), SumNumeric)
+        assert isinstance(aggregator_of(T.Integral), SumNumeric)
+        assert isinstance(aggregator_of(T.Percent), MeanNumeric)
+        assert isinstance(aggregator_of(T.Date), MaxNumeric)
+        assert isinstance(aggregator_of(T.DateTime), MaxNumeric)
+        assert isinstance(aggregator_of(T.Binary), LogicalOr)
+        assert isinstance(aggregator_of(T.PickList), ModeText)
+        assert isinstance(aggregator_of(T.Text), ConcatText)
+        assert isinstance(aggregator_of(T.MultiPickList), UnionSet)
+        assert isinstance(aggregator_of(T.TextList), ConcatList)
+        assert isinstance(aggregator_of(T.Geolocation), GeolocationMidpoint)
+        assert isinstance(aggregator_of(T.OPVector), CombineVector)
+        assert isinstance(aggregator_of(T.RealMap), UnionMap)
+
+    def test_sum_and_none(self):
+        agg = SumNumeric()
+        assert agg([1.0, None, 2.5]) == 3.5
+        assert agg([None, None]) is None
+
+    def test_mean_percent_clamps(self):
+        agg = MeanNumeric(is_percent=True)
+        # -1 -> 0, 0.5 -> 0.5, 50 -> 0.5, 1000 -> 1.0
+        assert agg([-1.0, 0.5, 50.0, 1000.0]) == pytest.approx((0 + 0.5 + 0.5 + 1.0) / 4)
+
+    def test_mode_tie_breaks_lexicographic(self):
+        agg = ModeText()
+        assert agg(["b", "a", "b", "a"]) == "a"
+        assert agg([None, "z"]) == "z"
+        assert agg([None]) is None
+
+    def test_concat_separators(self):
+        assert ConcatText(" ")(["hello", None, "world"]) == "hello world"
+        assert ConcatText(",")(["a@x.com", "b@y.com"]) == "a@x.com,b@y.com"
+
+    def test_logical_or(self):
+        assert LogicalOr()([False, None, True]) is True
+        assert LogicalOr()([None]) is None
+
+    def test_union_set_and_list(self):
+        assert UnionSet()([{"a"}, None, {"b", "a"}]) == {"a", "b"}
+        assert ConcatList()([[1, 2], None, [3]]) == [1, 2, 3]
+
+    def test_union_real_map_sums_per_key(self):
+        agg = aggregator_of(T.RealMap)
+        out = agg([{"a": 1.0, "b": 2.0}, {"a": 3.0}, None])
+        assert out == {"a": 4.0, "b": 2.0}
+
+    def test_union_binary_map_ors(self):
+        agg = aggregator_of(T.BinaryMap)
+        assert agg([{"x": False}, {"x": True, "y": False}]) == {"x": True, "y": False}
+
+    def test_union_date_map_max(self):
+        agg = aggregator_of(T.DateMap)
+        assert agg([{"d": 5}, {"d": 9, "e": 1}]) == {"d": 9, "e": 1}
+
+    def test_geolocation_midpoint(self):
+        agg = GeolocationMidpoint()
+        # two points on the equator at lon 0 and lon 90 -> midpoint lon 45
+        out = agg([[0.0, 0.0, 1.0], [0.0, 90.0, 1.0]])
+        assert out[0] == pytest.approx(0.0, abs=1e-9)
+        assert out[1] == pytest.approx(45.0)
+        assert agg([None, []]) == []
+
+    def test_vectors(self):
+        assert CombineVector()([[1.0, 2.0], [3.0]]) == [1.0, 2.0, 3.0]
+        assert SumVector()([[1.0, 2.0], [3.0, 4.0]]) == [4.0, 6.0]
+
+    def test_custom_monoid(self):
+        agg = CustomMonoidAggregator(zero=0, plus=lambda a, b: a + b)
+        assert agg([1, 2, 3]) == 6
+
+    def test_last_first(self):
+        last, first = LastAggregator(), FirstAggregator()
+        events = [(5, "mid"), (1, "old"), (9, "new")]
+        acc_l = last.zero
+        acc_f = first.zero
+        for ts, v in events:
+            acc_l = last.plus(acc_l, last.prepare_event(v, ts))
+            acc_f = first.plus(acc_f, first.prepare_event(v, ts))
+        assert last.present(acc_l) == "new"
+        assert first.present(acc_f) == "old"
+
+    def test_order_invariance(self):
+        """Monoid law the TPU reduction relies on (SURVEY.md §2.6)."""
+        rng = np.random.default_rng(0)
+        vals = [float(v) for v in rng.normal(size=20)]
+        for agg in (SumNumeric(), MeanNumeric(), MaxNumeric()):
+            a = agg(vals)
+            b = agg(list(reversed(vals)))
+            assert a == pytest.approx(b)
+
+
+# -------------------------------------------------------------------- readers
+def _events():
+    # (user, ts_ms, amount, tag)
+    return [
+        {"user": "u1", "ts": 100, "amount": 1.0, "tag": "a"},
+        {"user": "u1", "ts": 200, "amount": 2.0, "tag": "b"},
+        {"user": "u1", "ts": 300, "amount": 4.0, "tag": "b"},
+        {"user": "u2", "ts": 150, "amount": 10.0, "tag": "c"},
+        {"user": "u2", "ts": 250, "amount": 20.0, "tag": "c"},
+    ]
+
+
+def _features():
+    amount = (
+        FeatureBuilder.Real("amount").extract(lambda r: r["amount"]).as_predictor()
+    )
+    tag = FeatureBuilder.PickList("tag").extract(lambda r: r["tag"]).as_predictor()
+    label = (
+        FeatureBuilder.RealNN("label").extract(lambda r: r["amount"]).as_response()
+    )
+    return amount, tag, label
+
+
+class TestAggregateReader:
+    def test_no_cutoff_aggregates_everything(self):
+        amount, tag, label = _features()
+        reader = AggregateReader(
+            _events(),
+            key_fn=lambda r: r["user"],
+            aggregate_params=AggregateParams(
+                timestamp_fn=lambda r: r["ts"],
+                cutoff_time=CutOffTime.no_cutoff(),
+            ),
+        )
+        ds = reader.generate_dataset([amount, tag])
+        assert ds["key"].to_list() == ["u1", "u2"]
+        assert ds["amount"].to_list() == [7.0, 30.0]
+        assert ds["tag"].to_list() == ["b", "c"]  # mode
+
+    def test_cutoff_splits_predictor_and_response(self):
+        amount, tag, label = _features()
+        reader = AggregateReader(
+            _events(),
+            key_fn=lambda r: r["user"],
+            aggregate_params=AggregateParams(
+                timestamp_fn=lambda r: r["ts"],
+                cutoff_time=CutOffTime.unix_epoch(200),
+            ),
+        )
+        ds = reader.generate_dataset([amount, label])
+        # predictors: ts < 200 -> u1: 1.0, u2: 10.0
+        assert ds["amount"].to_list() == [1.0, 10.0]
+        # responses: ts >= 200 -> u1: 2+4, u2: 20
+        assert ds["label"].to_list() == [6.0, 20.0]
+
+    def test_predictor_window(self):
+        amount, _, _ = _features()
+        reader = AggregateReader(
+            _events(),
+            key_fn=lambda r: r["user"],
+            aggregate_params=AggregateParams(
+                timestamp_fn=lambda r: r["ts"],
+                cutoff_time=CutOffTime.unix_epoch(301),
+                predictor_window_ms=150,
+            ),
+        )
+        ds = reader.generate_dataset([amount])
+        # window [151, 301): u1 gets 2+4, u2 gets 20
+        assert ds["amount"].to_list() == [6.0, 20.0]
+
+
+class TestConditionalReader:
+    def test_cutoff_at_target_event(self):
+        amount, tag, label = _features()
+        reader = ConditionalReader(
+            _events(),
+            key_fn=lambda r: r["user"],
+            conditional_params=ConditionalParams(
+                timestamp_fn=lambda r: r["ts"],
+                target_condition=lambda r: r["tag"] == "b",
+                timestamp_to_keep=TimeStampToKeep.MIN,
+                response_window_ms=None,
+                predictor_window_ms=None,
+                drop_if_target_condition_not_met=True,
+            ),
+        )
+        ds = reader.generate_dataset([amount, label])
+        # only u1 has tag=="b"; first b at ts=200
+        assert ds["key"].to_list() == ["u1"]
+        assert ds["amount"].to_list() == [1.0]   # before 200
+        assert ds["label"].to_list() == [6.0]    # at/after 200
+
+    def test_keep_unmet_keys_when_not_dropping(self):
+        amount, _, _ = _features()
+        reader = ConditionalReader(
+            _events(),
+            key_fn=lambda r: r["user"],
+            conditional_params=ConditionalParams(
+                timestamp_fn=lambda r: r["ts"],
+                target_condition=lambda r: r["tag"] == "b",
+                timestamp_to_keep=TimeStampToKeep.MAX,
+                response_window_ms=None,
+                predictor_window_ms=None,
+                drop_if_target_condition_not_met=False,
+            ),
+        )
+        ds = reader.generate_dataset([amount])
+        assert ds["key"].to_list() == ["u1", "u2"]
+        # u2 cutoff = now -> all events are predictors
+        assert ds["amount"].to_list()[1] == 30.0
+
+
+class TestJoinedReaders:
+    def _sides(self):
+        left = SimpleReader(
+            [{"k": "a", "x": 1.0}, {"k": "b", "x": 2.0}],
+            key_fn=lambda r: r["k"],
+        )
+        right = SimpleReader(
+            [{"k": "b", "y": 20.0}, {"k": "c", "y": 30.0}],
+            key_fn=lambda r: r["k"],
+        )
+        xf = FeatureBuilder.Real("x").extract(lambda r: r["x"]).as_predictor()
+        yf = FeatureBuilder.Real("y").extract(lambda r: r["y"]).as_predictor()
+        kxf = FeatureBuilder.ID("key").extract(lambda r: r["k"]).as_predictor()
+        return left, right, xf, yf, kxf
+
+    def _datasets(self):
+        left, right, xf, yf, kxf = self._sides()
+        lds = left.generate_dataset([kxf, xf])
+        rds = right.generate_dataset([kxf, yf])
+        return lds, rds
+
+    def test_inner(self):
+        lds, rds = self._datasets()
+        out = join_datasets(lds, rds, JoinType.INNER)
+        assert out["key"].to_list() == ["b"]
+        assert out["x"].to_list() == [2.0]
+        assert out["y"].to_list() == [20.0]
+
+    def test_left_outer(self):
+        lds, rds = self._datasets()
+        out = join_datasets(lds, rds, JoinType.LEFT_OUTER)
+        assert out["key"].to_list() == ["a", "b"]
+        assert out["y"].to_list() == [None, 20.0]
+
+    def test_outer(self):
+        lds, rds = self._datasets()
+        out = join_datasets(lds, rds, JoinType.OUTER)
+        assert out["key"].to_list() == ["a", "b", "c"]
+        assert out["x"].to_list() == [1.0, 2.0, None]
+        assert out["y"].to_list() == [None, 20.0, 30.0]
+
+
+class TestStreamingReader:
+    def test_micro_batches(self):
+        from transmogrifai_tpu.readers import StreamingReader
+
+        amount, _, _ = _features()
+        sr = StreamingReader([_events()[:2], _events()[2:], []])
+        batches = list(sr.stream_datasets([amount]))
+        assert len(batches) == 2
+        assert batches[0]["amount"].to_list() == [1.0, 2.0]
+        assert batches[1]["amount"].to_list() == [4.0, 10.0, 20.0]

@@ -84,6 +84,13 @@ class GeneralizedLinearRegression(PredictorEstimator):
             "fit_intercept": self.fit_intercept,
         }
 
+    def with_params(self, **params):
+        # grid points that change the family without naming a link must get
+        # the new family's canonical link, not this instance's resolved one
+        if "family" in params and "link" not in params:
+            params = {**params, "link": GLM_DEFAULT_LINK[params["family"]]}
+        return super().with_params(**params)
+
     def fit_arrays(self, x, y, row_mask):
         params = fit_glm_irls(
             x, y, row_mask, float(self.reg_param),

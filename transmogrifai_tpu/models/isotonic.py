@@ -30,7 +30,8 @@ def _pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
     wsum = np.bincount(inv, weights=ws)
     ysum = np.bincount(inv, weights=ys * ws)
     ym = ysum / np.maximum(wsum, 1e-300)
-    # stack-based PAV
+    # stack-based PAV; pooling mutates the stack tops in place so the whole
+    # fit is O(n) even on all-distinct continuous scores
     vals: list[float] = []
     wts: list[float] = []
     lo: list[int] = []
@@ -38,11 +39,11 @@ def _pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
     for i in range(len(ux)):
         vals.append(float(ym[i])); wts.append(float(wsum[i])); lo.append(i); hi.append(i)
         while len(vals) > 1 and vals[-2] > vals[-1]:
-            v = (vals[-2] * wts[-2] + vals[-1] * wts[-1]) / (wts[-2] + wts[-1])
             w2 = wts[-2] + wts[-1]
-            l2, h2 = lo[-2], hi[-1]
-            vals = vals[:-2] + [v]; wts = wts[:-2] + [w2]
-            lo = lo[:-2] + [l2]; hi = hi[:-2] + [h2]
+            v = (vals[-2] * wts[-2] + vals[-1] * wts[-1]) / w2
+            h2 = hi[-1]
+            vals.pop(); wts.pop(); lo.pop(); hi.pop()
+            vals[-1] = v; wts[-1] = w2; hi[-1] = h2
     boundaries: list[float] = []
     predictions: list[float] = []
     for v, l, h in zip(vals, lo, hi):

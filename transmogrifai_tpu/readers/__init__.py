@@ -1,3 +1,13 @@
 """Data plane (reference: readers module)."""
 from .csv import CsvReader, infer_csv_dataset, read_csv_auto  # noqa: F401
-from .core import DataReader, SimpleReader  # noqa: F401
+from .core import DataReader, DatasetReader, SimpleReader  # noqa: F401
+from .aggregate import (  # noqa: F401
+    AggregateParams,
+    AggregateReader,
+    ConditionalParams,
+    ConditionalReader,
+    CutOffTime,
+    TimeStampToKeep,
+)
+from .joins import JoinedReader, JoinKeys, JoinType, join_datasets  # noqa: F401
+from .streaming import StreamingReader  # noqa: F401

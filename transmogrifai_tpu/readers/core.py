@@ -67,13 +67,16 @@ class DatasetReader(DataReader):
                 isinstance(stage, FeatureGeneratorStage)
                 and stage.extract_fn is not None
             ):
+                # extract_fn always wins: passing a column through by name
+                # here would silently skip the user's extraction logic (to
+                # score already-aggregated event data, use score(reader=...))
                 if rows is None:
                     rows = self.dataset.rows()
                 cols[f.name] = stage.extract_column(rows)
-            else:
-                if f.name not in self.dataset:
-                    raise KeyError(
-                        f"Raw feature '{f.name}' missing from input dataset"
-                    )
+            elif f.name in self.dataset:
                 cols[f.name] = self.dataset[f.name]
+            else:
+                raise KeyError(
+                    f"Raw feature '{f.name}' missing from input dataset"
+                )
         return Dataset.of(cols)

@@ -1,0 +1,165 @@
+"""Joined readers — typed joins of two readers' outputs on key columns.
+
+Reference: readers/.../JoinedDataReader.scala:83-390 and JoinTypes.scala.
+The reference joins the two generated DataFrames on `JoinKeys` (default both
+sides' "key" column) with inner/left-outer/outer semantics, then optionally
+re-aggregates. Columnar equivalent: hash-join the two Datasets; missing side
+rows become all-missing columns (the reference's nulls).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features.feature import Feature
+from ..types.columns import empty_like
+from .core import DataReader
+
+
+class JoinType(enum.Enum):
+    """JoinTypes.scala."""
+
+    INNER = "inner"
+    LEFT_OUTER = "leftOuter"
+    OUTER = "outer"
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinKeys:
+    """JoinedDataReader.scala: key column names on each side (default the
+    reader key column)."""
+
+    left_key: str = "key"
+    right_key: str = "key"
+    result_key: str = "key"
+
+
+class JoinedReader(DataReader):
+    """Join the outputs of two readers (JoinedDataReader.scala:83).
+
+    Each raw feature must be resolvable by exactly one side; the split is by
+    feature name against each side's generated columns.
+    """
+
+    def __init__(
+        self,
+        left: DataReader,
+        right: DataReader,
+        join_type: JoinType = JoinType.LEFT_OUTER,
+        join_keys: JoinKeys = JoinKeys(),
+        left_features: Sequence[Feature] = (),
+        right_features: Sequence[Feature] = (),
+    ):
+        super().__init__(None)
+        self.left = left
+        self.right = right
+        self.join_type = join_type
+        self.join_keys = join_keys
+        self.left_features = tuple(left_features)
+        self.right_features = tuple(right_features)
+
+    def inner_join(self, other: "DataReader", **kw) -> "JoinedReader":
+        return JoinedReader(self, other, JoinType.INNER, **kw)
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        left_names = {f.name for f in self.left_features}
+        right_names = {f.name for f in self.right_features}
+        lf = [f for f in raw_features if f.name in left_names]
+        rf = [f for f in raw_features if f.name in right_names]
+        unclaimed = [
+            f.name for f in raw_features
+            if f.name not in left_names and f.name not in right_names
+        ]
+        if unclaimed:
+            raise ValueError(
+                f"Raw features {unclaimed} not declared on either join side"
+            )
+        lds = self.left.generate_dataset(lf)
+        rds = self.right.generate_dataset(rf)
+        return join_datasets(
+            lds, rds, self.join_type, self.join_keys
+        )
+
+
+def join_datasets(
+    left: Dataset,
+    right: Dataset,
+    join_type: JoinType = JoinType.LEFT_OUTER,
+    keys: JoinKeys = JoinKeys(),
+) -> Dataset:
+    """Hash-join two columnar Datasets on their key columns."""
+    lkeys = [_key_str(v) for v in left[keys.left_key].to_list()]
+    rkeys = [_key_str(v) for v in right[keys.right_key].to_list()]
+    rindex: dict[str, int] = {}
+    for i, k in enumerate(rkeys):
+        rindex.setdefault(k, i)  # first match wins (1:1 join)
+
+    # left rows are addressed positionally so duplicate left keys each keep
+    # their own data; only the right side is looked up through its key index
+    out_keys: list[str] = []
+    li_list: list[int] = []
+    ri_list: list[int] = []
+    for i, k in enumerate(lkeys):
+        r = rindex.get(k, -1)
+        if join_type is JoinType.INNER and r < 0:
+            continue
+        out_keys.append(k)
+        li_list.append(i)
+        ri_list.append(r)
+    if join_type is JoinType.OUTER:
+        seen = set(lkeys)
+        for i, k in enumerate(rkeys):
+            if k not in seen and rindex[k] == i:
+                out_keys.append(k)
+                li_list.append(-1)
+                ri_list.append(i)
+    li = np.array(li_list, dtype=np.int64)
+    ri = np.array(ri_list, dtype=np.int64)
+
+    cols = {}
+    for name, col in left.columns.items():
+        if name == keys.left_key:
+            continue
+        cols[name] = _gather(col, li, left.num_rows)
+    for name, col in right.columns.items():
+        if name == keys.right_key:
+            continue
+        if name in cols:
+            raise ValueError(f"Join column collision: '{name}' on both sides")
+        cols[name] = _gather(col, ri, right.num_rows)
+    from ..types.columns import column_from_values
+
+    from .. import types as T
+
+    cols = {keys.result_key: column_from_values(T.ID, out_keys), **cols}
+    return Dataset.of(cols)
+
+
+def _key_str(v) -> str:
+    return "" if v is None else str(v)
+
+
+def _gather(col, idx: np.ndarray, n_src: int):
+    """Take rows by index; -1 produces a missing row."""
+    missing = idx < 0
+    if not missing.any():
+        return col.take(idx)
+    if missing.all() or n_src == 0:
+        return empty_like(col.feature_type, len(idx))
+    # take valid rows then splice in missing rows
+    safe = np.where(missing, 0, idx)
+    taken = col.take(safe)
+    vals = taken.to_list()
+    evals = empty_like(col.feature_type, int(missing.sum())).to_list()
+    j = 0
+    for i, m in enumerate(missing):
+        if m:
+            vals[i] = evals[j]
+            j += 1
+    from ..types.columns import column_from_values
+
+    return column_from_values(col.feature_type, vals)

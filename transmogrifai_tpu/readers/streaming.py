@@ -1,0 +1,36 @@
+"""Streaming reader — micro-batch scoring source.
+
+Reference: readers/.../StreamingReaders.scala:50-70 (`StreamingReaders.Simple
+.avro`) feeding OpWorkflowRunner.streamingScore (OpWorkflowRunner.scala:232).
+The Spark Streaming DStream becomes a plain iterator of record batches; the
+runner scores each batch with the already-jitted score function (the TPU
+path: host loop feeding a compiled program, SURVEY.md §2.6 "async scoring").
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+from ..dataset import Dataset
+from ..features.feature import Feature
+from .core import SimpleReader
+
+
+class StreamingReader:
+    """An iterator of micro-batches, each a list of records."""
+
+    def __init__(
+        self,
+        batches: Iterable[Sequence[Any]],
+        key_fn: Callable[[Any], str] | None = None,
+    ):
+        self._batches = batches
+        self.key_fn = key_fn
+
+    def stream_datasets(
+        self, raw_features: Sequence[Feature]
+    ) -> Iterator[Dataset]:
+        """Yield one columnar Dataset per micro-batch."""
+        for batch in self._batches:
+            if not batch:
+                continue
+            yield SimpleReader(batch, self.key_fn).generate_dataset(raw_features)
