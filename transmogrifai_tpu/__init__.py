@@ -10,5 +10,6 @@ meshes instead of Spark executors.
 from . import types  # noqa: F401
 from .dataset import Dataset  # noqa: F401
 from .features import Feature, FeatureBuilder, from_dataset  # noqa: F401
+from . import dsl  # noqa: F401  — attaches the rich-feature vocabulary
 
 __version__ = "0.1.0"

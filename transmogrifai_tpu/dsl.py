@@ -1,0 +1,184 @@
+"""dsl — the user-facing feature-transformation vocabulary.
+
+Reference: core/.../dsl/Rich{Numeric,Text,Date,List,Map,Set,Vector}Feature
+.scala + RichFeaturesCollection.scala — implicit enrichments that give
+features methods like ``tokenize``, ``vectorize``, ``sanityCheck`` and
+arithmetic operators. Python equivalent: importing this module attaches the
+same vocabulary onto ``Feature`` (done once at package import), so
+
+    pred = (f1 + f2).z_normalize()
+    toks = text.tokenize()
+    vec  = toks.tf_idf(num_terms=512)
+
+mirror the Scala one-liners.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from .features.feature import Feature
+from .ops import math as _math
+from .ops import simple as _simple
+from .ops.bucketizers import (
+    DecisionTreeNumericBucketizer,
+    NumericBucketizer,
+)
+from .ops.domains import EmailToPickListTransformer, UrlMapToPickListMapTransformer
+from .ops.embeddings import OpLDA, OpWord2Vec
+from .ops.scalers import (
+    FillMissingWithMean,
+    OpScalarStandardScaler,
+    PercentileCalibrator,
+    ScalerTransformer,
+    DescalerTransformer,
+)
+from .ops.text_stages import (
+    JaccardSimilarity,
+    LangDetector,
+    MimeTypeDetector,
+    NameEntityRecognizer,
+    NGramSimilarity,
+    OpCountVectorizer,
+    OpHashingTF,
+    OpIDF,
+    OpNGram,
+    OpStopWordsRemover,
+    OpStringIndexer,
+    TextTokenizer,
+    ValidEmailTransformer,
+)
+from .ops.time_period import (
+    TimePeriodListTransformer,
+    TimePeriodMapTransformer,
+    TimePeriodTransformer,
+)
+
+
+def _unary(stage_factory: Callable[..., Any]) -> Callable[..., Feature]:
+    def method(self: Feature, *args: Any, **kwargs: Any) -> Feature:
+        return self.transform_with(stage_factory(*args, **kwargs))
+
+    return method
+
+
+def _binary(stage_factory: Callable[..., Any]) -> Callable[..., Feature]:
+    def method(self: Feature, other: Feature, *args: Any, **kwargs: Any) -> Feature:
+        return self.transform_with(stage_factory(*args, **kwargs), other)
+
+    return method
+
+
+def _scalar_or_feature(
+    feature_cls: type, scalar_cls: type
+) -> Callable[..., Feature]:
+    def method(self: Feature, other: Any) -> Feature:
+        if isinstance(other, Feature):
+            return self.transform_with(feature_cls(), other)
+        return self.transform_with(scalar_cls(float(other)))
+
+    return method
+
+
+# ---------------------------------------------------------------- numeric dsl
+# RichNumericFeature.scala: +, -, *, / with feature or scalar operands
+Feature.__add__ = _scalar_or_feature(_math.AddTransformer, _math.ScalarAddTransformer)
+Feature.__sub__ = _scalar_or_feature(
+    _math.SubtractTransformer, _math.ScalarSubtractTransformer
+)
+Feature.__mul__ = _scalar_or_feature(
+    _math.MultiplyTransformer, _math.ScalarMultiplyTransformer
+)
+Feature.__truediv__ = _scalar_or_feature(
+    _math.DivideTransformer, _math.ScalarDivideTransformer
+)
+Feature.abs = _unary(_math.AbsoluteValueTransformer)
+Feature.ceil = _unary(_math.CeilTransformer)
+Feature.floor = _unary(_math.FloorTransformer)
+Feature.round = _unary(_math.RoundTransformer)
+Feature.round_digits = _unary(_math.RoundDigitsTransformer)
+Feature.exp = _unary(_math.ExpTransformer)
+Feature.sqrt = _unary(_math.SqrtTransformer)
+Feature.log = _unary(_math.LogTransformer)
+Feature.power = _unary(_math.PowerTransformer)
+Feature.z_normalize = _unary(OpScalarStandardScaler)
+Feature.fill_missing_with_mean = _unary(FillMissingWithMean)
+Feature.bucketize = _unary(NumericBucketizer)
+Feature.scale = _unary(ScalerTransformer)
+Feature.descale = _binary(DescalerTransformer)
+Feature.calibrate_percentile = _unary(PercentileCalibrator)
+
+
+def _auto_bucketize(
+    self: Feature, label: Feature, **kwargs: Any
+) -> Feature:
+    """Supervised decision-tree binning
+    (RichNumericFeature.autoBucketize)."""
+    return label.transform_with(DecisionTreeNumericBucketizer(**kwargs), self)
+
+
+Feature.auto_bucketize = _auto_bucketize
+
+# ------------------------------------------------------------------- text dsl
+# RichTextFeature.scala
+Feature.tokenize = _unary(TextTokenizer)
+Feature.ngram = _unary(OpNGram)
+Feature.remove_stop_words = _unary(OpStopWordsRemover)
+Feature.tf = _unary(OpHashingTF)
+Feature.count_vectorize = _unary(OpCountVectorizer)
+Feature.idf = _unary(OpIDF)
+Feature.string_indexed = _unary(OpStringIndexer)
+Feature.detect_languages = _unary(LangDetector)
+Feature.detect_mime_types = _unary(MimeTypeDetector)
+Feature.is_valid_email = _unary(ValidEmailTransformer)
+Feature.email_to_pick_list = _unary(EmailToPickListTransformer)
+Feature.url_map_to_pick_list_map = _unary(UrlMapToPickListMapTransformer)
+Feature.recognize_entities = _unary(NameEntityRecognizer)
+Feature.word2vec = _unary(OpWord2Vec)
+Feature.lda = _unary(OpLDA)
+Feature.jaccard_similarity = _binary(JaccardSimilarity)
+Feature.ngram_similarity = _binary(NGramSimilarity)
+
+
+def _tf_idf(self: Feature, num_terms: int = 512) -> Feature:
+    """tokenized text → hashed TF → IDF (RichTextFeature.tfidf)."""
+    return self.transform_with(OpHashingTF(num_features=num_terms)).transform_with(
+        OpIDF()
+    )
+
+
+Feature.tf_idf = _tf_idf
+
+# ------------------------------------------------------------------- date dsl
+Feature.to_time_period = _unary(TimePeriodTransformer)
+Feature.to_time_period_list = _unary(TimePeriodListTransformer)
+Feature.to_time_period_map = _unary(TimePeriodMapTransformer)
+
+# ---------------------------------------------------------------- generic dsl
+Feature.alias = _unary(_simple.AliasTransformer)
+Feature.filter_values = _unary(_simple.FilterTransformer)
+Feature.replace_values = _unary(_simple.ReplaceTransformer)
+Feature.substring_of = _binary(_simple.SubstringTransformer)
+Feature.occurs = _unary(_simple.ToOccurTransformer)
+Feature.exists = _unary(_simple.ExistsTransformer)
+Feature.filter_map = _unary(_simple.FilterMap)
+
+
+def _vectorize_collection(features: Sequence[Feature], **kwargs: Any) -> Feature:
+    """RichFeaturesCollection.transmogrify on a plain list."""
+    from .ops import transmogrify
+
+    return transmogrify(list(features), **kwargs)
+
+
+def _sanity_check(
+    self: Feature, feature_vector: Feature, **kwargs: Any
+) -> Feature:
+    """label.sanity_check(vector) (RichNumericFeature.scala:469)."""
+    from .prep import SanityChecker
+
+    return self.transform_with(SanityChecker(**kwargs), feature_vector)
+
+
+Feature.sanity_check = _sanity_check
+
+transmogrify_features = _vectorize_collection

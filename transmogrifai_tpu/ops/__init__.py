@@ -2,3 +2,75 @@
 from .defaults import TransmogrifierDefaults  # noqa: F401
 from .transmogrify import transmogrify  # noqa: F401
 from .combiner import VectorsCombiner  # noqa: F401
+from .math import (  # noqa: F401
+    AbsoluteValueTransformer,
+    AddTransformer,
+    CeilTransformer,
+    DivideTransformer,
+    ExpTransformer,
+    FloorTransformer,
+    LogTransformer,
+    MultiplyTransformer,
+    PowerTransformer,
+    RoundDigitsTransformer,
+    RoundTransformer,
+    ScalarAddTransformer,
+    ScalarDivideTransformer,
+    ScalarMultiplyTransformer,
+    ScalarSubtractTransformer,
+    SqrtTransformer,
+    SubtractTransformer,
+)
+from .simple import (  # noqa: F401
+    AliasTransformer,
+    ExistsTransformer,
+    FilterMap,
+    FilterTransformer,
+    MultiLabelJoiner,
+    ReplaceTransformer,
+    SubstringTransformer,
+    TextLenTransformer,
+    ToOccurTransformer,
+    TopNLabelProbMap,
+)
+from .scalers import (  # noqa: F401
+    DescalerTransformer,
+    FillMissingWithMean,
+    LinearScalerArgs,
+    OpScalarStandardScaler,
+    PercentileCalibrator,
+    ScalerTransformer,
+    ScalingType,
+)
+from .bucketizers import (  # noqa: F401
+    DecisionTreeNumericBucketizer,
+    DropIndicesByTransformer,
+    NumericBucketizer,
+)
+from .text_stages import (  # noqa: F401
+    JaccardSimilarity,
+    LangDetector,
+    MimeTypeDetector,
+    NameEntityRecognizer,
+    NGramSimilarity,
+    OpCountVectorizer,
+    OpHashingTF,
+    OpIDF,
+    OpIndexToString,
+    OpNGram,
+    OpStopWordsRemover,
+    OpStringIndexer,
+    TextTokenizer,
+    ValidEmailTransformer,
+    HumanNameDetector,
+)
+from .embeddings import OpLDA, OpWord2Vec  # noqa: F401
+from .time_period import (  # noqa: F401
+    TimePeriodListTransformer,
+    TimePeriodMapTransformer,
+    TimePeriodTransformer,
+)
+from .domains import (  # noqa: F401
+    EmailToPickListTransformer,
+    UrlMapToPickListMapTransformer,
+)

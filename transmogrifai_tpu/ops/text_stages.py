@@ -1,0 +1,788 @@
+"""Text pipeline stages: tokenizer, n-grams, stop-words, count/hashing TF,
+IDF, string indexing, similarity, language/MIME/email/name detection.
+
+Reference stages replaced (core/.../stages/impl/feature/):
+  * TextTokenizer.scala — Lucene per-language analyzers → locale-light regex
+    tokenizer (utils/text.py) with the same defaults (lowercase, min length).
+  * OpNGram.scala — Spark NGram: n-grams joined by spaces.
+  * OpStopWordsRemover.scala — Spark StopWordsRemover (english defaults).
+  * OpCountVectorizer.scala — Spark CountVectorizer (vocabSize, minDF).
+  * OpHashingTF.scala — term hashing to a fixed width (murmur3).
+  * (Spark IDF via sparkwrappers) — OpIDF estimator here.
+  * OpStringIndexer{,NoFilter}.scala / OpIndexToString{,NoFilter}.scala —
+    frequency-ordered label indexing and its inverse.
+  * JaccardSimilarity.scala — |A∩B| / |A∪B| over token sets.
+  * NGramSimilarity.scala — character-n-gram similarity (Lucene
+    NGramDistance replaced by a Jaccard over char n-grams).
+  * LangDetector.scala — Optimaize profiles → stopword/charset heuristic
+    over 12 languages (documented divergence; same output shape
+    RealMap[lang → confidence]).
+  * MimeTypeDetector.scala — Tika → magic-byte table over common formats.
+  * ValidEmailTransformer.scala — RFC-lite regex validation.
+  * HumanNameDetector.scala / NameEntityRecognizer.scala — OpenNLP models →
+    dictionary+shape heuristics emitting the same NameStats / entity-map
+    shapes (documented divergence).
+"""
+from __future__ import annotations
+
+import base64
+import binascii
+import re
+
+import numpy as np
+
+from ..stages.base import Estimator, Model, Transformer
+from ..stages.metadata import ColumnMeta, VectorMetadata
+from ..types import (
+    Binary,
+    MultiPickListMap,
+    NameStats,
+    OPVector,
+    PickList,
+    Real,
+    RealMap,
+    RealNN,
+    Text,
+    TextList,
+)
+from ..types.columns import (
+    Column,
+    ListColumn,
+    MapColumn,
+    NumericColumn,
+    TextColumn,
+    VectorColumn,
+)
+from ..utils.text import hash_to_index, tokenize
+
+
+class TextTokenizer(Transformer):
+    """Text → TextList (TextTokenizer.scala; defaults ToLowercase=true,
+    MinTokenLength=1)."""
+
+    input_types = (Text,)
+    output_type = TextList
+
+    def __init__(
+        self,
+        to_lowercase: bool = True,
+        min_token_length: int = 1,
+        uid: str | None = None,
+    ):
+        super().__init__("tokenized", uid=uid)
+        self.to_lowercase = to_lowercase
+        self.min_token_length = min_token_length
+
+    def get_params(self):
+        return {
+            "to_lowercase": self.to_lowercase,
+            "min_token_length": self.min_token_length,
+        }
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> ListColumn:
+        col = cols[0]
+        assert isinstance(col, TextColumn)
+        out = [
+            tokenize(v, self.to_lowercase, self.min_token_length) if v else []
+            for v in col.values
+        ]
+        return ListColumn(TextList, out)
+
+
+class OpNGram(Transformer):
+    """TextList → TextList of space-joined n-grams (OpNGram.scala; Spark
+    NGram default n=2)."""
+
+    input_types = (TextList,)
+    output_type = TextList
+
+    def __init__(self, n: int = 2, uid: str | None = None):
+        super().__init__("ngram", uid=uid)
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        self.n = n
+
+    def get_params(self):
+        return {"n": self.n}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> ListColumn:
+        col = cols[0]
+        assert isinstance(col, ListColumn)
+        n = self.n
+        out = [
+            [" ".join(row[i : i + n]) for i in range(len(row) - n + 1)]
+            if row
+            else []
+            for row in col.values
+        ]
+        return ListColumn(TextList, out)
+
+
+# Spark's StopWordsRemover english default list (org.apache.spark.ml.feature,
+# itself from the public "Glasgow stop words" set) — abridged to the tokens
+# that affect typical feature engineering.
+ENGLISH_STOP_WORDS = frozenset("""
+a about above after again against all am an and any are aren't as at be
+because been before being below between both but by can't cannot could
+couldn't did didn't do does doesn't doing don't down during each few for from
+further had hadn't has hasn't have haven't having he he'd he'll he's her here
+here's hers herself him himself his how how's i i'd i'll i'm i've if in into
+is isn't it it's its itself let's me more most mustn't my myself no nor not of
+off on once only or other ought our ours ourselves out over own same shan't
+she she'd she'll she's should shouldn't so some such than that that's the
+their theirs them themselves then there there's these they they'd they'll
+they're they've this those through to too under until up very was wasn't we
+we'd we'll we're we've were weren't what what's when when's where where's
+which while who who's whom why why's with won't would wouldn't you you'd
+you'll you're you've your yours yourself yourselves
+""".split())
+
+
+class OpStopWordsRemover(Transformer):
+    """TextList → TextList without stop words (OpStopWordsRemover.scala;
+    Spark default: english, caseSensitive=false)."""
+
+    input_types = (TextList,)
+    output_type = TextList
+
+    def __init__(
+        self,
+        stop_words=ENGLISH_STOP_WORDS,
+        case_sensitive: bool = False,
+        uid: str | None = None,
+    ):
+        super().__init__("stopWordsRemoved", uid=uid)
+        self.stop_words = frozenset(stop_words)
+        self.case_sensitive = case_sensitive
+        self._lowered = frozenset(w.lower() for w in self.stop_words)
+
+    def get_params(self):
+        return {
+            "stop_words": sorted(self.stop_words),
+            "case_sensitive": self.case_sensitive,
+        }
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> ListColumn:
+        col = cols[0]
+        assert isinstance(col, ListColumn)
+        if self.case_sensitive:
+            sw = self.stop_words
+            out = [[t for t in row if t not in sw] for row in col.values]
+        else:
+            sw = self._lowered
+            out = [[t for t in row if t.lower() not in sw] for row in col.values]
+        return ListColumn(TextList, out)
+
+
+def _term_vector_column(
+    output_name: str, feature, vocab: list[str], rows: list[dict[str, float]]
+) -> VectorColumn:
+    values = np.zeros((len(rows), len(vocab)), dtype=np.float32)
+    index = {t: i for i, t in enumerate(vocab)}
+    for r, counts in enumerate(rows):
+        for t, c in counts.items():
+            j = index.get(t)
+            if j is not None:
+                values[r, j] = c
+    metas = tuple(
+        ColumnMeta(
+            parent_names=(feature.name,),
+            parent_type=feature.ftype.__name__,
+            grouping=feature.name,
+            indicator_value=t,
+            index=i,
+        )
+        for i, t in enumerate(vocab)
+    )
+    return VectorColumn(OPVector, values, VectorMetadata(output_name, metas))
+
+
+class OpCountVectorizer(Estimator):
+    """TextList → OPVector of term counts with a learned vocabulary
+    (OpCountVectorizer.scala; Spark defaults vocabSize 2^18, minDF 1)."""
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    def __init__(
+        self,
+        vocab_size: int = 1 << 18,
+        min_df: float = 1.0,
+        binary: bool = False,
+        uid: str | None = None,
+    ):
+        super().__init__("countVectorized", uid=uid)
+        self.vocab_size = vocab_size
+        self.min_df = min_df
+        self.binary = binary
+
+    def get_params(self):
+        return {
+            "vocab_size": self.vocab_size,
+            "min_df": self.min_df,
+            "binary": self.binary,
+        }
+
+    def fit_model(self, dataset) -> "OpCountVectorizerModel":
+        col = dataset[self.input_names[0]]
+        assert isinstance(col, ListColumn)
+        df: dict[str, int] = {}
+        tf: dict[str, int] = {}
+        for row in col.values:
+            for t in set(row):
+                df[t] = df.get(t, 0) + 1
+            for t in row:
+                tf[t] = tf.get(t, 0) + 1
+        n = len(col.values)
+        min_docs = self.min_df if self.min_df >= 1 else self.min_df * n
+        terms = [t for t, d in df.items() if d >= min_docs]
+        # highest total frequency first, ties lexicographic (stable vocab)
+        terms.sort(key=lambda t: (-tf[t], t))
+        vocab = terms[: self.vocab_size]
+        self.metadata["vocabSize"] = len(vocab)
+        return OpCountVectorizerModel(vocab, self.binary)
+
+
+class OpCountVectorizerModel(Model):
+    output_type = OPVector
+
+    def __init__(self, vocab: list[str], binary: bool = False, uid: str | None = None):
+        super().__init__("countVectorized", uid=uid)
+        self.vocab = list(vocab)
+        self.binary = binary
+
+    def get_params(self):
+        return {"vocab": self.vocab, "binary": self.binary}
+
+    @classmethod
+    def from_params(cls, params, arrays):
+        return cls(params["vocab"], params.get("binary", False))
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, ListColumn)
+        rows = []
+        for row in col.values:
+            counts: dict[str, float] = {}
+            for t in row:
+                counts[t] = counts.get(t, 0.0) + 1.0
+            if self.binary:
+                counts = {t: 1.0 for t in counts}
+            rows.append(counts)
+        return _term_vector_column(
+            self.output_name, self.input_features[0], self.vocab, rows
+        )
+
+
+class OpHashingTF(Transformer):
+    """TextList → OPVector via term hashing (OpHashingTF.scala). Spark's
+    default width is 2^18 over a sparse vector; this column is dense
+    ([N, D] float32 shipping to device), so the default follows the
+    Transmogrifier text-hash width (512, TransmogrifierDefaults
+    DefaultNumOfFeatures) — pass num_features explicitly for more."""
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    def __init__(
+        self, num_features: int = 512, binary: bool = False, uid: str | None = None
+    ):
+        super().__init__("hashingTF", uid=uid)
+        self.num_features = num_features
+        self.binary = binary
+
+    def get_params(self):
+        return {"num_features": self.num_features, "binary": self.binary}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, ListColumn)
+        values = np.zeros((num_rows, self.num_features), dtype=np.float32)
+        for r, row in enumerate(col.values):
+            for t in row:
+                j = hash_to_index(t, self.num_features)
+                if self.binary:
+                    values[r, j] = 1.0
+                else:
+                    values[r, j] += 1.0
+        f = self.input_features[0]
+        metas = tuple(
+            ColumnMeta(
+                parent_names=(f.name,),
+                parent_type=f.ftype.__name__,
+                grouping=f.name,
+                index=i,
+            )
+            for i in range(self.num_features)
+        )
+        return VectorColumn(
+            OPVector, values, VectorMetadata(self.output_name, metas)
+        )
+
+
+class OpIDF(Estimator):
+    """OPVector (term counts) → OPVector (tf·idf); Spark IDF semantics:
+    idf = ln((n_docs + 1) / (df + 1)), minDocFreq 0."""
+
+    input_types = (OPVector,)
+    output_type = OPVector
+
+    def __init__(self, min_doc_freq: int = 0, uid: str | None = None):
+        super().__init__("idf", uid=uid)
+        self.min_doc_freq = min_doc_freq
+
+    def get_params(self):
+        return {"min_doc_freq": self.min_doc_freq}
+
+    def fit_model(self, dataset) -> "OpIDFModel":
+        col = dataset[self.input_names[0]]
+        assert isinstance(col, VectorColumn)
+        x = np.asarray(col.values)
+        df = (x > 0).sum(axis=0).astype(np.float64)
+        n = x.shape[0]
+        idf = np.log((n + 1.0) / (df + 1.0))
+        idf = np.where(df >= self.min_doc_freq, idf, 0.0)
+        return OpIDFModel(idf)
+
+
+class OpIDFModel(Model):
+    output_type = OPVector
+
+    def __init__(self, idf, uid: str | None = None):
+        super().__init__("idf", uid=uid)
+        self.idf = np.asarray(idf, dtype=np.float64)
+
+    def get_arrays(self):
+        return {"idf": self.idf}
+
+    @classmethod
+    def from_params(cls, params, arrays):
+        return cls(arrays["idf"])
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        col = cols[0]
+        assert isinstance(col, VectorColumn)
+        values = (np.asarray(col.values) * self.idf[None, :]).astype(np.float32)
+        return VectorColumn(OPVector, values, col.metadata)
+
+
+class OpStringIndexer(Estimator):
+    """Text → RealNN index ordered by descending frequency
+    (OpStringIndexer.scala). handle_invalid: 'error' | 'skip'-as-NaN |
+    'keep' (unseen → num_labels), reference default NoFilter keeps."""
+
+    input_types = (Text,)
+    output_type = RealNN
+
+    def __init__(self, handle_invalid: str = "keep", uid: str | None = None):
+        super().__init__("strIdx", uid=uid)
+        if handle_invalid not in ("error", "skip", "keep"):
+            raise ValueError(f"bad handle_invalid {handle_invalid}")
+        self.handle_invalid = handle_invalid
+
+    def get_params(self):
+        return {"handle_invalid": self.handle_invalid}
+
+    def fit_model(self, dataset) -> "OpStringIndexerModel":
+        col = dataset[self.input_names[0]]
+        assert isinstance(col, TextColumn)
+        counts: dict[str, int] = {}
+        for v in col.values:
+            if v is not None:
+                counts[v] = counts.get(v, 0) + 1
+        labels = sorted(counts, key=lambda t: (-counts[t], t))
+        self.metadata["labels"] = labels
+        return OpStringIndexerModel(labels, self.handle_invalid)
+
+
+class OpStringIndexerModel(Model):
+    output_type = RealNN
+
+    def __init__(self, labels: list[str], handle_invalid: str = "keep", uid=None):
+        super().__init__("strIdx", uid=uid)
+        self.labels = list(labels)
+        self.handle_invalid = handle_invalid
+        self._index = {t: i for i, t in enumerate(self.labels)}
+
+    def get_params(self):
+        return {"labels": self.labels, "handle_invalid": self.handle_invalid}
+
+    @classmethod
+    def from_params(cls, params, arrays):
+        return cls(params["labels"], params.get("handle_invalid", "keep"))
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, TextColumn)
+        unseen = float(len(self.labels))
+        vals = np.zeros(num_rows, dtype=np.float64)
+        mask = np.ones(num_rows, dtype=bool)
+        for i, v in enumerate(col.values):
+            j = self._index.get(v) if v is not None else None
+            if j is not None:
+                vals[i] = float(j)
+            elif self.handle_invalid == "keep":
+                vals[i] = unseen
+            elif self.handle_invalid == "skip":
+                mask[i] = False
+            else:
+                raise ValueError(f"Unseen label {v!r}")
+        return NumericColumn(RealNN, vals, mask)
+
+
+class OpIndexToString(Transformer):
+    """RealNN index → Text label (OpIndexToString{,NoFilter}.scala)."""
+
+    input_types = (RealNN,)
+    output_type = Text
+
+    def __init__(self, labels: list[str], unseen: str = "UnseenIndex", uid=None):
+        super().__init__("idxToStr", uid=uid)
+        self.labels = list(labels)
+        self.unseen = unseen
+
+    def get_params(self):
+        return {"labels": self.labels, "unseen": self.unseen}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> TextColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        out = np.empty(num_rows, dtype=object)
+        for i, (v, m) in enumerate(zip(col.values, col.mask)):
+            j = int(v)
+            if m and 0 <= j < len(self.labels):
+                out[i] = self.labels[j]
+            else:
+                out[i] = self.unseen
+        return TextColumn(Text, out)
+
+
+class JaccardSimilarity(Transformer):
+    """Two set/list features → RealNN |A∩B|/|A∪B| (JaccardSimilarity.scala;
+    both empty → 1.0)."""
+
+    output_type = RealNN
+
+    def __init__(self, uid: str | None = None):
+        super().__init__("jacSim", uid=uid)
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> NumericColumn:
+        a_vals = cols[0].to_list()
+        b_vals = cols[1].to_list()
+        out = np.zeros(num_rows, dtype=np.float64)
+        for i, (a, b) in enumerate(zip(a_vals, b_vals)):
+            sa = set(a) if a else set()
+            sb = set(b) if b else set()
+            if not sa and not sb:
+                out[i] = 1.0
+            else:
+                union = len(sa | sb)
+                out[i] = len(sa & sb) / union if union else 1.0
+        return NumericColumn(RealNN, out, np.ones(num_rows, dtype=bool))
+
+
+class NGramSimilarity(Transformer):
+    """Two text features → RealNN char-n-gram similarity
+    (NGramSimilarity.scala; default n=3; Lucene NGramDistance replaced by
+    Jaccard over padded char n-grams — same range, both-empty → 0)."""
+
+    output_type = RealNN
+
+    def __init__(self, n: int = 3, uid: str | None = None):
+        super().__init__("ngramSim", uid=uid)
+        self.n = n
+
+    def get_params(self):
+        return {"n": self.n}
+
+    def _grams(self, s: str) -> set:
+        s = f"{'_' * (self.n - 1)}{s.lower()}{'_' * (self.n - 1)}"
+        return {s[i : i + self.n] for i in range(len(s) - self.n + 1)}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> NumericColumn:
+        def as_text(v):
+            if isinstance(v, list):
+                v = " ".join(v)
+            return v or ""
+
+        a_vals, b_vals = cols[0].to_list(), cols[1].to_list()
+        out = np.zeros(num_rows, dtype=np.float64)
+        for i in range(num_rows):
+            a, b = as_text(a_vals[i]), as_text(b_vals[i])
+            if not a or not b:
+                out[i] = 0.0
+                continue
+            ga, gb = self._grams(a), self._grams(b)
+            union = len(ga | gb)
+            out[i] = len(ga & gb) / union if union else 0.0
+        return NumericColumn(RealNN, out, np.ones(num_rows, dtype=bool))
+
+
+# ------------------------------------------------------------------ detectors
+
+_LANG_MARKERS: dict[str, frozenset] = {
+    "en": frozenset("the and of to in is you that it he was for on are with as at be this have from".split()),
+    "de": frozenset("der die und in den von zu das mit sich des auf für ist im nicht ein als auch es".split()),
+    "fr": frozenset("le de la et les des en un du une que est pour qui dans par sur au plus".split()),
+    "es": frozenset("el la de que y en un ser se no haber por con su para como estar tener le lo".split()),
+    "pt": frozenset("o de a e do da em um para é com não uma os no se na por mais as dos como".split()),
+    "it": frozenset("di e il la che in un a per è una sono non con si da come io questo ma".split()),
+    "nl": frozenset("de het een en van ik te dat die in je niet zijn is was op aan met als voor".split()),
+    "da": frozenset("og i jeg det at en den til er som på de med han af for ikke der var".split()),
+    "sv": frozenset("och det att i jag en som på är av för med den till inte har de om ett".split()),
+    "fi": frozenset("ja on ei se että en oli hän mutta niin kun min sin nyt mitä tämä ole".split()),
+    "pl": frozenset("i w nie na to że się z do jest jak po co tak o ale mnie jego być ja".split()),
+    "ro": frozenset("de și în a la cu pe este un o care nu mai din ce se pentru sau dar".split()),
+}
+
+
+class LangDetector(Transformer):
+    """Text → RealMap[language → confidence] (LangDetector.scala; the
+    Optimaize profile model is replaced by stop-word voting over 12
+    languages — documented divergence, same output shape/keying)."""
+
+    input_types = (Text,)
+    output_type = RealMap
+
+    def __init__(self, uid: str | None = None):
+        super().__init__("langDetected", uid=uid)
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        col = cols[0]
+        assert isinstance(col, TextColumn)
+        out = []
+        for v in col.values:
+            if not v:
+                out.append({})
+                continue
+            toks = tokenize(v)
+            if not toks:
+                out.append({})
+                continue
+            scores = {
+                lang: sum(1 for t in toks if t in markers) / len(toks)
+                for lang, markers in _LANG_MARKERS.items()
+            }
+            top = {k: v2 for k, v2 in scores.items() if v2 > 0}
+            if not top:
+                out.append({})
+                continue
+            total = sum(top.values())
+            out.append({k: v2 / total for k, v2 in sorted(top.items(), key=lambda kv: -kv[1])[:3]})
+        return MapColumn(RealMap, out)
+
+
+_MAGIC_BYTES: list[tuple[bytes, str]] = [
+    (b"%PDF", "application/pdf"),
+    (b"\x89PNG\r\n\x1a\n", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF87a", "image/gif"),
+    (b"GIF89a", "image/gif"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"BM", "image/bmp"),
+    (b"ID3", "audio/mpeg"),
+    (b"RIFF", "audio/x-wav"),
+    (b"\xd0\xcf\x11\xe0", "application/x-ole-storage"),
+    (b"<?xml", "application/xml"),
+    (b"<html", "text/html"),
+    (b"<!DOCTYPE html", "text/html"),
+]
+
+
+class MimeTypeDetector(Transformer):
+    """Base64 → Text MIME type (MimeTypeDetector.scala; Tika replaced by a
+    magic-byte table; undecodable/unknown → 'application/octet-stream',
+    decodable text → 'text/plain')."""
+
+    output_type = Text
+
+    def __init__(self, uid: str | None = None):
+        super().__init__("mimeDetected", uid=uid)
+
+    def _detect(self, b64: str) -> str | None:
+        if not b64:
+            return None
+        try:
+            data = base64.b64decode(b64, validate=True)
+        except (binascii.Error, ValueError):
+            return None
+        if not data:
+            return None
+        head = data[:32]
+        for magic, mime in _MAGIC_BYTES:
+            if head.startswith(magic):
+                return mime
+        try:
+            data[:512].decode("utf-8")
+            return "text/plain"
+        except UnicodeDecodeError:
+            return "application/octet-stream"
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> TextColumn:
+        col = cols[0]
+        assert isinstance(col, TextColumn)
+        out = np.empty(num_rows, dtype=object)
+        out[:] = [self._detect(v) for v in col.values]
+        return TextColumn(Text, out)
+
+
+_EMAIL_RE = re.compile(
+    r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@"
+    r"[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?"
+    r"(?:\.[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?)+$"
+)
+
+
+class ValidEmailTransformer(Transformer):
+    """Email → Binary validity (ValidEmailTransformer.scala)."""
+
+    output_type = Binary
+
+    def __init__(self, uid: str | None = None):
+        super().__init__("validEmail", uid=uid)
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, TextColumn)
+        vals = [
+            bool(_EMAIL_RE.match(v)) if v is not None else None
+            for v in col.values
+        ]
+        from ..types.columns import column_from_values
+
+        return column_from_values(Binary, vals)
+
+
+# A compact sample of high-frequency given names (US census top names,
+# public domain). The reference ships full census dictionaries in its
+# models module; extend via the `names` ctor arg.
+_COMMON_NAMES = frozenset("""
+james john robert michael william david richard joseph thomas charles mary
+patricia jennifer linda elizabeth barbara susan jessica sarah karen nancy
+lisa margaret betty sandra ashley kimberly emily donna michelle carol amanda
+daniel matthew anthony mark donald steven paul andrew joshua kenneth kevin
+brian george timothy ronald edward jason jeffrey ryan jacob gary nicholas
+eric jonathan stephen larry justin scott brandon benjamin samuel gregory
+frank alexander raymond patrick jack dennis jerry tyler aaron jose adam
+henry nathan douglas zachary peter kyle ethan walter noah jeremy christian
+keith roger terry sean austin carl arthur lawrence dylan jesse jordan bryan
+emma olivia ava isabella sophia charlotte mia amelia harper evelyn abigail
+ella scarlett grace chloe victoria riley aria lily aubrey zoey penelope
+lillian addison layla natalie camila hannah brooklyn zoe nora leah savannah
+audrey claire eleanor skylar anna caroline maria christopher
+""".split())
+
+
+class HumanNameDetector(Estimator):
+    """Text → NameStats (HumanNameDetector.scala): decides whether a text
+    column contains person names (dictionary hit-rate >= threshold over the
+    data) and emits per-row name stats. OpenNLP/census data replaced by a
+    compact name dictionary (extendable via ctor)."""
+
+    input_types = (Text,)
+    output_type = NameStats
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        names: frozenset = _COMMON_NAMES,
+        uid: str | None = None,
+    ):
+        super().__init__("humanNameDetector", uid=uid)
+        self.threshold = threshold
+        self.names = frozenset(n.lower() for n in names)
+
+    def get_params(self):
+        return {"threshold": self.threshold}
+
+    def fit_model(self, dataset) -> "HumanNameDetectorModel":
+        col = dataset[self.input_names[0]]
+        assert isinstance(col, TextColumn)
+        hits = total = 0
+        for v in col.values:
+            if not v:
+                continue
+            total += 1
+            toks = tokenize(v)
+            if toks and any(t in self.names for t in toks):
+                hits += 1
+        is_name = total > 0 and (hits / total) >= self.threshold
+        self.metadata["treatAsName"] = bool(is_name)
+        self.metadata["predictedNameProb"] = (hits / total) if total else 0.0
+        return HumanNameDetectorModel(bool(is_name), self.names)
+
+
+class HumanNameDetectorModel(Model):
+    output_type = NameStats
+
+    def __init__(self, treat_as_name: bool, names: frozenset, uid=None):
+        super().__init__("humanNameDetector", uid=uid)
+        self.treat_as_name = treat_as_name
+        self.names = names
+
+    def get_params(self):
+        return {"treat_as_name": self.treat_as_name, "names": sorted(self.names)}
+
+    @classmethod
+    def from_params(cls, params, arrays):
+        return cls(params["treat_as_name"], frozenset(params["names"]))
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        col = cols[0]
+        assert isinstance(col, TextColumn)
+        out = []
+        for v in col.values:
+            if not self.treat_as_name or not v:
+                out.append({"isName": "false"} if v else {})
+                continue
+            toks = tokenize(v)
+            is_name = any(t in self.names for t in toks)
+            stats = {"isName": "true" if is_name else "false"}
+            if is_name:
+                first = next((t for t in toks if t in self.names), "")
+                stats["firstName"] = first
+            out.append(stats)
+        return MapColumn(NameStats, out)
+
+
+class NameEntityRecognizer(Transformer):
+    """Text → MultiPickListMap[entity-kind → tokens]
+    (NameEntityRecognizer.scala): OpenNLP NER replaced by shape heuristics —
+    capitalized token runs become entities, tagged Person when a token is in
+    the name dictionary, else Organization/Location by suffix hints."""
+
+    input_types = (Text,)
+    output_type = MultiPickListMap
+
+    _ORG_HINTS = ("inc", "corp", "llc", "ltd", "co", "company", "corporation")
+    _LOC_HINTS = ("city", "county", "street", "avenue", "lake", "river",
+                  "north", "south", "east", "west")
+
+    def __init__(self, names: frozenset = _COMMON_NAMES, uid: str | None = None):
+        super().__init__("nameEntityRecognizer", uid=uid)
+        self.names = frozenset(n.lower() for n in names)
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        col = cols[0]
+        assert isinstance(col, TextColumn)
+        out = []
+        for v in col.values:
+            if not v:
+                out.append({})
+                continue
+            ents: dict[str, set] = {}
+            for run in re.findall(r"(?:[A-Z][\w'-]*(?:\s+|$))+", v):
+                toks = run.split()
+                lows = [t.lower().strip(".,") for t in toks]
+                if any(t in self.names for t in lows):
+                    kind = "Person"
+                elif any(t in self._ORG_HINTS for t in lows):
+                    kind = "Organization"
+                elif any(t in self._LOC_HINTS for t in lows):
+                    kind = "Location"
+                else:
+                    kind = "Misc"
+                ents.setdefault(kind, set()).update(lows)
+            out.append({k: frozenset(s) for k, s in ents.items()})
+        return MapColumn(MultiPickListMap, out)

@@ -1,0 +1,127 @@
+"""Time-period extraction transformers.
+
+Reference: core/.../stages/impl/feature/{TimePeriodTransformer,
+TimePeriodListTransformer, TimePeriodMapTransformer}.scala — extract one
+calendar period (DayOfMonth/DayOfWeek/DayOfYear/HourOfDay/MonthOfYear/
+WeekOfMonth/WeekOfYear) from Date values as Integral.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+
+import numpy as np
+
+from ..stages.base import Transformer
+from ..types import Date, DateList, Integral, IntegralMap, OPMap
+from ..types.columns import (
+    Column,
+    ListColumn,
+    MapColumn,
+    NumericColumn,
+)
+
+TIME_PERIODS = (
+    "DayOfMonth", "DayOfWeek", "DayOfYear", "HourOfDay",
+    "MonthOfYear", "WeekOfMonth", "WeekOfYear",
+)
+
+
+def period_value(ms: int, period: str) -> int:
+    """One calendar period component from epoch millis (UTC, joda
+    conventions: Monday=1, months 1-12, WeekOfMonth 1-based)."""
+    if period == "HourOfDay":
+        return int((ms // 3_600_000) % 24)
+    if period == "DayOfWeek":
+        return int(((ms // 86_400_000 + 3) % 7) + 1)  # epoch day 0 = Thursday
+    d = _dt.datetime.fromtimestamp(ms / 1000.0, tz=_dt.timezone.utc)
+    if period == "DayOfMonth":
+        return d.day
+    if period == "DayOfYear":
+        return d.timetuple().tm_yday
+    if period == "MonthOfYear":
+        return d.month
+    if period == "WeekOfMonth":
+        return (d.day - 1) // 7 + 1
+    if period == "WeekOfYear":
+        return d.isocalendar()[1]
+    raise ValueError(f"Unknown time period {period}")
+
+
+class TimePeriodTransformer(Transformer):
+    """Date → Integral period (TimePeriodTransformer.scala)."""
+
+    input_types = (Date,)
+    output_type = Integral
+
+    def __init__(self, period: str, uid: str | None = None):
+        super().__init__(f"timePeriod{period}", uid=uid)
+        if period not in TIME_PERIODS:
+            raise ValueError(f"Unknown time period {period}")
+        self.period = period
+
+    def get_params(self):
+        return {"period": self.period}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> NumericColumn:
+        col = cols[0]
+        assert isinstance(col, NumericColumn)
+        vals = np.array(
+            [
+                period_value(int(v), self.period) if m else 0
+                for v, m in zip(col.values, col.mask)
+            ],
+            dtype=np.int64,
+        )
+        return NumericColumn(Integral, vals, col.mask.copy())
+
+
+class TimePeriodListTransformer(Transformer):
+    """DateList → DateList of period values (TimePeriodListTransformer.scala)."""
+
+    input_types = (DateList,)
+    output_type = DateList
+
+    def __init__(self, period: str, uid: str | None = None):
+        super().__init__(f"timePeriodList{period}", uid=uid)
+        if period not in TIME_PERIODS:
+            raise ValueError(f"Unknown time period {period}")
+        self.period = period
+
+    def get_params(self):
+        return {"period": self.period}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> ListColumn:
+        col = cols[0]
+        assert isinstance(col, ListColumn)
+        out = [
+            [period_value(int(v), self.period) for v in row] if row else []
+            for row in col.values
+        ]
+        return ListColumn(DateList, out)
+
+
+class TimePeriodMapTransformer(Transformer):
+    """DateMap → IntegralMap of period values (TimePeriodMapTransformer.scala)."""
+
+    input_types = (OPMap,)
+    output_type = IntegralMap
+
+    def __init__(self, period: str, uid: str | None = None):
+        super().__init__(f"timePeriodMap{period}", uid=uid)
+        if period not in TIME_PERIODS:
+            raise ValueError(f"Unknown time period {period}")
+        self.period = period
+
+    def get_params(self):
+        return {"period": self.period}
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        col = cols[0]
+        assert isinstance(col, MapColumn)
+        out = [
+            {k: period_value(int(v), self.period) for k, v in m.items()}
+            if m
+            else {}
+            for m in col.values
+        ]
+        return MapColumn(IntegralMap, out)

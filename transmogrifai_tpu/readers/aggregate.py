@@ -139,6 +139,24 @@ def _aggregate_feature(
     return agg.present(acc)
 
 
+def _column_for(feature: Feature, vals: list) -> Any:
+    """Build the output column; vector aggregates (CombineVector concatenates
+    per-event vectors) are zero-padded to the longest row so the columnar
+    [N, D] layout stays rectangular."""
+    from ..types import Storage
+
+    if feature.ftype.storage is Storage.VECTOR:
+        import numpy as np
+
+        rows = [np.asarray(v, dtype=np.float32).ravel() for v in vals]
+        width = max((len(r) for r in rows), default=0)
+        out = np.zeros((len(rows), width), dtype=np.float32)
+        for i, r in enumerate(rows):
+            out[i, : len(r)] = r
+        return column_from_values(feature.ftype, out)
+    return column_from_values(feature.ftype, vals)
+
+
 @dataclasses.dataclass
 class AggregateParams:
     """AggregateParams (DataReader.scala:279)."""
@@ -188,7 +206,7 @@ class AggregateReader(DataReader):
                 _aggregate_feature(f, groups[k], cutoff, f.is_response, window)
                 for k in keys
             ]
-            cols[f.name] = column_from_values(f.ftype, vals)
+            cols[f.name] = _column_for(f, vals)
         return Dataset.of(cols)
 
 
@@ -266,7 +284,7 @@ class ConditionalReader(DataReader):
                 _aggregate_feature(f, groups[k], c, f.is_response, window)
                 for k, c in zip(keys, cutoffs)
             ]
-            cols[f.name] = column_from_values(f.ftype, vals)
+            cols[f.name] = _column_for(f, vals)
         return Dataset.of(cols)
 
 

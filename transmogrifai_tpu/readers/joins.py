@@ -145,9 +145,19 @@ def _key_str(v) -> str:
 
 def _gather(col, idx: np.ndarray, n_src: int):
     """Take rows by index; -1 produces a missing row."""
+    from ..types.columns import VectorColumn, column_from_values
+
     missing = idx < 0
     if not missing.any():
         return col.take(idx)
+    if isinstance(col, VectorColumn):
+        # rectangular: unmatched rows become zero vectors, metadata kept
+        src = np.asarray(col.values)
+        out = np.zeros((len(idx), src.shape[1]), dtype=src.dtype)
+        valid = ~missing
+        if valid.any() and n_src:
+            out[valid] = src[idx[valid]]
+        return VectorColumn(col.feature_type, out, col.metadata)
     if missing.all() or n_src == 0:
         return empty_like(col.feature_type, len(idx))
     # take valid rows then splice in missing rows
@@ -160,6 +170,4 @@ def _gather(col, idx: np.ndarray, n_src: int):
         if m:
             vals[i] = evals[j]
             j += 1
-    from ..types.columns import column_from_values
-
     return column_from_values(col.feature_type, vals)

@@ -1,0 +1,37 @@
+"""Callable serialization for stage persistence.
+
+The reference serializes stage lambdas by class name (Scala lambdas are
+classes, features/.../OpPipelineStageReaderWriter.scala). The Python
+equivalent: pickle module-level callables to base64. Lambdas/closures are
+rejected AT SAVE TIME with a clear error, matching the reference's
+checkSerializable gate (OpWorkflow.scala:280-287) — failing at load time
+would strand a saved model.
+"""
+from __future__ import annotations
+
+import base64
+import pickle
+from typing import Any, Callable
+
+
+def encode_callable(fn: Callable | None, owner: str, param: str) -> str | None:
+    """Pickle a callable param to base64; None passes through."""
+    if fn is None:
+        return None
+    try:
+        blob = pickle.dumps(fn)
+        pickle.loads(blob)  # round-trip check (catches unimportable defs)
+    except Exception as e:
+        raise ValueError(
+            f"{owner}: param '{param}' is not serializable ({e}). Use a "
+            "module-level function instead of a lambda/closure so the saved "
+            "workflow can be loaded."
+        ) from None
+    return base64.b64encode(blob).decode("ascii")
+
+
+def decode_callable(value: Any) -> Any:
+    """Inverse of encode_callable; non-string values pass through."""
+    if isinstance(value, str):
+        return pickle.loads(base64.b64decode(value.encode("ascii")))
+    return value

@@ -47,8 +47,11 @@ def _registry() -> dict[str, type]:
     from ..models import glm, gbdt, isotonic, linear, logistic, mlp, naive_bayes, svc
     from ..models.base import PredictorModel
     from ..ops import (
-        categorical, combiner, dates, lists, maps, numeric, phone, text,
+        bucketizers, categorical, combiner, dates, domains, embeddings,
+        lists, maps, numeric, phone, scalers, simple, text, text_stages,
+        time_period,
     )
+    from ..ops import math as ops_math
     from ..prep import derived_filter, sanity_checker
     from ..selector import model_selector
 
@@ -57,6 +60,8 @@ def _registry() -> dict[str, type]:
         categorical, combiner, dates, lists,
         maps, numeric, phone, text, derived_filter, sanity_checker,
         model_selector, loco,
+        bucketizers, domains, embeddings, ops_math, scalers, simple,
+        text_stages, time_period,
     ):
         for name in dir(module):
             obj = getattr(module, name)
