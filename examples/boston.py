@@ -1,0 +1,34 @@
+"""Boston housing regression (reference: OpBostonSimple.scala)."""
+import json
+
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.readers.csv import infer_csv_dataset
+from transmogrifai_tpu.selector import RegressionModelSelector
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+DATA = "/root/reference/helloworld/src/main/resources/BostonDataset/housingData.csv"
+HEADERS = [
+    "rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age",
+    "dis", "rad", "tax", "ptratio", "b", "lstat", "medv",
+]
+
+
+def main():
+    ds = infer_csv_dataset(DATA, headers=HEADERS, has_header=False)
+    medv, predictors = from_dataset(ds, response="medv")
+    predictors = [p for p in predictors if p.name != "rowId"]
+    feature_vector = transmogrify(predictors)
+    prediction = (
+        RegressionModelSelector(seed=42)
+        .set_input(medv, feature_vector)
+        .get_output()
+    )
+    model = Workflow().set_result_features(prediction).set_input_dataset(ds).train()
+    holdout = model.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]
+    print(json.dumps(holdout, indent=2))
+    return model
+
+
+if __name__ == "__main__":
+    main()

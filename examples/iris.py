@@ -1,0 +1,36 @@
+"""Iris multiclass classification (reference: OpIrisSimple.scala)."""
+import json
+
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.readers.csv import infer_csv_dataset
+from transmogrifai_tpu.selector import MultiClassificationModelSelector
+from transmogrifai_tpu.ops.text_stages import OpStringIndexer
+from transmogrifai_tpu.workflow.workflow import Workflow
+import transmogrifai_tpu.types as T
+
+DATA = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data"
+HEADERS = ["sepalLength", "sepalWidth", "petalLength", "petalWidth", "irisClass"]
+
+
+def main():
+    ds = infer_csv_dataset(DATA, headers=HEADERS, has_header=False)
+    label_text, predictors = from_dataset(
+        ds, response="irisClass", response_type=T.PickList
+    )
+    # index the text label into RealNN class ids (OpIrisSimple.scala:58)
+    label = label_text.string_indexed()
+    feature_vector = transmogrify(predictors)
+    prediction = (
+        MultiClassificationModelSelector(seed=42)
+        .set_input(label, feature_vector)
+        .get_output()
+    )
+    model = Workflow().set_result_features(prediction).set_input_dataset(ds).train()
+    holdout = model.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]
+    print(json.dumps(holdout, indent=2))
+    return model
+
+
+if __name__ == "__main__":
+    main()

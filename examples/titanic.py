@@ -1,0 +1,49 @@
+"""Titanic binary classification — the README flagship flow.
+
+Reference: helloworld/.../OpTitanicSimple.scala:30-130. Run:
+    python examples/titanic.py
+"""
+import json
+
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.readers.csv import infer_csv_dataset
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+DATA = "/root/reference/helloworld/src/main/resources/TitanicDataset/TitanicPassengersTrainData.csv"
+
+
+HEADERS = [
+    "id", "survived", "pClass", "name", "sex", "age",
+    "sibSp", "parCh", "ticket", "fare", "cabin", "embarked",
+]
+
+
+def main():
+    ds = infer_csv_dataset(DATA, headers=HEADERS, has_header=False)
+    survived, predictors = from_dataset(ds, response="survived")
+    predictors = [p for p in predictors if p.name not in ("id", "name", "ticket")]
+
+    # a little manual feature engineering on top (OpTitanicSimple.scala:60-72)
+    by = {p.name: p for p in predictors}
+    family_size = (by["sibSp"] + by["parCh"] + 1).alias("familySize")
+    predictors = list(predictors) + [family_size]
+
+    feature_vector = transmogrify(predictors)
+    checked = survived.sanity_check(feature_vector, remove_bad_features=True)
+    prediction = (
+        BinaryClassificationModelSelector(seed=42)
+        .set_input(survived, checked)
+        .get_output()
+    )
+    model = Workflow().set_result_features(prediction).set_input_dataset(ds).train()
+    print(model.summary_pretty())
+    holdout = model.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]
+    print(json.dumps(holdout, indent=2))
+    return model
+
+
+if __name__ == "__main__":
+    main()

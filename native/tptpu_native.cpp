@@ -1,0 +1,214 @@
+// tptpu_native — C++ host-side kernels for the data/ingest plane.
+//
+// The reference delegates its native heavy lifting to JVM-external libraries
+// (SURVEY.md §2.5: libxgboost via JNI, netlib BLAS, Lucene). Device math
+// here lives in XLA; this library covers the HOST hot loops the reference
+// runs on the JVM: CSV field→number parsing (readers module) and
+// MurmurHash3 feature hashing (OPCollectionHashingVectorizer /
+// SmartTextVectorizer hashing path).
+//
+// ABI: plain C functions over flat buffers (ctypes-friendly, no pybind11).
+// Strings arrive as one concatenated UTF-8 buffer + an int64 offsets array
+// of length n+1 (offsets[i]..offsets[i+1] is value i).
+//
+// Build: `make` in this directory → libtptpu.so (see Makefile).
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+extern "C" {
+
+// ---------------------------------------------------------------- murmur3
+// MurmurHash3 x86 32-bit, bit-identical to utils/text.py murmur3_32 (and to
+// the reference's com.twitter.algebird / scala.util.hashing.MurmurHash3 use
+// for feature hashing).
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+  uint32_t h = seed;
+  const int64_t nblocks = len / 4;
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + i * 4, 4);  // little-endian load
+    k *= c1;
+    k = rotl32(k, 15);
+    k *= c2;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xe6546b64u;
+  }
+  uint32_t k = 0;
+  const uint8_t* tail = data + nblocks * 4;
+  switch (len & 3) {
+    case 3: k ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+    case 2: k ^= (uint32_t)tail[1] << 8;  [[fallthrough]];
+    case 1:
+      k ^= tail[0];
+      k *= c1;
+      k = rotl32(k, 15);
+      k *= c2;
+      h ^= k;
+  }
+  h ^= (uint32_t)len;
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+// Hash n strings (concatenated buffer + offsets[n+1]) into out[n].
+void tp_murmur3_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                      uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+// Hash n strings straight into bucket counts: rows[i] gives the output row
+// of string i; out is a dense [num_rows, num_buckets] float32 matrix.
+// binary != 0 sets presence instead of accumulating counts. This fuses the
+// hash + scatter of hash_block/OpHashingTF into one pass.
+void tp_murmur3_scatter(const uint8_t* buf, const int64_t* offsets,
+                        const int64_t* rows, int64_t n, uint32_t seed,
+                        int64_t num_buckets, int binary, float* out,
+                        int64_t out_cols) {
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t h = murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+    int64_t j = (int64_t)(h % (uint32_t)num_buckets);
+    float* cell = out + rows[i] * out_cols + j;
+    if (binary) {
+      *cell = 1.0f;
+    } else {
+      *cell += 1.0f;
+    }
+  }
+}
+
+// ------------------------------------------------------------- CSV parsing
+// Parse n decimal strings into out[n] with validity mask[n] (0 = missing /
+// unparseable). Empty and whitespace-only fields are missing. Grammar
+// matches Python float(): strtod plus underscore digit grouping ("1_000").
+void tp_parse_doubles(const char* buf, const int64_t* offsets, int64_t n,
+                      double* out, uint8_t* mask) {
+  std::string heap;  // reused scratch for long / underscore-grouped fields
+  for (int64_t i = 0; i < n; i++) {
+    const char* s = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    // skip leading whitespace; empty -> missing
+    int64_t a = 0;
+    while (a < len && std::isspace((unsigned char)s[a])) a++;
+    int64_t m = len - a;
+    if (m <= 0) {
+      out[i] = 0.0;
+      mask[i] = 0;
+      continue;
+    }
+    // strtod needs NUL termination; copy (dropping Python-style underscore
+    // digit separators) to a stack buffer, spilling to heap for long fields
+    char tmp[64];
+    char* dst = tmp;
+    if (m >= (int64_t)sizeof(tmp)) {
+      heap.assign((size_t)m + 1, '\0');
+      dst = heap.data();
+    }
+    int64_t w = 0;
+    bool bad_underscore = false;
+    for (int64_t k = 0; k < m; k++) {
+      char c = s[a + k];
+      if (c == '_') {
+        // Python allows '_' only BETWEEN digits
+        bool prev_digit = k > 0 && std::isdigit((unsigned char)s[a + k - 1]);
+        bool next_digit =
+            k + 1 < m && std::isdigit((unsigned char)s[a + k + 1]);
+        if (!prev_digit || !next_digit) {
+          bad_underscore = true;
+          break;
+        }
+        continue;
+      }
+      dst[w++] = c;
+    }
+    if (bad_underscore) {
+      out[i] = 0.0;
+      mask[i] = 0;
+      continue;
+    }
+    dst[w] = '\0';
+    char* end = nullptr;
+    double v = std::strtod(dst, &end);
+    // trailing whitespace ok, anything else -> unparseable
+    while (end && *end && std::isspace((unsigned char)*end)) end++;
+    if (end == dst || (end && *end != '\0')) {
+      out[i] = 0.0;
+      mask[i] = 0;
+    } else {
+      out[i] = v;
+      mask[i] = 1;
+    }
+  }
+}
+
+// Split one CSV buffer into fields (RFC-4180 quoting: "" escapes a quote
+// inside a quoted field). Writes field boundaries as (start, end) pairs and
+// row ids; returns the number of fields found, or -(needed) if the caps are
+// too small. Callers then slice the original buffer — zero copies.
+int64_t tp_csv_split(const char* buf, int64_t len, char delim,
+                     int64_t* field_start, int64_t* field_end,
+                     int64_t* field_row, int64_t max_fields) {
+  int64_t nf = 0;
+  int64_t row = 0;
+  int64_t i = 0;
+  while (i < len) {
+    // one field
+    int64_t start, end;
+    if (buf[i] == '"') {
+      start = ++i;
+      // scan to closing quote, collapsing "" later (flagged by caller via
+      // memchr for '"' in the slice — rare path)
+      while (i < len) {
+        if (buf[i] == '"') {
+          if (i + 1 < len && buf[i + 1] == '"') {
+            i += 2;
+            continue;
+          }
+          break;
+        }
+        i++;
+      }
+      end = i;
+      if (i < len) i++;  // closing quote
+    } else {
+      start = i;
+      while (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r') i++;
+      end = i;
+    }
+    if (nf >= max_fields) return -(nf + 1);
+    field_start[nf] = start;
+    field_end[nf] = end;
+    field_row[nf] = row;
+    nf++;
+    // separator handling
+    if (i < len && buf[i] == delim) {
+      i++;
+      // trailing delimiter at EOL is handled by the loop producing the next
+      // (possibly empty) field
+      continue;
+    }
+    if (i < len && (buf[i] == '\r' || buf[i] == '\n')) {
+      if (buf[i] == '\r' && i + 1 < len && buf[i + 1] == '\n') i++;
+      i++;
+      row++;
+    }
+  }
+  return nf;
+}
+
+}  // extern "C"

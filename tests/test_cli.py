@@ -1,0 +1,86 @@
+"""CLI project-generator tests (reference: cli/src/test/.../CliExecTest)."""
+import json
+import os
+
+import numpy as np
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.cli import generate_project, infer_problem_kind, main
+from transmogrifai_tpu.types.columns import column_from_values
+
+
+class TestProblemKind:
+    def test_binary(self):
+        col = column_from_values(T.Integral, [0, 1, 1, 0, None])
+        assert infer_problem_kind(col, 5) == "BinaryClassification"
+
+    def test_multiclass_text(self):
+        col = column_from_values(T.Text, ["a", "b", "c", "a"])
+        assert infer_problem_kind(col, 4) == "MultiClassification"
+
+    def test_multiclass_small_int(self):
+        col = column_from_values(T.Integral, [0, 1, 2, 3, 2, 1])
+        assert infer_problem_kind(col, 6) == "MultiClassification"
+
+    def test_regression(self):
+        col = column_from_values(T.Real, list(np.linspace(0, 10, 50)))
+        assert infer_problem_kind(col, 50) == "Regression"
+
+
+class TestGenerateProject:
+    def test_gen_titanic(self, tmp_path):
+        out = str(tmp_path / "proj")
+        info = generate_project(
+            "/root/reference/test-data/PassengerDataAllWithHeader.csv",
+            response="Survived",
+            output_dir=out,
+            id_field="PassengerId",
+            project_name="TitanicGen",
+        )
+        assert info["kind"] == "BinaryClassification"
+        for f in ("main.py", "README.md", "params.json"):
+            assert os.path.exists(os.path.join(out, f))
+        src = open(os.path.join(out, "main.py")).read()
+        assert "BinaryClassificationModelSelector" in src
+        assert "Survived" in src
+        compile(src, "main.py", "exec")  # generated code parses
+
+    def test_cli_main(self, tmp_path, capsys):
+        out = str(tmp_path / "proj2")
+        main([
+            "gen", "--input",
+            "/root/reference/test-data/PassengerDataAllWithHeader.csv",
+            "--response", "Survived", "--output", out,
+        ])
+        printed = json.loads(capsys.readouterr().out.strip())
+        assert printed["kind"] == "BinaryClassification"
+
+    def test_missing_response_errors(self, tmp_path):
+        import pytest
+
+        with pytest.raises(SystemExit):
+            generate_project(
+                "/root/reference/test-data/PassengerDataAllWithHeader.csv",
+                response="NoSuchColumn",
+                output_dir=str(tmp_path / "x"),
+            )
+
+
+class TestTextResponseGen:
+    def test_gen_text_label_project(self, tmp_path):
+        """A string-labeled response generates the PickList+index pattern."""
+        import csv as _csv
+
+        data = tmp_path / "flowers.csv"
+        with open(data, "w", newline="") as f:
+            w = _csv.writer(f)
+            w.writerow(["a", "b", "species"])
+            for i in range(30):
+                w.writerow([i * 0.1, i * 0.2, ["setosa", "virginica", "versicolor"][i % 3]])
+        out = str(tmp_path / "proj")
+        info = generate_project(str(data), response="species", output_dir=out)
+        assert info["kind"] == "MultiClassification"
+        src = open(os.path.join(out, "main.py")).read()
+        assert "response_type=T.PickList" in src
+        assert "string_indexed" in src
+        compile(src, "main.py", "exec")

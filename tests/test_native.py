@@ -1,0 +1,46 @@
+"""Native (C++) host-kernel tests: parity with the Python implementations."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import native
+from transmogrifai_tpu.utils.text import murmur3_32
+
+
+class TestNative:
+    def test_murmur3_parity(self):
+        vals = ["hello", "", "a", "héllo çà", "x" * 133, "tab\tsep"]
+        h = native.murmur3_batch(vals, seed=42)
+        assert list(h) == [murmur3_32(v, 42) for v in vals]
+        h7 = native.murmur3_batch(vals, seed=7)
+        assert list(h7) == [murmur3_32(v, 7) for v in vals]
+
+    def test_parse_doubles(self):
+        out, mask = native.parse_doubles(
+            ["1.5", " 2 ", "", "abc", "-3e2", None, "0.0", "1e400"]
+        )
+        assert list(mask[:7]) == [True, True, False, False, True, False, True]
+        np.testing.assert_allclose(out[[0, 1, 4, 6]], [1.5, 2.0, -300.0, 0.0])
+
+    def test_scatter_counts(self):
+        rows = np.array([0, 0, 1, 1, 1], dtype=np.int64)
+        out = native.murmur3_scatter(["a", "b", "a", "a", "c"], rows, 2, 16)
+        assert out.sum() == 5.0
+        ja = murmur3_32("a", 42) % 16
+        assert out[1, ja] == 2.0
+        outb = native.murmur3_scatter(
+            ["a", "a"], np.array([0, 0], dtype=np.int64), 1, 16, binary=True
+        )
+        assert outb.sum() == 1.0
+
+    def test_scatter_matches_python_fallback(self):
+        rng = np.random.default_rng(0)
+        tokens = [f"tok{v}" for v in rng.integers(0, 50, 500)]
+        rows = np.sort(rng.integers(0, 20, 500)).astype(np.int64)
+        a = native.murmur3_scatter(tokens, rows, 20, 64)
+        b = np.zeros((20, 64), dtype=np.float32)
+        native._scatter_py(tokens, rows, 64, 42, False, b, 0)
+        np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.skipif(not native.available(), reason="no toolchain")
+    def test_native_is_active_in_ci(self):
+        assert native.available()

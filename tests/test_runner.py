@@ -1,0 +1,199 @@
+"""WorkflowRunner run-type tests (reference: OpWorkflowRunnerTest)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.readers import DatasetReader, StreamingReader
+from transmogrifai_tpu.runner import (
+    OpParams,
+    OpStep,
+    OpWorkflowRunType,
+    WorkflowRunner,
+    parse_args,
+)
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    n = 150
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = (x1 + 0.5 * x2 > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    pred = BinaryClassificationModelSelector(seed=5).set_input(resp, vec).get_output()
+    wf = Workflow().set_result_features(pred)
+    root = tmp_path_factory.mktemp("runner")
+    return ds, wf, pred, str(root)
+
+
+class TestWorkflowRunner:
+    def test_train_then_score_then_evaluate(self, setup):
+        ds, wf, pred, root = setup
+        model_loc = os.path.join(root, "model")
+        write_loc = os.path.join(root, "scores")
+        metrics_loc = os.path.join(root, "metrics")
+        runner = WorkflowRunner(
+            wf,
+            train_reader=DatasetReader(ds),
+            score_reader=DatasetReader(ds),
+            app_name="test-app",
+        )
+        params = OpParams(
+            model_location=model_loc,
+            write_location=write_loc,
+            metrics_location=metrics_loc,
+        )
+
+        out = runner.run(OpWorkflowRunType.TRAIN, params)
+        assert out.model_summary is not None
+        assert os.path.exists(os.path.join(model_loc, "manifest.json"))
+        phases = [p["step"] for p in out.app_metrics["phases"]]
+        assert OpStep.CROSS_VALIDATION.value in phases
+        assert OpStep.MODEL_IO.value in phases
+
+        out = runner.run(OpWorkflowRunType.SCORE, params)
+        assert out.scores is not None and len(out.scores) == len(ds)
+        assert os.path.exists(os.path.join(write_loc, "part-00000.csv"))
+
+        out = runner.run(OpWorkflowRunType.EVALUATE, params)
+        assert out.metrics is not None
+        assert out.metrics["AuROC"] > 0.8
+        assert os.path.exists(os.path.join(metrics_loc, "eval.json"))
+        assert os.path.exists(os.path.join(metrics_loc, "metrics.json"))
+
+    def test_streaming_score(self, setup):
+        ds, wf, pred, root = setup
+        model_loc = os.path.join(root, "model")
+        rows = ds.rows()
+        batches = [rows[:50], rows[50:100], rows[100:]]
+
+        def to_ds(batch):
+            return Dataset.of({
+                name: column_from_values(ds[name].feature_type,
+                                         [r[name] for r in batch])
+                for name in ds.columns
+            })
+
+        # streaming via dataset-per-batch readers
+        class DsStream(StreamingReader):
+            def stream_datasets(self, raw_features):
+                for b in batches:
+                    yield to_ds(b)
+
+        runner = WorkflowRunner(wf, streaming_reader=DsStream([]))
+        out = runner.run(
+            OpWorkflowRunType.STREAMING_SCORE,
+            OpParams(model_location=model_loc),
+        )
+        assert len(out.score_batches) == 3
+        assert sum(len(b) for b in out.score_batches) == len(ds)
+
+    def test_features_run_type(self, setup):
+        ds, wf, pred, root = setup
+        runner = WorkflowRunner(wf, train_reader=DatasetReader(ds))
+        out = runner.run(OpWorkflowRunType.FEATURES)
+        assert out.features is not None
+        # feature-vector column present, no prediction column
+        assert pred.name not in out.features.columns
+        assert any(
+            c for c in out.features.columns if "vecCombined" in c or "combined" in c.lower()
+        ) or len(out.features.columns) > 3
+
+    def test_app_end_handler(self, setup):
+        ds, wf, pred, root = setup
+        seen = {}
+        runner = WorkflowRunner(wf, train_reader=DatasetReader(ds))
+        runner.add_application_end_handler(lambda m: seen.update(m))
+        runner.run(OpWorkflowRunType.FEATURES)
+        assert seen["appName"] == "op-app"
+        assert seen["phases"]
+
+    def test_stage_param_overrides(self, setup):
+        """OpParams.stage_params applied by class name before fit
+        (OpWorkflow.setStageParameters parity)."""
+        ds, _, _, _ = setup
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        pred = (
+            BinaryClassificationModelSelector(seed=5)
+            .set_input(resp, vec)
+            .get_output()
+        )
+        wf = Workflow().set_result_features(pred)
+        runner = WorkflowRunner(wf, train_reader=DatasetReader(ds))
+        params = OpParams(
+            stage_params={
+                "BinaryClassificationModelSelector": {"parallelism": 2}
+            }
+        )
+        out = runner.run(OpWorkflowRunType.TRAIN, params)
+        assert out.model_summary is not None
+
+
+class TestOpParams:
+    def test_json_yaml_round_trip(self, tmp_path):
+        p = OpParams(
+            stage_params={"SanityChecker": {"max_correlation": 0.9}},
+            model_location="/tmp/m",
+            custom_params={"note": "hi"},
+        )
+        jpath = tmp_path / "params.json"
+        jpath.write_text(p.to_json())
+        p2 = OpParams.from_file(str(jpath))
+        assert p2.stage_params == p.stage_params
+        assert p2.model_location == "/tmp/m"
+
+        ypath = tmp_path / "params.yaml"
+        ypath.write_text(
+            "stage_params:\n  SanityChecker:\n    max_correlation: 0.9\n"
+            "model_location: /tmp/m\n"
+        )
+        p3 = OpParams.from_file(str(ypath))
+        assert p3.stage_params["SanityChecker"]["max_correlation"] == 0.9
+
+    def test_parse_args(self, tmp_path):
+        run_type, params = parse_args(
+            ["Train", "--model-location", "/tmp/m", "--foo", "bar"]
+        )
+        assert run_type is OpWorkflowRunType.TRAIN
+        assert params.model_location == "/tmp/m"
+        assert params.custom_params["foo"] == "bar"
+
+        jpath = tmp_path / "p.json"
+        jpath.write_text(json.dumps({"model_location": "/x"}))
+        _, p2 = parse_args(["Score", "--param-location", str(jpath)])
+        assert p2.model_location == "/x"
+
+
+class TestRunnerFixes:
+    def test_score_without_label_column(self, setup):
+        """Score-time data lacks the response column (the normal case)."""
+        ds, wf, pred, root = setup
+        model_loc = os.path.join(root, "model2")
+        runner = WorkflowRunner(wf, train_reader=DatasetReader(ds))
+        runner.run(OpWorkflowRunType.TRAIN, OpParams(model_location=model_loc))
+        unlabeled = ds.drop(["label"])
+        r2 = WorkflowRunner(wf, score_reader=DatasetReader(unlabeled))
+        out = r2.run(OpWorkflowRunType.SCORE, OpParams(model_location=model_loc))
+        assert len(out.scores) == len(ds)
+
+    def test_parse_args_dict_field(self):
+        _, p = parse_args(["Train", "--stage-params",
+                           '{"SanityChecker": {"max_correlation": 0.8}}'])
+        assert p.stage_params["SanityChecker"]["max_correlation"] == 0.8

@@ -1,0 +1,147 @@
+"""testkit generator tests (reference: testkit/src/test/.../testkit/)."""
+import numpy as np
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.testkit import (
+    RandomBinary,
+    RandomIntegral,
+    RandomList,
+    RandomMap,
+    RandomReal,
+    RandomSet,
+    RandomText,
+    RandomVector,
+    random_dataset,
+)
+
+
+class TestRandomGenerators:
+    def test_deterministic_with_seed(self):
+        a = RandomReal.normal(seed=7).limit(10)
+        b = RandomReal.normal(seed=7).limit(10)
+        assert a == b
+        c = RandomReal.normal(seed=8).limit(10)
+        assert a != c
+
+    def test_probability_of_empty(self):
+        vals = RandomReal.uniform(seed=1).with_probability_of_empty(0.5).limit(400)
+        empties = sum(1 for v in vals if v is None)
+        assert 120 < empties < 280
+
+    def test_distributions_plausible(self):
+        n = RandomReal.normal(mean=10, sigma=0.1, seed=2).limit(500)
+        assert abs(np.mean(n) - 10) < 0.05
+        u = RandomReal.uniform(2.0, 4.0, seed=2).limit(500)
+        assert 2.0 <= min(u) and max(u) <= 4.0
+        p = RandomReal.poisson(mean=3.0, seed=2).limit(500)
+        assert abs(np.mean(p) - 3.0) < 0.4
+        e = RandomReal.exponential(mean=2.0, seed=2).limit(1000)
+        assert abs(np.mean(e) - 2.0) < 0.3
+
+    def test_integrals_and_dates(self):
+        ints = RandomIntegral.integrals(5, 10, seed=3).limit(100)
+        assert all(5 <= v < 10 for v in ints)
+        dates = RandomIntegral.dates(seed=3).limit(10)
+        assert all(isinstance(v, int) and v >= 1_300_000_000_000 for v in dates)
+
+    def test_binary(self):
+        vals = RandomBinary.of(0.8, seed=4).limit(500)
+        assert 0.7 < np.mean([1.0 if v else 0.0 for v in vals]) < 0.9
+
+    def test_text_domains(self):
+        picks = RandomText.pick_lists(["a", "b"], distribution=[0.9, 0.1], seed=5)
+        vals = picks.limit(300)
+        assert vals.count("a") > 200
+        assert set(vals) <= {"a", "b"}
+        countries = RandomText.countries(seed=5).limit(20)
+        assert all(isinstance(c, str) and c for c in countries)
+
+    def test_emails_phones_urls(self):
+        emails = RandomText.emails("corp.co", seed=6).limit(5)
+        assert all(e.endswith("@corp.co") for e in emails)
+        phones = RandomText.phones(seed=6).limit(5)
+        assert all(p.startswith("+1") and len(p) >= 11 for p in phones)
+        urls = RandomText.urls(seed=6).limit(5)
+        assert all(u.startswith("https://") for u in urls)
+        bad = RandomText.phones_with_errors(1.0, seed=6).limit(5)
+        assert all(len(p) <= 3 for p in bad)
+
+    def test_unique_ids(self):
+        ids = RandomText.unique_ids(seed=7).limit(100)
+        assert len(set(ids)) == 100
+
+    def test_collections(self):
+        lists = RandomList.of_texts(min_len=1, max_len=3, seed=8).limit(50)
+        assert all(1 <= len(x) <= 3 for x in lists)
+        sets_ = RandomSet.of(["x", "y", "z"], seed=8).limit(50)
+        assert all(isinstance(s, frozenset) for s in sets_)
+        geos = RandomList.of_geolocations(seed=8).limit(10)
+        assert all(len(g) == 3 and -90 <= g[0] <= 90 for g in geos)
+
+    def test_maps(self):
+        m = RandomMap.of(RandomReal.uniform(seed=9), T.RealMap, keys=["a", "b"], seed=9)
+        vals = m.limit(50)
+        assert all(set(v) <= {"a", "b"} for v in vals)
+
+    def test_vectors(self):
+        col = RandomVector.dense(4, seed=10).to_column(6)
+        assert np.asarray(col.values).shape == (6, 4)
+
+    def test_random_dataset_assembly(self):
+        ds = random_dataset(
+            {
+                "age": RandomReal.uniform(18, 80, ftype=T.Real),
+                "city": RandomText.pick_lists(["sf", "la"]),
+                "active": RandomBinary.of(0.5),
+            },
+            n=25,
+            seed=11,
+        )
+        assert len(ds) == 25
+        assert ds["age"].feature_type is T.Real
+        assert ds["city"].feature_type is T.PickList
+
+    def test_generators_feed_workflow(self):
+        """End-to-end: testkit data through transmogrify + selector."""
+        from transmogrifai_tpu.features import from_dataset
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+        from transmogrifai_tpu.workflow.workflow import Workflow
+        from transmogrifai_tpu.types.columns import column_from_values
+
+        ds = random_dataset(
+            {
+                "x1": RandomReal.normal(0, 1),
+                "x2": RandomReal.uniform(0, 1).with_probability_of_empty(0.1),
+                "cat": RandomText.pick_lists(["a", "b", "c"]),
+            },
+            n=120,
+            seed=12,
+        )
+        x1 = np.asarray(ds["x1"].values)
+        label = (x1 > 0).astype(float)
+        ds = ds.with_column("label", column_from_values(T.RealNN, label))
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        pred = BinaryClassificationModelSelector(seed=1).set_input(resp, vec).get_output()
+        model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        sel = model.summary_json()["modelSelectorSummary"]
+        assert sel["holdoutEvaluation"]["AuROC"] > 0.9
+
+
+class TestReproducibilityFixes:
+    def test_unique_ids_reproducible_per_stream(self):
+        g = RandomText.unique_ids(seed=7)
+        assert g.limit(3) == g.limit(3) == ["id_00000001", "id_00000002", "id_00000003"]
+
+    def test_map_source_probability_of_empty_respected(self):
+        src = RandomReal.uniform(seed=9).with_probability_of_empty(0.8)
+        m = RandomMap.of(src, T.RealMap, keys=["a", "b", "c"], min_size=3, seed=9)
+        vals = m.limit(200)
+        sizes = [len(v) for v in vals]
+        assert min(sizes) < 3  # empties removed keys
+
+    def test_list_source_probability_of_empty_respected(self):
+        src = RandomText.strings(seed=9).with_probability_of_empty(0.9)
+        lists = RandomList.of_texts(src, min_len=5, max_len=5, seed=9).limit(100)
+        assert np.mean([len(x) for x in lists]) < 2.0

@@ -1,0 +1,187 @@
+"""Warm start, workflow-level CV, and SelectedModelCombiner tests.
+
+Mirrors the reference's OpWorkflowCVTest and SelectedModelCombinerTest."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector,
+    CombinationStrategy,
+    RegressionModelSelector,
+    SelectedModelCombiner,
+)
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+
+def _binary_ds(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = (x1 + 0.5 * x2 + 0.3 * rng.normal(size=n) > 0).astype(float)
+    return Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+    })
+
+
+def _graph(ds, selector_factory=None, sanity_check=True):
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    checked = (
+        resp.transform_with(SanityChecker(remove_bad_features=True), vec)
+        if sanity_check
+        else vec
+    )
+    factory = selector_factory or (
+        lambda: BinaryClassificationModelSelector(seed=3)
+    )
+    selector = factory()
+    pred = selector.set_input(resp, checked).get_output()
+    return resp, pred, selector
+
+
+class TestWarmStart:
+    def test_with_model_stages_skips_refit(self):
+        ds = _binary_ds()
+        resp, pred, selector = _graph(ds)
+        model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+
+        # warm start: same DAG, fitted stages swapped in by uid
+        wf2 = (
+            Workflow()
+            .set_result_features(pred)
+            .set_input_dataset(ds)
+            .with_model_stages(model)
+        )
+        fit_calls = []
+        orig_fit = SanityChecker.fit
+
+        def spy(self, dataset):
+            fit_calls.append(self.uid)
+            return orig_fit(self, dataset)
+
+        SanityChecker.fit = spy
+        try:
+            model2 = wf2.train()
+        finally:
+            SanityChecker.fit = orig_fit
+        assert fit_calls == []  # nothing re-fit
+        s1 = model.score(dataset=ds)
+        s2 = model2.score(dataset=ds)
+        np.testing.assert_allclose(
+            s1[pred.name].prediction, s2[pred.name].prediction
+        )
+
+
+class TestWorkflowCV:
+    def test_workflow_cv_trains_and_selects(self):
+        ds = _binary_ds()
+        resp, pred, selector = _graph(ds)
+        model = (
+            Workflow()
+            .set_result_features(pred)
+            .set_input_dataset(ds)
+            .with_workflow_cv()
+            .train()
+        )
+        summary = model.summary_json()["modelSelectorSummary"]
+        assert summary["validationResults"]
+        # per-fold metrics exist for the winning candidate
+        best = [
+            r for r in summary["validationResults"]
+            if r["modelName"] == summary["bestModelType"]
+        ]
+        assert best and all(len(r["metricValues"]) >= 2 for r in best)
+        assert summary["holdoutEvaluation"]["AuROC"] > 0.7
+
+    def test_workflow_cv_comparable_to_selector_cv(self):
+        """Workflow CV should produce similar (not wildly different) quality
+        to selector-level CV on clean data (OpWorkflowCVTest parity)."""
+        ds = _binary_ds(seed=1)
+        _, pred1, _ = _graph(ds)
+        m1 = Workflow().set_result_features(pred1).set_input_dataset(ds).train()
+        _, pred2, _ = _graph(ds)
+        m2 = (
+            Workflow()
+            .set_result_features(pred2)
+            .set_input_dataset(ds)
+            .with_workflow_cv()
+            .train()
+        )
+        a1 = m1.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]["AuROC"]
+        a2 = m2.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]["AuROC"]
+        assert abs(a1 - a2) < 0.25
+
+
+class TestSelectedModelCombiner:
+    def _selectors(self):
+        from transmogrifai_tpu.models.gbdt import RandomForestClassifier
+
+        s1 = BinaryClassificationModelSelector(
+            models=[(LogisticRegression(), {"reg_param": [0.01, 0.1]})], seed=3
+        )
+        s2 = BinaryClassificationModelSelector(
+            models=[(RandomForestClassifier(), {"max_depth": [3]})], seed=3
+        )
+        return s1, s2
+
+    def test_best_strategy_picks_winner(self):
+        ds = _binary_ds(seed=2)
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        s1, s2 = self._selectors()
+        comb = SelectedModelCombiner(s1, s2, CombinationStrategy.BEST)
+        pred = comb.set_input(resp, vec).get_output()
+        model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        summary = model.summary_json()["modelSelectorSummary"]
+        assert summary["combinationStrategy"] == "Best"
+        assert summary["bestModelType"] in (
+            "LogisticRegression", "RandomForestClassifier"
+        )
+        # validation results from BOTH selectors present
+        names = {r["modelName"] for r in summary["validationResults"]}
+        assert {"LogisticRegression", "RandomForestClassifier"} <= names
+
+    def test_weighted_strategy_combines_probabilities(self):
+        ds = _binary_ds(seed=4)
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        s1, s2 = self._selectors()
+        comb = SelectedModelCombiner(s1, s2, CombinationStrategy.WEIGHTED)
+        pred = comb.set_input(resp, vec).get_output()
+        model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        summary = model.summary_json()["modelSelectorSummary"]
+        assert summary["bestModelType"] == "CombinedModel"
+        w = summary["weights"]
+        assert len(w) == 2 and abs(sum(w) - 1.0) < 1e-9
+        assert summary["holdoutEvaluation"]["AuROC"] > 0.7
+        scored = model.score(dataset=ds)
+        probs = scored[pred.name].probability
+        np.testing.assert_allclose(np.asarray(probs).sum(axis=1), 1.0, atol=1e-6)
+
+    def test_combiner_persistence_round_trip(self, tmp_path):
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+        ds = _binary_ds(seed=5)
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        s1, s2 = self._selectors()
+        comb = SelectedModelCombiner(s1, s2, CombinationStrategy.WEIGHTED)
+        pred = comb.set_input(resp, vec).get_output()
+        model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        path = str(tmp_path / "m")
+        model.save(path)
+        m2 = WorkflowModel.load(path)
+        s1_ = model.score(dataset=ds)
+        s2_ = m2.score(dataset=ds)
+        np.testing.assert_allclose(
+            s1_[pred.name].prediction, s2_[pred.name].prediction
+        )

@@ -1,0 +1,4 @@
+"""`python -m transmogrifai_tpu` → the CLI (cli/.../CliExec.scala parity)."""
+from .cli import main
+
+main()

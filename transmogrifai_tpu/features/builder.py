@@ -120,11 +120,21 @@ def from_dataset(
             f"Response feature '{response}' not found in columns {list(dataset)}"
         )
     resp_col = dataset[response]
-    if not isinstance(resp_col, NumericColumn):
+    if T.is_subtype(response_type, T.Text):
+        # categorical text label: the caller indexes it into class ids
+        # downstream (e.g. .string_indexed(), OpIrisSimple.scala:58)
+        if not isinstance(resp_col, TextColumn):
+            raise TypeError(
+                f"Response '{response}' declared {response_type.__name__} but "
+                f"stored as {type(resp_col).__name__}"
+            )
+        if any(v is None for v in resp_col.values):
+            raise ValueError(f"Response '{response}' contains missing values")
+    elif not isinstance(resp_col, NumericColumn):
         raise TypeError(
             f"Response '{response}' must be numeric, got {type(resp_col).__name__}"
         )
-    if not resp_col.mask.all():
+    elif not resp_col.mask.all():
         raise ValueError(f"Response '{response}' contains missing values")
 
     resp = FeatureGeneratorStage(response, response_type, is_response=True).get_output()

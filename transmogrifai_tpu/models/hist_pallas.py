@@ -1,0 +1,185 @@
+"""Pallas TPU kernel: per-node gradient histograms for tree growth.
+
+The histogram build is THE hot op of histogram GBDT (the reference runs it
+in libxgboost's C++ core, SURVEY.md §2.5 item 1). The XLA scatter-add in
+models/trees.py lowers to a serialized sort/scatter on TPU; this kernel
+reformulates the build as matmuls so it runs on the MXU:
+
+    hist[m, f, b] = Σ_r 1[node_r = m] · 1[binned_{r,f} = b] · v_r
+                  = (NodeOneHot · v)ᵀ @ BinOneHot_f        per feature f
+
+i.e. for every feature an [M, T] x [T, B] matmul over row tiles T — dense
+systolic-array work instead of scattered memory traffic. Grad and hess are
+two value columns of the same one-hot product.
+
+Grid: (F, N/T). The output block for feature f is revisited across row
+tiles (accumulation pattern: init at j==0, add afterwards). Padded rows
+carry node = -1 → their one-hot row is all zero → no contribution.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+FEAT_TILE = 8  # features per program (TPU sublane granule)
+
+
+def _hist_kernel(binned_ref, node_ref, g_ref, h_ref, outg_ref, outh_ref,
+                 *, m_pad, b_pad):
+    """One (feature-tile, row-tile) step: accumulate grad/hess histograms
+    [FEAT_TILE, M, B] (separate outputs — a trailing dim of 2 would be
+    tile-padded to 128 and blow VMEM)."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    nodes = node_ref[0, :]    # [T] int32 (-1 = padded/dead row)
+    g = g_ref[0, :]           # [T] f32
+    h = h_ref[0, :]           # [T] f32
+    t = nodes.shape[0]
+
+    iota_m = lax.broadcasted_iota(jnp.int32, (t, m_pad), 1)
+    node_oh = (nodes[:, None] == iota_m).astype(jnp.float32)     # [T, M]
+    # HIGHEST: the one-hots are exact in bf16 but the value operand is not —
+    # split-precision passes keep the histogram sums f32-accurate
+    wg = node_oh * g[:, None]
+    wh = node_oh * h[:, None]
+    iota_b = lax.broadcasted_iota(jnp.int32, (t, b_pad), 1)
+    contract = (((0,), (0,)), ((), ()))  # contract the row-tile axis
+
+    for k in range(FEAT_TILE):
+        codes = binned_ref[k, :]  # [T] int32 for feature k of this tile
+        bin_oh = (codes[:, None] == iota_b).astype(jnp.float32)  # [T, B]
+        hg = lax.dot_general(
+            wg, bin_oh, contract,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )  # [M, B]
+        hh = lax.dot_general(
+            wh, bin_oh, contract,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+
+        @pl.when(j == 0)
+        def _(k=k, hg=hg, hh=hh):
+            outg_ref[k, :, :] = hg
+            outh_ref[k, :, :] = hh
+
+        @pl.when(j > 0)
+        def _(k=k, hg=hg, hh=hh):
+            outg_ref[k, :, :] = outg_ref[k, :, :] + hg
+            outh_ref[k, :, :] = outh_ref[k, :, :] + hh
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "num_bins", "row_tile", "interpret")
+)
+def build_histogram_pallas(
+    binned: jax.Array,   # [N, F] int32 codes in [0, num_bins)
+    node: jax.Array,     # [N] int32 node slot per row (-1 = dead)
+    grad: jax.Array,     # [N] f32 (pre-masked)
+    hess: jax.Array,     # [N] f32
+    num_nodes: int,
+    num_bins: int,
+    row_tile: int = 2048,
+    interpret: bool = False,
+) -> jax.Array:
+    """hist [num_nodes, F, num_bins, 2] via the MXU one-hot formulation."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, f = binned.shape
+    m_pad = _round_up(max(num_nodes, 8), 8)
+    b_pad = _round_up(num_bins, 128)
+    n_pad = _round_up(max(n, row_tile), row_tile)
+    f_pad = _round_up(f, FEAT_TILE)
+
+    binned_t = jnp.zeros((f_pad, n_pad), dtype=jnp.int32)
+    binned_t = binned_t.at[:f, :n].set(binned.T)
+    node_p = jnp.full((1, n_pad), -1, dtype=jnp.int32).at[0, :n].set(node)
+    g_p = jnp.zeros((1, n_pad), dtype=jnp.float32).at[0, :n].set(grad)
+    h_p = jnp.zeros((1, n_pad), dtype=jnp.float32).at[0, :n].set(hess)
+
+    num_row_tiles = n_pad // row_tile
+    grid = (f_pad // FEAT_TILE, num_row_tiles)
+
+    out_g, out_h = pl.pallas_call(
+        functools.partial(_hist_kernel, m_pad=m_pad, b_pad=b_pad),
+        out_shape=(
+            jax.ShapeDtypeStruct((f_pad, m_pad, b_pad), jnp.float32),
+            jax.ShapeDtypeStruct((f_pad, m_pad, b_pad), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (FEAT_TILE, row_tile), lambda i, j: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, row_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, row_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, row_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (FEAT_TILE, m_pad, b_pad), lambda i, j: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (FEAT_TILE, m_pad, b_pad), lambda i, j: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        interpret=interpret,
+    )(binned_t, node_p, g_p, h_p)
+
+    # 2 × [F, M, B] -> [M, F, B, 2], unpadded
+    out = jnp.stack([out_g, out_h], axis=-1)
+    return jnp.transpose(out[:f, :num_nodes, :num_bins, :], (1, 0, 2, 3))
+
+
+def build_histogram_scatter(
+    binned: jax.Array,
+    node: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    num_nodes: int,
+    num_bins: int,
+) -> jax.Array:
+    """XLA scatter-add reference implementation (CPU / correctness)."""
+    n, f = binned.shape
+    col_ids = jnp.arange(f, dtype=jnp.int32)[None, :]
+    safe_node = jnp.maximum(node, 0)
+    alive = (node >= 0).astype(jnp.float32)
+    flat = ((safe_node[:, None] * f + col_ids) * num_bins + binned).reshape(-1)
+    gh = jnp.stack([grad * alive, hess * alive], axis=1)  # [N, 2]
+    vals = jnp.repeat(gh[:, None, :], f, axis=1).reshape(-1, 2)
+    hist = jnp.zeros((num_nodes * f * num_bins, 2), dtype=jnp.float32)
+    hist = hist.at[flat].add(vals)
+    return hist.reshape(num_nodes, f, num_bins, 2)
+
+
+def default_impl() -> str:
+    """'pallas' on real TPU backends, 'scatter' elsewhere (CPU tests run the
+    kernel via interpret mode in the dedicated unit tests only)."""
+    import os
+
+    forced = os.environ.get("TPTPU_HIST")
+    if forced:
+        return forced
+    return "pallas" if jax.default_backend() == "tpu" else "scatter"

@@ -57,7 +57,7 @@ def bin_data(x: jax.Array, thresholds: jax.Array) -> jax.Array:
 
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins"),
+    static_argnames=("max_depth", "num_bins", "hist_impl"),
 )
 def grow_tree(
     binned: jax.Array,     # [N, F] int32 codes in [0, num_bins)
@@ -71,14 +71,20 @@ def grow_tree(
     gamma: float | jax.Array = 0.0,
     min_child_weight: float | jax.Array = 1.0,
     min_info_gain: float | jax.Array = 0.0,
+    hist_impl: str | None = None,
 ) -> Tree:
+    from .hist_pallas import (
+        build_histogram_pallas,
+        build_histogram_scatter,
+        default_impl,
+    )
+
     n, f = binned.shape
     b = num_bins
     max_nodes = 1 << max_depth
     g = grad * row_mask
     h = hess * row_mask
-    col_ids = jnp.arange(f, dtype=jnp.int32)[None, :]
-    gh = jnp.stack([g, h], axis=1)  # [N, 2]
+    impl = hist_impl or default_impl()
 
     # ---- node chunking: bound per-level histogram memory (the Spark
     # maxMemoryInMB node-group equivalent). Deep trees on wide matrices would
@@ -90,17 +96,28 @@ def grow_tree(
     while chunk_nodes & (chunk_nodes - 1):  # round down to a power of two
         chunk_nodes &= chunk_nodes - 1
     chunk_nodes = min(chunk_nodes, max_nodes)
+    if impl == "pallas":
+        # Mosaic keeps the kernel's full [f_pad, M, 128]×2 output resident in
+        # scoped VMEM (plus the [row_tile, M] node one-hot), so M must scale
+        # inversely with the feature count to stay under the ~16 MB budget
+        f_pad = (f + 7) // 8 * 8
+        b_pad = (b + 127) // 128 * 128  # kernel pads bins to lane width
+        # outputs are double-buffered: 2 bufs × 2 outs × f_pad·M·b_pad·4B
+        m_cap = max(8, (1 << 19) // (f_pad * b_pad))
+        while m_cap & (m_cap - 1):
+            m_cap &= m_cap - 1
+        chunk_nodes = min(chunk_nodes, m_cap)
     num_chunks = max_nodes // chunk_nodes
 
     def chunk_stats(node, c0):
         """Best (gain, feat, bin) for node slots [c0, c0 + chunk_nodes)."""
         active = (node >= c0) & (node < c0 + chunk_nodes)
-        w = active.astype(jnp.float32)
-        local = jnp.where(active, node - c0, 0)
-        flat = ((local[:, None] * f + col_ids) * b + binned).reshape(-1)
-        vals = jnp.repeat((gh * w[:, None])[:, None, :], f, axis=1).reshape(-1, 2)
-        hist = jnp.zeros((chunk_nodes * f * b, 2), dtype=jnp.float32)
-        hist = hist.at[flat].add(vals).reshape(chunk_nodes, f, b, 2)
+        local = jnp.where(active, node - c0, -1)  # -1 = dead for this chunk
+        if impl == "pallas":
+            # MXU one-hot kernel (hist_pallas.py) — dead rows carry node -1
+            hist = build_histogram_pallas(binned, local, g, h, chunk_nodes, b)
+        else:
+            hist = build_histogram_scatter(binned, local, g, h, chunk_nodes, b)
         hg, hh = hist[..., 0], hist[..., 1]
 
         gl = jnp.cumsum(hg, axis=2)[:, :, :-1]  # left = bins <= t

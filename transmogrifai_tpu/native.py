@@ -1,0 +1,170 @@
+"""ctypes bindings for the C++ host kernels (native/tptpu_native.cpp).
+
+The library is built on demand with ``make`` (g++ is in the image) and
+cached next to the sources. Every entry point has a pure-Python/numpy
+fallback, so the package works without a toolchain — `available()` reports
+which path is active.
+
+Covers the reference's host hot loops (SURVEY.md §2.5): MurmurHash3 feature
+hashing (OPCollectionHashingVectorizer) and CSV field→double parsing
+(readers module).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtptpu.so")
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("TPTPU_DISABLE_NATIVE"):
+            return None
+        try:
+            if not os.path.exists(_SO_PATH):
+                if not os.path.isdir(_NATIVE_DIR):
+                    return None
+                subprocess.run(
+                    ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                    capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_SO_PATH)
+        except Exception as e:  # toolchain or load failure -> fallback
+            log.info("native library unavailable (%s); using numpy fallbacks", e)
+            return None
+        lib.tp_murmur3_batch.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C"),
+            ctypes.c_int64, ctypes.c_uint32,
+            np.ctypeslib.ndpointer(np.uint32, flags="C"),
+        ]
+        lib.tp_murmur3_scatter.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C"),
+            np.ctypeslib.ndpointer(np.int64, flags="C"),
+            ctypes.c_int64, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float32, flags="C"),
+            ctypes.c_int64,
+        ]
+        lib.tp_parse_doubles.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float64, flags="C"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _concat(values: list) -> tuple[bytes, np.ndarray]:
+    """Concatenate strings into one UTF-8 buffer + offsets[n+1]."""
+    encoded = [v.encode("utf-8") if isinstance(v, str) else b"" for v in values]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    return b"".join(encoded), offsets
+
+
+def murmur3_batch(values: list, seed: int = 42) -> np.ndarray:
+    """uint32 murmur3 of each string (None → hash of empty)."""
+    lib = _load()
+    n = len(values)
+    if lib is not None:
+        buf, offsets = _concat(values)
+        out = np.empty(n, dtype=np.uint32)
+        lib.tp_murmur3_batch(buf, offsets, n, seed & 0xFFFFFFFF, out)
+        return out
+    from .utils.text import murmur3_32
+
+    return np.array(
+        [murmur3_32(v if isinstance(v, str) else "", seed) for v in values],
+        dtype=np.uint32,
+    )
+
+
+def murmur3_scatter(
+    tokens: list,
+    rows: np.ndarray,
+    num_rows: int,
+    num_buckets: int,
+    seed: int = 42,
+    binary: bool = False,
+    out: np.ndarray | None = None,
+    col_offset: int = 0,
+) -> np.ndarray:
+    """Hash tokens → bucket counts in one pass: out[rows[i], h(tokens[i])] += 1.
+    ``out`` may be a wider matrix; ``col_offset`` places the bucket block."""
+    if out is None:
+        out = np.zeros((num_rows, num_buckets), dtype=np.float32)
+    lib = _load()
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    if (
+        lib is not None
+        and col_offset == 0
+        and out.flags["C_CONTIGUOUS"]
+        and out.dtype == np.float32
+    ):
+        buf, offsets = _concat(tokens)
+        lib.tp_murmur3_scatter(
+            buf, offsets, rows, len(tokens), seed & 0xFFFFFFFF,
+            num_buckets, 1 if binary else 0, out, out.shape[1],
+        )
+        return out
+    _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset)
+    return out
+
+
+def _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset):
+    h = murmur3_batch(tokens, seed)
+    j = (h % np.uint32(num_buckets)).astype(np.int64) + col_offset
+    if binary:
+        out[rows, j] = 1.0
+    else:
+        np.add.at(out, (rows, j), 1.0)
+
+
+def parse_doubles(values: list) -> tuple[np.ndarray, np.ndarray]:
+    """Batch str→double: (values float64[n], mask bool[n])."""
+    lib = _load()
+    n = len(values)
+    if lib is not None:
+        buf, offsets = _concat(values)
+        out = np.empty(n, dtype=np.float64)
+        mask = np.empty(n, dtype=np.uint8)
+        lib.tp_parse_doubles(buf, offsets, n, out, mask)
+        return out, mask.astype(bool)
+    out = np.zeros(n, dtype=np.float64)
+    mask = np.zeros(n, dtype=bool)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        s = v.strip() if isinstance(v, str) else v
+        if s == "" or s is None:
+            continue
+        try:
+            out[i] = float(s)
+            mask[i] = True
+        except (TypeError, ValueError):
+            pass
+    return out, mask

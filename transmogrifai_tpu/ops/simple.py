@@ -35,7 +35,16 @@ from ..types.columns import (
 )
 
 
-class AliasTransformer(Transformer):
+class _IdentityTyped(Transformer):
+    """Mixin for stages whose output type IS the input type (alias/filter/
+    replace): the reference expresses this as I → I generics."""
+
+    def get_output(self):
+        self.output_type = self.input_features[0].ftype
+        return super().get_output()
+
+
+class AliasTransformer(_IdentityTyped):
     """Identity stage that renames its input (AliasTransformer.scala:51)."""
 
     def __init__(self, name: str, uid: str | None = None):
@@ -53,7 +62,7 @@ class AliasTransformer(Transformer):
         return cols[0]
 
 
-class FilterTransformer(Transformer):
+class FilterTransformer(_IdentityTyped):
     """Keep values passing a predicate, else a default
     (FilterTransformer.scala:39)."""
 
@@ -83,7 +92,7 @@ class FilterTransformer(Transformer):
         return column_from_values(cols[0].feature_type, vals)
 
 
-class ReplaceTransformer(Transformer):
+class ReplaceTransformer(_IdentityTyped):
     """Replace one value with another (ReplaceTransformer.scala:39)."""
 
     def __init__(self, old_value: Any, new_value: Any, uid: str | None = None):
@@ -225,7 +234,7 @@ class TextLenTransformer(Transformer):
         return VectorColumn(OPVector, values, meta)
 
 
-class FilterMap(Transformer):
+class FilterMap(_IdentityTyped):
     """Filter map keys/values by allow/block lists (FilterMap.scala:45)."""
 
     def __init__(

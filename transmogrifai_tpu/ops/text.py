@@ -114,22 +114,27 @@ def hash_block(
     emits a single block). Always appends the null-indicator column when
     track_nulls (SmartTextVectorizer trackNulls semantics).
     """
+    from ..native import murmur3_scatter
+
     n = len(values)
-    out = np.zeros((n, num_features + (1 if track_nulls else 0)), dtype=np.float64)
+    out = np.zeros((n, num_features + (1 if track_nulls else 0)), dtype=np.float32)
+    tokens: list[str] = []
+    rows: list[int] = []
     for r, raw in enumerate(values):
         if raw is None:
             if track_nulls:
                 out[r, num_features] = 1.0
             continue
-        toks = tokenize(raw, to_lowercase=to_lowercase, min_token_length=min_token_length)
-        for t in toks:
-            key = t if not shared else f"{feature_slot}_{t}"
-            j = hash_to_index(key, num_features, seed)
-            if binary_freq:
-                out[r, j] = 1.0
-            else:
-                out[r, j] += 1.0
-    return out
+        for t in tokenize(raw, to_lowercase=to_lowercase, min_token_length=min_token_length):
+            tokens.append(t if not shared else f"{feature_slot}_{t}")
+            rows.append(r)
+    if tokens:
+        # hash + scatter in one native pass (falls back to numpy)
+        murmur3_scatter(
+            tokens, np.asarray(rows, dtype=np.int64), n, num_features,
+            seed=seed, binary=binary_freq, out=out,
+        )
+    return out.astype(np.float64)
 
 
 def hash_metas(

@@ -53,7 +53,7 @@ from ..types.columns import (
     TextColumn,
     VectorColumn,
 )
-from ..utils.text import hash_to_index, tokenize
+from ..utils.text import tokenize
 
 
 class TextTokenizer(Transformer):
@@ -295,16 +295,21 @@ class OpHashingTF(Transformer):
         return {"num_features": self.num_features, "binary": self.binary}
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        from ..native import murmur3_scatter
+
         col = cols[0]
         assert isinstance(col, ListColumn)
-        values = np.zeros((num_rows, self.num_features), dtype=np.float32)
+        tokens: list[str] = []
+        rows: list[int] = []
         for r, row in enumerate(col.values):
-            for t in row:
-                j = hash_to_index(t, self.num_features)
-                if self.binary:
-                    values[r, j] = 1.0
-                else:
-                    values[r, j] += 1.0
+            tokens.extend(row)
+            rows.extend([r] * len(row))
+        values = np.zeros((num_rows, self.num_features), dtype=np.float32)
+        if tokens:
+            murmur3_scatter(
+                tokens, np.asarray(rows, dtype=np.int64), num_rows,
+                self.num_features, binary=self.binary, out=values,
+            )
         f = self.input_features[0]
         metas = tuple(
             ColumnMeta(

@@ -89,7 +89,19 @@ def infer_csv_dataset(
     overrides = type_overrides or {}
     for j, name in enumerate(names):
         vals = [_cell(r, j) for r in body]
-        ftype = overrides.get(name) or _infer_type(vals)
+        ftype = overrides.get(name)
+        if ftype is None:
+            ftype = _infer_type(vals)
+            if ftype is T.Real:
+                # hot path: batch field->double parse in native code. Only
+                # for INFERRED Real columns (inference guarantees
+                # parseability); user overrides keep the strict raising path.
+                from ..native import parse_doubles
+                from ..types.columns import NumericColumn
+
+                values, mask = parse_doubles(vals)
+                columns[name] = NumericColumn(T.Real, values, mask)
+                continue
         columns[name] = column_from_values(ftype, vals)
     return Dataset.of(columns)
 

@@ -1,0 +1,352 @@
+"""Batch app harness: run-type dispatch, config, and phase profiling.
+
+Reference: core/.../OpWorkflowRunner.scala (run types Train/Score/
+StreamingScore/Features/Evaluate :359-379, result types :163-272),
+core/.../OpApp.scala (arg parsing, session setup), features/.../OpParams
+.scala:81-96 (JSON/YAML run configuration with per-stage overrides), and
+utils/.../spark/{OpStep,OpSparkListener,JobGroupUtil}.scala (phase-scoped
+metric collection).
+
+TPU mapping: the Spark listener becomes a phase-span collector around the
+host orchestration loop — per-phase wall-clock plus (optionally) a
+``jax.profiler`` trace per phase; metrics are handed to app-end handlers
+exactly like OpSparkListener's (OpWorkflowRunner.scala:326-357).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import enum
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Iterator
+
+from .dataset import Dataset
+from .readers.core import DataReader, DatasetReader
+from .readers.streaming import StreamingReader
+from .workflow.workflow import Workflow, WorkflowModel
+
+log = logging.getLogger("transmogrifai_tpu.runner")
+
+
+# ------------------------------------------------------------------ OpStep
+class OpStep(enum.Enum):
+    """utils/.../spark/OpStep.scala:38-46."""
+
+    DATA_READING_AND_FILTERING = "DataReadingAndFiltering"
+    FEATURE_ENGINEERING = "FeatureEngineering"
+    CROSS_VALIDATION = "CrossValidation"
+    MODEL_IO = "ModelIO"
+    RESULTS_SAVING = "ResultsSaving"
+    OTHER = "Other"
+
+
+@dataclasses.dataclass
+class PhaseMetric:
+    """One phase span (StageMetrics equivalent, OpSparkListener.scala:231)."""
+
+    step: str
+    wall_s: float
+    started_at: float
+
+
+class RunListener:
+    """Collects phase spans + app metrics (OpSparkListener.scala:62-260).
+    ``with_jax_profiler`` additionally writes a TensorBoard-readable device
+    trace per phase under ``trace_dir``."""
+
+    def __init__(self, app_name: str = "op-app", trace_dir: str | None = None):
+        self.app_name = app_name
+        self.trace_dir = trace_dir
+        self.phases: list[PhaseMetric] = []
+        self._app_start = time.time()
+
+    @contextlib.contextmanager
+    def phase(self, step: OpStep) -> Iterator[None]:
+        """JobGroupUtil.withJobGroup equivalent."""
+        t0 = time.time()
+        trace_ctx = None
+        if self.trace_dir is not None:
+            import jax
+
+            trace_ctx = jax.profiler.trace(
+                os.path.join(self.trace_dir, step.value)
+            )
+            trace_ctx.__enter__()
+        log.info("[%s] phase %s started", self.app_name, step.value)
+        try:
+            yield
+        finally:
+            if trace_ctx is not None:
+                trace_ctx.__exit__(None, None, None)
+            dt = time.time() - t0
+            self.phases.append(PhaseMetric(step.value, dt, t0))
+            log.info(
+                "[%s] phase %s finished in %.3fs", self.app_name, step.value, dt
+            )
+
+    def app_metrics(self) -> dict[str, Any]:
+        """AppMetrics (OpSparkListener.scala:173)."""
+        return {
+            "appName": self.app_name,
+            "appDurationS": time.time() - self._app_start,
+            "phases": [dataclasses.asdict(p) for p in self.phases],
+        }
+
+
+# ------------------------------------------------------------------ OpParams
+@dataclasses.dataclass
+class OpParams:
+    """Run configuration (OpParams.scala:81-96): per-stage param overrides
+    keyed by stage class name or uid, locations, and free-form params.
+    Loadable from JSON or YAML."""
+
+    stage_params: dict[str, dict[str, Any]] = dataclasses.field(default_factory=dict)
+    reader_params: dict[str, dict[str, Any]] = dataclasses.field(default_factory=dict)
+    model_location: str | None = None
+    write_location: str | None = None
+    metrics_location: str | None = None
+    custom_params: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_file(path: str) -> "OpParams":
+        with open(path) as f:
+            text = f.read()
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            data = yaml.safe_load(text) or {}
+        else:
+            data = json.loads(text)
+        known = {f.name for f in dataclasses.fields(OpParams)}
+        return OpParams(**{k: v for k, v in data.items() if k in known})
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+# ----------------------------------------------------------------- run types
+class OpWorkflowRunType(enum.Enum):
+    """OpWorkflowRunner.scala:359-365."""
+
+    TRAIN = "Train"
+    SCORE = "Score"
+    STREAMING_SCORE = "StreamingScore"
+    FEATURES = "Features"
+    EVALUATE = "Evaluate"
+
+
+@dataclasses.dataclass
+class RunResult:
+    """The per-run-type results (Train/Score/.../Evaluate Result classes,
+    OpWorkflowRunner.scala:163-272)."""
+
+    run_type: OpWorkflowRunType
+    model_summary: dict[str, Any] | None = None
+    scores: Dataset | None = None
+    score_batches: list[Dataset] | None = None
+    features: Dataset | None = None
+    metrics: dict[str, Any] | None = None
+    app_metrics: dict[str, Any] | None = None
+
+
+class WorkflowRunner:
+    """OpWorkflowRunner (core/.../OpWorkflowRunner.scala:70): owns a
+    workflow + readers + evaluator and dispatches on run type."""
+
+    def __init__(
+        self,
+        workflow: Workflow,
+        train_reader: DataReader | None = None,
+        score_reader: DataReader | None = None,
+        streaming_reader: StreamingReader | None = None,
+        evaluator: Any = None,
+        features: Any = None,
+        app_name: str = "op-app",
+        trace_dir: str | None = None,
+    ):
+        self.workflow = workflow
+        self.train_reader = train_reader
+        self.score_reader = score_reader
+        self.streaming_reader = streaming_reader
+        self.evaluator = evaluator
+        self.features = features
+        self.listener = RunListener(app_name, trace_dir)
+        self._app_end_handlers: list[Callable[[dict[str, Any]], None]] = []
+
+    def add_application_end_handler(
+        self, fn: Callable[[dict[str, Any]], None]
+    ) -> "WorkflowRunner":
+        """OpWorkflowRunner.addApplicationEndHandler (:145)."""
+        self._app_end_handlers.append(fn)
+        return self
+
+    # ------------------------------------------------------------------- run
+    def run(
+        self, run_type: OpWorkflowRunType, params: OpParams | None = None
+    ) -> RunResult:
+        params = params or OpParams()
+        if params.stage_params:
+            self.workflow.set_stage_parameters(params.stage_params)
+        dispatch = {
+            OpWorkflowRunType.TRAIN: self._train,
+            OpWorkflowRunType.SCORE: self._score,
+            OpWorkflowRunType.STREAMING_SCORE: self._streaming_score,
+            OpWorkflowRunType.FEATURES: self._features,
+            OpWorkflowRunType.EVALUATE: self._evaluate,
+        }
+        result = dispatch[run_type](params)
+        result.app_metrics = self.listener.app_metrics()
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            with open(os.path.join(params.metrics_location, "metrics.json"), "w") as f:
+                json.dump(result.app_metrics, f, indent=2, default=str)
+        for handler in self._app_end_handlers:
+            handler(result.app_metrics)
+        return result
+
+    def _require_model(self, params: OpParams) -> WorkflowModel:
+        if params.model_location is None:
+            raise ValueError(f"model_location required for this run type")
+        return WorkflowModel.load(params.model_location)
+
+    def _train(self, params: OpParams) -> RunResult:
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        with self.listener.phase(OpStep.CROSS_VALIDATION):
+            model = self.workflow.train()
+        summary = model.summary_json()
+        if params.model_location:
+            with self.listener.phase(OpStep.MODEL_IO):
+                model.save(params.model_location)
+        return RunResult(OpWorkflowRunType.TRAIN, model_summary=summary)
+
+    def _score(self, params: OpParams) -> RunResult:
+        if self.score_reader is None:
+            raise ValueError("score_reader required for Score")
+        with self.listener.phase(OpStep.MODEL_IO):
+            model = self._require_model(params)
+        metrics = None
+        with self.listener.phase(OpStep.FEATURE_ENGINEERING):
+            if self.evaluator is not None:
+                scores, metrics = model.score_and_evaluate(
+                    evaluator=self.evaluator, reader=self.score_reader
+                )
+            else:
+                scores = model.score(reader=self.score_reader)
+        if params.write_location:
+            with self.listener.phase(OpStep.RESULTS_SAVING):
+                _write_scores(scores, params.write_location)
+        return RunResult(OpWorkflowRunType.SCORE, scores=scores, metrics=metrics)
+
+    def _streaming_score(self, params: OpParams) -> RunResult:
+        """Micro-batch scoring loop (OpWorkflowRunner.scala:232-270): the
+        jitted score program is reused across batches — only the first batch
+        pays compilation."""
+        if self.streaming_reader is None:
+            raise ValueError("streaming_reader required for StreamingScore")
+        with self.listener.phase(OpStep.MODEL_IO):
+            model = self._require_model(params)
+        batches: list[Dataset] = []
+        with self.listener.phase(OpStep.FEATURE_ENGINEERING):
+            for ds in self.streaming_reader.stream_datasets(
+                list(model.raw_features)
+            ):
+                batches.append(model.score(dataset=ds))
+        if params.write_location:
+            with self.listener.phase(OpStep.RESULTS_SAVING):
+                for i, b in enumerate(batches):
+                    _write_scores(b, os.path.join(params.write_location, f"batch={i}"))
+        return RunResult(OpWorkflowRunType.STREAMING_SCORE, score_batches=batches)
+
+    def _features(self, params: OpParams) -> RunResult:
+        """computeDataUpTo: materialize features without training models
+        (OpWorkflowRunner.scala:190). ``features`` (ctor) picks the targets;
+        default is everything upstream of the model selector."""
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        targets = list(self.features) if self.features else []
+        if not targets:
+            # everything the selector consumes (its input features)
+            from .selector.model_selector import ModelSelector
+
+            for f in self.workflow.result_features:
+                stage = f.origin_stage
+                if isinstance(stage, ModelSelector):
+                    targets.extend(stage.input_features)
+                else:
+                    targets.append(f)
+        with self.listener.phase(OpStep.FEATURE_ENGINEERING):
+            features = self.workflow.compute_data_up_to(*targets)
+        if params.write_location:
+            with self.listener.phase(OpStep.RESULTS_SAVING):
+                _write_scores(features, params.write_location)
+        return RunResult(OpWorkflowRunType.FEATURES, features=features)
+
+    def _evaluate(self, params: OpParams) -> RunResult:
+        with self.listener.phase(OpStep.MODEL_IO):
+            model = self._require_model(params)
+        reader = self.score_reader or self.train_reader
+        if reader is None:
+            raise ValueError("a reader is required for Evaluate")
+        with self.listener.phase(OpStep.FEATURE_ENGINEERING):
+            metrics = model.evaluate(evaluator=self.evaluator, reader=reader)
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            with open(
+                os.path.join(params.metrics_location, "eval.json"), "w"
+            ) as f:
+                json.dump(metrics, f, indent=2, default=str)
+        return RunResult(OpWorkflowRunType.EVALUATE, metrics=metrics)
+
+
+def _write_scores(ds: Dataset, path: str) -> None:
+    """Write scores as CSV (the reference writes avro/parquet via Spark;
+    the columnar equivalent is a plain CSV of row-wise values)."""
+    import csv
+
+    os.makedirs(path, exist_ok=True)
+    names = list(ds.columns)
+    cols = {n: ds[n].to_list() for n in names}
+    with open(os.path.join(path, "part-00000.csv"), "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(names)
+        for i in range(ds.num_rows):
+            w.writerow([_cell(cols[n][i]) for n in names])
+
+
+def _cell(v: Any) -> Any:
+    if isinstance(v, (dict, list, tuple, frozenset, set)):
+        return json.dumps(sorted(v) if isinstance(v, (set, frozenset)) else v, default=str)
+    return v
+
+
+def parse_args(argv: list[str]) -> tuple[OpWorkflowRunType, OpParams]:
+    """OpApp.parseArgs (OpApp.scala:130-176): `<RunType> [--param-location
+    path] [--model-location path] [--read-location path] ...`."""
+    if not argv:
+        raise SystemExit("usage: <Train|Score|StreamingScore|Features|Evaluate> [--flags]")
+    run_type = OpWorkflowRunType(argv[0])
+    params = OpParams()
+    i = 1
+    while i < len(argv):
+        flag = argv[i]
+        if not flag.startswith("--"):
+            raise SystemExit(f"unexpected argument {flag!r}")
+        if i + 1 >= len(argv):
+            raise SystemExit(f"missing value for {flag}")
+        value = argv[i + 1]
+        key = flag[2:].replace("-", "_")
+        if key == "param_location":
+            params = OpParams.from_file(value)
+        elif hasattr(params, key):
+            if isinstance(getattr(params, key), dict):
+                setattr(params, key, json.loads(value))
+            else:
+                setattr(params, key, value)
+        else:
+            params.custom_params[key] = value
+        i += 2
+    return run_type, params

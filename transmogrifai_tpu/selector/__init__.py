@@ -10,3 +10,8 @@ from .model_selector import (  # noqa: F401
     RegressionModelSelector,
     make_candidates,
 )
+from .combiner import (  # noqa: F401
+    CombinationStrategy,
+    CombinedModel,
+    SelectedModelCombiner,
+)

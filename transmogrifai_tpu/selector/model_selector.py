@@ -259,6 +259,9 @@ class ModelSelector(PredictorEstimator):
         self.evaluator = evaluator
         self.extra_evaluators = list(extra_evaluators)
         self.problem_kind = problem_kind
+        #: set by workflow-level CV (workflow/cv.py): validation already ran
+        #: with per-fold DAG refits, so fit skips the internal validator
+        self.precomputed_results: list | None = None
 
     def get_params(self):
         return {
@@ -277,7 +280,13 @@ class ModelSelector(PredictorEstimator):
             keep = self.splitter.prepare(yt)
             xt, yt = xt[keep], yt[keep]
 
-        results = self.validator.validate(self.models, xt, yt, self.evaluator)
+        if self.precomputed_results is not None:
+            # consume-once: stale fold metrics must not leak into a later
+            # re-train on different data
+            results = self.precomputed_results
+            self.precomputed_results = None
+        else:
+            results = self.validator.validate(self.models, xt, yt, self.evaluator)
         best = Validator.best(results, self.evaluator)
         log.info(
             "ModelSelector best: %s %s (%s=%.4f over %d candidates)",

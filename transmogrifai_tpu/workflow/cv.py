@@ -1,0 +1,152 @@
+"""Workflow-level cross-validation — refit the label-dependent DAG per fold.
+
+Reference: core/.../OpWorkflow.scala:403-453 (fitStages withWorkflowCV) and
+FitStagesUtil.cutDAG (core/.../utils/stages/FitStagesUtil.scala:302-355):
+the DAG is cut into *before* (label-independent), *during* (label-dependent
+estimators feeding the selector, e.g. SanityChecker), and *after*. Selector-
+level CV would fit the during-stages once on all training rows — their
+statistics (correlations, drop decisions) would then leak validation rows
+into candidate selection. Workflow CV re-fits the during-DAG inside each
+fold instead.
+
+Mechanics here: for each fold, fit the DAG up to the selector's inputs on
+the fold-train rows only, transform the fold-validation rows through those
+fitted stages, and sweep every candidate × grid point on the resulting
+arrays (per-candidate failure isolation as in OpValidator.scala:318-357).
+The aggregated CandidateResults are handed to the ModelSelector, which then
+skips its own validator and refits the winner on the full training data.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..evaluators.base import Evaluator
+from ..selector.model_selector import ModelSelector
+from ..selector.validators import CandidateResult, expand_grid
+from ..types.columns import NumericColumn, VectorColumn
+from .fit import apply_transformations_dag, fit_and_transform_dag
+
+log = logging.getLogger(__name__)
+
+
+def workflow_cv_results(
+    selector: ModelSelector,
+    train_data: Dataset,
+    prefitted: dict[str, Any] | None = None,
+) -> list[CandidateResult]:
+    """Run the per-fold DAG refit + candidate sweep; returns aggregated
+    candidate results for the selector to consume."""
+    label_feature, vector_feature = selector.input_features
+    targets = [label_feature, vector_feature]
+
+    # label per row (labels may be derived; fit a throwaway label-only DAG)
+    label_data, _ = fit_and_transform_dag(
+        train_data, [label_feature], prefitted=prefitted
+    )
+    label_col = label_data[label_feature.name]
+    assert isinstance(label_col, NumericColumn)
+    y_all = label_col.values.astype(np.float64)
+
+    # pre-validation prepare, mirroring ModelSelector.fit_arrays: DataCutter
+    # trims rare labels BEFORE folds so fold-train and fold-val draw from
+    # the same label universe the final refit will see
+    from ..prep.splitters import DataCutter
+
+    if isinstance(selector.splitter, DataCutter):
+        keep = np.nonzero(selector.splitter.prepare(y_all))[0]
+        train_data = train_data.take(keep)
+        y_all = y_all[keep]
+
+    folds = selector.validator.split_masks(y_all)
+    evaluator = selector.evaluator
+    per_candidate: dict[tuple[str, int], CandidateResult] = {}
+    failed: set[str] = set()
+
+    for fold_i, (train_mask, val_mask) in enumerate(folds):
+        tr_idx = np.nonzero(train_mask)[0]
+        va_idx = np.nonzero(val_mask)[0]
+        fold_train = train_data.take(tr_idx)
+        fold_val = train_data.take(va_idx)
+
+        # the leak-free part: every estimator up to the selector's inputs is
+        # re-fit on the fold's training rows only
+        fitted_t, fitted_stages = fit_and_transform_dag(
+            fold_train, targets, prefitted=prefitted
+        )
+        transformed_v = apply_transformations_dag(fold_val, targets, fitted_stages)
+
+        xt, yt = _arrays(fitted_t, label_feature.name, vector_feature.name)
+        xv, yv = _arrays(transformed_v, label_feature.name, vector_feature.name)
+
+        for est, grid in selector.models:
+            if est.uid in failed:
+                continue
+            points = expand_grid(grid)
+            try:
+                _sweep_fold(
+                    est, points, xt, yt, xv, yv, evaluator,
+                    per_candidate, fold_i,
+                )
+            except Exception as e:  # candidate-level isolation
+                log.warning(
+                    "Model %s failed workflow CV: %s", type(est).__name__, e
+                )
+                failed.add(est.uid)
+                per_candidate = {
+                    k: v
+                    for k, v in per_candidate.items()
+                    if v.model_uid != est.uid
+                }
+
+    results = list(per_candidate.values())
+    if not results:
+        raise RuntimeError("All model candidates failed workflow-level CV")
+    return results
+
+
+def _arrays(data: Dataset, label_name: str, vec_name: str):
+    label = data[label_name]
+    vec = data[vec_name]
+    assert isinstance(label, NumericColumn) and isinstance(vec, VectorColumn)
+    return (
+        np.asarray(vec.values, dtype=np.float32),
+        label.values.astype(np.float64),
+    )
+
+
+def _sweep_fold(
+    est,
+    points: list[dict[str, Any]],
+    xt: np.ndarray,
+    yt: np.ndarray,
+    xv: np.ndarray,
+    yv: np.ndarray,
+    evaluator: Evaluator,
+    per_candidate: dict,
+    fold_i: int,
+) -> None:
+    """One fold's fits for one model family. Fold vector widths can differ
+    (per-fold SanityChecker drops differ) so models never cross folds."""
+    ones = np.ones(len(yt), dtype=np.float32)
+    batched = getattr(est, "fit_arrays_batched", None)
+    if batched is not None:
+        models = batched(xt, yt, ones, points)
+    else:
+        models = [est.with_params(**p).fit_arrays(xt, yt, ones) for p in points]
+    for gi, model in enumerate(models):
+        pred, prob, _ = model.predict_arrays(xv)
+        metrics = evaluator.evaluate_arrays(yv, pred, prob)
+        value = evaluator.metric_of(metrics)
+        key = (est.uid, gi)
+        if key not in per_candidate:
+            per_candidate[key] = CandidateResult(
+                model_name=type(est).__name__,
+                model_uid=est.uid,
+                grid=points[gi],
+                metric_values=[],
+            )
+        per_candidate[key].metric_values.append(value)

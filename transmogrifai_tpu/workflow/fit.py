@@ -20,16 +20,25 @@ from .dag import compute_dag
 def fit_and_transform_dag(
     dataset: Dataset,
     result_features: Iterable[Feature],
+    prefitted: dict[str, PipelineStage] | None = None,
 ) -> tuple[Dataset, dict[str, PipelineStage]]:
     """Fit the whole DAG; returns (transformed dataset, fitted stage by
     original-stage uid). Fitted models replace their estimators keyed by the
-    estimator uid (FitStagesUtil.scala:251-290)."""
+    estimator uid (FitStagesUtil.scala:251-290). ``prefitted`` supplies
+    already-fitted models by estimator uid — those estimators are skipped
+    (warm start, OpWorkflow.withModelStages OpWorkflow.scala:468-472)."""
     layers = compute_dag(list(result_features))
     fitted: dict[str, PipelineStage] = {}
+    prefitted = prefitted or {}
     for layer in layers:
         transformers: list[Transformer] = []
         for stage in layer:
-            if isinstance(stage, Estimator):
+            if stage.uid in prefitted:
+                model = prefitted[stage.uid]
+                assert isinstance(model, Transformer)
+                fitted[stage.uid] = model
+                transformers.append(model)
+            elif isinstance(stage, Estimator):
                 model = stage.fit(dataset)
                 fitted[stage.uid] = model
                 transformers.append(model)

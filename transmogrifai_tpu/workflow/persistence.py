@@ -53,13 +53,14 @@ def _registry() -> dict[str, type]:
     )
     from ..ops import math as ops_math
     from ..prep import derived_filter, sanity_checker
+    from ..selector import combiner as selector_combiner
     from ..selector import model_selector
 
     for module in (
         glm, gbdt, isotonic, linear, logistic, mlp, naive_bayes, svc,
         categorical, combiner, dates, lists,
         maps, numeric, phone, text, derived_filter, sanity_checker,
-        model_selector, loco,
+        model_selector, selector_combiner, loco,
         bucketizers, domains, embeddings, ops_math, scalers, simple,
         text_stages, time_period,
     ):

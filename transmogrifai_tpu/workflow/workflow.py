@@ -37,6 +37,8 @@ class Workflow:
         self._raw_feature_filter = None
         self._rff_score_reader: DataReader | None = None
         self.blocklisted_features: list[str] = []
+        self._prefitted: dict[str, PipelineStage] = {}
+        self._workflow_cv = False
 
     # ----------------------------------------------------------- configure
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -56,6 +58,21 @@ class Workflow:
         applied reflectively before fit (OpWorkflow.setStageParameters,
         OpWorkflow.scala:179-201)."""
         self._stage_overrides.update(overrides)
+        return self
+
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """Warm start (OpWorkflow.withModelStages, OpWorkflow.scala:468-472):
+        fitted stages from a previous model are swapped in by estimator uid,
+        so only new estimators train."""
+        self._prefitted.update(model.fitted)
+        return self
+
+    def with_workflow_cv(self) -> "Workflow":
+        """Workflow-level cross-validation (OpWorkflow.withWorkflowCV,
+        OpWorkflow.scala:403-453): label-dependent estimators upstream of the
+        model selector are re-fit inside every CV fold, so their statistics
+        cannot leak validation rows into candidate selection."""
+        self._workflow_cv = True
         return self
 
     def with_raw_feature_filter(
@@ -117,6 +134,21 @@ class Workflow:
                 if key in self._stage_overrides:
                     stage.set_params(**self._stage_overrides[key])
 
+    def compute_data_up_to(self, *features: Feature) -> Dataset:
+        """Materialize the DAG up to the given features without running the
+        full train (OpWorkflowCore.computeDataUpTo; used by the runner's
+        Features run type, OpWorkflowRunner.scala:190)."""
+        targets = list(features) or list(self.result_features)
+        if not targets:
+            raise ValueError("computeDataUpTo needs target features")
+        if self.reader is None:
+            raise ValueError("No input data: call set_input_dataset or set_reader")
+        stages = list({s.uid: s for f in targets for s in f.parent_stages()}.values())
+        self._apply_overrides(stages)
+        raw = self.reader.generate_dataset(raw_features_of(targets))
+        data, _ = fit_and_transform_dag(raw, targets, prefitted=self._prefitted)
+        return data
+
     def train(self) -> "WorkflowModel":
         if not self.result_features:
             raise ValueError("setResultFeatures must be called before train")
@@ -169,7 +201,20 @@ class Workflow:
                 train_data = raw.take(train_idx)
                 holdout_data = raw.take(holdout_idx)
 
-        fitted_data, fitted = fit_and_transform_dag(train_data, self.result_features)
+        if self._workflow_cv and selector is not None:
+            from .cv import workflow_cv_results
+
+            selector.precomputed_results = workflow_cv_results(
+                selector, train_data, prefitted=self._prefitted
+            )
+            log.info(
+                "Workflow-level CV: %d candidate results from per-fold DAG refits",
+                len(selector.precomputed_results),
+            )
+
+        fitted_data, fitted = fit_and_transform_dag(
+            train_data, self.result_features, prefitted=self._prefitted
+        )
 
         selector_info = None
         if selector is not None:
@@ -257,19 +302,28 @@ class WorkflowModel:
             reader = DatasetReader(self._with_missing_response(dataset))
         if reader is None:
             raise ValueError("score requires a dataset or reader")
-        return reader.generate_dataset(list(self.raw_features))
+        try:
+            raw = reader.generate_dataset(list(self.raw_features))
+        except KeyError:
+            # scoring data typically lacks the response column: generate the
+            # predictors only and synthesize null labels
+            raw = reader.generate_dataset(
+                [f for f in self.raw_features if not f.is_response]
+            )
+        return self._with_missing_response(raw)
 
     def _with_missing_response(self, dataset: Dataset) -> Dataset:
-        """Scoring data often lacks the response column; synthesize zeros
-        (the reference reader produces null labels at score time)."""
+        """Scoring data often lacks the response column; synthesize NULL
+        labels of the right physical type (mask=False / None — the reference
+        reader produces null labels at score time). Evaluation rejects
+        all-null labels loudly."""
+        from ..types.columns import empty_like
+
         for f in self.raw_features:
             if f.is_response and f.name not in dataset:
-                col = NumericColumn(
-                    f.ftype,
-                    np.zeros(dataset.num_rows, dtype=np.float64),
-                    np.ones(dataset.num_rows, dtype=bool),
+                dataset = dataset.with_column(
+                    f.name, empty_like(f.ftype, dataset.num_rows)
                 )
-                dataset = dataset.with_column(f.name, col)
         return dataset
 
     def score(
@@ -290,16 +344,26 @@ class WorkflowModel:
         return transformed.select(keep)
 
     def score_and_evaluate(
-        self, dataset: Dataset, evaluator=None
+        self,
+        dataset: Dataset | None = None,
+        evaluator=None,
+        reader: DataReader | None = None,
     ) -> tuple[Dataset, dict[str, Any]]:
-        scores = self.score(dataset, keep_intermediate_features=True)
+        scores = self.score(dataset, reader=reader, keep_intermediate_features=True)
         metrics = self._evaluate_transformed(scores, evaluator)
         keep = [f.name for f in self.result_features if f.name in scores]
         return scores.select(keep), metrics
 
-    def evaluate(self, dataset: Dataset, evaluator=None) -> dict[str, Any]:
-        """Score + evaluate against the true labels present in ``dataset``."""
-        transformed = self.score(dataset, keep_intermediate_features=True)
+    def evaluate(
+        self,
+        dataset: Dataset | None = None,
+        evaluator=None,
+        reader: DataReader | None = None,
+    ) -> dict[str, Any]:
+        """Score + evaluate against the true labels present in the data."""
+        transformed = self.score(
+            dataset, reader=reader, keep_intermediate_features=True
+        )
         return self._evaluate_transformed(transformed, evaluator)
 
     def _evaluate_transformed(self, transformed: Dataset, evaluator=None) -> dict[str, Any]:
@@ -332,6 +396,12 @@ class WorkflowModel:
                 )
             evaluator = by_name[name]
         label = transformed[self.selector_info["labelName"]]
+        if isinstance(label, NumericColumn) and not label.mask.any():
+            raise ValueError(
+                "evaluate requires true labels, but the response column "
+                f"'{self.selector_info['labelName']}' is absent/all-null in "
+                "the provided data"
+            )
         pred = transformed[self.selector_info["predName"]]
         return evaluator.evaluate(label, pred)
 
