@@ -14,9 +14,26 @@ workload shape (best available proxy) and vs_baseline = reference / ours
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+
+def _enable_compile_cache() -> None:
+    """Persist XLA compilations across bench runs (first compile of the
+    model-selector sweep is minutes; cached reruns skip it)."""
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
+_enable_compile_cache()
 
 # Reference workload proxy: TransmogrifAI helloworld Titanic train
 # (local[*] Spark, BinaryClassificationModelSelector LR+RF+XGB defaults)

@@ -156,59 +156,4 @@ void tp_parse_doubles(const char* buf, const int64_t* offsets, int64_t n,
   }
 }
 
-// Split one CSV buffer into fields (RFC-4180 quoting: "" escapes a quote
-// inside a quoted field). Writes field boundaries as (start, end) pairs and
-// row ids; returns the number of fields found, or -(needed) if the caps are
-// too small. Callers then slice the original buffer — zero copies.
-int64_t tp_csv_split(const char* buf, int64_t len, char delim,
-                     int64_t* field_start, int64_t* field_end,
-                     int64_t* field_row, int64_t max_fields) {
-  int64_t nf = 0;
-  int64_t row = 0;
-  int64_t i = 0;
-  while (i < len) {
-    // one field
-    int64_t start, end;
-    if (buf[i] == '"') {
-      start = ++i;
-      // scan to closing quote, collapsing "" later (flagged by caller via
-      // memchr for '"' in the slice — rare path)
-      while (i < len) {
-        if (buf[i] == '"') {
-          if (i + 1 < len && buf[i + 1] == '"') {
-            i += 2;
-            continue;
-          }
-          break;
-        }
-        i++;
-      }
-      end = i;
-      if (i < len) i++;  // closing quote
-    } else {
-      start = i;
-      while (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r') i++;
-      end = i;
-    }
-    if (nf >= max_fields) return -(nf + 1);
-    field_start[nf] = start;
-    field_end[nf] = end;
-    field_row[nf] = row;
-    nf++;
-    // separator handling
-    if (i < len && buf[i] == delim) {
-      i++;
-      // trailing delimiter at EOL is handled by the loop producing the next
-      // (possibly empty) field
-      continue;
-    }
-    if (i < len && (buf[i] == '\r' || buf[i] == '\n')) {
-      if (buf[i] == '\r' && i + 1 < len && buf[i + 1] == '\n') i++;
-      i++;
-      row++;
-    }
-  }
-  return nf;
-}
-
 }  // extern "C"

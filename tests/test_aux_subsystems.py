@@ -1,0 +1,167 @@
+"""Auxiliary subsystems: streaming histogram, RecordInsightsCorr,
+sensitive-feature detection (SURVEY.md §2.5 item 6, §5.5)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils.streaming_histogram import StreamingHistogram
+
+
+class TestStreamingHistogram:
+    def test_exact_below_capacity(self):
+        h = StreamingHistogram(max_bins=10)
+        for v in [1, 2, 2, 3]:
+            h.update(v)
+        assert h.bins == [(1.0, 1.0), (2.0, 2.0), (3.0, 1.0)]
+        assert h.total_count == 4
+
+    def test_bounded_bins(self):
+        h = StreamingHistogram(max_bins=8)
+        rng = np.random.default_rng(0)
+        for v in rng.normal(size=1000):
+            h.update(float(v))
+        assert len(h.bins) <= 8
+        assert h.total_count == 1000
+
+    def test_quantiles_approximate(self):
+        h = StreamingHistogram(max_bins=64)
+        rng = np.random.default_rng(1)
+        data = rng.uniform(0, 100, 5000)
+        for v in data:
+            h.update(float(v))
+        for q in (0.25, 0.5, 0.9):
+            est = h.quantile(q)
+            true = np.quantile(data, q)
+            assert abs(est - true) < 5.0
+
+    def test_merge_is_monoid(self):
+        rng = np.random.default_rng(2)
+        data = rng.normal(size=2000)
+        h1, h2 = StreamingHistogram(32), StreamingHistogram(32)
+        for v in data[:1000]:
+            h1.update(float(v))
+        for v in data[1000:]:
+            h2.update(float(v))
+        merged = h1.merge(h2)
+        assert merged.total_count == 2000
+        # merged median close to the full-data median
+        assert abs(merged.quantile(0.5) - np.median(data)) < 0.2
+
+    def test_sum_at(self):
+        h = StreamingHistogram(10)
+        for v in [1, 2, 3, 4, 5]:
+            h.update(v)
+        assert h.sum_at(0.5) == 0.0
+        assert h.sum_at(5.0) == 5.0
+        assert 2.0 <= h.sum_at(3.0) <= 3.0
+
+    def test_json_round_trip(self):
+        h = StreamingHistogram(4)
+        for v in [1, 2, 3, 4, 5, 6]:
+            h.update(v)
+        h2 = StreamingHistogram.from_json(h.to_json())
+        assert h2.bins == h.bins
+
+
+class TestRecordInsightsCorr:
+    def test_top_feature_is_the_signal(self):
+        from transmogrifai_tpu.insights import RecordInsightsCorr
+        from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+
+        rng = np.random.default_rng(0)
+        n = 200
+        signal = rng.normal(size=n)
+        noise = rng.normal(size=n)
+        label = (signal > 0).astype(float)
+        ds = Dataset.of({
+            "label": column_from_values(T.RealNN, label),
+            "signal": column_from_values(T.Real, signal),
+            "noise": column_from_values(T.Real, noise),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        sel = BinaryClassificationModelSelector(
+            models=[(LogisticRegression(), {"reg_param": [0.01]})], seed=1
+        )
+        pred = sel.set_input(resp, vec).get_output()
+        insights = pred.transform_with(RecordInsightsCorr(top_k=3), vec)
+        data, _ = fit_and_transform_dag(ds, [insights])
+        rows = data[insights.name].to_list()
+        assert len(rows) == n
+        # the signal column should appear in the insights of most rows
+        hits = sum(1 for r in rows if any("signal" in k for k in r))
+        assert hits > n * 0.9
+
+    def test_persistence_round_trip(self):
+        from transmogrifai_tpu.insights.correlation import (
+            RecordInsightsCorrModel,
+        )
+        from transmogrifai_tpu.workflow.persistence import construct_stage
+
+        m = RecordInsightsCorrModel(
+            corr=np.array([[0.5, -0.2]]),
+            norm_kind="minmax",
+            shift=np.zeros(2),
+            scale=np.ones(2),
+            top_k=2,
+        )
+        m2 = construct_stage("RecordInsightsCorrModel", m.get_params(), m.get_arrays())
+        np.testing.assert_array_equal(m2.corr, m.corr)
+
+
+class TestSensitiveFeatures:
+    def _ds(self):
+        return Dataset.of({
+            "label": column_from_values(T.RealNN, [1.0, 0.0, 1.0]),
+            "contact": column_from_values(
+                T.Text, ["a@x.com", "b@y.org", "c@z.net"]
+            ),
+            "fullname": column_from_values(
+                T.Text, ["John Smith", "Mary Jones", "David Lee"]
+            ),
+            "notes": column_from_values(
+                T.Text, ["likes the product", "asked for refund", "happy"]
+            ),
+        })
+
+    def test_detection(self):
+        from transmogrifai_tpu.prep.sensitive import detect_sensitive_features
+
+        ds = self._ds()
+        resp, preds = from_dataset(ds, response="label")
+        found = {s.name: s.kind for s in detect_sensitive_features(ds, preds)}
+        assert found.get("contact") == "Email"
+        assert found.get("fullname") == "Name"
+        assert "notes" not in found
+
+    def test_workflow_records_sensitive_info(self):
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+        from transmogrifai_tpu.workflow.workflow import Workflow
+
+        ds = self._ds()
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        sel = BinaryClassificationModelSelector(
+            models=[(LogisticRegression(), {"reg_param": [0.01]})],
+            splitter=None, seed=1,
+        )
+        pred = sel.set_input(resp, vec).get_output()
+        model = (
+            Workflow()
+            .set_result_features(pred)
+            .set_input_dataset(ds)
+            .with_sensitive_feature_detection()
+            .train()
+        )
+        info = model.summary_json()["sensitiveFeatures"]
+        kinds = {s["name"]: s["kind"] for s in info}
+        assert kinds.get("contact") == "Email"
+        assert kinds.get("fullname") == "Name"

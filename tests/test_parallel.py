@@ -152,3 +152,46 @@ def test_grid_parallel_fit_shards_grid_axis(rng):
     assert w.shape == (g, d) and np.isfinite(w).all()
     # stronger regularization shrinks weights
     assert np.linalg.norm(w[-1]) < np.linalg.norm(w[0])
+
+
+class TestSegmentReductions:
+    """Device-side per-key event aggregation (parallel/segments.py)."""
+
+    def test_segment_ops_match_host(self, mesh):
+        import numpy as np
+        from transmogrifai_tpu.parallel import psegment_reduce
+
+        rng = np.random.default_rng(0)
+        n, k = 1000, 7
+        seg = rng.integers(0, k, n)
+        vals = rng.normal(size=n).astype(np.float32)
+        for op, ref in [
+            ("sum", lambda m: vals[m].sum()),
+            ("max", lambda m: vals[m].max()),
+            ("min", lambda m: vals[m].min()),
+            ("mean", lambda m: vals[m].mean()),
+            ("count", lambda m: float(m.sum())),
+        ]:
+            out = psegment_reduce(vals, seg, k, mesh, op=op)
+            for s in range(k):
+                m = seg == s
+                assert abs(out[s] - ref(m)) < 1e-3, (op, s)
+
+    def test_aggregate_events_on_device(self, mesh):
+        import numpy as np
+        from transmogrifai_tpu.parallel import aggregate_events_on_device
+
+        keys = ["u1", "u2", "u1", "u3", "u2", "u1"]
+        vals = np.array([1.0, 10.0, 2.0, 100.0, 20.0, 4.0], dtype=np.float32)
+        out = aggregate_events_on_device(keys, vals, mesh, op="sum")
+        assert out == {"u1": 7.0, "u2": 30.0, "u3": 100.0}
+
+    def test_padding_invariance(self, mesh):
+        """Row counts not divisible by the mesh shards still reduce right."""
+        import numpy as np
+        from transmogrifai_tpu.parallel import psegment_reduce
+
+        vals = np.array([5.0, -3.0, 7.0], dtype=np.float32)  # 3 rows, 8 shards
+        seg = np.array([0, 1, 0])
+        out = psegment_reduce(vals, seg, 2, mesh, op="max")
+        assert out[0] == 7.0 and out[1] == -3.0

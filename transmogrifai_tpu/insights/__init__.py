@@ -1,3 +1,4 @@
 """Model interpretability (reference: ModelInsights, RecordInsightsLOCO)."""
 from .model_insights import model_insights  # noqa: F401
 from .loco import RecordInsightsLOCO  # noqa: F401
+from .correlation import RecordInsightsCorr, RecordInsightsCorrModel  # noqa: F401

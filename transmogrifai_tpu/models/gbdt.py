@@ -243,6 +243,10 @@ class ForestRegressionModel(_BinnedModel):
 # estimators
 # ---------------------------------------------------------------------------
 class _TreeEstimator(PredictorEstimator):
+    #: grid params that are STATIC in the jitted fit (shape-affecting);
+    #: points sharing them batch into one vmapped fit
+    _STATIC_GRID_KEYS: tuple = ()
+
     def __init__(self, operation_name: str, max_depth: int, max_bins: int, uid=None):
         super().__init__(operation_name, uid=uid)
         self.max_depth = max_depth
@@ -253,6 +257,60 @@ class _TreeEstimator(PredictorEstimator):
         return thresholds, TR.bin_data(
             jnp.asarray(x, dtype=jnp.float32), jnp.asarray(thresholds)
         )
+
+    def _fit_group_batched(self, x, y, row_mask, group_points):
+        """Fit same-static-shape grid points in ONE vmapped program; None →
+        caller falls back to sequential fits. Overridden per family."""
+        return None
+
+    def fit_arrays_batched(self, x, y, row_mask, points):
+        """Validator hook (validators.py:102): the model×grid sweep batches
+        points that share static shapes — the TPU replacement for the
+        reference's driver thread pool (OpValidator.scala:363-367). Cuts a
+        3-depth × 6-point tree grid from 18 dispatches to 3.
+
+        Disabled on the axon TPU runtime: vmapping whole forest/boost fits
+        crashes its worker with a kernel fault (observed with both the
+        pallas and scatter histogram impls); the sweep runs sequentially
+        there until the runtime is fixed. Override with
+        TPTPU_BATCHED_FITS=1."""
+        import os
+
+        if (
+            jax.default_backend() == "tpu"
+            and not os.environ.get("TPTPU_BATCHED_FITS")
+        ):
+            return [
+                self.with_params(**p).fit_arrays(x, y, row_mask) for p in points
+            ]
+        if not self._STATIC_GRID_KEYS:
+            return [
+                self.with_params(**p).fit_arrays(x, y, row_mask) for p in points
+            ]
+        groups: dict[tuple, list[int]] = {}
+        for i, p in enumerate(points):
+            merged = {**self.get_params(), **p}
+            key = tuple(merged.get(k) for k in self._STATIC_GRID_KEYS)
+            groups.setdefault(key, []).append(i)
+        models: list = [None] * len(points)
+        for idxs in groups.values():
+            fitted = None
+            if len(idxs) > 1:
+                fitted = self._fit_group_batched(
+                    x, y, row_mask, [points[i] for i in idxs]
+                )
+            if fitted is None:
+                fitted = [
+                    self.with_params(**points[i]).fit_arrays(x, y, row_mask)
+                    for i in idxs
+                ]
+            for i, m in zip(idxs, fitted):
+                models[i] = m
+        return models
+
+    @staticmethod
+    def _tree_slice(stacked_trees, i):
+        return jax.tree.map(lambda a: a[i], stacked_trees)
 
 
 class XGBoostClassifier(_TreeEstimator):
@@ -293,6 +351,8 @@ class XGBoostClassifier(_TreeEstimator):
             "max_bins": self.max_bins,
         }
 
+    _STATIC_GRID_KEYS = ("num_round", "max_depth", "max_bins")
+
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned = self._binned(x)
         present = y[row_mask > 0]
@@ -319,6 +379,53 @@ class XGBoostClassifier(_TreeEstimator):
             per_class.append(trees)
         return BoostedMultiModel(thresholds, per_class, float(self.eta), 0.0)
 
+    def _normalize_boost(self, merged: dict) -> dict:
+        """Map this family's param names onto the boosting knobs (GBT uses
+        Spark names: maxIter/stepSize/minInstancesPerNode)."""
+        return merged
+
+    def _fit_group_batched(self, x, y, row_mask, group_points):
+        present = y[row_mask > 0]
+        num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
+        if num_classes != 2:
+            return None  # one-vs-rest loops stay sequential
+        base = self.with_params(**group_points[0])
+        thresholds, binned = base._binned(x)
+        merged = [
+            self._normalize_boost({**self.get_params(), **p})
+            for p in group_points
+        ]
+        stack = lambda k: jnp.asarray(  # noqa: E731
+            [float(m[k]) for m in merged], dtype=jnp.float32
+        )
+        yj = jnp.asarray(y, dtype=jnp.float32)
+        rm = jnp.asarray(row_mask, dtype=jnp.float32)
+        m0 = merged[0]
+
+        def one(eta, lam, gam, mcw, mig):
+            trees, _ = TR.fit_boosted(
+                binned, yj, rm,
+                num_rounds=int(m0["num_round"]),
+                max_depth=int(m0["max_depth"]),
+                num_bins=int(m0["max_bins"]),
+                eta=eta, reg_lambda=lam, gamma=gam,
+                min_child_weight=mcw, min_info_gain=mig,
+                objective="binary:logistic",
+                parallel_fits=len(merged),
+            )
+            return trees
+
+        trees = jax.vmap(one)(
+            stack("eta"), stack("reg_lambda"), stack("gamma"),
+            stack("min_child_weight"), stack("min_info_gain"),
+        )
+        return [
+            BoostedBinaryModel(
+                thresholds, self._tree_slice(trees, i), float(m["eta"]), 0.0
+            )
+            for i, m in enumerate(merged)
+        ]
+
 
 class XGBoostRegressor(_TreeEstimator):
     model_type = "OpXGBoostRegressor"
@@ -344,6 +451,48 @@ class XGBoostRegressor(_TreeEstimator):
         self.min_info_gain = min_info_gain
 
     get_params = XGBoostClassifier.get_params
+    _STATIC_GRID_KEYS = ("num_round", "max_depth", "max_bins")
+    _normalize_boost = XGBoostClassifier._normalize_boost
+
+    def _fit_group_batched(self, x, y, row_mask, group_points):
+        base_est = self.with_params(**group_points[0])
+        thresholds, binned = base_est._binned(x)
+        merged = [
+            self._normalize_boost({**self.get_params(), **p})
+            for p in group_points
+        ]
+        stack = lambda k: jnp.asarray(  # noqa: E731
+            [float(m[k]) for m in merged], dtype=jnp.float32
+        )
+        base_score = float(np.mean(y[row_mask > 0])) if (row_mask > 0).any() else 0.0
+        yj = jnp.asarray(y, dtype=jnp.float32)
+        rm = jnp.asarray(row_mask, dtype=jnp.float32)
+        m0 = merged[0]
+
+        def one(eta, lam, gam, mcw, mig):
+            trees, _ = TR.fit_boosted(
+                binned, yj, rm,
+                num_rounds=int(m0["num_round"]),
+                max_depth=int(m0["max_depth"]),
+                num_bins=int(m0["max_bins"]),
+                eta=eta, reg_lambda=lam, gamma=gam,
+                min_child_weight=mcw, min_info_gain=mig,
+                base_score=base_score,
+                objective="reg:squarederror",
+                parallel_fits=len(merged),
+            )
+            return trees
+
+        trees = jax.vmap(one)(
+            stack("eta"), stack("reg_lambda"), stack("gamma"),
+            stack("min_child_weight"), stack("min_info_gain"),
+        )
+        return [
+            BoostedRegressionModel(
+                thresholds, self._tree_slice(trees, i), float(m["eta"]), base_score
+            )
+            for i, m in enumerate(merged)
+        ]
 
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned = self._binned(x)
@@ -407,6 +556,8 @@ class GBTClassifier(XGBoostClassifier):
             "max_bins": self.max_bins,
         }
 
+    _STATIC_GRID_KEYS = ("max_iter", "max_depth", "max_bins")
+
     def fit_arrays(self, x, y, row_mask):
         # keep the boosted knobs in sync with the Spark-named params
         self.num_round = self.max_iter
@@ -414,9 +565,23 @@ class GBTClassifier(XGBoostClassifier):
         self.min_child_weight = float(self.min_instances_per_node)
         return super().fit_arrays(x, y, row_mask)
 
+    def _normalize_boost(self, merged: dict) -> dict:
+        return {
+            "num_round": merged["max_iter"],
+            "eta": merged["step_size"],
+            "reg_lambda": 0.0,
+            "gamma": 0.0,
+            "min_child_weight": float(merged["min_instances_per_node"]),
+            "min_info_gain": merged["min_info_gain"],
+            "max_depth": merged["max_depth"],
+            "max_bins": merged["max_bins"],
+        }
+
 
 class GBTRegressor(XGBoostRegressor):
     model_type = "OpGBTRegressor"
+    _STATIC_GRID_KEYS = ("max_iter", "max_depth", "max_bins")
+    _normalize_boost = GBTClassifier._normalize_boost
 
     def __init__(
         self,
@@ -487,6 +652,8 @@ class RandomForestClassifier(_TreeEstimator):
             "seed": self.seed,
         }
 
+    _STATIC_GRID_KEYS = ("num_trees", "max_depth", "max_bins", "seed")
+
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned = self._binned(x)
         present = y[row_mask > 0]
@@ -514,6 +681,43 @@ class RandomForestClassifier(_TreeEstimator):
             ]
         return ForestClassifierModel(thresholds, forests)
 
+    def _fit_group_batched(self, x, y, row_mask, group_points):
+        present = y[row_mask > 0]
+        num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
+        if num_classes != 2:
+            return None
+        base = self.with_params(**group_points[0])
+        thresholds, binned = base._binned(x)
+        merged = [{**self.get_params(), **p} for p in group_points]
+        colsample = 1.0 / np.sqrt(max(x.shape[1], 1))
+        stack = lambda k: jnp.asarray(  # noqa: E731
+            [float(m[k]) for m in merged], dtype=jnp.float32
+        )
+        yj = jnp.asarray((y == 1).astype(np.float32))
+        rm = jnp.asarray(row_mask, dtype=jnp.float32)
+
+        def one(sub, mi, mig):
+            return TR.fit_forest(
+                binned, yj, rm,
+                num_trees=int(base.num_trees),
+                max_depth=int(base.max_depth),
+                num_bins=int(base.max_bins),
+                subsample_rate=sub, colsample_rate=float(colsample),
+                min_instances=mi, min_info_gain=mig,
+                seed=int(base.seed),
+                parallel_fits=len(merged),
+            )
+
+        forests = jax.vmap(one)(
+            stack("subsampling_rate"),
+            stack("min_instances_per_node"),
+            stack("min_info_gain"),
+        )
+        return [
+            ForestClassifierModel(thresholds, [self._tree_slice(forests, i)])
+            for i in range(len(merged))
+        ]
+
 
 class RandomForestRegressor(_TreeEstimator):
     model_type = "OpRandomForestRegressor"
@@ -537,6 +741,7 @@ class RandomForestRegressor(_TreeEstimator):
         self.seed = seed
 
     get_params = RandomForestClassifier.get_params
+    _STATIC_GRID_KEYS = ("num_trees", "max_depth", "max_bins", "seed")
 
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned = self._binned(x)
@@ -556,11 +761,48 @@ class RandomForestRegressor(_TreeEstimator):
         )
         return ForestRegressionModel(thresholds, trees)
 
+    def _fit_group_batched(self, x, y, row_mask, group_points):
+        base = self.with_params(**group_points[0])
+        thresholds, binned = base._binned(x)
+        merged = [{**self.get_params(), **p} for p in group_points]
+        stack = lambda k: jnp.asarray(  # noqa: E731
+            [float(m[k]) for m in merged], dtype=jnp.float32
+        )
+        yj = jnp.asarray(y, dtype=jnp.float32)
+        rm = jnp.asarray(row_mask, dtype=jnp.float32)
+
+        def one(sub, mi, mig):
+            return TR.fit_forest(
+                binned, yj, rm,
+                num_trees=int(base.num_trees),
+                max_depth=int(base.max_depth),
+                num_bins=int(base.max_bins),
+                subsample_rate=sub, colsample_rate=1.0 / 3.0,
+                min_instances=mi, min_info_gain=mig,
+                seed=int(base.seed),
+                parallel_fits=len(merged),
+            )
+
+        forests = jax.vmap(one)(
+            stack("subsampling_rate"),
+            stack("min_instances_per_node"),
+            stack("min_info_gain"),
+        )
+        return [
+            ForestRegressionModel(thresholds, self._tree_slice(forests, i))
+            for i in range(len(merged))
+        ]
+
 
 class DecisionTreeClassifier(RandomForestClassifier):
     """Single unbagged tree (OpDecisionTreeClassifier parity)."""
 
     model_type = "OpDecisionTreeClassifier"
+
+    def _fit_group_batched(self, x, y, row_mask, group_points):
+        # RF's batched fit bootstraps + column-samples; a decision tree is
+        # deterministic and full-feature — never inherit that path
+        return None
 
     def __init__(self, max_depth: int = 5, min_instances_per_node: int = 1,
                  min_info_gain: float = 0.0, max_bins: int = 32, uid=None):
@@ -592,6 +834,9 @@ class DecisionTreeClassifier(RandomForestClassifier):
 
 class DecisionTreeRegressor(RandomForestRegressor):
     model_type = "OpDecisionTreeRegressor"
+
+    def _fit_group_batched(self, x, y, row_mask, group_points):
+        return None  # see DecisionTreeClassifier — no RF randomization
 
     def __init__(self, max_depth: int = 5, min_instances_per_node: int = 1,
                  min_info_gain: float = 0.0, max_bins: int = 32, uid=None):

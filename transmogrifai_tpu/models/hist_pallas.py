@@ -161,17 +161,25 @@ def build_histogram_scatter(
     num_nodes: int,
     num_bins: int,
 ) -> jax.Array:
-    """XLA scatter-add reference implementation (CPU / correctness)."""
+    """XLA scatter-add reference implementation (CPU / correctness).
+
+    Grad and hess scatter as separate flat [N·F] vectors — a trailing
+    length-2 axis would be tile-padded 64× on TPU (catastrophic under the
+    forest vmap)."""
     n, f = binned.shape
     col_ids = jnp.arange(f, dtype=jnp.int32)[None, :]
     safe_node = jnp.maximum(node, 0)
     alive = (node >= 0).astype(jnp.float32)
     flat = ((safe_node[:, None] * f + col_ids) * num_bins + binned).reshape(-1)
-    gh = jnp.stack([grad * alive, hess * alive], axis=1)  # [N, 2]
-    vals = jnp.repeat(gh[:, None, :], f, axis=1).reshape(-1, 2)
-    hist = jnp.zeros((num_nodes * f * num_bins, 2), dtype=jnp.float32)
-    hist = hist.at[flat].add(vals)
-    return hist.reshape(num_nodes, f, num_bins, 2)
+    gv = jnp.repeat(grad * alive, f)
+    hv = jnp.repeat(hess * alive, f)
+    size = num_nodes * f * num_bins
+    hg = jnp.zeros(size, dtype=jnp.float32).at[flat].add(gv)
+    hh = jnp.zeros(size, dtype=jnp.float32).at[flat].add(hv)
+    return jnp.stack(
+        [hg.reshape(num_nodes, f, num_bins), hh.reshape(num_nodes, f, num_bins)],
+        axis=-1,
+    )
 
 
 def default_impl() -> str:

@@ -57,7 +57,7 @@ def bin_data(x: jax.Array, thresholds: jax.Array) -> jax.Array:
 
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins", "hist_impl"),
+    static_argnames=("max_depth", "num_bins", "hist_impl", "parallel_fits"),
 )
 def grow_tree(
     binned: jax.Array,     # [N, F] int32 codes in [0, num_bins)
@@ -72,6 +72,7 @@ def grow_tree(
     min_child_weight: float | jax.Array = 1.0,
     min_info_gain: float | jax.Array = 0.0,
     hist_impl: str | None = None,
+    parallel_fits: int = 1,
 ) -> Tree:
     from .hist_pallas import (
         build_histogram_pallas,
@@ -85,31 +86,36 @@ def grow_tree(
     g = grad * row_mask
     h = hess * row_mask
     impl = hist_impl or default_impl()
+    if parallel_fits > 1 and impl == "pallas":
+        # vmapping the Mosaic custom call over batched grid fits crashes the
+        # TPU worker (kernel fault); batched sweeps take the scatter path
+        impl = "scatter"
 
     # ---- node chunking: bound per-level histogram memory (the Spark
-    # maxMemoryInMB node-group equivalent). Deep trees on wide matrices would
-    # otherwise allocate [2^depth, F, B] gain tensors (GBs); instead each
-    # level processes `chunk_nodes` node slots at a time with static shapes,
-    # and chunks beyond the level's live node range are skipped via lax.cond.
-    budget_elems = 1 << 22  # ~4M f32 per histogram tensor (~16 MB)
-    chunk_nodes = max(1, budget_elems // max(f * b, 1))
-    while chunk_nodes & (chunk_nodes - 1):  # round down to a power of two
-        chunk_nodes &= chunk_nodes - 1
-    chunk_nodes = min(chunk_nodes, max_nodes)
+    # maxMemoryInMB node-group equivalent). One shared fixed-size level body
+    # runs under lax.fori_loop (unrolling per-level sizes was measured
+    # SLOWER on TPU — less fusion, more distinct program regions). Forests
+    # lax.map trees sequentially, so ONE tree owns the budget — but batched
+    # grid fits vmap `parallel_fits` whole fits concurrently, so the caller
+    # must declare that factor and the per-fit budget shrinks accordingly.
+    budget_elems = max((1 << 25) // max(parallel_fits, 1), 1 << 20)
+    chunk_cap = max(1, budget_elems // max(f * b, 1))
+    while chunk_cap & (chunk_cap - 1):  # round down to a power of two
+        chunk_cap &= chunk_cap - 1
+    chunk_cap = min(chunk_cap, max_nodes)
     if impl == "pallas":
-        # Mosaic keeps the kernel's full [f_pad, M, 128]×2 output resident in
-        # scoped VMEM (plus the [row_tile, M] node one-hot), so M must scale
-        # inversely with the feature count to stay under the ~16 MB budget
+        # Mosaic keeps the kernel's full [f_pad, M, b_pad]×2 output resident
+        # in scoped VMEM (plus the [row_tile, M] node one-hot), so M must
+        # scale inversely with the feature count to stay under ~16 MB;
+        # outputs are double-buffered: 2 bufs × 2 outs × f_pad·M·b_pad·4B
         f_pad = (f + 7) // 8 * 8
         b_pad = (b + 127) // 128 * 128  # kernel pads bins to lane width
-        # outputs are double-buffered: 2 bufs × 2 outs × f_pad·M·b_pad·4B
         m_cap = max(8, (1 << 19) // (f_pad * b_pad))
         while m_cap & (m_cap - 1):
             m_cap &= m_cap - 1
-        chunk_nodes = min(chunk_nodes, m_cap)
-    num_chunks = max_nodes // chunk_nodes
+        chunk_cap = min(chunk_cap, m_cap)
 
-    def chunk_stats(node, c0):
+    def chunk_stats(node, c0, chunk_nodes):
         """Best (gain, feat, bin) for node slots [c0, c0 + chunk_nodes)."""
         active = (node >= c0) & (node < c0 + chunk_nodes)
         local = jnp.where(active, node - c0, -1)  # -1 = dead for this chunk
@@ -148,8 +154,12 @@ def grow_tree(
             jnp.where(do_split, best_bin, 0),
         )
 
+    chunk_nodes = chunk_cap
+    num_chunks = max_nodes // chunk_nodes
+
     def level(d, carry):
-        # one compiled level body reused for every depth (lax.fori_loop)
+        # one compiled level body reused for every depth (lax.fori_loop);
+        # chunks wholly beyond the level's live node range are skipped
         node, feats, bins = carry
         n_nodes = jnp.left_shift(jnp.int32(1), d)
 
@@ -158,7 +168,7 @@ def grow_tree(
             c0 = ci * chunk_nodes
 
             def run(_):
-                cf, cb = chunk_stats(node, c0)
+                cf, cb = chunk_stats(node, c0, chunk_nodes)
                 return (
                     jax.lax.dynamic_update_slice(feats_d, cf, (c0,)),
                     jax.lax.dynamic_update_slice(bins_d, cb, (c0,)),
@@ -219,7 +229,7 @@ def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
 # --------------------------------------------------------------------------
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins", "num_trees", "bootstrap"),
+    static_argnames=("max_depth", "num_bins", "num_trees", "bootstrap", "parallel_fits"),
 )
 def fit_forest(
     binned: jax.Array,
@@ -234,6 +244,7 @@ def fit_forest(
     min_info_gain: float | jax.Array = 0.0,
     seed: int | jax.Array = 42,
     bootstrap: bool = True,
+    parallel_fits: int = 1,
 ) -> Tree:
     """Random forest of mean-target trees: bootstrap row weights + feature
     subsampling, all trees trained in one vmap (Spark RandomForest parity:
@@ -267,6 +278,7 @@ def fit_forest(
             gamma=0.0,
             min_child_weight=min_instances,
             min_info_gain=min_info_gain,
+            parallel_fits=parallel_fits,
         )
 
     # sequential lax.map keeps peak memory at ONE tree's histograms (a deep
@@ -283,7 +295,7 @@ def predict_forest(binned: jax.Array, trees: Tree) -> jax.Array:
 
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins", "num_rounds", "objective"),
+    static_argnames=("max_depth", "num_bins", "num_rounds", "objective", "parallel_fits"),
 )
 def fit_boosted(
     binned: jax.Array,
@@ -299,6 +311,7 @@ def fit_boosted(
     min_info_gain: float | jax.Array = 0.0,
     base_score: float | jax.Array = 0.0,
     objective: str = "binary:logistic",
+    parallel_fits: int = 1,
 ) -> tuple[Tree, jax.Array]:
     """Gradient boosting (XGBoost/Spark-GBT parity): lax.scan over rounds,
     second-order gradients, shrinkage eta. Returns stacked trees [R, ...]
@@ -320,6 +333,7 @@ def fit_boosted(
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
+            parallel_fits=parallel_fits,
         )
         margin = margin + eta * predict_tree(binned, tree)
         return margin, tree

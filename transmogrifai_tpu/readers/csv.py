@@ -100,6 +100,19 @@ def infer_csv_dataset(
                 from ..types.columns import NumericColumn
 
                 values, mask = parse_doubles(vals)
+                # strtod rejects a few strings Python float() accepts
+                # (unicode digits, exotic whitespace): re-parse only the
+                # (typically zero) fields the native path marked missing
+                import numpy as _np
+
+                for i in _np.nonzero(~_np.asarray(mask))[0]:
+                    v = vals[i]
+                    if v is not None and v.strip():
+                        try:
+                            values[i] = float(v)
+                            mask[i] = True
+                        except ValueError:
+                            pass
                 columns[name] = NumericColumn(T.Real, values, mask)
                 continue
         columns[name] = column_from_values(ftype, vals)

@@ -329,7 +329,12 @@ def parse_args(argv: list[str]) -> tuple[OpWorkflowRunType, OpParams]:
     if not argv:
         raise SystemExit("usage: <Train|Score|StreamingScore|Features|Evaluate> [--flags]")
     run_type = OpWorkflowRunType(argv[0])
+    # a params file is the BASE config; explicit flags override it
+    # regardless of their position relative to --param-location
     params = OpParams()
+    for i in range(1, len(argv) - 1, 2):
+        if argv[i] == "--param-location":
+            params = OpParams.from_file(argv[i + 1])
     i = 1
     while i < len(argv):
         flag = argv[i]
@@ -340,7 +345,7 @@ def parse_args(argv: list[str]) -> tuple[OpWorkflowRunType, OpParams]:
         value = argv[i + 1]
         key = flag[2:].replace("-", "_")
         if key == "param_location":
-            params = OpParams.from_file(value)
+            pass  # already loaded above
         elif hasattr(params, key):
             if isinstance(getattr(params, key), dict):
                 setattr(params, key, json.loads(value))

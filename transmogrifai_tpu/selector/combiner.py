@@ -156,8 +156,13 @@ class SelectedModelCombiner(ModelSelector):
             return SelectedModel(winner.best_model, summary)
 
         # Weighted: weights proportional to validation metric (inverted for
-        # smaller-is-better metrics, SelectedModelCombiner.scala weighting)
-        w1, w2 = (v1, v2) if larger_better else (1.0 / v1, 1.0 / v2)
+        # smaller-is-better metrics, SelectedModelCombiner.scala weighting);
+        # a perfect 0.0 error metric gets a finite, strongly-dominant weight
+        if larger_better:
+            w1, w2 = v1, v2
+        else:
+            eps = 1e-12
+            w1, w2 = 1.0 / max(v1, eps), 1.0 / max(v2, eps)
         combined = CombinedModel(
             m1.best_model, m2.best_model, w1, w2, self.problem_kind
         )

@@ -43,7 +43,7 @@ def _registry() -> dict[str, type]:
     if _BUILTINS_POPULATED:
         return _REGISTRY
     _BUILTINS_POPULATED = True
-    from ..insights import loco
+    from ..insights import correlation as insights_corr, loco
     from ..models import glm, gbdt, isotonic, linear, logistic, mlp, naive_bayes, svc
     from ..models.base import PredictorModel
     from ..ops import (
@@ -60,7 +60,7 @@ def _registry() -> dict[str, type]:
         glm, gbdt, isotonic, linear, logistic, mlp, naive_bayes, svc,
         categorical, combiner, dates, lists,
         maps, numeric, phone, text, derived_filter, sanity_checker,
-        model_selector, selector_combiner, loco,
+        model_selector, selector_combiner, loco, insights_corr,
         bucketizers, domains, embeddings, ops_math, scalers, simple,
         text_stages, time_period,
     ):

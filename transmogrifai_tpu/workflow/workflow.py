@@ -39,6 +39,7 @@ class Workflow:
         self.blocklisted_features: list[str] = []
         self._prefitted: dict[str, PipelineStage] = {}
         self._workflow_cv = False
+        self._detect_sensitive = False
 
     # ----------------------------------------------------------- configure
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -73,6 +74,13 @@ class Workflow:
         model selector are re-fit inside every CV fold, so their statistics
         cannot leak validation rows into candidate selection."""
         self._workflow_cv = True
+        return self
+
+    def with_sensitive_feature_detection(self) -> "Workflow":
+        """Scan raw text features for personal data at train time and record
+        SensitiveFeatureInformation in the model summary
+        (SensitiveFeatureInformation.scala, SURVEY.md §5.5)."""
+        self._detect_sensitive = True
         return self
 
     def with_raw_feature_filter(
@@ -170,6 +178,17 @@ class Workflow:
             raise ValueError("Input dataset cannot be empty")
         log.info("Generated raw data: %d rows, %d features", raw.num_rows, len(raw_features))
 
+        sensitive_info = None
+        if self._detect_sensitive:
+            from ..prep.sensitive import detect_sensitive_features
+
+            sensitive_info = [
+                s.to_json()
+                for s in detect_sensitive_features(raw, raw_features)
+            ]
+            if sensitive_info:
+                log.info("Sensitive features detected: %s", sensitive_info)
+
         rff_results = None
         if self._raw_feature_filter is not None:
             label_names = [f.name for f in raw_features if f.is_response]
@@ -253,6 +272,7 @@ class Workflow:
             holdout_rows=0 if holdout_data is None else holdout_data.num_rows,
             rff_results=None if rff_results is None else rff_results.to_json(),
             blocklisted=list(self.blocklisted_features),
+            sensitive_info=sensitive_info,
         )
         if selector is not None:
             # keep the live evaluator object so custom evaluators keep working
@@ -272,6 +292,7 @@ class WorkflowModel:
         holdout_rows: int = 0,
         rff_results: dict[str, Any] | None = None,
         blocklisted: list[str] | None = None,
+        sensitive_info: list[dict[str, Any]] | None = None,
     ):
         self.result_features = result_features
         self.raw_features = raw_features
@@ -281,6 +302,7 @@ class WorkflowModel:
         self.holdout_rows = holdout_rows
         self.rff_results = rff_results
         self.blocklisted = blocklisted or []
+        self.sensitive_info = sensitive_info
 
     # --------------------------------------------------------- persistence
     def save(self, path: str) -> None:
@@ -424,6 +446,7 @@ class WorkflowModel:
             "resultFeatures": [f.name for f in self.result_features],
             "blocklistedFeatures": self.blocklisted,
             "rawFeatureFilterResults": self.rff_results,
+            "sensitiveFeatures": self.sensitive_info,
             "modelSelectorSummary": sel_summary,
             "stageMetadata": stage_meta,
         }
