@@ -1,0 +1,6 @@
+"""Make `transmogrifai_tpu` importable when examples run from a source
+checkout without `pip install -e .` — import this first in every example."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

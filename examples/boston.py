@@ -1,4 +1,7 @@
 """Boston housing regression (reference: OpBostonSimple.scala)."""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402  (adds the repo root to sys.path)
 import json
 
 from transmogrifai_tpu.features import from_dataset

@@ -1,0 +1,77 @@
+"""Dataprep: conditional (target-event-relative) aggregation.
+
+Reference: helloworld/.../dataprep/ConditionalAggregation.scala — web-visit
+events aggregated per user relative to the first purchase event, so
+predictors only see pre-purchase data (temporal leakage-free) and the
+response only post-cutoff data.
+
+Run: python examples/conditional_aggregation.py
+"""
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402  (adds the repo root to sys.path)
+import datetime
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.readers import (
+    ConditionalParams,
+    DataReaders,
+    TimeStampToKeep,
+)
+
+DATA = "/root/reference/helloworld/src/main/resources/WebVisitsDataset/WebVisits.csv"
+FIELDS = ("user", "url", "productId", "price", "timestamp")
+
+
+def _ts(s: str) -> int:
+    return int(
+        datetime.datetime.strptime(s, "%Y-%m-%d::%H:%M:%S")
+        .replace(tzinfo=datetime.timezone.utc)
+        .timestamp()
+        * 1000
+    )
+
+
+def _rows() -> list[dict]:
+    with open(DATA) as fh:
+        return [dict(zip(FIELDS, ln.strip().split(","))) for ln in fh if ln.strip()]
+
+
+def main():
+    visits = _rows()
+    is_purchase = lambda r: bool(r["productId"])  # noqa: E731
+
+    # predictors: pre-purchase browsing behavior (aggregated strictly before
+    # the per-user cutoff = first purchase time)
+    num_visits = FeatureBuilder.Real("numVisits").extract(
+        lambda r: 1.0
+    ).as_predictor()
+    pages = FeatureBuilder.MultiPickList("pagesVisited").extract(
+        lambda r: {r["url"].rsplit("/", 1)[-1]}
+    ).as_predictor()
+
+    # response: did the user purchase within a day after the cutoff
+    purchased = FeatureBuilder.Binary("purchasedNextDay").extract(
+        lambda r: bool(r["productId"])
+    ).as_response()
+
+    reader = DataReaders.Conditional.records(
+        visits,
+        key_fn=lambda r: r["user"],
+        params=ConditionalParams(
+            timestamp_fn=lambda r: _ts(r["timestamp"]),
+            target_condition=is_purchase,
+            timestamp_to_keep=TimeStampToKeep.MIN,
+            response_window_ms=86_400_000,
+            predictor_window_ms=None,
+            drop_if_target_condition_not_met=True,
+        ),
+    )
+    ds = reader.generate_dataset([purchased, num_visits, pages])
+    for row in ds.rows():
+        print(row)
+    return ds
+
+
+if __name__ == "__main__":
+    main()

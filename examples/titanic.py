@@ -3,6 +3,9 @@
 Reference: helloworld/.../OpTitanicSimple.scala:30-130. Run:
     python examples/titanic.py
 """
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402  (adds the repo root to sys.path)
 import json
 
 from transmogrifai_tpu.features import from_dataset

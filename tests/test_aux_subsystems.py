@@ -165,3 +165,13 @@ class TestSensitiveFeatures:
         kinds = {s["name"]: s["kind"] for s in info}
         assert kinds.get("contact") == "Email"
         assert kinds.get("fullname") == "Name"
+
+        # the governance record must survive save/load (manifest field)
+        import tempfile
+
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+        with tempfile.TemporaryDirectory() as d:
+            model.save(d)
+            loaded = WorkflowModel.load(d)
+        assert loaded.summary_json()["sensitiveFeatures"] == info

@@ -6,8 +6,9 @@ prediction column, plus column stats for normalization. Transform: per
 record, importance = corr[pred, feature] · normalized feature value; the
 top-K |importance| columns per prediction are reported as a map.
 
-All device math is two matmuls (XᵀY correlation and the normalize-multiply),
-so unlike the reference's RDD stats pass this fits in one fused XLA program.
+The fit is two matmuls (XᵀY correlation + normalization stats); the
+transform processes rows in fixed-size blocks with top-k selection via
+argpartition, so memory stays at block×D per prediction column.
 """
 from __future__ import annotations
 
@@ -73,8 +74,10 @@ class RecordInsightsCorr(Estimator):
             s_c = rankdata(scores, axis=0)
         else:
             x_c, s_c = x, scores
-        xs = (x_c - x_c.mean(0)) / np.where(x_c.std(0) == 0, 1.0, x_c.std(0))
-        ss = (s_c - s_c.mean(0)) / np.where(s_c.std(0) == 0, 1.0, s_c.std(0))
+        x_sd = x_c.std(0)
+        s_sd = s_c.std(0)
+        xs = (x_c - x_c.mean(0)) / np.where(x_sd == 0, 1.0, x_sd)
+        ss = (s_c - s_c.mean(0)) / np.where(s_sd == 0, 1.0, s_sd)
         corr = ss.T @ xs / len(x)  # [C, D]
         corr = np.nan_to_num(corr)
 
@@ -123,27 +126,47 @@ class RecordInsightsCorrModel(Model):
             return self._meta.column_names()
         return [f"col_{j}" for j in range(dim)]
 
+    #: rows per block — bounds peak memory at BLOCK×D per prediction column
+    #: instead of N×C×D for the whole score set
+    _BLOCK = 1 << 16
+
     def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
         vec = cols[-1]
         assert isinstance(vec, VectorColumn)
         x = np.asarray(vec.values, dtype=np.float64)
         if self._meta is None:
             self._meta = vec.metadata
-        normalized = (x - self.shift[None, :]) / self.scale[None, :]
-        # importance [N, C, D]
-        imp = self.corr[None, :, :] * normalized[:, None, :]
         names = self._names(x.shape[1])
-        out = []
-        k = min(self.top_k, x.shape[1])
-        for r in range(num_rows):
-            row: dict[str, str] = {}
-            scores = imp[r]  # [C, D]
-            order = np.argsort(-np.abs(scores), axis=1)[:, :k]
-            for ci in range(scores.shape[0]):
-                for j in order[ci]:
-                    row.setdefault(
-                        names[int(j)],
-                        json.dumps([[ci, float(scores[ci, int(j)])]]),
-                    )
-            out.append(row)
+        d = x.shape[1]
+        k = min(self.top_k, d)
+        out: list[dict[str, str]] = []
+        for start in range(0, num_rows, self._BLOCK):
+            xb = x[start:start + self._BLOCK]
+            nb = len(xb)
+            normalized = (xb - self.shift[None, :]) / self.scale[None, :]
+            # per feature: the list of [prediction-index, importance] pairs
+            # over ALL prediction columns it ranks top-k for (the reference
+            # emits one pair per prediction index, RecordInsightsCorr.scala)
+            acc: list[dict[str, list]] = [{} for _ in range(nb)]
+            for ci in range(self.corr.shape[0]):
+                imp = normalized * self.corr[ci][None, :]  # [nb, D]
+                mag = np.abs(imp)
+                if k < d:
+                    idx = np.argpartition(-mag, k - 1, axis=1)[:, :k]
+                else:
+                    idx = np.broadcast_to(np.arange(d), (nb, d)).copy()
+                # deterministic order inside the top-k: |importance| desc
+                sub = np.take_along_axis(mag, idx, axis=1)
+                idx = np.take_along_axis(idx, np.argsort(-sub, axis=1), axis=1)
+                for r in range(nb):
+                    row_imp = imp[r]
+                    row_acc = acc[r]
+                    for j in idx[r]:
+                        row_acc.setdefault(names[int(j)], []).append(
+                            [ci, float(row_imp[j])]
+                        )
+            out.extend(
+                {name: json.dumps(pairs) for name, pairs in row.items()}
+                for row in acc
+            )
         return MapColumn(TextMap, out)

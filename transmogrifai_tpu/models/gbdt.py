@@ -312,6 +312,40 @@ class _TreeEstimator(PredictorEstimator):
     def _tree_slice(stacked_trees, i):
         return jax.tree.map(lambda a: a[i], stacked_trees)
 
+    def _vmapped_group_fit(
+        self, x, group_points, stacked_keys, fit_one, make_model, normalize=None
+    ):
+        """Shared plumbing for the vmapped same-static-shape grid fit: bin
+        once, merge (+ normalize) each point's params, stack the float knobs,
+        vmap ``fit_one`` over them, slice the stacked tree pytree back into
+        one model per point.
+
+        ``fit_one(binned, m0, n_fits, *knobs) -> tree pytree``;
+        ``make_model(thresholds, sliced_trees, merged_params) -> model``.
+        """
+        base = self.with_params(**group_points[0])
+        thresholds, binned = base._binned(x)
+        norm = normalize or (lambda m: m)
+        merged = [norm({**self.get_params(), **p}) for p in group_points]
+        knobs = [
+            jnp.asarray([float(m[k]) for m in merged], dtype=jnp.float32)
+            for k in stacked_keys
+        ]
+        m0 = merged[0]
+        trees = jax.vmap(lambda *vals: fit_one(binned, m0, len(merged), *vals))(
+            *knobs
+        )
+        return [
+            make_model(thresholds, self._tree_slice(trees, i), m)
+            for i, m in enumerate(merged)
+        ]
+
+
+#: the non-shape-affecting boosting knobs batched by the vmapped grid fit
+_BOOST_KNOBS = ("eta", "reg_lambda", "gamma", "min_child_weight", "min_info_gain")
+#: same for forests
+_FOREST_KNOBS = ("subsampling_rate", "min_instances_per_node", "min_info_gain")
+
 
 class XGBoostClassifier(_TreeEstimator):
     """OpXGBoostClassifier parity (XGBoost defaults: eta 0.3, maxDepth 6,
@@ -389,20 +423,10 @@ class XGBoostClassifier(_TreeEstimator):
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         if num_classes != 2:
             return None  # one-vs-rest loops stay sequential
-        base = self.with_params(**group_points[0])
-        thresholds, binned = base._binned(x)
-        merged = [
-            self._normalize_boost({**self.get_params(), **p})
-            for p in group_points
-        ]
-        stack = lambda k: jnp.asarray(  # noqa: E731
-            [float(m[k]) for m in merged], dtype=jnp.float32
-        )
         yj = jnp.asarray(y, dtype=jnp.float32)
         rm = jnp.asarray(row_mask, dtype=jnp.float32)
-        m0 = merged[0]
 
-        def one(eta, lam, gam, mcw, mig):
+        def fit_one(binned, m0, n_fits, eta, lam, gam, mcw, mig):
             trees, _ = TR.fit_boosted(
                 binned, yj, rm,
                 num_rounds=int(m0["num_round"]),
@@ -411,20 +435,15 @@ class XGBoostClassifier(_TreeEstimator):
                 eta=eta, reg_lambda=lam, gamma=gam,
                 min_child_weight=mcw, min_info_gain=mig,
                 objective="binary:logistic",
-                parallel_fits=len(merged),
+                parallel_fits=n_fits,
             )
             return trees
 
-        trees = jax.vmap(one)(
-            stack("eta"), stack("reg_lambda"), stack("gamma"),
-            stack("min_child_weight"), stack("min_info_gain"),
+        return self._vmapped_group_fit(
+            x, group_points, _BOOST_KNOBS, fit_one,
+            lambda th, tr, m: BoostedBinaryModel(th, tr, float(m["eta"]), 0.0),
+            normalize=self._normalize_boost,
         )
-        return [
-            BoostedBinaryModel(
-                thresholds, self._tree_slice(trees, i), float(m["eta"]), 0.0
-            )
-            for i, m in enumerate(merged)
-        ]
 
 
 class XGBoostRegressor(_TreeEstimator):
@@ -455,21 +474,11 @@ class XGBoostRegressor(_TreeEstimator):
     _normalize_boost = XGBoostClassifier._normalize_boost
 
     def _fit_group_batched(self, x, y, row_mask, group_points):
-        base_est = self.with_params(**group_points[0])
-        thresholds, binned = base_est._binned(x)
-        merged = [
-            self._normalize_boost({**self.get_params(), **p})
-            for p in group_points
-        ]
-        stack = lambda k: jnp.asarray(  # noqa: E731
-            [float(m[k]) for m in merged], dtype=jnp.float32
-        )
         base_score = float(np.mean(y[row_mask > 0])) if (row_mask > 0).any() else 0.0
         yj = jnp.asarray(y, dtype=jnp.float32)
         rm = jnp.asarray(row_mask, dtype=jnp.float32)
-        m0 = merged[0]
 
-        def one(eta, lam, gam, mcw, mig):
+        def fit_one(binned, m0, n_fits, eta, lam, gam, mcw, mig):
             trees, _ = TR.fit_boosted(
                 binned, yj, rm,
                 num_rounds=int(m0["num_round"]),
@@ -479,20 +488,17 @@ class XGBoostRegressor(_TreeEstimator):
                 min_child_weight=mcw, min_info_gain=mig,
                 base_score=base_score,
                 objective="reg:squarederror",
-                parallel_fits=len(merged),
+                parallel_fits=n_fits,
             )
             return trees
 
-        trees = jax.vmap(one)(
-            stack("eta"), stack("reg_lambda"), stack("gamma"),
-            stack("min_child_weight"), stack("min_info_gain"),
+        return self._vmapped_group_fit(
+            x, group_points, _BOOST_KNOBS, fit_one,
+            lambda th, tr, m: BoostedRegressionModel(
+                th, tr, float(m["eta"]), base_score
+            ),
+            normalize=self._normalize_boost,
         )
-        return [
-            BoostedRegressionModel(
-                thresholds, self._tree_slice(trees, i), float(m["eta"]), base_score
-            )
-            for i, m in enumerate(merged)
-        ]
 
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned = self._binned(x)
@@ -654,11 +660,16 @@ class RandomForestClassifier(_TreeEstimator):
 
     _STATIC_GRID_KEYS = ("num_trees", "max_depth", "max_bins", "seed")
 
+    @staticmethod
+    def _colsample(num_features: int) -> float:
+        """Spark featureSubsetStrategy 'auto' = sqrt for classification."""
+        return 1.0 / np.sqrt(max(num_features, 1))
+
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned = self._binned(x)
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
-        colsample = 1.0 / np.sqrt(max(x.shape[1], 1))  # 'auto' = sqrt
+        colsample = self._colsample(x.shape[1])
         rm = jnp.asarray(row_mask, dtype=jnp.float32)
         kwargs = dict(
             num_trees=int(self.num_trees),
@@ -686,37 +697,26 @@ class RandomForestClassifier(_TreeEstimator):
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         if num_classes != 2:
             return None
-        base = self.with_params(**group_points[0])
-        thresholds, binned = base._binned(x)
-        merged = [{**self.get_params(), **p} for p in group_points]
-        colsample = 1.0 / np.sqrt(max(x.shape[1], 1))
-        stack = lambda k: jnp.asarray(  # noqa: E731
-            [float(m[k]) for m in merged], dtype=jnp.float32
-        )
+        colsample = self._colsample(x.shape[1])
         yj = jnp.asarray((y == 1).astype(np.float32))
         rm = jnp.asarray(row_mask, dtype=jnp.float32)
 
-        def one(sub, mi, mig):
+        def fit_one(binned, m0, n_fits, sub, mi, mig):
             return TR.fit_forest(
                 binned, yj, rm,
-                num_trees=int(base.num_trees),
-                max_depth=int(base.max_depth),
-                num_bins=int(base.max_bins),
+                num_trees=int(m0["num_trees"]),
+                max_depth=int(m0["max_depth"]),
+                num_bins=int(m0["max_bins"]),
                 subsample_rate=sub, colsample_rate=float(colsample),
                 min_instances=mi, min_info_gain=mig,
-                seed=int(base.seed),
-                parallel_fits=len(merged),
+                seed=int(m0["seed"]),
+                parallel_fits=n_fits,
             )
 
-        forests = jax.vmap(one)(
-            stack("subsampling_rate"),
-            stack("min_instances_per_node"),
-            stack("min_info_gain"),
+        return self._vmapped_group_fit(
+            x, group_points, _FOREST_KNOBS, fit_one,
+            lambda th, tr, m: ForestClassifierModel(th, [tr]),
         )
-        return [
-            ForestClassifierModel(thresholds, [self._tree_slice(forests, i)])
-            for i in range(len(merged))
-        ]
 
 
 class RandomForestRegressor(_TreeEstimator):
@@ -743,9 +743,14 @@ class RandomForestRegressor(_TreeEstimator):
     get_params = RandomForestClassifier.get_params
     _STATIC_GRID_KEYS = ("num_trees", "max_depth", "max_bins", "seed")
 
+    @staticmethod
+    def _colsample(num_features: int) -> float:
+        """Spark featureSubsetStrategy 'auto' = onethird for regression."""
+        return 1.0 / 3.0
+
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned = self._binned(x)
-        colsample = 1.0 / 3.0  # Spark 'auto' = onethird for regression
+        colsample = self._colsample(x.shape[1])
         trees = TR.fit_forest(
             binned,
             jnp.asarray(y, dtype=jnp.float32),
@@ -762,36 +767,26 @@ class RandomForestRegressor(_TreeEstimator):
         return ForestRegressionModel(thresholds, trees)
 
     def _fit_group_batched(self, x, y, row_mask, group_points):
-        base = self.with_params(**group_points[0])
-        thresholds, binned = base._binned(x)
-        merged = [{**self.get_params(), **p} for p in group_points]
-        stack = lambda k: jnp.asarray(  # noqa: E731
-            [float(m[k]) for m in merged], dtype=jnp.float32
-        )
+        colsample = self._colsample(x.shape[1])
         yj = jnp.asarray(y, dtype=jnp.float32)
         rm = jnp.asarray(row_mask, dtype=jnp.float32)
 
-        def one(sub, mi, mig):
+        def fit_one(binned, m0, n_fits, sub, mi, mig):
             return TR.fit_forest(
                 binned, yj, rm,
-                num_trees=int(base.num_trees),
-                max_depth=int(base.max_depth),
-                num_bins=int(base.max_bins),
-                subsample_rate=sub, colsample_rate=1.0 / 3.0,
+                num_trees=int(m0["num_trees"]),
+                max_depth=int(m0["max_depth"]),
+                num_bins=int(m0["max_bins"]),
+                subsample_rate=sub, colsample_rate=float(colsample),
                 min_instances=mi, min_info_gain=mig,
-                seed=int(base.seed),
-                parallel_fits=len(merged),
+                seed=int(m0["seed"]),
+                parallel_fits=n_fits,
             )
 
-        forests = jax.vmap(one)(
-            stack("subsampling_rate"),
-            stack("min_instances_per_node"),
-            stack("min_info_gain"),
+        return self._vmapped_group_fit(
+            x, group_points, _FOREST_KNOBS, fit_one,
+            lambda th, tr, m: ForestRegressionModel(th, tr),
         )
-        return [
-            ForestRegressionModel(thresholds, self._tree_slice(forests, i))
-            for i in range(len(merged))
-        ]
 
 
 class DecisionTreeClassifier(RandomForestClassifier):

@@ -36,6 +36,7 @@ from .reductions import (  # noqa: F401
     pxtx,
 )
 from .fit import data_parallel_fit, grid_parallel_fit  # noqa: F401
+from .ring import pad_cols, ring_corr, ring_gram, shard_cols  # noqa: F401
 from .segments import (  # noqa: F401
     aggregate_events_on_device,
     factorize_keys,

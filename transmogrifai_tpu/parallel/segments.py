@@ -91,6 +91,20 @@ def psegment_reduce(
         values = np.ones_like(values, dtype=np.float32)
     if op == "or":
         values = (values != 0).astype(np.float32)
+    if op == "mean":
+        # one kernel dispatch: sums land in segments [0, S), counts in
+        # [S, 2S) by offsetting a ones copy's segment ids
+        s = int(num_segments)
+        both = psegment_reduce(
+            np.concatenate([values, np.ones_like(values)]),
+            np.concatenate([seg_ids, seg_ids + s]),
+            2 * s,
+            mesh,
+            op="sum",
+        )
+        sums, counts = both[:s], both[s:]
+        with np.errstate(invalid="ignore"):
+            return np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
 
     n = len(values)
     shards = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
@@ -103,16 +117,17 @@ def psegment_reduce(
         # neutral is 0 (no effect); for max/min the neutral is ∓inf
         seg_ids = np.concatenate([seg_ids, np.zeros(pad, dtype=np.int32)])
 
-    kernel = _segment_kernels(mesh, num_segments, "sum" if op in ("mean", "count", "or") else op)
+    # compile-cache discipline: num_segments is data-dependent (the unique
+    # key count), so pad it to the next power of two — the jitted kernel set
+    # stays O(log max-segments) instead of one program per distinct count
+    padded_segments = 1 << max(int(num_segments) - 1, 0).bit_length()
+    kernel = _segment_kernels(
+        mesh, padded_segments, "sum" if op in ("count", "or") else op
+    )
     out = np.asarray(kernel(jnp.asarray(values), jnp.asarray(seg_ids)))
+    out = out[:num_segments]
 
-    if op == "mean":
-        counts = psegment_reduce(
-            np.ones(n, dtype=np.float32), seg_ids[:n], num_segments, mesh, "sum"
-        )
-        with np.errstate(invalid="ignore"):
-            out = np.where(counts > 0, out / np.maximum(counts, 1), np.nan)
-    elif op == "or":
+    if op == "or":
         out = (out > 0).astype(np.float32)
     return out
 

@@ -104,8 +104,9 @@ def detect_sensitive_features(
                 if toks and any(t in name_set for t in toks):
                     counts["Name"] += 1
         n = len(values)
-        for kind, c in counts.items():
-            if c / n >= threshold:
-                out.append(SensitiveFeatureInformation(f.name, kind, c / n))
-                break
+        # report the DOMINANT kind crossing the threshold, not the first in
+        # dict order — a 60%-email / 30%-name column is an Email column
+        kind, c = max(counts.items(), key=lambda kv: kv[1])
+        if c / n >= threshold:
+            out.append(SensitiveFeatureInformation(f.name, kind, c / n))
     return out

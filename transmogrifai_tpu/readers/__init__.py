@@ -11,3 +11,13 @@ from .aggregate import (  # noqa: F401
 )
 from .joins import JoinedReader, JoinKeys, JoinType, join_datasets  # noqa: F401
 from .streaming import StreamingReader  # noqa: F401
+from .parquet import (  # noqa: F401
+    AvroReader,
+    ParquetReader,
+    dataset_from_arrow,
+    infer_avro_dataset,
+    infer_parquet_dataset,
+    read_parquet,
+    write_parquet,
+)
+from .catalog import DataReaders  # noqa: F401

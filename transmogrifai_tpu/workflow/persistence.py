@@ -125,6 +125,7 @@ def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F82
         "holdoutRows": model.holdout_rows,
         "rffResults": model.rff_results,
         "blocklisted": model.blocklisted,
+        "sensitiveFeatures": model.sensitive_info,
     }
     with open(os.path.join(path, "manifest.json"), "w") as fh:
         json.dump(manifest, fh, indent=2, default=_json_default)
@@ -200,4 +201,5 @@ def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
         holdout_rows=manifest.get("holdoutRows", 0),
         rff_results=manifest.get("rffResults"),
         blocklisted=manifest.get("blocklisted", []),
+        sensitive_info=manifest.get("sensitiveFeatures"),
     )
