@@ -92,6 +92,9 @@ def test_parquet_round_trip_preserves_feature_types(tmp_path):
     assert back["x"].to_list() == [1.5, None, 3.25]
     assert back["m"].to_list()[0] == {"u": 1.0}
     assert back["tags"].to_list()[0] == ["a", "b"]
+    # empty containers survive as empty, not missing
+    assert back["m"].to_list()[1] == {}
+    assert back["tags"].to_list()[1] == []
 
 
 def test_parquet_reader_feeds_workflow(tmp_path):

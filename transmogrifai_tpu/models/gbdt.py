@@ -48,20 +48,19 @@ def _class_trees_from_arrays(arrays: dict) -> list[TR.Tree]:
 
 
 class _BinnedModel(PredictorModel):
-    """Shared predict plumbing: bin with stored thresholds, run trees."""
+    """Shared state for binned-tree models; prediction goes through the
+    fused jitted entry points (trees.predict_*_raw) which bin internally —
+    one dispatch per scoring call."""
 
     def __init__(self, operation_name: str, thresholds: np.ndarray, uid=None):
         super().__init__(operation_name, uid=uid)
         self.thresholds = np.asarray(thresholds, dtype=np.float32)
 
-    def _bin(self, x: np.ndarray) -> jax.Array:
-        return TR.bin_data(jnp.asarray(x, dtype=jnp.float32), jnp.asarray(self.thresholds))
-
 
 class BoostedBinaryModel(_BinnedModel):
     def __init__(self, thresholds, trees: TR.Tree, eta: float, base_score: float, uid=None):
         super().__init__("xgbClassifier", thresholds, uid=uid)
-        self.trees = jax.tree.map(np.asarray, trees)
+        self.trees = jax.tree.map(jnp.asarray, trees)
         self.eta = eta
         self.base_score = base_score
 
@@ -85,9 +84,10 @@ class BoostedBinaryModel(_BinnedModel):
 
     def predict_arrays(self, x):
         margin = np.asarray(
-            TR.predict_boosted(
-                self._bin(x), jax.tree.map(jnp.asarray, self.trees),
-                self.eta, self.base_score,
+            TR.predict_boosted_raw(
+                jnp.asarray(x, dtype=jnp.float32),
+                jnp.asarray(self.thresholds), self.trees,
+                jnp.float32(self.eta), jnp.float32(self.base_score),
             ),
             dtype=np.float64,
         )
@@ -102,7 +102,7 @@ class BoostedMultiModel(_BinnedModel):
 
     def __init__(self, thresholds, trees_per_class: list[TR.Tree], eta, base_score, uid=None):
         super().__init__("xgbClassifier", thresholds, uid=uid)
-        self.trees_per_class = [jax.tree.map(np.asarray, t) for t in trees_per_class]
+        self.trees_per_class = [jax.tree.map(jnp.asarray, t) for t in trees_per_class]
         self.eta = eta
         self.base_score = base_score
 
@@ -125,14 +125,13 @@ class BoostedMultiModel(_BinnedModel):
         )
 
     def predict_arrays(self, x):
-        binned = self._bin(x)
+        xj = jnp.asarray(x, dtype=jnp.float32)
+        thr = jnp.asarray(self.thresholds)
+        eta = jnp.float32(self.eta)
+        base = jnp.float32(self.base_score)
         margins = np.stack(
             [
-                np.asarray(
-                    TR.predict_boosted(
-                        binned, jax.tree.map(jnp.asarray, t), self.eta, self.base_score
-                    )
-                )
+                np.asarray(TR.predict_boosted_raw(xj, thr, t, eta, base))
                 for t in self.trees_per_class
             ],
             axis=1,
@@ -145,7 +144,7 @@ class BoostedMultiModel(_BinnedModel):
 class BoostedRegressionModel(_BinnedModel):
     def __init__(self, thresholds, trees, eta, base_score, uid=None):
         super().__init__("xgbRegressor", thresholds, uid=uid)
-        self.trees = jax.tree.map(np.asarray, trees)
+        self.trees = jax.tree.map(jnp.asarray, trees)
         self.eta = eta
         self.base_score = base_score
 
@@ -169,9 +168,10 @@ class BoostedRegressionModel(_BinnedModel):
 
     def predict_arrays(self, x):
         pred = np.asarray(
-            TR.predict_boosted(
-                self._bin(x), jax.tree.map(jnp.asarray, self.trees),
-                self.eta, self.base_score,
+            TR.predict_boosted_raw(
+                jnp.asarray(x, dtype=jnp.float32),
+                jnp.asarray(self.thresholds), self.trees,
+                jnp.float32(self.eta), jnp.float32(self.base_score),
             ),
             dtype=np.float64,
         )
@@ -183,7 +183,7 @@ class ForestClassifierModel(_BinnedModel):
 
     def __init__(self, thresholds, forests_per_class: list[TR.Tree], uid=None):
         super().__init__("rfClassifier", thresholds, uid=uid)
-        self.forests_per_class = [jax.tree.map(np.asarray, t) for t in forests_per_class]
+        self.forests_per_class = [jax.tree.map(jnp.asarray, t) for t in forests_per_class]
 
     def get_arrays(self):
         out = {"thresholds": self.thresholds}
@@ -198,10 +198,11 @@ class ForestClassifierModel(_BinnedModel):
         return cls(arrays["thresholds"], _class_trees_from_arrays(arrays))
 
     def predict_arrays(self, x):
-        binned = self._bin(x)
+        xj = jnp.asarray(x, dtype=jnp.float32)
+        thr = jnp.asarray(self.thresholds)
         probs = np.stack(
             [
-                np.asarray(TR.predict_forest(binned, jax.tree.map(jnp.asarray, t)))
+                np.asarray(TR.predict_forest_raw(xj, thr, t))
                 for t in self.forests_per_class
             ],
             axis=1,
@@ -217,7 +218,7 @@ class ForestClassifierModel(_BinnedModel):
 class ForestRegressionModel(_BinnedModel):
     def __init__(self, thresholds, trees, uid=None):
         super().__init__("rfRegressor", thresholds, uid=uid)
-        self.trees = jax.tree.map(np.asarray, trees)
+        self.trees = jax.tree.map(jnp.asarray, trees)
 
     @classmethod
     def from_params(cls, params, arrays):
@@ -233,7 +234,10 @@ class ForestRegressionModel(_BinnedModel):
 
     def predict_arrays(self, x):
         pred = np.asarray(
-            TR.predict_forest(self._bin(x), jax.tree.map(jnp.asarray, self.trees)),
+            TR.predict_forest_raw(
+                jnp.asarray(x, dtype=jnp.float32),
+                jnp.asarray(self.thresholds), self.trees,
+            ),
             dtype=np.float64,
         )
         return pred, None, None
@@ -258,93 +262,101 @@ class _TreeEstimator(PredictorEstimator):
             jnp.asarray(x, dtype=jnp.float32), jnp.asarray(thresholds)
         )
 
-    def _fit_group_batched(self, x, y, row_mask, group_points):
-        """Fit same-static-shape grid points in ONE vmapped program; None →
-        caller falls back to sequential fits. Overridden per family."""
+    def _fit_group_masks(self, x, y, masks, group_points):
+        """Fit len(masks) × len(group_points) same-static-shape models in
+        ONE batched program (fit axis = histogram-kernel grid axis, see
+        trees.grow_tree_batched); None → caller falls back to sequential
+        fits. Overridden per family. ``masks`` is [M, N] float32."""
         return None
 
     def fit_arrays_batched(self, x, y, row_mask, points):
-        """Validator hook (validators.py:102): the model×grid sweep batches
-        points that share static shapes — the TPU replacement for the
-        reference's driver thread pool (OpValidator.scala:363-367). Cuts a
-        3-depth × 6-point tree grid from 18 dispatches to 3.
+        """One mask, many grid points (back-compat validator hook)."""
+        return self.fit_arrays_batched_masks(x, y, [row_mask], points)[0]
 
-        Disabled on the axon TPU runtime: vmapping whole forest/boost fits
-        crashes its worker with a kernel fault (observed with both the
-        pallas and scatter histogram impls); the sweep runs sequentially
-        there until the runtime is fixed. Override with
-        TPTPU_BATCHED_FITS=1."""
+    def fit_arrays_batched_masks(self, x, y, masks, points):
+        """Validator hook: the folds × grid sweep batches points that share
+        static shapes into one compiled program per group — the TPU
+        replacement for the reference's driver thread pool
+        (OpValidator.scala:363-367). A 3-fold × 18-point RF grid becomes 3
+        programs (one per max_depth) instead of 54 dispatches.
+
+        Set TPTPU_BATCHED_FITS=0 to force sequential fits."""
         import os
 
+        masks = [np.asarray(m, dtype=np.float32) for m in masks]
         if (
-            jax.default_backend() == "tpu"
-            and not os.environ.get("TPTPU_BATCHED_FITS")
+            os.environ.get("TPTPU_BATCHED_FITS") == "0"
+            or not self._STATIC_GRID_KEYS
         ):
             return [
-                self.with_params(**p).fit_arrays(x, y, row_mask) for p in points
-            ]
-        if not self._STATIC_GRID_KEYS:
-            return [
-                self.with_params(**p).fit_arrays(x, y, row_mask) for p in points
+                [self.with_params(**p).fit_arrays(x, y, m) for p in points]
+                for m in masks
             ]
         groups: dict[tuple, list[int]] = {}
         for i, p in enumerate(points):
             merged = {**self.get_params(), **p}
             key = tuple(merged.get(k) for k in self._STATIC_GRID_KEYS)
             groups.setdefault(key, []).append(i)
-        models: list = [None] * len(points)
+        models: list[list] = [[None] * len(points) for _ in masks]
+        mask_arr = np.stack(masks)
         for idxs in groups.values():
-            fitted = None
-            if len(idxs) > 1:
-                fitted = self._fit_group_batched(
-                    x, y, row_mask, [points[i] for i in idxs]
-                )
+            fitted = self._fit_group_masks(
+                x, y, mask_arr, [points[i] for i in idxs]
+            )
             if fitted is None:
                 fitted = [
-                    self.with_params(**points[i]).fit_arrays(x, y, row_mask)
-                    for i in idxs
+                    [
+                        self.with_params(**points[i]).fit_arrays(x, y, m)
+                        for i in idxs
+                    ]
+                    for m in masks
                 ]
-            for i, m in zip(idxs, fitted):
-                models[i] = m
+            for mi in range(len(masks)):
+                for j, i in enumerate(idxs):
+                    models[mi][i] = fitted[mi][j]
         return models
 
     @staticmethod
     def _tree_slice(stacked_trees, i):
         return jax.tree.map(lambda a: a[i], stacked_trees)
 
-    def _vmapped_group_fit(
-        self, x, group_points, stacked_keys, fit_one, make_model, normalize=None
+    def _batched_group_fit(
+        self, x, masks, group_points, run_batched, make_model, normalize=None
     ):
-        """Shared plumbing for the vmapped same-static-shape grid fit: bin
-        once, merge (+ normalize) each point's params, stack the float knobs,
-        vmap ``fit_one`` over them, slice the stacked tree pytree back into
-        one model per point.
+        """Shared plumbing for the masks × points batched fit: bin once,
+        merge (+ normalize) params, stack the float knobs mask-major
+        (fit k = mask_index * n_points + point_index), run the family's
+        batched trainer, slice the [K, ...] tree pytree back out.
 
-        ``fit_one(binned, m0, n_fits, *knobs) -> tree pytree``;
-        ``make_model(thresholds, sliced_trees, merged_params) -> model``.
+        ``run_batched(binned, m0, row_mask_K, knob) -> [K, ...] tree pytree``
+        where ``knob(name)`` returns the [K] float32 array for a param;
+        ``make_model(thresholds, sliced_trees, merged_params, mask_index)``.
         """
         base = self.with_params(**group_points[0])
         thresholds, binned = base._binned(x)
         norm = normalize or (lambda m: m)
         merged = [norm({**self.get_params(), **p}) for p in group_points]
-        knobs = [
-            jnp.asarray([float(m[k]) for m in merged], dtype=jnp.float32)
-            for k in stacked_keys
-        ]
-        m0 = merged[0]
-        trees = jax.vmap(lambda *vals: fit_one(binned, m0, len(merged), *vals))(
-            *knobs
-        )
+        n_masks, n_pts = masks.shape[0], len(merged)
+        row_mask_k = jnp.asarray(np.repeat(masks, n_pts, axis=0))
+
+        def knob(name):
+            return jnp.asarray(
+                [float(m[name]) for m in merged] * n_masks, dtype=jnp.float32
+            )
+
+        trees = run_batched(binned, merged[0], row_mask_k, knob)
         return [
-            make_model(thresholds, self._tree_slice(trees, i), m)
-            for i, m in enumerate(merged)
+            [
+                make_model(
+                    thresholds,
+                    self._tree_slice(trees, mi * n_pts + j),
+                    merged[j],
+                    mi,
+                )
+                for j in range(n_pts)
+            ]
+            for mi in range(n_masks)
         ]
-
-
-#: the non-shape-affecting boosting knobs batched by the vmapped grid fit
-_BOOST_KNOBS = ("eta", "reg_lambda", "gamma", "min_child_weight", "min_info_gain")
-#: same for forests
-_FOREST_KNOBS = ("subsampling_rate", "min_instances_per_node", "min_info_gain")
 
 
 class XGBoostClassifier(_TreeEstimator):
@@ -418,30 +430,30 @@ class XGBoostClassifier(_TreeEstimator):
         Spark names: maxIter/stepSize/minInstancesPerNode)."""
         return merged
 
-    def _fit_group_batched(self, x, y, row_mask, group_points):
-        present = y[row_mask > 0]
+    def _fit_group_masks(self, x, y, masks, group_points):
+        present = y[masks.max(axis=0) > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         if num_classes != 2:
             return None  # one-vs-rest loops stay sequential
         yj = jnp.asarray(y, dtype=jnp.float32)
-        rm = jnp.asarray(row_mask, dtype=jnp.float32)
 
-        def fit_one(binned, m0, n_fits, eta, lam, gam, mcw, mig):
-            trees, _ = TR.fit_boosted(
-                binned, yj, rm,
+        def run_batched(binned, m0, row_mask_k, knob):
+            trees, _ = TR.fit_boosted_batched(
+                binned, yj, row_mask_k,
                 num_rounds=int(m0["num_round"]),
                 max_depth=int(m0["max_depth"]),
                 num_bins=int(m0["max_bins"]),
-                eta=eta, reg_lambda=lam, gamma=gam,
-                min_child_weight=mcw, min_info_gain=mig,
+                eta=knob("eta"), reg_lambda=knob("reg_lambda"),
+                gamma=knob("gamma"),
+                min_child_weight=knob("min_child_weight"),
+                min_info_gain=knob("min_info_gain"),
                 objective="binary:logistic",
-                parallel_fits=n_fits,
             )
             return trees
 
-        return self._vmapped_group_fit(
-            x, group_points, _BOOST_KNOBS, fit_one,
-            lambda th, tr, m: BoostedBinaryModel(th, tr, float(m["eta"]), 0.0),
+        return self._batched_group_fit(
+            x, masks, group_points, run_batched,
+            lambda th, tr, m, mi: BoostedBinaryModel(th, tr, float(m["eta"]), 0.0),
             normalize=self._normalize_boost,
         )
 
@@ -473,29 +485,36 @@ class XGBoostRegressor(_TreeEstimator):
     _STATIC_GRID_KEYS = ("num_round", "max_depth", "max_bins")
     _normalize_boost = XGBoostClassifier._normalize_boost
 
-    def _fit_group_batched(self, x, y, row_mask, group_points):
-        base_score = float(np.mean(y[row_mask > 0])) if (row_mask > 0).any() else 0.0
+    def _fit_group_masks(self, x, y, masks, group_points):
         yj = jnp.asarray(y, dtype=jnp.float32)
-        rm = jnp.asarray(row_mask, dtype=jnp.float32)
+        # per-mask base score = mean target over that mask's rows
+        sums = masks @ y.astype(np.float64)
+        cnts = masks.sum(axis=1)
+        base_scores = np.where(cnts > 0, sums / np.maximum(cnts, 1), 0.0)
+        n_pts = len(group_points)
 
-        def fit_one(binned, m0, n_fits, eta, lam, gam, mcw, mig):
-            trees, _ = TR.fit_boosted(
-                binned, yj, rm,
+        def run_batched(binned, m0, row_mask_k, knob):
+            base_k = jnp.asarray(
+                np.repeat(base_scores, n_pts), dtype=jnp.float32
+            )
+            trees, _ = TR.fit_boosted_batched(
+                binned, yj, row_mask_k,
                 num_rounds=int(m0["num_round"]),
                 max_depth=int(m0["max_depth"]),
                 num_bins=int(m0["max_bins"]),
-                eta=eta, reg_lambda=lam, gamma=gam,
-                min_child_weight=mcw, min_info_gain=mig,
-                base_score=base_score,
+                eta=knob("eta"), reg_lambda=knob("reg_lambda"),
+                gamma=knob("gamma"),
+                min_child_weight=knob("min_child_weight"),
+                min_info_gain=knob("min_info_gain"),
+                base_score=base_k,
                 objective="reg:squarederror",
-                parallel_fits=n_fits,
             )
             return trees
 
-        return self._vmapped_group_fit(
-            x, group_points, _BOOST_KNOBS, fit_one,
-            lambda th, tr, m: BoostedRegressionModel(
-                th, tr, float(m["eta"]), base_score
+        return self._batched_group_fit(
+            x, masks, group_points, run_batched,
+            lambda th, tr, m, mi: BoostedRegressionModel(
+                th, tr, float(m["eta"]), float(base_scores[mi])
             ),
             normalize=self._normalize_boost,
         )
@@ -692,30 +711,30 @@ class RandomForestClassifier(_TreeEstimator):
             ]
         return ForestClassifierModel(thresholds, forests)
 
-    def _fit_group_batched(self, x, y, row_mask, group_points):
-        present = y[row_mask > 0]
+    def _fit_group_masks(self, x, y, masks, group_points):
+        present = y[masks.max(axis=0) > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         if num_classes != 2:
             return None
         colsample = self._colsample(x.shape[1])
         yj = jnp.asarray((y == 1).astype(np.float32))
-        rm = jnp.asarray(row_mask, dtype=jnp.float32)
 
-        def fit_one(binned, m0, n_fits, sub, mi, mig):
-            return TR.fit_forest(
-                binned, yj, rm,
+        def run_batched(binned, m0, row_mask_k, knob):
+            return TR.fit_forest_batched(
+                binned, yj, row_mask_k,
                 num_trees=int(m0["num_trees"]),
                 max_depth=int(m0["max_depth"]),
                 num_bins=int(m0["max_bins"]),
-                subsample_rate=sub, colsample_rate=float(colsample),
-                min_instances=mi, min_info_gain=mig,
+                subsample_rate=knob("subsampling_rate"),
+                colsample_rate=float(colsample),
+                min_instances=knob("min_instances_per_node"),
+                min_info_gain=knob("min_info_gain"),
                 seed=int(m0["seed"]),
-                parallel_fits=n_fits,
             )
 
-        return self._vmapped_group_fit(
-            x, group_points, _FOREST_KNOBS, fit_one,
-            lambda th, tr, m: ForestClassifierModel(th, [tr]),
+        return self._batched_group_fit(
+            x, masks, group_points, run_batched,
+            lambda th, tr, m, mi: ForestClassifierModel(th, [tr]),
         )
 
 
@@ -766,26 +785,26 @@ class RandomForestRegressor(_TreeEstimator):
         )
         return ForestRegressionModel(thresholds, trees)
 
-    def _fit_group_batched(self, x, y, row_mask, group_points):
+    def _fit_group_masks(self, x, y, masks, group_points):
         colsample = self._colsample(x.shape[1])
         yj = jnp.asarray(y, dtype=jnp.float32)
-        rm = jnp.asarray(row_mask, dtype=jnp.float32)
 
-        def fit_one(binned, m0, n_fits, sub, mi, mig):
-            return TR.fit_forest(
-                binned, yj, rm,
+        def run_batched(binned, m0, row_mask_k, knob):
+            return TR.fit_forest_batched(
+                binned, yj, row_mask_k,
                 num_trees=int(m0["num_trees"]),
                 max_depth=int(m0["max_depth"]),
                 num_bins=int(m0["max_bins"]),
-                subsample_rate=sub, colsample_rate=float(colsample),
-                min_instances=mi, min_info_gain=mig,
+                subsample_rate=knob("subsampling_rate"),
+                colsample_rate=float(colsample),
+                min_instances=knob("min_instances_per_node"),
+                min_info_gain=knob("min_info_gain"),
                 seed=int(m0["seed"]),
-                parallel_fits=n_fits,
             )
 
-        return self._vmapped_group_fit(
-            x, group_points, _FOREST_KNOBS, fit_one,
-            lambda th, tr, m: ForestRegressionModel(th, tr),
+        return self._batched_group_fit(
+            x, masks, group_points, run_batched,
+            lambda th, tr, m, mi: ForestRegressionModel(th, tr),
         )
 
 
@@ -794,7 +813,7 @@ class DecisionTreeClassifier(RandomForestClassifier):
 
     model_type = "OpDecisionTreeClassifier"
 
-    def _fit_group_batched(self, x, y, row_mask, group_points):
+    def _fit_group_masks(self, x, y, masks, group_points):
         # RF's batched fit bootstraps + column-samples; a decision tree is
         # deterministic and full-feature — never inherit that path
         return None
@@ -830,7 +849,7 @@ class DecisionTreeClassifier(RandomForestClassifier):
 class DecisionTreeRegressor(RandomForestRegressor):
     model_type = "OpDecisionTreeRegressor"
 
-    def _fit_group_batched(self, x, y, row_mask, group_points):
+    def _fit_group_masks(self, x, y, masks, group_points):
         return None  # see DecisionTreeClassifier — no RF randomization
 
     def __init__(self, max_depth: int = 5, min_instances_per_node: int = 1,

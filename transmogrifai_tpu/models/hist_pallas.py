@@ -34,17 +34,21 @@ FEAT_TILE = 8  # features per program (TPU sublane granule)
 
 def _hist_kernel(binned_ref, node_ref, g_ref, h_ref, outg_ref, outh_ref,
                  *, m_pad, b_pad):
-    """One (feature-tile, row-tile) step: accumulate grad/hess histograms
-    [FEAT_TILE, M, B] (separate outputs — a trailing dim of 2 would be
-    tile-padded to 128 and blow VMEM)."""
+    """One (fit, feature-tile, row-tile) step: accumulate grad/hess
+    histograms [FEAT_TILE, M, B] for one batched fit (separate outputs — a
+    trailing dim of 2 would be tile-padded to 128 and blow VMEM).
+
+    The batch (fit) axis is a GRID dimension, not a vmap: Mosaic custom
+    calls crash this TPU runtime under vmap, and a grid axis reuses the same
+    VMEM working set per step anyway."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
 
-    j = pl.program_id(1)
+    j = pl.program_id(2)
 
-    nodes = node_ref[0, :]    # [T] int32 (-1 = padded/dead row)
-    g = g_ref[0, :]           # [T] f32
-    h = h_ref[0, :]           # [T] f32
+    nodes = node_ref[0, 0, :]    # [T] int32 (-1 = padded/dead row)
+    g = g_ref[0, 0, :]           # [T] f32
+    h = h_ref[0, 0, :]           # [T] f32
     t = nodes.shape[0]
 
     iota_m = lax.broadcasted_iota(jnp.int32, (t, m_pad), 1)
@@ -72,18 +76,103 @@ def _hist_kernel(binned_ref, node_ref, g_ref, h_ref, outg_ref, outh_ref,
 
         @pl.when(j == 0)
         def _(k=k, hg=hg, hh=hh):
-            outg_ref[k, :, :] = hg
-            outh_ref[k, :, :] = hh
+            outg_ref[0, k, :, :] = hg
+            outh_ref[0, k, :, :] = hh
 
         @pl.when(j > 0)
         def _(k=k, hg=hg, hh=hh):
-            outg_ref[k, :, :] = outg_ref[k, :, :] + hg
-            outh_ref[k, :, :] = outh_ref[k, :, :] + hh
+            outg_ref[0, k, :, :] = outg_ref[0, k, :, :] + hg
+            outh_ref[0, k, :, :] = outh_ref[0, k, :, :] + hh
 
 
 @functools.partial(
     jax.jit, static_argnames=("num_nodes", "num_bins", "row_tile", "interpret")
 )
+def build_histogram_pallas_batched(
+    binned: jax.Array,   # [N, F] int32 codes in [0, num_bins), SHARED
+    node: jax.Array,     # [K, N] int32 node slot per row per fit (-1 = dead)
+    grad: jax.Array,     # [K, N] f32 (pre-masked)
+    hess: jax.Array,     # [K, N] f32
+    num_nodes: int,
+    num_bins: int,
+    row_tile: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """hist [K, num_nodes, F, num_bins, 2] via the MXU one-hot formulation.
+
+    K batched fits (grid points × CV folds) share one binned matrix; the fit
+    axis rides the kernel grid, so the whole hyperparameter sweep's
+    histograms build in one custom call."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k_fits, n = node.shape
+    f = binned.shape[1]
+    m_pad = _round_up(max(num_nodes, 8), 8)
+    b_pad = _round_up(num_bins, 128)
+    if row_tile is None:
+        # the kernel's big VMEM temporaries are the [T, M] node one-hot and
+        # its two value-weighted copies — shrink the row tile as the node
+        # axis grows so T·M stays bounded (~256k elems ≈ 1 MB f32 each);
+        # lane-align to 128 (Mosaic trailing-block constraint)
+        row_tile = max(128, min(2048, ((1 << 18) // m_pad) // 128 * 128))
+    n_pad = _round_up(max(n, row_tile), row_tile)
+    f_pad = _round_up(f, FEAT_TILE)
+
+    binned_t = jnp.zeros((f_pad, n_pad), dtype=jnp.int32)
+    binned_t = binned_t.at[:f, :n].set(binned.T)
+    # per-fit row vectors get a singleton sublane axis [K, 1, n_pad] so the
+    # (1, row_tile) trailing block dims satisfy Mosaic's tiling constraint
+    node_p = jnp.full((k_fits, 1, n_pad), -1, dtype=jnp.int32).at[:, 0, :n].set(node)
+    g_p = jnp.zeros((k_fits, 1, n_pad), dtype=jnp.float32).at[:, 0, :n].set(grad)
+    h_p = jnp.zeros((k_fits, 1, n_pad), dtype=jnp.float32).at[:, 0, :n].set(hess)
+
+    num_row_tiles = n_pad // row_tile
+    grid = (k_fits, f_pad // FEAT_TILE, num_row_tiles)
+
+    out_g, out_h = pl.pallas_call(
+        functools.partial(_hist_kernel, m_pad=m_pad, b_pad=b_pad),
+        out_shape=(
+            jax.ShapeDtypeStruct((k_fits, f_pad, m_pad, b_pad), jnp.float32),
+            jax.ShapeDtypeStruct((k_fits, f_pad, m_pad, b_pad), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (FEAT_TILE, row_tile), lambda k, i, j: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, row_tile), lambda k, i, j: (k, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, row_tile), lambda k, i, j: (k, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, row_tile), lambda k, i, j: (k, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (1, FEAT_TILE, m_pad, b_pad), lambda k, i, j: (k, i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, FEAT_TILE, m_pad, b_pad), lambda k, i, j: (k, i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        interpret=interpret,
+    )(binned_t, node_p, g_p, h_p)
+
+    # 2 × [K, F, M, B] -> [K, M, F, B, 2], unpadded
+    out = jnp.stack([out_g, out_h], axis=-1)
+    return jnp.transpose(out[:, :f, :num_nodes, :num_bins, :], (0, 2, 1, 3, 4))
+
+
 def build_histogram_pallas(
     binned: jax.Array,   # [N, F] int32 codes in [0, num_bins)
     node: jax.Array,     # [N] int32 node slot per row (-1 = dead)
@@ -91,66 +180,14 @@ def build_histogram_pallas(
     hess: jax.Array,     # [N] f32
     num_nodes: int,
     num_bins: int,
-    row_tile: int = 2048,
+    row_tile: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """hist [num_nodes, F, num_bins, 2] via the MXU one-hot formulation."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    n, f = binned.shape
-    m_pad = _round_up(max(num_nodes, 8), 8)
-    b_pad = _round_up(num_bins, 128)
-    n_pad = _round_up(max(n, row_tile), row_tile)
-    f_pad = _round_up(f, FEAT_TILE)
-
-    binned_t = jnp.zeros((f_pad, n_pad), dtype=jnp.int32)
-    binned_t = binned_t.at[:f, :n].set(binned.T)
-    node_p = jnp.full((1, n_pad), -1, dtype=jnp.int32).at[0, :n].set(node)
-    g_p = jnp.zeros((1, n_pad), dtype=jnp.float32).at[0, :n].set(grad)
-    h_p = jnp.zeros((1, n_pad), dtype=jnp.float32).at[0, :n].set(hess)
-
-    num_row_tiles = n_pad // row_tile
-    grid = (f_pad // FEAT_TILE, num_row_tiles)
-
-    out_g, out_h = pl.pallas_call(
-        functools.partial(_hist_kernel, m_pad=m_pad, b_pad=b_pad),
-        out_shape=(
-            jax.ShapeDtypeStruct((f_pad, m_pad, b_pad), jnp.float32),
-            jax.ShapeDtypeStruct((f_pad, m_pad, b_pad), jnp.float32),
-        ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (FEAT_TILE, row_tile), lambda i, j: (i, j),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, row_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, row_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, row_tile), lambda i, j: (0, j), memory_space=pltpu.VMEM
-            ),
-        ],
-        out_specs=(
-            pl.BlockSpec(
-                (FEAT_TILE, m_pad, b_pad), lambda i, j: (i, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (FEAT_TILE, m_pad, b_pad), lambda i, j: (i, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ),
-        interpret=interpret,
-    )(binned_t, node_p, g_p, h_p)
-
-    # 2 × [F, M, B] -> [M, F, B, 2], unpadded
-    out = jnp.stack([out_g, out_h], axis=-1)
-    return jnp.transpose(out[:f, :num_nodes, :num_bins, :], (1, 0, 2, 3))
+    """hist [num_nodes, F, num_bins, 2] — the K=1 case of the batched build."""
+    return build_histogram_pallas_batched(
+        binned, node[None, :], grad[None, :], hess[None, :],
+        num_nodes, num_bins, row_tile=row_tile, interpret=interpret,
+    )[0]
 
 
 def build_histogram_scatter(
@@ -180,6 +217,259 @@ def build_histogram_scatter(
         [hg.reshape(num_nodes, f, num_bins), hh.reshape(num_nodes, f, num_bins)],
         axis=-1,
     )
+
+
+SPLIT_FEAT_TILE = 32  # features per split-kernel program step
+
+
+def _split_kernel(
+    binned_ref, node_ref, g_ref, h_ref, fmask_ref, lam_ref, gam_ref, mcw_ref,
+    outg_ref, outf_ref, outb_ref, *, m_pad, num_bins, pack, feat_tile,
+):
+    """Fused best-split step for one (fit, feature-tile): histogram build
+    (MXU one-hot matmuls), prefix sums (block-triangular matmul), XGBoost
+    gain, and the per-tile arg-best — all while the blocks are
+    VMEM-resident. Only [M] bests leave the kernel, never [M, F, B]
+    histograms.
+
+    ``pack`` features share the 128-lane bin axis (lane = sub·S + bin with
+    S = 128 // pack), so one [T,M]ᵀ@[T,128] dot builds ``pack`` features'
+    histograms — a ``pack``× FLOP cut over one-feature-per-dot."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+
+    nodes = node_ref[0, 0, :]    # [T]
+    g = g_ref[0, 0, :]
+    h = h_ref[0, 0, :]
+    lam = lam_ref[0, 0, 0]
+    gam = gam_ref[0, 0, 0]
+    mcw = mcw_ref[0, 0, 0]
+    mrow = fmask_ref[0, 0, 0, :]  # [feat_tile_pad] lanes (one per feature)
+    t = nodes.shape[0]
+    s = 128 // pack  # lanes per feature group
+
+    iota_m = lax.broadcasted_iota(jnp.int32, (t, m_pad), 1)
+    node_oh = (nodes[:, None] == iota_m).astype(jnp.float32)
+    wg = node_oh * g[:, None]
+    wh = node_oh * h[:, None]
+    iota_b = lax.broadcasted_iota(jnp.int32, (t, 128), 1)
+
+    # block-diagonal prefix/total matrices: lane (q·S+b) aggregates lanes of
+    # the SAME feature group only
+    r0 = lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    c0 = lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+    same_grp = (r0 // s) == (c0 // s)
+    tri_bd = (same_grp & (r0 <= c0)).astype(jnp.float32)   # prefix within group
+    ones_bd = same_grp.astype(jnp.float32)                 # total within group
+
+    lane = lax.broadcasted_iota(jnp.int32, (m_pad, 128), 1)
+    lane_bin = lane % s
+    lane_sub = lane // s
+    thr_ok = lane_bin < (num_bins - 1)  # valid thresholds t = 0..B-2
+    contract = (((0,), (0,)), ((), ()))
+    mm = (((1,), (0,)), ((), ()))
+
+    best_gain = jnp.full((m_pad,), -jnp.inf, dtype=jnp.float32)
+    best_feat = jnp.full((m_pad,), -1, dtype=jnp.int32)
+    best_bin = jnp.zeros((m_pad,), dtype=jnp.int32)
+
+    for q in range(feat_tile // pack):
+        # combined (sub-feature, bin) one-hot: pack features in one dot
+        comb_oh = jnp.zeros((t, 128), dtype=jnp.float32)
+        for sub in range(pack):
+            codes = binned_ref[q * pack + sub, :]
+            comb_oh = comb_oh + (
+                (codes[:, None] + sub * s) == iota_b
+            ).astype(jnp.float32)
+        hg = lax.dot_general(
+            wg, comb_oh, contract,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )  # [M, 128] = pack features' histograms side by side
+        hh = lax.dot_general(
+            wh, comb_oh, contract,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+        gl = lax.dot_general(
+            hg, tri_bd, mm,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )  # per-feature inclusive prefix sums
+        hl = lax.dot_general(
+            hh, tri_bd, mm,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+        gt = lax.dot_general(
+            hg, ones_bd, mm,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )  # per-feature totals broadcast across the group
+        ht = lax.dot_general(
+            hh, ones_bd, mm,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+        gr = gt - gl
+        hr = ht - hl
+        gain = 0.5 * (
+            gl * gl / (hl + lam) + gr * gr / (hr + lam) - gt * gt / (ht + lam)
+        ) - gam
+        # per-lane feature mask: feature q*pack + lane_sub of this tile
+        # (static per-sub scalar selects — no gathers inside the kernel)
+        mlane = jnp.zeros((m_pad, 128), dtype=jnp.float32)
+        for sub in range(pack):
+            mlane = jnp.where(lane_sub == sub, mrow[q * pack + sub], mlane)
+        valid = thr_ok & (hl >= mcw) & (hr >= mcw) & (mlane > 0)
+        gain = jnp.where(valid, gain, -jnp.inf)
+
+        bg = jnp.max(gain, axis=1)  # [M]
+        # deterministic tie-break: smallest lane at the max
+        bl = jnp.min(
+            jnp.where(gain >= bg[:, None], lane, 128), axis=1
+        ).astype(jnp.int32)
+        better = bg > best_gain
+        best_gain = jnp.where(better, bg, best_gain)
+        best_feat = jnp.where(
+            better, i * feat_tile + q * pack + bl // s, best_feat
+        ).astype(jnp.int32)
+        best_bin = jnp.where(better, bl % s, best_bin).astype(jnp.int32)
+
+    outg_ref[0, 0, :] = best_gain
+    outf_ref[0, 0, :] = best_feat
+    outb_ref[0, 0, :] = best_bin
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "num_bins", "interpret")
+)
+def build_best_split_pallas(
+    binned: jax.Array,     # [N, F] int32, SHARED
+    node: jax.Array,       # [K, N] int32 compact slot per row (-1 = dead)
+    grad: jax.Array,       # [K, N] f32 (pre-masked)
+    hess: jax.Array,       # [K, N] f32
+    feat_mask: jax.Array,  # [K, F] f32 (0 disables a feature)
+    reg_lambda: jax.Array,       # [K] f32
+    gamma: jax.Array,            # [K] f32
+    min_child_weight: jax.Array, # [K] f32
+    num_nodes: int,
+    num_bins: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(best_gain, best_feat, best_bin) each [K, num_nodes] — the fused
+    split search. Requires all rows to fit one VMEM tile (N ≲ 2k); callers
+    fall back to the two-phase histogram path beyond that."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k_fits, n = node.shape
+    f = binned.shape[1]
+    m_pad = _round_up(max(num_nodes, 8), 8)
+    n_pad = _round_up(max(n, 128), 128)
+    # bin-axis packing: features per 128-lane dot (4 for ≤32 bins)
+    pack = 4 if num_bins <= 32 else (2 if num_bins <= 64 else 1)
+    feat_tile = SPLIT_FEAT_TILE
+    f_pad = _round_up(f, feat_tile)
+    n_tiles = f_pad // feat_tile
+
+    binned_t = jnp.zeros((f_pad, n_pad), dtype=jnp.int32)
+    binned_t = binned_t.at[:f, :n].set(binned.T)
+    node_p = jnp.full((k_fits, 1, n_pad), -1, dtype=jnp.int32).at[:, 0, :n].set(node)
+    g_p = jnp.zeros((k_fits, 1, n_pad), dtype=jnp.float32).at[:, 0, :n].set(grad)
+    h_p = jnp.zeros((k_fits, 1, n_pad), dtype=jnp.float32).at[:, 0, :n].set(hess)
+    # per-(fit, tile) mask rows, one lane per feature of the tile
+    ft_pad = _round_up(feat_tile, 128)
+    fm = jnp.zeros((k_fits, n_tiles, 1, ft_pad), dtype=jnp.float32)
+    fm_src = jnp.zeros((k_fits, f_pad), dtype=jnp.float32).at[:, :f].set(feat_mask)
+    fm = fm.at[:, :, 0, :feat_tile].set(
+        fm_src.reshape(k_fits, n_tiles, feat_tile)
+    )
+    scal = lambda v: jnp.asarray(v, dtype=jnp.float32).reshape(k_fits, 1, 1)  # noqa: E731
+
+    grid = (k_fits, n_tiles)
+    out_shape = jax.ShapeDtypeStruct((k_fits * n_tiles, 1, m_pad), jnp.float32)
+    out_shape_i = jax.ShapeDtypeStruct((k_fits * n_tiles, 1, m_pad), jnp.int32)
+    out_spec = pl.BlockSpec(
+        (1, 1, m_pad), lambda k, i: (k * n_tiles + i, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+    outg, outf, outb = pl.pallas_call(
+        functools.partial(
+            _split_kernel, m_pad=m_pad, num_bins=num_bins, pack=pack,
+            feat_tile=feat_tile,
+        ),
+        out_shape=(out_shape, out_shape_i, out_shape_i),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (feat_tile, n_pad), lambda k, i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, n_pad), lambda k, i: (k, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, n_pad), lambda k, i: (k, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, n_pad), lambda k, i: (k, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, ft_pad), lambda k, i: (k, i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, 1), lambda k, i: (k, 0, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, 1), lambda k, i: (k, 0, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, 1), lambda k, i: (k, 0, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_specs=(out_spec, out_spec, out_spec),
+        interpret=interpret,
+    )(
+        binned_t, node_p, g_p, h_p, fm,
+        scal(reg_lambda), scal(gamma), scal(min_child_weight),
+    )
+
+    # reduce the per-tile bests over tiles (tiny [K, n_tiles, M] arrays)
+    outg = outg.reshape(k_fits, n_tiles, m_pad)
+    outf = outf.reshape(k_fits, n_tiles, m_pad)
+    outb = outb.reshape(k_fits, n_tiles, m_pad)
+    ti = jnp.argmax(outg, axis=1)  # [K, M]
+    take = lambda a: jnp.take_along_axis(a, ti[:, None, :], axis=1)[:, 0, :]  # noqa: E731
+    return (
+        take(outg)[:, :num_nodes],
+        take(outf)[:, :num_nodes],
+        take(outb)[:, :num_nodes],
+    )
+
+
+#: rows must fit one VMEM tile for the fused split kernel
+FUSED_SPLIT_MAX_ROWS = 2048
+
+
+def build_histogram_scatter_batched(
+    binned: jax.Array,   # [N, F] shared
+    node: jax.Array,     # [K, N]
+    grad: jax.Array,     # [K, N]
+    hess: jax.Array,     # [K, N]
+    num_nodes: int,
+    num_bins: int,
+) -> jax.Array:
+    """[K, num_nodes, F, num_bins, 2] scatter-add fallback (CPU / non-TPU)."""
+    return jax.vmap(
+        lambda nd, g, h: build_histogram_scatter(
+            binned, nd, g, h, num_nodes, num_bins
+        )
+    )(node, grad, hess)
 
 
 def default_impl() -> str:

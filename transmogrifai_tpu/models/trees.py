@@ -55,10 +55,6 @@ def bin_data(x: jax.Array, thresholds: jax.Array) -> jax.Array:
     return (x[:, :, None] > thresholds[None, :, :]).sum(axis=2).astype(jnp.int32)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("max_depth", "num_bins", "hist_impl", "parallel_fits"),
-)
 def grow_tree(
     binned: jax.Array,     # [N, F] int32 codes in [0, num_bins)
     grad: jax.Array,       # [N] float32
@@ -72,139 +68,253 @@ def grow_tree(
     min_child_weight: float | jax.Array = 1.0,
     min_info_gain: float | jax.Array = 0.0,
     hist_impl: str | None = None,
-    parallel_fits: int = 1,
+    parallel_fits: int = 1,  # kept for API compat; K now rides the kernel grid
 ) -> Tree:
+    """Single-fit tree growth — the K=1 case of grow_tree_batched."""
+    tree = grow_tree_batched(
+        binned, grad[None, :], hess[None, :], row_mask[None, :],
+        feat_mask[None, :],
+        max_depth=max_depth, num_bins=num_bins,
+        reg_lambda=reg_lambda, gamma=gamma,
+        min_child_weight=min_child_weight, min_info_gain=min_info_gain,
+        hist_impl=hist_impl,
+    )
+    return jax.tree.map(lambda a: a[0], tree)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "num_bins", "hist_impl"),
+)
+def grow_tree_batched(
+    binned: jax.Array,     # [N, F] int32 codes, SHARED across fits
+    grad: jax.Array,       # [K, N] float32
+    hess: jax.Array,       # [K, N] float32
+    row_mask: jax.Array,   # [K, N] float32
+    feat_mask: jax.Array,  # [K, F] float32
+    max_depth: int,
+    num_bins: int,
+    reg_lambda: jax.Array | float = 1.0,       # scalar or [K]
+    gamma: jax.Array | float = 0.0,
+    min_child_weight: jax.Array | float = 1.0,
+    min_info_gain: jax.Array | float = 0.0,
+    hist_impl: str | None = None,
+) -> Tree:
+    """Grow K trees at once — one per batched fit (hyperparameter grid point
+    × CV fold). The fit axis is a kernel GRID dimension of the histogram
+    build (hist_pallas.build_histogram_pallas_batched), NOT a vmap over the
+    custom call (which crashes this TPU runtime), so the entire candidate
+    sweep's tree growth runs as one compiled program. Returned Tree arrays
+    carry a leading K axis."""
     from .hist_pallas import (
-        build_histogram_pallas,
-        build_histogram_scatter,
+        FUSED_SPLIT_MAX_ROWS,
+        build_best_split_pallas,
+        build_histogram_pallas_batched,
+        build_histogram_scatter_batched,
         default_impl,
     )
 
-    n, f = binned.shape
+    k_fits, n = grad.shape
+    f = binned.shape[1]
     b = num_bins
     max_nodes = 1 << max_depth
     g = grad * row_mask
     h = hess * row_mask
     impl = hist_impl or default_impl()
-    if parallel_fits > 1 and impl == "pallas":
-        # vmapping the Mosaic custom call over batched grid fits crashes the
-        # TPU worker (kernel fault); batched sweeps take the scatter path
-        impl = "scatter"
 
-    # ---- node chunking: bound per-level histogram memory (the Spark
-    # maxMemoryInMB node-group equivalent). One shared fixed-size level body
-    # runs under lax.fori_loop (unrolling per-level sizes was measured
-    # SLOWER on TPU — less fusion, more distinct program regions). Forests
-    # lax.map trees sequentially, so ONE tree owns the budget — but batched
-    # grid fits vmap `parallel_fits` whole fits concurrently, so the caller
-    # must declare that factor and the per-fit budget shrinks accordingly.
-    budget_elems = max((1 << 25) // max(parallel_fits, 1), 1 << 20)
+    def vec(v):
+        arr = jnp.asarray(v, dtype=jnp.float32).reshape(-1)
+        return arr  # shape (1,) broadcasts over K; shape (K,) is per-fit
+
+    lam = vec(reg_lambda)[:, None, None, None]
+    gam = vec(gamma)[:, None, None, None]
+    mcw = vec(min_child_weight)[:, None, None, None]
+    mig = vec(min_info_gain)[:, None]
+
+    # ---- node compaction: at any level at most min(2^depth, N) node slots
+    # are LIVE (every live slot holds ≥1 row), so histograms are built over
+    # a compact slot space of ``cap`` ids instead of the full 2^d range —
+    # depth-12 growth on 1k rows costs the same as depth-10 (the dominant
+    # win for the deep ends of the reference's maxDepth {3,6,12} grids)
+    cap = max_nodes
+    if cap > n:
+        cap = 1
+        while cap < n:
+            cap <<= 1
+        cap = min(cap, max_nodes)
+    compact = cap < max_nodes
+
+    # fused split search: gains + arg-best computed inside the kernel while
+    # histograms are VMEM-resident — nothing [M, F, B]-sized touches HBM.
+    # Only possible when every row fits one VMEM tile and the bins fit the
+    # kernel's 128-lane packing.
+    use_fused = impl == "pallas" and n <= FUSED_SPLIT_MAX_ROWS and b <= 128
+
+    # per-chunk histogram memory scales with K — shrink the node chunk so
+    # [K, chunk, F, B, 2] stays inside the HBM budget (the Spark
+    # maxMemoryInMB node-group equivalent)
+    budget_elems = max((1 << 25) // k_fits, 1 << 20)
     chunk_cap = max(1, budget_elems // max(f * b, 1))
-    while chunk_cap & (chunk_cap - 1):  # round down to a power of two
+    while chunk_cap & (chunk_cap - 1):
         chunk_cap &= chunk_cap - 1
-    chunk_cap = min(chunk_cap, max_nodes)
-    if impl == "pallas":
-        # Mosaic keeps the kernel's full [f_pad, M, b_pad]×2 output resident
-        # in scoped VMEM (plus the [row_tile, M] node one-hot), so M must
-        # scale inversely with the feature count to stay under ~16 MB;
-        # outputs are double-buffered: 2 bufs × 2 outs × f_pad·M·b_pad·4B
-        f_pad = (f + 7) // 8 * 8
-        b_pad = (b + 127) // 128 * 128  # kernel pads bins to lane width
-        m_cap = max(8, (1 << 19) // (f_pad * b_pad))
+    chunk_cap = min(chunk_cap, cap)
+    if use_fused:
+        # the [T, M] one-hot temporaries are the only big VMEM tenants
+        n_pad = (n + 127) // 128 * 128
+        m_cap = max(8, min(256, (1 << 18) // max(n_pad, 128)))
+        while m_cap & (m_cap - 1):
+            m_cap &= m_cap - 1
+        chunk_cap = min(cap, m_cap)
+    elif impl == "pallas":
+        # VMEM per grid step: the [FEAT_TILE, M, b_pad]×2 output block (the
+        # feature axis is gridded — f does not multiply in) plus the [T, M]
+        # one-hot temporaries (the kernel shrinks its row tile as M grows)
+        b_pad = (b + 127) // 128 * 128
+        m_cap = max(8, min(256, (1 << 19) // (8 * b_pad)))
         while m_cap & (m_cap - 1):
             m_cap &= m_cap - 1
         chunk_cap = min(chunk_cap, m_cap)
 
-    def chunk_stats(node, c0, chunk_nodes):
-        """Best (gain, feat, bin) for node slots [c0, c0 + chunk_nodes)."""
-        active = (node >= c0) & (node < c0 + chunk_nodes)
-        local = jnp.where(active, node - c0, -1)  # -1 = dead for this chunk
-        if impl == "pallas":
-            # MXU one-hot kernel (hist_pallas.py) — dead rows carry node -1
-            hist = build_histogram_pallas(binned, local, g, h, chunk_nodes, b)
-        else:
-            hist = build_histogram_scatter(binned, local, g, h, chunk_nodes, b)
-        hg, hh = hist[..., 0], hist[..., 1]
+    lam_k = jnp.broadcast_to(vec(reg_lambda), (k_fits,))
+    gam_k = jnp.broadcast_to(vec(gamma), (k_fits,))
+    mcw_k = jnp.broadcast_to(vec(min_child_weight), (k_fits,))
 
-        gl = jnp.cumsum(hg, axis=2)[:, :, :-1]  # left = bins <= t
-        hl = jnp.cumsum(hh, axis=2)[:, :, :-1]
-        gt = hg.sum(axis=2, keepdims=True)
-        ht = hh.sum(axis=2, keepdims=True)
+    def chunk_stats(local, c0, chunk_nodes):
+        """Best (feat, bin) per compact slot in [c0, c0 + chunk_nodes)."""
+        active = (local >= c0) & (local < c0 + chunk_nodes)
+        loc = jnp.where(active, local - c0, -1)  # [K, N]
+        if use_fused:
+            bg, bf, bb = build_best_split_pallas(
+                binned, loc, g, h, feat_mask,
+                lam_k, gam_k, mcw_k,
+                num_nodes=chunk_nodes, num_bins=b,
+            )
+            do_split = bg > jnp.maximum(mig, 0.0)
+            return (
+                jnp.where(do_split, bf, -1),
+                jnp.where(do_split, bb, 0),
+            )
+        if impl == "pallas":
+            hist = build_histogram_pallas_batched(
+                binned, loc, g, h, chunk_nodes, b
+            )
+        else:
+            hist = build_histogram_scatter_batched(
+                binned, loc, g, h, chunk_nodes, b
+            )
+        hg, hh = hist[..., 0], hist[..., 1]  # [K, M, F, B]
+
+        gl = jnp.cumsum(hg, axis=3)[..., :-1]
+        hl = jnp.cumsum(hh, axis=3)[..., :-1]
+        gt = hg.sum(axis=3, keepdims=True)
+        ht = hh.sum(axis=3, keepdims=True)
         gr = gt - gl
         hr = ht - hl
-        parent = (gt**2) / (ht + reg_lambda)
-        gain = 0.5 * (
-            gl**2 / (hl + reg_lambda) + gr**2 / (hr + reg_lambda) - parent
-        ) - gamma
+        parent = (gt**2) / (ht + lam)
+        gain = 0.5 * (gl**2 / (hl + lam) + gr**2 / (hr + lam) - parent) - gam
         valid = (
-            (hl >= min_child_weight)
-            & (hr >= min_child_weight)
-            & (feat_mask[None, :, None] > 0)
+            (hl >= mcw)
+            & (hr >= mcw)
+            & (feat_mask[:, None, :, None] > 0)
         )
         gain = jnp.where(valid, gain, -jnp.inf)
 
-        flat_gain = gain.reshape(chunk_nodes, -1)
-        best = jnp.argmax(flat_gain, axis=1)
-        best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=1)[:, 0]
+        flat_gain = gain.reshape(gain.shape[0], chunk_nodes, -1)
+        best = jnp.argmax(flat_gain, axis=2)
+        best_gain = jnp.take_along_axis(flat_gain, best[..., None], axis=2)[..., 0]
         best_feat = (best // (b - 1)).astype(jnp.int32)
         best_bin = (best % (b - 1)).astype(jnp.int32)
-        do_split = best_gain > jnp.maximum(min_info_gain, 0.0)
+        do_split = best_gain > jnp.maximum(mig, 0.0)
         return (
             jnp.where(do_split, best_feat, -1),
             jnp.where(do_split, best_bin, 0),
+        )  # each [K, chunk]
+
+    sentinel = jnp.int32(max_nodes)  # out-of-range → dropped by scatters
+
+    def compact_ids(nd):
+        """Per fit: sorted unique live node ids [cap] (sentinel-padded) and
+        each row's compact slot. Rank-order preserves id order, so slot
+        numbering is deterministic."""
+        srt = jnp.sort(nd)
+        is_new = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), srt[1:] != srt[:-1]]
         )
-
-    chunk_nodes = chunk_cap
-    num_chunks = max_nodes // chunk_nodes
-
-    def level(d, carry):
-        # one compiled level body reused for every depth (lax.fori_loop);
-        # chunks wholly beyond the level's live node range are skipped
-        node, feats, bins = carry
-        n_nodes = jnp.left_shift(jnp.int32(1), d)
-
-        def chunk_body(ci, fb):
-            feats_d, bins_d = fb
-            c0 = ci * chunk_nodes
-
-            def run(_):
-                cf, cb = chunk_stats(node, c0, chunk_nodes)
-                return (
-                    jax.lax.dynamic_update_slice(feats_d, cf, (c0,)),
-                    jax.lax.dynamic_update_slice(bins_d, cb, (c0,)),
-                )
-
-            return jax.lax.cond(c0 < n_nodes, run, lambda _: (feats_d, bins_d), None)
-
-        feats_d0 = jnp.full(max_nodes, -1, dtype=jnp.int32)
-        bins_d0 = jnp.zeros(max_nodes, dtype=jnp.int32)
-        feats_d, bins_d = jax.lax.fori_loop(
-            0, num_chunks, chunk_body, (feats_d0, bins_d0)
+        ranks = jnp.cumsum(is_new) - 1  # [N] rank of each sorted element
+        uids = jnp.full(cap, sentinel, dtype=jnp.int32).at[ranks].set(
+            srt, mode="drop"
         )
-        feats = feats.at[d].set(feats_d)
-        bins = bins.at[d].set(bins_d)
+        slot = jnp.searchsorted(uids, nd).astype(jnp.int32)
+        return uids, slot
 
-        # ---- route rows to children
-        row_feat = feats_d[node]             # [N]
-        row_thr = bins_d[node]
-        code = jnp.take_along_axis(
-            binned, jnp.maximum(row_feat, 0)[:, None], axis=1
-        )[:, 0]
+    # ---- Python-unrolled level loop: every level's node-slot space and
+    # chunk size are STATIC (min(2^d, cap)), so level 0 costs a 1-slot
+    # kernel pass and only the deepest levels pay for `cap` slots — the
+    # shared-body fori_loop alternative forces every level to the maximum
+    node = jnp.zeros((k_fits, n), dtype=jnp.int32)
+    feats_levels, bins_levels = [], []
+    for d in range(max_depth):
+        n_nodes = min(1 << d, cap)  # static live-slot bound for this level
+        chunk_nodes = min(chunk_cap, n_nodes)
+        num_chunks = (n_nodes + chunk_nodes - 1) // chunk_nodes
+
+        if compact and (1 << d) > cap:
+            uids, local = jax.vmap(compact_ids)(node)  # [K, cap], [K, N]
+            compacted = True
+        else:
+            local = node
+            compacted = False
+
+        cfs, cbs = [], []
+        for ci in range(num_chunks):
+            cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
+            cfs.append(cf)
+            cbs.append(cb)
+        feats_c = jnp.concatenate(cfs, axis=1)[:, :n_nodes]  # [K, n_nodes]
+        bins_c = jnp.concatenate(cbs, axis=1)[:, :n_nodes]
+
+        # write per-slot decisions into the GLOBAL node-slot tree arrays
+        if compacted:
+            feats_d = jax.vmap(
+                lambda u, v: jnp.full(max_nodes, -1, dtype=jnp.int32)
+                .at[u].set(v, mode="drop")
+            )(uids[:, :n_nodes], feats_c)
+            bins_d = jax.vmap(
+                lambda u, v: jnp.zeros(max_nodes, dtype=jnp.int32)
+                .at[u].set(v, mode="drop")
+            )(uids[:, :n_nodes], bins_c)
+        else:
+            pad = max_nodes - n_nodes
+            feats_d = jnp.pad(feats_c, ((0, 0), (0, pad)), constant_values=-1)
+            bins_d = jnp.pad(bins_c, ((0, 0), (0, pad)))
+        feats_levels.append(feats_d)
+        bins_levels.append(bins_d)
+
+        # ---- route rows to children (gather via compact slots — cheaper)
+        row_feat = jnp.take_along_axis(
+            feats_c, jnp.minimum(local, n_nodes - 1), axis=1
+        )  # [K, N]
+        row_thr = jnp.take_along_axis(
+            bins_c, jnp.minimum(local, n_nodes - 1), axis=1
+        )
+        code = jax.vmap(
+            lambda rf: jnp.take_along_axis(
+                binned, jnp.maximum(rf, 0)[:, None], axis=1
+            )[:, 0]
+        )(row_feat)
         go_right = (row_feat >= 0) & (code > row_thr)
         node = node * 2 + go_right.astype(jnp.int32)
-        return node, feats, bins
 
-    node0 = jnp.zeros(n, dtype=jnp.int32)
-    feats0 = jnp.full((max_depth, max_nodes), -1, dtype=jnp.int32)
-    bins0 = jnp.zeros((max_depth, max_nodes), dtype=jnp.int32)
-    node, feats, bins = jax.lax.fori_loop(
-        0, max_depth, level, (node0, feats0, bins0)
-    )
+    feats = jnp.stack(feats_levels, axis=1)  # [K, depth, max_nodes]
+    bins = jnp.stack(bins_levels, axis=1)
 
-    # ---- leaf values: Newton step -G/(H+λ) per final node
-    leaf_g = jnp.zeros(max_nodes, dtype=jnp.float32).at[node].add(g)
-    leaf_h = jnp.zeros(max_nodes, dtype=jnp.float32).at[node].add(h)
-    leaf_value = -leaf_g / (leaf_h + reg_lambda)
+    leaf_g = jax.vmap(
+        lambda nd, gk: jnp.zeros(max_nodes, dtype=jnp.float32).at[nd].add(gk)
+    )(node, g)
+    leaf_h = jax.vmap(
+        lambda nd, hk: jnp.zeros(max_nodes, dtype=jnp.float32).at[nd].add(hk)
+    )(node, h)
+    leaf_value = -leaf_g / (leaf_h + vec(reg_lambda)[:, None])
     return Tree(split_feat=feats, split_bin=bins, leaf_value=leaf_value)
 
 
@@ -293,6 +403,112 @@ def predict_forest(binned: jax.Array, trees: Tree) -> jax.Array:
     return preds.mean(axis=0)
 
 
+@jax.jit
+def predict_forest_raw(x: jax.Array, thresholds: jax.Array, trees: Tree) -> jax.Array:
+    """Fused bin + forest predict — ONE dispatch per call (model scoring runs
+    through here; the eager op-by-op path costs a host round-trip per op,
+    which dominates wall-clock on a tunneled chip)."""
+    return predict_forest(bin_data(x, thresholds), trees)
+
+
+@jax.jit
+def predict_boosted_raw(
+    x: jax.Array, thresholds: jax.Array, trees: Tree,
+    eta: jax.Array, base_score: jax.Array,
+) -> jax.Array:
+    """Fused bin + boosted predict — one dispatch; eta/base_score are
+    traced arrays so distinct hyperparameter values share the compilation."""
+    binned = bin_data(x, thresholds)
+    preds = jax.vmap(lambda t: predict_tree(binned, t))(trees)  # [R, N]
+    return base_score + eta * preds.sum(axis=0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "num_bins", "bootstrap"),
+)
+def _forest_tree_batched(
+    binned, target, row_mask, tkey, sub, col, min_instances, min_info_gain,
+    max_depth, num_bins, bootstrap,
+) -> Tree:
+    """One bagged tree for all K fits (one compiled program, reused per
+    tree by the host loop in fit_forest_batched)."""
+    k_fits, n = row_mask.shape
+    f = binned.shape[1]
+    k1, k2 = jax.random.split(tkey)
+    if bootstrap:
+        # same key for every fit, drawn per-fit (vmap): each lane's sample
+        # equals the sequential fit_forest draw, so batched and sequential
+        # sweeps train bit-identical forests
+        counts = jax.vmap(
+            lambda r: jax.random.poisson(k1, r, (n,))
+        )(sub).astype(jnp.float32)
+    else:
+        counts = jnp.ones((k_fits, n), dtype=jnp.float32)
+    rmask = row_mask * counts
+    fmask = jax.vmap(
+        lambda c: (jax.random.uniform(k2, (f,)) < c)
+    )(col).astype(jnp.float32)
+    fmask = jnp.where(
+        fmask.sum(axis=1, keepdims=True) == 0, jnp.ones((1, f)), fmask
+    )
+    gb = jnp.broadcast_to(-target[None, :], (k_fits, n))
+    return grow_tree_batched(
+        binned,
+        gb,
+        jnp.ones((k_fits, n), dtype=jnp.float32),
+        rmask,
+        fmask,
+        max_depth=max_depth,
+        num_bins=num_bins,
+        reg_lambda=0.0,
+        gamma=0.0,
+        min_child_weight=min_instances,
+        min_info_gain=min_info_gain,
+    )
+
+
+def fit_forest_batched(
+    binned: jax.Array,      # [N, F] shared
+    target: jax.Array,      # [N] shared regression target / indicator
+    row_mask: jax.Array,    # [K, N] per-fit row masks (folds × resamples)
+    num_trees: int,
+    max_depth: int,
+    num_bins: int,
+    subsample_rate: jax.Array | float = 1.0,   # scalar or [K]
+    colsample_rate: jax.Array | float = 1.0,
+    min_instances: jax.Array | float = 1.0,
+    min_info_gain: jax.Array | float = 0.0,
+    seed: int = 42,
+    bootstrap: bool = True,
+) -> Tree:
+    """K random forests batched over the fit axis: tree t of every fit grows
+    in one program (grow_tree_batched — fit axis = histogram-kernel grid
+    axis); the TREE loop runs on host, reusing that one compiled program per
+    dispatch. A single fused 50-tree × K-fit program was observed to bring
+    down the TPU runtime worker, and buys nothing — each tree's histogram
+    build already fills the chip. Returns stacked Tree arrays [K, T, ...]."""
+    k_fits, n = row_mask.shape
+    key = jax.random.PRNGKey(seed)
+    tkeys = jax.random.split(key, num_trees)
+    sub = jnp.broadcast_to(
+        jnp.asarray(subsample_rate, dtype=jnp.float32).reshape(-1), (k_fits,)
+    )
+    col = jnp.broadcast_to(
+        jnp.asarray(colsample_rate, dtype=jnp.float32).reshape(-1), (k_fits,)
+    )
+    mi = jnp.asarray(min_instances, dtype=jnp.float32)
+    mg = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    trees = [
+        _forest_tree_batched(
+            binned, target, row_mask, tkeys[t], sub, col, mi, mg,
+            max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
+        )
+        for t in range(num_trees)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *trees)  # [K, T, ...]
+
+
 @partial(
     jax.jit,
     static_argnames=("max_depth", "num_bins", "num_rounds", "objective", "parallel_fits"),
@@ -351,3 +567,92 @@ def predict_boosted(
 ) -> jax.Array:
     preds = jax.vmap(lambda t: predict_tree(binned, t))(trees)  # [R, N]
     return base_score + eta * preds.sum(axis=0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "num_bins", "num_rounds", "objective"),
+)
+def _boost_rounds_batched(
+    binned, y, row_mask, margin0, eta_v, reg_lambda, gamma,
+    min_child_weight, min_info_gain,
+    num_rounds, max_depth, num_bins, objective,
+) -> tuple[Tree, jax.Array]:
+    """A chunk of boosting rounds for all K fits (lax.scan inside one
+    program; the host loop in fit_boosted_batched chains chunks)."""
+    k_fits, n = row_mask.shape
+    f = binned.shape[1]
+    feat_mask = jnp.ones((k_fits, f), dtype=jnp.float32)
+
+    def grads(margin):  # [K, N]
+        if objective == "binary:logistic":
+            p = jax.nn.sigmoid(margin)
+            return p - y[None, :], p * (1.0 - p)
+        return margin - y[None, :], jnp.ones_like(margin)
+
+    def round_step(margin, _):
+        g, h = grads(margin)
+        tree = grow_tree_batched(
+            binned, g, h, row_mask, feat_mask,
+            max_depth=max_depth, num_bins=num_bins,
+            reg_lambda=reg_lambda, gamma=gamma,
+            min_child_weight=min_child_weight, min_info_gain=min_info_gain,
+        )
+        step = jax.vmap(lambda t: predict_tree(binned, t))(tree)  # [K, N]
+        margin = margin + eta_v[:, None] * step
+        return margin, tree
+
+    margin, trees = jax.lax.scan(round_step, margin0, None, length=num_rounds)
+    return trees, margin  # trees [R, K, ...]
+
+
+#: boosting rounds per compiled program — keeps any one program's size
+#: bounded (a single 200-round × K-fit program risks the runtime-worker
+#: faults observed with the fused forest program)
+_BOOST_ROUND_CHUNK = 25
+
+
+def fit_boosted_batched(
+    binned: jax.Array,     # [N, F] shared
+    y: jax.Array,          # [N] shared labels
+    row_mask: jax.Array,   # [K, N]
+    num_rounds: int,
+    max_depth: int,
+    num_bins: int,
+    eta: jax.Array | float = 0.3,          # scalar or [K]
+    reg_lambda: jax.Array | float = 1.0,
+    gamma: jax.Array | float = 0.0,
+    min_child_weight: jax.Array | float = 1.0,
+    min_info_gain: jax.Array | float = 0.0,
+    base_score: jax.Array | float = 0.0,
+    objective: str = "binary:logistic",
+) -> tuple[Tree, jax.Array]:
+    """K boosting runs batched over the fit axis: every round grows all K
+    trees in one histogram build; rounds scan in fixed-size chunks so each
+    compiled program stays modest. Returns Tree arrays [K, R, ...] and the
+    training margins [K, N]."""
+    k_fits, n = row_mask.shape
+    eta_v = jnp.broadcast_to(
+        jnp.asarray(eta, dtype=jnp.float32).reshape(-1), (k_fits,)
+    )
+    lam = jnp.asarray(reg_lambda, dtype=jnp.float32)
+    gam = jnp.asarray(gamma, dtype=jnp.float32)
+    mcw = jnp.asarray(min_child_weight, dtype=jnp.float32)
+    mig = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    margin = jnp.broadcast_to(
+        jnp.asarray(base_score, dtype=jnp.float32).reshape(-1, 1), (k_fits, n)
+    ).astype(jnp.float32)
+    chunks = []
+    done = 0
+    while done < num_rounds:
+        rc = min(_BOOST_ROUND_CHUNK, num_rounds - done)
+        trees_c, margin = _boost_rounds_batched(
+            binned, y, row_mask, margin, eta_v, lam, gam, mcw, mig,
+            num_rounds=rc, max_depth=max_depth, num_bins=num_bins,
+            objective=objective,
+        )
+        chunks.append(trees_c)
+        done += rc
+    trees = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *chunks)
+    # trees: [R, K, ...] -> [K, R, ...]
+    return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees), margin

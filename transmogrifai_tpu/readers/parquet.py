@@ -186,12 +186,20 @@ def write_parquet(dataset: Dataset, path: str) -> None:
         elif isinstance(col, (TextColumn, ListColumn, MapColumn)):
             vals = col.to_list()
             if isinstance(col, MapColumn):
+                # empty map ≠ missing: only None becomes null
                 arrays.append(
-                    pa.array([list(v.items()) if v else None for v in vals],
-                             type=_map_arrow_type(pa, vals))
+                    pa.array(
+                        [
+                            list(v.items()) if v is not None else None
+                            for v in vals
+                        ],
+                        type=_map_arrow_type(pa, vals),
+                    )
                 )
             elif isinstance(col, ListColumn):
-                arrays.append(pa.array([list(v) if v else None for v in vals]))
+                arrays.append(
+                    pa.array([list(v) if v is not None else None for v in vals])
+                )
             else:
                 arrays.append(pa.array(vals))
         else:

@@ -99,18 +99,30 @@ class Validator:
         y: np.ndarray,
         evaluator: Evaluator,
     ) -> list[CandidateResult]:
-        batched = getattr(est, "fit_arrays_batched", None)
         per_point_values: list[list[float]] = [[] for _ in points]
-        for train_mask, val_mask in folds:
-            if batched is not None:
-                models = batched(x, y, train_mask.astype(np.float32), points)
+        batched_masks = getattr(est, "fit_arrays_batched_masks", None)
+        if batched_masks is not None:
+            # the whole folds × grid sweep in as few compiled programs as
+            # the family's static shapes allow (fold = batch-axis entry)
+            models_by_fold = batched_masks(
+                x, y, [tm.astype(np.float32) for tm, _ in folds], points
+            )
+        else:
+            models_by_fold = None
+        for fi, (train_mask, val_mask) in enumerate(folds):
+            if models_by_fold is not None:
+                models = models_by_fold[fi]
             else:
-                models = [
-                    est.with_params(**p).fit_arrays(
-                        x, y, train_mask.astype(np.float32)
-                    )
-                    for p in points
-                ]
+                batched = getattr(est, "fit_arrays_batched", None)
+                if batched is not None:
+                    models = batched(x, y, train_mask.astype(np.float32), points)
+                else:
+                    models = [
+                        est.with_params(**p).fit_arrays(
+                            x, y, train_mask.astype(np.float32)
+                        )
+                        for p in points
+                    ]
             val_idx = np.nonzero(val_mask)[0]
             for gi, model in enumerate(models):
                 pred, prob, _ = model.predict_arrays(x[val_idx])
