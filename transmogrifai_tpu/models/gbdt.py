@@ -699,6 +699,7 @@ class RandomForestClassifier(_TreeEstimator):
             min_instances=float(self.min_instances_per_node),
             min_info_gain=float(self.min_info_gain),
             seed=int(self.seed),
+            lowp=True,  # one-vs-rest indicators are bf16-exact
         )
         if num_classes == 2:
             forests = [
@@ -730,6 +731,7 @@ class RandomForestClassifier(_TreeEstimator):
                 min_instances=knob("min_instances_per_node"),
                 min_info_gain=knob("min_info_gain"),
                 seed=int(m0["seed"]),
+                lowp=True,  # one-vs-rest indicators are bf16-exact
             )
 
         return self._batched_group_fit(

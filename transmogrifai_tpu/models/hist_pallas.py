@@ -224,7 +224,7 @@ SPLIT_FEAT_TILE = 32  # features per split-kernel program step
 
 def _split_kernel(
     binned_ref, node_ref, g_ref, h_ref, fmask_ref, lam_ref, gam_ref, mcw_ref,
-    outg_ref, outf_ref, outb_ref, *, m_pad, num_bins, pack, feat_tile,
+    outg_ref, outf_ref, outb_ref, *, m_pad, num_bins, pack, feat_tile, lowp,
 ):
     """Fused best-split step for one (fit, feature-tile): histogram build
     (MXU one-hot matmuls), prefix sums (block-triangular matmul), XGBoost
@@ -250,10 +250,14 @@ def _split_kernel(
     t = nodes.shape[0]
     s = 128 // pack  # lanes per feature group
 
+    # lowp: operands in bf16 with f32 MXU accumulation — callers assert the
+    # values are bf16-exact (RF: g ∈ {0,±1}, h = 1), so sums stay exact up
+    # to 2^24 while the dots run at the bf16 MXU rate
+    op_dtype = jnp.bfloat16 if lowp else jnp.float32
     iota_m = lax.broadcasted_iota(jnp.int32, (t, m_pad), 1)
     node_oh = (nodes[:, None] == iota_m).astype(jnp.float32)
-    wg = node_oh * g[:, None]
-    wh = node_oh * h[:, None]
+    wg = (node_oh * g[:, None]).astype(op_dtype)
+    wh = (node_oh * h[:, None]).astype(op_dtype)
     iota_b = lax.broadcasted_iota(jnp.int32, (t, 128), 1)
 
     # block-diagonal prefix/total matrices: lane (q·S+b) aggregates lanes of
@@ -275,23 +279,24 @@ def _split_kernel(
     best_feat = jnp.full((m_pad,), -1, dtype=jnp.int32)
     best_bin = jnp.zeros((m_pad,), dtype=jnp.int32)
 
+    hist_precision = lax.Precision.DEFAULT if lowp else lax.Precision.HIGHEST
     for q in range(feat_tile // pack):
         # combined (sub-feature, bin) one-hot: pack features in one dot
-        comb_oh = jnp.zeros((t, 128), dtype=jnp.float32)
+        comb_oh = jnp.zeros((t, 128), dtype=op_dtype)
         for sub in range(pack):
             codes = binned_ref[q * pack + sub, :]
             comb_oh = comb_oh + (
                 (codes[:, None] + sub * s) == iota_b
-            ).astype(jnp.float32)
+            ).astype(op_dtype)
         hg = lax.dot_general(
             wg, comb_oh, contract,
             preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST,
+            precision=hist_precision,
         )  # [M, 128] = pack features' histograms side by side
         hh = lax.dot_general(
             wh, comb_oh, contract,
             preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST,
+            precision=hist_precision,
         )
         gl = lax.dot_general(
             hg, tri_bd, mm,
@@ -344,7 +349,7 @@ def _split_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_nodes", "num_bins", "interpret")
+    jax.jit, static_argnames=("num_nodes", "num_bins", "lowp", "interpret")
 )
 def build_best_split_pallas(
     binned: jax.Array,     # [N, F] int32, SHARED
@@ -357,6 +362,7 @@ def build_best_split_pallas(
     min_child_weight: jax.Array, # [K] f32
     num_nodes: int,
     num_bins: int,
+    lowp: bool = False,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(best_gain, best_feat, best_bin) each [K, num_nodes] — the fused
@@ -400,7 +406,7 @@ def build_best_split_pallas(
     outg, outf, outb = pl.pallas_call(
         functools.partial(
             _split_kernel, m_pad=m_pad, num_bins=num_bins, pack=pack,
-            feat_tile=feat_tile,
+            feat_tile=feat_tile, lowp=lowp,
         ),
         out_shape=(out_shape, out_shape_i, out_shape_i),
         grid=grid,

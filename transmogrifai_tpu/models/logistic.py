@@ -116,49 +116,59 @@ class LogisticRegression(PredictorEstimator):
             np.asarray(params.weights), np.asarray(params.intercept), num_classes
         )
 
-    def fit_arrays_batched(self, x, y, row_mask, grid_points):
-        """Train the whole hyperparameter grid as ONE vmapped XLA computation
-        (SURVEY.md §2.6: the reference's driver thread pool becomes a vmap
-        axis). Grid points sharing this estimator's static params (max_iter,
-        fit_intercept) vmap over (reg_param, elastic_net); stragglers fall
-        back to sequential fits."""
-        def _is_vmappable(p):
-            # only reg/elastic-net vary inside the vmap; any other overridden
-            # param must match this estimator's static value
-            return all(
-                k in ("reg_param", "elastic_net_param") or v == getattr(self, k)
-                for k, v in p.items()
-            )
+    # ---- batched sweeps (SURVEY.md §2.6: the reference's driver thread
+    # pool becomes a batch axis of one compiled program) -------------------
 
-        vmappable = [i for i, p in enumerate(grid_points) if _is_vmappable(p)]
-        rest = [i for i in range(len(grid_points)) if i not in vmappable]
-        present = y[row_mask > 0]
-        num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
+    def _is_vmappable(self, p: dict) -> bool:
+        # only reg/elastic-net vary inside the vmap; any other overridden
+        # param must match this estimator's static value
+        return all(
+            k in ("reg_param", "elastic_net_param") or v == getattr(self, k)
+            for k, v in p.items()
+        )
+
+    def _grid_values(self, points) -> tuple[np.ndarray, np.ndarray]:
+        regs = np.asarray(
+            [p.get("reg_param", self.reg_param) for p in points],
+            dtype=np.float32,
+        )
+        ens = np.asarray(
+            [p.get("elastic_net_param", self.elastic_net_param) for p in points],
+            dtype=np.float32,
+        )
+        return regs, ens
+
+    def _vmapped_fit(self, x, y, num_classes: int):
+        """fit fn of (reg, elastic_net, row_mask) for the vmapped sweep."""
         iters = self.max_iter * 4
+        if num_classes == 2:
+            return lambda r, e, m: fit_logistic_binary(
+                x, y, m, r, e, num_iters=iters,
+                fit_intercept=self.fit_intercept,
+            )
+        return lambda r, e, m: fit_logistic_multinomial(
+            x, y, m, r, e, num_classes=num_classes,
+            num_iters=iters, fit_intercept=self.fit_intercept,
+        )
+
+    @staticmethod
+    def _num_classes(y, any_mask) -> int:
+        present = y[any_mask > 0]
+        return max(int(present.max()) + 1 if len(present) else 2, 2)
+
+    def fit_arrays_batched(self, x, y, row_mask, grid_points):
+        """One mask, many grid points — vmappable points train in one
+        program; stragglers fall back to sequential fits."""
+        vmappable = [i for i, p in enumerate(grid_points) if self._is_vmappable(p)]
+        rest = [i for i in range(len(grid_points)) if i not in vmappable]
+        num_classes = self._num_classes(y, row_mask)
         models: dict[int, LogisticRegressionModel] = {}
         if vmappable:
-            regs = np.asarray(
-                [grid_points[i].get("reg_param", self.reg_param) for i in vmappable],
-                dtype=np.float32,
+            regs, ens = self._grid_values([grid_points[i] for i in vmappable])
+            rm = np.broadcast_to(
+                np.asarray(row_mask, dtype=np.float32), (len(vmappable), len(y))
             )
-            ens = np.asarray(
-                [
-                    grid_points[i].get("elastic_net_param", self.elastic_net_param)
-                    for i in vmappable
-                ],
-                dtype=np.float32,
-            )
-            if num_classes == 2:
-                fn = lambda r, e: fit_logistic_binary(  # noqa: E731
-                    x, y, row_mask, r, e, num_iters=iters,
-                    fit_intercept=self.fit_intercept,
-                )
-            else:
-                fn = lambda r, e: fit_logistic_multinomial(  # noqa: E731
-                    x, y, row_mask, r, e, num_classes=num_classes,
-                    num_iters=iters, fit_intercept=self.fit_intercept,
-                )
-            stacked = jax.vmap(fn)(regs, ens)
+            stacked = jax.vmap(self._vmapped_fit(x, y, num_classes))(regs, ens, rm)
             w = np.asarray(stacked.weights)
             b = np.asarray(stacked.intercept)
             for j, i in enumerate(vmappable):
@@ -172,50 +182,17 @@ class LogisticRegression(PredictorEstimator):
         (fold-mask, reg, elastic-net) triples, so the validator's whole
         sweep is a single dispatch. Non-vmappable points fall back to the
         per-fold batched path."""
-        import numpy as _np
-
-        def _is_vmappable(p):
-            return all(
-                k in ("reg_param", "elastic_net_param") or v == getattr(self, k)
-                for k, v in p.items()
-            )
-
-        if not all(_is_vmappable(p) for p in grid_points):
+        if not all(self._is_vmappable(p) for p in grid_points):
             return [
                 self.fit_arrays_batched(x, y, m, grid_points) for m in masks
             ]
-        present = y[_np.max(_np.stack(masks), axis=0) > 0]
-        num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
-        iters = self.max_iter * 4
+        num_classes = self._num_classes(y, np.max(np.stack(masks), axis=0))
         n_pts = len(grid_points)
-        regs = _np.asarray(
-            [
-                p.get("reg_param", self.reg_param)
-                for _ in masks for p in grid_points
-            ],
-            dtype=_np.float32,
-        )
-        ens = _np.asarray(
-            [
-                p.get("elastic_net_param", self.elastic_net_param)
-                for _ in masks for p in grid_points
-            ],
-            dtype=_np.float32,
-        )
-        rm = _np.repeat(
-            _np.stack(masks).astype(_np.float32), n_pts, axis=0
-        )  # [K, N]
-        if num_classes == 2:
-            fn = lambda r, e, m: fit_logistic_binary(  # noqa: E731
-                x, y, m, r, e, num_iters=iters,
-                fit_intercept=self.fit_intercept,
-            )
-        else:
-            fn = lambda r, e, m: fit_logistic_multinomial(  # noqa: E731
-                x, y, m, r, e, num_classes=num_classes,
-                num_iters=iters, fit_intercept=self.fit_intercept,
-            )
-        stacked = jax.vmap(fn)(regs, ens, rm)
+        regs, ens = self._grid_values(list(grid_points) * len(masks))
+        rm = np.repeat(
+            np.stack(masks).astype(np.float32), n_pts, axis=0
+        )  # [K, N], mask-major to match regs/ens tiling
+        stacked = jax.vmap(self._vmapped_fit(x, y, num_classes))(regs, ens, rm)
         w = np.asarray(stacked.weights)
         b = np.asarray(stacked.intercept)
         return [

@@ -84,7 +84,7 @@ def grow_tree(
 
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins", "hist_impl"),
+    static_argnames=("max_depth", "num_bins", "hist_impl", "lowp"),
 )
 def grow_tree_batched(
     binned: jax.Array,     # [N, F] int32 codes, SHARED across fits
@@ -99,6 +99,7 @@ def grow_tree_batched(
     min_child_weight: jax.Array | float = 1.0,
     min_info_gain: jax.Array | float = 0.0,
     hist_impl: str | None = None,
+    lowp: bool = False,
 ) -> Tree:
     """Grow K trees at once — one per batched fit (hyperparameter grid point
     × CV fold). The fit axis is a kernel GRID dimension of the histogram
@@ -187,7 +188,7 @@ def grow_tree_batched(
             bg, bf, bb = build_best_split_pallas(
                 binned, loc, g, h, feat_mask,
                 lam_k, gam_k, mcw_k,
-                num_nodes=chunk_nodes, num_bins=b,
+                num_nodes=chunk_nodes, num_bins=b, lowp=lowp,
             )
             do_split = bg > jnp.maximum(mig, 0.0)
             return (
@@ -246,6 +247,17 @@ def grow_tree_batched(
         )
         slot = jnp.searchsorted(uids, nd).astype(jnp.int32)
         return uids, slot
+
+    if max_depth == 0:
+        # root-only tree (legal Spark maxDepth=0): no splits, leaf = all rows
+        node0 = jnp.zeros((k_fits, n), dtype=jnp.int32)
+        leaf_g0 = (g).sum(axis=1, keepdims=True)
+        leaf_h0 = (h).sum(axis=1, keepdims=True)
+        return Tree(
+            split_feat=jnp.full((k_fits, 0, 1), -1, dtype=jnp.int32),
+            split_bin=jnp.zeros((k_fits, 0, 1), dtype=jnp.int32),
+            leaf_value=-leaf_g0 / (leaf_h0 + vec(reg_lambda)[:, None]),
+        )
 
     # ---- Python-unrolled level loop: every level's node-slot space and
     # chunk size are STATIC (min(2^d, cap)), so level 0 costs a 1-slot
@@ -335,12 +347,8 @@ def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
 
 
 # --------------------------------------------------------------------------
-# forests (bagged, vmapped) and boosting (scanned)
+# forests (bagged, batched over the fit axis) and boosting (chunk-scanned)
 # --------------------------------------------------------------------------
-@partial(
-    jax.jit,
-    static_argnames=("max_depth", "num_bins", "num_trees", "bootstrap", "parallel_fits"),
-)
 def fit_forest(
     binned: jax.Array,
     target: jax.Array,      # [N] regression target (or one-vs-rest indicator)
@@ -354,47 +362,20 @@ def fit_forest(
     min_info_gain: float | jax.Array = 0.0,
     seed: int | jax.Array = 42,
     bootstrap: bool = True,
-    parallel_fits: int = 1,
+    parallel_fits: int = 1,  # kept for API compat
+    lowp: bool = False,
 ) -> Tree:
-    """Random forest of mean-target trees: bootstrap row weights + feature
-    subsampling, all trees trained in one vmap (Spark RandomForest parity:
-    variance impurity == gain formula with h=1, λ=0)."""
-    n, f = binned.shape
-    key = jax.random.PRNGKey(seed)
-    tkeys = jax.random.split(key, num_trees)
-
-    def one_tree(tkey):
-        k1, k2 = jax.random.split(tkey)
-        if bootstrap:
-            # bootstrap: Poisson(rate) counts ≈ sampling with replacement
-            counts = jax.random.poisson(k1, subsample_rate, (n,)).astype(jnp.float32)
-        else:
-            counts = jnp.ones(n, dtype=jnp.float32)
-        rmask = row_mask * counts
-        fmask = (
-            jax.random.uniform(k2, (f,)) < colsample_rate
-        ).astype(jnp.float32)
-        # ensure at least one feature stays on
-        fmask = jnp.where(fmask.sum() == 0, jnp.ones(f), fmask)
-        return grow_tree(
-            binned,
-            -target,  # g = -target, h = 1 -> leaf = mean(target)
-            jnp.ones(n, dtype=jnp.float32),
-            rmask,
-            fmask,
-            max_depth=max_depth,
-            num_bins=num_bins,
-            reg_lambda=0.0,
-            gamma=0.0,
-            min_child_weight=min_instances,
-            min_info_gain=min_info_gain,
-            parallel_fits=parallel_fits,
-        )
-
-    # sequential lax.map keeps peak memory at ONE tree's histograms (a deep
-    # forest vmap would multiply the [max_nodes, F, B] buffers by num_trees);
-    # each tree's histogram build already saturates the chip.
-    return jax.lax.map(one_tree, tkeys)  # stacked Tree arrays [T, ...]
+    """Random forest of mean-target trees — the K=1 case of
+    fit_forest_batched (Spark RandomForest parity: variance impurity ==
+    gain formula with h=1, λ=0). Returns stacked Tree arrays [T, ...]."""
+    trees = fit_forest_batched(
+        binned, target, jnp.asarray(row_mask)[None, :],
+        num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
+        subsample_rate=subsample_rate, colsample_rate=colsample_rate,
+        min_instances=min_instances, min_info_gain=min_info_gain,
+        seed=int(seed), bootstrap=bootstrap, lowp=lowp,
+    )
+    return jax.tree.map(lambda a: a[0], trees)
 
 
 def predict_forest(binned: jax.Array, trees: Tree) -> jax.Array:
@@ -425,11 +406,11 @@ def predict_boosted_raw(
 
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins", "bootstrap"),
+    static_argnames=("max_depth", "num_bins", "bootstrap", "lowp"),
 )
 def _forest_tree_batched(
     binned, target, row_mask, tkey, sub, col, min_instances, min_info_gain,
-    max_depth, num_bins, bootstrap,
+    max_depth, num_bins, bootstrap, lowp,
 ) -> Tree:
     """One bagged tree for all K fits (one compiled program, reused per
     tree by the host loop in fit_forest_batched)."""
@@ -465,6 +446,7 @@ def _forest_tree_batched(
         gamma=0.0,
         min_child_weight=min_instances,
         min_info_gain=min_info_gain,
+        lowp=lowp,
     )
 
 
@@ -481,6 +463,7 @@ def fit_forest_batched(
     min_info_gain: jax.Array | float = 0.0,
     seed: int = 42,
     bootstrap: bool = True,
+    lowp: bool = False,
 ) -> Tree:
     """K random forests batched over the fit axis: tree t of every fit grows
     in one program (grow_tree_batched — fit axis = histogram-kernel grid
@@ -503,6 +486,9 @@ def fit_forest_batched(
         _forest_tree_batched(
             binned, target, row_mask, tkeys[t], sub, col, mi, mg,
             max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
+            # lowp is only sound when target values are bf16-exact
+            # (classification indicators); regression keeps f32
+            lowp=lowp,
         )
         for t in range(num_trees)
     ]

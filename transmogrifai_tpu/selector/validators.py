@@ -6,9 +6,11 @@ OpValidator}.scala. Defaults (OpValidator.scala:371-379): 3 folds, train ratio
 model/grid is logged and skipped; error only if ALL fail).
 
 TPU mapping (SURVEY.md §2.6): folds are row masks and hyperparameter grids are
-stacked arrays — when a model family implements ``fit_arrays_batched`` the
-whole folds × grid sweep trains as one vmapped XLA computation instead of a
-driver thread pool.
+stacked arrays. The primary model-family hook is
+``fit_arrays_batched_masks(x, y, masks, points)`` — the whole folds × grid
+sweep trains batched over the fit axis of one compiled program per
+static-shape group; ``fit_arrays_batched`` (one mask, many points) is the
+legacy fallback, and families with neither hook fit sequentially.
 """
 from __future__ import annotations
 
