@@ -28,7 +28,10 @@ def _enable_compile_cache() -> None:
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # the axon backend compiles through a remote helper, so even trivial
+        # ops cost ~0.8 s to compile — persist EVERYTHING so fresh processes
+        # only pay cache loads
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:
         pass
 

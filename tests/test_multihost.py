@@ -1,0 +1,77 @@
+"""Multi-host plumbing (SURVEY.md §5.8) — exercised single-process on the
+8-device CPU mesh: the ("dcn", "data") hierarchy degenerates to dcn=1 but
+runs the exact same collectives and global-array assembly."""
+import numpy as np
+
+from transmogrifai_tpu.parallel import (
+    dcn_data_spec,
+    global_column_stats,
+    host_row_slice,
+    initialize_distributed,
+    make_global_array,
+    make_multihost_mesh,
+)
+
+
+def test_initialize_noop_single_process():
+    initialize_distributed()  # must not raise with no coordinator
+
+
+def test_multihost_mesh_axes():
+    mesh = make_multihost_mesh()
+    assert mesh.axis_names == ("dcn", "data", "model")
+    assert mesh.shape["dcn"] == 1  # single process
+    assert mesh.shape["data"] == 8
+
+
+def test_host_row_slice_partitions_everything():
+    s = host_row_slice(103)
+    assert s == slice(0, 103)  # single process owns all rows
+
+
+def test_make_global_array_round_trip(rng):
+    mesh = make_multihost_mesh()
+    x = rng.normal(size=(64, 5)).astype(np.float32)
+    g = make_global_array(x, mesh, 64)
+    assert g.shape == (64, 5)
+    np.testing.assert_allclose(np.asarray(g), x, rtol=1e-6)
+    # sharded over (dcn, data) jointly
+    assert g.sharding.spec == dcn_data_spec(None)
+
+
+def test_global_column_stats_match_numpy(rng):
+    mesh = make_multihost_mesh()
+    x = rng.normal(size=(64, 7)) * 3 + 1
+    stats = global_column_stats(x.astype(np.float32), mesh, 64)
+    assert stats["count"] == 64
+    np.testing.assert_allclose(stats["mean"], x.mean(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        stats["var"], x.var(0), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_global_column_stats_excludes_padding(rng):
+    # 103 rows on an 8-device mesh: 1 padding row must not skew stats
+    mesh = make_multihost_mesh()
+    x = rng.normal(size=(103, 3)) + 5
+    stats = global_column_stats(x.astype(np.float32), mesh, 103)
+    assert stats["count"] == 103
+    np.testing.assert_allclose(stats["mean"], x.mean(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(stats["var"], x.var(0), rtol=1e-3, atol=1e-3)
+
+
+def test_global_column_stats_large_mean_column(rng):
+    # centered two-pass variance: |mean| >> std must not cancel
+    mesh = make_multihost_mesh()
+    x = (rng.normal(size=(64, 1)) * 1e3 + 1.7e9)
+    stats = global_column_stats(x.astype(np.float32), mesh, 64)
+    ref_var = x.astype(np.float32).astype(np.float64).var(0)
+    np.testing.assert_allclose(stats["var"], ref_var, rtol=0.05)
+
+
+def test_make_global_array_rejects_uneven_rows(rng):
+    import pytest
+
+    mesh = make_multihost_mesh()
+    with pytest.raises(ValueError, match="multiple of the total device"):
+        make_global_array(np.zeros((103, 2), np.float32), mesh, 103)

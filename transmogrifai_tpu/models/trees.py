@@ -250,7 +250,6 @@ def grow_tree_batched(
 
     if max_depth == 0:
         # root-only tree (legal Spark maxDepth=0): no splits, leaf = all rows
-        node0 = jnp.zeros((k_fits, n), dtype=jnp.int32)
         leaf_g0 = (g).sum(axis=1, keepdims=True)
         leaf_h0 = (h).sum(axis=1, keepdims=True)
         return Tree(

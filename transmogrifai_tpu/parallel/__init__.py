@@ -37,6 +37,16 @@ from .reductions import (  # noqa: F401
 )
 from .fit import data_parallel_fit, grid_parallel_fit  # noqa: F401
 from .ring import pad_cols, ring_corr, ring_gram, shard_cols  # noqa: F401
+from .multihost import (  # noqa: F401
+    DCN_AXIS,
+    dcn_data_spec,
+    global_column_stats,
+    host_row_slice,
+    initialize_distributed,
+    make_global_array,
+    make_multihost_mesh,
+    padded_rows,
+)
 from .segments import (  # noqa: F401
     aggregate_events_on_device,
     factorize_keys,

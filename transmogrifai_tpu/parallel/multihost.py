@@ -1,0 +1,255 @@
+"""Multi-host execution: the DCN-spanning distributed backend.
+
+Reference substrate: Apache Spark driver⇄executor RPC + shuffle + XGBoost's
+Rabit tracker (SURVEY.md §5.8). TPU-native replacement:
+
+  * control plane — `jax.distributed.initialize` (one process per host),
+    after which `jax.devices()` spans every host's chips;
+  * data plane — a global `Mesh` whose leading axis factors (dcn, ici):
+    collectives between chips on one host ride ICI, cross-host hops ride
+    DCN. `shard_map`/`pjit` programs written against
+    transmogrifai_tpu.parallel run unchanged — XLA routes `psum` over the
+    hierarchy;
+  * ingest — each host reads only its row block (`host_row_slice`), then
+    `make_global_array` assembles a globally-sharded array from per-host
+    locals without gathering anywhere.
+
+The monoid discipline (every estimator = map rows → commutative reduce)
+means nothing else changes for multi-host: the same `pcolumn_stats`/`pxtx`/
+`phistogram` reductions are correct whatever the mesh spans — that is WHY
+the reference's Spark shuffle maps onto plain psum (SURVEY.md §2.6).
+
+Row layout contract (shared by every helper here): the global row count is
+padded up to a multiple of the TOTAL device count; host h owns the padded
+block [h·chunk, (h+1)·chunk) with chunk = padded // n_hosts; padding rows
+live at the global tail and are excluded from statistics via a validity
+column, exactly like parallel.reductions.
+"""
+from __future__ import annotations
+
+import os
+from functools import lru_cache, partial
+
+import numpy as np
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+#: DCN (cross-host) mesh axis name — leading so cross-host traffic is the
+#: outermost collective dimension
+DCN_AXIS = "dcn"
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    auto: bool = False,
+) -> None:
+    """Bring up the cross-host control plane (idempotent).
+
+    Explicit arguments win; otherwise JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID are read from the environment. With
+    ``auto=True`` and nothing configured, `jax.distributed.initialize()` is
+    called bare so Cloud TPU pod metadata auto-detection can kick in (do
+    NOT set auto on single-machine setups — bare initialize errors there).
+    Single-process configurations without ``auto`` no-op.
+    """
+    import jax
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+
+    configured = coordinator_address is not None or (
+        num_processes is not None and num_processes > 1
+    )
+    if not configured and not auto:
+        return
+    try:
+        if configured:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            jax.distributed.initialize()  # Cloud TPU pod auto-detection
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e).lower():
+            raise
+
+
+def make_multihost_mesh(n_model: int = 1):
+    """A ("dcn", "data", "model") mesh over every device of every host.
+
+    Chips within one host form the ("data", "model") submesh (ICI); the
+    leading "dcn" axis spans hosts. Use `dcn_data_spec()` to shard rows over
+    BOTH host and chip axes; `psum` over ("dcn", "data") reduces globally.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices())
+    n_hosts = jax.process_count()
+    per_host = len(devices) // n_hosts
+    if per_host * n_hosts != len(devices):
+        raise RuntimeError(
+            f"uneven device counts: {len(devices)} devices / {n_hosts} hosts"
+        )
+    n_data = per_host // n_model
+    if n_data * n_model != per_host:
+        raise ValueError(
+            f"n_model={n_model} does not divide per-host device count {per_host}"
+        )
+    return Mesh(
+        devices.reshape(n_hosts, n_data, n_model),
+        (DCN_AXIS, DATA_AXIS, MODEL_AXIS),
+    )
+
+
+def dcn_data_spec(*trailing):
+    """PartitionSpec sharding rows over (dcn, data) jointly."""
+    from jax.sharding import PartitionSpec as P
+
+    return P((DCN_AXIS, DATA_AXIS), *trailing)
+
+
+def _total_devices(mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+
+def padded_rows(num_rows: int, mesh) -> int:
+    """num_rows rounded up to a multiple of the mesh's total device count
+    (the global row axis must divide evenly for (dcn, data) sharding)."""
+    t = _total_devices(mesh)
+    return (num_rows + t - 1) // t * t
+
+
+def host_row_slice(num_rows: int, mesh=None) -> slice:
+    """The half-open range of REAL rows this host should read.
+
+    Hosts own equal blocks of the PADDED row space (chunk = padded //
+    n_hosts, consistent with `make_global_array`'s (dcn, data) sharding);
+    the returned slice is that block clipped to the real rows — trailing
+    hosts may own fewer (or zero) real rows, with the remainder of their
+    block being padding.
+    """
+    import jax
+
+    n_hosts = jax.process_count()
+    pid = jax.process_index()
+    if mesh is not None:
+        chunk = padded_rows(num_rows, mesh) // n_hosts
+    else:
+        chunk = (num_rows + n_hosts - 1) // n_hosts
+    return slice(min(pid * chunk, num_rows), min((pid + 1) * chunk, num_rows))
+
+
+def make_global_array(local_rows: np.ndarray, mesh, num_rows: int):
+    """Assemble a globally row-sharded array from this host's row block.
+
+    ``num_rows`` must be a multiple of the mesh's total device count (use
+    `padded_rows`); ``local_rows`` must be this host's full block
+    (num_rows // n_hosts rows). No host ever holds the global array.
+    """
+    import jax
+
+    t = _total_devices(mesh)
+    if num_rows % t != 0:
+        raise ValueError(
+            f"num_rows={num_rows} must be a multiple of the total device "
+            f"count {t} — pad first (parallel.multihost.padded_rows)"
+        )
+    n_hosts = jax.process_count()
+    chunk = num_rows // n_hosts
+    if local_rows.shape[0] != chunk:
+        raise ValueError(
+            f"local block has {local_rows.shape[0]} rows, expected "
+            f"{chunk} (= padded num_rows // n_hosts)"
+        )
+    return jax.make_array_from_process_local_data(
+        jax.sharding.NamedSharding(
+            mesh, dcn_data_spec(*([None] * (local_rows.ndim - 1)))
+        ),
+        local_rows,
+        global_shape=(num_rows, *local_rows.shape[1:]),
+    )
+
+
+# jitted kernels are built once per mesh (see parallel.reductions — a fresh
+# closure + jit per call would retrace and recompile on every stats call)
+@lru_cache(maxsize=None)
+def _global_stats_kernels(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = (DCN_AXIS, DATA_AXIS)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(dcn_data_spec(None),),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def pass1(xs):
+        v = xs[:, -1:]
+        cnt = jax.lax.psum(v.sum(), axes)
+        s = jax.lax.psum((xs[:, :-1] * v).sum(axis=0), axes)
+        return cnt, s
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(dcn_data_spec(None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def pass2(xs, mean):
+        v = xs[:, -1:]
+        c = (xs[:, :-1] - mean[None, :]) * v
+        return jax.lax.psum((c * c).sum(axis=0), axes)
+
+    return jax.jit(pass1), jax.jit(pass2)
+
+
+def global_column_stats(x_local: np.ndarray, mesh, num_rows: int) -> dict:
+    """Per-column count/mean/var across hosts: per-host row blocks in,
+    global statistics out.
+
+    ``x_local`` is this host's REAL rows (`host_row_slice(num_rows, mesh)`);
+    padding to the sharded block size plus the validity column are handled
+    here, and the variance uses the same two-pass centered-M2 scheme as
+    `parallel.reductions.pcolumn_stats` (raw-moment variance cancels
+    catastrophically in float32). Cross-host traffic is one psum of the
+    per-column partials per pass — never the data.
+    """
+    import jax
+
+    n_hosts = jax.process_count()
+    padded = padded_rows(num_rows, mesh)
+    chunk = padded // n_hosts
+    x_local = np.asarray(x_local, dtype=np.float32)
+    f = x_local.shape[1]
+    block = np.zeros((chunk, f + 1), dtype=np.float32)
+    block[: len(x_local), :f] = x_local
+    block[: len(x_local), f] = 1.0  # validity — padding rows stay 0
+
+    xg = make_global_array(block, mesh, padded)
+    pass1, pass2 = _global_stats_kernels(mesh)
+    cnt, s = pass1(xg)
+    cnt_f = float(np.asarray(cnt))
+    mean = np.asarray(s, dtype=np.float64) / max(cnt_f, 1.0)
+    m2 = np.asarray(pass2(xg, mean.astype(np.float32)), dtype=np.float64)
+    return {
+        "count": cnt_f,
+        "mean": mean,
+        "var": m2 / max(cnt_f, 1.0),
+    }
