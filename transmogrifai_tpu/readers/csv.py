@@ -59,7 +59,10 @@ def _read_table(
     """Shared CSV parse: (column names, body rows). Missing trailing cells in
     short rows are treated as empty."""
     with open(path, newline="", encoding="utf-8") as fh:
-        rows = list(_csv.reader(fh))
+        # physically blank lines are ignored (Spark CSV semantics; a
+        # trailing newline must not surface as an all-missing row) — but
+        # ',,,' all-empty RECORDS are kept
+        rows = [r for r in _csv.reader(fh) if r]
     if not rows:
         return [], []
     if has_header is None:
