@@ -160,7 +160,9 @@ def grow_tree_batched(
         chunk_cap &= chunk_cap - 1
     chunk_cap = min(chunk_cap, cap)
     if use_fused:
-        # the [T, M] one-hot temporaries are the only big VMEM tenants
+        # the [T, M] one-hot temporaries are the only big VMEM tenants;
+        # M=512 at T=896 was measured to overflow scoped VMEM on v5e —
+        # 256 is the validated ceiling
         n_pad = (n + 127) // 128 * 128
         m_cap = max(8, min(256, (1 << 18) // max(n_pad, 128)))
         while m_cap & (m_cap - 1):
