@@ -270,3 +270,34 @@ class TestBatchedGridFits:
             pm, _, _ = m.predict_arrays(x)
             ps, _, _ = s.predict_arrays(x)
             np.testing.assert_allclose(np.asarray(pm), np.asarray(ps), atol=1e-4)
+
+
+def test_fori_chunk_path_matches_unrolled(rng):
+    """Large chunk counts take a shared fori body (program-size bound);
+    results must match the small-count Python-unrolled branch exactly."""
+    import transmogrifai_tpu.models.trees as TR
+    import jax.numpy as jnp
+
+    n, f, b, depth, k_fits = 600, 2000, 32, 9, 32
+    binned = jnp.asarray(rng.integers(0, b, (n, f)).astype(np.int32))
+    g1 = -rng.normal(size=n).astype(np.float32)
+    g = np.tile(g1[None, :], (k_fits, 1))
+    ones = np.ones((k_fits, n), np.float32)
+    tK = TR.grow_tree_batched(
+        binned, jnp.asarray(g), jnp.asarray(ones), jnp.asarray(ones),
+        jnp.asarray(np.ones((k_fits, f), np.float32)),
+        max_depth=depth, num_bins=b,
+    )  # K=32 shrinks the chunk budget below 8 chunks -> fori branch
+    t1 = TR.grow_tree(
+        binned, jnp.asarray(g1), jnp.ones(n), jnp.ones(n), jnp.ones(f),
+        max_depth=depth, num_bins=b,
+    )  # K=1 -> Python-unrolled branch
+    for name in ("split_feat", "split_bin"):
+        arr = np.asarray(getattr(tK, name))
+        ref = np.asarray(getattr(t1, name))
+        np.testing.assert_array_equal(arr[0], ref, err_msg=name)
+        np.testing.assert_array_equal(arr[-1], ref, err_msg=name)
+    leaf = np.asarray(tK.leaf_value)
+    leaf_ref = np.asarray(t1.leaf_value)
+    np.testing.assert_allclose(leaf[0], leaf_ref, atol=1e-4, err_msg="leaf")
+    np.testing.assert_allclose(leaf[-1], leaf_ref, atol=1e-4, err_msg="leaf")

@@ -278,13 +278,37 @@ def grow_tree_batched(
             local = node
             compacted = False
 
-        cfs, cbs = [], []
-        for ci in range(num_chunks):
-            cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
-            cfs.append(cf)
-            cbs.append(cb)
-        feats_c = jnp.concatenate(cfs, axis=1)[:, :n_nodes]  # [K, n_nodes]
-        bins_c = jnp.concatenate(cbs, axis=1)[:, :n_nodes]
+        if num_chunks <= 8:
+            cfs, cbs = [], []
+            for ci in range(num_chunks):
+                cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
+                cfs.append(cf)
+                cbs.append(cb)
+            feats_c = jnp.concatenate(cfs, axis=1)[:, :n_nodes]
+            bins_c = jnp.concatenate(cbs, axis=1)[:, :n_nodes]
+        else:
+            # many chunks (large-N two-phase path): a shared fori body keeps
+            # the program size bounded — Python-unrolling 100+ chunk bodies
+            # per level explodes trace/compile time
+            def chunk_body(ci, fb):
+                feats_a, bins_a = fb
+                cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
+                return (
+                    jax.lax.dynamic_update_slice(feats_a, cf, (0, ci * chunk_nodes)),
+                    jax.lax.dynamic_update_slice(bins_a, cb, (0, ci * chunk_nodes)),
+                )
+
+            feats_a0 = jnp.full(
+                (k_fits, num_chunks * chunk_nodes), -1, dtype=jnp.int32
+            )
+            bins_a0 = jnp.zeros(
+                (k_fits, num_chunks * chunk_nodes), dtype=jnp.int32
+            )
+            feats_c, bins_c = jax.lax.fori_loop(
+                0, num_chunks, chunk_body, (feats_a0, bins_a0)
+            )
+            feats_c = feats_c[:, :n_nodes]  # [K, n_nodes]
+            bins_c = bins_c[:, :n_nodes]
 
         # write per-slot decisions into the GLOBAL node-slot tree arrays
         if compacted:
