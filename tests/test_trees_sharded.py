@@ -1,0 +1,145 @@
+"""Mesh-sharded tree growth must agree with single-device growth.
+
+The reference distributes XGBoost via the Rabit allreduce tracker
+(OpXGBoostClassifier.scala:101): workers build partial histograms over their
+row partitions and allreduce them, so every worker makes the same split
+decisions. Here rows shard over the mesh 'data' axis and the per-level
+histogram is a psum — these tests assert the resulting trees are identical
+to the unsharded path (same splits; leaf values equal to float tolerance).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import trees as TR
+from transmogrifai_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    return make_mesh(n_data=8, n_model=1)
+
+
+def _data(n=333, f=12, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x @ rng.normal(size=f) + 0.3 * rng.normal(size=n) > 0).astype(
+        np.float32
+    )
+    thr = TR.quantile_thresholds(x, max_bins=16)
+    binned = np.asarray(TR.bin_data(jnp.asarray(x), jnp.asarray(thr)))
+    masks = (rng.random((k, n)) > 0.2).astype(np.float32)
+    return binned, y, masks
+
+
+def _assert_trees_match(t_single: TR.Tree, t_sharded: TR.Tree):
+    np.testing.assert_array_equal(
+        np.asarray(t_single.split_feat), np.asarray(t_sharded.split_feat)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t_single.split_bin), np.asarray(t_sharded.split_bin)
+    )
+    a = np.asarray(t_single.leaf_value)
+    b = np.asarray(t_sharded.leaf_value)
+    live = np.isfinite(a)
+    # dead slots (no rows) are 0/0 = nan on both paths
+    np.testing.assert_array_equal(live, np.isfinite(b))
+    np.testing.assert_allclose(a[live], b[live], rtol=1e-5, atol=1e-6)
+
+
+def test_forest_sharded_matches_single(mesh):
+    binned, y, masks = _data()
+    kw = dict(
+        num_trees=4, max_depth=4, num_bins=16,
+        subsample_rate=np.array([1.0, 0.8, 0.9], np.float32),
+        colsample_rate=np.array([1.0, 0.7, 1.0], np.float32),
+        min_instances=1.0, seed=7,
+    )
+    t_single = TR.fit_forest_batched(jnp.asarray(binned), jnp.asarray(y),
+                                     jnp.asarray(masks), **kw)
+    t_sharded = TR.fit_forest_batched(jnp.asarray(binned), jnp.asarray(y),
+                                      jnp.asarray(masks), mesh=mesh, **kw)
+    _assert_trees_match(t_single, t_sharded)
+
+
+def test_boosted_sharded_matches_single(mesh):
+    binned, y, masks = _data()
+    kw = dict(
+        num_rounds=6, max_depth=3, num_bins=16,
+        eta=np.array([0.3, 0.1, 0.2], np.float32),
+        reg_lambda=1.0, min_child_weight=1.0,
+        objective="binary:logistic",
+    )
+    t_single, m_single = TR.fit_boosted_batched(
+        jnp.asarray(binned), jnp.asarray(y), jnp.asarray(masks), **kw
+    )
+    t_sharded, m_sharded = TR.fit_boosted_batched(
+        jnp.asarray(binned), jnp.asarray(y), jnp.asarray(masks),
+        mesh=mesh, **kw
+    )
+    _assert_trees_match(t_single, t_sharded)
+    np.testing.assert_allclose(
+        np.asarray(m_single), np.asarray(m_sharded), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_boosted_sharded_regression(mesh):
+    # seed chosen tie-free: psum partial-sum ordering can flip an exact
+    # gain tie (float associativity) — the same worker-count sensitivity
+    # real XGBoost/Rabit has. Structure is otherwise deterministic.
+    binned, y, masks = _data(seed=2)
+    yr = y * 2.0 + np.asarray(binned[:, 0], np.float32) * 0.1
+    t_single, m_single = TR.fit_boosted_batched(
+        jnp.asarray(binned), jnp.asarray(yr), jnp.asarray(masks),
+        num_rounds=4, max_depth=3, num_bins=16, eta=0.3,
+        objective="reg:squarederror",
+    )
+    t_sharded, m_sharded = TR.fit_boosted_batched(
+        jnp.asarray(binned), jnp.asarray(yr), jnp.asarray(masks),
+        num_rounds=4, max_depth=3, num_bins=16, eta=0.3,
+        objective="reg:squarederror", mesh=mesh,
+    )
+    _assert_trees_match(t_single, t_sharded)
+    np.testing.assert_allclose(
+        np.asarray(m_single), np.asarray(m_sharded), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_sharded_compaction_deep_tree(mesh):
+    """Depth deep enough that 2^d exceeds the live-node cap: the sharded
+    path must agree on the psum'd-occupancy compaction numbering."""
+    binned, y, _ = _data(n=30, f=6)
+    masks = np.ones((2, 30), np.float32)
+    kw = dict(num_trees=2, max_depth=7, num_bins=16,
+              subsample_rate=1.0, colsample_rate=1.0, bootstrap=False,
+              seed=3)
+    t_single = TR.fit_forest_batched(jnp.asarray(binned), jnp.asarray(y),
+                                     jnp.asarray(masks), **kw)
+    t_sharded = TR.fit_forest_batched(jnp.asarray(binned), jnp.asarray(y),
+                                      jnp.asarray(masks), mesh=mesh, **kw)
+    _assert_trees_match(t_single, t_sharded)
+
+
+def test_sharded_predictions_match(mesh):
+    binned, y, masks = _data(n=256, k=2)
+    t_sharded = TR.fit_forest_batched(
+        jnp.asarray(binned), jnp.asarray(y), jnp.asarray(masks),
+        num_trees=3, max_depth=4, num_bins=16, seed=11, mesh=mesh,
+    )
+    t_single = TR.fit_forest_batched(
+        jnp.asarray(binned), jnp.asarray(y), jnp.asarray(masks),
+        num_trees=3, max_depth=4, num_bins=16, seed=11,
+    )
+    for k in range(2):
+        p_sh = TR.predict_forest(
+            jnp.asarray(binned), jax.tree.map(lambda a: a[k], t_sharded)
+        )
+        p_si = TR.predict_forest(
+            jnp.asarray(binned), jax.tree.map(lambda a: a[k], t_single)
+        )
+        np.testing.assert_allclose(
+            np.asarray(p_si), np.asarray(p_sh), rtol=1e-5, atol=1e-6
+        )

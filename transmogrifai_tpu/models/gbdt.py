@@ -4,8 +4,10 @@ Reference stages replaced (behavioral parity on the histogram learner in
 models/trees.py):
   * OpXGBoostClassifier/Regressor (core/.../classification/OpXGBoostClassifier.scala
     — JNI libxgboost + Rabit allreduce): XLA boosting with second-order
-    gradients; the per-level histogram reduction rides the mesh instead of
-    Rabit.
+    gradients; pass ``mesh=`` to the trees.fit_* entry points to shard rows
+    over the mesh data axis with per-level histograms psum'd over ICI
+    (trees._sharded_boost_kernel — the Rabit replacement, proven
+    tree-identical in tests/test_trees_sharded.py).
   * OpGBTClassifier/Regressor (Spark GBT; defaults maxIter 20, stepSize 0.1).
   * OpRandomForestClassifier/Regressor (Spark RF; defaults numTrees 50 in
     selector grids, maxDepth 5 spark default).

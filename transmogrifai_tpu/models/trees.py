@@ -25,7 +25,7 @@ forest leaves) pass g = -target, h = 1: the leaf value becomes mean(target).
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -107,6 +107,41 @@ def grow_tree_batched(
     custom call (which crashes this TPU runtime), so the entire candidate
     sweep's tree growth runs as one compiled program. Returned Tree arrays
     carry a leading K axis."""
+    return _grow_tree_impl(
+        binned, grad, hess, row_mask, feat_mask,
+        max_depth=max_depth, num_bins=num_bins,
+        reg_lambda=reg_lambda, gamma=gamma,
+        min_child_weight=min_child_weight, min_info_gain=min_info_gain,
+        hist_impl=hist_impl, lowp=lowp,
+    )
+
+
+def _grow_tree_impl(
+    binned: jax.Array,     # [N_local, F] int32 codes, SHARED across fits
+    grad: jax.Array,       # [K, N_local] float32
+    hess: jax.Array,       # [K, N_local] float32
+    row_mask: jax.Array,   # [K, N_local] float32
+    feat_mask: jax.Array,  # [K, F] float32
+    max_depth: int,
+    num_bins: int,
+    reg_lambda: jax.Array | float = 1.0,
+    gamma: jax.Array | float = 0.0,
+    min_child_weight: jax.Array | float = 1.0,
+    min_info_gain: jax.Array | float = 0.0,
+    hist_impl: str | None = None,
+    lowp: bool = False,
+    axis_name: str | None = None,
+    axis_size: int = 1,
+) -> Tree:
+    """Tree-growth body shared by the single-device jit wrapper and the
+    shard_map'd path. With ``axis_name`` set, the function runs per-shard
+    inside shard_map: rows are the LOCAL shard, each level's histogram is
+    psum'd over the mesh axis before the split search, node compaction uses
+    a psum'd global occupancy mask, and leaf sums are psum'd — the direct
+    ICI replacement for XGBoost's Rabit allreduce of per-worker histograms
+    (reference OpXGBoostClassifier.scala:101, SURVEY §2.6 row 5). Split
+    decisions consume the same reduced histogram either way, so sharded and
+    single-device growth produce the same tree."""
     from .hist_pallas import (
         FUSED_SPLIT_MAX_ROWS,
         build_best_split_pallas,
@@ -136,11 +171,13 @@ def grow_tree_batched(
     # are LIVE (every live slot holds ≥1 row), so histograms are built over
     # a compact slot space of ``cap`` ids instead of the full 2^d range —
     # depth-12 growth on 1k rows costs the same as depth-10 (the dominant
-    # win for the deep ends of the reference's maxDepth {3,6,12} grids)
+    # win for the deep ends of the reference's maxDepth {3,6,12} grids).
+    # When sharded, the live bound is the GLOBAL row count.
+    n_global = n * axis_size
     cap = max_nodes
-    if cap > n:
+    if cap > n_global:
         cap = 1
-        while cap < n:
+        while cap < n_global:
             cap <<= 1
         cap = min(cap, max_nodes)
     compact = cap < max_nodes
@@ -148,8 +185,14 @@ def grow_tree_batched(
     # fused split search: gains + arg-best computed inside the kernel while
     # histograms are VMEM-resident — nothing [M, F, B]-sized touches HBM.
     # Only possible when every row fits one VMEM tile and the bins fit the
-    # kernel's 128-lane packing.
-    use_fused = impl == "pallas" and n <= FUSED_SPLIT_MAX_ROWS and b <= 128
+    # kernel's 128-lane packing. The sharded path needs the raw histogram
+    # for the cross-shard psum, so it always takes the two-step path.
+    use_fused = (
+        impl == "pallas"
+        and axis_name is None
+        and n <= FUSED_SPLIT_MAX_ROWS
+        and b <= 128
+    )
 
     # per-chunk histogram memory scales with K — shrink the node chunk so
     # [K, chunk, F, B, 2] stays inside the HBM budget (the Spark
@@ -205,6 +248,10 @@ def grow_tree_batched(
             hist = build_histogram_scatter_batched(
                 binned, loc, g, h, chunk_nodes, b
             )
+        if axis_name is not None:
+            # the Rabit-allreduce moment: per-shard partial histograms
+            # reduce over ICI; everything after sees the global histogram
+            hist = jax.lax.psum(hist, axis_name)
         hg, hh = hist[..., 0], hist[..., 1]  # [K, M, F, B]
 
         gl = jnp.cumsum(hg, axis=3)[..., :-1]
@@ -254,6 +301,9 @@ def grow_tree_batched(
         # root-only tree (legal Spark maxDepth=0): no splits, leaf = all rows
         leaf_g0 = (g).sum(axis=1, keepdims=True)
         leaf_h0 = (h).sum(axis=1, keepdims=True)
+        if axis_name is not None:
+            leaf_g0 = jax.lax.psum(leaf_g0, axis_name)
+            leaf_h0 = jax.lax.psum(leaf_h0, axis_name)
         return Tree(
             split_feat=jnp.full((k_fits, 0, 1), -1, dtype=jnp.int32),
             split_bin=jnp.zeros((k_fits, 0, 1), dtype=jnp.int32),
@@ -272,7 +322,24 @@ def grow_tree_batched(
         num_chunks = (n_nodes + chunk_nodes - 1) // chunk_nodes
 
         if compact and (1 << d) > cap:
-            uids, local = jax.vmap(compact_ids)(node)  # [K, cap], [K, N]
+            if axis_name is None:
+                uids, local = jax.vmap(compact_ids)(node)  # [K, cap], [K, N]
+            else:
+                # global compaction: every shard must agree on the live-slot
+                # numbering, so derive it from a psum'd occupancy mask (same
+                # sorted-unique-ids result as compact_ids, but global)
+                occ = jax.vmap(
+                    lambda nd: jnp.zeros(max_nodes, jnp.int32).at[nd].add(
+                        1, mode="drop"
+                    )
+                )(node)
+                occ = jax.lax.psum(occ, axis_name)
+                ids = jnp.arange(max_nodes, dtype=jnp.int32)
+                live = jnp.where(occ > 0, ids[None, :], sentinel)
+                uids = jnp.sort(live, axis=1)[:, :cap]  # [K, cap]
+                local = jax.vmap(
+                    lambda u, nd: jnp.searchsorted(u, nd).astype(jnp.int32)
+                )(uids, node)
             compacted = True
         else:
             local = node
@@ -351,6 +418,9 @@ def grow_tree_batched(
     leaf_h = jax.vmap(
         lambda nd, hk: jnp.zeros(max_nodes, dtype=jnp.float32).at[nd].add(hk)
     )(node, h)
+    if axis_name is not None:
+        leaf_g = jax.lax.psum(leaf_g, axis_name)
+        leaf_h = jax.lax.psum(leaf_h, axis_name)
     leaf_value = -leaf_g / (leaf_h + vec(reg_lambda)[:, None])
     return Tree(split_feat=feats, split_bin=bins, leaf_value=leaf_value)
 
@@ -429,18 +499,12 @@ def predict_boosted_raw(
     return base_score + eta * preds.sum(axis=0)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("max_depth", "num_bins", "bootstrap", "lowp"),
-)
-def _forest_tree_batched(
-    binned, target, row_mask, tkey, sub, col, min_instances, min_info_gain,
-    max_depth, num_bins, bootstrap, lowp,
-) -> Tree:
-    """One bagged tree for all K fits (one compiled program, reused per
-    tree by the host loop in fit_forest_batched)."""
-    k_fits, n = row_mask.shape
-    f = binned.shape[1]
+@partial(jax.jit, static_argnames=("n", "f", "bootstrap"))
+def _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap):
+    """Bootstrap row counts + feature masks for one tree across K fits.
+    Drawn over the UNPADDED row count so the sharded path (which pads rows
+    afterwards) samples bit-identically to the single-device path."""
+    k_fits = row_mask.shape[0]
     k1, k2 = jax.random.split(tkey)
     if bootstrap:
         # same key for every fit, drawn per-fit (vmap): each lane's sample
@@ -458,6 +522,22 @@ def _forest_tree_batched(
     fmask = jnp.where(
         fmask.sum(axis=1, keepdims=True) == 0, jnp.ones((1, f)), fmask
     )
+    return rmask, fmask
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "num_bins", "bootstrap", "lowp"),
+)
+def _forest_tree_batched(
+    binned, target, row_mask, tkey, sub, col, min_instances, min_info_gain,
+    max_depth, num_bins, bootstrap, lowp,
+) -> Tree:
+    """One bagged tree for all K fits (one compiled program, reused per
+    tree by the host loop in fit_forest_batched)."""
+    k_fits, n = row_mask.shape
+    f = binned.shape[1]
+    rmask, fmask = _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap)
     gb = jnp.broadcast_to(-target[None, :], (k_fits, n))
     return grow_tree_batched(
         binned,
@@ -489,13 +569,18 @@ def fit_forest_batched(
     seed: int = 42,
     bootstrap: bool = True,
     lowp: bool = False,
+    mesh=None,
 ) -> Tree:
     """K random forests batched over the fit axis: tree t of every fit grows
     in one program (grow_tree_batched — fit axis = histogram-kernel grid
     axis); the TREE loop runs on host, reusing that one compiled program per
     dispatch. A single fused 50-tree × K-fit program was observed to bring
     down the TPU runtime worker, and buys nothing — each tree's histogram
-    build already fills the chip. Returns stacked Tree arrays [K, T, ...]."""
+    build already fills the chip. Returns stacked Tree arrays [K, T, ...].
+
+    With ``mesh`` set, rows shard over the mesh's data axis and each level's
+    histogram psums over it (grows the same trees as the unsharded path —
+    see _grow_tree_impl)."""
     k_fits, n = row_mask.shape
     key = jax.random.PRNGKey(seed)
     tkeys = jax.random.split(key, num_trees)
@@ -507,6 +592,12 @@ def fit_forest_batched(
     )
     mi = jnp.asarray(min_instances, dtype=jnp.float32)
     mg = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    if mesh is not None:
+        return _fit_forest_batched_sharded(
+            mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
+            num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
+            bootstrap=bootstrap, lowp=lowp,
+        )
     trees = [
         _forest_tree_batched(
             binned, target, row_mask, tkeys[t], sub, col, mi, mg,
@@ -580,22 +671,21 @@ def predict_boosted(
     return base_score + eta * preds.sum(axis=0)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("max_depth", "num_bins", "num_rounds", "objective"),
-)
-def _boost_rounds_batched(
+def _boost_chunk_body(
     binned, y, row_mask, margin0, eta_v, reg_lambda, gamma,
     min_child_weight, min_info_gain,
     num_rounds, max_depth, num_bins, objective,
+    axis_name=None, axis_size=1,
 ) -> tuple[Tree, jax.Array]:
     """A chunk of boosting rounds for all K fits (lax.scan inside one
-    program; the host loop in fit_boosted_batched chains chunks)."""
+    program) — shared by the single-device jit and the shard_map'd path
+    (axis_name set: per-level histograms psum over the mesh axis; margins,
+    gradients and predictions stay row-local)."""
     k_fits, n = row_mask.shape
     f = binned.shape[1]
     feat_mask = jnp.ones((k_fits, f), dtype=jnp.float32)
 
-    def grads(margin):  # [K, N]
+    def grads(margin):  # [K, N_local]
         if objective == "binary:logistic":
             p = jax.nn.sigmoid(margin)
             return p - y[None, :], p * (1.0 - p)
@@ -603,11 +693,12 @@ def _boost_rounds_batched(
 
     def round_step(margin, _):
         g, h = grads(margin)
-        tree = grow_tree_batched(
+        tree = _grow_tree_impl(
             binned, g, h, row_mask, feat_mask,
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
+            axis_name=axis_name, axis_size=axis_size,
         )
         step = jax.vmap(lambda t: predict_tree(binned, t))(tree)  # [K, N]
         margin = margin + eta_v[:, None] * step
@@ -615,6 +706,15 @@ def _boost_rounds_batched(
 
     margin, trees = jax.lax.scan(round_step, margin0, None, length=num_rounds)
     return trees, margin  # trees [R, K, ...]
+
+
+_boost_rounds_batched = partial(
+    jax.jit,
+    static_argnames=(
+        "num_rounds", "max_depth", "num_bins", "objective",
+        "axis_name", "axis_size",
+    ),
+)(_boost_chunk_body)
 
 
 #: boosting rounds per compiled program — keeps any one program's size
@@ -637,11 +737,16 @@ def fit_boosted_batched(
     min_info_gain: jax.Array | float = 0.0,
     base_score: jax.Array | float = 0.0,
     objective: str = "binary:logistic",
+    mesh=None,
 ) -> tuple[Tree, jax.Array]:
     """K boosting runs batched over the fit axis: every round grows all K
     trees in one histogram build; rounds scan in fixed-size chunks so each
     compiled program stays modest. Returns Tree arrays [K, R, ...] and the
-    training margins [K, N]."""
+    training margins [K, N].
+
+    With ``mesh`` set, rows shard over the mesh's data axis: gradients and
+    margins live sharded, per-level histograms psum over ICI, and trees come
+    back replicated — the Rabit-tracker topology with XLA collectives."""
     k_fits, n = row_mask.shape
     eta_v = jnp.broadcast_to(
         jnp.asarray(eta, dtype=jnp.float32).reshape(-1), (k_fits,)
@@ -650,6 +755,12 @@ def fit_boosted_batched(
     gam = jnp.asarray(gamma, dtype=jnp.float32)
     mcw = jnp.asarray(min_child_weight, dtype=jnp.float32)
     mig = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    if mesh is not None:
+        return _fit_boosted_batched_sharded(
+            mesh, binned, y, row_mask, eta_v, lam, gam, mcw, mig,
+            base_score=base_score, num_rounds=num_rounds,
+            max_depth=max_depth, num_bins=num_bins, objective=objective,
+        )
     margin = jnp.broadcast_to(
         jnp.asarray(base_score, dtype=jnp.float32).reshape(-1, 1), (k_fits, n)
     ).astype(jnp.float32)
@@ -667,3 +778,166 @@ def fit_boosted_batched(
     trees = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *chunks)
     # trees: [R, K, ...] -> [K, R, ...]
     return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees), margin
+
+
+# --------------------------------------------------------------------------
+# mesh-sharded growth: rows shard over the data axis; per-level histograms
+# psum over ICI — the XLA-collective replacement for XGBoost's Rabit
+# allreduce of per-worker histograms (OpXGBoostClassifier.scala:101,
+# SURVEY §2.6 row 5). The split search consumes the reduced histogram
+# identically, so the sharded path grows the SAME trees as single-device.
+# --------------------------------------------------------------------------
+def _pad_axis(a: jax.Array, axis: int, multiple: int) -> jax.Array:
+    """Zero-pad ``axis`` to a multiple (static shard shapes). Zero rows are
+    inert in growth: row_mask 0 drops them from histograms and leaf sums."""
+    pad = (-a.shape[axis]) % multiple
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths)
+
+
+@lru_cache(maxsize=None)
+def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp):
+    """jit(shard_map(grow)) for one (mesh, statics) combo, built once —
+    rebuilding per call would retrace every tree."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    size = mesh.shape[DATA_AXIS]
+
+    def body(binned, grad, hess, row_mask, feat_mask, lam, gam, mcw, mig):
+        return _grow_tree_impl(
+            binned, grad, hess, row_mask, feat_mask,
+            max_depth=max_depth, num_bins=num_bins,
+            reg_lambda=lam, gamma=gam, min_child_weight=mcw,
+            min_info_gain=mig, hist_impl=hist_impl, lowp=lowp,
+            axis_name=DATA_AXIS, axis_size=size,
+        )
+
+    rep = P()
+    sm = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None),   # binned [N, F]
+            P(None, DATA_AXIS),   # grad [K, N]
+            P(None, DATA_AXIS),   # hess
+            P(None, DATA_AXIS),   # row_mask
+            rep, rep, rep, rep, rep,
+        ),
+        out_specs=Tree(split_feat=rep, split_bin=rep, leaf_value=rep),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+def _fit_forest_batched_sharded(
+    mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
+    num_trees, max_depth, num_bins, bootstrap, lowp,
+) -> Tree:
+    from ..parallel.mesh import DATA_AXIS
+
+    size = mesh.shape[DATA_AXIS]
+    k_fits, n = row_mask.shape
+    f = binned.shape[1]
+    binned_p = _pad_axis(jnp.asarray(binned, jnp.int32), 0, size)
+    target_p = _pad_axis(jnp.asarray(target, jnp.float32), 0, size)
+    n_pad = binned_p.shape[0]
+    rm = jnp.asarray(row_mask, jnp.float32)
+    kern = _sharded_grow_kernel(mesh, max_depth, num_bins, None, lowp)
+    zero = jnp.zeros(1, jnp.float32)
+    mi = jnp.asarray(mi, jnp.float32).reshape(-1)
+    mg = jnp.asarray(mg, jnp.float32).reshape(-1)
+    gb = jnp.broadcast_to(-target_p[None, :], (k_fits, n_pad))
+    ones = jnp.ones((k_fits, n_pad), jnp.float32)
+    trees = []
+    for t in range(num_trees):
+        # masks drawn over the UNPADDED n — bit-identical to the
+        # single-device draw — then padded with zeros
+        rmask_t, fmask_t = _bag_masks(
+            tkeys[t], sub, col, rm, n=n, f=f, bootstrap=bootstrap
+        )
+        trees.append(
+            kern(
+                binned_p, gb, ones, _pad_axis(rmask_t, 1, size), fmask_t,
+                zero, zero, mi, mg,
+            )
+        )
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *trees)
+
+
+@lru_cache(maxsize=None)
+def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective):
+    """jit(shard_map(boost-round-chunk)): margins stay row-sharded across
+    the scan; each round's histogram build psums over the data axis."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    size = mesh.shape[DATA_AXIS]
+
+    def body(binned, y, row_mask, margin0, eta_v, lam, gam, mcw, mig):
+        return _boost_chunk_body(
+            binned, y, row_mask, margin0, eta_v, lam, gam, mcw, mig,
+            num_rounds=num_rounds, max_depth=max_depth, num_bins=num_bins,
+            objective=objective, axis_name=DATA_AXIS, axis_size=size,
+        )
+
+    rep = P()
+    sm = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None),   # binned
+            P(DATA_AXIS),         # y
+            P(None, DATA_AXIS),   # row_mask
+            P(None, DATA_AXIS),   # margin0
+            rep, rep, rep, rep, rep,
+        ),
+        out_specs=(
+            Tree(split_feat=rep, split_bin=rep, leaf_value=rep),
+            P(None, DATA_AXIS),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+def _fit_boosted_batched_sharded(
+    mesh, binned, y, row_mask, eta_v, lam, gam, mcw, mig,
+    base_score, num_rounds, max_depth, num_bins, objective,
+) -> tuple[Tree, jax.Array]:
+    from ..parallel.mesh import DATA_AXIS
+
+    size = mesh.shape[DATA_AXIS]
+    k_fits, n = row_mask.shape
+    binned_p = _pad_axis(jnp.asarray(binned, jnp.int32), 0, size)
+    y_p = _pad_axis(jnp.asarray(y, jnp.float32), 0, size)
+    rm_p = _pad_axis(jnp.asarray(row_mask, jnp.float32), 1, size)
+    n_pad = binned_p.shape[0]
+    margin = jnp.broadcast_to(
+        jnp.asarray(base_score, dtype=jnp.float32).reshape(-1, 1),
+        (k_fits, n_pad),
+    ).astype(jnp.float32)
+    lam = jnp.asarray(lam, jnp.float32).reshape(-1)
+    gam = jnp.asarray(gam, jnp.float32).reshape(-1)
+    mcw = jnp.asarray(mcw, jnp.float32).reshape(-1)
+    mig = jnp.asarray(mig, jnp.float32).reshape(-1)
+    chunks = []
+    done = 0
+    while done < num_rounds:
+        rc = min(_BOOST_ROUND_CHUNK, num_rounds - done)
+        kern = _sharded_boost_kernel(mesh, rc, max_depth, num_bins, objective)
+        trees_c, margin = kern(
+            binned_p, y_p, rm_p, margin, eta_v, lam, gam, mcw, mig
+        )
+        chunks.append(trees_c)
+        done += rc
+    trees = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *chunks)
+    trees = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees)
+    return trees, margin[:, :n]
