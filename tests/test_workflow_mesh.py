@@ -1,0 +1,78 @@
+"""Mesh-as-default execution: the workflow's selector sweep must produce
+the same result sharded over the 8-device mesh as single-device.
+
+Reference parity: every Spark stage is row-partitioned by construction
+(FitStagesUtil.scala:96-118) and partition count never changes results.
+Here Workflow.train installs the ambient execution mesh; these tests A/B
+`set_parallelism(None)` (plain jit) against the 8-device mesh and assert
+the selector picks the same model with (near-)identical metrics/scores.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.models.gbdt import XGBoostClassifier
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.readers import infer_csv_dataset
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.parallel import make_mesh
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+MODELS = [
+    (LogisticRegression(), {"reg_param": [0.01, 0.1]}),
+    (XGBoostClassifier(num_round=8), {"eta": [0.3], "max_depth": [3]}),
+]
+
+
+def _train(mesh):
+    ds = infer_csv_dataset(TITANIC)
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+    selector = BinaryClassificationModelSelector(seed=7, models=MODELS)
+    pred = selector.set_input(resp, checked).get_output()
+    model = (
+        Workflow()
+        .set_result_features(pred)
+        .set_input_dataset(ds)
+        .set_parallelism(mesh)
+        .train()
+    )
+    scores = model.score(dataset=ds)
+    probs = np.asarray(scores[pred.name].probability)
+    return model, probs
+
+
+@pytest.mark.skipif(
+    not os.path.exists(TITANIC), reason="no titanic data"
+)
+def test_selector_output_identical_sharded_vs_not():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = make_mesh(n_data=8, n_model=1)
+    model_single, probs_single = _train(None)
+    model_mesh, probs_mesh = _train(mesh)
+
+    s1 = model_single.summary_json()["modelSelectorSummary"]
+    s8 = model_mesh.summary_json()["modelSelectorSummary"]
+    assert s1["bestModelName"] == s8["bestModelName"]
+    # fold metrics agree to float tolerance (psum ordering differs)
+    for r1, r8 in zip(s1["validationResults"], s8["validationResults"]):
+        assert r1["modelName"] == r8["modelName"] and r1["grid"] == r8["grid"]
+        np.testing.assert_allclose(
+            r1["metricValues"], r8["metricValues"], rtol=1e-4, atol=1e-6
+        )
+    np.testing.assert_allclose(
+        s1["holdoutEvaluation"]["AuPR"], s8["holdoutEvaluation"]["AuPR"],
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(probs_single, probs_mesh, rtol=1e-3, atol=1e-5)

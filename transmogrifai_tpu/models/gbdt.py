@@ -347,6 +347,15 @@ class _TreeEstimator(PredictorEstimator):
             )
 
         trees = run_batched(binned, merged[0], row_mask_k, knob)
+        # mesh-sharded fits return trees replicated across the mesh; pull
+        # them to host ONCE before the per-model slicing — slicing a
+        # multi-device array eagerly dispatches a gather on every device per
+        # slice (hundreds across a sweep), which both wastes dispatches and
+        # stresses the async CPU runtime. Single-device (1-chip) fits stay
+        # device-resident for the fused predict paths.
+        leaves = jax.tree.leaves(trees)
+        if leaves and len(getattr(leaves[0], "devices", lambda: [0])()) > 1:
+            trees = jax.tree.map(lambda a: np.asarray(a), trees)
         return [
             [
                 make_model(

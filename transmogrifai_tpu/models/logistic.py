@@ -85,9 +85,29 @@ class LogisticRegression(PredictorEstimator):
             "standardization": self.standardization,
         }
 
+    @staticmethod
+    def _mesh_rows(x, y, masks):
+        """Pad rows to the execution-mesh multiple (mask-0 padding is inert
+        in the mask-weighted solvers) and shard x over the data axis;
+        identity when no mesh is active. ``masks`` pads on its LAST axis
+        (handles both [N] and [K, N])."""
+        from ..parallel.mesh import data_row_multiple, shard_rows_if_active
+
+        x = np.asarray(x, dtype=np.float32)
+        y = np.asarray(y, dtype=np.float32)
+        masks = np.asarray(masks, dtype=np.float32)
+        pad = (-x.shape[0]) % data_row_multiple()
+        if pad:
+            x = np.pad(x, ((0, pad), (0, 0)))
+            y = np.pad(y, (0, pad))
+            widths = [(0, 0)] * (masks.ndim - 1) + [(0, pad)]
+            masks = np.pad(masks, widths)
+        return shard_rows_if_active(x), y, masks
+
     def fit_arrays(self, x, y, row_mask):
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
+        x, y, row_mask = self._mesh_rows(x, y, row_mask)
         # FISTA needs more iterations than Newton for tight convergence;
         # scale the budget (maxIter is the Spark-semantic knob).
         iters = self.max_iter * 4
@@ -139,7 +159,8 @@ class LogisticRegression(PredictorEstimator):
         return regs, ens
 
     def _vmapped_fit(self, x, y, num_classes: int):
-        """fit fn of (reg, elastic_net, row_mask) for the vmapped sweep."""
+        """fit fn of (reg, elastic_net, row_mask) for the vmapped sweep;
+        callers pass x already padded/sharded via _mesh_rows."""
         iters = self.max_iter * 4
         if num_classes == 2:
             return lambda r, e, m: fit_logistic_binary(
@@ -165,10 +186,9 @@ class LogisticRegression(PredictorEstimator):
         models: dict[int, LogisticRegressionModel] = {}
         if vmappable:
             regs, ens = self._grid_values([grid_points[i] for i in vmappable])
-            rm = np.broadcast_to(
-                np.asarray(row_mask, dtype=np.float32), (len(vmappable), len(y))
-            )
-            stacked = jax.vmap(self._vmapped_fit(x, y, num_classes))(regs, ens, rm)
+            xp, yp, rmp = self._mesh_rows(x, y, row_mask)
+            rm = np.broadcast_to(rmp, (len(vmappable), len(yp)))
+            stacked = jax.vmap(self._vmapped_fit(xp, yp, num_classes))(regs, ens, rm)
             w = np.asarray(stacked.weights)
             b = np.asarray(stacked.intercept)
             for j, i in enumerate(vmappable):
@@ -189,10 +209,11 @@ class LogisticRegression(PredictorEstimator):
         num_classes = self._num_classes(y, np.max(np.stack(masks), axis=0))
         n_pts = len(grid_points)
         regs, ens = self._grid_values(list(grid_points) * len(masks))
+        xp, yp, masksp = self._mesh_rows(x, y, np.stack(masks))
         rm = np.repeat(
-            np.stack(masks).astype(np.float32), n_pts, axis=0
+            masksp, n_pts, axis=0
         )  # [K, N], mask-major to match regs/ens tiling
-        stacked = jax.vmap(self._vmapped_fit(x, y, num_classes))(regs, ens, rm)
+        stacked = jax.vmap(self._vmapped_fit(xp, yp, num_classes))(regs, ens, rm)
         w = np.asarray(stacked.weights)
         b = np.asarray(stacked.intercept)
         return [

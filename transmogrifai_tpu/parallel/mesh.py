@@ -48,6 +48,89 @@ def auto_mesh(min_devices: int = 2):
     return make_mesh(n_data=len(devices), n_model=1, devices=devices)
 
 
+# --------------------------------------------------------------------------
+# execution mesh: the workflow-level default parallelism context.
+#
+# The reference row-partitions EVERY stage by construction
+# (FitStagesUtil.scala:96-118 — everything is an RDD operation). Here the
+# equivalent substrate is an ambient mesh that Workflow.train/score install
+# around the fit/score phases: estimator fit paths consult
+# ``execution_mesh()`` and, when one is active, run row-sharded (trees via
+# shard_map+psum histograms, solvers via GSPMD row sharding). On a single
+# device the context stays None and everything is plain jit — zero cost.
+# --------------------------------------------------------------------------
+_EXECUTION_MESH = None
+
+
+def execution_mesh():
+    """The ambient mesh installed by the workflow (None = single-device)."""
+    return _EXECUTION_MESH
+
+
+def set_execution_mesh(mesh) -> None:
+    global _EXECUTION_MESH
+    _EXECUTION_MESH = mesh
+
+
+class use_execution_mesh:
+    """Context manager installing ``mesh`` as the ambient execution mesh.
+
+    ``use_execution_mesh(None)`` explicitly forces single-device execution
+    (the A/B lever the sharded-vs-not parity tests use)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._saved = None
+
+    def __enter__(self):
+        global _EXECUTION_MESH
+        self._saved = _EXECUTION_MESH
+        _EXECUTION_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _EXECUTION_MESH
+        _EXECUTION_MESH = self._saved
+        return False
+
+
+_AUTO_MESH_CACHE: list = []
+
+
+def default_execution_mesh():
+    """The mesh Workflow installs when the user didn't pick one: all devices
+    data-parallel when >1 device is visible (cached — Mesh identity matters
+    for the lru_cached shard_map kernels), else None. Set TPTPU_MESH=0 to
+    force single-device execution everywhere."""
+    import os
+
+    if os.environ.get("TPTPU_MESH", "") == "0":
+        return None
+    if not _AUTO_MESH_CACHE:
+        _AUTO_MESH_CACHE.append(auto_mesh())
+    return _AUTO_MESH_CACHE[0]
+
+
+def data_row_multiple() -> int:
+    """Row-count multiple required to shard over the ambient mesh's data
+    axis (1 when no mesh is active). Callers pad with mask-0 rows — inert
+    in every mask-weighted solver — before shard_rows_if_active."""
+    mesh = execution_mesh()
+    return 1 if mesh is None else mesh.shape[DATA_AXIS]
+
+
+def shard_rows_if_active(x):
+    """Row-shard ``x`` over the ambient execution mesh (rows must already be
+    a multiple of data_row_multiple()) — identity when no mesh is active.
+    This is how solver-family fits join the row-partitioned substrate: XLA
+    (GSPMD) propagates the sharding through the jitted solver and inserts
+    the gradient psums."""
+    mesh = execution_mesh()
+    if mesh is None:
+        return x
+    return shard_rows(mesh, np.ascontiguousarray(x))
+
+
 def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
     """Zero-pad axis 0 to a multiple of ``multiple`` (static shard shapes).
 

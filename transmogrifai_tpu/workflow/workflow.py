@@ -40,6 +40,7 @@ class Workflow:
         self._prefitted: dict[str, PipelineStage] = {}
         self._workflow_cv = False
         self._detect_sensitive = False
+        self._mesh: Any = "auto"
 
     # ----------------------------------------------------------- configure
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -75,6 +76,20 @@ class Workflow:
         cannot leak validation rows into candidate selection."""
         self._workflow_cv = True
         return self
+
+    def set_parallelism(self, mesh) -> "Workflow":
+        """Pin the execution mesh for train/score. Default "auto": all
+        visible devices data-parallel (the reference row-partitions every
+        stage by construction — FitStagesUtil.scala:96-118); on a single
+        device this resolves to None and everything is plain jit. Pass None
+        to force single-device execution."""
+        self._mesh = mesh
+        return self
+
+    def _resolve_mesh(self):
+        from ..parallel.mesh import default_execution_mesh
+
+        return default_execution_mesh() if self._mesh == "auto" else self._mesh
 
     def with_sensitive_feature_detection(self) -> "Workflow":
         """Scan raw text features for personal data at train time and record
@@ -220,20 +235,27 @@ class Workflow:
                 train_data = raw.take(train_idx)
                 holdout_data = raw.take(holdout_idx)
 
-        if self._workflow_cv and selector is not None:
-            from .cv import workflow_cv_results
+        # every estimator fit below runs under the ambient execution mesh:
+        # tree fits shard_map rows with psum'd histograms, solver fits ride
+        # GSPMD row sharding; None (single device) = plain jit
+        from ..parallel.mesh import use_execution_mesh
 
-            selector.precomputed_results = workflow_cv_results(
-                selector, train_data, prefitted=self._prefitted
-            )
-            log.info(
-                "Workflow-level CV: %d candidate results from per-fold DAG refits",
-                len(selector.precomputed_results),
-            )
+        mesh = self._resolve_mesh()
+        with use_execution_mesh(mesh):
+            if self._workflow_cv and selector is not None:
+                from .cv import workflow_cv_results
 
-        fitted_data, fitted = fit_and_transform_dag(
-            train_data, self.result_features, prefitted=self._prefitted
-        )
+                selector.precomputed_results = workflow_cv_results(
+                    selector, train_data, prefitted=self._prefitted
+                )
+                log.info(
+                    "Workflow-level CV: %d candidate results from per-fold DAG refits",
+                    len(selector.precomputed_results),
+                )
+
+            fitted_data, fitted = fit_and_transform_dag(
+                train_data, self.result_features, prefitted=self._prefitted
+            )
 
         selector_info = None
         if selector is not None:
@@ -263,6 +285,12 @@ class Workflow:
             )
             log.info("Holdout metrics: %s", holdout_metrics)
 
+        label_summary = None
+        if selector_info is not None:
+            label_summary = _label_summary(
+                fitted_data, selector_info, self.result_features
+            )
+
         model = WorkflowModel(
             result_features=self.result_features,
             raw_features=tuple(raw_features),
@@ -273,12 +301,60 @@ class Workflow:
             rff_results=None if rff_results is None else rff_results.to_json(),
             blocklisted=list(self.blocklisted_features),
             sensitive_info=sensitive_info,
+            label_summary=label_summary,
+            training_params=dict(self._stage_overrides),
         )
         if selector is not None:
             # keep the live evaluator object so custom evaluators keep working
             # on the in-memory model (the name in selector_info covers load)
             model._live_evaluator = selector.evaluator
         return model
+
+
+def _label_summary(
+    fitted_data: Dataset,
+    selector_info: dict[str, Any],
+    result_features: Sequence[Feature],
+) -> dict[str, Any] | None:
+    """LabelSummary (ModelInsights.scala:293-325): raw lineage + sample size
+    + distribution — Discrete {domain, prob} for classification problems,
+    Continuous {min, max, mean, variance} for regression."""
+    name = selector_info["labelName"]
+    if name not in fitted_data:
+        return None
+    col = fitted_data[name]
+    vals = np.asarray(col.values, dtype=np.float64)
+    mask = np.asarray(col.mask, dtype=bool) if hasattr(col, "mask") else np.ones(len(vals), bool)
+    present = vals[mask]
+    label_feat = next((f for f in result_features if f.name == name), None)
+    raw = label_feat.raw_features() if label_feat is not None else []
+    summary: dict[str, Any] = {
+        "labelName": name,
+        "rawFeatureName": [f.name for f in raw],
+        "rawFeatureType": [f.ftype.__name__ for f in raw],
+        "stagesApplied": (
+            label_feat.history()["stages"] if label_feat is not None else []
+        ),
+        "sampleSize": float(len(present)),
+    }
+    if len(present) == 0:
+        summary["distribution"] = None
+    elif selector_info["problemKind"] == "Regression":
+        summary["distribution"] = {
+            "type": "Continuous",
+            "min": float(present.min()),
+            "max": float(present.max()),
+            "mean": float(present.mean()),
+            "variance": float(present.var()),
+        }
+    else:
+        uniq, counts = np.unique(present, return_counts=True)
+        summary["distribution"] = {
+            "type": "Discrete",
+            "domain": [str(int(u)) if u == int(u) else str(u) for u in uniq],
+            "prob": (counts / counts.sum()).tolist(),
+        }
+    return summary
 
 
 class WorkflowModel:
@@ -293,6 +369,8 @@ class WorkflowModel:
         rff_results: dict[str, Any] | None = None,
         blocklisted: list[str] | None = None,
         sensitive_info: list[dict[str, Any]] | None = None,
+        label_summary: dict[str, Any] | None = None,
+        training_params: dict[str, Any] | None = None,
     ):
         self.result_features = result_features
         self.raw_features = raw_features
@@ -303,6 +381,8 @@ class WorkflowModel:
         self.rff_results = rff_results
         self.blocklisted = blocklisted or []
         self.sensitive_info = sensitive_info
+        self.label_summary = label_summary
+        self.training_params = training_params or {}
 
     # --------------------------------------------------------- persistence
     def save(self, path: str) -> None:
