@@ -455,14 +455,15 @@ def fit_forest(
     colsample_rate: float | jax.Array = 1.0,
     min_instances: float | jax.Array = 1.0,
     min_info_gain: float | jax.Array = 0.0,
-    seed: int | jax.Array = 42,
+    seed: int = 42,
     bootstrap: bool = True,
     parallel_fits: int = 1,  # kept for API compat
     lowp: bool = False,
 ) -> Tree:
     """Random forest of mean-target trees — the K=1 case of
     fit_forest_batched (Spark RandomForest parity: variance impurity ==
-    gain formula with h=1, λ=0). Returns stacked Tree arrays [T, ...]."""
+    gain formula with h=1, λ=0). Returns stacked Tree arrays [T, ...].
+    ``seed`` must be a concrete int (it keys host-side PRNG splits)."""
     trees = fit_forest_batched(
         binned, target, jnp.asarray(row_mask)[None, :],
         num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
@@ -525,33 +526,62 @@ def _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap):
     return rmask, fmask
 
 
+def _tree_batch_size(k_fits: int, num_trees: int) -> int:
+    """Trees per grow dispatch. The combined fit axis (K fits × tb trees)
+    is capped so the batched histogram kernels stay inside the per-chunk
+    budgets grow_tree_batched derives from K. TPTPU_TREE_BATCH=1 restores
+    one-dispatch-per-tree (the round-1 behavior) if a runtime regresses."""
+    import os
+
+    env = os.environ.get("TPTPU_TREE_BATCH")
+    if env:
+        return max(1, int(env))
+    return max(1, min(num_trees, 256 // max(k_fits, 1)))
+
+
 @partial(
     jax.jit,
     static_argnames=("max_depth", "num_bins", "bootstrap", "lowp"),
 )
-def _forest_tree_batched(
-    binned, target, row_mask, tkey, sub, col, min_instances, min_info_gain,
+def _forest_trees_chunk(
+    binned, target, row_mask, tkeys, sub, col, min_instances, min_info_gain,
     max_depth, num_bins, bootstrap, lowp,
 ) -> Tree:
-    """One bagged tree for all K fits (one compiled program, reused per
-    tree by the host loop in fit_forest_batched)."""
+    """A chunk of bagged trees × all K fits in ONE batched growth: the
+    combined (tree, fit) axis rides the histogram-kernel grid. Masks are
+    drawn per tree with that tree's key — identical to the sequential
+    per-tree draws, so forests match the one-dispatch-per-tree path
+    bit-for-bit. Returns Tree arrays [K, tc, ...]."""
     k_fits, n = row_mask.shape
     f = binned.shape[1]
-    rmask, fmask = _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap)
-    gb = jnp.broadcast_to(-target[None, :], (k_fits, n))
-    return grow_tree_batched(
-        binned,
-        gb,
-        jnp.ones((k_fits, n), dtype=jnp.float32),
-        rmask,
-        fmask,
-        max_depth=max_depth,
-        num_bins=num_bins,
-        reg_lambda=0.0,
-        gamma=0.0,
-        min_child_weight=min_instances,
-        min_info_gain=min_info_gain,
+    tc = len(tkeys)
+    rms, fms = [], []
+    for tk in tkeys:
+        rm_t, fm_t = _bag_masks(tk, sub, col, row_mask, n, f, bootstrap)
+        rms.append(rm_t)
+        fms.append(fm_t)
+    rmask = jnp.concatenate(rms, axis=0)  # [tc*K, N], tree-major
+    fmask = jnp.concatenate(fms, axis=0)
+    gb = jnp.broadcast_to(-target[None, :], (tc * k_fits, n))
+
+    def tile(v):
+        vk = jnp.broadcast_to(
+            jnp.asarray(v, dtype=jnp.float32).reshape(-1), (k_fits,)
+        )
+        return jnp.tile(vk, tc)
+
+    tree = grow_tree_batched(
+        binned, gb, jnp.ones((tc * k_fits, n), dtype=jnp.float32),
+        rmask, fmask,
+        max_depth=max_depth, num_bins=num_bins,
+        reg_lambda=0.0, gamma=0.0,
+        min_child_weight=tile(min_instances),
+        min_info_gain=tile(min_info_gain),
         lowp=lowp,
+    )
+    return jax.tree.map(
+        lambda a: jnp.swapaxes(a.reshape((tc, k_fits) + a.shape[1:]), 0, 1),
+        tree,
     )
 
 
@@ -571,12 +601,15 @@ def fit_forest_batched(
     lowp: bool = False,
     mesh=None,
 ) -> Tree:
-    """K random forests batched over the fit axis: tree t of every fit grows
-    in one program (grow_tree_batched — fit axis = histogram-kernel grid
-    axis); the TREE loop runs on host, reusing that one compiled program per
-    dispatch. A single fused 50-tree × K-fit program was observed to bring
-    down the TPU runtime worker, and buys nothing — each tree's histogram
-    build already fills the chip. Returns stacked Tree arrays [K, T, ...].
+    """K random forests batched over the fit axis: chunks of trees ride the
+    SAME batch axis as the fits (combined tree×fit axis, capped at 256 by
+    _tree_batch_size), so a 50-tree × 18-fit sweep is ~4 dispatches instead
+    of 50 — each dispatch pays tunnel RTT. The cap matters: the crash
+    observed in round 1 was a single program CHAINING 50 sequential grows
+    (50× the program size); a wider batch axis on ONE grow is the same
+    program with a bigger kernel grid, validated at 256 combined slots.
+    TPTPU_TREE_BATCH overrides the chunk size (1 = round-1 behavior).
+    Returns stacked Tree arrays [K, T, ...].
 
     With ``mesh`` set, rows shard over the mesh's data axis and each level's
     histogram psums over it (grows the same trees as the unsharded path —
@@ -592,23 +625,38 @@ def fit_forest_batched(
     )
     mi = jnp.asarray(min_instances, dtype=jnp.float32)
     mg = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    if mesh is None:
+        from ..parallel.mesh import execution_mesh
+
+        mesh = execution_mesh()
     if mesh is not None:
         return _fit_forest_batched_sharded(
             mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
             num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
             bootstrap=bootstrap, lowp=lowp,
         )
-    trees = [
-        _forest_tree_batched(
-            binned, target, row_mask, tkeys[t], sub, col, mi, mg,
-            max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
-            # lowp is only sound when target values are bf16-exact
-            # (classification indicators); regression keeps f32
-            lowp=lowp,
-        )
-        for t in range(num_trees)
-    ]
-    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *trees)  # [K, T, ...]
+    # ---- trees ride the FIT axis too: bagged trees are independent, so a
+    # chunk of `tb` trees × K fits grows as one K·tb-fit batched program —
+    # 50 trees × 18 fits collapses from 50 dispatches to 4 (each dispatch
+    # pays the tunnel RTT; this is the dominant fresh-process win). Masks
+    # are drawn per tree exactly as the sequential path would, so the
+    # resulting forests are bit-identical.
+    tb = _tree_batch_size(k_fits, num_trees)
+    chunks = []
+    for t0 in range(0, num_trees, tb):
+        tc = min(tb, num_trees - t0)
+        chunks.append(
+            _forest_trees_chunk(
+                binned, target, row_mask,
+                tuple(tkeys[t0 + i] for i in range(tc)),
+                sub, col, mi, mg,
+                max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
+                # lowp is only sound when target values are bf16-exact
+                # (classification indicators); regression keeps f32
+                lowp=lowp,
+            )
+        )  # each [K, tc, ...]
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1), *chunks)
 
 
 @partial(
@@ -755,6 +803,10 @@ def fit_boosted_batched(
     gam = jnp.asarray(gamma, dtype=jnp.float32)
     mcw = jnp.asarray(min_child_weight, dtype=jnp.float32)
     mig = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    if mesh is None:
+        from ..parallel.mesh import execution_mesh
+
+        mesh = execution_mesh()
     if mesh is not None:
         return _fit_boosted_batched_sharded(
             mesh, binned, y, row_mask, eta_v, lam, gam, mcw, mig,
@@ -850,24 +902,39 @@ def _fit_forest_batched_sharded(
     rm = jnp.asarray(row_mask, jnp.float32)
     kern = _sharded_grow_kernel(mesh, max_depth, num_bins, None, lowp)
     zero = jnp.zeros(1, jnp.float32)
-    mi = jnp.asarray(mi, jnp.float32).reshape(-1)
-    mg = jnp.asarray(mg, jnp.float32).reshape(-1)
-    gb = jnp.broadcast_to(-target_p[None, :], (k_fits, n_pad))
-    ones = jnp.ones((k_fits, n_pad), jnp.float32)
-    trees = []
-    for t in range(num_trees):
-        # masks drawn over the UNPADDED n — bit-identical to the
-        # single-device draw — then padded with zeros
-        rmask_t, fmask_t = _bag_masks(
-            tkeys[t], sub, col, rm, n=n, f=f, bootstrap=bootstrap
+    mi = jnp.broadcast_to(jnp.asarray(mi, jnp.float32).reshape(-1), (k_fits,))
+    mg = jnp.broadcast_to(jnp.asarray(mg, jnp.float32).reshape(-1), (k_fits,))
+    # trees ride the fit axis in chunks, same as the unsharded path
+    tb = _tree_batch_size(k_fits, num_trees)
+    chunks = []
+    for t0 in range(0, num_trees, tb):
+        tc = min(tb, num_trees - t0)
+        rms, fms = [], []
+        for i in range(tc):
+            # masks drawn over the UNPADDED n — bit-identical to the
+            # single-device draw — then padded with zeros
+            rmask_t, fmask_t = _bag_masks(
+                tkeys[t0 + i], sub, col, rm, n=n, f=f, bootstrap=bootstrap
+            )
+            rms.append(_pad_axis(rmask_t, 1, size))
+            fms.append(fmask_t)
+        rmask = jnp.concatenate(rms, axis=0)  # [tc*K, N_pad], tree-major
+        fmask = jnp.concatenate(fms, axis=0)
+        gb = jnp.broadcast_to(-target_p[None, :], (tc * k_fits, n_pad))
+        ones = jnp.ones((tc * k_fits, n_pad), jnp.float32)
+        tree = kern(
+            binned_p, gb, ones, rmask, fmask,
+            zero, zero, jnp.tile(mi, tc), jnp.tile(mg, tc),
         )
-        trees.append(
-            kern(
-                binned_p, gb, ones, _pad_axis(rmask_t, 1, size), fmask_t,
-                zero, zero, mi, mg,
+        chunks.append(
+            jax.tree.map(
+                lambda a: jnp.swapaxes(
+                    a.reshape((tc, k_fits) + a.shape[1:]), 0, 1
+                ),
+                tree,
             )
         )
-    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *trees)
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1), *chunks)
 
 
 @lru_cache(maxsize=None)
