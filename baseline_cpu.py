@@ -1,0 +1,164 @@
+"""Measured CPU reference for the Titanic selector bench (BASELINE.md).
+
+No JVM/Spark exists in this image, so the reference's local-Spark run cannot
+be timed directly. This harness reproduces the reference WORKLOAD SHAPE
+(BinaryClassificationModelSelector defaults — OpValidator.scala:371-379,
+BinaryClassificationModelSelector.scala:61-63) in sklearn on CPU:
+
+  * Titanic 891 rows, CSV -> imputed/one-hot feature matrix
+  * LogisticRegression grid 8 (reg {.001,.01,.1,.2} x elasticNet {.1,.5})
+  * RandomForest grid 18 (depth {3,6,12} x minInstances {10,100}
+    x minInfoGain {.001,.01,.1}), 50 trees
+  * XGBoost grid 2 (minChildWeight {1,10}, eta .02, depth 10, 200 rounds)
+    — sklearn HistGradientBoosting stands in for libxgboost 'hist' (same
+    histogram-boosting algorithm family; no xgboost wheel in this image)
+  * 3-fold CV (84 fits) + best-model refit + 10% holdout AuPR
+
+Run:  python baseline_cpu.py     -> one JSON line; also writes
+BASELINE_CPU.json consumed by bench.py as the measured vs_baseline anchor.
+"""
+from __future__ import annotations
+
+import csv
+import json
+import os
+import time
+
+import numpy as np
+
+
+def load_titanic(path: str) -> tuple[np.ndarray, np.ndarray]:
+    rows = list(csv.DictReader(open(path)))
+    n = len(rows)
+    y = np.array([float(r["Survived"]) for r in rows])
+
+    def num(field):
+        vals = np.array(
+            [float(r[field]) if r[field] not in ("", None) else np.nan for r in rows]
+        )
+        med = np.nanmedian(vals)
+        missing = np.isnan(vals)
+        return np.where(missing, med, vals), missing.astype(float)
+
+    age, age_missing = num("Age")
+    fare, fare_missing = num("Fare")
+    sibsp, _ = num("SibSp")
+    parch, _ = num("Parch")
+    pclass, _ = num("Pclass")
+
+    def onehot(field, topk=20):
+        vals = [r[field] or "" for r in rows]
+        uniq = [v for v, _ in sorted(
+            {v: sum(1 for x in vals if x == v) for v in set(vals)}.items(),
+            key=lambda kv: -kv[1],
+        )[:topk]]
+        out = np.zeros((n, len(uniq) + 1))
+        for i, v in enumerate(vals):
+            out[i, uniq.index(v) if v in uniq else len(uniq)] = 1.0
+        return out
+
+    sex = onehot("Sex")
+    embarked = onehot("Embarked")
+    cabin_letter = np.zeros((n, 9))
+    letters = "ABCDEFGT"
+    for i, r in enumerate(rows):
+        c = (r["Cabin"] or "")[:1]
+        cabin_letter[i, letters.index(c) if c in letters else 8] = 1.0
+    x = np.column_stack([
+        age, age_missing, fare, fare_missing, sibsp, parch, pclass,
+        sibsp + parch + 1.0, sex, embarked, cabin_letter,
+    ])
+    return x.astype(np.float64), y
+
+
+def main() -> None:
+    from sklearn.ensemble import (
+        HistGradientBoostingClassifier,
+        RandomForestClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import average_precision_score
+    from sklearn.model_selection import StratifiedKFold
+
+    path = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+    t0 = time.perf_counter()
+    x, y = load_titanic(path)
+    n = len(y)
+    rng = np.random.default_rng(42)
+
+    # 10% holdout reserve (DataSplitter default reserveTestFraction 0.1)
+    perm = rng.permutation(n)
+    cut = int(n * 0.9)
+    tr, ho = perm[:cut], perm[cut:]
+    xt, yt, xh, yh = x[tr], y[tr], x[ho], y[ho]
+
+    candidates = []
+    for reg in [0.001, 0.01, 0.1, 0.2]:
+        for en in [0.1, 0.5]:
+            candidates.append((
+                "LR", dict(reg=reg, en=en),
+                lambda reg=reg, en=en: LogisticRegression(
+                    solver="saga", l1_ratio=en,
+                    C=1.0 / max(reg * len(yt), 1e-12), max_iter=200,
+                ),
+            ))
+    for depth in [3, 6, 12]:
+        for mi in [10, 100]:
+            for mg in [0.001, 0.01, 0.1]:
+                candidates.append((
+                    "RF", dict(depth=depth, min_inst=mi, min_gain=mg),
+                    lambda depth=depth, mi=mi, mg=mg: RandomForestClassifier(
+                        n_estimators=50, max_depth=depth,
+                        min_samples_leaf=mi, min_impurity_decrease=mg,
+                        random_state=0,
+                    ),
+                ))
+    for mcw in [1.0, 10.0]:
+        candidates.append((
+            "XGB(hist-gbm)", dict(min_child_weight=mcw),
+            lambda mcw=mcw: HistGradientBoostingClassifier(
+                max_iter=200, learning_rate=0.02, max_depth=10,
+                min_samples_leaf=max(int(mcw), 1), l2_regularization=1.0,
+                early_stopping=False, random_state=0,
+            ),
+        ))
+
+    skf = StratifiedKFold(n_splits=3, shuffle=True, random_state=42)
+    results = []
+    for name, grid, make in candidates:
+        scores = []
+        for tri, vai in skf.split(xt, yt):
+            m = make().fit(xt[tri], yt[tri])
+            p = m.predict_proba(xt[vai])[:, 1]
+            scores.append(average_precision_score(yt[vai], p))
+        results.append((float(np.mean(scores)), name, grid, make))
+    best = max(results, key=lambda r: r[0])
+    final = best[3]().fit(xt, yt)
+    holdout_aupr = float(
+        average_precision_score(yh, final.predict_proba(xh)[:, 1])
+    )
+    wall = time.perf_counter() - t0
+
+    out = {
+        "metric": "titanic_binary_selector_train_wallclock_cpu_reference",
+        "value": round(wall, 3),
+        "unit": "s",
+        "candidates": len(candidates),
+        "cv_fits": len(candidates) * 3,
+        "best_model": best[1],
+        "best_cv_aupr": round(best[0], 4),
+        "holdout_aupr": round(holdout_aupr, 4),
+        "hardware": f"{os.cpu_count()} vCPU (container), sklearn",
+        "note": (
+            "measured proxy for the reference local-Spark run (no JVM in "
+            "image); HistGradientBoosting stands in for libxgboost hist"
+        ),
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BASELINE_CPU.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
