@@ -92,6 +92,110 @@ void tp_murmur3_scatter(const uint8_t* buf, const int64_t* offsets,
   }
 }
 
+// ------------------------------------------------- fused tokenize + hash
+// Tokenize n ASCII row-strings (concatenated buffer + offsets[n+1]) and
+// scatter token hashes into bucket counts in ONE pass — the native hot
+// path of SmartTextVectorizer/OPCollectionHashingVectorizer
+// (SmartTextVectorizer.scala:79-132). Token rule matches utils/text.py
+// _TOKEN_RE ([^\s\W_]+) for ASCII input: runs of [A-Za-z0-9]; the Python
+// caller routes rows containing non-ASCII bytes to the regex fallback so
+// Unicode semantics stay exact. `prefix` (e.g. "3_") implements the
+// shared-hash-space slot prefix; min_token_len counts characters (==
+// bytes for ASCII).
+void tp_tokenize_hash_scatter(const uint8_t* buf, const int64_t* offsets,
+                              const int64_t* rows, int64_t n_strings,
+                              uint32_t seed, int64_t num_buckets, int binary,
+                              int lowercase, int64_t min_token_len,
+                              const uint8_t* prefix, int64_t prefix_len,
+                              float* out, int64_t out_cols,
+                              int64_t col_offset) {
+  std::string token;
+  token.reserve(64);
+  for (int64_t i = 0; i < n_strings; i++) {
+    const uint8_t* s = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    float* row_out = out + rows[i] * out_cols + col_offset;
+    int64_t start = -1;
+    for (int64_t k = 0; k <= len; k++) {
+      bool word = false;
+      if (k < len) {
+        uint8_t c = s[k];
+        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+               (c >= 'a' && c <= 'z');
+      }
+      if (word) {
+        if (start < 0) start = k;
+        continue;
+      }
+      if (start >= 0) {
+        int64_t tlen = k - start;
+        if (tlen >= min_token_len) {
+          token.assign((const char*)prefix, (size_t)prefix_len);
+          for (int64_t t = start; t < k; t++) {
+            uint8_t c = s[t];
+            if (lowercase && c >= 'A' && c <= 'Z') c += 32;
+            token.push_back((char)c);
+          }
+          uint32_t h = murmur3_32((const uint8_t*)token.data(),
+                                  (int64_t)token.size(), seed);
+          float* cell = row_out + (int64_t)(h % (uint32_t)num_buckets);
+          if (binary) {
+            *cell = 1.0f;
+          } else {
+            *cell += 1.0f;
+          }
+        }
+        start = -1;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------- text stats (SmartText fit)
+// One pass over n ASCII strings producing BOTH TextStats inputs
+// (SmartTextVectorizer.scala TextStats): the cleaned string
+// (TextUtils.cleanString: lowercase, split on non-alnum, capitalize words,
+// join with no separator) written to out_buf/out_offsets, and the
+// token-length histogram (tokenize = [A-Za-z0-9]+ runs; lengths clipped to
+// hist_size-1). out_buf capacity must be >= the input buffer size (cleaning
+// never grows an ASCII string).
+void tp_clean_tokenstats(const uint8_t* buf, const int64_t* offsets,
+                         int64_t n, uint8_t* out_buf, int64_t* out_offsets,
+                         int64_t* len_hist, int64_t hist_size) {
+  int64_t w = 0;
+  out_offsets[0] = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* s = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t start = -1;
+    for (int64_t k = 0; k <= len; k++) {
+      bool word = false;
+      if (k < len) {
+        uint8_t c = s[k];
+        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+               (c >= 'a' && c <= 'z');
+      }
+      if (word) {
+        if (start < 0) start = k;
+        continue;
+      }
+      if (start >= 0) {
+        int64_t tlen = k - start;
+        int64_t bin = tlen < hist_size ? tlen : hist_size - 1;
+        len_hist[bin]++;
+        for (int64_t t = start; t < k; t++) {
+          uint8_t c = s[t];
+          if (c >= 'A' && c <= 'Z') c += 32;   // lowercase...
+          if (t == start && c >= 'a' && c <= 'z') c -= 32;  // ...capitalize
+          out_buf[w++] = c;
+        }
+        start = -1;
+      }
+    }
+    out_offsets[i + 1] = w;
+  }
+}
+
 // ------------------------------------------------------------- CSV parsing
 // Parse n decimal strings into out[n] with validity mask[n] (0 = missing /
 // unparseable). Empty and whitespace-only fields are missing. Grammar

@@ -70,6 +70,27 @@ def _load() -> ctypes.CDLL | None:
             np.ctypeslib.ndpointer(np.float64, flags="C"),
             np.ctypeslib.ndpointer(np.uint8, flags="C"),
         ]
+        if hasattr(lib, "tp_clean_tokenstats"):
+            lib.tp_clean_tokenstats.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.uint8, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64,
+            ]
+        if hasattr(lib, "tp_tokenize_hash_scatter"):
+            lib.tp_tokenize_hash_scatter.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64, ctypes.c_uint32, ctypes.c_int64,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.float32, flags="C"),
+                ctypes.c_int64, ctypes.c_int64,
+            ]
         _LIB = lib
         return _LIB
 
@@ -133,6 +154,66 @@ def murmur3_scatter(
         return out
     _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset)
     return out
+
+
+def tokenize_hash_scatter(
+    texts: list,
+    rows: np.ndarray,
+    num_buckets: int,
+    out: np.ndarray,
+    seed: int = 42,
+    binary: bool = False,
+    to_lowercase: bool = True,
+    min_token_length: int = 1,
+    prefix: str = "",
+    col_offset: int = 0,
+) -> bool:
+    """Fused tokenize+hash+scatter for ASCII row strings (the
+    SmartTextVectorizer hot loop in one native pass). Returns False when the
+    native path can't take it (library missing, non-float32/C output) — the
+    caller must then run the Python tokenize fallback. Callers route rows
+    with non-ASCII content to the fallback themselves: the C tokenizer is
+    exact only for ASCII (utils/text.py _TOKEN_RE semantics)."""
+    lib = _load()
+    if (
+        lib is None
+        or not hasattr(lib, "tp_tokenize_hash_scatter")
+        or not out.flags["C_CONTIGUOUS"]
+        or out.dtype != np.float32
+    ):
+        return False
+    buf, offsets = _concat(texts)
+    pref = prefix.encode("ascii")
+    lib.tp_tokenize_hash_scatter(
+        buf, offsets, np.ascontiguousarray(rows, dtype=np.int64),
+        len(texts), seed & 0xFFFFFFFF, num_buckets,
+        1 if binary else 0, 1 if to_lowercase else 0, min_token_length,
+        pref, len(pref), out, out.shape[1], col_offset,
+    )
+    return True
+
+
+def clean_tokenstats(texts: list) -> tuple[list, np.ndarray] | None:
+    """Batch TextUtils.cleanString + token-length histogram over ASCII
+    strings in one native pass. Returns (cleaned_strings, length_hist) or
+    None when the native path is unavailable (caller falls back to the
+    per-row Python clean/tokenize)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tp_clean_tokenstats"):
+        return None
+    buf, offsets = _concat(texts)
+    out_buf = np.zeros(max(len(buf), 1), dtype=np.uint8)
+    out_offsets = np.zeros(len(texts) + 1, dtype=np.int64)
+    hist = np.zeros(256, dtype=np.int64)
+    lib.tp_clean_tokenstats(
+        buf, offsets, len(texts), out_buf, out_offsets, hist, hist.shape[0]
+    )
+    raw = out_buf.tobytes()
+    cleaned = [
+        raw[out_offsets[i]:out_offsets[i + 1]].decode("ascii")
+        for i in range(len(texts))
+    ]
+    return cleaned, hist
 
 
 def _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset):

@@ -510,7 +510,7 @@ class SmartTextMapVectorizer(VectorizerEstimator):
         }
 
     def fit_model(self, dataset: Dataset) -> SmartTextMapModel:
-        from ..utils.text import tokenize
+        from .text import batch_text_stats
 
         all_keys, all_methods, all_vocabs, summaries = [], [], [], []
         for name in self.input_names:
@@ -519,14 +519,10 @@ class SmartTextMapVectorizer(VectorizerEstimator):
             rows = map_rows(col, self.clean_keys)
             methods, vocabs = [], []
             for k in keys:
-                stats = TextStats.empty(self.max_cardinality)
-                for m in rows:
-                    v = m.get(k)
-                    if v is None:
-                        continue
-                    s = str(v)
-                    cleaned = clean_string(s) if self.clean_text else s
-                    stats.add(cleaned, tokenize(s))
+                stats = batch_text_stats(
+                    [m.get(k) for m in rows],
+                    self.max_cardinality, self.clean_text,
+                )
                 method = decide_method(
                     stats, self.max_cardinality, self.top_k, self.min_support,
                     self.coverage_pct, self.min_length_std_dev,

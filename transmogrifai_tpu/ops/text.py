@@ -75,6 +75,54 @@ class TextStats:
         return sum(filtered[:top_k]) / total
 
 
+def batch_text_stats(
+    values: Sequence, cardinality_cap: int, clean_text: bool
+) -> TextStats:
+    """TextStats over a column of optional strings. ASCII rows ride ONE
+    native clean+tokenize pass (native/tptpu_native.cpp
+    tp_clean_tokenstats — the SmartText fit hot loop); non-ASCII rows keep
+    the exact-Unicode Python path. The capped value-count insertion runs
+    over cleaned values in the ORIGINAL row order, so results match the
+    sequential per-row loop exactly (the cap drops the same keys)."""
+    from ..native import clean_tokenstats
+    from ..utils.text import clean_string, tokenize
+
+    stats = TextStats.empty(cardinality_cap)
+    strs: list[str | None] = [
+        None if v is None else (v if isinstance(v, str) else str(v))
+        for v in values
+    ]
+    ascii_idx = [i for i, s in enumerate(strs) if s is not None and s.isascii()]
+    res = clean_tokenstats([strs[i] for i in ascii_idx]) if ascii_idx else None
+    cleaned: list[str | None] = [None] * len(strs)
+    if res is not None:
+        native_cleaned, hist = res
+        for i, c in zip(ascii_idx, native_cleaned):
+            cleaned[i] = c if clean_text else strs[i]
+        for length, count in enumerate(hist):
+            if count:
+                stats.length_counts[length] += int(count)
+        slow = [
+            i for i, s in enumerate(strs)
+            if s is not None and not s.isascii()
+        ]
+    else:
+        slow = [i for i, s in enumerate(strs) if s is not None]
+    for i in slow:
+        s = strs[i]
+        cleaned[i] = clean_string(s) if clean_text else s
+        for t in tokenize(s):
+            stats.length_counts[len(t)] += 1
+    for c in cleaned:
+        if c is not None:
+            if (
+                c in stats.value_counts
+                or len(stats.value_counts) <= cardinality_cap
+            ):
+                stats.value_counts[c] += 1
+    return stats
+
+
 PIVOT, HASH, IGNORE = "Pivot", "Hash", "Ignore"
 
 
@@ -114,26 +162,51 @@ def hash_block(
     emits a single block). Always appends the null-indicator column when
     track_nulls (SmartTextVectorizer trackNulls semantics).
     """
-    from ..native import murmur3_scatter
+    from ..native import murmur3_scatter, tokenize_hash_scatter
 
     n = len(values)
     out = np.zeros((n, num_features + (1 if track_nulls else 0)), dtype=np.float32)
-    tokens: list[str] = []
-    rows: list[int] = []
+    prefix = f"{feature_slot}_" if shared else ""
+
+    # fast path: whole ASCII rows go through the fused native
+    # tokenize+hash+scatter pass (one C call for the column); rows with
+    # non-ASCII content keep the exact-Unicode Python tokenizer
+    ascii_texts: list[str] = []
+    ascii_rows: list[int] = []
+    slow_rows: list[tuple[int, str]] = []
     for r, raw in enumerate(values):
         if raw is None:
             if track_nulls:
                 out[r, num_features] = 1.0
-            continue
-        for t in tokenize(raw, to_lowercase=to_lowercase, min_token_length=min_token_length):
-            tokens.append(t if not shared else f"{feature_slot}_{t}")
-            rows.append(r)
-    if tokens:
-        # hash + scatter in one native pass (falls back to numpy)
-        murmur3_scatter(
-            tokens, np.asarray(rows, dtype=np.int64), n, num_features,
-            seed=seed, binary=binary_freq, out=out,
+        elif isinstance(raw, str) and raw.isascii():
+            ascii_texts.append(raw)
+            ascii_rows.append(r)
+        else:
+            slow_rows.append((r, raw))
+    if ascii_texts:
+        ok = tokenize_hash_scatter(
+            ascii_texts, np.asarray(ascii_rows, dtype=np.int64),
+            num_features, out, seed=seed, binary=binary_freq,
+            to_lowercase=to_lowercase, min_token_length=min_token_length,
+            prefix=prefix,
         )
+        if not ok:
+            slow_rows = [(r, v) for r, v in zip(ascii_rows, ascii_texts)] + slow_rows
+    if slow_rows:
+        tokens: list[str] = []
+        rows: list[int] = []
+        for r, raw in slow_rows:
+            for t in tokenize(
+                raw, to_lowercase=to_lowercase,
+                min_token_length=min_token_length,
+            ):
+                tokens.append(prefix + t)
+                rows.append(r)
+        if tokens:
+            murmur3_scatter(
+                tokens, np.asarray(rows, dtype=np.int64), n, num_features,
+                seed=seed, binary=binary_freq, out=out,
+            )
     return out.astype(np.float64)
 
 
@@ -277,13 +350,7 @@ class SmartTextVectorizer(VectorizerEstimator):
         }
 
     def compute_stats(self, col: TextColumn) -> TextStats:
-        stats = TextStats.empty(self.max_cardinality)
-        for v in col.values:
-            if v is None:
-                continue
-            cleaned = clean_string(v) if self.clean_text else v
-            stats.add(cleaned, tokenize(v))
-        return stats
+        return batch_text_stats(col.values, self.max_cardinality, self.clean_text)
 
     def fit_model(self, dataset: Dataset) -> SmartTextModel:
         methods, vocabs, summaries = [], [], []
