@@ -1,0 +1,163 @@
+"""Evaluator completeness: multiclass ThresholdMetrics, regression
+signed-percentage-error histogram, forecast SeasonalError/MASE.
+
+Parity targets: OpMultiClassificationEvaluator.scala:153-238,
+OpRegressionEvaluator.scala:63-190, OpForecastEvaluator.scala:83-121.
+The multiclass test cross-checks the vectorized implementation against a
+direct per-row transcription of the reference algorithm.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.forecast import ForecastEvaluator
+from transmogrifai_tpu.evaluators.multiclass import (
+    MultiClassificationEvaluator,
+    calculate_threshold_metrics,
+)
+from transmogrifai_tpu.evaluators.regression import (
+    RegressionEvaluator,
+    signed_percentage_error_histogram,
+)
+
+
+def _reference_threshold_metrics(prob, y, top_ns, thresholds):
+    """Per-row transcription of calculateThresholdMetrics (Scala)."""
+    n, c = prob.shape
+    n_t = len(thresholds)
+    correct = {t: np.zeros(n_t, dtype=int) for t in top_ns}
+    incorrect = {t: np.zeros(n_t, dtype=int) for t in top_ns}
+    for i in range(n):
+        label = int(y[i])
+        scores = prob[i]
+        true_score = scores[label] if 0 <= label < c else 0.0
+        order = sorted(range(c), key=lambda j: (-scores[j], j))
+        top_score = scores[order[0]]
+        t_cut = next(
+            (j for j, th in enumerate(thresholds) if th > true_score), n_t
+        )
+        m_cut = next(
+            (j for j, th in enumerate(thresholds) if th > top_score), n_t
+        )
+        for t in top_ns:
+            in_top = label in order[: min(t, c)]
+            if in_top:
+                correct[t][0:t_cut] += 1
+                incorrect[t][t_cut:m_cut] += 1
+            else:
+                incorrect[t][0:m_cut] += 1
+    return correct, incorrect
+
+
+def test_threshold_metrics_match_reference_algorithm():
+    rng = np.random.default_rng(0)
+    n, c = 200, 4
+    logits = rng.normal(size=(n, c))
+    prob = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+    y = rng.integers(0, c, n)
+    thresholds = np.arange(0, 1.01, 0.05)
+    out = calculate_threshold_metrics(prob, y, (1, 3), thresholds)
+    ref_c, ref_i = _reference_threshold_metrics(prob, y, (1, 3), thresholds)
+    for t in (1, 3):
+        np.testing.assert_array_equal(out["correctCounts"][str(t)], ref_c[t])
+        np.testing.assert_array_equal(out["incorrectCounts"][str(t)], ref_i[t])
+        total = (
+            np.array(out["correctCounts"][str(t)])
+            + np.array(out["incorrectCounts"][str(t)])
+            + np.array(out["noPredictionCounts"][str(t)])
+        )
+        # the three arrays always sum to N (the reference's invariant)
+        np.testing.assert_array_equal(total, np.full(len(thresholds), n))
+
+
+def test_threshold_metrics_unseen_label_scores_zero():
+    prob = np.array([[0.7, 0.3]])
+    y = np.array([5])  # unseen class -> true score 0.0
+    out = calculate_threshold_metrics(prob, y, (1,), np.array([0.0, 0.5, 0.9]))
+    assert out["correctCounts"]["1"] == [0, 0, 0]
+    # top score .7 clears thresholds 0 and .5 but not .9
+    assert out["incorrectCounts"]["1"] == [1, 1, 0]
+    assert out["noPredictionCounts"]["1"] == [0, 0, 1]
+
+
+def test_threshold_metrics_validation():
+    prob = np.array([[0.5, 0.5]])
+    y = np.array([0])
+    with pytest.raises(ValueError):
+        calculate_threshold_metrics(prob, y, (), None)
+    with pytest.raises(ValueError):
+        calculate_threshold_metrics(prob, y, (1,), np.array([-0.1, 0.5]))
+    with pytest.raises(ValueError):
+        calculate_threshold_metrics(prob, y, (0,), None)
+
+
+def test_multiclass_evaluator_includes_threshold_metrics():
+    rng = np.random.default_rng(1)
+    prob = rng.dirichlet(np.ones(3), size=60)
+    y = rng.integers(0, 3, 60).astype(float)
+    pred = prob.argmax(axis=1).astype(float)
+    m = MultiClassificationEvaluator().evaluate_arrays(y, pred, prob)
+    tm = m["ThresholdMetrics"]
+    assert tm["topNs"] == [1, 3]
+    assert len(tm["thresholds"]) == 101
+    assert set(tm["correctCounts"]) == {"1", "3"}
+
+
+def test_signed_percentage_error_histogram():
+    y = np.array([1.0, 2.0, 100.0, 0.0])
+    pred = np.array([1.1, 1.0, 50.0, 5.0])
+    h = signed_percentage_error_histogram(pred, y)
+    assert len(h["counts"]) == len(h["bins"]) - 1
+    assert sum(h["counts"]) == 4
+    # errors: +10%, -50%, -50%, +500000% (cutoff 1e-3 -> huge, lands in +inf bin)
+    assert h["counts"][-1] == 1
+    bins = np.asarray(h["bins"])
+    neg50 = int(np.searchsorted(bins, -50.0, side="right")) - 1
+    assert h["counts"][neg50] == 2
+
+
+def test_signed_percentage_error_smart_cutoff():
+    y = np.zeros(10)
+    pred = np.ones(10)
+    h = signed_percentage_error_histogram(
+        pred, y, smart_cutoff_ratio=0.1, scaled_error_cutoff=1e-3
+    )
+    # all-zero labels: smart cutoff falls back to scaledErrorCutoff
+    assert h["scaledErrorCutoff"] == pytest.approx(1e-3)
+    y2 = np.full(10, 10.0)
+    h2 = signed_percentage_error_histogram(
+        pred, y2, smart_cutoff_ratio=0.5, scaled_error_cutoff=1e-3
+    )
+    assert h2["scaledErrorCutoff"] == pytest.approx(5.0)
+
+
+def test_regression_evaluator_has_histogram():
+    y = np.array([1.0, 2.0, 3.0])
+    pred = np.array([1.5, 2.5, 2.0])
+    m = RegressionEvaluator().evaluate_arrays(y, pred, None)
+    assert "SignedPercentageErrorHistogram" in m
+    assert sum(m["SignedPercentageErrorHistogram"]["counts"]) == 3
+
+
+def test_forecast_seasonal_error_and_mase():
+    # y has period-2 seasonality; a one-step-behind forecast
+    y = np.array([1.0, 5.0, 1.0, 5.0, 1.0, 5.0], dtype=float)
+    pred = np.array([1.0, 1.0, 5.0, 1.0, 5.0, 1.0], dtype=float)
+    ev = ForecastEvaluator(seasonal_window=2)
+    m = ev.evaluate_arrays(y, pred, None)
+    # seasonal error over first cnt-2 rows: |y_i - y_{i+2}| = 0
+    assert m["SeasonalError"] == 0.0
+    assert m["MASE"] == 0.0  # denominator 0 -> 0 per reference
+    ev1 = ForecastEvaluator(seasonal_window=1)
+    m1 = ev1.evaluate_arrays(y, pred, None)
+    # window 1: |1-5| repeated over 5 gaps -> 4.0
+    assert m1["SeasonalError"] == pytest.approx(4.0)
+    abs_diff = np.abs(y - pred).sum()
+    assert m1["MASE"] == pytest.approx(abs_diff / (4.0 * 6))
+    assert 0.0 <= m1["SMAPE"] <= 2.0
+
+
+def test_forecast_validation():
+    with pytest.raises(ValueError):
+        ForecastEvaluator(seasonal_window=0)
+    with pytest.raises(ValueError):
+        ForecastEvaluator(max_items=0)
