@@ -70,8 +70,12 @@ def _arrow_to_ftype(pa: Any, typ: Any) -> type:
         inner = typ.value_type
         if pa.types.is_string(inner) or pa.types.is_large_string(inner):
             return T.TextList
-        if pa.types.is_integer(inner) or pa.types.is_timestamp(inner):
+        if pa.types.is_timestamp(inner):
             return T.DateTimeList
+        if pa.types.is_integer(inner):
+            # FeatureSparkTypes.scala:216 maps ArrayType(LongType) to
+            # DateList; DateTimeList is reserved for timestamp elements
+            return T.DateList
         if pa.types.is_floating(inner):
             return T.Geolocation
         return T.TextList
@@ -249,44 +253,68 @@ class ParquetReader(DataReader):
         return pq.read_table(self.path).to_pylist()
 
 
-# --- avro (gated: no avro library in the image) -------------------------------
+# --- avro --------------------------------------------------------------------
 
-def infer_avro_dataset(path: str, **kwargs: Any) -> Dataset:
-    """DataReaders.Simple.avro equivalent — requires an avro library."""
+def _read_avro_records(path: str) -> list[dict[str, Any]]:
+    """fastavro when available, else the vendored pure-Python container
+    reader (utils/avro.py) — the reader catalog has no gated hole."""
     try:
         import fastavro
-    except ImportError as e:
-        raise ImportError(
-            "Avro ingestion needs the 'fastavro' package, which is not in "
-            "this image. Convert to parquet/CSV, or use infer_parquet_dataset "
-            "/ infer_csv_dataset."
-        ) from e
+    except ImportError:
+        from ..utils.avro import read_avro
+
+        return read_avro(path)
     with open(path, "rb") as fh:  # pragma: no cover - fastavro not in image
-        records = list(fastavro.reader(fh))
+        return list(fastavro.reader(fh))
+
+
+def _avro_value_type(values: list[Any]) -> type:
+    """Feature type from decoded Avro values (CSVAutoReaders.scala infers
+    from the Avro schema; here the schema already decoded to Python)."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return T.Text
+    if all(isinstance(v, bool) for v in present):
+        return T.Binary
+    if all(isinstance(v, int) and not isinstance(v, bool) for v in present):
+        return T.Integral
+    if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in present):
+        return T.Real
+    if all(isinstance(v, list) for v in present):
+        return T.TextList
+    if all(isinstance(v, dict) for v in present):
+        inner = [x for v in present for x in v.values() if x is not None]
+        if inner and all(
+            isinstance(x, (int, float)) and not isinstance(x, bool)
+            for x in inner
+        ):
+            return T.RealMap
+        return T.TextMap
+    return T.Text
+
+
+def infer_avro_dataset(path: str, **kwargs: Any) -> Dataset:
+    """DataReaders.Simple.avro equivalent (CSVAutoReaders.scala)."""
+    records = _read_avro_records(path)
     names: list[str] = []
     for r in records:
         for k in r:
             if k not in names:
                 names.append(k)
-    cols = {
-        n: column_from_values(
-            kwargs.get("type_overrides", {}).get(n, T.Text),
-            [r.get(n) for r in records],
+    overrides = kwargs.get("type_overrides", {})
+    cols = {}
+    for n in names:
+        values = [r.get(n) for r in records]
+        cols[n] = column_from_values(
+            overrides.get(n, _avro_value_type(values)), values
         )
-        for n in names
-    }
     return Dataset.of(cols)
 
 
-class AvroReader(DataReader):  # pragma: no cover - fastavro not in image
+class AvroReader(DataReader):
     def __init__(self, path: str, key_fn: Callable[[Any], str] | None = None):
         super().__init__(key_fn)
         self.path = path
 
     def read_records(self) -> Iterable[dict[str, Any]]:
-        try:
-            import fastavro
-        except ImportError as e:
-            raise ImportError("AvroReader requires 'fastavro'") from e
-        with open(self.path, "rb") as fh:
-            return list(fastavro.reader(fh))
+        return _read_avro_records(self.path)
