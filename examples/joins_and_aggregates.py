@@ -86,6 +86,40 @@ def main():
     ds = joined.generate_dataset([send_key, send_user, mailing_list, num_clicks])
     for row in ds.rows():
         print(row)
+
+    # many-to-many join + POST-JOIN secondary aggregation
+    # (JoinedDataReader.withSecondaryAggregation — each send's raw click
+    # events join 1:N, then merge per send under a time window)
+    from transmogrifai_tpu.readers import TimeBasedFilter, TimeColumn
+
+    click_ts = FeatureBuilder.Integral("clickTs").extract(
+        lambda r: _ts(r["timestamp"])
+    ).as_predictor()
+    send_ts = FeatureBuilder.Integral("sendTs").extract(
+        lambda r: _ts(r["timestamp"])
+    ).as_predictor()
+    raw_clicks_reader = DataReaders.Simple.records(
+        clicks, key_fn=lambda r: r["sendId"]
+    )
+    joined_agg = JoinedReader(
+        left=sends_reader,
+        right=raw_clicks_reader,
+        join_type=JoinType.LEFT_OUTER,
+        left_features=[send_key, send_user, mailing_list, send_ts],
+        right_features=[num_clicks, click_ts],
+    ).with_secondary_aggregation(
+        TimeBasedFilter(
+            condition=TimeColumn("sendTs", keep=False),
+            primary=TimeColumn("clickTs", keep=False),
+            time_window_ms=1000 * 3600 * 24 * 365,
+        )
+    )
+    agg_ds = joined_agg.generate_dataset(
+        [send_key, send_user, mailing_list, send_ts, num_clicks, click_ts]
+    )
+    print("-- with secondary aggregation (clicks in the year BEFORE send) --")
+    for row in agg_ds.rows():
+        print(row)
     return ds
 
 

@@ -1,0 +1,156 @@
+"""Joined readers: many-to-many joins + post-join secondary aggregation.
+
+Parity: readers/.../JoinedDataReader.scala:83-390 and the scenarios of
+core's JoinedDataReaderDataGenerationTest (parent/child sales+calls data:
+join, then aggregate child events per parent key under a time filter).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.readers.catalog import SimpleReader
+from transmogrifai_tpu.readers.joins import (
+    JoinKeys,
+    JoinType,
+    JoinedReader,
+    TimeBasedFilter,
+    TimeColumn,
+    join_datasets,
+)
+
+
+def _features():
+    kf = FeatureBuilder.ID("key").extract(lambda r: r["k"]).as_predictor()
+    name = FeatureBuilder.Text("name").extract(lambda r: r.get("name")).as_predictor()
+    amount = FeatureBuilder.Real("amount").extract(lambda r: r.get("amount")).as_predictor()
+    ts = FeatureBuilder.Integral("ts").extract(lambda r: r.get("ts")).as_predictor()
+    cutoff = FeatureBuilder.Integral("cutoff").extract(lambda r: r.get("cutoff")).as_predictor()
+    return kf, name, amount, ts, cutoff
+
+
+def _readers():
+    # parent table: one row per account; child table: many events per key
+    left = SimpleReader(
+        [
+            {"k": "a", "name": "Acme", "cutoff": 100},
+            {"k": "b", "name": "Bolt", "cutoff": 200},
+            {"k": "d", "name": "Dorm", "cutoff": 50},
+        ],
+        key_fn=lambda r: r["k"],
+    )
+    right = SimpleReader(
+        [
+            {"k": "a", "amount": 1.0, "ts": 90},
+            {"k": "a", "amount": 2.0, "ts": 95},
+            {"k": "a", "amount": 100.0, "ts": 150},  # after cutoff: dropped
+            {"k": "b", "amount": 10.0, "ts": 190},
+            {"k": "b", "amount": 20.0, "ts": 10},    # too old for window 100
+            {"k": "c", "amount": 7.0, "ts": 5},
+        ],
+        key_fn=lambda r: r["k"],
+    )
+    return left, right
+
+
+def test_many_to_many_join():
+    left, right = _readers()
+    kf, name, amount, ts, cutoff = _features()
+    lds = left.generate_dataset([kf, name, cutoff])
+    rds = right.generate_dataset([kf, amount, ts])
+    out = join_datasets(lds, rds, JoinType.LEFT_OUTER)
+    # 'a' matches 3 child rows, 'b' 2, 'd' none -> 3+2+1 rows
+    assert out["key"].to_list() == ["a", "a", "a", "b", "b", "d"]
+    assert out["amount"].to_list() == [1.0, 2.0, 100.0, 10.0, 20.0, None]
+    assert out["name"].to_list() == [
+        "Acme", "Acme", "Acme", "Bolt", "Bolt", "Dorm"
+    ]
+
+
+def test_outer_join_emits_right_only_rows():
+    left, right = _readers()
+    kf, name, amount, ts, cutoff = _features()
+    lds = left.generate_dataset([kf, name])
+    rds = right.generate_dataset([kf, amount])
+    out = join_datasets(lds, rds, JoinType.OUTER)
+    assert out["key"].to_list().count("c") == 1
+    c_row = out["key"].to_list().index("c")
+    assert out["name"].to_list()[c_row] is None
+    assert out["amount"].to_list()[c_row] == 7.0
+
+
+def test_secondary_aggregation_with_time_filter():
+    left, right = _readers()
+    kf, name, amount, ts, cutoff = _features()
+    reader = JoinedReader(
+        left, right, JoinType.LEFT_OUTER, JoinKeys(),
+        left_features=[kf, name, cutoff],
+        right_features=[amount, ts],
+    ).with_secondary_aggregation(
+        TimeBasedFilter(
+            condition=TimeColumn("cutoff", keep=False),
+            primary=TimeColumn("ts", keep=False),
+            time_window_ms=100,
+        )
+    )
+    out = reader.generate_dataset([kf, name, cutoff, amount, ts])
+    assert out["key"].to_list() == ["a", "b", "d"]
+    # parent features keep one copy per key
+    assert out["name"].to_list() == ["Acme", "Bolt", "Dorm"]
+    # child amounts: only events with cutoff-100 < ts < cutoff merge
+    # a: 1.0 + 2.0 (ts 150 after cutoff dropped); b: 10.0 (ts 10 too old)
+    assert out["amount"].to_list() == [3.0, 10.0, None]
+    # keep=False drops both time columns
+    assert "ts" not in out and "cutoff" not in out
+
+
+def test_secondary_aggregation_keeps_time_columns_when_asked():
+    left, right = _readers()
+    kf, name, amount, ts, cutoff = _features()
+    reader = JoinedReader(
+        left, right, JoinType.LEFT_OUTER, JoinKeys(),
+        left_features=[kf, name, cutoff],
+        right_features=[amount, ts],
+    ).with_secondary_aggregation(
+        TimeBasedFilter(
+            condition=TimeColumn("cutoff", keep=True),
+            primary=TimeColumn("ts", keep=False),
+            time_window_ms=100,
+        )
+    )
+    out = reader.generate_dataset([kf, name, cutoff, amount, ts])
+    # cutoff is a parent feature: one copy per key survives
+    assert out["cutoff"].to_list() == [100, 200, 50]
+    assert "ts" not in out
+
+
+def test_response_window_direction():
+    """Responses aggregate FORWARD from the cutoff (reference
+    JoinedConditionalAggregator.update:434-436)."""
+    left = SimpleReader(
+        [{"k": "a", "cutoff": 100}], key_fn=lambda r: r["k"]
+    )
+    right = SimpleReader(
+        [
+            {"k": "a", "label": 1.0, "ts": 150},   # in [100, 200)
+            {"k": "a", "label": 1.0, "ts": 90},    # before cutoff: dropped
+            {"k": "a", "label": 1.0, "ts": 250},   # beyond window: dropped
+        ],
+        key_fn=lambda r: r["k"],
+    )
+    kf = FeatureBuilder.ID("key").extract(lambda r: r["k"]).as_predictor()
+    cutoff = FeatureBuilder.Integral("cutoff").extract(lambda r: r["cutoff"]).as_predictor()
+    label = FeatureBuilder.Real("label").extract(lambda r: r.get("label")).as_response()
+    ts = FeatureBuilder.Integral("ts").extract(lambda r: r.get("ts")).as_predictor()
+    reader = JoinedReader(
+        left, right, JoinType.LEFT_OUTER, JoinKeys(),
+        left_features=[kf, cutoff],
+        right_features=[label, ts],
+    ).with_secondary_aggregation(
+        TimeBasedFilter(
+            condition=TimeColumn("cutoff", keep=False),
+            primary=TimeColumn("ts", keep=False),
+            time_window_ms=100,
+        )
+    )
+    out = reader.generate_dataset([kf, cutoff, label, ts])
+    assert out["label"].to_list() == [1.0]

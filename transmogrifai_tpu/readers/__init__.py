@@ -9,7 +9,15 @@ from .aggregate import (  # noqa: F401
     CutOffTime,
     TimeStampToKeep,
 )
-from .joins import JoinedReader, JoinKeys, JoinType, join_datasets  # noqa: F401
+from .joins import (  # noqa: F401
+    JoinedAggregateReader,
+    JoinedReader,
+    JoinKeys,
+    JoinType,
+    TimeBasedFilter,
+    TimeColumn,
+    join_datasets,
+)
 from .streaming import StreamingReader  # noqa: F401
 from .parquet import (  # noqa: F401
     AvroReader,

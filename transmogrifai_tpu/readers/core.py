@@ -36,6 +36,18 @@ class DataReader:
                 f"Raw feature {f.name} must originate from a FeatureGeneratorStage"
             )
             cols[f.name] = stage.extract_column(records)
+        if self.key_fn is not None and "key" not in cols:
+            # keyed readers always carry KeyFieldName in the generated frame
+            # (DataFrameFieldNames.scala) — the join plane depends on it
+            from .. import types as T
+            from ..types.columns import column_from_values
+
+            cols = {
+                "key": column_from_values(
+                    T.ID, [self.key_fn(r) for r in records]
+                ),
+                **cols,
+            }
         return Dataset.of(cols)
 
 
