@@ -95,12 +95,38 @@ def model_insights(workflow_model) -> dict[str, Any]:
         np.zeros(dim)
     )
 
+    raw_types = {f.name: f.ftype.__name__ for f in workflow_model.raw_features}
+    # stage chain per result feature (all derived columns of the model
+    # vector share the lineage of the vector feature)
+    stages_applied: list[str] = []
+    for f in workflow_model.result_features:
+        try:
+            stages_applied = f.history()["stages"]
+            break
+        except Exception:
+            pass
+
+    rff = workflow_model.rff_results or {}
+    rff_metrics = rff.get("rawFeatureDistributions", {})
+    rff_excluded = rff.get("exclusionReasons", [])
+
     features: dict[str, dict[str, Any]] = {}
 
     def record(parent: str, entry: dict[str, Any]) -> None:
-        features.setdefault(
-            parent, {"featureName": parent, "derivedFeatures": []}
-        )["derivedFeatures"].append(entry)
+        if parent not in features:
+            features[parent] = {
+                "featureName": parent,
+                "featureType": raw_types.get(parent, "?"),
+                "derivedFeatures": [],
+                # RawFeatureFilter ledger (FeatureInsights.metrics /
+                # exclusionReasons, ModelInsights.scala:338-348)
+                "metrics": rff_metrics.get(parent, {}),
+                "exclusionReasons": (
+                    rff_excluded.get(parent, [])
+                    if isinstance(rff_excluded, dict) else []
+                ),
+            }
+        features[parent]["derivedFeatures"].append(entry)
 
     if final_meta is not None:
         for j, cm in enumerate(final_meta.columns):
@@ -109,11 +135,16 @@ def model_insights(workflow_model) -> dict[str, Any]:
             record(
                 cm.parent_names[0] if cm.parent_names else "?",
                 {
-                    "columnName": cm.make_name(),
+                    "derivedFeatureName": cm.make_name(),
+                    "stagesApplied": stages_applied,
+                    "derivedFeatureGroup": cm.grouping,
+                    "derivedFeatureValue": cm.indicator_value
+                    or cm.descriptor_value,
                     "indicatorValue": cm.indicator_value,
                     "descriptorValue": cm.descriptor_value,
                     "corr": stats.get("corr_label"),
                     "cramersV": stats.get("cramers_v"),
+                    "mean": stats.get("mean"),
                     "variance": stats.get("variance"),
                     "contribution": float(contributions[j]) if j < len(contributions) else None,
                     "excluded": False,
@@ -125,9 +156,13 @@ def model_insights(workflow_model) -> dict[str, Any]:
             record(
                 stats.get("parent") or stats["name"],
                 {
-                    "columnName": stats["name"],
+                    "derivedFeatureName": stats["name"],
+                    "stagesApplied": stages_applied,
+                    "derivedFeatureGroup": None,
+                    "derivedFeatureValue": None,
                     "corr": stats.get("corr_label"),
                     "cramersV": stats.get("cramers_v"),
+                    "mean": stats.get("mean"),
                     "variance": stats.get("variance"),
                     "contribution": 0.0,
                     "excluded": True,
@@ -135,18 +170,38 @@ def model_insights(workflow_model) -> dict[str, Any]:
                 },
             )
 
+    # stageInfo: uid -> operation + params for every fitted stage
+    # (ModelInsights.stageInfo, RawFeatureFilterConfig etc ride along)
+    stage_info: dict[str, Any] = {}
+    for uid, stage in fitted.items():
+        entry: dict[str, Any] = {
+            "operationName": getattr(stage, "operation_name", type(stage).__name__),
+            "stageClass": type(stage).__name__,
+        }
+        try:
+            entry["params"] = stage.get_params()
+        except Exception:
+            pass
+        stage_info[uid] = entry
+
     sel_summary = selected.summary if selected is not None else None
+    label = workflow_model.label_summary
+    if label is None and workflow_model.selector_info is not None:
+        label = {
+            "labelName": workflow_model.selector_info["labelName"],
+            "problemKind": workflow_model.selector_info["problemKind"],
+        }
+    elif label is not None and workflow_model.selector_info is not None:
+        label = {
+            **label,
+            "problemKind": workflow_model.selector_info["problemKind"],
+        }
     return {
-        "label": (
-            None
-            if workflow_model.selector_info is None
-            else {
-                "labelName": workflow_model.selector_info["labelName"],
-                "problemKind": workflow_model.selector_info["problemKind"],
-            }
-        ),
+        "label": label,
         "features": sorted(features.values(), key=lambda d: d["featureName"]),
         "selectedModelInfo": sel_summary,
+        "trainingParams": workflow_model.training_params,
+        "stageInfo": stage_info,
         "trainRows": workflow_model.train_rows,
         "blocklistedFeatures": workflow_model.blocklisted,
         "rawFeatureFilterResults": workflow_model.rff_results,

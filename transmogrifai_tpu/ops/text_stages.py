@@ -679,11 +679,21 @@ audrey claire eleanor skylar anna caroline maria christopher
 """.split())
 
 
+#: NameDetectUtils.scala:260-262 — honorific tokens (used both for the
+#: name decision and for FindHonorific gender detection)
+_MALE_HONORIFICS = frozenset({"mr", "mister", "sir"})
+_FEMALE_HONORIFICS = frozenset({"ms", "mrs", "miss", "madam"})
+_HONORIFICS = _MALE_HONORIFICS | _FEMALE_HONORIFICS
+
+
 class HumanNameDetector(Estimator):
     """Text → NameStats (HumanNameDetector.scala): decides whether a text
-    column contains person names (dictionary hit-rate >= threshold over the
-    data) and emits per-row name stats. OpenNLP/census data replaced by a
-    compact name dictionary (extendable via ctor)."""
+    column contains person names (dictionary-or-honorific hit-rate >=
+    threshold over the data) and emits per-row name stats with
+    FindHonorific gender (NameDetectUtils.scala:104-108). OpenNLP/census
+    data replaced by a compact name dictionary (extendable via ctor);
+    measured agreement on reference fixtures in
+    tests/test_nlp_fixture_agreement.py."""
 
     input_types = (Text,)
     output_type = NameStats
@@ -710,7 +720,9 @@ class HumanNameDetector(Estimator):
                 continue
             total += 1
             toks = tokenize(v)
-            if toks and any(t in self.names for t in toks):
+            if toks and any(
+                t in self.names or t in _HONORIFICS for t in toks
+            ):
                 hits += 1
         is_name = total > 0 and (hits / total) >= self.threshold
         self.metadata["treatAsName"] = bool(is_name)
@@ -742,11 +754,25 @@ class HumanNameDetectorModel(Model):
                 out.append({"isName": "false"} if v else {})
                 continue
             toks = tokenize(v)
-            is_name = any(t in self.names for t in toks)
+            is_name = any(
+                t in self.names or t in _HONORIFICS for t in toks
+            )
             stats = {"isName": "true" if is_name else "false"}
             if is_name:
                 first = next((t for t in toks if t in self.names), "")
-                stats["firstName"] = first
+                if first:
+                    stats["firstName"] = first
+                # FindHonorific gender (NameDetectUtils.scala:104-108)
+                gender = next(
+                    (
+                        "Male" if t in _MALE_HONORIFICS else "Female"
+                        for t in toks
+                        if t in _HONORIFICS
+                    ),
+                    None,
+                )
+                if gender:
+                    stats["gender"] = gender
             out.append(stats)
         return MapColumn(NameStats, out)
 
