@@ -1,0 +1,187 @@
+"""BadFeatureZoo — constructed leaky/junk features the data-prep layer MUST
+catch, with the specific drop reason asserted.
+
+Parity: core/src/test/.../preparators/BadFeatureZooTest.scala (901 LoC):
+the reference builds zoos of known-bad features and asserts SanityChecker /
+RawFeatureFilter remove them. Each case here states the leak/junk pattern
+and checks both THAT it's dropped and WHY.
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.prep.raw_feature_filter import RawFeatureFilter
+from transmogrifai_tpu.types.columns import NumericColumn, TextColumn
+from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+
+N = 400
+RNG = np.random.default_rng(0)
+
+
+def _label() -> np.ndarray:
+    return (RNG.random(N) > 0.5).astype(np.float64)
+
+
+def _num(vals, ftype=T.Real, mask=None):
+    vals = np.asarray(vals, dtype=np.float64)
+    mask = np.ones(N, bool) if mask is None else mask
+    return NumericColumn(ftype, vals, mask)
+
+
+def _run_checker(cols: dict, **kw):
+    ds = Dataset.of(cols)
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True, **kw), vec)
+    _, stages = fit_and_transform_dag(ds, [checked])
+    checker = next(
+        s for s in stages.values()
+        if s.metadata.get("sanityCheckerSummary") is not None
+    )
+    summary = checker.metadata["sanityCheckerSummary"]
+    dropped = {
+        c["name"]: c["reasons"] for c in summary["columns"] if c["dropped"]
+    }
+    return dropped
+
+
+class TestSanityCheckerZoo:
+    def test_label_copy_is_dropped_for_correlation(self):
+        """The classic leak: a predictor that IS the label."""
+        y = _label()
+        noise = RNG.normal(size=N)
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "leak": _num(y),            # exact copy
+            "ok": _num(noise),
+        })
+        leak_cols = [n for n in dropped if n.startswith("leak")]
+        assert leak_cols, f"label copy survived; dropped={list(dropped)}"
+        assert any(
+            "corrLabel" in r for n in leak_cols for r in dropped[n]
+        )
+
+    def test_noisy_label_proxy_is_dropped(self):
+        y = _label()
+        proxy = y + RNG.normal(scale=0.01, size=N)
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "proxy": _num(proxy),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        assert any(n.startswith("proxy") for n in dropped)
+
+    def test_constant_feature_dropped_for_variance(self):
+        y = _label()
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "constant": _num(np.full(N, 3.25)),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        const_cols = [n for n in dropped if n.startswith("constant")]
+        assert const_cols
+        assert any(
+            "variance" in r for n in const_cols for r in dropped[n]
+        )
+
+    def test_perfectly_predictive_categorical_dropped_for_cramers_v(self):
+        """A picklist that encodes the label exactly (BadFeatureZooTest's
+        gender-predicts-label scenarios)."""
+        y = _label()
+        cat = np.where(y > 0.5, "yes", "no").astype(object)
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "catleak": TextColumn(T.PickList, cat),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        cat_cols = [n for n in dropped if n.startswith("catleak")]
+        assert cat_cols, f"categorical leak survived; dropped={list(dropped)}"
+        reasons = [r for n in cat_cols for r in dropped[n]]
+        assert any(
+            "cramersV" in r or "corrLabel" in r or "ruleConfidence" in r
+            for r in reasons
+        )
+
+    def test_clean_features_survive(self):
+        y = _label()
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "ok1": _num(RNG.normal(size=N)),
+            "ok2": _num(RNG.normal(size=N) + 0.15 * y),  # weak, legitimate
+        })
+        # the VALUE columns survive (their constant all-present null
+        # indicators legitimately drop for zero variance)
+        assert not any(
+            n.startswith("ok") and "NullIndicator" not in n for n in dropped
+        )
+
+
+class TestRawFeatureFilterZoo:
+    def _features(self, cols):
+        ds = Dataset.of(cols)
+        resp, preds = from_dataset(ds, response="label")
+        return ds, resp, preds
+
+    def test_mostly_null_feature_excluded_for_fill_rate(self):
+        y = _label()
+        mask = np.zeros(N, bool)
+        mask[:3] = True
+        ds, resp, preds = self._features({
+            "label": _num(y, T.RealNN),
+            "ghost": _num(RNG.normal(size=N), mask=mask),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        rff = RawFeatureFilter(min_fill=0.1)
+        excluded = rff.compute_exclusions(
+            ds, [resp] + list(preds), label_name="label"
+        )
+        assert "ghost" in excluded
+        assert any(
+            "fillRate" in r for r in rff.results.excluded["ghost"]
+        )
+
+    def test_label_leaking_null_pattern_excluded(self):
+        """Missingness that encodes the label (the reference's
+        null-label-correlation gate)."""
+        y = _label()
+        mask = y > 0.5  # present exactly when label is 1
+        ds, resp, preds = self._features({
+            "label": _num(y, T.RealNN),
+            "nullleak": _num(RNG.normal(size=N), mask=mask),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        rff = RawFeatureFilter(max_null_label_corr=0.2, min_fill=0.0)
+        excluded = rff.compute_exclusions(
+            ds, [resp] + list(preds), label_name="label"
+        )
+        assert "nullleak" in excluded
+        assert any(
+            "nullLabelCorr" in r for r in rff.results.excluded["nullleak"]
+        )
+
+    def test_train_score_drift_excluded_for_js_divergence(self):
+        y = _label()
+        train_vals = RNG.normal(0.0, 1.0, N)
+        score_vals = RNG.normal(25.0, 1.0, N)  # massive shift
+        ds, resp, preds = self._features({
+            "label": _num(y, T.RealNN),
+            "drift": _num(train_vals),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        score_ds = Dataset.of({
+            "drift": _num(score_vals),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        rff = RawFeatureFilter(max_js_divergence=0.5, min_fill=0.0)
+        excluded = rff.compute_exclusions(
+            ds, [resp] + list(preds), score=score_ds, label_name="label"
+        )
+        assert "drift" in excluded
+        assert any(
+            "jsDivergence" in r for r in rff.results.excluded["drift"]
+        )
+        assert "ok" not in excluded

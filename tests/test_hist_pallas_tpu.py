@@ -1,0 +1,99 @@
+"""On-device (real TPU) parity for the fused split kernel.
+
+ADVICE r1: FUSED_SPLIT_MAX_ROWS / lowp behavior was only exercised in
+interpret mode; a Mosaic regression on-device would not be caught. These
+tests run ONLY on a TPU backend (skipped on the CPU-mesh CI run — the
+conftest forces JAX_PLATFORMS=cpu there; run with TPTPU_TPU_TESTS=1 and no
+platform override to exercise them on hardware).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="on-device Mosaic parity tests need a real TPU backend",
+)
+
+
+def _case(n, f, b, k, seed=0):
+    rng = np.random.default_rng(seed)
+    binned = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=(k, n)).astype(np.float32)
+    h = np.abs(rng.normal(size=(k, n))).astype(np.float32) + 0.1
+    node = rng.integers(0, 4, size=(k, n)).astype(np.int32)
+    fmask = np.ones((k, f), np.float32)
+    return binned, node, g, h, fmask
+
+
+@pytest.mark.parametrize("lowp", [False, True])
+def test_fused_split_matches_scatter_on_device(lowp):
+    from transmogrifai_tpu.models.hist_pallas import (
+        build_best_split_pallas,
+        build_histogram_scatter_batched,
+    )
+
+    n, f, b, k, m = 896, 12, 32, 3, 4
+    binned, node, g, h, fmask = _case(n, f, b, k)
+    lam = jnp.full((k,), 1.0)
+    gam = jnp.zeros((k,))
+    mcw = jnp.full((k,), 1.0)
+    bg, bf, bb = build_best_split_pallas(
+        jnp.asarray(binned), jnp.asarray(node), jnp.asarray(g),
+        jnp.asarray(h), jnp.asarray(fmask), lam, gam, mcw,
+        num_nodes=m, num_bins=b, lowp=lowp,
+    )
+    hist = build_histogram_scatter_batched(
+        jnp.asarray(binned), jnp.asarray(node), jnp.asarray(g),
+        jnp.asarray(h), m, b,
+    )
+    hg, hh = hist[..., 0], hist[..., 1]
+    gl = jnp.cumsum(hg, axis=3)[..., :-1]
+    hl = jnp.cumsum(hh, axis=3)[..., :-1]
+    gt = hg.sum(axis=3, keepdims=True)
+    ht = hh.sum(axis=3, keepdims=True)
+    gain = 0.5 * (
+        gl**2 / (hl + 1.0) + (gt - gl) ** 2 / (ht - hl + 1.0)
+        - gt**2 / (ht + 1.0)
+    )
+    valid = (hl >= 1.0) & (ht - hl >= 1.0)
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(k, m, -1)
+    ref_best = np.asarray(jnp.max(flat, axis=2))
+    got = np.asarray(bg)
+    tol = 0.05 if lowp else 1e-3
+    np.testing.assert_allclose(got, ref_best, rtol=tol, atol=tol)
+    # chosen split must achieve (near-)best gain
+    chosen = np.asarray(bf) * (b - 1) + np.asarray(bb)
+    picked = np.take_along_axis(
+        np.asarray(flat), chosen[..., None], axis=2
+    )[..., 0]
+    np.testing.assert_allclose(picked, ref_best, rtol=tol, atol=tol)
+
+
+def test_grow_tree_pallas_vs_scatter_on_device():
+    from transmogrifai_tpu.models import trees as TR
+
+    rng = np.random.default_rng(1)
+    n, f = 1500, 16
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x @ rng.normal(size=f) > 0).astype(np.float32)
+    thr = TR.quantile_thresholds(x, max_bins=32)
+    binned = TR.bin_data(jnp.asarray(x), jnp.asarray(thr))
+    masks = jnp.ones((2, n), jnp.float32)
+    kw = dict(num_rounds=4, max_depth=5, num_bins=32, eta=0.3,
+              objective="binary:logistic")
+    tp, mp = TR.fit_boosted_batched(binned, jnp.asarray(y), masks, **kw)
+    import os
+
+    os.environ["TPTPU_HIST"] = "scatter"
+    try:
+        ts, ms = TR.fit_boosted_batched(binned, jnp.asarray(y), masks, **kw)
+    finally:
+        del os.environ["TPTPU_HIST"]
+    np.testing.assert_array_equal(
+        np.asarray(tp.split_feat), np.asarray(ts.split_feat)
+    )
+    np.testing.assert_allclose(np.asarray(mp), np.asarray(ms), rtol=1e-4)
