@@ -29,23 +29,39 @@ def _init_params(key, sizes: Sequence[int]):
     return params
 
 
-def _forward(params, x):
+def _matmul(h, layer, compute_dtype):
+    """Layer matmul; with a low-precision compute dtype the operands ride
+    the MXU in bf16 while accumulation and bias stay f32 (the standard TPU
+    mixed-precision recipe — params and optimizer state remain f32)."""
+    if compute_dtype is None:
+        return h @ layer["w"] + layer["b"]
+    dot = jax.lax.dot(
+        h.astype(compute_dtype),
+        layer["w"].astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return dot + layer["b"]
+
+
+def _forward(params, x, compute_dtype=None):
     h = x
     for layer in params[:-1]:
-        h = jax.nn.sigmoid(h @ layer["w"] + layer["b"])  # Spark MLP uses sigmoid
-    out = params[-1]
-    return h @ out["w"] + out["b"]
+        # Spark MLP uses sigmoid hidden activations
+        h = jax.nn.sigmoid(_matmul(h, layer, compute_dtype))
+    return _matmul(h, params[-1], compute_dtype)
 
 
-@partial(jax.jit, static_argnames=("sizes", "num_iters"))
-def _train_mlp(x, y1h, row_mask, sizes, num_iters, step_size, seed):
+@partial(jax.jit, static_argnames=("sizes", "num_iters", "compute_dtype"))
+def _train_mlp(x, y1h, row_mask, sizes, num_iters, step_size, seed,
+               compute_dtype=None):
+    cd = jnp.dtype(compute_dtype) if compute_dtype else None
     params = _init_params(jax.random.PRNGKey(seed), sizes)
     opt = optax.adam(step_size)
     opt_state = opt.init(params)
     n = jnp.maximum(row_mask.sum(), 1.0)
 
     def loss_fn(p):
-        logits = _forward(p, x)
+        logits = _forward(p, x, cd)
         ll = optax.softmax_cross_entropy(logits, y1h) * row_mask
         return ll.sum() / n
 
@@ -110,6 +126,7 @@ class MLPClassifier(PredictorEstimator):
         max_iter: int = 100,
         step_size: float = 0.01,
         seed: int = 42,
+        compute_dtype: str | None = None,
         uid: str | None = None,
     ):
         super().__init__("mlp", uid=uid)
@@ -117,6 +134,9 @@ class MLPClassifier(PredictorEstimator):
         self.max_iter = max_iter
         self.step_size = step_size
         self.seed = seed
+        #: e.g. "bfloat16": matmuls ride the MXU in bf16 with f32
+        #: accumulation; params/optimizer state stay f32 (mixed precision)
+        self.compute_dtype = compute_dtype
 
     def get_params(self):
         return {
@@ -124,6 +144,7 @@ class MLPClassifier(PredictorEstimator):
             "max_iter": self.max_iter,
             "step_size": self.step_size,
             "seed": self.seed,
+            "compute_dtype": self.compute_dtype,
         }
 
     def fit_arrays(self, x, y, row_mask):
@@ -139,6 +160,7 @@ class MLPClassifier(PredictorEstimator):
             int(self.max_iter),
             float(self.step_size),
             int(self.seed),
+            compute_dtype=self.compute_dtype,
         )
         self.metadata["finalLoss"] = float(np.asarray(losses)[-1])
         return MLPClassifierModel(params, num_classes)

@@ -345,15 +345,18 @@ def _grow_tree_impl(
             local = node
             compacted = False
 
-        if num_chunks <= 8:
-            cfs, cbs = [], []
-            for ci in range(num_chunks):
-                cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
-                cfs.append(cf)
-                cbs.append(cb)
-            feats_c = jnp.concatenate(cfs, axis=1)[:, :n_nodes]
-            bins_c = jnp.concatenate(cbs, axis=1)[:, :n_nodes]
-        else:
+        def live_level(local=local, n_nodes=n_nodes,
+                       chunk_nodes=chunk_nodes, num_chunks=num_chunks):
+            if num_chunks <= 8:
+                cfs, cbs = [], []
+                for ci in range(num_chunks):
+                    cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
+                    cfs.append(cf)
+                    cbs.append(cb)
+                return (
+                    jnp.concatenate(cfs, axis=1)[:, :n_nodes],
+                    jnp.concatenate(cbs, axis=1)[:, :n_nodes],
+                )
             # many chunks (large-N two-phase path): a shared fori body keeps
             # the program size bounded — Python-unrolling 100+ chunk bodies
             # per level explodes trace/compile time
@@ -371,11 +374,33 @@ def _grow_tree_impl(
             bins_a0 = jnp.zeros(
                 (k_fits, num_chunks * chunk_nodes), dtype=jnp.int32
             )
-            feats_c, bins_c = jax.lax.fori_loop(
+            feats_a, bins_a = jax.lax.fori_loop(
                 0, num_chunks, chunk_body, (feats_a0, bins_a0)
             )
-            feats_c = feats_c[:, :n_nodes]  # [K, n_nodes]
-            bins_c = bins_c[:, :n_nodes]
+            return feats_a[:, :n_nodes], bins_a[:, :n_nodes]
+
+        # ---- early level exit: no-split is hereditary (an unsplit node's
+        # child has the SAME rows, hence the same histogram and the same
+        # failed gain test), so once a level produces zero splits every
+        # deeper level is all-leaves. Skipping the histogram kernels for
+        # those levels is the dominant win for the deep ends of the
+        # reference's maxDepth grid (depth 12 with minInstances 10/100
+        # stops splitting around level 7 on Titanic-sized folds). The
+        # sharded path always computes: its histogram psums would sit
+        # inside a cond branch, and replicated-predicate collectives under
+        # shard_map are not worth the coupling.
+        if d == 0 or axis_name is not None:
+            feats_c, bins_c = live_level()
+        else:
+            feats_c, bins_c = jax.lax.cond(
+                alive,
+                live_level,
+                lambda: (
+                    jnp.full((k_fits, n_nodes), -1, dtype=jnp.int32),
+                    jnp.zeros((k_fits, n_nodes), dtype=jnp.int32),
+                ),
+            )
+        alive = (feats_c >= 0).any()
 
         # write per-slot decisions into the GLOBAL node-slot tree arrays
         if compacted:
@@ -527,16 +552,20 @@ def _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap):
 
 
 def _tree_batch_size(k_fits: int, num_trees: int) -> int:
-    """Trees per grow dispatch. The combined fit axis (K fits × tb trees)
-    is capped so the batched histogram kernels stay inside the per-chunk
-    budgets grow_tree_batched derives from K. TPTPU_TREE_BATCH=1 restores
-    one-dispatch-per-tree (the round-1 behavior) if a runtime regresses."""
+    """Trees per grow dispatch — DEFAULT 1 (one program per tree, reused
+    across the host tree loop). Measured on the real chip (round 2): the
+    Titanic RF sweep with trees folded onto the fit axis (K'=252) ran 4x
+    SLOWER than per-tree dispatch (177 s vs 44 s) — the wide-grid fused
+    split-kernel programs schedule far worse, and dispatch round-trips are
+    negligible (~0.3 ms sync RTT), so there is nothing to amortize.
+    TPTPU_TREE_BATCH=N opts into folding N trees per dispatch for runtimes
+    where dispatch latency actually dominates."""
     import os
 
     env = os.environ.get("TPTPU_TREE_BATCH")
     if env:
         return max(1, int(env))
-    return max(1, min(num_trees, 256 // max(k_fits, 1)))
+    return 1
 
 
 @partial(
@@ -601,15 +630,12 @@ def fit_forest_batched(
     lowp: bool = False,
     mesh=None,
 ) -> Tree:
-    """K random forests batched over the fit axis: chunks of trees ride the
-    SAME batch axis as the fits (combined tree×fit axis, capped at 256 by
-    _tree_batch_size), so a 50-tree × 18-fit sweep is ~4 dispatches instead
-    of 50 — each dispatch pays tunnel RTT. The cap matters: the crash
-    observed in round 1 was a single program CHAINING 50 sequential grows
-    (50× the program size); a wider batch axis on ONE grow is the same
-    program with a bigger kernel grid, validated at 256 combined slots.
-    TPTPU_TREE_BATCH overrides the chunk size (1 = round-1 behavior).
-    Returns stacked Tree arrays [K, T, ...].
+    """K random forests batched over the fit axis: tree t of every fit
+    grows in one program (fit axis = histogram-kernel grid axis); the TREE
+    loop runs on host, reusing that compiled program per dispatch — the
+    measured-fastest shape on the real chip (see _tree_batch_size for the
+    trees-on-the-fit-axis experiment and why it lost). Returns stacked
+    Tree arrays [K, T, ...].
 
     With ``mesh`` set, rows shard over the mesh's data axis and each level's
     histogram psums over it (grows the same trees as the unsharded path —
@@ -635,12 +661,9 @@ def fit_forest_batched(
             num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
             bootstrap=bootstrap, lowp=lowp,
         )
-    # ---- trees ride the FIT axis too: bagged trees are independent, so a
-    # chunk of `tb` trees × K fits grows as one K·tb-fit batched program —
-    # 50 trees × 18 fits collapses from 50 dispatches to 4 (each dispatch
-    # pays the tunnel RTT; this is the dominant fresh-process win). Masks
-    # are drawn per tree exactly as the sequential path would, so the
-    # resulting forests are bit-identical.
+    # tb defaults to 1 (one program per tree — measured fastest on the real
+    # chip; see _tree_batch_size). Masks are drawn per tree exactly as the
+    # sequential path would, so forests are bit-identical at any tb.
     tb = _tree_batch_size(k_fits, num_trees)
     chunks = []
     for t0 in range(0, num_trees, tb):
