@@ -1,0 +1,121 @@
+"""fit_logistic_binary_batched parity with the sequential solver.
+
+The batched GEMM formulation reassociates the per-lane standardization
+(shared x, implicit corrections) — these tests pin it against
+fit_logistic_binary lane-by-lane, including the numerically nasty cases:
+large-mean columns (one-pass variance cancellation) and FOLD-CONSTANT
+columns (phantom cancellation variance whose reciprocal used to amplify
+weights into garbage).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.models.solvers import (
+    fit_logistic_binary,
+    fit_logistic_binary_batched,
+)
+
+
+def _data(seed=0, n=300, d=16):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.mark.parametrize("standardization", [True, False])
+def test_batched_matches_sequential_per_lane(standardization):
+    x, y = _data()
+    k = 4
+    rng = np.random.default_rng(1)
+    masks = (rng.random((k, len(y))) > 0.25).astype(np.float32)
+    regs = np.array([0.001, 0.01, 0.1, 0.2], np.float32)
+    ens = np.array([0.1, 0.5, 0.0, 0.3], np.float32)
+    batched = fit_logistic_binary_batched(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(masks),
+        jnp.asarray(regs), jnp.asarray(ens),
+        num_iters=400, standardization=standardization,
+    )
+    for i in range(k):
+        single = fit_logistic_binary(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(masks[i]),
+            float(regs[i]), float(ens[i]),
+            num_iters=400, standardization=standardization,
+        )
+        np.testing.assert_allclose(
+            np.asarray(single.weights), np.asarray(batched.weights[i]),
+            rtol=0.02, atol=0.02,
+        )
+        np.testing.assert_allclose(
+            float(single.intercept), float(batched.intercept[i]), atol=0.02
+        )
+
+
+def test_large_mean_column_no_cancellation():
+    """One-pass variance on a mean~2000 column must not collapse to 0."""
+    x, y = _data()
+    x[:, 3] += 2000.0
+    masks = np.ones((2, len(y)), np.float32)
+    regs = np.full(2, 0.01, np.float32)
+    ens = np.zeros(2, np.float32)
+    batched = fit_logistic_binary_batched(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(masks),
+        jnp.asarray(regs), jnp.asarray(ens), num_iters=400,
+    )
+    single = fit_logistic_binary(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(masks[0]),
+        0.01, 0.0, num_iters=400,
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.weights), np.asarray(batched.weights[0]),
+        rtol=0.02, atol=0.02,
+    )
+
+
+def test_fold_constant_column_stays_sane():
+    """A column constant within the mask must get (near-)zero weight, not
+    a 1/phantom-std amplified one, in BOTH solvers."""
+    x, y = _data()
+    x[:, 5] = 4.7  # globally constant, non-zero
+    mask = np.ones(len(y), np.float32)
+    mask[:30] = 0.0
+    masks = np.stack([mask, np.ones(len(y), np.float32)])
+    regs = np.full(2, 0.01, np.float32)
+    ens = np.zeros(2, np.float32)
+    batched = fit_logistic_binary_batched(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(masks),
+        jnp.asarray(regs), jnp.asarray(ens), num_iters=400,
+    )
+    single = fit_logistic_binary(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+        0.01, 0.0, num_iters=400,
+    )
+    assert abs(float(single.weights[5])) < 1e-3
+    assert abs(float(batched.weights[0][5])) < 1e-3
+    assert abs(float(single.intercept)) < 50
+    assert abs(float(batched.intercept[0])) < 50
+    # the other coefficients still solve the problem
+    acc = ((x @ np.asarray(batched.weights[0]) + float(batched.intercept[0]) > 0) == (y > 0.5)).mean()
+    assert acc > 0.85
+
+
+def test_estimator_groups_mixed_static_grids():
+    """Grids mixing max_iter values batch per group; unknown keys fall back
+    to sequential — both produce working models."""
+    x, y = _data()
+    masks = [np.ones(len(y), np.float32)]
+    est = LogisticRegression()
+    points = [
+        {"reg_param": 0.01, "max_iter": 50},
+        {"reg_param": 0.1, "max_iter": 50},
+        {"reg_param": 0.01, "max_iter": 100},
+    ]
+    out = est.fit_arrays_batched_masks(x, y.astype(np.float64), masks, points)
+    assert len(out) == 1 and len(out[0]) == 3
+    for m in out[0]:
+        pred, _, _ = m.predict_arrays(x)
+        assert (pred == y).mean() > 0.8

@@ -1,0 +1,152 @@
+"""Property-based tests (hypothesis) over the testkit generators and core
+invariants — the ScalaCheck layer of the reference's test strategy
+(SURVEY.md §4: RandomData generators feed property specs).
+
+Each property states an invariant that must hold for ALL generated inputs,
+not just hand-picked cases: generator typing/determinism, column codec
+round-trips, monoid laws for the aggregators, murmur3 stability, and
+evaluator bounds.
+"""
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import testkit as tk
+from transmogrifai_tpu.features.aggregators import aggregator_of
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils.text import clean_string, murmur3_32, tokenize
+
+SETTINGS = settings(max_examples=60, deadline=None)
+
+
+@SETTINGS
+@given(seed=st.integers(0, 2**31 - 1), n=st.integers(1, 60))
+def test_generators_are_deterministic_per_seed(seed, n):
+    g1 = tk.RandomReal.normal(0.0, 2.0, seed=seed)
+    g2 = tk.RandomReal.normal(0.0, 2.0, seed=seed)
+    c1, c2 = g1.to_column(n), g2.to_column(n)
+    np.testing.assert_array_equal(c1.values, c2.values)
+    np.testing.assert_array_equal(c1.mask, c2.mask)
+
+
+@SETTINGS
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    p_empty=st.floats(0.0, 1.0),
+    n=st.integers(1, 80),
+)
+def test_probability_of_empty_bounds(seed, p_empty, n):
+    g = tk.RandomReal.uniform(seed=seed).with_probability_of_empty(p_empty)
+    col = g.to_column(n)
+    # masked-out entries are exactly the empties; all values remain finite
+    assert col.mask.dtype == bool
+    assert np.isfinite(col.values[col.mask]).all()
+    if p_empty == 0.0:
+        assert col.mask.all()
+    if p_empty == 1.0:
+        assert not col.mask.any()
+
+
+@SETTINGS
+@given(
+    values=st.lists(
+        st.one_of(st.none(), st.floats(-1e6, 1e6, allow_nan=False)),
+        min_size=1, max_size=50,
+    )
+)
+def test_numeric_column_round_trip(values):
+    col = column_from_values(T.Real, values)
+    back = col.to_list()
+    assert len(back) == len(values)
+    for orig, got in zip(values, back):
+        if orig is None:
+            assert got is None
+        else:
+            assert got is not None and abs(got - orig) <= 1e-6 * max(1, abs(orig))
+
+
+@SETTINGS
+@given(
+    values=st.lists(st.one_of(st.none(), st.text(max_size=20)),
+                    min_size=1, max_size=50)
+)
+def test_text_column_round_trip(values):
+    col = column_from_values(T.Text, values)
+    # "" normalizes to None (missing) — the reader/codec convention
+    assert col.to_list() == [v if v else None for v in values]
+
+
+@SETTINGS
+@given(
+    a=st.lists(st.floats(-1e6, 1e6, allow_nan=False), max_size=10),
+    b=st.lists(st.floats(-1e6, 1e6, allow_nan=False), max_size=10),
+    c=st.lists(st.floats(-1e6, 1e6, allow_nan=False), max_size=10),
+)
+def test_real_aggregator_monoid_laws(a, b, c):
+    """associativity + zero identity for the Real monoid (Algebird laws)."""
+    agg = aggregator_of(T.Real)
+
+    def fold(vals):
+        acc = agg.zero
+        for v in vals:
+            acc = agg.plus(acc, agg.prepare(v))
+        return acc
+
+    left = agg.plus(agg.plus(fold(a), fold(b)), fold(c))
+    right = agg.plus(fold(a), agg.plus(fold(b), fold(c)))
+    if left is None or right is None:
+        assert left == right
+    else:
+        np.testing.assert_allclose(left, right, rtol=1e-9)
+    # zero identity
+    x = fold(a)
+    assert agg.plus(agg.zero, x) == agg.plus(x, agg.zero)
+
+
+@SETTINGS
+@given(s=st.text(max_size=60))
+def test_murmur3_matches_itself_and_is_stable(s):
+    h1 = murmur3_32(s)
+    h2 = murmur3_32(s.encode("utf-8"))
+    assert h1 == h2
+    assert 0 <= h1 < 2**32
+
+
+@SETTINGS
+@given(s=st.text(max_size=60))
+def test_tokenize_tokens_are_clean(s):
+    for t in tokenize(s):
+        assert t == t.lower()
+        assert len(t) >= 1
+        # tokens never contain separators or underscores (_TOKEN_RE)
+        assert not any(ch.isspace() or ch == "_" for ch in t)
+
+
+@SETTINGS
+@given(s=st.text(max_size=60))
+def test_clean_string_idempotent_shape(s):
+    cleaned = clean_string(s)
+    # cleaning twice changes nothing except case normalization of the
+    # already-cleaned form (capitalize is stable on CamelCase words)
+    assert clean_string(cleaned) == clean_string(clean_string(cleaned))
+    assert " " not in cleaned
+
+
+@SETTINGS
+@given(
+    y=st.lists(st.integers(0, 1), min_size=4, max_size=60),
+    seed=st.integers(0, 1000),
+)
+def test_binary_evaluator_metric_bounds(y, seed):
+    from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+
+    y = np.asarray(y, dtype=np.float64)
+    if y.sum() == 0 or y.sum() == len(y):
+        y[0] = 1.0 - y[0]  # ensure both classes present
+    rng = np.random.default_rng(seed)
+    prob1 = rng.random(len(y))
+    prob = np.stack([1 - prob1, prob1], axis=1)
+    pred = (prob1 > 0.5).astype(np.float64)
+    m = BinaryClassificationEvaluator().evaluate_arrays(y, pred, prob)
+    for key in ("AuROC", "AuPR", "Precision", "Recall", "F1"):
+        assert 0.0 <= m[key] <= 1.0, (key, m[key])

@@ -10,9 +10,14 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from .base import PredictorEstimator, PredictorModel
-from .solvers import fit_logistic_binary, fit_logistic_multinomial
+from .solvers import (
+    fit_logistic_binary,
+    fit_logistic_binary_batched,
+    fit_logistic_multinomial,
+)
 
 
 class LogisticRegressionModel(PredictorModel):
@@ -120,6 +125,7 @@ class LogisticRegression(PredictorEstimator):
                 float(self.elastic_net_param),
                 num_iters=iters,
                 fit_intercept=self.fit_intercept,
+                standardization=self.standardization,
             )
         else:
             params = fit_logistic_multinomial(
@@ -131,6 +137,7 @@ class LogisticRegression(PredictorEstimator):
                 num_classes=num_classes,
                 num_iters=iters,
                 fit_intercept=self.fit_intercept,
+                standardization=self.standardization,
             )
         return LogisticRegressionModel(
             np.asarray(params.weights), np.asarray(params.intercept), num_classes
@@ -139,13 +146,32 @@ class LogisticRegression(PredictorEstimator):
     # ---- batched sweeps (SURVEY.md §2.6: the reference's driver thread
     # pool becomes a batch axis of one compiled program) -------------------
 
-    def _is_vmappable(self, p: dict) -> bool:
-        # only reg/elastic-net vary inside the vmap; any other overridden
-        # param must match this estimator's static value
-        return all(
-            k in ("reg_param", "elastic_net_param") or v == getattr(self, k)
-            for k, v in p.items()
-        )
+    _KNOWN_KEYS = frozenset(
+        ("reg_param", "elastic_net_param", "fit_intercept", "max_iter",
+         "standardization")
+    )
+
+    def _static_groups(self, points) -> tuple[dict, list[int]]:
+        """Group point indices by their STATIC params (fit_intercept,
+        max_iter, standardization) — reg/elastic-net vary freely inside a
+        group and batch as GEMM lanes. Points carrying unknown keys fall
+        out to the sequential list. (Round 1 compared statics against the
+        estimator's ctor defaults, so the default grid's max_iter=50 vs
+        ctor 100 silently disabled batching — every default sweep ran 24
+        sequential fits.)"""
+        groups: dict[tuple, list[int]] = {}
+        sequential: list[int] = []
+        for i, p in enumerate(points):
+            if set(p) - self._KNOWN_KEYS:
+                sequential.append(i)
+                continue
+            key = (
+                bool(p.get("fit_intercept", self.fit_intercept)),
+                int(p.get("max_iter", self.max_iter)),
+                bool(p.get("standardization", self.standardization)),
+            )
+            groups.setdefault(key, []).append(i)
+        return groups, sequential
 
     def _grid_values(self, points) -> tuple[np.ndarray, np.ndarray]:
         regs = np.asarray(
@@ -158,70 +184,67 @@ class LogisticRegression(PredictorEstimator):
         )
         return regs, ens
 
-    def _vmapped_fit(self, x, y, num_classes: int):
-        """fit fn of (reg, elastic_net, row_mask) for the vmapped sweep;
-        callers pass x already padded/sharded via _mesh_rows."""
-        iters = self.max_iter * 4
-        if num_classes == 2:
-            return lambda r, e, m: fit_logistic_binary(
-                x, y, m, r, e, num_iters=iters,
-                fit_intercept=self.fit_intercept,
-            )
-        return lambda r, e, m: fit_logistic_multinomial(
-            x, y, m, r, e, num_classes=num_classes,
-            num_iters=iters, fit_intercept=self.fit_intercept,
-        )
-
     @staticmethod
     def _num_classes(y, any_mask) -> int:
         present = y[any_mask > 0]
         return max(int(present.max()) + 1 if len(present) else 2, 2)
 
     def fit_arrays_batched(self, x, y, row_mask, grid_points):
-        """One mask, many grid points — vmappable points train in one
-        program; stragglers fall back to sequential fits."""
-        vmappable = [i for i, p in enumerate(grid_points) if self._is_vmappable(p)]
-        rest = [i for i in range(len(grid_points)) if i not in vmappable]
-        num_classes = self._num_classes(y, row_mask)
-        models: dict[int, LogisticRegressionModel] = {}
-        if vmappable:
-            regs, ens = self._grid_values([grid_points[i] for i in vmappable])
-            xp, yp, rmp = self._mesh_rows(x, y, row_mask)
-            rm = np.broadcast_to(rmp, (len(vmappable), len(yp)))
-            stacked = jax.vmap(self._vmapped_fit(xp, yp, num_classes))(regs, ens, rm)
-            w = np.asarray(stacked.weights)
-            b = np.asarray(stacked.intercept)
-            for j, i in enumerate(vmappable):
-                models[i] = LogisticRegressionModel(w[j], b[j], num_classes)
-        for i in rest:
-            models[i] = self.with_params(**grid_points[i]).fit_arrays(x, y, row_mask)
-        return [models[i] for i in range(len(grid_points))]
+        """One mask, many grid points — same-static groups batch into one
+        program each; points with unknown params fit sequentially."""
+        return self.fit_arrays_batched_masks(x, y, [row_mask], grid_points)[0]
+
+    def _batched_fit(self, xp, yp, rm, regs, ens, num_classes, statics):
+        fit_intercept, max_iter, standardization = statics
+        if num_classes == 2:
+            # shared-x GEMM sweep (see fit_logistic_binary_batched)
+            return fit_logistic_binary_batched(
+                jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(rm),
+                jnp.asarray(regs), jnp.asarray(ens),
+                num_iters=max_iter * 4,
+                fit_intercept=fit_intercept,
+                standardization=standardization,
+            )
+        return jax.vmap(
+            lambda r, e, m: fit_logistic_multinomial(
+                xp, yp, m, r, e, num_classes=num_classes,
+                num_iters=max_iter * 4, fit_intercept=fit_intercept,
+                standardization=standardization,
+            )
+        )(regs, ens, rm)
 
     def fit_arrays_batched_masks(self, x, y, masks, grid_points):
-        """Folds × grid in ONE vmapped program: the fit axis carries
-        (fold-mask, reg, elastic-net) triples, so the validator's whole
-        sweep is a single dispatch. Non-vmappable points fall back to the
-        per-fold batched path."""
-        if not all(self._is_vmappable(p) for p in grid_points):
-            return [
-                self.fit_arrays_batched(x, y, m, grid_points) for m in masks
-            ]
+        """Folds × grid in as few programs as the grid's static params
+        allow: each same-(fit_intercept, max_iter, standardization) group
+        batches (fold-mask, reg, elastic-net) triples onto the fit axis
+        (binary: shared-x GEMM FISTA); points with unknown params fall back
+        to sequential fits."""
+        masks = [np.asarray(m, dtype=np.float32) for m in masks]
+        groups, sequential = self._static_groups(grid_points)
         num_classes = self._num_classes(y, np.max(np.stack(masks), axis=0))
-        n_pts = len(grid_points)
-        regs, ens = self._grid_values(list(grid_points) * len(masks))
-        xp, yp, masksp = self._mesh_rows(x, y, np.stack(masks))
-        rm = np.repeat(
-            masksp, n_pts, axis=0
-        )  # [K, N], mask-major to match regs/ens tiling
-        stacked = jax.vmap(self._vmapped_fit(xp, yp, num_classes))(regs, ens, rm)
-        w = np.asarray(stacked.weights)
-        b = np.asarray(stacked.intercept)
-        return [
-            [
-                LogisticRegressionModel(
-                    w[mi * n_pts + j], b[mi * n_pts + j], num_classes
+        n_masks = len(masks)
+        models: list[list] = [[None] * len(grid_points) for _ in masks]
+        if groups:
+            xp, yp, masksp = self._mesh_rows(x, y, np.stack(masks))
+            for statics, idxs in groups.items():
+                pts = [grid_points[i] for i in idxs]
+                regs, ens = self._grid_values(pts * n_masks)
+                rm = np.repeat(
+                    masksp, len(pts), axis=0
+                )  # [K, N], mask-major to match regs/ens tiling
+                stacked = self._batched_fit(
+                    xp, yp, rm, regs, ens, num_classes, statics
                 )
-                for j in range(n_pts)
-            ]
-            for mi in range(len(masks))
-        ]
+                w = np.asarray(stacked.weights)
+                b = np.asarray(stacked.intercept)
+                for mi in range(n_masks):
+                    for j, i in enumerate(idxs):
+                        models[mi][i] = LogisticRegressionModel(
+                            w[mi * len(pts) + j], b[mi * len(pts) + j],
+                            num_classes,
+                        )
+        for i in sequential:
+            est = self.with_params(**grid_points[i])
+            for mi, m in enumerate(masks):
+                models[mi][i] = est.fit_arrays(x, y, m)
+        return models

@@ -30,13 +30,27 @@ class GLMParams(NamedTuple):
     intercept: jax.Array  # scalar or [C]
 
 
+def _effectively_constant(std: jax.Array, scale: jax.Array) -> jax.Array:
+    """Columns whose std is ~float-noise relative to their magnitude.
+
+    An exact `std > 0` check misses fold-constant columns: a column stuck
+    at c within the mask computes var ≈ (c·eps)² > 0 through float
+    cancellation, and dividing by that phantom std amplifies weights into
+    garbage. Treat std below ~1e-5 of the column's RMS magnitude as zero
+    (SanityChecker drops genuinely tiny-variance columns anyway)."""
+    return std <= jnp.maximum(1e-5 * scale, 1e-12)
+
+
 def _standardize(x: jax.Array, row_mask: jax.Array):
     n = jnp.maximum(row_mask.sum(), 1.0)
     mean = (x * row_mask[:, None]).sum(0) / n
     var = ((x - mean) ** 2 * row_mask[:, None]).sum(0) / n
     std = jnp.sqrt(var)
-    safe = jnp.where(std > 0, std, 1.0)
+    const = _effectively_constant(std, jnp.sqrt(var + mean**2))
+    safe = jnp.where(const, 1.0, std)
     xs = jnp.where(row_mask[:, None], (x - mean) / safe, 0.0)
+    # zero the constant columns entirely: (x - mean) there is pure noise
+    xs = jnp.where(const[None, :], 0.0, xs)
     return xs, mean, safe
 
 
@@ -59,7 +73,10 @@ def _fista(grad_fn, prox_fn, w0, step, num_iters):
     return w
 
 
-@partial(jax.jit, static_argnames=("num_iters", "fit_intercept"))
+@partial(
+    jax.jit,
+    static_argnames=("num_iters", "fit_intercept", "standardization"),
+)
 def fit_logistic_binary(
     x: jax.Array,          # [N, D]
     y: jax.Array,          # [N] in {0, 1}
@@ -68,12 +85,18 @@ def fit_logistic_binary(
     elastic_net: jax.Array,  # alpha in [0, 1]
     num_iters: int = 200,
     fit_intercept: bool = True,
+    standardization: bool = True,
 ) -> GLMParams:
     """Binary logistic regression (OpLogisticRegression parity —
     core/.../classification/OpLogisticRegression.scala wraps Spark LR)."""
     row_mask = row_mask.astype(x.dtype)
     n = jnp.maximum(row_mask.sum(), 1.0)
-    xs, mean, std = _standardize(x, row_mask)
+    if standardization:
+        xs, mean, std = _standardize(x, row_mask)
+    else:
+        xs = jnp.where(row_mask[:, None] > 0, x, 0.0)
+        mean = jnp.zeros(x.shape[1], dtype=x.dtype)
+        std = jnp.ones(x.shape[1], dtype=x.dtype)
     l1 = reg_param * elastic_net
     l2 = reg_param * (1.0 - elastic_net)
 
@@ -103,7 +126,123 @@ def fit_logistic_binary(
     return GLMParams(weights=w, intercept=jnp.where(fit_intercept, b, 0.0))
 
 
-@partial(jax.jit, static_argnames=("num_classes", "num_iters", "fit_intercept"))
+@partial(
+    jax.jit,
+    static_argnames=("num_iters", "fit_intercept", "standardization"),
+)
+def fit_logistic_binary_batched(
+    x: jax.Array,           # [N, D] SHARED feature matrix
+    y: jax.Array,           # [N]
+    row_masks: jax.Array,   # [K, N] per-fit masks (folds × grid)
+    reg_params: jax.Array,  # [K]
+    elastic_nets: jax.Array,  # [K]
+    num_iters: int = 200,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+) -> GLMParams:
+    """K binary logistic fits sharing ONE feature matrix.
+
+    The round-1 sweep vmapped fit_logistic_binary, which materializes K
+    per-lane standardized COPIES of x ([K, N, D] — 3 GB for the Titanic
+    sweep) and turns every FISTA iteration into a memory-bound pass over
+    them. Here lanes batch as GEMM columns on the shared x (two MXU
+    matmuls per iteration: logits = x @ (W/std)ᵀ and gradients = r @ x),
+    with per-lane standardization applied IMPLICITLY:
+        xsᵀr = (xᵀ(r·m) − mean·Σ(r·m)) / std
+    Identical math to the vmapped path, reassociated — weights agree to
+    float tolerance. Returns GLMParams with weights [K, D], intercept [K].
+    """
+    k_fits, _ = row_masks.shape
+    rm = row_masks.astype(x.dtype)
+    n = jnp.maximum(rm.sum(axis=1), 1.0)                 # [K]
+    # shifted-data moments: center on the GLOBAL column means first so the
+    # one-pass per-lane variance s2c/n - mean_c² operates on small values —
+    # the raw one-pass form catastrophically cancels in f32 for large-mean
+    # columns (mean² ~4e6 has float spacing ~0.5). Without standardization
+    # the model must NOT center (iterates match the sequential raw-x path),
+    # so gshift/mean_c stay zero and s2 is the raw second moment.
+    if standardization:
+        gshift = x.mean(axis=0)                          # [D]
+    else:
+        gshift = jnp.zeros(x.shape[1], dtype=x.dtype)
+    xc = x - gshift[None, :]
+    s1 = rm @ xc                                         # [K, D]
+    s2 = rm @ (xc * xc)                                  # [K, D]
+    mean_raw = s1 / n[:, None]
+    var = jnp.maximum(s2 / n[:, None] - mean_raw**2, 0.0)
+    std = jnp.sqrt(var)
+    # see _effectively_constant: fold-constant columns carry phantom
+    # cancellation variance; their std must not be divided by
+    const = _effectively_constant(std, jnp.sqrt(s2 / n[:, None]))
+    if standardization:
+        mean_c = mean_raw
+        safe = jnp.where(const, 1.0, std)
+    else:
+        mean_c = jnp.zeros_like(mean_raw)
+        safe = jnp.ones_like(std)
+    l1 = (reg_params * elastic_nets)[:, None]            # [K, 1]
+    l2 = (reg_params * (1.0 - elastic_nets))[:, None]
+
+    def grads(params):
+        w_std, b = params[:, :-1], params[:, -1]
+        ws = w_std / safe                                # [K, D]
+        logits = (xc @ ws.T).T - (mean_c * ws).sum(axis=1)[:, None]
+        logits = logits + jnp.where(fit_intercept, b[:, None], 0.0)
+        p = jax.nn.sigmoid(logits)
+        r = (p - y[None, :]) * rm                        # [K, N]
+        xr = r @ xc                                      # [K, D]
+        rsum = r.sum(axis=1)[:, None]
+        gw = (xr - mean_c * rsum) / safe / n[:, None] + l2 * w_std
+        if standardization:
+            # constant columns are pure cancellation noise: pin their
+            # weights at 0 (matches _standardize zeroing those columns)
+            gw = jnp.where(const, 0.0, gw)
+        gb = jnp.where(fit_intercept, rsum[:, 0] / n, 0.0)
+        return jnp.concatenate([gw, gb[:, None]], axis=1)
+
+    # tr(XsᵀXs)/n per lane: standardized columns have unit variance (0 for
+    # constant columns) → count of non-constant columns; without
+    # standardization it is the raw masked second moment per column
+    if standardization:
+        col_sum = (~const).sum(axis=1).astype(x.dtype)
+    else:
+        col_sum = (s2 / n[:, None]).sum(axis=1)
+    lip = 0.25 * col_sum + l2[:, 0]
+    step = (1.0 / jnp.maximum(lip, 1e-6))[:, None]       # [K, 1]
+
+    params0 = jnp.zeros((k_fits, x.shape[1] + 1), dtype=x.dtype)
+
+    def body(carry, _):
+        w_prev, z, t = carry
+        g = grads(z)
+        moved = z - step * g
+        w_next = jnp.concatenate(
+            [_soft_threshold(moved[:, :-1], step * l1), moved[:, -1:]],
+            axis=1,
+        )
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_next = w_next + ((t - 1.0) / t_next) * (w_next - w_prev)
+        return (w_next, z_next, t_next), None
+
+    (params, _, _), _ = jax.lax.scan(
+        body, (params0, params0, jnp.array(1.0)), None, length=num_iters
+    )
+    w_std, b_std = params[:, :-1], params[:, -1]
+    w = w_std / safe
+    mean_total = gshift[None, :] + mean_c
+    b = b_std - (w_std * mean_total / safe).sum(axis=1)
+    return GLMParams(
+        weights=w,
+        intercept=jnp.where(fit_intercept, b, jnp.zeros_like(b)),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "num_classes", "num_iters", "fit_intercept", "standardization"
+    ),
+)
 def fit_logistic_multinomial(
     x: jax.Array,
     y: jax.Array,          # [N] int class ids
@@ -113,11 +252,17 @@ def fit_logistic_multinomial(
     num_classes: int,
     num_iters: int = 200,
     fit_intercept: bool = True,
+    standardization: bool = True,
 ) -> GLMParams:
     """Softmax regression (Spark multinomial logistic parity)."""
     row_mask = row_mask.astype(x.dtype)
     n = jnp.maximum(row_mask.sum(), 1.0)
-    xs, mean, std = _standardize(x, row_mask)
+    if standardization:
+        xs, mean, std = _standardize(x, row_mask)
+    else:
+        xs = jnp.where(row_mask[:, None] > 0, x, 0.0)
+        mean = jnp.zeros(x.shape[1], dtype=x.dtype)
+        std = jnp.ones(x.shape[1], dtype=x.dtype)
     y1h = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=x.dtype)
     l1 = reg_param * elastic_net
     l2 = reg_param * (1.0 - elastic_net)

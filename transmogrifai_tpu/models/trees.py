@@ -949,15 +949,19 @@ def _fit_forest_batched_sharded(
             binned_p, gb, ones, rmask, fmask,
             zero, zero, jnp.tile(mi, tc), jnp.tile(mg, tc),
         )
+        # pull each replicated chunk to HOST before reshaping: eagerly
+        # reshaping/concatenating multi-device arrays dispatches per-device
+        # ops per tree, which intermittently aborts the XLA:CPU async
+        # runtime (memory: xla-cpu-mesh-gotchas); trees are tiny
         chunks.append(
             jax.tree.map(
-                lambda a: jnp.swapaxes(
-                    a.reshape((tc, k_fits) + a.shape[1:]), 0, 1
+                lambda a: np.swapaxes(
+                    np.asarray(a).reshape((tc, k_fits) + a.shape[1:]), 0, 1
                 ),
                 tree,
             )
         )
-    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1), *chunks)
+    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=1), *chunks)
 
 
 @lru_cache(maxsize=None)
@@ -1026,8 +1030,12 @@ def _fit_boosted_batched_sharded(
         trees_c, margin = kern(
             binned_p, y_p, rm_p, margin, eta_v, lam, gam, mcw, mig
         )
-        chunks.append(trees_c)
+        # host-fetch each chunk's replicated trees — eager multi-device
+        # reshapes intermittently abort the XLA:CPU async runtime (memory:
+        # xla-cpu-mesh-gotchas); margin stays DEVICE-resident as the next
+        # chunk's carry
+        chunks.append(jax.tree.map(lambda a: np.asarray(a), trees_c))
         done += rc
-    trees = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *chunks)
-    trees = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees)
-    return trees, margin[:, :n]
+    trees = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *chunks)
+    trees = jax.tree.map(lambda a: np.swapaxes(a, 0, 1), trees)
+    return trees, np.asarray(margin)[:, :n]

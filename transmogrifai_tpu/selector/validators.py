@@ -64,6 +64,13 @@ class Validator:
     def split_masks(self, y: np.ndarray) -> list[tuple[np.ndarray, np.ndarray]]:
         raise NotImplementedError
 
+    #: candidate-fit parallelism (OpValidator.scala:371-379 default 8).
+    #: Families sweep in a thread pool: device executions serialize on the
+    #: chip anyway (they are milliseconds — see BASELINE.md round 2), but
+    #: each family's program acquisition (tracing + XLA compile-cache
+    #: round-trips, the actual wall-clock cost) overlaps across threads.
+    parallelism: int = 8
+
     def validate(
         self,
         candidates: Sequence[tuple[PredictorEstimator, dict[str, Sequence[Any]]]],
@@ -74,18 +81,44 @@ class Validator:
         """Fit every model family x grid point on every fold; returns results
         with per-fold metric values. Failed families are skipped
         (OpValidator.scala:318-357); raises only if everything failed."""
+        from concurrent.futures import ThreadPoolExecutor
+
         folds = self.split_masks(y)
         results: list[CandidateResult] = []
         errors: list[str] = []
-        for est, grid in candidates:
-            points = expand_grid(grid)
-            try:
-                results.extend(
-                    self._sweep_family(est, points, folds, x, y, evaluator)
+
+        def run(est, grid):
+            return self._sweep_family(
+                est, expand_grid(grid), folds, x, y, evaluator
+            )
+
+        import jax
+
+        # threads only on a single device: with a multi-device mesh the
+        # sweep is already device-parallel, and concurrent multi-device
+        # dispatch intermittently aborts the XLA:CPU async runtime (see
+        # memory: xla-cpu-mesh-gotchas). max_workers=1 serializes through
+        # the same code path.
+        if len(jax.devices()) > 1:
+            n_workers = 1
+        else:
+            n_workers = max(1, min(self.parallelism, len(candidates)))
+        with ThreadPoolExecutor(max_workers=n_workers) as pool:
+            futs = [pool.submit(run, est, grid) for est, grid in candidates]
+            outs = []
+            for f in futs:
+                try:
+                    outs.append(f.result())
+                except Exception as e:
+                    outs.append(e)
+        for (est, _), out in zip(candidates, outs):
+            if isinstance(out, Exception):  # candidate-level isolation
+                log.warning(
+                    "Model %s failed validation: %s", type(est).__name__, out
                 )
-            except Exception as e:  # candidate-level isolation
-                log.warning("Model %s failed validation: %s", type(est).__name__, e)
-                errors.append(f"{type(est).__name__}: {e}")
+                errors.append(f"{type(est).__name__}: {out}")
+            else:
+                results.extend(out)
         if not results:
             raise RuntimeError(
                 f"All model candidates failed validation: {errors}"
