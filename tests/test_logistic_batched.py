@@ -27,6 +27,28 @@ def _data(seed=0, n=300, d=16):
     return x, y
 
 
+def test_no_intercept_scales_without_centering():
+    """fit_intercept=False must SCALE but not center (Spark parity):
+    a centered fit would differ from predict-time x@w by mean·w."""
+    x, y = _data()
+    x += 5.0  # non-zero means expose implicit-intercept bugs
+    masks = np.ones((1, len(y)), np.float32)
+    b = fit_logistic_binary_batched(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(masks),
+        jnp.asarray(np.full(1, 0.01, np.float32)),
+        jnp.asarray(np.zeros(1, np.float32)),
+        num_iters=400, fit_intercept=False,
+    )
+    s = fit_logistic_binary(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(masks[0]),
+        0.01, 0.0, num_iters=400, fit_intercept=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s.weights), np.asarray(b.weights[0]), atol=0.01
+    )
+    assert float(b.intercept[0]) == 0.0 and float(s.intercept) == 0.0
+
+
 @pytest.mark.parametrize("standardization", [True, False])
 def test_batched_matches_sequential_per_lane(standardization):
     x, y = _data()

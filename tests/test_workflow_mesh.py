@@ -80,8 +80,11 @@ def test_selector_output_identical_sharded_vs_not():
             # iterations, so float reassociation (shard reduction order)
             # legitimately moves fold metrics — the reference's
             # distributed L-BFGS has the same run-to-run property. Assert
-            # sanity bounds, not bit parity.
-            assert all(0.3 < v <= 1.0 for v in r8["metricValues"])
+            # BOUNDED two-sided drift, not bit parity.
+            assert len(r1["metricValues"]) == len(r8["metricValues"])
+            for v1, v8 in zip(r1["metricValues"], r8["metricValues"]):
+                assert 0.3 < v1 <= 1.0 and 0.3 < v8 <= 1.0
+                assert abs(v1 - v8) <= 0.35
     # the selected model (trees) must score identically either way
     np.testing.assert_allclose(
         s1["holdoutEvaluation"]["AuPR"], s8["holdoutEvaluation"]["AuPR"],

@@ -197,13 +197,18 @@ class LogisticRegression(PredictorEstimator):
     def _batched_fit(self, xp, yp, rm, regs, ens, num_classes, statics):
         fit_intercept, max_iter, standardization = statics
         if num_classes == 2:
+            from ..utils.aot import aot_call
+
             # shared-x GEMM sweep (see fit_logistic_binary_batched)
-            return fit_logistic_binary_batched(
-                jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(rm),
-                jnp.asarray(regs), jnp.asarray(ens),
-                num_iters=max_iter * 4,
-                fit_intercept=fit_intercept,
-                standardization=standardization,
+            return aot_call(
+                "logistic_binary_batched", fit_logistic_binary_batched,
+                (
+                    jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(rm),
+                    jnp.asarray(regs), jnp.asarray(ens),
+                ),
+                dict(num_iters=max_iter * 4,
+                     fit_intercept=fit_intercept,
+                     standardization=standardization),
             )
         return jax.vmap(
             lambda r, e, m: fit_logistic_multinomial(

@@ -30,15 +30,21 @@ class GLMParams(NamedTuple):
     intercept: jax.Array  # scalar or [C]
 
 
-def _effectively_constant(std: jax.Array, scale: jax.Array) -> jax.Array:
+def _effectively_constant(
+    std: jax.Array, scale: jax.Array, rel_tol: float = 1e-5
+) -> jax.Array:
     """Columns whose std is ~float-noise relative to their magnitude.
 
     An exact `std > 0` check misses fold-constant columns: a column stuck
     at c within the mask computes var ≈ (c·eps)² > 0 through float
     cancellation, and dividing by that phantom std amplifies weights into
-    garbage. Treat std below ~1e-5 of the column's RMS magnitude as zero
-    (SanityChecker drops genuinely tiny-variance columns anyway)."""
-    return std <= jnp.maximum(1e-5 * scale, 1e-12)
+    garbage. ``rel_tol`` calibrates to the variance formula's error: the
+    two-pass centered sum cancels to ~eps·c (1e-5 covers it); the ONE-PASS
+    s2/n − mean² form accumulates ~sqrt(N)·eps·c² of noise, i.e. phantom
+    std up to ~2e-3·c on ~1k-row folds, and needs ~3e-3 (columns with a
+    genuine coefficient of variation below 0.3% are treated as constant —
+    a documented trade for not materializing per-lane centered copies)."""
+    return std <= jnp.maximum(rel_tol * scale, 1e-12)
 
 
 def _standardize(x: jax.Array, row_mask: jax.Array):
@@ -93,6 +99,12 @@ def fit_logistic_binary(
     n = jnp.maximum(row_mask.sum(), 1.0)
     if standardization:
         xs, mean, std = _standardize(x, row_mask)
+        if not fit_intercept:
+            # Spark parity: without an intercept, standardization SCALES
+            # but does not center — centering would bake an implicit
+            # intercept (mean·w) into training that predict never applies
+            mean = jnp.zeros(x.shape[1], dtype=x.dtype)
+            xs = jnp.where(row_mask[:, None] > 0, x / std, 0.0)
     else:
         xs = jnp.where(row_mask[:, None] > 0, x, 0.0)
         mean = jnp.zeros(x.shape[1], dtype=x.dtype)
@@ -172,14 +184,25 @@ def fit_logistic_binary_batched(
     var = jnp.maximum(s2 / n[:, None] - mean_raw**2, 0.0)
     std = jnp.sqrt(var)
     # see _effectively_constant: fold-constant columns carry phantom
-    # cancellation variance; their std must not be divided by
-    const = _effectively_constant(std, jnp.sqrt(s2 / n[:, None]))
+    # cancellation variance; their std must not be divided by. The wider
+    # 3e-3 tolerance matches the ONE-PASS formula's error bound (e.g. a
+    # rare one-hot absent from one fold: xc ≡ −p in-mask, var = p²−p²
+    # cancellation noise ~2e-3·p escapes a 1e-5 gate)
+    const = _effectively_constant(std, jnp.sqrt(s2 / n[:, None]), rel_tol=3e-3)
     if standardization:
-        mean_c = mean_raw
         safe = jnp.where(const, 1.0, std)
+        if fit_intercept:
+            mean_c = mean_raw
+        else:
+            # no intercept → scale only, never center (Spark parity; a
+            # centered fit would differ from predict by mean·w). Gradients
+            # must then see RAW x, so undo the moment shift.
+            mean_c = jnp.zeros_like(mean_raw)
+            xc = x
     else:
         mean_c = jnp.zeros_like(mean_raw)
         safe = jnp.ones_like(std)
+        xc = x
     l1 = (reg_params * elastic_nets)[:, None]            # [K, 1]
     l2 = (reg_params * (1.0 - elastic_nets))[:, None]
 
@@ -200,11 +223,16 @@ def fit_logistic_binary_batched(
         gb = jnp.where(fit_intercept, rsum[:, 0] / n, 0.0)
         return jnp.concatenate([gw, gb[:, None]], axis=1)
 
-    # tr(XsᵀXs)/n per lane: standardized columns have unit variance (0 for
-    # constant columns) → count of non-constant columns; without
-    # standardization it is the raw masked second moment per column
-    if standardization:
+    # tr(XsᵀXs)/n per lane: centered standardized columns have unit
+    # variance (0 for constant columns) → count of non-constant columns.
+    # Scaled-but-NOT-centered columns (fit_intercept=False) have second
+    # moment (var + mean²)/std² ≥ 1; without standardization it is the raw
+    # masked second moment per column.
+    if standardization and fit_intercept:
         col_sum = (~const).sum(axis=1).astype(x.dtype)
+    elif standardization:
+        raw_second = var + (gshift[None, :] + mean_raw) ** 2
+        col_sum = jnp.where(const, 0.0, raw_second / safe**2).sum(axis=1)
     else:
         col_sum = (s2 / n[:, None]).sum(axis=1)
     lip = 0.25 * col_sum + l2[:, 0]

@@ -664,19 +664,25 @@ def fit_forest_batched(
     # tb defaults to 1 (one program per tree — measured fastest on the real
     # chip; see _tree_batch_size). Masks are drawn per tree exactly as the
     # sequential path would, so forests are bit-identical at any tb.
+    from ..utils.aot import aot_call
+
     tb = _tree_batch_size(k_fits, num_trees)
     chunks = []
     for t0 in range(0, num_trees, tb):
         tc = min(tb, num_trees - t0)
         chunks.append(
-            _forest_trees_chunk(
-                binned, target, row_mask,
-                tuple(tkeys[t0 + i] for i in range(tc)),
-                sub, col, mi, mg,
-                max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
-                # lowp is only sound when target values are bf16-exact
-                # (classification indicators); regression keeps f32
-                lowp=lowp,
+            aot_call(
+                "forest_chunk", _forest_trees_chunk,
+                (
+                    binned, target, row_mask,
+                    tuple(tkeys[t0 + i] for i in range(tc)),
+                    sub, col, mi, mg,
+                ),
+                dict(max_depth=max_depth, num_bins=num_bins,
+                     bootstrap=bootstrap,
+                     # lowp is only sound when target values are bf16-exact
+                     # (classification indicators); regression keeps f32
+                     lowp=lowp),
             )
         )  # each [K, tc, ...]
     return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1), *chunks)
@@ -839,14 +845,17 @@ def fit_boosted_batched(
     margin = jnp.broadcast_to(
         jnp.asarray(base_score, dtype=jnp.float32).reshape(-1, 1), (k_fits, n)
     ).astype(jnp.float32)
+    from ..utils.aot import aot_call
+
     chunks = []
     done = 0
     while done < num_rounds:
         rc = min(_BOOST_ROUND_CHUNK, num_rounds - done)
-        trees_c, margin = _boost_rounds_batched(
-            binned, y, row_mask, margin, eta_v, lam, gam, mcw, mig,
-            num_rounds=rc, max_depth=max_depth, num_bins=num_bins,
-            objective=objective,
+        trees_c, margin = aot_call(
+            "boost_chunk", _boost_rounds_batched,
+            (binned, y, row_mask, margin, eta_v, lam, gam, mcw, mig),
+            dict(num_rounds=rc, max_depth=max_depth, num_bins=num_bins,
+                 objective=objective),
         )
         chunks.append(trees_c)
         done += rc

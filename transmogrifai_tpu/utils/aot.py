@@ -1,0 +1,204 @@
+"""Disk-backed AOT program cache (jax.export).
+
+Fresh-process wall-clock on the tunneled chip is dominated by program
+ACQUISITION, not execution (BASELINE.md round 2: the 25-round XGB chunk
+traces+lowers in ~4 s, loads from the persistent compile cache in ~0.6 s,
+and executes in ~1 ms). The persistent XLA compile cache already removes
+recompilation; this layer removes the per-process TRACING by serializing
+exported StableHLO programs to disk and rehydrating them with
+``jax.export.deserialize`` (~0 s) — the subsequent jit-of-call compile
+hits the persistent compile cache.
+
+Usage: ``aot_call("name", jit_fn, args, statics)`` — transparently falls
+back to a direct ``jit_fn(*args, **statics)`` call on ANY failure (new
+shapes still work, blobs self-invalidate via a source-version salt).
+Opt out with TPTPU_AOT=0.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_MEM: dict = {}
+_PENDING: set = set()
+_FAILED: set = set()
+_THREADS: list = []
+_SALT: str | None = None
+_REGISTERED = False
+
+
+def _drain_exports() -> None:
+    """Give in-flight background exports a chance to land before the
+    process exits — daemon threads are otherwise killed mid-trace and the
+    blob never materializes (each short-lived bench process would only
+    bank one or two programs)."""
+    import time
+
+    deadline = time.monotonic() + 60.0
+    for th in list(_THREADS):
+        th.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
+import atexit  # noqa: E402
+
+atexit.register(_drain_exports)
+
+
+def _enabled() -> bool:
+    return os.environ.get("TPTPU_AOT", "1") != "0"
+
+
+def _cache_dir() -> str:
+    base = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ".jax_cache", "exports",
+    )
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _version_salt() -> str:
+    """Hash of the source files whose tracing the cache skips — a code
+    change invalidates every blob."""
+    global _SALT
+    if _SALT is None:
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in (
+            "models/trees.py", "models/hist_pallas.py", "models/solvers.py",
+            "models/gbdt.py",
+        ):
+            try:
+                with open(os.path.join(pkg, rel), "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                h.update(rel.encode())
+        _SALT = h.hexdigest()[:16]
+    return _SALT
+
+
+def _register_serializations() -> None:
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from jax import export
+
+    from ..models.solvers import GLMParams
+    from ..models.trees import Tree
+
+    for cls, sname in (
+        (Tree, "transmogrifai_tpu.Tree"),
+        (GLMParams, "transmogrifai_tpu.GLMParams"),
+    ):
+        try:
+            export.register_namedtuple_serialization(
+                cls, serialized_name=sname
+            )
+        except ValueError:
+            pass  # already registered
+    _REGISTERED = True
+
+
+def _key(name: str, args: tuple, statics: dict) -> str:
+    import jax
+
+    # device count + per-leaf shardings are part of program identity: a
+    # blob exported single-device must not shadow a mesh-sharded variant
+    # (and vice versa) on the same backend/shapes
+    parts = [name, _version_salt(), jax.default_backend(),
+             f"ndev={len(jax.devices())}"]
+    parts.append(str(jax.tree_util.tree_structure(args)))
+    for a in jax.tree_util.tree_leaves(args):
+        parts.append(f"{getattr(a, 'shape', ())}:{getattr(a, 'dtype', type(a).__name__)}")
+        sharding = getattr(a, "sharding", None)
+        if sharding is not None:
+            parts.append(str(sharding))
+    for k in sorted(statics):
+        parts.append(f"{k}={statics[k]}")
+    return hashlib.sha256("|".join(map(str, parts)).encode()).hexdigest()[:24]
+
+
+def aot_call(
+    name: str, jit_fn: Callable, args: tuple, statics: dict
+) -> Any:
+    """``jit_fn(*args, **statics)`` through the export cache."""
+    if not _enabled():
+        return jit_fn(*args, **statics)
+    try:
+        import jax
+        from jax import export
+
+        _register_serializations()
+        key = _key(name, args, statics)
+        with _LOCK:
+            call = _MEM.get(key)
+        if call is not None:
+            return call(*args)
+        path = os.path.join(_cache_dir(), key + ".jaxexport")
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as fh:
+                    exp = export.deserialize(fh.read())
+                call = jax.jit(exp.call)
+                out = call(*args)
+                with _LOCK:
+                    _MEM[key] = call
+                return out
+            except Exception as e:
+                # corrupt/stale blob: remove it so a future first-use
+                # re-exports instead of permanently disabling the cache
+                log.info("AOT blob %s unusable (%s); removing", key, e)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        # first use of this program version: run directly, then export in
+        # the background so FUTURE processes skip the trace (the export
+        # itself re-traces, which we don't want on the critical path).
+        # _PENDING dedupes concurrent validator threads; _FAILED is the
+        # negative cache (a program export cannot spontaneously start
+        # working, so don't re-trace it per call); the tmp suffix is
+        # unique per thread so racing writers can't interleave one file.
+        out = jit_fn(*args, **statics)
+        with _LOCK:
+            if key not in _MEM:
+                # same-process repeats should reuse jit_fn's warm cache
+                # instead of preferring the blob once it lands mid-process
+                # (deserialize + recompile would ADD latency here)
+                _MEM[key] = lambda *a: jit_fn(*a, **statics)
+            if key in _PENDING or key in _FAILED:
+                return out
+            _PENDING.add(key)
+
+        def _export():
+            try:
+                exp = export.export(
+                    jax.jit(lambda *a: jit_fn(*a, **statics))
+                )(*args)
+                blob = exp.serialize()
+                tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
+                with open(tmp, "wb") as fh:
+                    fh.write(blob)
+                os.replace(tmp, path)
+            except Exception as e:  # never break the fit for the cache
+                log.info("AOT export of %s failed: %s", name, e)
+                with _LOCK:
+                    _FAILED.add(key)
+            finally:
+                with _LOCK:
+                    _PENDING.discard(key)
+
+        th = threading.Thread(target=_export, daemon=True)
+        with _LOCK:
+            _THREADS.append(th)
+        th.start()
+        return out
+    except Exception as e:
+        log.info("AOT cache bypassed for %s: %s", name, e)
+        return jit_fn(*args, **statics)
