@@ -141,3 +141,54 @@ def test_estimator_groups_mixed_static_grids():
     for m in out[0]:
         pred, _, _ = m.predict_arrays(x)
         assert (pred == y).mean() > 0.8
+
+
+@pytest.mark.parametrize("fitfn_kind", ["multinomial", "svc"])
+def test_no_intercept_scale_only_multinomial_svc(fitfn_kind):
+    """ADVICE r2: fit_logistic_multinomial / fit_linear_svc with
+    standardization=True + fit_intercept=False must scale WITHOUT centering.
+    At reg=0 standardization changes conditioning, not the optimum, so the
+    standardized and raw fits must agree on mean-shifted data; the centering
+    bug bakes an implicit mean·w offset into training that predict never
+    applies, and the two fits diverge."""
+    from transmogrifai_tpu.models.solvers import (
+        fit_linear_svc,
+        fit_logistic_multinomial,
+    )
+
+    rng = np.random.default_rng(3)
+    n, d = 400, 8
+    x = rng.normal(size=(n, d)).astype(np.float32) + 5.0  # non-zero means
+    w = rng.normal(size=(d, 3)).astype(np.float32)
+    y3 = np.argmax((x - 5.0) @ w + 0.3 * rng.normal(size=(n, 3)), axis=1)
+    mask = np.ones(n, np.float32)
+    if fitfn_kind == "multinomial":
+        std = fit_logistic_multinomial(
+            jnp.asarray(x), jnp.asarray(y3.astype(np.float32)),
+            jnp.asarray(mask), 0.0, 0.0, num_classes=3,
+            num_iters=800, fit_intercept=False, standardization=True,
+        )
+        raw = fit_logistic_multinomial(
+            jnp.asarray(x), jnp.asarray(y3.astype(np.float32)),
+            jnp.asarray(mask), 0.0, 0.0, num_classes=3,
+            num_iters=800, fit_intercept=False, standardization=False,
+        )
+        logits_s = x @ np.asarray(std.weights)
+        logits_r = x @ np.asarray(raw.weights)
+        # same objective, same optimum: predicted classes agree
+        agree = (logits_s.argmax(1) == logits_r.argmax(1)).mean()
+        assert agree > 0.97
+    else:
+        yb = (y3 > 0).astype(np.float32)
+        std = fit_linear_svc(
+            jnp.asarray(x), jnp.asarray(yb), jnp.asarray(mask), 0.001,
+            num_iters=1500, fit_intercept=False, standardization=True,
+        )
+        raw = fit_linear_svc(
+            jnp.asarray(x), jnp.asarray(yb), jnp.asarray(mask), 0.001,
+            num_iters=1500, fit_intercept=False, standardization=False,
+        )
+        m_s = x @ np.asarray(std.weights)
+        m_r = x @ np.asarray(raw.weights)
+        agree = ((m_s > 0) == (m_r > 0)).mean()
+        assert agree > 0.97

@@ -123,14 +123,17 @@ def test_data_parallel_fit_logistic(mesh, rng):
     w_true = rng.normal(size=d).astype(np.float32)
     y = (x @ w_true > 0).astype(np.float32)
     mask = np.ones(n, dtype=np.float32)
+    # reg > 0 so the optimum exists and is unique: separable data with
+    # reg=0 has no finite minimum, and comparing two diverging-to-infinity
+    # trajectories only measures float reassociation noise
     params = data_parallel_fit(
-        fit_logistic_binary, mesh, x, y, mask, 0.0, 0.0, num_iters=60
+        fit_logistic_binary, mesh, x, y, mask, 0.05, 0.0, num_iters=100
     )
     w = np.asarray(params.weights)
     assert np.isfinite(w).all()
-    # sharded fit equals the single-device fit
-    ref = fit_logistic_binary(x, y, mask, 0.0, 0.0, num_iters=60)
-    np.testing.assert_allclose(w, np.asarray(ref.weights), atol=1e-3)
+    # sharded fit converges to the same optimum as the single-device fit
+    ref = fit_logistic_binary(x, y, mask, 0.05, 0.0, num_iters=100)
+    np.testing.assert_allclose(w, np.asarray(ref.weights), rtol=1e-3, atol=1e-3)
 
 
 def test_grid_parallel_fit_shards_grid_axis(rng):

@@ -74,17 +74,13 @@ def test_selector_output_identical_sharded_vs_not():
                 r1["metricValues"], r8["metricValues"], rtol=1e-4, atol=1e-6
             )
         else:
-            # first-order solver fits on this UNDERDETERMINED matrix
-            # (891 rows x ~950 one-hot columns, condition number ~1e4) do
-            # not converge the weak-curvature subspace in maxIter*4
-            # iterations, so float reassociation (shard reduction order)
-            # legitimately moves fold metrics — the reference's
-            # distributed L-BFGS has the same run-to-run property. Assert
-            # BOUNDED two-sided drift, not bit parity.
-            assert len(r1["metricValues"]) == len(r8["metricValues"])
-            for v1, v8 in zip(r1["metricValues"], r8["metricValues"]):
-                assert 0.3 < v1 <= 1.0 and 0.3 < v8 <= 1.0
-                assert abs(v1 - v8) <= 0.35
+            # L-BFGS/OWL-QN converges to gradient-norm tolerance on both
+            # paths (round 2's FISTA did not, forcing a ±0.35 bound here),
+            # so shard-reduction float reassociation no longer moves fold
+            # metrics beyond tight tolerance
+            np.testing.assert_allclose(
+                r1["metricValues"], r8["metricValues"], rtol=1e-3, atol=1e-3
+            )
     # the selected model (trees) must score identically either way
     np.testing.assert_allclose(
         s1["holdoutEvaluation"]["AuPR"], s8["holdoutEvaluation"]["AuPR"],

@@ -113,8 +113,8 @@ class LogisticRegression(PredictorEstimator):
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         x, y, row_mask = self._mesh_rows(x, y, row_mask)
-        # FISTA needs more iterations than Newton for tight convergence;
-        # scale the budget (maxIter is the Spark-semantic knob).
+        # binary runs quasi-Newton (maxIter is the Spark-semantic knob,
+        # 1:1); multinomial still runs FISTA, which needs a larger budget
         iters = self.max_iter * 4
         if num_classes == 2:
             params = fit_logistic_binary(
@@ -123,7 +123,7 @@ class LogisticRegression(PredictorEstimator):
                 row_mask,
                 float(self.reg_param),
                 float(self.elastic_net_param),
-                num_iters=iters,
+                num_iters=self.max_iter,
                 fit_intercept=self.fit_intercept,
                 standardization=self.standardization,
             )
@@ -206,7 +206,7 @@ class LogisticRegression(PredictorEstimator):
                     jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(rm),
                     jnp.asarray(regs), jnp.asarray(ens),
                 ),
-                dict(num_iters=max_iter * 4,
+                dict(num_iters=max_iter,
                      fit_intercept=fit_intercept,
                      standardization=standardization),
             )
