@@ -154,3 +154,55 @@ def test_avro_reader_in_catalog(tmp_path):
     write_avro(path, SCHEMA, RECORDS)
     records = list(AvroReader(path).read_records())
     assert records == RECORDS
+
+
+def test_union_branch_matches_value_type(tmp_path):
+    """ADVICE r2: the writer must pick the union branch by the VALUE's
+    type, not the first non-null branch."""
+    from transmogrifai_tpu.utils.avro import read_avro, write_avro
+
+    schema = {
+        "type": "record", "name": "R",
+        "fields": [{"name": "v", "type": ["null", "int", "string"]}],
+    }
+    path = str(tmp_path / "u.avro")
+    records = [{"v": 3}, {"v": "three"}, {"v": None}]
+    write_avro(path, schema, records)
+    assert [r["v"] for r in read_avro(path)] == [3, "three", None]
+
+
+def test_fixed_truncation_raises(tmp_path):
+    """A truncated 'fixed' value must raise AvroError, not silently return
+    a short value."""
+    import io
+
+    import pytest as _pytest
+
+    from transmogrifai_tpu.utils.avro import AvroError, _read_datum
+
+    fh = io.BytesIO(b"ab")
+    with _pytest.raises(AvroError):
+        _read_datum(fh, {"type": "fixed", "name": "F", "size": 4})
+
+
+def test_union_accepts_numpy_scalars(tmp_path):
+    import numpy as np
+
+    from transmogrifai_tpu.utils.avro import read_avro, write_avro
+
+    schema = {
+        "type": "record", "name": "R",
+        "fields": [
+            {"name": "d", "type": ["null", "double"]},
+            {"name": "l", "type": ["null", "long"]},
+            {"name": "b", "type": ["null", "boolean", "int"]},
+        ],
+    }
+    path = str(tmp_path / "np.avro")
+    write_avro(path, schema, [
+        {"d": np.float32(1.5), "l": np.int64(7), "b": True},
+        {"d": np.int32(2), "l": np.int32(9), "b": np.bool_(False)},
+    ])
+    rows = read_avro(path)
+    assert rows[0]["d"] == 1.5 and rows[0]["l"] == 7 and rows[0]["b"] is True
+    assert rows[1]["d"] == 2.0 and rows[1]["l"] == 9 and rows[1]["b"] is False

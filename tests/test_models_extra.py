@@ -219,3 +219,29 @@ def test_svc_standardization_flag_changes_fit():
     m_raw = LinearSVC(reg_param=0.5, standardization=False).fit_arrays(
         x, y, np.ones(len(y), np.float32))
     assert not np.allclose(m_std.weights, m_raw.weights)
+
+
+def test_fit_linear_no_intercept_scale_only():
+    """code-review r3: fit_linear with fit_intercept=False must not center
+    x or y — the centered fit bakes an implicit intercept into training
+    that predict never applies."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from transmogrifai_tpu.models.solvers import fit_linear
+
+    rng = np.random.default_rng(0)
+    n, d = 300, 6
+    x = rng.normal(size=(n, d)).astype(np.float32) + 5.0
+    w = rng.normal(size=d).astype(np.float32)
+    y = (x @ w + 0.05 * rng.normal(size=n)).astype(np.float32)
+    mask = np.ones(n, np.float32)
+    out = fit_linear(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask), 0.0, 0.0,
+        num_iters=3000, fit_intercept=False,
+    )
+    pred = x @ np.asarray(out.weights)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    # through-origin data: the scale-only no-intercept fit recovers it
+    assert rmse < 0.2, rmse
+    assert float(out.intercept) == 0.0

@@ -646,8 +646,16 @@ def fit_linear(
     WLS/normal-equation semantics for alpha=0 via converged FISTA)."""
     row_mask = row_mask.astype(x.dtype)
     n = jnp.maximum(row_mask.sum(), 1.0)
-    xs, mean, std, _const = _standardize(x, row_mask)
-    ym = (y * row_mask).sum() / n
+    xs, mean, std, const = _standardize(x, row_mask)
+    if not fit_intercept:
+        # Spark parity: scale only, never center x OR y — a centered fit
+        # bakes an implicit intercept into training that predict never
+        # applies (same fix as the logistic/SVC no-intercept paths)
+        mean = jnp.zeros(x.shape[1], dtype=x.dtype)
+        xs = _scale_only(x, row_mask, std, const)
+        ym = jnp.zeros((), dtype=x.dtype)
+    else:
+        ym = (y * row_mask).sum() / n
     yc = jnp.where(row_mask > 0, y - ym, 0.0)
     l1 = reg_param * elastic_net
     l2 = reg_param * (1.0 - elastic_net)

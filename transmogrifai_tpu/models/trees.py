@@ -570,11 +570,11 @@ def _tree_batch_size(k_fits: int, num_trees: int) -> int:
 
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins", "bootstrap", "lowp"),
+    static_argnames=("max_depth", "num_bins", "bootstrap", "lowp", "hist_impl"),
 )
 def _forest_trees_chunk(
     binned, target, row_mask, tkeys, sub, col, min_instances, min_info_gain,
-    max_depth, num_bins, bootstrap, lowp,
+    max_depth, num_bins, bootstrap, lowp, hist_impl=None,
 ) -> Tree:
     """A chunk of bagged trees × all K fits in ONE batched growth: the
     combined (tree, fit) axis rides the histogram-kernel grid. Masks are
@@ -606,7 +606,7 @@ def _forest_trees_chunk(
         reg_lambda=0.0, gamma=0.0,
         min_child_weight=tile(min_instances),
         min_info_gain=tile(min_info_gain),
-        lowp=lowp,
+        lowp=lowp, hist_impl=hist_impl,
     )
     return jax.tree.map(
         lambda a: jnp.swapaxes(a.reshape((tc, k_fits) + a.shape[1:]), 0, 1),
@@ -682,7 +682,12 @@ def fit_forest_batched(
                      bootstrap=bootstrap,
                      # lowp is only sound when target values are bf16-exact
                      # (classification indicators); regression keeps f32
-                     lowp=lowp),
+                     lowp=lowp,
+                     # resolved EARLY so both the jit cache and the AOT
+                     # blob key see the trace-time impl choice — an env
+                     # flip mid-process or a blob exported under the other
+                     # impl can no longer serve the wrong program
+                     hist_impl=_resolved_impl()),
             )
         )  # each [K, tc, ...]
     return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1), *chunks)
@@ -752,7 +757,7 @@ def _boost_chunk_body(
     binned, y, row_mask, margin0, eta_v, reg_lambda, gamma,
     min_child_weight, min_info_gain,
     num_rounds, max_depth, num_bins, objective,
-    axis_name=None, axis_size=1,
+    axis_name=None, axis_size=1, hist_impl=None,
 ) -> tuple[Tree, jax.Array]:
     """A chunk of boosting rounds for all K fits (lax.scan inside one
     program) — shared by the single-device jit and the shard_map'd path
@@ -775,7 +780,7 @@ def _boost_chunk_body(
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
-            axis_name=axis_name, axis_size=axis_size,
+            axis_name=axis_name, axis_size=axis_size, hist_impl=hist_impl,
         )
         step = jax.vmap(lambda t: predict_tree(binned, t))(tree)  # [K, N]
         margin = margin + eta_v[:, None] * step
@@ -789,9 +794,18 @@ _boost_rounds_batched = partial(
     jax.jit,
     static_argnames=(
         "num_rounds", "max_depth", "num_bins", "objective",
-        "axis_name", "axis_size",
+        "axis_name", "axis_size", "hist_impl",
     ),
 )(_boost_chunk_body)
+
+
+def _resolved_impl() -> str:
+    """The histogram impl the trace WILL use, resolved at call time so it
+    participates in jit-cache and AOT-blob identity (the env knob is read
+    at trace time deep inside _grow_tree_impl otherwise)."""
+    from .hist_pallas import default_impl
+
+    return default_impl()
 
 
 #: boosting rounds per compiled program — keeps any one program's size
@@ -855,7 +869,7 @@ def fit_boosted_batched(
             "boost_chunk", _boost_rounds_batched,
             (binned, y, row_mask, margin, eta_v, lam, gam, mcw, mig),
             dict(num_rounds=rc, max_depth=max_depth, num_bins=num_bins,
-                 objective=objective),
+                 objective=objective, hist_impl=_resolved_impl()),
         )
         chunks.append(trees_c)
         done += rc
@@ -932,7 +946,7 @@ def _fit_forest_batched_sharded(
     target_p = _pad_axis(jnp.asarray(target, jnp.float32), 0, size)
     n_pad = binned_p.shape[0]
     rm = jnp.asarray(row_mask, jnp.float32)
-    kern = _sharded_grow_kernel(mesh, max_depth, num_bins, None, lowp)
+    kern = _sharded_grow_kernel(mesh, max_depth, num_bins, _resolved_impl(), lowp)
     zero = jnp.zeros(1, jnp.float32)
     mi = jnp.broadcast_to(jnp.asarray(mi, jnp.float32).reshape(-1), (k_fits,))
     mg = jnp.broadcast_to(jnp.asarray(mg, jnp.float32).reshape(-1), (k_fits,))
@@ -974,7 +988,8 @@ def _fit_forest_batched_sharded(
 
 
 @lru_cache(maxsize=None)
-def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective):
+def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective,
+                          hist_impl=None):
     """jit(shard_map(boost-round-chunk)): margins stay row-sharded across
     the scan; each round's histogram build psums over the data axis."""
     from jax import shard_map
@@ -989,6 +1004,7 @@ def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective):
             binned, y, row_mask, margin0, eta_v, lam, gam, mcw, mig,
             num_rounds=num_rounds, max_depth=max_depth, num_bins=num_bins,
             objective=objective, axis_name=DATA_AXIS, axis_size=size,
+            hist_impl=hist_impl,
         )
 
     rep = P()
@@ -1035,7 +1051,8 @@ def _fit_boosted_batched_sharded(
     done = 0
     while done < num_rounds:
         rc = min(_BOOST_ROUND_CHUNK, num_rounds - done)
-        kern = _sharded_boost_kernel(mesh, rc, max_depth, num_bins, objective)
+        kern = _sharded_boost_kernel(mesh, rc, max_depth, num_bins, objective,
+                                     _resolved_impl())
         trees_c, margin = kern(
             binned_p, y_p, rm_p, margin, eta_v, lam, gam, mcw, mig
         )

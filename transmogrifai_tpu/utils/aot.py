@@ -33,16 +33,28 @@ _SALT: str | None = None
 _REGISTERED = False
 
 
+import time as _time
+
+_START = _time.monotonic()
+
+
 def _drain_exports() -> None:
     """Give in-flight background exports a chance to land before the
     process exits — daemon threads are otherwise killed mid-trace and the
     blob never materializes (each short-lived bench process would only
-    bank one or two programs)."""
+    bank one or two programs). The wait is scaled to process lifetime so a
+    quick scoring CLI run never hangs ~60 s at exit: a process that ran
+    for t seconds waits at most min(60, max(5, 2t))."""
     import time
 
-    deadline = time.monotonic() + 60.0
+    elapsed = time.monotonic() - _START
+    budget = min(60.0, max(5.0, 2.0 * elapsed))
+    deadline = time.monotonic() + budget
     for th in list(_THREADS):
         th.join(timeout=max(0.0, deadline - time.monotonic()))
+    alive = [th for th in _THREADS if th.is_alive()]
+    if alive:
+        log.info("abandoning %d unfinished AOT exports at exit", len(alive))
 
 
 import atexit  # noqa: E402

@@ -16,6 +16,8 @@ import struct
 import zlib
 from typing import Any, BinaryIO, Iterator
 
+import numpy as np
+
 _MAGIC = b"Obj\x01"
 
 
@@ -141,7 +143,13 @@ def _read_datum(fh: BinaryIO, schema: Any) -> Any:
             raise AvroError(f"enum index {idx} out of range")
         return symbols[idx]
     if kind == "fixed":
-        return fh.read(schema["size"])
+        size = schema["size"]
+        data = fh.read(size)
+        if len(data) != size:
+            raise AvroError(
+                f"truncated fixed: wanted {size} bytes, got {len(data)}"
+            )
+        return data
     if kind == "array":
         out = []
         while True:
@@ -240,18 +248,54 @@ def _write_bytes(out: BinaryIO, data: bytes) -> None:
     out.write(data)
 
 
+def _branch_accepts(kind: str, v: Any, strict: bool) -> bool:
+    """Does a union branch of ``kind`` match the value's type? numbers.ABCs
+    cover numpy scalars (np.float32 is Real, np.int64 is Integral)."""
+    import numbers
+
+    is_bool = isinstance(v, (bool, np.bool_))
+    if kind == "boolean":
+        return is_bool
+    if kind in ("int", "long"):
+        return not is_bool and isinstance(v, numbers.Integral)
+    if kind in ("float", "double"):
+        if is_bool:
+            return False
+        if isinstance(v, numbers.Real) and not isinstance(v, numbers.Integral):
+            return True
+        # relaxed pass: ints may encode as float/double
+        return not strict and isinstance(v, numbers.Integral)
+    if kind in ("string", "enum"):
+        return isinstance(v, str)
+    if kind in ("bytes", "fixed"):
+        return isinstance(v, (bytes, bytearray))
+    if kind in ("record", "map"):
+        return isinstance(v, dict)
+    if kind == "array":
+        return isinstance(v, (list, tuple, np.ndarray))
+    return not strict
+
+
 def _write_datum(out: BinaryIO, schema: Any, v: Any) -> None:
     if isinstance(schema, list):
+        # match the branch to the VALUE's type — picking the first
+        # non-null branch mis-encodes multi-branch unions like
+        # ["null","int","string"] for string values
         for i, branch in enumerate(schema):
             kind = branch if isinstance(branch, str) else branch["type"]
             if v is None and kind == "null":
                 _write_long(out, i)
                 return
-            if v is not None and kind != "null":
-                _write_long(out, i)
-                _write_datum(out, branch, v)
-                return
-        raise AvroError("no matching union branch")
+        for strict in (True, False):
+            for i, branch in enumerate(schema):
+                kind = branch if isinstance(branch, str) else branch["type"]
+                if v is None or kind == "null":
+                    continue
+                if _branch_accepts(kind, v, strict):
+                    _write_long(out, i)
+                    _write_datum(out, branch, v)
+                    return
+        raise AvroError(f"no matching union branch for {type(v).__name__}")
     kind = schema if isinstance(schema, str) else schema["type"]
     if kind == "null":
         return
