@@ -20,9 +20,13 @@ gini — both preserve the fitted-probability semantics used downstream.
 """
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from .base import PredictorEstimator, PredictorModel
 from . import trees as TR
@@ -49,29 +53,91 @@ def _class_trees_from_arrays(arrays: dict) -> list[TR.Tree]:
     return out
 
 
+def _feature_bin_groups(x: np.ndarray):
+    """(narrow_idx, wide_idx) partition of the columns: 0/1 indicator
+    columns (the bulk of a transmogrified one-hot matrix) vs multi-valued
+    ones. Tree growth searches the narrow group at 2 bins instead of
+    max_bins — split-search cost scales with features×bins, so this is a
+    ~10-16× cut on one-hot-heavy matrices with identical fitted trees
+    (trees._grow_tree_impl docstring). Host-side and cheap: one vectorized
+    pass over the matrix."""
+    xf = np.asarray(x)
+    with np.errstate(invalid="ignore"):
+        binary = ((xf == 0) | (xf == 1) | ~np.isfinite(xf)).all(axis=0)
+    narrow = np.nonzero(binary)[0].astype(np.int32)
+    wide = np.nonzero(~binary)[0].astype(np.int32)
+    if len(narrow) == 0:
+        return None
+    return jnp.asarray(narrow), jnp.asarray(wide)
+
+
+class _LazySlice:
+    """Deferred host materialization of one lane of a stacked-trees fit.
+
+    The batched sweep fits K candidates' trees as one device array; pulling
+    it to host eagerly costs a ~44 MB download over the tunneled link
+    (measured ~40 s for the Titanic RF groups) that the sweep never uses —
+    candidate metrics come from sweep_eval_batched on the DEVICE stack, and
+    only the winner's model ever needs its tree arrays (for persistence or
+    re-scoring). First access downloads the stack once and caches it on the
+    shared stack record."""
+
+    def __init__(self, stack: dict, lane: int):
+        self.stack = stack
+        self.lane = lane
+
+    def get(self):
+        host = self.stack.get("host")
+        if host is None:
+            host = jax.tree.map(lambda a: np.asarray(a), self.stack["trees"])
+            self.stack["host"] = host
+        return jax.tree.map(lambda a: a[self.lane], host)
+
+
+def _resolve_trees(t):
+    return t.get() if isinstance(t, _LazySlice) else t
+
+
 class _BinnedModel(PredictorModel):
     """Shared state for binned-tree models; prediction goes through the
     fused jitted entry points (trees.predict_*_raw) which bin internally —
-    one dispatch per scoring call."""
+    one dispatch per scoring call.
+
+    Tree arrays are stored as given (host numpy from batched sweeps, device
+    from sequential fits) and uploaded LAZILY on first predict: the sweep
+    path never calls per-model predict (see sweep_eval_batched), so eagerly
+    uploading every candidate's trees would re-send the whole stacked array
+    over the tunnel for nothing."""
 
     def __init__(self, operation_name: str, thresholds: np.ndarray, uid=None):
         super().__init__(operation_name, uid=uid)
         self.thresholds = np.asarray(thresholds, dtype=np.float32)
+        self._dev_cache = None
+
+    def _dev(self, trees):
+        if self._dev_cache is None:
+            if isinstance(trees, list):
+                trees = [_resolve_trees(t) for t in trees]
+            else:
+                trees = _resolve_trees(trees)
+            self._dev_cache = jax.tree.map(jnp.asarray, trees)
+        return self._dev_cache
 
 
 class BoostedBinaryModel(_BinnedModel):
     def __init__(self, thresholds, trees: TR.Tree, eta: float, base_score: float, uid=None):
         super().__init__("xgbClassifier", thresholds, uid=uid)
-        self.trees = jax.tree.map(jnp.asarray, trees)
+        self.trees = trees
         self.eta = eta
         self.base_score = base_score
 
     def get_arrays(self):
+        t = _resolve_trees(self.trees)
         return {
             "thresholds": self.thresholds,
-            "split_feat": self.trees.split_feat,
-            "split_bin": self.trees.split_bin,
-            "leaf_value": self.trees.leaf_value,
+            "split_feat": t.split_feat,
+            "split_bin": t.split_bin,
+            "leaf_value": t.leaf_value,
         }
 
     def get_params(self):
@@ -88,12 +154,21 @@ class BoostedBinaryModel(_BinnedModel):
         margin = np.asarray(
             TR.predict_boosted_raw(
                 jnp.asarray(x, dtype=jnp.float32),
-                jnp.asarray(self.thresholds), self.trees,
+                jnp.asarray(self.thresholds), self._dev(self.trees),
                 jnp.float32(self.eta), jnp.float32(self.base_score),
             ),
             dtype=np.float64,
         )
-        p1 = _sigmoid(margin)
+        return self.predictions_from_sweep(margin)
+
+    # ---- batched sweep-eval protocol (validators._sweep_family) ----------
+    sweep_mode = "boost"
+
+    def sweep_lane_params(self):
+        return float(self.eta), float(self.base_score)
+
+    def predictions_from_sweep(self, margin):
+        p1 = _sigmoid(np.asarray(margin, dtype=np.float64))
         prob = np.stack([1 - p1, p1], axis=1)
         raw = np.stack([-margin, margin], axis=1)
         return (p1 > 0.5).astype(np.float64), prob, raw
@@ -104,13 +179,13 @@ class BoostedMultiModel(_BinnedModel):
 
     def __init__(self, thresholds, trees_per_class: list[TR.Tree], eta, base_score, uid=None):
         super().__init__("xgbClassifier", thresholds, uid=uid)
-        self.trees_per_class = [jax.tree.map(jnp.asarray, t) for t in trees_per_class]
+        self.trees_per_class = trees_per_class
         self.eta = eta
         self.base_score = base_score
 
     def get_arrays(self):
         out = {"thresholds": self.thresholds}
-        for c, t in enumerate(self.trees_per_class):
+        for c, t in enumerate(map(_resolve_trees, self.trees_per_class)):
             out[f"c{c}__split_feat"] = t.split_feat
             out[f"c{c}__split_bin"] = t.split_bin
             out[f"c{c}__leaf_value"] = t.leaf_value
@@ -131,10 +206,11 @@ class BoostedMultiModel(_BinnedModel):
         thr = jnp.asarray(self.thresholds)
         eta = jnp.float32(self.eta)
         base = jnp.float32(self.base_score)
+        dev = self._dev(self.trees_per_class)
         margins = np.stack(
             [
                 np.asarray(TR.predict_boosted_raw(xj, thr, t, eta, base))
-                for t in self.trees_per_class
+                for t in dev
             ],
             axis=1,
         ).astype(np.float64)
@@ -146,16 +222,17 @@ class BoostedMultiModel(_BinnedModel):
 class BoostedRegressionModel(_BinnedModel):
     def __init__(self, thresholds, trees, eta, base_score, uid=None):
         super().__init__("xgbRegressor", thresholds, uid=uid)
-        self.trees = jax.tree.map(jnp.asarray, trees)
+        self.trees = trees
         self.eta = eta
         self.base_score = base_score
 
     def get_arrays(self):
+        t = _resolve_trees(self.trees)
         return {
             "thresholds": self.thresholds,
-            "split_feat": self.trees.split_feat,
-            "split_bin": self.trees.split_bin,
-            "leaf_value": self.trees.leaf_value,
+            "split_feat": t.split_feat,
+            "split_bin": t.split_bin,
+            "leaf_value": t.leaf_value,
         }
 
     def get_params(self):
@@ -172,12 +249,21 @@ class BoostedRegressionModel(_BinnedModel):
         pred = np.asarray(
             TR.predict_boosted_raw(
                 jnp.asarray(x, dtype=jnp.float32),
-                jnp.asarray(self.thresholds), self.trees,
+                jnp.asarray(self.thresholds), self._dev(self.trees),
                 jnp.float32(self.eta), jnp.float32(self.base_score),
             ),
             dtype=np.float64,
         )
         return pred, None, None
+
+    sweep_mode = "boost"
+
+    def sweep_lane_params(self):
+        return float(self.eta), float(self.base_score)
+
+    @staticmethod
+    def predictions_from_sweep(margin):
+        return np.asarray(margin, dtype=np.float64), None, None
 
 
 class ForestClassifierModel(_BinnedModel):
@@ -185,11 +271,11 @@ class ForestClassifierModel(_BinnedModel):
 
     def __init__(self, thresholds, forests_per_class: list[TR.Tree], uid=None):
         super().__init__("rfClassifier", thresholds, uid=uid)
-        self.forests_per_class = [jax.tree.map(jnp.asarray, t) for t in forests_per_class]
+        self.forests_per_class = forests_per_class
 
     def get_arrays(self):
         out = {"thresholds": self.thresholds}
-        for c, t in enumerate(self.forests_per_class):
+        for c, t in enumerate(map(_resolve_trees, self.forests_per_class)):
             out[f"c{c}__split_feat"] = t.split_feat
             out[f"c{c}__split_bin"] = t.split_bin
             out[f"c{c}__leaf_value"] = t.leaf_value
@@ -202,13 +288,18 @@ class ForestClassifierModel(_BinnedModel):
     def predict_arrays(self, x):
         xj = jnp.asarray(x, dtype=jnp.float32)
         thr = jnp.asarray(self.thresholds)
+        dev = self._dev(self.forests_per_class)
         probs = np.stack(
             [
                 np.asarray(TR.predict_forest_raw(xj, thr, t))
-                for t in self.forests_per_class
+                for t in dev
             ],
             axis=1,
         ).astype(np.float64)
+        return self._probs_to_predictions(probs)
+
+    @staticmethod
+    def _probs_to_predictions(probs):
         probs = np.clip(probs, 0.0, 1.0)
         if probs.shape[1] == 1:  # binary trained on the positive indicator
             probs = np.concatenate([1 - probs, probs], axis=1)
@@ -216,33 +307,57 @@ class ForestClassifierModel(_BinnedModel):
         prob = probs / np.maximum(probs.sum(axis=1, keepdims=True), 1e-12)
         return prob.argmax(axis=1).astype(np.float64), prob, raw
 
+    # sweep-eval protocol: only single-forest (binary) stacks batch — the
+    # one-vs-rest multiclass loop stays on the per-model path
+    sweep_mode = "forest"
+
+    def sweep_lane_params(self):
+        return 1.0, 0.0
+
+    def predictions_from_sweep(self, preds):
+        if len(self.forests_per_class) != 1:
+            raise ValueError("sweep path is single-forest only")
+        return self._probs_to_predictions(
+            np.asarray(preds, dtype=np.float64)[:, None]
+        )
+
 
 class ForestRegressionModel(_BinnedModel):
     def __init__(self, thresholds, trees, uid=None):
         super().__init__("rfRegressor", thresholds, uid=uid)
-        self.trees = jax.tree.map(jnp.asarray, trees)
+        self.trees = trees
 
     @classmethod
     def from_params(cls, params, arrays):
         return cls(arrays["thresholds"], _tree_from_arrays(arrays))
 
     def get_arrays(self):
+        t = _resolve_trees(self.trees)
         return {
             "thresholds": self.thresholds,
-            "split_feat": self.trees.split_feat,
-            "split_bin": self.trees.split_bin,
-            "leaf_value": self.trees.leaf_value,
+            "split_feat": t.split_feat,
+            "split_bin": t.split_bin,
+            "leaf_value": t.leaf_value,
         }
 
     def predict_arrays(self, x):
         pred = np.asarray(
             TR.predict_forest_raw(
                 jnp.asarray(x, dtype=jnp.float32),
-                jnp.asarray(self.thresholds), self.trees,
+                jnp.asarray(self.thresholds), self._dev(self.trees),
             ),
             dtype=np.float64,
         )
         return pred, None, None
+
+    sweep_mode = "forest"
+
+    def sweep_lane_params(self):
+        return 1.0, 0.0
+
+    @staticmethod
+    def predictions_from_sweep(preds):
+        return np.asarray(preds, dtype=np.float64), None, None
 
 
 # ---------------------------------------------------------------------------
@@ -258,11 +373,12 @@ class _TreeEstimator(PredictorEstimator):
         self.max_depth = max_depth
         self.max_bins = max_bins
 
-    def _binned(self, x: np.ndarray) -> tuple[np.ndarray, jax.Array]:
+    def _binned(self, x: np.ndarray):
+        """(thresholds, binned codes, narrow/wide feature groups)."""
         thresholds = TR.quantile_thresholds(x, self.max_bins)
         return thresholds, TR.bin_data(
             jnp.asarray(x, dtype=jnp.float32), jnp.asarray(thresholds)
-        )
+        ), _feature_bin_groups(x)
 
     def _fit_group_masks(self, x, y, masks, group_points):
         """Fit len(masks) × len(group_points) same-static-shape models in
@@ -322,6 +438,87 @@ class _TreeEstimator(PredictorEstimator):
     def _tree_slice(stacked_trees, i):
         return jax.tree.map(lambda a: a[i], stacked_trees)
 
+    def sweep_eval_batched(self, models_by_fold, x, y, folds, evaluator):
+        """Validator hook: validation metrics for the WHOLE folds × grid
+        sweep with one device program per fitted stack. The per-model
+        predict loop pays a dispatch + val-matrix upload per model over the
+        tunneled link (~0.1-0.3 s each × 54 RF models); here each stack's
+        [K, N] outputs come back in one download and the per-lane
+        probability/metric math runs on host exactly as predict_arrays
+        would. Returns [n_points][n_folds] metric values, or None when any
+        model lacks the sweep protocol (caller falls back)."""
+        from ..utils.aot import aot_call
+
+        flat = [m for fold_models in models_by_fold for m in fold_models]
+        if not flat or any(
+            getattr(m, "_sweep_stack", None) is None
+            or not hasattr(m, "predictions_from_sweep")
+            for m in flat
+        ):
+            return None
+        try:
+            for m in flat:  # multiclass forest stacks don't batch
+                if getattr(m, "forests_per_class", None) is not None and len(
+                    m.forests_per_class
+                ) != 1:
+                    return None
+            import time as _t
+
+            _t0 = _t.perf_counter()
+            xj = jnp.asarray(x, dtype=jnp.float32)
+            outputs: dict[int, np.ndarray] = {}
+            for m in flat:
+                stack = m._sweep_stack
+                sid = id(stack)
+                if sid in outputs:
+                    continue
+                log.debug("sweep_eval stack start +%.2fs", _t.perf_counter() - _t0)
+                k = stack["k"]
+                eta_v = np.ones(k, dtype=np.float32)
+                base_v = np.zeros(k, dtype=np.float32)
+                for mm in flat:
+                    if mm._sweep_stack is stack:
+                        e, b = mm.sweep_lane_params()
+                        eta_v[mm._sweep_lane] = e
+                        base_v[mm._sweep_lane] = b
+                mode = m.sweep_mode
+                fn = (
+                    TR.sweep_boosted_outputs
+                    if mode == "boost"
+                    else TR.sweep_forest_outputs
+                )
+                out = aot_call(
+                    f"sweep_{mode}_outputs", fn,
+                    (
+                        xj, jnp.asarray(stack["thresholds"]),
+                        jax.tree.map(jnp.asarray, stack["trees"]),
+                        jnp.asarray(eta_v), jnp.asarray(base_v),
+                    ),
+                    {},
+                )
+                log.debug("sweep_eval dispatched +%.2fs", _t.perf_counter() - _t0)
+                outputs[sid] = np.asarray(out)  # [K, N]
+                log.debug("sweep_eval downloaded +%.2fs", _t.perf_counter() - _t0)
+            _t1 = _t.perf_counter()
+            values: list[list[float]] = [
+                [] for _ in range(len(models_by_fold[0]))
+            ]
+            for fi, (_train_mask, val_mask) in enumerate(folds):
+                val_idx = np.nonzero(val_mask)[0]
+                for gi, m in enumerate(models_by_fold[fi]):
+                    row = outputs[id(m._sweep_stack)][m._sweep_lane][val_idx]
+                    pred, prob, _ = m.predictions_from_sweep(row)
+                    metrics = evaluator.evaluate_arrays(y[val_idx], pred, prob)
+                    values[gi].append(evaluator.metric_of(metrics))
+            log.debug(
+                "sweep_eval: device outputs %.2fs, host metrics %.2fs",
+                _t1 - _t0, _t.perf_counter() - _t1,
+            )
+            return values
+        except Exception:
+            log.warning("batched sweep-eval failed; falling back", exc_info=True)
+            return None
+
     def _batched_group_fit(
         self, x, masks, group_points, run_batched, make_model, normalize=None
     ):
@@ -335,7 +532,8 @@ class _TreeEstimator(PredictorEstimator):
         ``make_model(thresholds, sliced_trees, merged_params, mask_index)``.
         """
         base = self.with_params(**group_points[0])
-        thresholds, binned = base._binned(x)
+        thresholds, binned, fgroups = base._binned(x)
+        self._last_feature_groups = fgroups
         norm = normalize or (lambda m: m)
         merged = [norm({**self.get_params(), **p}) for p in group_points]
         n_masks, n_pts = masks.shape[0], len(merged)
@@ -346,21 +544,31 @@ class _TreeEstimator(PredictorEstimator):
                 [float(m[name]) for m in merged] * n_masks, dtype=jnp.float32
             )
 
-        trees = run_batched(binned, merged[0], row_mask_k, knob)
-        # mesh-sharded fits return trees replicated across the mesh; pull
-        # them to host ONCE before the per-model slicing — slicing a
-        # multi-device array eagerly dispatches a gather on every device per
-        # slice (hundreds across a sweep), which both wastes dispatches and
-        # stresses the async CPU runtime. Single-device (1-chip) fits stay
-        # device-resident for the fused predict paths.
+        trees = run_batched(binned, merged[0], row_mask_k, knob, fgroups)
+        # the stacked trees STAY on device for sweep_eval_batched (one
+        # validation program per stack); per-model tree arrays materialize
+        # lazily via _LazySlice — eager host pulls cost a ~44 MB download
+        # over the tunnel and eager device slicing compiles a
+        # dynamic_slice/squeeze program per shape. On a multi-device mesh
+        # the stack is host-pulled once up front instead: keeping
+        # replicated arrays around invites the eager multi-device slicing
+        # that aborts the async XLA:CPU runtime (memory:
+        # xla-cpu-mesh-gotchas).
         leaves = jax.tree.leaves(trees)
-        if leaves and len(getattr(leaves[0], "devices", lambda: [0])()) > 1:
+        is_dev = bool(leaves) and hasattr(leaves[0], "devices")
+        multi_dev = is_dev and len(leaves[0].devices()) > 1
+        if multi_dev or not is_dev:
             trees = jax.tree.map(lambda a: np.asarray(a), trees)
-        return [
+        stack = {
+            "trees": trees,
+            "thresholds": thresholds,
+            "k": n_masks * n_pts,
+        }
+        models = [
             [
                 make_model(
                     thresholds,
-                    self._tree_slice(trees, mi * n_pts + j),
+                    _LazySlice(stack, mi * n_pts + j),
                     merged[j],
                     mi,
                 )
@@ -368,6 +576,12 @@ class _TreeEstimator(PredictorEstimator):
             ]
             for mi in range(n_masks)
         ]
+        for mi in range(n_masks):
+            for j in range(n_pts):
+                m = models[mi][j]
+                m._sweep_stack = stack
+                m._sweep_lane = mi * n_pts + j
+        return models
 
 
 class XGBoostClassifier(_TreeEstimator):
@@ -411,7 +625,7 @@ class XGBoostClassifier(_TreeEstimator):
     _STATIC_GRID_KEYS = ("num_round", "max_depth", "max_bins")
 
     def fit_arrays(self, x, y, row_mask):
-        thresholds, binned = self._binned(x)
+        thresholds, binned, fgroups = self._binned(x)
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         kwargs = dict(
@@ -424,6 +638,7 @@ class XGBoostClassifier(_TreeEstimator):
             min_child_weight=float(self.min_child_weight),
             min_info_gain=float(self.min_info_gain),
             objective="binary:logistic",
+            feature_groups=fgroups,
         )
         rm = jnp.asarray(row_mask, dtype=jnp.float32)
         if num_classes == 2:
@@ -448,7 +663,7 @@ class XGBoostClassifier(_TreeEstimator):
             return None  # one-vs-rest loops stay sequential
         yj = jnp.asarray(y, dtype=jnp.float32)
 
-        def run_batched(binned, m0, row_mask_k, knob):
+        def run_batched(binned, m0, row_mask_k, knob, fgroups):
             trees, _ = TR.fit_boosted_batched(
                 binned, yj, row_mask_k,
                 num_rounds=int(m0["num_round"]),
@@ -459,6 +674,7 @@ class XGBoostClassifier(_TreeEstimator):
                 min_child_weight=knob("min_child_weight"),
                 min_info_gain=knob("min_info_gain"),
                 objective="binary:logistic",
+                feature_groups=fgroups,
             )
             return trees
 
@@ -504,7 +720,7 @@ class XGBoostRegressor(_TreeEstimator):
         base_scores = np.where(cnts > 0, sums / np.maximum(cnts, 1), 0.0)
         n_pts = len(group_points)
 
-        def run_batched(binned, m0, row_mask_k, knob):
+        def run_batched(binned, m0, row_mask_k, knob, fgroups):
             base_k = jnp.asarray(
                 np.repeat(base_scores, n_pts), dtype=jnp.float32
             )
@@ -519,6 +735,7 @@ class XGBoostRegressor(_TreeEstimator):
                 min_info_gain=knob("min_info_gain"),
                 base_score=base_k,
                 objective="reg:squarederror",
+                feature_groups=fgroups,
             )
             return trees
 
@@ -531,7 +748,7 @@ class XGBoostRegressor(_TreeEstimator):
         )
 
     def fit_arrays(self, x, y, row_mask):
-        thresholds, binned = self._binned(x)
+        thresholds, binned, fgroups = self._binned(x)
         base = float(np.mean(y[row_mask > 0])) if (row_mask > 0).any() else 0.0
         trees, _ = TR.fit_boosted(
             binned,
@@ -547,6 +764,7 @@ class XGBoostRegressor(_TreeEstimator):
             min_info_gain=float(self.min_info_gain),
             base_score=base,
             objective="reg:squarederror",
+            feature_groups=fgroups,
         )
         return BoostedRegressionModel(thresholds, trees, float(self.eta), base)
 
@@ -696,7 +914,7 @@ class RandomForestClassifier(_TreeEstimator):
         return 1.0 / np.sqrt(max(num_features, 1))
 
     def fit_arrays(self, x, y, row_mask):
-        thresholds, binned = self._binned(x)
+        thresholds, binned, fgroups = self._binned(x)
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         colsample = self._colsample(x.shape[1])
@@ -711,6 +929,7 @@ class RandomForestClassifier(_TreeEstimator):
             min_info_gain=float(self.min_info_gain),
             seed=int(self.seed),
             lowp=True,  # one-vs-rest indicators are bf16-exact
+            feature_groups=fgroups,
         )
         if num_classes == 2:
             forests = [
@@ -731,7 +950,7 @@ class RandomForestClassifier(_TreeEstimator):
         colsample = self._colsample(x.shape[1])
         yj = jnp.asarray((y == 1).astype(np.float32))
 
-        def run_batched(binned, m0, row_mask_k, knob):
+        def run_batched(binned, m0, row_mask_k, knob, fgroups):
             return TR.fit_forest_batched(
                 binned, yj, row_mask_k,
                 num_trees=int(m0["num_trees"]),
@@ -743,6 +962,7 @@ class RandomForestClassifier(_TreeEstimator):
                 min_info_gain=knob("min_info_gain"),
                 seed=int(m0["seed"]),
                 lowp=True,  # one-vs-rest indicators are bf16-exact
+                feature_groups=fgroups,
             )
 
         return self._batched_group_fit(
@@ -781,7 +1001,7 @@ class RandomForestRegressor(_TreeEstimator):
         return 1.0 / 3.0
 
     def fit_arrays(self, x, y, row_mask):
-        thresholds, binned = self._binned(x)
+        thresholds, binned, fgroups = self._binned(x)
         colsample = self._colsample(x.shape[1])
         trees = TR.fit_forest(
             binned,
@@ -795,6 +1015,7 @@ class RandomForestRegressor(_TreeEstimator):
             min_instances=float(self.min_instances_per_node),
             min_info_gain=float(self.min_info_gain),
             seed=int(self.seed),
+            feature_groups=fgroups,
         )
         return ForestRegressionModel(thresholds, trees)
 
@@ -802,7 +1023,7 @@ class RandomForestRegressor(_TreeEstimator):
         colsample = self._colsample(x.shape[1])
         yj = jnp.asarray(y, dtype=jnp.float32)
 
-        def run_batched(binned, m0, row_mask_k, knob):
+        def run_batched(binned, m0, row_mask_k, knob, fgroups):
             return TR.fit_forest_batched(
                 binned, yj, row_mask_k,
                 num_trees=int(m0["num_trees"]),
@@ -813,6 +1034,7 @@ class RandomForestRegressor(_TreeEstimator):
                 min_instances=knob("min_instances_per_node"),
                 min_info_gain=knob("min_info_gain"),
                 seed=int(m0["seed"]),
+                feature_groups=fgroups,
             )
 
         return self._batched_group_fit(
@@ -840,7 +1062,7 @@ class DecisionTreeClassifier(RandomForestClassifier):
         )
 
     def fit_arrays(self, x, y, row_mask):
-        thresholds, binned = self._binned(x)
+        thresholds, binned, fgroups = self._binned(x)
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         rm = jnp.asarray(row_mask, dtype=jnp.float32)
@@ -849,7 +1071,7 @@ class DecisionTreeClassifier(RandomForestClassifier):
             num_bins=int(self.max_bins), subsample_rate=1.0, colsample_rate=1.0,
             min_instances=float(self.min_instances_per_node),
             min_info_gain=float(self.min_info_gain), seed=int(self.seed),
-            bootstrap=False,
+            bootstrap=False, feature_groups=fgroups,
         )
         indicators = [1] if num_classes == 2 else list(range(num_classes))
         forests = [
@@ -874,7 +1096,7 @@ class DecisionTreeRegressor(RandomForestRegressor):
         )
 
     def fit_arrays(self, x, y, row_mask):
-        thresholds, binned = self._binned(x)
+        thresholds, binned, fgroups = self._binned(x)
         trees = TR.fit_forest(
             binned,
             jnp.asarray(y, dtype=jnp.float32),
@@ -883,6 +1105,6 @@ class DecisionTreeRegressor(RandomForestRegressor):
             num_bins=int(self.max_bins), subsample_rate=1.0, colsample_rate=1.0,
             min_instances=float(self.min_instances_per_node),
             min_info_gain=float(self.min_info_gain), seed=int(self.seed),
-            bootstrap=False,
+            bootstrap=False, feature_groups=fgroups,
         )
         return ForestRegressionModel(thresholds, trees)

@@ -69,6 +69,7 @@ def grow_tree(
     min_info_gain: float | jax.Array = 0.0,
     hist_impl: str | None = None,
     parallel_fits: int = 1,  # kept for API compat; K now rides the kernel grid
+    feature_groups=None,
 ) -> Tree:
     """Single-fit tree growth — the K=1 case of grow_tree_batched."""
     tree = grow_tree_batched(
@@ -77,7 +78,7 @@ def grow_tree(
         max_depth=max_depth, num_bins=num_bins,
         reg_lambda=reg_lambda, gamma=gamma,
         min_child_weight=min_child_weight, min_info_gain=min_info_gain,
-        hist_impl=hist_impl,
+        hist_impl=hist_impl, feature_groups=feature_groups,
     )
     return jax.tree.map(lambda a: a[0], tree)
 
@@ -100,6 +101,7 @@ def grow_tree_batched(
     min_info_gain: jax.Array | float = 0.0,
     hist_impl: str | None = None,
     lowp: bool = False,
+    feature_groups=None,
 ) -> Tree:
     """Grow K trees at once — one per batched fit (hyperparameter grid point
     × CV fold). The fit axis is a kernel GRID dimension of the histogram
@@ -112,7 +114,7 @@ def grow_tree_batched(
         max_depth=max_depth, num_bins=num_bins,
         reg_lambda=reg_lambda, gamma=gamma,
         min_child_weight=min_child_weight, min_info_gain=min_info_gain,
-        hist_impl=hist_impl, lowp=lowp,
+        hist_impl=hist_impl, lowp=lowp, feature_groups=feature_groups,
     )
 
 
@@ -132,6 +134,7 @@ def _grow_tree_impl(
     lowp: bool = False,
     axis_name: str | None = None,
     axis_size: int = 1,
+    feature_groups: tuple[jax.Array, jax.Array] | None = None,
 ) -> Tree:
     """Tree-growth body shared by the single-device jit wrapper and the
     shard_map'd path. With ``axis_name`` set, the function runs per-shard
@@ -141,7 +144,17 @@ def _grow_tree_impl(
     ICI replacement for XGBoost's Rabit allreduce of per-worker histograms
     (reference OpXGBoostClassifier.scala:101, SURVEY §2.6 row 5). Split
     decisions consume the same reduced histogram either way, so sharded and
-    single-device growth produce the same tree."""
+    single-device growth produce the same tree.
+
+    ``feature_groups`` = (narrow_idx, wide_idx): original-feature index
+    arrays partitioning the columns into ≤2-bin features (one-hot /
+    indicator columns — the vast majority of a transmogrified matrix) and
+    genuinely multi-bin ones. Split-search cost scales with features×bins,
+    so searching 900 binary columns at num_bins=32 wastes ~16× the bin-axis
+    work; the narrow group runs the same kernels at b=2 instead. Per-feature
+    gains are bin-cumsum along each feature's own row, so grouped growth
+    finds the SAME splits as ungrouped (tie-break by original feature id
+    preserved across the group merge)."""
     from .hist_pallas import (
         FUSED_SPLIT_MAX_ROWS,
         build_best_split_pallas,
@@ -157,6 +170,30 @@ def _grow_tree_impl(
     g = grad * row_mask
     h = hess * row_mask
     impl = hist_impl or default_impl()
+
+    if feature_groups is not None:
+        narrow_idx, wide_idx = feature_groups
+        if narrow_idx.shape[0] == 0:
+            feature_groups = None  # degenerate partition gains nothing
+    if feature_groups is not None:
+        # (binned columns, per-fit feature mask, bin count, orig ids).
+        # Narrow features hold exactly two values {0, t} in code space
+        # (duplicate quantile edges put the '1' value at code t = #zeros);
+        # recoding (code > 0) compresses them to b=2 while the stored split
+        # bin 0 routes identically in ORIGINAL code space (code > 0 ⇔
+        # value is the upper one) — predict needs no remapping.
+        groups = [
+            (
+                (binned[:, narrow_idx] > 0).astype(jnp.int32),
+                feat_mask[:, narrow_idx], 2, narrow_idx,
+            ),
+        ]
+        if wide_idx.shape[0]:
+            groups.append(
+                (binned[:, wide_idx], feat_mask[:, wide_idx], b, wide_idx)
+            )
+    else:
+        groups = [(binned, feat_mask, b, None)]
 
     def vec(v):
         arr = jnp.asarray(v, dtype=jnp.float32).reshape(-1)
@@ -182,13 +219,23 @@ def _grow_tree_impl(
         cap = min(cap, max_nodes)
     compact = cap < max_nodes
 
+    # histogram impl policy: "pallas" is AUTO — at AutoML-tabular row counts
+    # (≤4k) the one-hot GEMM histogram beats the kernels outright (per-level
+    # work is two MXU matmuls that fuse into the program; the pallas grid
+    # and the fused-split kernel carry per-pass costs that dominate at
+    # small N), while large N keeps the Mosaic kernels. "gemm"/"scatter"
+    # force their paths. The GEMM path also serves the sharded body: it is
+    # plain jnp, and the psum below reduces its per-shard histograms.
+    use_gemm = (impl == "gemm") or (impl == "pallas" and n <= 4096)
+
     # fused split search: gains + arg-best computed inside the kernel while
     # histograms are VMEM-resident — nothing [M, F, B]-sized touches HBM.
     # Only possible when every row fits one VMEM tile and the bins fit the
     # kernel's 128-lane packing. The sharded path needs the raw histogram
     # for the cross-shard psum, so it always takes the two-step path.
     use_fused = (
-        impl == "pallas"
+        not use_gemm
+        and impl == "pallas"
         and axis_name is None
         and n <= FUSED_SPLIT_MAX_ROWS
         and b <= 128
@@ -196,9 +243,12 @@ def _grow_tree_impl(
 
     # per-chunk histogram memory scales with K — shrink the node chunk so
     # [K, chunk, F, B, 2] stays inside the HBM budget (the Spark
-    # maxMemoryInMB node-group equivalent)
+    # maxMemoryInMB node-group equivalent). With feature groups the total
+    # histogram width is Σ_g f_g·b_g, and VMEM kernel caps take the min
+    # over groups.
+    hist_width = sum(gb.shape[1] * bb for gb, _, bb, _ in groups)
     budget_elems = max((1 << 25) // k_fits, 1 << 20)
-    chunk_cap = max(1, budget_elems // max(f * b, 1))
+    chunk_cap = max(1, budget_elems // max(hist_width, 1))
     while chunk_cap & (chunk_cap - 1):
         chunk_cap &= chunk_cap - 1
     chunk_cap = min(chunk_cap, cap)
@@ -211,6 +261,15 @@ def _grow_tree_impl(
         while m_cap & (m_cap - 1):
             m_cap &= m_cap - 1
         chunk_cap = min(cap, m_cap)
+    elif use_gemm:
+        # the [K, N, M] weighted node-one-hot temporaries bound the chunk;
+        # the 128 ceiling keeps deep levels multi-chunk so the occupancy
+        # skip can drop the (mostly dead) tail of the slot range instead of
+        # paying one [K·cap, N] GEMM per level
+        m_cap = max(8, min(128, (1 << 24) // max(k_fits * n, 1)))
+        while m_cap & (m_cap - 1):
+            m_cap &= m_cap - 1
+        chunk_cap = min(chunk_cap, m_cap)
     elif impl == "pallas":
         # VMEM per grid step: the [FEAT_TILE, M, b_pad]×2 output block (the
         # feature axis is gridded — f does not multiply in) plus the [T, M]
@@ -225,34 +284,57 @@ def _grow_tree_impl(
     gam_k = jnp.broadcast_to(vec(gamma), (k_fits,))
     mcw_k = jnp.broadcast_to(vec(min_child_weight), (k_fits,))
 
-    def chunk_stats(local, c0, chunk_nodes):
-        """Best (feat, bin) per compact slot in [c0, c0 + chunk_nodes)."""
-        active = (local >= c0) & (local < c0 + chunk_nodes)
-        loc = jnp.where(active, local - c0, -1)  # [K, N]
+    def build_histogram_gemm(gbinned, loc, chunk_nodes, gb):
+        """[K, M, Fg, Bg, 2] histogram as TWO one-hot GEMMs — the MXU-native
+        formulation for small row counts. The pallas kernel's grid economics
+        only win at large N; at AutoML-tabular sizes (≤4k rows) the whole
+        per-level histogram is a [K·M, N] @ [N, Fg·Bg] matmul pair that XLA
+        fuses into the surrounding program (measured: the depth-12 RF group
+        fell from ~25 s of kernel passes to GEMM noise)."""
+        nloc = gbinned.shape[0]
+        fg = gbinned.shape[1]
+        dt = jnp.bfloat16 if lowp else jnp.float32
+        codes1h = jax.nn.one_hot(gbinned, gb, dtype=dt).reshape(nloc, fg * gb)
+        node1h = jax.nn.one_hot(loc, chunk_nodes, dtype=jnp.float32)  # [K,N,M]
+        gw = (node1h * g[:, :, None]).astype(dt)
+        hw = (node1h * h[:, :, None]).astype(dt)
+        hg = jnp.einsum(
+            "knm,nw->kmw", gw, codes1h, preferred_element_type=jnp.float32
+        )
+        hh = jnp.einsum(
+            "knm,nw->kmw", hw, codes1h, preferred_element_type=jnp.float32
+        )
+        return jnp.stack([hg, hh], axis=-1).reshape(
+            loc.shape[0], chunk_nodes, fg, gb, 2
+        )
+
+    def group_stats(gbinned, gmask, gb, gidx, loc, chunk_nodes):
+        """(gain, orig feat, bin) of the best split per compact slot for
+        ONE feature group."""
         if use_fused:
             bg, bf, bb = build_best_split_pallas(
-                binned, loc, g, h, feat_mask,
+                gbinned, loc, g, h, gmask,
                 lam_k, gam_k, mcw_k,
-                num_nodes=chunk_nodes, num_bins=b, lowp=lowp,
+                num_nodes=chunk_nodes, num_bins=gb, lowp=lowp,
             )
-            do_split = bg > jnp.maximum(mig, 0.0)
-            return (
-                jnp.where(do_split, bf, -1),
-                jnp.where(do_split, bb, 0),
-            )
-        if impl == "pallas":
+            if gidx is not None:
+                bf = gidx[jnp.maximum(bf, 0)].astype(jnp.int32)
+            return bg, bf, bb
+        if use_gemm:
+            hist = build_histogram_gemm(gbinned, loc, chunk_nodes, gb)
+        elif impl == "pallas":
             hist = build_histogram_pallas_batched(
-                binned, loc, g, h, chunk_nodes, b
+                gbinned, loc, g, h, chunk_nodes, gb
             )
         else:
             hist = build_histogram_scatter_batched(
-                binned, loc, g, h, chunk_nodes, b
+                gbinned, loc, g, h, chunk_nodes, gb
             )
         if axis_name is not None:
             # the Rabit-allreduce moment: per-shard partial histograms
             # reduce over ICI; everything after sees the global histogram
             hist = jax.lax.psum(hist, axis_name)
-        hg, hh = hist[..., 0], hist[..., 1]  # [K, M, F, B]
+        hg, hh = hist[..., 0], hist[..., 1]  # [K, M, Fg, Bg]
 
         gl = jnp.cumsum(hg, axis=3)[..., :-1]
         hl = jnp.cumsum(hh, axis=3)[..., :-1]
@@ -265,19 +347,41 @@ def _grow_tree_impl(
         valid = (
             (hl >= mcw)
             & (hr >= mcw)
-            & (feat_mask[:, None, :, None] > 0)
+            & (gmask[:, None, :, None] > 0)
         )
         gain = jnp.where(valid, gain, -jnp.inf)
 
         flat_gain = gain.reshape(gain.shape[0], chunk_nodes, -1)
         best = jnp.argmax(flat_gain, axis=2)
         best_gain = jnp.take_along_axis(flat_gain, best[..., None], axis=2)[..., 0]
-        best_feat = (best // (b - 1)).astype(jnp.int32)
-        best_bin = (best % (b - 1)).astype(jnp.int32)
-        do_split = best_gain > jnp.maximum(mig, 0.0)
+        best_feat = (best // (gb - 1)).astype(jnp.int32)
+        best_bin = (best % (gb - 1)).astype(jnp.int32)
+        if gidx is not None:
+            best_feat = gidx[best_feat].astype(jnp.int32)
+        return best_gain, best_feat, best_bin
+
+    def chunk_stats(local, c0, chunk_nodes):
+        """Best (feat, bin) per compact slot in [c0, c0 + chunk_nodes),
+        merged across feature groups (tie-break: lowest original feature
+        id — matches the single-group argmax order)."""
+        active = (local >= c0) & (local < c0 + chunk_nodes)
+        loc = jnp.where(active, local - c0, -1)  # [K, N]
+        bg, bf, bb = None, None, None
+        for gbinned, gmask, grp_b, gidx in groups:
+            gg, gf, gbin = group_stats(
+                gbinned, gmask, grp_b, gidx, loc, chunk_nodes
+            )
+            if bg is None:
+                bg, bf, bb = gg, gf, gbin
+            else:
+                take = (gg > bg) | ((gg == bg) & (gf < bf))
+                bg = jnp.where(take, gg, bg)
+                bf = jnp.where(take, gf, bf)
+                bb = jnp.where(take, gbin, bb)
+        do_split = bg > jnp.maximum(mig, 0.0)
         return (
-            jnp.where(do_split, best_feat, -1),
-            jnp.where(do_split, best_bin, 0),
+            jnp.where(do_split, bf, -1),
+            jnp.where(do_split, bb, 0),
         )  # each [K, chunk]
 
     sentinel = jnp.int32(max_nodes)  # out-of-range → dropped by scatters
@@ -315,57 +419,102 @@ def _grow_tree_impl(
     # kernel pass and only the deepest levels pay for `cap` slots — the
     # shared-body fori_loop alternative forces every level to the maximum
     node = jnp.zeros((k_fits, n), dtype=jnp.int32)
+    # rows whose node failed to split are DEAD for histogram purposes: a
+    # non-split node's child holds the same rows, hence the same histogram
+    # and the same failed gain test (the hereditary no-split argument behind
+    # the early level exit, applied per NODE). Excluding them is lossless,
+    # shrinks the compacted live-slot frontier to the still-splittable
+    # nodes, and lets the per-chunk occupancy skip drop the dead bulk of
+    # deep levels. `node` itself keeps the full routing chain (dead rows
+    # continue left) so leaf assignment is unchanged.
+    active = jnp.ones((k_fits, n), dtype=bool)
     feats_levels, bins_levels = [], []
     for d in range(max_depth):
         n_nodes = min(1 << d, cap)  # static live-slot bound for this level
         chunk_nodes = min(chunk_cap, n_nodes)
         num_chunks = (n_nodes + chunk_nodes - 1) // chunk_nodes
 
-        if compact and (1 << d) > cap:
+        hist_node = jnp.where(active, node, sentinel)
+        # compact whenever the level's raw id space exceeds the slot cap OR
+        # spans multiple kernel chunks: dense slot numbering makes the
+        # trailing chunks provably empty, so the per-chunk occupancy skip
+        # above can drop their kernel passes (live nodes ≪ 2^d at depth)
+        if (compact and (1 << d) > cap) or (
+            axis_name is None and (1 << d) > chunk_nodes
+        ):
             if axis_name is None:
-                uids, local = jax.vmap(compact_ids)(node)  # [K, cap], [K, N]
+                uids, local = jax.vmap(compact_ids)(hist_node)
             else:
                 # global compaction: every shard must agree on the live-slot
                 # numbering, so derive it from a psum'd occupancy mask (same
-                # sorted-unique-ids result as compact_ids, but global)
+                # sorted-unique-ids result as compact_ids, but global);
+                # sentinel (dead) rows fall outside the scatter range
                 occ = jax.vmap(
                     lambda nd: jnp.zeros(max_nodes, jnp.int32).at[nd].add(
                         1, mode="drop"
                     )
-                )(node)
+                )(hist_node)
                 occ = jax.lax.psum(occ, axis_name)
                 ids = jnp.arange(max_nodes, dtype=jnp.int32)
                 live = jnp.where(occ > 0, ids[None, :], sentinel)
                 uids = jnp.sort(live, axis=1)[:, :cap]  # [K, cap]
                 local = jax.vmap(
                     lambda u, nd: jnp.searchsorted(u, nd).astype(jnp.int32)
-                )(uids, node)
+                )(uids, hist_node)
             compacted = True
         else:
-            local = node
+            local = hist_node
             compacted = False
+        # dead rows out of every histogram / occupancy check, regardless of
+        # which slot the sentinel landed on after compaction
+        local = jnp.where(active, local, sentinel)
 
         def live_level(local=local, n_nodes=n_nodes,
                        chunk_nodes=chunk_nodes, num_chunks=num_chunks):
-            if num_chunks <= 8:
+            if num_chunks <= 2:
                 cfs, cbs = [], []
                 for ci in range(num_chunks):
                     cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
                     cfs.append(cf)
                     cbs.append(cb)
+                if num_chunks == 1:
+                    return cfs[0][:, :n_nodes], cbs[0][:, :n_nodes]
                 return (
                     jnp.concatenate(cfs, axis=1)[:, :n_nodes],
                     jnp.concatenate(cbs, axis=1)[:, :n_nodes],
                 )
-            # many chunks (large-N two-phase path): a shared fori body keeps
-            # the program size bounded — Python-unrolling 100+ chunk bodies
-            # per level explodes trace/compile time
+            # multi-chunk levels run ONE shared fori body — unrolling a
+            # branch per chunk multiplies program size (and serialized
+            # executable bytes, which ship over the tunneled link every
+            # fresh process) by the chunk count. The occupancy cond inside
+            # the body skips the kernels for empty chunks: compaction
+            # numbers live slots densely from 0, so the deep-level tail of
+            # the slot range is provably empty. (The sharded path always
+            # computes — its psums can't sit under a data-dependent cond.)
             def chunk_body(ci, fb):
                 feats_a, bins_a = fb
-                cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
+                c0 = ci * chunk_nodes
+                if axis_name is None:
+                    occupied = (
+                        (local >= c0) & (local < c0 + chunk_nodes)
+                    ).any()
+                    cf, cb = jax.lax.cond(
+                        occupied,
+                        lambda: chunk_stats(local, c0, chunk_nodes),
+                        lambda: (
+                            jnp.full(
+                                (k_fits, chunk_nodes), -1, dtype=jnp.int32
+                            ),
+                            jnp.zeros(
+                                (k_fits, chunk_nodes), dtype=jnp.int32
+                            ),
+                        ),
+                    )
+                else:
+                    cf, cb = chunk_stats(local, c0, chunk_nodes)
                 return (
-                    jax.lax.dynamic_update_slice(feats_a, cf, (0, ci * chunk_nodes)),
-                    jax.lax.dynamic_update_slice(bins_a, cb, (0, ci * chunk_nodes)),
+                    jax.lax.dynamic_update_slice(feats_a, cf, (0, c0)),
+                    jax.lax.dynamic_update_slice(bins_a, cb, (0, c0)),
                 )
 
             feats_a0 = jnp.full(
@@ -420,19 +569,17 @@ def _grow_tree_impl(
         bins_levels.append(bins_d)
 
         # ---- route rows to children (gather via compact slots — cheaper)
-        row_feat = jnp.take_along_axis(
-            feats_c, jnp.minimum(local, n_nodes - 1), axis=1
-        )  # [K, N]
-        row_thr = jnp.take_along_axis(
-            bins_c, jnp.minimum(local, n_nodes - 1), axis=1
-        )
+        slot = jnp.clip(local, 0, n_nodes - 1)
+        row_feat = jnp.take_along_axis(feats_c, slot, axis=1)  # [K, N]
+        row_thr = jnp.take_along_axis(bins_c, slot, axis=1)
         code = jax.vmap(
             lambda rf: jnp.take_along_axis(
                 binned, jnp.maximum(rf, 0)[:, None], axis=1
             )[:, 0]
         )(row_feat)
-        go_right = (row_feat >= 0) & (code > row_thr)
+        go_right = active & (row_feat >= 0) & (code > row_thr)
         node = node * 2 + go_right.astype(jnp.int32)
+        active = active & (row_feat >= 0)
 
     feats = jnp.stack(feats_levels, axis=1)  # [K, depth, max_nodes]
     bins = jnp.stack(bins_levels, axis=1)
@@ -484,6 +631,7 @@ def fit_forest(
     bootstrap: bool = True,
     parallel_fits: int = 1,  # kept for API compat
     lowp: bool = False,
+    feature_groups=None,
 ) -> Tree:
     """Random forest of mean-target trees — the K=1 case of
     fit_forest_batched (Spark RandomForest parity: variance impurity ==
@@ -495,6 +643,7 @@ def fit_forest(
         subsample_rate=subsample_rate, colsample_rate=colsample_rate,
         min_instances=min_instances, min_info_gain=min_info_gain,
         seed=int(seed), bootstrap=bootstrap, lowp=lowp,
+        feature_groups=feature_groups,
     )
     return jax.tree.map(lambda a: a[0], trees)
 
@@ -525,6 +674,36 @@ def predict_boosted_raw(
     return base_score + eta * preds.sum(axis=0)
 
 
+@jax.jit
+def sweep_boosted_outputs(
+    x: jax.Array, thresholds: jax.Array, trees: Tree,
+    eta_v: jax.Array, base_v: jax.Array,
+) -> jax.Array:
+    """Margins for a WHOLE sweep stack in one dispatch: trees [K, R, ...]
+    (folds × grid lanes) → [K, N]. The validator's per-model predict loop
+    costs a dispatch + input upload per model over the tunneled link; here
+    the full candidate sweep's validation margins are one program."""
+    binned = bin_data(x, thresholds)
+
+    def one(t, e, b):
+        preds = jax.vmap(lambda tt: predict_tree(binned, tt))(t)  # [R, N]
+        return b + e * preds.sum(axis=0)
+
+    return jax.vmap(one)(trees, eta_v, base_v)
+
+
+@jax.jit
+def sweep_forest_outputs(
+    x: jax.Array, thresholds: jax.Array, trees: Tree,
+    eta_v: jax.Array, base_v: jax.Array,
+) -> jax.Array:
+    """Forest mean-leaf outputs for a sweep stack: trees [K, T, ...] →
+    [K, N]. eta_v/base_v are accepted (and ignored) so both sweep entry
+    points share a call signature."""
+    binned = bin_data(x, thresholds)
+    return jax.vmap(lambda t: predict_forest(binned, t))(trees)
+
+
 @partial(jax.jit, static_argnames=("n", "f", "bootstrap"))
 def _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap):
     """Bootstrap row counts + feature masks for one tree across K fits.
@@ -551,67 +730,47 @@ def _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap):
     return rmask, fmask
 
 
-def _tree_batch_size(k_fits: int, num_trees: int) -> int:
-    """Trees per grow dispatch — DEFAULT 1 (one program per tree, reused
-    across the host tree loop). Measured on the real chip (round 2): the
-    Titanic RF sweep with trees folded onto the fit axis (K'=252) ran 4x
-    SLOWER than per-tree dispatch (177 s vs 44 s) — the wide-grid fused
-    split-kernel programs schedule far worse, and dispatch round-trips are
-    negligible (~0.3 ms sync RTT), so there is nothing to amortize.
-    TPTPU_TREE_BATCH=N opts into folding N trees per dispatch for runtimes
-    where dispatch latency actually dominates."""
-    import os
-
-    env = os.environ.get("TPTPU_TREE_BATCH")
-    if env:
-        return max(1, int(env))
-    return 1
-
-
 @partial(
     jax.jit,
     static_argnames=("max_depth", "num_bins", "bootstrap", "lowp", "hist_impl"),
 )
-def _forest_trees_chunk(
+def _forest_trees_scan(
     binned, target, row_mask, tkeys, sub, col, min_instances, min_info_gain,
+    feature_groups=None, *,
     max_depth, num_bins, bootstrap, lowp, hist_impl=None,
 ) -> Tree:
-    """A chunk of bagged trees × all K fits in ONE batched growth: the
-    combined (tree, fit) axis rides the histogram-kernel grid. Masks are
-    drawn per tree with that tree's key — identical to the sequential
-    per-tree draws, so forests match the one-dispatch-per-tree path
-    bit-for-bit. Returns Tree arrays [K, tc, ...]."""
+    """The whole bagged forest as ONE program: ``lax.scan`` over the
+    per-tree PRNG keys with a single tree-growth body (the same shape as
+    the boosting rounds scan, which runs 200 rounds in under a second on
+    chip). This replaces both the host tree loop (a ~0.4 s dispatch per
+    tree over the tunneled link) and the tree-folded K'=trees×K kernels
+    (whose wide grids schedule badly and defeat the early level exit).
+    Masks are drawn per tree from the same keys, so forests are
+    bit-identical to the per-tree path. Returns Tree arrays [K, T, ...]."""
     k_fits, n = row_mask.shape
     f = binned.shape[1]
-    tc = len(tkeys)
-    rms, fms = [], []
-    for tk in tkeys:
+    gb = jnp.broadcast_to(-target[None, :], (k_fits, n))
+    ones = jnp.ones((k_fits, n), dtype=jnp.float32)
+    mi_k = jnp.broadcast_to(
+        jnp.asarray(min_instances, dtype=jnp.float32).reshape(-1), (k_fits,)
+    )
+    mg_k = jnp.broadcast_to(
+        jnp.asarray(min_info_gain, dtype=jnp.float32).reshape(-1), (k_fits,)
+    )
+
+    def body(_, tk):
         rm_t, fm_t = _bag_masks(tk, sub, col, row_mask, n, f, bootstrap)
-        rms.append(rm_t)
-        fms.append(fm_t)
-    rmask = jnp.concatenate(rms, axis=0)  # [tc*K, N], tree-major
-    fmask = jnp.concatenate(fms, axis=0)
-    gb = jnp.broadcast_to(-target[None, :], (tc * k_fits, n))
-
-    def tile(v):
-        vk = jnp.broadcast_to(
-            jnp.asarray(v, dtype=jnp.float32).reshape(-1), (k_fits,)
+        tree = _grow_tree_impl(
+            binned, gb, ones, rm_t, fm_t,
+            max_depth=max_depth, num_bins=num_bins,
+            reg_lambda=0.0, gamma=0.0,
+            min_child_weight=mi_k, min_info_gain=mg_k,
+            hist_impl=hist_impl, lowp=lowp, feature_groups=feature_groups,
         )
-        return jnp.tile(vk, tc)
+        return None, tree
 
-    tree = grow_tree_batched(
-        binned, gb, jnp.ones((tc * k_fits, n), dtype=jnp.float32),
-        rmask, fmask,
-        max_depth=max_depth, num_bins=num_bins,
-        reg_lambda=0.0, gamma=0.0,
-        min_child_weight=tile(min_instances),
-        min_info_gain=tile(min_info_gain),
-        lowp=lowp, hist_impl=hist_impl,
-    )
-    return jax.tree.map(
-        lambda a: jnp.swapaxes(a.reshape((tc, k_fits) + a.shape[1:]), 0, 1),
-        tree,
-    )
+    _, trees = jax.lax.scan(body, None, tkeys)  # [T, K, ...]
+    return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees)
 
 
 def fit_forest_batched(
@@ -629,13 +788,12 @@ def fit_forest_batched(
     bootstrap: bool = True,
     lowp: bool = False,
     mesh=None,
+    feature_groups=None,
 ) -> Tree:
-    """K random forests batched over the fit axis: tree t of every fit
-    grows in one program (fit axis = histogram-kernel grid axis); the TREE
-    loop runs on host, reusing that compiled program per dispatch — the
-    measured-fastest shape on the real chip (see _tree_batch_size for the
-    trees-on-the-fit-axis experiment and why it lost). Returns stacked
-    Tree arrays [K, T, ...].
+    """K random forests batched over the fit axis, the whole bagged forest
+    as ONE scan-over-trees program (_forest_trees_scan — one tree-growth
+    body, no per-tree dispatches, no tree-folded wide kernels). Returns
+    stacked Tree arrays [K, T, ...].
 
     With ``mesh`` set, rows shard over the mesh's data axis and each level's
     histogram psums over it (grows the same trees as the unsharded path —
@@ -659,38 +817,21 @@ def fit_forest_batched(
         return _fit_forest_batched_sharded(
             mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
             num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
-            bootstrap=bootstrap, lowp=lowp,
+            bootstrap=bootstrap, lowp=lowp, feature_groups=feature_groups,
         )
-    # tb defaults to 1 (one program per tree — measured fastest on the real
-    # chip; see _tree_batch_size). Masks are drawn per tree exactly as the
-    # sequential path would, so forests are bit-identical at any tb.
     from ..utils.aot import aot_call
 
-    tb = _tree_batch_size(k_fits, num_trees)
-    chunks = []
-    for t0 in range(0, num_trees, tb):
-        tc = min(tb, num_trees - t0)
-        chunks.append(
-            aot_call(
-                "forest_chunk", _forest_trees_chunk,
-                (
-                    binned, target, row_mask,
-                    tuple(tkeys[t0 + i] for i in range(tc)),
-                    sub, col, mi, mg,
-                ),
-                dict(max_depth=max_depth, num_bins=num_bins,
-                     bootstrap=bootstrap,
-                     # lowp is only sound when target values are bf16-exact
-                     # (classification indicators); regression keeps f32
-                     lowp=lowp,
-                     # resolved EARLY so both the jit cache and the AOT
-                     # blob key see the trace-time impl choice — an env
-                     # flip mid-process or a blob exported under the other
-                     # impl can no longer serve the wrong program
-                     hist_impl=_resolved_impl()),
-            )
-        )  # each [K, tc, ...]
-    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1), *chunks)
+    return aot_call(
+        "forest_scan", _forest_trees_scan,
+        (binned, target, row_mask, tkeys, sub, col, mi, mg, feature_groups),
+        dict(max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
+             # lowp is only sound when target values are bf16-exact
+             # (classification indicators); regression keeps f32
+             lowp=lowp,
+             # resolved EARLY so both the jit cache and the AOT blob key
+             # see the trace-time impl choice
+             hist_impl=_resolved_impl()),
+    )
 
 
 @partial(
@@ -712,6 +853,7 @@ def fit_boosted(
     base_score: float | jax.Array = 0.0,
     objective: str = "binary:logistic",
     parallel_fits: int = 1,
+    feature_groups=None,
 ) -> tuple[Tree, jax.Array]:
     """Gradient boosting (XGBoost/Spark-GBT parity): lax.scan over rounds,
     second-order gradients, shrinkage eta. Returns stacked trees [R, ...]
@@ -733,7 +875,7 @@ def fit_boosted(
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
-            parallel_fits=parallel_fits,
+            parallel_fits=parallel_fits, feature_groups=feature_groups,
         )
         margin = margin + eta * predict_tree(binned, tree)
         return margin, tree
@@ -755,7 +897,7 @@ def predict_boosted(
 
 def _boost_chunk_body(
     binned, y, row_mask, margin0, eta_v, reg_lambda, gamma,
-    min_child_weight, min_info_gain,
+    min_child_weight, min_info_gain, feature_groups=None, *,
     num_rounds, max_depth, num_bins, objective,
     axis_name=None, axis_size=1, hist_impl=None,
 ) -> tuple[Tree, jax.Array]:
@@ -781,13 +923,17 @@ def _boost_chunk_body(
             reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, min_info_gain=min_info_gain,
             axis_name=axis_name, axis_size=axis_size, hist_impl=hist_impl,
+            feature_groups=feature_groups,
         )
         step = jax.vmap(lambda t: predict_tree(binned, t))(tree)  # [K, N]
         margin = margin + eta_v[:, None] * step
         return margin, tree
 
     margin, trees = jax.lax.scan(round_step, margin0, None, length=num_rounds)
-    return trees, margin  # trees [R, K, ...]
+    # [R, K, ...] -> [K, R, ...] INSIDE the program: an eager transpose
+    # after the fact costs a compile-cache round-trip per shape
+    trees = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees)
+    return trees, margin  # trees [K, R, ...]
 
 
 _boost_rounds_batched = partial(
@@ -808,10 +954,17 @@ def _resolved_impl() -> str:
     return default_impl()
 
 
-#: boosting rounds per compiled program — keeps any one program's size
-#: bounded (a single 200-round × K-fit program risks the runtime-worker
-#: faults observed with the fused forest program)
-_BOOST_ROUND_CHUNK = 25
+def _boost_round_chunk(num_rounds: int) -> int:
+    """Boosting rounds per compiled program — DEFAULT the whole run (one
+    program). Round 3 validated a single 200-round × K-fit program on the
+    real chip (25.6 s one-time compile, banked as a serialized executable;
+    ~ms warm) — the round-1 worker faults that motivated 25-round chunks
+    no longer reproduce, and per-process cost is per-PROGRAM. Set
+    TPTPU_BOOST_CHUNK=N to restore chunking on runtimes that fault."""
+    import os
+
+    env = os.environ.get("TPTPU_BOOST_CHUNK")
+    return max(1, int(env)) if env else num_rounds
 
 
 def fit_boosted_batched(
@@ -829,6 +982,7 @@ def fit_boosted_batched(
     base_score: jax.Array | float = 0.0,
     objective: str = "binary:logistic",
     mesh=None,
+    feature_groups=None,
 ) -> tuple[Tree, jax.Array]:
     """K boosting runs batched over the fit axis: every round grows all K
     trees in one histogram build; rounds scan in fixed-size chunks so each
@@ -855,6 +1009,7 @@ def fit_boosted_batched(
             mesh, binned, y, row_mask, eta_v, lam, gam, mcw, mig,
             base_score=base_score, num_rounds=num_rounds,
             max_depth=max_depth, num_bins=num_bins, objective=objective,
+            feature_groups=feature_groups,
         )
     margin = jnp.broadcast_to(
         jnp.asarray(base_score, dtype=jnp.float32).reshape(-1, 1), (k_fits, n)
@@ -863,19 +1018,24 @@ def fit_boosted_batched(
 
     chunks = []
     done = 0
+    chunk_size = _boost_round_chunk(num_rounds)
     while done < num_rounds:
-        rc = min(_BOOST_ROUND_CHUNK, num_rounds - done)
+        rc = min(chunk_size, num_rounds - done)
         trees_c, margin = aot_call(
             "boost_chunk", _boost_rounds_batched,
-            (binned, y, row_mask, margin, eta_v, lam, gam, mcw, mig),
+            (binned, y, row_mask, margin, eta_v, lam, gam, mcw, mig,
+             feature_groups),
             dict(num_rounds=rc, max_depth=max_depth, num_bins=num_bins,
                  objective=objective, hist_impl=_resolved_impl()),
         )
-        chunks.append(trees_c)
+        chunks.append(trees_c)  # each [K, rc, ...] (swap happens in-jit)
         done += rc
-    trees = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *chunks)
-    # trees: [R, K, ...] -> [K, R, ...]
-    return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees), margin
+    if len(chunks) == 1:
+        return chunks[0], margin
+    # multi-chunk only off the default path: concatenate on HOST (eager
+    # device concatenates cost a compile-cache round-trip per shape)
+    chunks = [jax.tree.map(np.asarray, c) for c in chunks]
+    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=1), *chunks), margin
 
 
 # --------------------------------------------------------------------------
@@ -897,9 +1057,11 @@ def _pad_axis(a: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 
 @lru_cache(maxsize=None)
-def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp):
+def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
+                         has_groups=False):
     """jit(shard_map(grow)) for one (mesh, statics) combo, built once —
-    rebuilding per call would retrace every tree."""
+    rebuilding per call would retrace every tree. Feature-group index
+    arrays (when present) are replicated: the feature axis is unsharded."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -907,13 +1069,15 @@ def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp):
 
     size = mesh.shape[DATA_AXIS]
 
-    def body(binned, grad, hess, row_mask, feat_mask, lam, gam, mcw, mig):
+    def body(binned, grad, hess, row_mask, feat_mask, lam, gam, mcw, mig,
+             *grp):
         return _grow_tree_impl(
             binned, grad, hess, row_mask, feat_mask,
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=lam, gamma=gam, min_child_weight=mcw,
             min_info_gain=mig, hist_impl=hist_impl, lowp=lowp,
             axis_name=DATA_AXIS, axis_size=size,
+            feature_groups=grp if grp else None,
         )
 
     rep = P()
@@ -926,7 +1090,60 @@ def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp):
             P(None, DATA_AXIS),   # hess
             P(None, DATA_AXIS),   # row_mask
             rep, rep, rep, rep, rep,
-        ),
+        ) + ((rep, rep) if has_groups else ()),
+        out_specs=Tree(split_feat=rep, split_bin=rep, leaf_value=rep),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=None)
+def _sharded_forest_scan_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
+                                has_groups=False):
+    """jit(shard_map(scan-over-trees)): the sharded counterpart of
+    _forest_trees_scan. Per-tree masks are drawn OUTSIDE (global-row
+    semantics) and enter sharded on the row axis; the scan carries the
+    whole forest in one program, psum'ing each level's histograms."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    size = mesh.shape[DATA_AXIS]
+
+    def body_fn(binned, target, rmasks, fmasks, mi_k, mg_k, *grp):
+        k_fits = rmasks.shape[1]
+        n_local = binned.shape[0]
+        gb = jnp.broadcast_to(-target[None, :], (k_fits, n_local))
+        ones = jnp.ones((k_fits, n_local), dtype=jnp.float32)
+
+        def one_tree(_, rm_fm):
+            rm_t, fm_t = rm_fm
+            tree = _grow_tree_impl(
+                binned, gb, ones, rm_t, fm_t,
+                max_depth=max_depth, num_bins=num_bins,
+                reg_lambda=0.0, gamma=0.0,
+                min_child_weight=mi_k, min_info_gain=mg_k,
+                hist_impl=hist_impl, lowp=lowp,
+                axis_name=DATA_AXIS, axis_size=size,
+                feature_groups=grp if grp else None,
+            )
+            return None, tree
+
+        _, trees = jax.lax.scan(one_tree, None, (rmasks, fmasks))
+        return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees)
+
+    rep = P()
+    sm = shard_map(
+        body_fn,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None),        # binned [N, F]
+            P(DATA_AXIS),              # target [N]
+            P(None, None, DATA_AXIS),  # rmasks [T, K, N]
+            rep,                       # fmasks [T, K, F]
+            rep, rep,
+        ) + ((rep, rep) if has_groups else ()),
         out_specs=Tree(split_feat=rep, split_bin=rep, leaf_value=rep),
         check_vma=False,
     )
@@ -935,7 +1152,7 @@ def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp):
 
 def _fit_forest_batched_sharded(
     mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
-    num_trees, max_depth, num_bins, bootstrap, lowp,
+    num_trees, max_depth, num_bins, bootstrap, lowp, feature_groups=None,
 ) -> Tree:
     from ..parallel.mesh import DATA_AXIS
 
@@ -944,52 +1161,28 @@ def _fit_forest_batched_sharded(
     f = binned.shape[1]
     binned_p = _pad_axis(jnp.asarray(binned, jnp.int32), 0, size)
     target_p = _pad_axis(jnp.asarray(target, jnp.float32), 0, size)
-    n_pad = binned_p.shape[0]
     rm = jnp.asarray(row_mask, jnp.float32)
-    kern = _sharded_grow_kernel(mesh, max_depth, num_bins, _resolved_impl(), lowp)
-    zero = jnp.zeros(1, jnp.float32)
-    mi = jnp.broadcast_to(jnp.asarray(mi, jnp.float32).reshape(-1), (k_fits,))
-    mg = jnp.broadcast_to(jnp.asarray(mg, jnp.float32).reshape(-1), (k_fits,))
-    # trees ride the fit axis in chunks, same as the unsharded path
-    tb = _tree_batch_size(k_fits, num_trees)
-    chunks = []
-    for t0 in range(0, num_trees, tb):
-        tc = min(tb, num_trees - t0)
-        rms, fms = [], []
-        for i in range(tc):
-            # masks drawn over the UNPADDED n — bit-identical to the
-            # single-device draw — then padded with zeros
-            rmask_t, fmask_t = _bag_masks(
-                tkeys[t0 + i], sub, col, rm, n=n, f=f, bootstrap=bootstrap
-            )
-            rms.append(_pad_axis(rmask_t, 1, size))
-            fms.append(fmask_t)
-        rmask = jnp.concatenate(rms, axis=0)  # [tc*K, N_pad], tree-major
-        fmask = jnp.concatenate(fms, axis=0)
-        gb = jnp.broadcast_to(-target_p[None, :], (tc * k_fits, n_pad))
-        ones = jnp.ones((tc * k_fits, n_pad), jnp.float32)
-        tree = kern(
-            binned_p, gb, ones, rmask, fmask,
-            zero, zero, jnp.tile(mi, tc), jnp.tile(mg, tc),
-        )
-        # pull each replicated chunk to HOST before reshaping: eagerly
-        # reshaping/concatenating multi-device arrays dispatches per-device
-        # ops per tree, which intermittently aborts the XLA:CPU async
-        # runtime (memory: xla-cpu-mesh-gotchas); trees are tiny
-        chunks.append(
-            jax.tree.map(
-                lambda a: np.swapaxes(
-                    np.asarray(a).reshape((tc, k_fits) + a.shape[1:]), 0, 1
-                ),
-                tree,
-            )
-        )
-    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=1), *chunks)
+    # masks drawn over the UNPADDED n — bit-identical to the single-device
+    # draw — then padded with zeros; [T, K, N] rides the scan axis
+    rmasks, fmasks = jax.vmap(
+        lambda tk: _bag_masks(tk, sub, col, rm, n=n, f=f, bootstrap=bootstrap)
+    )(tkeys)
+    rmasks = _pad_axis(rmasks, 2, size)
+    mi_k = jnp.broadcast_to(jnp.asarray(mi, jnp.float32).reshape(-1), (k_fits,))
+    mg_k = jnp.broadcast_to(jnp.asarray(mg, jnp.float32).reshape(-1), (k_fits,))
+    kern = _sharded_forest_scan_kernel(
+        mesh, max_depth, num_bins, _resolved_impl(), lowp,
+        has_groups=feature_groups is not None,
+    )
+    grp_args = tuple(feature_groups) if feature_groups is not None else ()
+    trees = kern(binned_p, target_p, rmasks, fmasks, mi_k, mg_k, *grp_args)
+    # pull replicated trees to HOST once (memory: xla-cpu-mesh-gotchas)
+    return jax.tree.map(lambda a: np.asarray(a), trees)
 
 
 @lru_cache(maxsize=None)
 def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective,
-                          hist_impl=None):
+                          hist_impl=None, has_groups=False):
     """jit(shard_map(boost-round-chunk)): margins stay row-sharded across
     the scan; each round's histogram build psums over the data axis."""
     from jax import shard_map
@@ -999,9 +1192,11 @@ def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective,
 
     size = mesh.shape[DATA_AXIS]
 
-    def body(binned, y, row_mask, margin0, eta_v, lam, gam, mcw, mig):
+    def body(binned, y, row_mask, margin0, eta_v, lam, gam, mcw, mig,
+             *grp):
         return _boost_chunk_body(
             binned, y, row_mask, margin0, eta_v, lam, gam, mcw, mig,
+            grp if grp else None,
             num_rounds=num_rounds, max_depth=max_depth, num_bins=num_bins,
             objective=objective, axis_name=DATA_AXIS, axis_size=size,
             hist_impl=hist_impl,
@@ -1017,7 +1212,7 @@ def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective,
             P(None, DATA_AXIS),   # row_mask
             P(None, DATA_AXIS),   # margin0
             rep, rep, rep, rep, rep,
-        ),
+        ) + ((rep, rep) if has_groups else ()),
         out_specs=(
             Tree(split_feat=rep, split_bin=rep, leaf_value=rep),
             P(None, DATA_AXIS),
@@ -1030,6 +1225,7 @@ def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective,
 def _fit_boosted_batched_sharded(
     mesh, binned, y, row_mask, eta_v, lam, gam, mcw, mig,
     base_score, num_rounds, max_depth, num_bins, objective,
+    feature_groups=None,
 ) -> tuple[Tree, jax.Array]:
     from ..parallel.mesh import DATA_AXIS
 
@@ -1049,19 +1245,21 @@ def _fit_boosted_batched_sharded(
     mig = jnp.asarray(mig, jnp.float32).reshape(-1)
     chunks = []
     done = 0
+    chunk_size = _boost_round_chunk(num_rounds)
     while done < num_rounds:
-        rc = min(_BOOST_ROUND_CHUNK, num_rounds - done)
+        rc = min(chunk_size, num_rounds - done)
         kern = _sharded_boost_kernel(mesh, rc, max_depth, num_bins, objective,
-                                     _resolved_impl())
+                                     _resolved_impl(),
+                                     has_groups=feature_groups is not None)
+        grp_args = tuple(feature_groups) if feature_groups is not None else ()
         trees_c, margin = kern(
-            binned_p, y_p, rm_p, margin, eta_v, lam, gam, mcw, mig
+            binned_p, y_p, rm_p, margin, eta_v, lam, gam, mcw, mig, *grp_args
         )
         # host-fetch each chunk's replicated trees — eager multi-device
         # reshapes intermittently abort the XLA:CPU async runtime (memory:
         # xla-cpu-mesh-gotchas); margin stays DEVICE-resident as the next
-        # chunk's carry
+        # chunk's carry. Chunks are [K, rc, ...] (swap happens in-jit).
         chunks.append(jax.tree.map(lambda a: np.asarray(a), trees_c))
         done += rc
-    trees = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *chunks)
-    trees = jax.tree.map(lambda a: np.swapaxes(a, 0, 1), trees)
+    trees = jax.tree.map(lambda *xs: np.concatenate(xs, axis=1), *chunks)
     return trees, np.asarray(margin)[:, :n]

@@ -310,7 +310,15 @@ class ModelSelector(PredictorEstimator):
         if self.splitter is not None and self.splitter.summary is not None:
             splitter_summary = self.splitter.summary.to_json()
 
-        best_model = final_est.fit_arrays(xt, yt, final_mask)
+        # refit through the family's BATCHED path when it has one: batched
+        # fits acquire their programs through the AOT executable bank
+        # (utils/aot.py), so a fresh process pays a cached load instead of
+        # a trace+compile for the winner's refit
+        batched = getattr(final_est, "fit_arrays_batched_masks", None)
+        if batched is not None:
+            best_model = batched(xt, yt, [final_mask], [dict(best.grid)])[0][0]
+        else:
+            best_model = final_est.fit_arrays(xt, yt, final_mask)
 
         pred, prob, _ = best_model.predict_arrays(xt)
         train_metrics = self.evaluator.evaluate_arrays(yt, pred, prob)

@@ -142,6 +142,15 @@ class Validator:
             models_by_fold = batched_masks(
                 x, y, [tm.astype(np.float32) for tm, _ in folds], points
             )
+            # family-managed batched validation: one device program per
+            # fitted stack instead of a predict dispatch per model
+            sweep_eval = getattr(est, "sweep_eval_batched", None)
+            if sweep_eval is not None:
+                vals = sweep_eval(models_by_fold, x, y, folds, evaluator)
+                if vals is not None:
+                    per_point_values = vals
+                    models_by_fold = None  # skip the per-model loop below
+                    folds = []
         else:
             models_by_fold = None
         for fi, (train_mask, val_mask) in enumerate(folds):

@@ -1,17 +1,23 @@
-"""Disk-backed AOT program cache (jax.export).
+"""Disk-backed AOT program cache (serialized executables + jax.export).
 
 Fresh-process wall-clock on the tunneled chip is dominated by program
-ACQUISITION, not execution (BASELINE.md round 2: the 25-round XGB chunk
-traces+lowers in ~4 s, loads from the persistent compile cache in ~0.6 s,
-and executes in ~1 ms). The persistent XLA compile cache already removes
-recompilation; this layer removes the per-process TRACING by serializing
-exported StableHLO programs to disk and rehydrating them with
-``jax.export.deserialize`` (~0 s) — the subsequent jit-of-call compile
-hits the persistent compile cache.
+ACQUISITION, not execution (BASELINE.md round 2/3: a 25-round boost chunk
+executes in ~9 ms but costs seconds to trace/compile/load per process; the
+axon backend routes compiles through a remote helper, so even a cached
+compile is ~0.3-0.8 s and a fresh one is tens of seconds).
 
-Usage: ``aot_call("name", jit_fn, args, statics)`` — transparently falls
-back to a direct ``jit_fn(*args, **statics)`` call on ANY failure (new
-shapes still work, blobs self-invalidate via a source-version salt).
+Round 3 layers, fastest first:
+  1. in-memory table (``_MEM``) — same-process repeats are free;
+  2. serialized EXECUTABLE cache (``jax.experimental.serialize_executable``)
+     — a fresh process skips trace AND compile AND compile-cache load:
+     measured ~1.3 s for a 46 MB boost-chunk executable vs ~2.6 s for the
+     round-2 StableHLO path and ~20-40 s for a cold compile. ``prewarm()``
+     loads every banked executable for the current (backend, device-count)
+     on a thread pool so the model-selector phase finds them in ``_MEM``;
+  3. transparent fallback to a direct ``jit_fn(*args, **statics)`` call on
+     ANY failure (new shapes still work; blobs self-invalidate via a
+     source-version salt in the key).
+
 Opt out with TPTPU_AOT=0.
 """
 from __future__ import annotations
@@ -19,7 +25,9 @@ from __future__ import annotations
 import hashlib
 import logging
 import os
+import pickle
 import threading
+import time as _time
 from typing import Any, Callable
 
 log = logging.getLogger(__name__)
@@ -30,31 +38,30 @@ _PENDING: set = set()
 _FAILED: set = set()
 _THREADS: list = []
 _SALT: str | None = None
-_REGISTERED = False
-
-
-import time as _time
 
 _START = _time.monotonic()
 
 
 def _drain_exports() -> None:
-    """Give in-flight background exports a chance to land before the
-    process exits — daemon threads are otherwise killed mid-trace and the
-    blob never materializes (each short-lived bench process would only
-    bank one or two programs). The wait is scaled to process lifetime so a
-    quick scoring CLI run never hangs ~60 s at exit: a process that ran
-    for t seconds waits at most min(60, max(5, 2t))."""
-    import time
-
-    elapsed = time.monotonic() - _START
-    budget = min(60.0, max(5.0, 2.0 * elapsed))
-    deadline = time.monotonic() + budget
+    """Give in-flight background executable saves a chance to land before
+    the process exits — daemon threads are otherwise killed mid-compile and
+    the blob never materializes. The wait is scaled to process lifetime (a
+    process that ran t seconds waits at most min(600, max(5, 2t))): quick
+    scoring CLI runs exit within seconds, while long bench/training runs
+    may sit out a background compile that takes minutes — capping those at
+    60 s starved the bank forever (the same key re-missed every run)."""
+    elapsed = _time.monotonic() - _START
+    # long-lived processes (bench/training runs) may be draining a save
+    # whose background compile is minutes — capping those at 60 s starves
+    # the bank forever (the same key misses every run); quick CLI runs
+    # stay bounded by twice their own lifetime
+    budget = min(600.0, max(5.0, 2.0 * elapsed))
+    deadline = _time.monotonic() + budget
     for th in list(_THREADS):
-        th.join(timeout=max(0.0, deadline - time.monotonic()))
+        th.join(timeout=max(0.0, deadline - _time.monotonic()))
     alive = [th for th in _THREADS if th.is_alive()]
     if alive:
-        log.info("abandoning %d unfinished AOT exports at exit", len(alive))
+        log.info("abandoning %d unfinished AOT saves at exit", len(alive))
 
 
 import atexit  # noqa: E402
@@ -66,10 +73,13 @@ def _enabled() -> bool:
     return os.environ.get("TPTPU_AOT", "1") != "0"
 
 
-def _cache_dir() -> str:
+def _exec_dir() -> str:
+    import jax
+
     base = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-        ".jax_cache", "exports",
+        ".jax_cache", "execs",
+        f"{jax.default_backend()}-{len(jax.devices())}",
     )
     os.makedirs(base, exist_ok=True)
     return base
@@ -95,28 +105,6 @@ def _version_salt() -> str:
     return _SALT
 
 
-def _register_serializations() -> None:
-    global _REGISTERED
-    if _REGISTERED:
-        return
-    from jax import export
-
-    from ..models.solvers import GLMParams
-    from ..models.trees import Tree
-
-    for cls, sname in (
-        (Tree, "transmogrifai_tpu.Tree"),
-        (GLMParams, "transmogrifai_tpu.GLMParams"),
-    ):
-        try:
-            export.register_namedtuple_serialization(
-                cls, serialized_name=sname
-            )
-        except ValueError:
-            pass  # already registered
-    _REGISTERED = True
-
-
 def _key(name: str, args: tuple, statics: dict) -> str:
     import jax
 
@@ -136,77 +124,156 @@ def _key(name: str, args: tuple, statics: dict) -> str:
     return hashlib.sha256("|".join(map(str, parts)).encode()).hexdigest()[:24]
 
 
+def _load_exec(path: str):
+    """pickle → deserialize_and_load → callable, or None."""
+    from jax.experimental import serialize_executable as SE
+
+    with open(path, "rb") as fh:
+        payload, in_tree, out_tree = pickle.loads(fh.read())
+    compiled = SE.deserialize_and_load(payload, in_tree, out_tree)
+    os.utime(path)  # recency marker for pruning
+    return lambda *a: compiled(*a)
+
+
+def prewarm(max_workers: int = 8, max_bytes: int = 32_000_000) -> int:
+    """Load every CURRENT-version banked executable for this
+    backend/device-count into ``_MEM`` on a thread pool. Call early (e.g.
+    right after backend init) so acquisition overlaps the data/feature
+    phases; returns the number of programs loaded. Files from other source
+    versions can never hit (the key embeds the salt), so they are deleted
+    on sight — without this the bank grows by a full program set per source
+    edit and prewarm ships gigabytes of dead executables."""
+    if not _enabled():
+        return 0
+    try:
+        d = _exec_dir()
+    except Exception:
+        return 0
+    salt = _version_salt()
+    paths = []
+    for fn in os.listdir(d):
+        if not fn.endswith(".jaxexec"):
+            continue
+        p = os.path.join(d, fn)
+        if not fn.startswith(salt + "-"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+            continue
+        try:
+            if os.path.getsize(p) > max_bytes:
+                # big executables ship their binary over the tunneled link
+                # at load — prewarming them CONTENDS with the foreground
+                # work's device traffic (measured: a ~1 GB prewarm stalls
+                # the first sweep ~20 s). They load lazily instead, inside
+                # whichever family thread needs them.
+                continue
+        except OSError:
+            continue
+        paths.append(p)
+    if not paths:
+        return 0
+    from concurrent.futures import ThreadPoolExecutor
+
+    loaded = [0]
+
+    def _one(p):
+        key = os.path.basename(p)[len(salt) + 1: -len(".jaxexec")]
+        with _LOCK:
+            if key in _MEM:
+                return
+        try:
+            call = _load_exec(p)
+        except Exception as e:
+            log.info("prewarm: dropping unusable executable %s (%s)", p, e)
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+            return
+        with _LOCK:
+            _MEM.setdefault(key, call)
+            loaded[0] += 1
+
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        list(pool.map(_one, paths))
+    log.info("prewarm: %d executables loaded", loaded[0])
+    return loaded[0]
+
+
 def aot_call(
     name: str, jit_fn: Callable, args: tuple, statics: dict
 ) -> Any:
-    """``jit_fn(*args, **statics)`` through the export cache."""
+    """``jit_fn(*args, **statics)`` through the executable cache."""
     if not _enabled():
         return jit_fn(*args, **statics)
     try:
-        import jax
-        from jax import export
-
-        _register_serializations()
         key = _key(name, args, statics)
         with _LOCK:
             call = _MEM.get(key)
         if call is not None:
             return call(*args)
-        path = os.path.join(_cache_dir(), key + ".jaxexport")
+        path = os.path.join(
+            _exec_dir(), f"{_version_salt()}-{key}.jaxexec"
+        )
         if os.path.exists(path):
             try:
-                with open(path, "rb") as fh:
-                    exp = export.deserialize(fh.read())
-                call = jax.jit(exp.call)
+                call = _load_exec(path)
                 out = call(*args)
                 with _LOCK:
                     _MEM[key] = call
                 return out
             except Exception as e:
                 # corrupt/stale blob: remove it so a future first-use
-                # re-exports instead of permanently disabling the cache
-                log.info("AOT blob %s unusable (%s); removing", key, e)
+                # re-saves instead of permanently disabling the cache
+                log.info("AOT executable %s unusable (%s); removing", key, e)
                 try:
                     os.remove(path)
                 except OSError:
                     pass
-        # first use of this program version: run directly, then export in
-        # the background so FUTURE processes skip the trace (the export
-        # itself re-traces, which we don't want on the critical path).
-        # _PENDING dedupes concurrent validator threads; _FAILED is the
-        # negative cache (a program export cannot spontaneously start
-        # working, so don't re-trace it per call); the tmp suffix is
-        # unique per thread so racing writers can't interleave one file.
+        # first use of this program version: run directly, then save the
+        # compiled executable in the background so FUTURE processes skip
+        # trace+compile. _PENDING dedupes concurrent validator threads;
+        # _FAILED is the negative cache; the tmp suffix is unique per
+        # thread so racing writers can't interleave one file.
         out = jit_fn(*args, **statics)
         with _LOCK:
             if key not in _MEM:
-                # same-process repeats should reuse jit_fn's warm cache
-                # instead of preferring the blob once it lands mid-process
-                # (deserialize + recompile would ADD latency here)
+                # same-process repeats reuse jit_fn's warm cache
                 _MEM[key] = lambda *a: jit_fn(*a, **statics)
             if key in _PENDING or key in _FAILED:
                 return out
             _PENDING.add(key)
 
-        def _export():
+        def _save():
             try:
-                exp = export.export(
-                    jax.jit(lambda *a: jit_fn(*a, **statics))
-                )(*args)
-                blob = exp.serialize()
+                from jax.experimental import serialize_executable as SE
+
+                t0 = _time.monotonic()
+                # .lower().compile() hits the jit's persistent compile
+                # cache (same computation), so this is load-cost, not a
+                # recompile
+                compiled = jit_fn.lower(*args, **statics).compile()
+                payload, in_tree, out_tree = SE.serialize(compiled)
+                blob = pickle.dumps((payload, in_tree, out_tree))
                 tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
                 with open(tmp, "wb") as fh:
                     fh.write(blob)
                 os.replace(tmp, path)
+                log.info(
+                    "AOT saved %s (%s, %.1f MB) in %.1f s", name, key,
+                    len(blob) / 1e6, _time.monotonic() - t0,
+                )
             except Exception as e:  # never break the fit for the cache
-                log.info("AOT export of %s failed: %s", name, e)
+                log.info("AOT save of %s failed: %s", name, e)
                 with _LOCK:
                     _FAILED.add(key)
             finally:
                 with _LOCK:
                     _PENDING.discard(key)
 
-        th = threading.Thread(target=_export, daemon=True)
+        th = threading.Thread(target=_save, daemon=True)
         with _LOCK:
             _THREADS.append(th)
         th.start()
