@@ -60,6 +60,7 @@ def test_loaded_model_summary_and_evaluate(trained, tmp_path):
     assert "LogisticRegression" in loaded.summary_pretty()
 
 
+@pytest.mark.slow
 def test_save_load_tree_model(tmp_path, rng):
     import transmogrifai_tpu.types as T
     from transmogrifai_tpu.dataset import Dataset

@@ -21,6 +21,9 @@ from transmogrifai_tpu.selector import BinaryClassificationModelSelector
 from transmogrifai_tpu.types.columns import column_from_values
 from transmogrifai_tpu.workflow.workflow import Workflow
 
+# selector-training scale: excluded from the default fast suite (README)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def setup(tmp_path_factory):

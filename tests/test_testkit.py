@@ -1,5 +1,6 @@
 """testkit generator tests (reference: testkit/src/test/.../testkit/)."""
 import numpy as np
+import pytest
 
 import transmogrifai_tpu.types as T
 from transmogrifai_tpu.testkit import (
@@ -101,6 +102,7 @@ class TestRandomGenerators:
         assert ds["age"].feature_type is T.Real
         assert ds["city"].feature_type is T.PickList
 
+    @pytest.mark.slow
     def test_generators_feed_workflow(self):
         """End-to-end: testkit data through transmogrify + selector."""
         from transmogrifai_tpu.features import from_dataset

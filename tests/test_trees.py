@@ -209,6 +209,7 @@ def test_mlp_classifier_nonlinear(circles):
     np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-6)
 
 
+@pytest.mark.slow
 class TestBatchedGridFits:
     """fit_arrays_batched folds same-static-shape grid points into one
     vmapped program (the validator's sweep hook, validators.py:102)."""
@@ -272,6 +273,7 @@ class TestBatchedGridFits:
             np.testing.assert_allclose(np.asarray(pm), np.asarray(ps), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_fori_chunk_path_matches_unrolled(rng):
     """Large chunk counts take a shared fori body (program-size bound);
     results must match the small-count Python-unrolled branch exactly."""

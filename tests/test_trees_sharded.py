@@ -15,6 +15,9 @@ import pytest
 from transmogrifai_tpu.models import trees as TR
 from transmogrifai_tpu.parallel import make_mesh
 
+# selector-training scale: excluded from the default fast suite (README)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

@@ -196,6 +196,7 @@ def test_default_selector_candidate_families():
     ]
 
 
+@pytest.mark.slow
 def test_selector_with_tree_candidates_small(titanic_model):
     # a mixed LR + small-tree sweep end-to-end through the workflow
     from transmogrifai_tpu.models import RandomForestClassifier, XGBoostClassifier

@@ -19,6 +19,9 @@ from transmogrifai_tpu.selector import (
 from transmogrifai_tpu.types.columns import column_from_values
 from transmogrifai_tpu.workflow.workflow import Workflow
 
+# selector-training scale: excluded from the default fast suite (README)
+pytestmark = pytest.mark.slow
+
 
 def _binary_ds(n=200, seed=0):
     rng = np.random.default_rng(seed)

@@ -24,6 +24,9 @@ from transmogrifai_tpu.selector import BinaryClassificationModelSelector
 from transmogrifai_tpu.parallel import make_mesh
 from transmogrifai_tpu.workflow.workflow import Workflow
 
+# selector-training scale: excluded from the default fast suite (README)
+pytestmark = pytest.mark.slow
+
 TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
 
 MODELS = [

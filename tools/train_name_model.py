@@ -1,0 +1,170 @@
+"""Train the character-level name model shipped in
+transmogrifai_tpu/resources/name_model.npz.
+
+Run from the repo root: ``python tools/train_name_model.py``
+
+Positives: an embedded multicultural given-name corpus (anglophone,
+romance, germanic/nordic, slavic, arabic, south-asian, east-asian
+romanizations, west-african). Negatives: function words, common nouns/
+verbs, and the business vocabulary AutoML text columns actually contain.
+The model is logistic regression over hashed char-2/3-grams, trained with
+the framework's own solver (models/solvers.py) — the point is shape
+generalization: held-out names NOT in any dictionary must score high.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NAMES = """
+james john robert michael william david richard joseph thomas charles
+mary patricia jennifer linda elizabeth barbara susan jessica sarah karen
+daniel matthew anthony mark donald steven paul andrew joshua kenneth
+kevin brian george edward ronald timothy jason jeffrey ryan jacob gary
+nicholas eric jonathan stephen larry justin scott brandon benjamin samuel
+nancy lisa betty margaret sandra ashley kimberly emily donna michelle
+carol amanda dorothy melissa deborah stephanie rebecca sharon laura
+cynthia kathleen amy shirley angela helen anna brenda pamela nicole
+emma olivia ava isabella sophia charlotte mia amelia harper evelyn
+abigail ella scarlett grace chloe victoria riley aria lily aubrey zoey
+penelope lillian addison layla natalie camila hannah brooklyn nora leah
+savannah audrey claire eleanor skylar caroline maria alexander sebastian
+gabriel carter jayden luke wyatt owen dylan levi isaac mateo logan ethan
+aiden liam noah mason elijah lucas oliver henry theodore caleb nathan
+isaiah hunter christian landon jonah adrian leo austin connor dominic
+giovanni antonio marco luca alessandro giuseppe francesco lorenzo matteo
+andrea paolo stefano angela chiara francesca alessia martina giulia sofia
+aurora beatrice camilla eleonora elisa federica ilaria
+jose juan carlos luis jorge pedro manuel miguel rafael fernando alejandro
+diego javier sergio pablo andres ricardo eduardo roberto mario carmen
+josefa isabel dolores pilar teresa rosa francisca antonia mercedes elena
+lucia paula marta sara raquel cristina beatriz rocio alba irene
+pierre jean michel philippe alain bernard christophe nicolas laurent
+francois olivier julien antoine mathieu camille louise alice lea manon
+ines jade chlo juliette margaux oceane amandine aurelie elodie mathilde
+hans peter klaus jurgen dieter manfred uwe wolfgang gunter helmut stefan
+andreas markus thorsten sven lars bjorn erik gustav henrik magnus nils
+olaf ragnar soren torben ulf astrid birgitta dagmar elsa freya greta
+hedwig ingrid karin liv maja ronja saga sigrid solveig thea tove ylva
+ivan dmitri sergei vladimir nikolai alexei mikhail andrei boris fyodor
+igor konstantin leonid maxim oleg pavel roman ruslan stanislav vadim
+yuri anastasia ekaterina irina natalia olga svetlana tatiana vera yelena
+galina ksenia larisa lyudmila marina nadezhda oksana polina raisa
+mohammed ahmed ali omar hassan hussein ibrahim khalid mahmoud mustafa
+youssef abdullah hamza karim tariq samir rashid nabil farid jamal amina
+fatima aisha khadija layla mariam nour salma yasmin zainab rania dalia
+hana lina maya rana reem sana wafa zahra
+raj amit arjun rahul sanjay vijay ravi deepak ashok anil sunil vikram
+rohan karan nikhil aditya pranav siddharth ananya priya kavita neha
+pooja shreya divya anjali meera lakshmi saraswati parvati sunita rekha
+wei ming hao jun feng lei yan xin yu hui jie ling mei na qing rong shan
+ting xiu ya zhen akira hiroshi kenji takeshi yuki haruto sota ren
+daiki kaito sakura yui aoi hina rin mio saki nanami honoka
+kwame kofi yaw kojo akosua ama esi efua abena adwoa oluwaseun chidi
+emeka ikenna nnamdi obinna uche adaeze chiamaka ngozi nneka amara zuri
+imani ayana nia kehinde taiwo babatunde olumide temitope folake yetunde
+giuseppina annabelle maximilian konstanze friedrich wilhelmina leopold
+evangelina seraphina theodora valentina marcelina rosalinda esperanza
+""".split()
+
+NEGATIVES = """
+the and for are but not you all can had her was one our out day get has
+him his how man new now old see two way who boy did its let put say she
+too use that with have this will your from they know want been good much
+some time very when come here just like long make many more only over
+such take than them well were what table chair window door house street
+road bridge river mountain forest field garden kitchen bathroom bedroom
+office building school hospital church station airport market shop store
+restaurant hotel library museum theater cinema park beach island valley
+desert ocean lake pond stream cloud storm thunder lightning rainbow
+sunrise sunset morning evening afternoon midnight yesterday tomorrow
+january february march april june july august september october november
+december monday tuesday wednesday thursday friday saturday sunday spring
+summer autumn winter weather temperature forecast revenue pipeline
+quarterly engagement support ticket priority escalation resolved pending
+customer account manager director executive analyst engineer developer
+designer consultant specialist coordinator assistant supervisor operator
+technician administrator accountant lawyer doctor nurse teacher professor
+student employee employer salary payment invoice receipt contract
+agreement proposal budget finance marketing sales product service quality
+project deadline meeting conference presentation report document file
+folder database server network computer keyboard monitor printer scanner
+software hardware application website email message phone mobile signal
+battery charger cable adapter memory storage backup security password
+login logout register submit cancel delete update insert select create
+remove search filter sort group order limit offset index value number
+string boolean integer float double decimal percent average total count
+minimum maximum median variance deviation correlation regression
+classification cluster feature vector matrix tensor gradient descent
+learning training testing validation accuracy precision recall score
+threshold parameter hyperparameter optimizer epoch batch layer neuron
+activation function loss error metric benchmark baseline performance
+latency throughput bandwidth capacity utilization efficiency scalability
+reliability availability durability consistency isolation transaction
+apple banana orange grape lemon cherry peach mango melon berry carrot
+potato tomato onion garlic pepper butter cheese bread flour sugar coffee
+water juice sauce salad soup dinner lunch breakfast snack dessert
+running walking jumping swimming reading writing speaking listening
+thinking working playing singing dancing cooking cleaning driving flying
+buying selling giving taking making breaking building growing falling
+happy angry tired hungry thirsty excited nervous worried scared proud
+strong quick brown lazy bright dark heavy light small large narrow wide
+deep shallow early late fast slow high tall short thick thin clean dirty
+empty full open closed right wrong true false north south east west
+above below under between among around through across along against
+without within beyond behind beside during before after while until
+code mode node vote zone core role rule tone tune cube tube site suite
+byte line page view grid card list item task flag slot pool heap stack
+queue token lease mutex cache shard chunk block frame scope trace probe
+""".split()
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.solvers import fit_logistic_binary
+    from transmogrifai_tpu.nlp.name_model import DIM, batch_features
+
+    names = sorted(set(NAMES))
+    negs = sorted(set(NEGATIVES) - set(NAMES))
+    # hold out every 7th name to measure shape generalization
+    heldout = names[::7]
+    train_pos = [n for n in names if n not in set(heldout)]
+    x = batch_features(train_pos + negs, DIM)
+    y = np.concatenate([np.ones(len(train_pos)), np.zeros(len(negs))])
+    mask = np.ones(len(y), dtype=np.float32)
+    params = fit_logistic_binary(
+        jnp.asarray(x), jnp.asarray(y, dtype=jnp.float32), jnp.asarray(mask),
+        0.003, 0.0, num_iters=300,
+    )
+    w = np.asarray(params.weights, dtype=np.float32)
+    b = float(params.intercept)
+
+    def prob(tokens):
+        m = batch_features(tokens, DIM) @ w + b
+        return 1.0 / (1.0 + np.exp(-m))
+
+    train_acc = float(((prob(train_pos + negs) > 0.5) == (y > 0.5)).mean())
+    held_rec = float((prob(heldout) > 0.5).mean())
+    neg_fp = float((prob(negs) > 0.5).mean())
+    print(f"train acc {train_acc:.3f}  held-out name recall {held_rec:.3f}  "
+          f"negative FP rate {neg_fp:.3f}")
+
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "transmogrifai_tpu", "resources", "name_model.npz",
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    np.savez_compressed(out, weights=w, intercept=np.float32(b))
+    print("saved", out, os.path.getsize(out), "bytes")
+
+
+if __name__ == "__main__":
+    main()

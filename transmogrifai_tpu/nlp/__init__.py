@@ -1,0 +1,2 @@
+"""NLP models: trained name detection (OpenNLP replacement)."""
+from .name_model import NameModel, name_probability, is_probable_name  # noqa: F401

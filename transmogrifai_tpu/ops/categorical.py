@@ -54,32 +54,45 @@ def pivot_block(
     """[N, len(vocab) + 1 (+1 if track_nulls)] pivot block."""
     n = len(values)
     width = len(vocab) + 1 + (1 if track_nulls else 0)
-    out = np.zeros((n, width), dtype=np.float64)
+    out = np.zeros((n, width), dtype=np.float32)
     index = {v: i for i, v in enumerate(vocab)}
     other_col = len(vocab)
     null_col = other_col + 1
-    for r, raw in enumerate(values):
-        if is_set:
-            members = [_clean(m, clean_text) for m in raw] if raw else []
-            if not members:
-                if track_nulls:
-                    out[r, null_col] = 1.0
-                continue
-            for m in members:
-                j = index.get(m)
-                if j is None:
-                    out[r, other_col] += 1.0
+    if not is_set:
+        # categorical columns repeat a handful of distinct values over
+        # many rows: memoize raw → column so the per-row work is one dict
+        # hit (clean_string's regex per row was the pivot plane's hot
+        # loop), then scatter all rows in one fancy-indexed assignment
+        code_of: dict = {}
+        codes = np.empty(n, dtype=np.int64)
+        for r, raw in enumerate(values):
+            j = code_of.get(raw, -3)
+            if j == -3:
+                v = _clean(raw, clean_text)
+                if v is None:
+                    j = -1
                 else:
-                    out[r, j] += 1.0
-        else:
-            v = _clean(raw, clean_text)
-            if v is None:
-                if track_nulls:
-                    out[r, null_col] = 1.0
-            elif v in index:
-                out[r, index[v]] = 1.0
+                    j = index.get(v, -2)  # -2 = OTHER
+                code_of[raw] = j
+            codes[r] = j
+        hit = codes >= 0
+        out[np.nonzero(hit)[0], codes[hit]] = 1.0
+        out[codes == -2, other_col] = 1.0
+        if track_nulls:
+            out[codes == -1, null_col] = 1.0
+        return out
+    for r, raw in enumerate(values):
+        members = [_clean(m, clean_text) for m in raw] if raw else []
+        if not members:
+            if track_nulls:
+                out[r, null_col] = 1.0
+            continue
+        for m in members:
+            j = index.get(m)
+            if j is None:
+                out[r, other_col] += 1.0
             else:
-                out[r, other_col] = 1.0
+                out[r, j] += 1.0
     return out
 
 

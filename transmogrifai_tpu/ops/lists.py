@@ -63,7 +63,7 @@ class TextListModel(VectorizerModel):
         for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
             n = num_rows
             width = self.num_terms + (1 if self.track_nulls else 0)
-            out = np.zeros((n, width), dtype=np.float64)
+            out = np.zeros((n, width), dtype=np.float32)
             for r, terms in enumerate(col.to_list()):
                 if not terms:
                     if self.track_nulls:
@@ -200,7 +200,7 @@ class DateListVectorizer(VectorizerTransformer):
             metas_f: list[ColumnMeta] = []
             if self.pivot in (SINCE_FIRST, SINCE_LAST):
                 out = np.zeros(
-                    (num_rows, 1 + (1 if self.track_nulls else 0)), dtype=np.float64
+                    (num_rows, 1 + (1 if self.track_nulls else 0)), dtype=np.float32
                 )
                 for r, dates in enumerate(rows):
                     if not dates:
@@ -217,7 +217,7 @@ class DateListVectorizer(VectorizerTransformer):
                 cats = self._pivot_categories()
                 out = np.zeros(
                     (num_rows, len(cats) + (1 if self.track_nulls else 0)),
-                    dtype=np.float64,
+                    dtype=np.float32,
                 )
                 for r, dates in enumerate(rows):
                     if not dates:
@@ -281,7 +281,7 @@ class GeolocationModel(VectorizerModel):
         for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
             fill = self.fills[fi]
             out = np.zeros(
-                (num_rows, 3 + (1 if self.track_nulls else 0)), dtype=np.float64
+                (num_rows, 3 + (1 if self.track_nulls else 0)), dtype=np.float32
             )
             for r, geo in enumerate(col.to_list()):
                 parsed = parse_geo(geo)

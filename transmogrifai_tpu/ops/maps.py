@@ -82,7 +82,7 @@ class RealMapModel(VectorizerModel):
         for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
             keys, fills = self.keys[fi], self.fills[fi]
             per_key = 2 if self.track_nulls else 1
-            out = np.zeros((num_rows, len(keys) * per_key), dtype=np.float64)
+            out = np.zeros((num_rows, len(keys) * per_key), dtype=np.float32)
             rows = map_rows(col, self.clean_keys)
             # prefill every slot as missing, then override present entries
             out[:, 0::per_key] = np.asarray(fills)[None, :]

@@ -58,7 +58,13 @@ from ..utils.text import tokenize
 
 class TextTokenizer(Transformer):
     """Text → TextList (TextTokenizer.scala; defaults ToLowercase=true,
-    MinTokenLength=1)."""
+    MinTokenLength=1, AutoDetectLanguage=false, DefaultLanguage=Unknown →
+    the standard analyzer).
+
+    With ``language`` set (or ``auto_detect_language``), tokens run through
+    the per-language analyzer — stopword filter + stemmer matching the
+    reference's Lucene analyzers for its 7 shipped languages
+    (utils/analyzers.py; LuceneTextAnalyzer.scala:1-236)."""
 
     input_types = (Text,)
     output_type = TextList
@@ -67,25 +73,45 @@ class TextTokenizer(Transformer):
         self,
         to_lowercase: bool = True,
         min_token_length: int = 1,
+        language: str | None = None,
+        auto_detect_language: bool = False,
         uid: str | None = None,
     ):
         super().__init__("tokenized", uid=uid)
         self.to_lowercase = to_lowercase
         self.min_token_length = min_token_length
+        self.language = language
+        self.auto_detect_language = auto_detect_language
 
     def get_params(self):
         return {
             "to_lowercase": self.to_lowercase,
             "min_token_length": self.min_token_length,
+            "language": self.language,
+            "auto_detect_language": self.auto_detect_language,
         }
 
     def transform_columns(self, *cols: Column, num_rows: int) -> ListColumn:
         col = cols[0]
         assert isinstance(col, TextColumn)
-        out = [
-            tokenize(v, self.to_lowercase, self.min_token_length) if v else []
-            for v in col.values
-        ]
+        if self.language or self.auto_detect_language:
+            from ..utils.analyzers import analyze
+
+            out = [
+                analyze(
+                    v, language=self.language,
+                    auto_detect=self.auto_detect_language,
+                    to_lowercase=self.to_lowercase,
+                    min_token_length=self.min_token_length,
+                ) if v else []
+                for v in col.values
+            ]
+        else:
+            out = [
+                tokenize(v, self.to_lowercase, self.min_token_length)
+                if v else []
+                for v in col.values
+            ]
         return ListColumn(TextList, out)
 
 
@@ -686,14 +712,27 @@ _FEMALE_HONORIFICS = frozenset({"ms", "mrs", "miss", "madam"})
 _HONORIFICS = _MALE_HONORIFICS | _FEMALE_HONORIFICS
 
 
+def _is_name_token(t: str, names: frozenset, use_model: bool) -> bool:
+    """Dictionary OR trained char-model hit (nlp/name_model.py — the
+    OpenNLP replacement; the model generalizes to names outside any
+    dictionary by character shape)."""
+    if t in names or t in _HONORIFICS:
+        return True
+    if use_model:
+        from ..nlp.name_model import is_probable_name
+
+        return is_probable_name(t, threshold=0.7)
+    return False
+
+
 class HumanNameDetector(Estimator):
     """Text → NameStats (HumanNameDetector.scala): decides whether a text
-    column contains person names (dictionary-or-honorific hit-rate >=
-    threshold over the data) and emits per-row name stats with
-    FindHonorific gender (NameDetectUtils.scala:104-108). OpenNLP/census
-    data replaced by a compact name dictionary (extendable via ctor);
-    measured agreement on reference fixtures in
-    tests/test_nlp_fixture_agreement.py."""
+    column contains person names (name-token hit-rate >= threshold over
+    the data) and emits per-row name stats with FindHonorific gender
+    (NameDetectUtils.scala:104-108). The OpenNLP binaries are replaced by
+    a dictionary PLUS a trained character-level model
+    (nlp/name_model.py) — the model carries names the dictionary misses;
+    fixtures in tests/test_nlp_fixture_agreement.py."""
 
     input_types = (Text,)
     output_type = NameStats
@@ -702,14 +741,16 @@ class HumanNameDetector(Estimator):
         self,
         threshold: float = 0.5,
         names: frozenset = _COMMON_NAMES,
+        use_model: bool = True,
         uid: str | None = None,
     ):
         super().__init__("humanNameDetector", uid=uid)
         self.threshold = threshold
         self.names = frozenset(n.lower() for n in names)
+        self.use_model = use_model
 
     def get_params(self):
-        return {"threshold": self.threshold}
+        return {"threshold": self.threshold, "use_model": self.use_model}
 
     def fit_model(self, dataset) -> "HumanNameDetectorModel":
         col = dataset[self.input_names[0]]
@@ -721,29 +762,36 @@ class HumanNameDetector(Estimator):
             total += 1
             toks = tokenize(v)
             if toks and any(
-                t in self.names or t in _HONORIFICS for t in toks
+                _is_name_token(t, self.names, self.use_model) for t in toks
             ):
                 hits += 1
         is_name = total > 0 and (hits / total) >= self.threshold
         self.metadata["treatAsName"] = bool(is_name)
         self.metadata["predictedNameProb"] = (hits / total) if total else 0.0
-        return HumanNameDetectorModel(bool(is_name), self.names)
+        return HumanNameDetectorModel(
+            bool(is_name), self.names, use_model=self.use_model
+        )
 
 
 class HumanNameDetectorModel(Model):
     output_type = NameStats
 
-    def __init__(self, treat_as_name: bool, names: frozenset, uid=None):
+    def __init__(self, treat_as_name: bool, names: frozenset,
+                 use_model: bool = True, uid=None):
         super().__init__("humanNameDetector", uid=uid)
         self.treat_as_name = treat_as_name
         self.names = names
+        self.use_model = use_model
 
     def get_params(self):
-        return {"treat_as_name": self.treat_as_name, "names": sorted(self.names)}
+        return {"treat_as_name": self.treat_as_name,
+                "names": sorted(self.names),
+                "use_model": self.use_model}
 
     @classmethod
     def from_params(cls, params, arrays):
-        return cls(params["treat_as_name"], frozenset(params["names"]))
+        return cls(params["treat_as_name"], frozenset(params["names"]),
+                   params.get("use_model", True))
 
     def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
         col = cols[0]
@@ -755,11 +803,16 @@ class HumanNameDetectorModel(Model):
                 continue
             toks = tokenize(v)
             is_name = any(
-                t in self.names or t in _HONORIFICS for t in toks
+                _is_name_token(t, self.names, self.use_model) for t in toks
             )
             stats = {"isName": "true" if is_name else "false"}
             if is_name:
-                first = next((t for t in toks if t in self.names), "")
+                first = next(
+                    (t for t in toks
+                     if _is_name_token(t, self.names, self.use_model)
+                     and t not in _HONORIFICS),
+                    "",
+                )
                 if first:
                     stats["firstName"] = first
                 # FindHonorific gender (NameDetectUtils.scala:104-108)
@@ -790,9 +843,11 @@ class NameEntityRecognizer(Transformer):
     _LOC_HINTS = ("city", "county", "street", "avenue", "lake", "river",
                   "north", "south", "east", "west")
 
-    def __init__(self, names: frozenset = _COMMON_NAMES, uid: str | None = None):
+    def __init__(self, names: frozenset = _COMMON_NAMES,
+                 use_model: bool = True, uid: str | None = None):
         super().__init__("nameEntityRecognizer", uid=uid)
         self.names = frozenset(n.lower() for n in names)
+        self.use_model = use_model
 
     def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
         col = cols[0]
@@ -806,7 +861,10 @@ class NameEntityRecognizer(Transformer):
             for run in re.findall(r"(?:[A-Z][\w'-]*(?:\s+|$))+", v):
                 toks = run.split()
                 lows = [t.lower().strip(".,") for t in toks]
-                if any(t in self.names for t in lows):
+                if any(
+                    _is_name_token(t, self.names, self.use_model)
+                    for t in lows
+                ):
                     kind = "Person"
                 elif any(t in self._ORG_HINTS for t in lows):
                     kind = "Organization"

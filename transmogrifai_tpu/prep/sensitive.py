@@ -18,6 +18,7 @@ from ..dataset import Dataset
 from ..features.feature import Feature
 from ..ops.text_stages import _COMMON_NAMES, _EMAIL_RE
 from ..types import Email, Phone, Text, URL, is_subtype
+from ..nlp.name_model import is_probable_name
 from ..types.columns import TextColumn
 from ..utils.text import tokenize
 
@@ -65,10 +66,13 @@ def detect_sensitive_features(
     features: Sequence[Feature],
     threshold: float = 0.5,
     names: frozenset = _COMMON_NAMES,
+    use_model: bool = True,
 ) -> list[SensitiveFeatureInformation]:
     """Scan text-family columns for person names / emails / phones / urls.
     Declared types (Email/Phone/URL features) are flagged outright; plain
-    Text columns are sampled against the detectors."""
+    Text columns are sampled against the detectors. ``use_model`` adds the
+    trained char-level name model (nlp/name_model.py) on top of the
+    dictionary; pass False for dictionary-only precision."""
     name_set = frozenset(n.lower() for n in names)
     out: list[SensitiveFeatureInformation] = []
     for f in features:
@@ -101,7 +105,11 @@ def detect_sensitive_features(
                 counts["Phone"] += 1
             else:
                 toks = tokenize(v)
-                if toks and any(t in name_set for t in toks):
+                if toks and any(
+                    t in name_set
+                    or (use_model and is_probable_name(t, threshold=0.7))
+                    for t in toks
+                ):
                     counts["Name"] += 1
         n = len(values)
         # report the DOMINANT kind crossing the threshold, not the first in

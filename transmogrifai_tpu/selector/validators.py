@@ -94,12 +94,15 @@ class Validator:
 
         import jax
 
-        # threads only on a single device: with a multi-device mesh the
-        # sweep is already device-parallel, and concurrent multi-device
-        # dispatch intermittently aborts the XLA:CPU async runtime (see
-        # memory: xla-cpu-mesh-gotchas). max_workers=1 serializes through
-        # the same code path.
-        if len(jax.devices()) > 1:
+        # Candidate families overlap on a thread pool (program acquisition
+        # is the wall-clock cost; device execs serialize on-chip anyway).
+        # The ONE broken combination is threads × multi-device XLA:CPU:
+        # concurrent multi-device dispatch intermittently aborts its async
+        # runtime (memory: xla-cpu-mesh-gotchas). Gate on that backend —
+        # a real multi-chip TPU mesh keeps the overlap (round-2 VERDICT
+        # item 6: the old device-count gate would serialize acquisition
+        # exactly where it costs the most).
+        if jax.default_backend() == "cpu" and len(jax.devices()) > 1:
             n_workers = 1
         else:
             n_workers = max(1, min(self.parallelism, len(candidates)))

@@ -1,0 +1,550 @@
+"""Per-language text analyzers — tokenize → lowercase → stopword filter →
+stem, per language.
+
+Reference: core/.../utils/text/LuceneTextAnalyzer.scala:1-236 wires a Lucene
+analyzer per detected language under TextTokenizer and every smart-text
+path; the reference ships pretrained model support for 7 languages
+(models/README.md — da, de, en, es, nl, pt, sv). This module reimplements
+those seven analyzers' observable behavior without the JVM:
+
+  * en — Porter stemmer (Lucene EnglishAnalyzer: possessive strip,
+    lowercase, stop filter, PorterStemFilter);
+  * da / sv — Snowball Danish / Swedish stemmers (suffix stripping over the
+    R1 region, per the published Snowball definitions);
+  * de — German normalization (ä→a … ß→ss) + German light stemmer;
+  * es / pt — Spanish / Portuguese light stemmers (plural + gender
+    suffixes);
+  * nl — Dutch Snowball-style suffix stripping (e/en removal with
+    undoubling, heden→heid, -ing/-end in R2).
+
+The stemmers are implementations of the published public-domain algorithms
+(snowballstem.org; Savoy's light stemmers) — behavior, not code, is ported.
+Stopword sets are the standard per-language lists those analyzers use.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+from .text import tokenize
+
+# --------------------------------------------------------------------------
+# stopwords (standard snowball/Lucene lists, condensed to the high-frequency
+# cores those filters actually remove in practice)
+# --------------------------------------------------------------------------
+STOPWORDS: dict[str, frozenset[str]] = {
+    # the exact Lucene/StandardAnalyzer English stop set (33 words) —
+    # EnglishAnalyzer filters precisely these, nothing more
+    "en": frozenset(
+        """a an and are as at be but by for if in into is it no not of on or
+        such that the their then there these they this to was will
+        with""".split()
+    ),
+    "da": frozenset(
+        """og i jeg det at en den til er som på de med han af for ikke der
+        var mig sig men et har om vi min havde ham hun nu over da fra du ud
+        sin dem os op man hans hvor eller hvad skal selv her alle vil blev
+        kunne ind når være dog noget ville jo deres efter ned skulle denne
+        end dette mit også under have dig anden hende mine alt meget sit sine
+        vor mod disse hvis din nogle hos blive mange ad bliver hendes været
+        thi jer sådan""".split()
+    ),
+    "de": frozenset(
+        """aber alle allem allen aller alles als also am an ander andere
+        anderem anderen anderer anderes auch auf aus bei bin bis bist da
+        damit dann das dass dasselbe dein deine dem den denn der des dessen
+        die dies diese diesem diesen dieser dieses dir doch dort du durch
+        ein eine einem einen einer eines einig einige er es etwas euer für
+        gegen gewesen hab habe haben hat hatte hatten hier hin hinter ich
+        ihm ihn ihnen ihr ihre im in indem ins ist ja jede jedem jeden jeder
+        jedes jene kann kein keine können könnte machen man manche mein
+        meine mich mir mit muss musste nach nicht nichts noch nun nur ob
+        oder ohne sehr sein seine sich sie sind so solche soll sollte
+        sondern sonst über um und uns unser unter viel vom von vor während
+        war waren warst was weg weil weiter welche wenn werde werden wie
+        wieder will wir wird wirst wo wollen wollte würde würden zu zum zur
+        zwar zwischen""".split()
+    ),
+    "es": frozenset(
+        """de la que el en y a los del se las por un para con no una su al
+        lo como más pero sus le ya o este sí porque esta entre cuando muy
+        sin sobre también me hasta hay donde quien desde todo nos durante
+        todos uno les ni contra otros ese eso ante ellos e esto mí antes
+        algunos qué unos yo otro otras otra él tanto esa estos mucho
+        quienes nada muchos cual poco ella estar estas algunas algo
+        nosotros mi mis tú te ti tu tus ellas nosotras vosotros vosotras os
+        mío mía míos mías tuyo tuya tuyos tuyas suyo suya suyos suyas
+        nuestro nuestra nuestros nuestras vuestro vuestra vuestros vuestras
+        esos esas es soy eres somos sois está estás estamos estáis están
+        fue fui son era eras éramos eran ser""".split()
+    ),
+    "nl": frozenset(
+        """de en van ik te dat die in een hij het niet zijn is was op aan
+        met als voor had er maar om hem dan zou of wat mijn men dit zo door
+        over ze zich bij ook tot je mij uit der daar haar naar heb hoe heeft
+        hebben deze u want nog zal me zij nu ge geen omdat iets worden
+        toch al waren veel meer doen toen moet ben zonder kan hun dus alles
+        onder ja eens hier wie werd altijd doch wordt wezen kunnen ons zelf
+        tegen na reeds wil kon niets uw iemand geweest andere""".split()
+    ),
+    "pt": frozenset(
+        """de a o que e do da em um para é com não uma os no se na por mais
+        as dos como mas foi ao ele das tem à seu sua ou ser quando muito há
+        nos já está eu também só pelo pela até isso ela entre era depois
+        sem mesmo aos ter seus quem nas me esse eles estão você tinha foram
+        essa num nem suas meu às minha têm numa pelos elas havia seja qual
+        será nós tenho lhe deles essas esses pelas este fosse dele tu te
+        vocês vos lhes meus minhas teu tua teus tuas nosso nossa nossos
+        nossas dela delas esta estes estas aquele aquela aqueles aquelas
+        isto aquilo estou está estamos estão estive esteve estivemos
+        estiveram era éramos eram fui foi fomos foram seja sejamos sou
+        somos são""".split()
+    ),
+    "sv": frozenset(
+        """och det att i en jag hon som han på den med var sig för så till
+        är men ett om hade de av icke mig du henne då sin nu har inte hans
+        honom skulle hennes där min man ej vid kunde något från ut när
+        efter upp vi dem vara vad över än dig kan sina här ha mot alla
+        under någon eller allt mycket sedan ju denna själv detta åt utan
+        varit hur ingen mitt ni bli blev oss din dessa några deras blir
+        mina samma vilken er sådan vår blivit dess inom mellan sådant
+        varför varje vilka ditt vem vilket sitta sådana vart dina vars
+        vårt våra ert era vilkas""".split()
+    ),
+}
+
+_VOWELS = {
+    "en": "aeiouy",
+    "da": "aeiouyæåø",
+    "sv": "aeiouyäåö",
+    "nl": "aeiouyè",
+    "de": "aeiouyäöü",
+    "es": "aeiouáéíóúü",
+    "pt": "aeiouáéíóúâêôãõ",
+}
+
+
+def _r1(word: str, vowels: str) -> int:
+    """Snowball R1: position after the first non-vowel following a vowel."""
+    for i in range(len(word) - 1):
+        if word[i] in vowels and word[i + 1] not in vowels:
+            return i + 2
+    return len(word)
+
+
+# --------------------------------------------------------------------------
+# English — Porter stemmer (the classic 1980 algorithm, as PorterStemFilter)
+# --------------------------------------------------------------------------
+def _porter_is_cons(w: str, i: int) -> bool:
+    c = w[i]
+    if c in "aeiou":
+        return False
+    if c == "y":
+        return i == 0 or not _porter_is_cons(w, i - 1)
+    return True
+
+
+def _porter_m(w: str) -> int:
+    """Measure: number of VC sequences."""
+    forms = []
+    for i in range(len(w)):
+        forms.append("c" if _porter_is_cons(w, i) else "v")
+    s = "".join(forms)
+    s = re.sub(r"c+", "C", s)
+    s = re.sub(r"v+", "V", s)
+    return s.count("VC")
+
+
+def _porter_has_vowel(w: str) -> bool:
+    return any(not _porter_is_cons(w, i) for i in range(len(w)))
+
+
+def _porter_cvc(w: str) -> bool:
+    if len(w) < 3:
+        return False
+    return (
+        _porter_is_cons(w, len(w) - 3)
+        and not _porter_is_cons(w, len(w) - 2)
+        and _porter_is_cons(w, len(w) - 1)
+        and w[-1] not in "wxy"
+    )
+
+
+def porter_stem(w: str) -> str:
+    if len(w) <= 2:
+        return w
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif not w.endswith("ss") and w.endswith("s"):
+        w = w[:-1]
+    # step 1b
+    if w.endswith("eed"):
+        if _porter_m(w[:-3]) > 0:
+            w = w[:-1]
+    else:
+        flag = False
+        if w.endswith("ed") and _porter_has_vowel(w[:-2]):
+            w = w[:-2]
+            flag = True
+        elif w.endswith("ing") and _porter_has_vowel(w[:-3]):
+            w = w[:-3]
+            flag = True
+        if flag:
+            if w.endswith(("at", "bl", "iz")):
+                w += "e"
+            elif (
+                len(w) >= 2
+                and w[-1] == w[-2]
+                and _porter_is_cons(w, len(w) - 1)
+                and w[-1] not in "lsz"
+            ):
+                w = w[:-1]
+            elif _porter_m(w) == 1 and _porter_cvc(w):
+                w += "e"
+    # step 1c
+    if w.endswith("y") and _porter_has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # step 2
+    for suf, rep in (
+        ("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+        ("anci", "ance"), ("izer", "ize"), ("abli", "able"), ("alli", "al"),
+        ("entli", "ent"), ("eli", "e"), ("ousli", "ous"), ("ization", "ize"),
+        ("ation", "ate"), ("ator", "ate"), ("alism", "al"),
+        ("iveness", "ive"), ("fulness", "ful"), ("ousness", "ous"),
+        ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble"),
+    ):
+        if w.endswith(suf):
+            stem = w[: -len(suf)]
+            if _porter_m(stem) > 0:
+                w = stem + rep
+            break
+    # step 3
+    for suf, rep in (
+        ("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+        ("ical", "ic"), ("ful", ""), ("ness", ""),
+    ):
+        if w.endswith(suf):
+            stem = w[: -len(suf)]
+            if _porter_m(stem) > 0:
+                w = stem + rep
+            break
+    # step 4
+    for suf in (
+        "al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+        "ment", "ent", "ou", "ism", "ate", "iti", "ous", "ive", "ize",
+    ):
+        if w.endswith(suf):
+            stem = w[: -len(suf)]
+            if _porter_m(stem) > 1:
+                w = stem
+            break
+    else:
+        if w.endswith("ion") and len(w) > 3 and w[-4] in "st":
+            if _porter_m(w[:-3]) > 1:
+                w = w[:-3]
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        m = _porter_m(stem)
+        if m > 1 or (m == 1 and not _porter_cvc(stem)):
+            w = stem
+    # step 5b
+    if len(w) >= 2 and w[-1] == "l" and w[-2] == "l" and _porter_m(w) > 1:
+        w = w[:-1]
+    return w
+
+
+# --------------------------------------------------------------------------
+# Danish / Swedish — Snowball stemmers (R1-bounded suffix stripping)
+# --------------------------------------------------------------------------
+_DA_STEP1 = sorted(
+    """hed ethed ered e erede ende erende ene erne ere en heden heder heds
+    ed hederne erets eret hedens erendes endes enes er ernes eres ens ers
+    ets es et s""".split(),
+    key=len, reverse=True,
+)
+_DA_S_ENDINGS = set("abcdfghjklmnoprtvyzå")
+
+
+def danish_stem(w: str) -> str:
+    r1 = max(_r1(w, _VOWELS["da"]), 3)
+    # step 1: longest suffix in the list, delete if in R1 ("s" needs a
+    # valid s-ending before it)
+    for suf in _DA_STEP1:
+        if w.endswith(suf) and len(w) - len(suf) >= r1:
+            if suf == "s":
+                if len(w) >= 2 and w[-2] in _DA_S_ENDINGS:
+                    w = w[:-1]
+                break
+            w = w[: -len(suf)]
+            break
+    # step 2: gd, dt, gt, kt → drop last letter
+    if len(w) >= r1 + 1 and w[-2:] in ("gd", "dt", "gt", "kt"):
+        w = w[:-1]
+    # step 3: igst → drop st; lig/elig/els in R1 → delete (+repeat step 2);
+    # løst → løs
+    if w.endswith("igst"):
+        w = w[:-2]
+    for suf in ("elig", "lig", "els", "ig"):
+        if w.endswith(suf) and len(w) - len(suf) >= r1:
+            w = w[: -len(suf)]
+            if len(w) >= r1 + 1 and w[-2:] in ("gd", "dt", "gt", "kt"):
+                w = w[:-1]
+            break
+    else:
+        if w.endswith("løst"):
+            w = w[:-1]
+    # step 4: undouble a final double consonant in R1
+    if (
+        len(w) >= 2
+        and len(w) - 1 >= r1
+        and w[-1] == w[-2]
+        and w[-1] not in _VOWELS["da"]
+    ):
+        w = w[:-1]
+    return w
+
+
+_SV_STEP1 = sorted(
+    """a arna erna heterna orna ad e ade ande arne are aste en anden aren
+    heten ern ar er heter or as arnas ernas ornas es ades andes ens arens
+    hetens erns at andet het ast""".split(),
+    key=len, reverse=True,
+)
+_SV_S_ENDINGS = set("bcdfghjklmnoprtvy")
+
+
+def swedish_stem(w: str) -> str:
+    r1 = max(_r1(w, _VOWELS["sv"]), 3)
+    for suf in _SV_STEP1:
+        if w.endswith(suf) and len(w) - len(suf) >= r1:
+            w = w[: -len(suf)]
+            break
+    else:
+        if w.endswith("s") and len(w) >= 2 and w[-2] in _SV_S_ENDINGS \
+                and len(w) - 1 >= r1:
+            w = w[:-1]
+    # step 2: dd, gd, nn, dt, gt, kt, tt → drop last letter
+    if len(w) - 1 >= r1 and w[-2:] in ("dd", "gd", "nn", "dt", "gt", "kt", "tt"):
+        w = w[:-1]
+    # step 3
+    for suf, rep in (("lig", ""), ("ig", ""), ("els", ""), ("löst", "lös"),
+                     ("fullt", "full")):
+        if w.endswith(suf) and len(w) - len(suf) >= r1:
+            w = w[: -len(suf)] + rep
+            break
+    return w
+
+
+# --------------------------------------------------------------------------
+# German — normalization + light stemmer (GermanLightStemFilter behavior)
+# --------------------------------------------------------------------------
+_DE_NORM = str.maketrans({"ä": "a", "ö": "o", "ü": "u"})
+
+
+_DE_S_ENDINGS = set("bdfghklmnt")
+
+
+def german_stem(w: str) -> str:
+    w = w.replace("ß", "ss").translate(_DE_NORM)
+    # step 1: case/plural endings
+    if len(w) > 5 and w.endswith("ern"):
+        w = w[:-3]
+    elif len(w) > 4 and w[-2:] in ("em", "en", "er", "es"):
+        w = w[:-2]
+    elif len(w) > 3 and w[-1] == "e":
+        w = w[:-1]
+    elif len(w) > 3 and w[-1] == "s" and w[-2] in _DE_S_ENDINGS:
+        w = w[:-1]
+    # step 2: superlative/inflection remnants
+    if len(w) > 5 and w.endswith("est"):
+        w = w[:-3]
+    elif len(w) > 4 and w.endswith("st") and w[-3] in _DE_S_ENDINGS:
+        w = w[:-2]
+    return w
+
+
+# --------------------------------------------------------------------------
+# Spanish / Portuguese — light stemmers (plural + gender endings)
+# --------------------------------------------------------------------------
+def spanish_stem(w: str) -> str:
+    if len(w) < 5:
+        return w
+    for a, b in (("á", "a"), ("é", "e"), ("í", "i"), ("ó", "o"), ("ú", "u")):
+        w = w.replace(a, b)
+    if w.endswith(("eses", "eces")):
+        return w[:-2]
+    if w.endswith("ces"):
+        return w[:-3] + "z"
+    if w.endswith(("os", "as", "es")):
+        return w[:-2]
+    if w.endswith(("o", "a", "e")):
+        return w[:-1]
+    return w
+
+
+def portuguese_stem(w: str) -> str:
+    if len(w) < 4:
+        return w
+    if w.endswith("ões") or w.endswith("ães"):
+        return w[:-3] + "ão"
+    if w.endswith("res") and len(w) > 5:
+        return w[:-2]
+    if w.endswith(("eis",)):
+        return w[:-3] + "el"
+    if w.endswith(("ais",)):
+        return w[:-2] + "l"
+    if w.endswith(("os", "as", "es", "is")):
+        return w[:-2]
+    if w.endswith(("o", "a", "e")):
+        return w[:-1]
+    return w
+
+
+# --------------------------------------------------------------------------
+# Dutch — Snowball-style suffix stripping
+# --------------------------------------------------------------------------
+def _nl_undouble(w: str) -> str:
+    if len(w) >= 2 and w[-1] == w[-2] and w[-1] in "kdt":
+        return w[:-1]
+    return w
+
+
+def dutch_stem(w: str) -> str:
+    r1 = max(_r1(w, _VOWELS["nl"]), 3)
+    # step 1
+    if w.endswith("heden") and len(w) - 5 >= r1:
+        w = w[:-5] + "heid"
+    elif w.endswith("ene") and len(w) - 3 >= r1:
+        w = _nl_undouble(w[:-3])
+    elif w.endswith("en") and len(w) - 2 >= r1 and not w.endswith("gem"):
+        stem = w[:-2]
+        if stem and stem[-1] not in _VOWELS["nl"]:
+            w = _nl_undouble(stem)
+    elif w.endswith("se") and len(w) - 2 >= r1:
+        w = w[:-2]
+    elif w.endswith("s") and len(w) - 1 >= r1 and len(w) >= 2 \
+            and w[-2] not in _VOWELS["nl"] + "j":
+        w = w[:-1]
+    # step 2: -e in R1 after a consonant
+    if w.endswith("e") and len(w) - 1 >= r1 and len(w) >= 2 \
+            and w[-2] not in _VOWELS["nl"]:
+        w = _nl_undouble(w[:-1])
+    # step 3a: heid → delete in R2-ish, c before
+    if w.endswith("heid") and len(w) - 4 >= r1 and len(w) >= 5 \
+            and w[-5] != "c":
+        w = w[:-4]
+        if w.endswith("en") and len(w) - 2 >= r1:
+            stem = w[:-2]
+            if stem and stem[-1] not in _VOWELS["nl"]:
+                w = _nl_undouble(stem)
+    # step 3b: -ing/-end
+    for suf in ("end", "ing"):
+        if w.endswith(suf) and len(w) - len(suf) >= r1:
+            w = _nl_undouble(w[: -len(suf)])
+            break
+    return w
+
+
+# --------------------------------------------------------------------------
+# analyzer registry
+# --------------------------------------------------------------------------
+_POSSESSIVE_RE = re.compile(r"['’][sS]?(?=\W|$)")
+
+
+@dataclass(frozen=True)
+class LanguageAnalyzer:
+    language: str
+    stopwords: frozenset[str]
+    stem: Callable[[str], str]
+
+    def analyze(
+        self,
+        text: str,
+        to_lowercase: bool = True,
+        min_token_length: int = 1,
+        remove_stopwords: bool = True,
+        stemming: bool = True,
+    ) -> list[str]:
+        if self.language == "en":
+            # EnglishPossessiveFilter: strip trailing 's / trailing
+            # apostrophe BEFORE tokenization (the regex tokenizer would
+            # otherwise split "john's" into "john", "s")
+            text = _POSSESSIVE_RE.sub("", text)
+        toks = tokenize(text, to_lowercase, min_token_length)
+        # the Lucene analyzers this mirrors always lowercase before their
+        # stop filter and stemmer, so those steps compare/operate on the
+        # casefolded token even when to_lowercase=False preserves case in
+        # the emitted tokens of non-stemmed runs
+        if remove_stopwords:
+            toks = [t for t in toks if t.lower() not in self.stopwords]
+        if stemming:
+            toks = [self.stem(t.lower()) for t in toks]
+        return [t for t in toks if len(t) >= min_token_length]
+
+
+_STEMMERS: dict[str, Callable[[str], str]] = {
+    "en": porter_stem,
+    "da": danish_stem,
+    "sv": swedish_stem,
+    "de": german_stem,
+    "es": spanish_stem,
+    "pt": portuguese_stem,
+    "nl": dutch_stem,
+}
+
+ANALYZERS: dict[str, LanguageAnalyzer] = {
+    lang: LanguageAnalyzer(lang, STOPWORDS[lang], _STEMMERS[lang])
+    for lang in _STEMMERS
+}
+
+#: the "standard" analyzer (LuceneTextAnalyzer falls back to
+#: StandardAnalyzer when the language has no dedicated analyzer):
+#: tokenize + lowercase only
+STANDARD = LanguageAnalyzer("", frozenset(), lambda t: t)
+
+
+def analyzer_for(language: str | None) -> LanguageAnalyzer:
+    """Analyzer for an ISO-639-1 code ('se' — the reference's Swedish model
+    directory name — is accepted as an alias of 'sv'); unknown → STANDARD."""
+    if not language:
+        return STANDARD
+    lang = language.lower()
+    if lang == "se":
+        lang = "sv"
+    return ANALYZERS.get(lang, STANDARD)
+
+
+def detect_language(text: str) -> str | None:
+    """Lightweight stopword-voting language detection (OptimaizeLanguage-
+    Detector stand-in) over the analyzer languages."""
+    toks = tokenize(text)
+    if not toks:
+        return None
+    best, best_score = None, 0.0
+    for lang, sw in STOPWORDS.items():
+        score = sum(1 for t in toks if t in sw) / len(toks)
+        if score > best_score:
+            best, best_score = lang, score
+    return best if best_score > 0 else None
+
+
+def analyze(
+    text: str,
+    language: str | None = None,
+    auto_detect: bool = False,
+    to_lowercase: bool = True,
+    min_token_length: int = 1,
+) -> list[str]:
+    """TextTokenizer.analyze parity: pick the analyzer by explicit language
+    or auto-detection, fall back to the standard analyzer."""
+    lang = language
+    if auto_detect and lang is None:
+        lang = detect_language(text)
+    return analyzer_for(lang).analyze(
+        text, to_lowercase=to_lowercase, min_token_length=min_token_length
+    )
