@@ -1,0 +1,111 @@
+"""Phase-level wall-clock breakdown of the flagship Titanic bench.
+
+Prints one line per phase so the program-acquisition tail is visible.
+Usage: python tools/profile_bench.py [--log]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (enables the compile cache)
+
+if "--log" in sys.argv:
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+
+_T0 = time.perf_counter()
+_last = [_T0]
+
+
+def mark(label: str) -> None:
+    now = time.perf_counter()
+    print(f"[{now - _T0:7.2f}s] +{now - _last[0]:6.2f}s  {label}", flush=True)
+    _last[0] = now
+
+
+def main() -> None:
+    import threading
+
+    from transmogrifai_tpu.utils import aot
+
+    warm = threading.Thread(target=aot.prewarm, daemon=True)
+    warm.start()
+    mark("prewarm thread started")
+
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.prep import SanityChecker
+    from transmogrifai_tpu.readers import infer_csv_dataset
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    mark("imports done")
+
+    ds = infer_csv_dataset(bench.TITANIC)
+    mark("csv read")
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+    selector = BinaryClassificationModelSelector(seed=42)
+    pred = selector.set_input(resp, checked).get_output()
+    wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+    mark("dag assembled")
+
+    # instrument the selector's validate to time each family sweep
+    from transmogrifai_tpu.selector import validators as V
+
+    orig_sweep = V.Validator._sweep_family
+
+    def timed_sweep(self, est, points, folds, x, y, evaluator):
+        t0 = time.perf_counter()
+        out = orig_sweep(self, est, points, folds, x, y, evaluator)
+        print(
+            f"    sweep {type(est).__name__:28s} {len(points):3d} pts "
+            f"{time.perf_counter() - t0:6.2f}s",
+            flush=True,
+        )
+        return out
+
+    V.Validator._sweep_family = timed_sweep
+
+    from transmogrifai_tpu.workflow import fit as WF
+
+    orig_fit_stage = None
+    try:
+        from transmogrifai_tpu.stages.base import Estimator
+
+        orig_fit = Estimator.fit
+
+        def timed_fit(self, dataset):
+            t0 = time.perf_counter()
+            out = orig_fit(self, dataset)
+            dt = time.perf_counter() - t0
+            if dt > 0.25:
+                print(f"    fit {type(self).__name__:30s} {dt:6.2f}s", flush=True)
+            return out
+
+        Estimator.fit = timed_fit
+    except Exception as e:
+        print("no stage timing:", e)
+
+    model = wf.train()
+    mark("train done")
+    sel = model.summary_json()["modelSelectorSummary"]
+    mark("summary")
+    model.score(dataset=ds)
+    mark("score")
+    print(json.dumps({
+        "train_s": None,
+        "holdout_aupr": sel["holdoutEvaluation"]["AuPR"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

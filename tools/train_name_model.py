@@ -18,8 +18,13 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+def _setup_env() -> None:
+    """CLI-only side effects (kept out of import time: the test suite
+    imports this module for its corpora, and mutating JAX_PLATFORMS
+    mid-session would silently move the rest of the suite off the TPU)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 NAMES = """
 james john robert michael william david richard joseph thomas charles
@@ -125,6 +130,7 @@ queue token lease mutex cache shard chunk block frame scope trace probe
 
 
 def main() -> None:
+    _setup_env()
     import jax
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp

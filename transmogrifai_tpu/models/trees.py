@@ -217,7 +217,6 @@ def _grow_tree_impl(
         while cap < n_global:
             cap <<= 1
         cap = min(cap, max_nodes)
-    compact = cap < max_nodes
 
     # histogram impl policy: "pallas" is AUTO — at AutoML-tabular row counts
     # (≤4k) the one-hot GEMM histogram beats the kernels outright (per-level
@@ -414,83 +413,59 @@ def _grow_tree_impl(
             leaf_value=-leaf_g0 / (leaf_h0 + vec(reg_lambda)[:, None]),
         )
 
-    # ---- Python-unrolled level loop: every level's node-slot space and
-    # chunk size are STATIC (min(2^d, cap)), so level 0 costs a 1-slot
-    # kernel pass and only the deepest levels pay for `cap` slots — the
-    # shared-body fori_loop alternative forces every level to the maximum
-    node = jnp.zeros((k_fits, n), dtype=jnp.int32)
-    # rows whose node failed to split are DEAD for histogram purposes: a
-    # non-split node's child holds the same rows, hence the same histogram
-    # and the same failed gain test (the hereditary no-split argument behind
-    # the early level exit, applied per NODE). Excluding them is lossless,
-    # shrinks the compacted live-slot frontier to the still-splittable
-    # nodes, and lets the per-chunk occupancy skip drop the dead bulk of
-    # deep levels. `node` itself keeps the full routing chain (dead rows
-    # continue left) so leaf assignment is unchanged.
-    active = jnp.ones((k_fits, n), dtype=bool)
-    feats_levels, bins_levels = [], []
-    for d in range(max_depth):
-        n_nodes = min(1 << d, cap)  # static live-slot bound for this level
-        chunk_nodes = min(chunk_cap, n_nodes)
-        num_chunks = (n_nodes + chunk_nodes - 1) // chunk_nodes
+    # ---- lax.scan over levels with ONE shared body. Program bytes are the
+    # binding constraint on the tunneled chip (serialized executables ship
+    # over the link every fresh process — BASELINE.md round 3), and an
+    # unrolled level loop multiplies the compiled body by max_depth. Every
+    # level therefore uses the SAME static slot layout: `cap` compact slots
+    # in `num_chunks` fixed chunks, with node compaction numbering live
+    # slots densely from 0 so the per-chunk occupancy cond skips the
+    # provably-empty tail (level 0 has one live node → one chunk runs).
+    # Shallow levels pay a full-width chunk where the unrolled loop paid
+    # 2^d slots; that is kernel-grid noise next to shipping a 10× bigger
+    # executable.
+    n_nodes = cap
+    chunk_nodes = min(chunk_cap, n_nodes)
+    num_chunks = (n_nodes + chunk_nodes - 1) // chunk_nodes
 
+    def compact_local(hist_node):
+        """Dense live-slot numbering [K, cap] + each row's slot."""
+        if axis_name is None:
+            return jax.vmap(compact_ids)(hist_node)
+        # global compaction: every shard must agree on the live-slot
+        # numbering, so derive it from a psum'd occupancy mask (same
+        # sorted-unique-ids result as compact_ids, but global); sentinel
+        # (dead) rows fall outside the scatter range
+        occ = jax.vmap(
+            lambda nd: jnp.zeros(max_nodes, jnp.int32).at[nd].add(
+                1, mode="drop"
+            )
+        )(hist_node)
+        occ = jax.lax.psum(occ, axis_name)
+        ids = jnp.arange(max_nodes, dtype=jnp.int32)
+        live = jnp.where(occ > 0, ids[None, :], sentinel)
+        uids = jnp.sort(live, axis=1)[:, :cap]  # [K, cap]
+        local = jax.vmap(
+            lambda u, nd: jnp.searchsorted(u, nd).astype(jnp.int32)
+        )(uids, hist_node)
+        return uids, local
+
+    def level_body(carry, _):
+        # rows whose node failed to split are DEAD for histogram purposes:
+        # a non-split node's child holds the same rows, hence the same
+        # histogram and the same failed gain test (the hereditary no-split
+        # argument). Excluding them shrinks the live-slot frontier so the
+        # occupancy skip drops the dead bulk of deep levels; `node` keeps
+        # the full routing chain (dead rows continue left) so leaf
+        # assignment is unchanged.
+        node, active, alive = carry
         hist_node = jnp.where(active, node, sentinel)
-        # compact whenever the level's raw id space exceeds the slot cap OR
-        # spans multiple kernel chunks: dense slot numbering makes the
-        # trailing chunks provably empty, so the per-chunk occupancy skip
-        # above can drop their kernel passes (live nodes ≪ 2^d at depth)
-        if (compact and (1 << d) > cap) or (
-            axis_name is None and (1 << d) > chunk_nodes
-        ):
-            if axis_name is None:
-                uids, local = jax.vmap(compact_ids)(hist_node)
-            else:
-                # global compaction: every shard must agree on the live-slot
-                # numbering, so derive it from a psum'd occupancy mask (same
-                # sorted-unique-ids result as compact_ids, but global);
-                # sentinel (dead) rows fall outside the scatter range
-                occ = jax.vmap(
-                    lambda nd: jnp.zeros(max_nodes, jnp.int32).at[nd].add(
-                        1, mode="drop"
-                    )
-                )(hist_node)
-                occ = jax.lax.psum(occ, axis_name)
-                ids = jnp.arange(max_nodes, dtype=jnp.int32)
-                live = jnp.where(occ > 0, ids[None, :], sentinel)
-                uids = jnp.sort(live, axis=1)[:, :cap]  # [K, cap]
-                local = jax.vmap(
-                    lambda u, nd: jnp.searchsorted(u, nd).astype(jnp.int32)
-                )(uids, hist_node)
-            compacted = True
-        else:
-            local = hist_node
-            compacted = False
-        # dead rows out of every histogram / occupancy check, regardless of
-        # which slot the sentinel landed on after compaction
+        uids, local = compact_local(hist_node)
+        # dead rows out of every histogram / occupancy check, regardless
+        # of which slot the sentinel landed on after compaction
         local = jnp.where(active, local, sentinel)
 
-        def live_level(local=local, n_nodes=n_nodes,
-                       chunk_nodes=chunk_nodes, num_chunks=num_chunks):
-            if num_chunks <= 2:
-                cfs, cbs = [], []
-                for ci in range(num_chunks):
-                    cf, cb = chunk_stats(local, ci * chunk_nodes, chunk_nodes)
-                    cfs.append(cf)
-                    cbs.append(cb)
-                if num_chunks == 1:
-                    return cfs[0][:, :n_nodes], cbs[0][:, :n_nodes]
-                return (
-                    jnp.concatenate(cfs, axis=1)[:, :n_nodes],
-                    jnp.concatenate(cbs, axis=1)[:, :n_nodes],
-                )
-            # multi-chunk levels run ONE shared fori body — unrolling a
-            # branch per chunk multiplies program size (and serialized
-            # executable bytes, which ship over the tunneled link every
-            # fresh process) by the chunk count. The occupancy cond inside
-            # the body skips the kernels for empty chunks: compaction
-            # numbers live slots densely from 0, so the deep-level tail of
-            # the slot range is provably empty. (The sharded path always
-            # computes — its psums can't sit under a data-dependent cond.)
+        def live_level():
             def chunk_body(ci, fb):
                 feats_a, bins_a = fb
                 c0 = ci * chunk_nodes
@@ -511,6 +486,8 @@ def _grow_tree_impl(
                         ),
                     )
                 else:
+                    # the sharded path always computes — its psums can't
+                    # sit under a data-dependent cond
                     cf, cb = chunk_stats(local, c0, chunk_nodes)
                 return (
                     jax.lax.dynamic_update_slice(feats_a, cf, (0, c0)),
@@ -528,17 +505,12 @@ def _grow_tree_impl(
             )
             return feats_a[:, :n_nodes], bins_a[:, :n_nodes]
 
-        # ---- early level exit: no-split is hereditary (an unsplit node's
-        # child has the SAME rows, hence the same histogram and the same
-        # failed gain test), so once a level produces zero splits every
-        # deeper level is all-leaves. Skipping the histogram kernels for
-        # those levels is the dominant win for the deep ends of the
-        # reference's maxDepth grid (depth 12 with minInstances 10/100
-        # stops splitting around level 7 on Titanic-sized folds). The
-        # sharded path always computes: its histogram psums would sit
-        # inside a cond branch, and replicated-predicate collectives under
-        # shard_map are not worth the coupling.
-        if d == 0 or axis_name is not None:
+        # ---- early level exit: no-split is hereditary, so once a level
+        # produces zero splits every deeper level is all-leaves — skip the
+        # histogram work under a cond. The sharded path always computes
+        # (replicated-predicate collectives under shard_map are not worth
+        # the coupling).
+        if axis_name is not None:
             feats_c, bins_c = live_level()
         else:
             feats_c, bins_c = jax.lax.cond(
@@ -552,21 +524,14 @@ def _grow_tree_impl(
         alive = (feats_c >= 0).any()
 
         # write per-slot decisions into the GLOBAL node-slot tree arrays
-        if compacted:
-            feats_d = jax.vmap(
-                lambda u, v: jnp.full(max_nodes, -1, dtype=jnp.int32)
-                .at[u].set(v, mode="drop")
-            )(uids[:, :n_nodes], feats_c)
-            bins_d = jax.vmap(
-                lambda u, v: jnp.zeros(max_nodes, dtype=jnp.int32)
-                .at[u].set(v, mode="drop")
-            )(uids[:, :n_nodes], bins_c)
-        else:
-            pad = max_nodes - n_nodes
-            feats_d = jnp.pad(feats_c, ((0, 0), (0, pad)), constant_values=-1)
-            bins_d = jnp.pad(bins_c, ((0, 0), (0, pad)))
-        feats_levels.append(feats_d)
-        bins_levels.append(bins_d)
+        feats_d = jax.vmap(
+            lambda u, v: jnp.full(max_nodes, -1, dtype=jnp.int32)
+            .at[u].set(v, mode="drop")
+        )(uids[:, :n_nodes], feats_c)
+        bins_d = jax.vmap(
+            lambda u, v: jnp.zeros(max_nodes, dtype=jnp.int32)
+            .at[u].set(v, mode="drop")
+        )(uids[:, :n_nodes], bins_c)
 
         # ---- route rows to children (gather via compact slots — cheaper)
         slot = jnp.clip(local, 0, n_nodes - 1)
@@ -580,9 +545,20 @@ def _grow_tree_impl(
         go_right = active & (row_feat >= 0) & (code > row_thr)
         node = node * 2 + go_right.astype(jnp.int32)
         active = active & (row_feat >= 0)
+        return (node, active, alive), (feats_d, bins_d)
 
-    feats = jnp.stack(feats_levels, axis=1)  # [K, depth, max_nodes]
-    bins = jnp.stack(bins_levels, axis=1)
+    (node, active, _), (feats_s, bins_s) = jax.lax.scan(
+        level_body,
+        (
+            jnp.zeros((k_fits, n), dtype=jnp.int32),
+            jnp.ones((k_fits, n), dtype=bool),
+            jnp.asarray(True),
+        ),
+        None,
+        length=max_depth,
+    )
+    feats = jnp.swapaxes(feats_s, 0, 1)  # [K, depth, max_nodes]
+    bins = jnp.swapaxes(bins_s, 0, 1)
 
     leaf_g = jax.vmap(
         lambda nd, gk: jnp.zeros(max_nodes, dtype=jnp.float32).at[nd].add(gk)
@@ -598,18 +574,25 @@ def _grow_tree_impl(
 
 
 def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
-    """Leaf value per row — a static unrolled depth loop of gathers."""
+    """Leaf value per row — lax.scan over the [depth, ...] level arrays
+    (one shared gather body; an unrolled depth loop multiplies program
+    bytes by depth, which is what ships over the tunneled link)."""
     n = binned.shape[0]
-    node = jnp.zeros(n, dtype=jnp.int32)
-    depth = tree.split_feat.shape[0]
-    for d in range(depth):
-        feat = tree.split_feat[d][node]
-        thr = tree.split_bin[d][node]
+
+    def level(node, sfsb):
+        sf, sb = sfsb
+        feat = sf[node]
+        thr = sb[node]
         code = jnp.take_along_axis(
             binned, jnp.maximum(feat, 0)[:, None], axis=1
         )[:, 0]
         go_right = (feat >= 0) & (code > thr)
-        node = node * 2 + go_right.astype(jnp.int32)
+        return node * 2 + go_right.astype(jnp.int32), None
+
+    node, _ = jax.lax.scan(
+        level, jnp.zeros(n, dtype=jnp.int32),
+        (tree.split_feat, tree.split_bin),
+    )
     return tree.leaf_value[node]
 
 

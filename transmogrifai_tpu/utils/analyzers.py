@@ -421,7 +421,7 @@ def dutch_stem(w: str) -> str:
         w = w[:-5] + "heid"
     elif w.endswith("ene") and len(w) - 3 >= r1:
         w = _nl_undouble(w[:-3])
-    elif w.endswith("en") and len(w) - 2 >= r1 and not w.endswith("gem"):
+    elif w.endswith("en") and len(w) - 2 >= r1 and not w[:-2].endswith("gem"):
         stem = w[:-2]
         if stem and stem[-1] not in _VOWELS["nl"]:
             w = _nl_undouble(stem)

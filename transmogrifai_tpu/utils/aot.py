@@ -128,9 +128,14 @@ def _load_exec(path: str):
     """pickle → deserialize_and_load → callable, or None."""
     from jax.experimental import serialize_executable as SE
 
+    t0 = _time.monotonic()
     with open(path, "rb") as fh:
         payload, in_tree, out_tree = pickle.loads(fh.read())
     compiled = SE.deserialize_and_load(payload, in_tree, out_tree)
+    log.info(
+        "AOT load %s (%.1f MB) in %.2f s", os.path.basename(path),
+        os.path.getsize(path) / 1e6, _time.monotonic() - t0,
+    )
     os.utime(path)  # recency marker for pruning
     return lambda *a: compiled(*a)
 
@@ -213,6 +218,9 @@ def aot_call(
         with _LOCK:
             call = _MEM.get(key)
         if call is not None:
+            # NOTE: dispatch is async — timing this call would measure
+            # enqueue latency, not execution
+            log.debug("AOT hit %s (%s)", name, key)
             return call(*args)
         path = os.path.join(
             _exec_dir(), f"{_version_salt()}-{key}.jaxexec"
@@ -237,7 +245,12 @@ def aot_call(
         # trace+compile. _PENDING dedupes concurrent validator threads;
         # _FAILED is the negative cache; the tmp suffix is unique per
         # thread so racing writers can't interleave one file.
+        t_direct = _time.monotonic()
         out = jit_fn(*args, **statics)
+        log.info(
+            "AOT miss %s (%s): direct call %.2f s", name, key,
+            _time.monotonic() - t_direct,
+        )
         with _LOCK:
             if key not in _MEM:
                 # same-process repeats reuse jit_fn's warm cache
