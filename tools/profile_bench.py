@@ -63,9 +63,9 @@ def main() -> None:
 
     orig_sweep = V.Validator._sweep_family
 
-    def timed_sweep(self, est, points, folds, x, y, evaluator):
+    def timed_sweep(self, est, points, folds, x, y, evaluator, **kw):
         t0 = time.perf_counter()
-        out = orig_sweep(self, est, points, folds, x, y, evaluator)
+        out = orig_sweep(self, est, points, folds, x, y, evaluator, **kw)
         print(
             f"    sweep {type(est).__name__:28s} {len(points):3d} pts "
             f"{time.perf_counter() - t0:6.2f}s",

@@ -1,0 +1,48 @@
+"""Capture a jax.profiler trace of the depth-12 forest_scan exec."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from transmogrifai_tpu.models import trees as TR  # noqa: E402
+from transmogrifai_tpu.models.gbdt import _feature_bin_groups  # noqa: E402
+
+rng = np.random.default_rng(0)
+N, F = 891, 120
+x = np.zeros((N, F), dtype=np.float32)
+x[:, :8] = rng.normal(size=(N, 8))
+x[:, 8:] = (rng.random((N, F - 8)) < 0.2).astype(np.float32)
+y = (rng.random(N) < 0.4).astype(np.float32)
+thr = TR.quantile_thresholds(x, 32)
+binned = TR.bin_data(jnp.asarray(x), jnp.asarray(thr))
+fg = tuple(jnp.asarray(a) for a in _feature_bin_groups(x))
+masks = np.stack([(rng.random(N) < 0.67).astype(np.float32) for _ in range(3)])
+
+K, T, depth = 18, 50, 12
+rm = jnp.asarray(np.repeat(masks, 6, axis=0))
+mi = jnp.asarray(np.tile([10.0, 100.0], 9).astype(np.float32))
+mg = jnp.asarray(np.tile([0.001, 0.01, 0.1], 6).astype(np.float32))
+tkeys = jax.random.split(jax.random.PRNGKey(42), T)
+
+f = lambda: TR._forest_trees_scan(  # noqa: E731
+    binned, jnp.asarray(-y), rm, tkeys, jnp.ones(K), jnp.ones(K), mi, mg,
+    fg, max_depth=depth, num_bins=32, bootstrap=True, lowp=True,
+    hist_impl=TR._resolved_impl(),
+)
+
+
+def sync(out):
+    for leaf in jax.tree.leaves(out):
+        np.asarray(jnp.sum(leaf))
+
+
+sync(f())  # warm
+jax.profiler.start_trace("/tmp/jaxtrace")
+sync(f())
+jax.profiler.stop_trace()
+print("trace done")

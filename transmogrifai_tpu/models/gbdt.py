@@ -71,31 +71,55 @@ def _feature_bin_groups(x: np.ndarray):
     return jnp.asarray(narrow), jnp.asarray(wide)
 
 
-class _LazySlice:
-    """Deferred host materialization of one lane of a stacked-trees fit.
+@jax.jit
+def _stack_lane(trees, lane):
+    """One lane of a stacked-trees pytree, sliced ON DEVICE (lane is a
+    traced scalar, so every lane of a given stack shape shares one
+    program)."""
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, lane, 0, keepdims=False),
+        trees,
+    )
 
-    The batched sweep fits K candidates' trees as one device array; pulling
-    it to host eagerly costs a ~44 MB download over the tunneled link
-    (measured ~40 s for the Titanic RF groups) that the sweep never uses —
-    candidate metrics come from sweep_eval_batched on the DEVICE stack, and
-    only the winner's model ever needs its tree arrays (for persistence or
-    re-scoring). First access downloads the stack once and caches it on the
-    shared stack record."""
+
+class _LazySlice:
+    """Deferred materialization of one lane of a stacked-trees fit.
+
+    The batched sweep fits K candidates' trees as one device array that the
+    sweep itself never slices — candidate metrics come from
+    sweep_eval_batched on the DEVICE stack, and only the winner's model
+    ever needs its tree arrays (for persistence or re-scoring). The slice
+    happens on device (a ~200 MB refit-lane stack pulled to host for a
+    16 MB lane was measured at ~15 s over the tunneled link); persistence
+    downloads just the winner's lane when get_arrays() converts to numpy."""
 
     def __init__(self, stack: dict, lane: int):
         self.stack = stack
         self.lane = lane
 
     def get(self):
-        host = self.stack.get("host")
-        if host is None:
-            host = jax.tree.map(lambda a: np.asarray(a), self.stack["trees"])
-            self.stack["host"] = host
-        return jax.tree.map(lambda a: a[self.lane], host)
+        cache = self.stack.setdefault("lane_slices", {})
+        out = cache.get(self.lane)
+        if out is None:
+            trees = self.stack["trees"]
+            if isinstance(jax.tree.leaves(trees)[0], np.ndarray):
+                # host stack (multi-device mesh path pre-pulls — see
+                # _batched_group_fit): plain numpy view
+                out = jax.tree.map(lambda a: a[self.lane], trees)
+            else:
+                out = _stack_lane(trees, jnp.int32(self.lane))
+            cache[self.lane] = out
+        return out
 
 
 def _resolve_trees(t):
     return t.get() if isinstance(t, _LazySlice) else t
+
+
+def _host_trees(t):
+    """Tree pytree as host numpy (persistence path — downloads only this
+    lane when the trees live on device)."""
+    return jax.tree.map(np.asarray, _resolve_trees(t))
 
 
 class _BinnedModel(PredictorModel):
@@ -123,6 +147,30 @@ class _BinnedModel(PredictorModel):
             self._dev_cache = jax.tree.map(jnp.asarray, trees)
         return self._dev_cache
 
+    def detach_from_sweep(self):
+        """Cut every reference to the stacked sweep arrays: materialize this
+        model's own lane (a small independent device array) and drop the
+        stack attrs, so selecting a winner does not pin the whole
+        (folds+refit) × grid stack in HBM for the model's lifetime."""
+        def own(t):
+            # numpy lane slices are VIEWS into the host stack — copy so the
+            # base array can be collected; device slices are independent
+            resolved = _resolve_trees(t)
+            return jax.tree.map(
+                lambda a: np.array(a) if isinstance(a, np.ndarray) else a,
+                resolved,
+            )
+
+        for attr in ("trees", "trees_per_class", "forests_per_class"):
+            t = getattr(self, attr, None)
+            if isinstance(t, _LazySlice):
+                setattr(self, attr, own(t))
+            elif isinstance(t, list):
+                setattr(self, attr, [own(x) for x in t])
+        for attr in ("_sweep_stack", "_sweep_lane"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+
 
 class BoostedBinaryModel(_BinnedModel):
     def __init__(self, thresholds, trees: TR.Tree, eta: float, base_score: float, uid=None):
@@ -132,7 +180,7 @@ class BoostedBinaryModel(_BinnedModel):
         self.base_score = base_score
 
     def get_arrays(self):
-        t = _resolve_trees(self.trees)
+        t = _host_trees(self.trees)
         return {
             "thresholds": self.thresholds,
             "split_feat": t.split_feat,
@@ -185,7 +233,7 @@ class BoostedMultiModel(_BinnedModel):
 
     def get_arrays(self):
         out = {"thresholds": self.thresholds}
-        for c, t in enumerate(map(_resolve_trees, self.trees_per_class)):
+        for c, t in enumerate(map(_host_trees, self.trees_per_class)):
             out[f"c{c}__split_feat"] = t.split_feat
             out[f"c{c}__split_bin"] = t.split_bin
             out[f"c{c}__leaf_value"] = t.leaf_value
@@ -227,7 +275,7 @@ class BoostedRegressionModel(_BinnedModel):
         self.base_score = base_score
 
     def get_arrays(self):
-        t = _resolve_trees(self.trees)
+        t = _host_trees(self.trees)
         return {
             "thresholds": self.thresholds,
             "split_feat": t.split_feat,
@@ -275,7 +323,7 @@ class ForestClassifierModel(_BinnedModel):
 
     def get_arrays(self):
         out = {"thresholds": self.thresholds}
-        for c, t in enumerate(map(_resolve_trees, self.forests_per_class)):
+        for c, t in enumerate(map(_host_trees, self.forests_per_class)):
             out[f"c{c}__split_feat"] = t.split_feat
             out[f"c{c}__split_bin"] = t.split_bin
             out[f"c{c}__leaf_value"] = t.leaf_value
@@ -332,7 +380,7 @@ class ForestRegressionModel(_BinnedModel):
         return cls(arrays["thresholds"], _tree_from_arrays(arrays))
 
     def get_arrays(self):
-        t = _resolve_trees(self.trees)
+        t = _host_trees(self.trees)
         return {
             "thresholds": self.thresholds,
             "split_feat": t.split_feat,

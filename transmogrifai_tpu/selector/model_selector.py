@@ -280,13 +280,27 @@ class ModelSelector(PredictorEstimator):
             keep = self.splitter.prepare(yt)
             xt, yt = xt[keep], yt[keep]
 
+        # validation prepare (balancing / down-sampling) is a deterministic
+        # seeded function of yt, so the refit mask is computable BEFORE
+        # validation — it rides the candidate sweep as an extra fit lane of
+        # the same batched program, so the winner's refit model is already
+        # trained when validation returns (no separate refit program)
+        final_mask = np.ones(len(yt), dtype=np.float32)
+        if self.splitter is not None and not isinstance(self.splitter, DataCutter):
+            final_mask = self.splitter.prepare(yt).astype(np.float32)
+
         if self.precomputed_results is not None:
             # consume-once: stale fold metrics must not leak into a later
             # re-train on different data
             results = self.precomputed_results
             self.precomputed_results = None
+            prefit = {}
         else:
-            results = self.validator.validate(self.models, xt, yt, self.evaluator)
+            results = self.validator.validate(
+                self.models, xt, yt, self.evaluator,
+                extra_masks=[final_mask],
+            )
+            prefit = getattr(self.validator, "last_extra_models", {})
         best = Validator.best(results, self.evaluator)
         log.info(
             "ModelSelector best: %s %s (%s=%.4f over %d candidates)",
@@ -302,23 +316,33 @@ class ModelSelector(PredictorEstimator):
         )
         final_est = family.with_params(**best.grid)
 
-        # validation prepare: balancing / down-sampling before the final refit
-        final_mask = np.ones(len(yt), dtype=np.float32)
         splitter_summary = None
-        if self.splitter is not None and not isinstance(self.splitter, DataCutter):
-            final_mask = self.splitter.prepare(yt).astype(np.float32)
         if self.splitter is not None and self.splitter.summary is not None:
             splitter_summary = self.splitter.summary.to_json()
 
-        # refit through the family's BATCHED path when it has one: batched
-        # fits acquire their programs through the AOT executable bank
-        # (utils/aot.py), so a fresh process pays a cached load instead of
-        # a trace+compile for the winner's refit
-        batched = getattr(final_est, "fit_arrays_batched_masks", None)
-        if batched is not None:
-            best_model = batched(xt, yt, [final_mask], [dict(best.grid)])[0][0]
-        else:
-            best_model = final_est.fit_arrays(xt, yt, final_mask)
+        # the winner's refit model usually already exists as the extra
+        # sweep lane fitted on final_mask (validate(extra_masks=...));
+        # families without the batched hook (or the workflow-CV path)
+        # refit directly — batched when possible so the program comes from
+        # the AOT executable bank
+        best_model = None
+        if best.model_uid in prefit:
+            points, extra_rows = prefit[best.model_uid]
+            if best.grid in points and extra_rows:
+                best_model = extra_rows[0][points.index(best.grid)]
+                # free the sweep stacks: keep only the winner's own lane
+                detach = getattr(best_model, "detach_from_sweep", None)
+                if detach is not None:
+                    detach()
+        getattr(self.validator, "last_extra_models", {}).clear()
+        if best_model is None:
+            batched = getattr(final_est, "fit_arrays_batched_masks", None)
+            if batched is not None:
+                best_model = batched(
+                    xt, yt, [final_mask], [dict(best.grid)]
+                )[0][0]
+            else:
+                best_model = final_est.fit_arrays(xt, yt, final_mask)
 
         pred, prob, _ = best_model.predict_arrays(xt)
         train_metrics = self.evaluator.evaluate_arrays(yt, pred, prob)

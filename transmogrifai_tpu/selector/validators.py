@@ -60,6 +60,9 @@ class Validator:
 
     def __init__(self, seed: int = 42):
         self.seed = seed
+        #: family_uid -> (points, models[extra_mask_i][point_i]) from the
+        #: last validate(extra_masks=...) call — pre-fitted refit lanes
+        self.last_extra_models: dict[str, tuple[list, list]] = {}
 
     def split_masks(self, y: np.ndarray) -> list[tuple[np.ndarray, np.ndarray]]:
         raise NotImplementedError
@@ -77,19 +80,31 @@ class Validator:
         x: np.ndarray,
         y: np.ndarray,
         evaluator: Evaluator,
+        extra_masks: Sequence[np.ndarray] = (),
     ) -> list[CandidateResult]:
         """Fit every model family x grid point on every fold; returns results
         with per-fold metric values. Failed families are skipped
-        (OpValidator.scala:318-357); raises only if everything failed."""
+        (OpValidator.scala:318-357); raises only if everything failed.
+
+        ``extra_masks`` ride the SAME batched program as the folds as
+        additional fit lanes that contribute no metrics — the selector
+        passes the post-balancing full-train mask here so the winner's
+        refit is already fitted when validation returns (no separate K=1
+        refit program to acquire/execute). Results land in
+        ``self.last_extra_models[family_uid] = (points, models)`` with
+        ``models[mask_i][point_i]``; families without the batched-masks
+        hook are omitted (the selector falls back to a direct refit)."""
         from concurrent.futures import ThreadPoolExecutor
 
         folds = self.split_masks(y)
         results: list[CandidateResult] = []
         errors: list[str] = []
+        self.last_extra_models: dict[str, tuple[list, list]] = {}
 
         def run(est, grid):
             return self._sweep_family(
-                est, expand_grid(grid), folds, x, y, evaluator
+                est, expand_grid(grid), folds, x, y, evaluator,
+                extra_masks=extra_masks,
             )
 
         import jax
@@ -136,15 +151,31 @@ class Validator:
         x: np.ndarray,
         y: np.ndarray,
         evaluator: Evaluator,
+        extra_masks: Sequence[np.ndarray] = (),
     ) -> list[CandidateResult]:
+        import os
+
         per_point_values: list[list[float]] = [[] for _ in points]
         batched_masks = getattr(est, "fit_arrays_batched_masks", None)
+        if os.environ.get("TPTPU_BATCHED_FITS") == "0":
+            # sequential fallback would pay len(points) extra full-data
+            # fits per family for lanes only the winner ever uses — the
+            # selector refits the winner directly instead
+            extra_masks = ()
         if batched_masks is not None:
             # the whole folds × grid sweep in as few compiled programs as
-            # the family's static shapes allow (fold = batch-axis entry)
-            models_by_fold = batched_masks(
-                x, y, [tm.astype(np.float32) for tm, _ in folds], points
-            )
+            # the family's static shapes allow (fold = batch-axis entry);
+            # extra_masks (e.g. the refit mask) are additional lanes of the
+            # same program — they produce models but no metrics
+            all_masks = [tm.astype(np.float32) for tm, _ in folds] + [
+                np.asarray(m, dtype=np.float32) for m in extra_masks
+            ]
+            models_by_fold = batched_masks(x, y, all_masks, points)
+            if extra_masks:
+                self.last_extra_models[est.uid] = (
+                    points, models_by_fold[len(folds):]
+                )
+                models_by_fold = models_by_fold[: len(folds)]
             # family-managed batched validation: one device program per
             # fitted stack instead of a predict dispatch per model
             sweep_eval = getattr(est, "sweep_eval_batched", None)
