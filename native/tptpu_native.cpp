@@ -79,11 +79,11 @@ void tp_murmur3_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
 void tp_murmur3_scatter(const uint8_t* buf, const int64_t* offsets,
                         const int64_t* rows, int64_t n, uint32_t seed,
                         int64_t num_buckets, int binary, float* out,
-                        int64_t out_cols) {
+                        int64_t out_cols, int64_t col_offset) {
   for (int64_t i = 0; i < n; i++) {
     uint32_t h = murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
     int64_t j = (int64_t)(h % (uint32_t)num_buckets);
-    float* cell = out + rows[i] * out_cols + j;
+    float* cell = out + rows[i] * out_cols + col_offset + j;
     if (binary) {
       *cell = 1.0f;
     } else {
@@ -149,6 +149,114 @@ void tp_tokenize_hash_scatter(const uint8_t* buf, const int64_t* offsets,
       }
     }
   }
+}
+
+// -------------------------------------------- tokenize + hash → COO pairs
+// Sparse variant of tp_tokenize_hash_scatter: instead of scattering into a
+// dense [num_rows, buckets] matrix (whose first-touch page faults dominate
+// on wide hash planes — the output is ~99% zeros at 512 buckets), emit
+// (row, bucket) pairs. Duplicates are NOT combined for count semantics
+// (the densifier adds them); binary mode dedupes per row with a bucket
+// bitset so add-combine still yields {0,1}.
+//
+// tp_count_tokens returns the number of pairs the fill pass will emit with
+// the same arguments — callers size the output arrays exactly.
+int64_t tp_count_tokens(const uint8_t* buf, const int64_t* offsets,
+                        int64_t n_strings, int64_t min_token_len) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n_strings; i++) {
+    const uint8_t* s = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t start = -1;
+    for (int64_t k = 0; k <= len; k++) {
+      bool word = false;
+      if (k < len) {
+        uint8_t c = s[k];
+        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+               (c >= 'a' && c <= 'z');
+      }
+      if (word) {
+        if (start < 0) start = k;
+        continue;
+      }
+      if (start >= 0) {
+        if (k - start >= min_token_len) count++;
+        start = -1;
+      }
+    }
+  }
+  return count;
+}
+
+// Fill pass: writes up to `cap` (row, col) pairs; returns the count
+// actually written (== tp_count_tokens for count mode; ≤ for binary mode,
+// which dedupes buckets per row).
+int64_t tp_tokenize_hash_coo(const uint8_t* buf, const int64_t* offsets,
+                             const int64_t* rows, int64_t n_strings,
+                             uint32_t seed, int64_t num_buckets, int binary,
+                             int lowercase, int64_t min_token_len,
+                             const uint8_t* prefix, int64_t prefix_len,
+                             int32_t* out_rows, int32_t* out_cols,
+                             int64_t cap) {
+  std::string token;
+  token.reserve(64);
+  // per-row bucket bitset for binary dedup
+  std::string seen;
+  if (binary) seen.assign((size_t)((num_buckets + 7) / 8), '\0');
+  int64_t w = 0;
+  for (int64_t i = 0; i < n_strings; i++) {
+    const uint8_t* s = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t start = -1;
+    bool row_touched = false;
+    for (int64_t k = 0; k <= len; k++) {
+      bool word = false;
+      if (k < len) {
+        uint8_t c = s[k];
+        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+               (c >= 'a' && c <= 'z');
+      }
+      if (word) {
+        if (start < 0) start = k;
+        continue;
+      }
+      if (start >= 0) {
+        int64_t tlen = k - start;
+        if (tlen >= min_token_len && w < cap) {
+          token.assign((const char*)prefix, (size_t)prefix_len);
+          for (int64_t t = start; t < k; t++) {
+            uint8_t c = s[t];
+            if (lowercase && c >= 'A' && c <= 'Z') c += 32;
+            token.push_back((char)c);
+          }
+          uint32_t h = murmur3_32((const uint8_t*)token.data(),
+                                  (int64_t)token.size(), seed);
+          int64_t col = (int64_t)(h % (uint32_t)num_buckets);
+          bool emit = true;
+          if (binary) {
+            char& byte = seen[(size_t)(col >> 3)];
+            char bit = (char)(1 << (col & 7));
+            if (byte & bit) {
+              emit = false;
+            } else {
+              byte |= bit;
+              row_touched = true;
+            }
+          }
+          if (emit) {
+            out_rows[w] = (int32_t)rows[i];
+            out_cols[w] = (int32_t)col;
+            w++;
+          }
+        }
+        start = -1;
+      }
+    }
+    if (binary && row_touched) {
+      std::memset(&seen[0], 0, seen.size());
+    }
+  }
+  return w;
 }
 
 // ---------------------------------------------- text stats (SmartText fit)

@@ -61,7 +61,7 @@ def _load() -> ctypes.CDLL | None:
             np.ctypeslib.ndpointer(np.int64, flags="C"),
             ctypes.c_int64, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int,
             np.ctypeslib.ndpointer(np.float32, flags="C"),
-            ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
         ]
         lib.tp_parse_doubles.argtypes = [
             ctypes.c_char_p,
@@ -80,6 +80,26 @@ def _load() -> ctypes.CDLL | None:
                 np.ctypeslib.ndpointer(np.int64, flags="C"),
                 ctypes.c_int64,
             ]
+        if hasattr(lib, "tp_count_tokens"):
+            lib.tp_count_tokens.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64, ctypes.c_int64,
+            ]
+            lib.tp_count_tokens.restype = ctypes.c_int64
+        if hasattr(lib, "tp_tokenize_hash_coo"):
+            lib.tp_tokenize_hash_coo.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64, ctypes.c_uint32, ctypes.c_int64,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                ctypes.c_int64,
+            ]
+            lib.tp_tokenize_hash_coo.restype = ctypes.c_int64
         if hasattr(lib, "tp_tokenize_hash_scatter"):
             lib.tp_tokenize_hash_scatter.argtypes = [
                 ctypes.c_char_p,
@@ -105,6 +125,32 @@ def _concat(values: list) -> tuple[bytes, np.ndarray]:
     offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
     np.cumsum([len(e) for e in encoded], out=offsets[1:])
     return b"".join(encoded), offsets
+
+
+def _concat_tokens(values: list) -> tuple[bytes, np.ndarray] | None:
+    """ASCII fast concat for the TOKENIZING consumers (tp_tokenize_* /
+    tp_clean_*): join once with a '\\x00' separator and compute offsets
+    from lengths — one C-level join + one encode instead of a per-row
+    encode/append loop. Item slices then carry a trailing separator byte,
+    which those consumers treat as an ordinary delimiter (non-alnum), so
+    tokenization is unchanged. NOT valid for whole-string hashing
+    (tp_murmur3_*), which hashes slices verbatim.
+
+    Returns None when any item is non-ASCII (one bulk check) — the C
+    tokenizers are byte-exact for ASCII only, so the caller must fall back
+    to the Unicode-exact Python path for those rows."""
+    n = len(values)
+    if n == 0:
+        return b"", np.zeros(1, dtype=np.int64)
+    joined = "\x00".join(values)
+    if not joined.isascii():
+        return None
+    lens = np.fromiter(map(len, values), np.int64, n)
+    offsets = np.empty(n + 1, dtype=np.int64)
+    offsets[0] = 0
+    np.cumsum(lens + 1, out=offsets[1:])
+    offsets[n] -= 1  # no trailing separator after the last item
+    return joined.encode("ascii"), offsets
 
 
 def murmur3_batch(values: list, seed: int = 42) -> np.ndarray:
@@ -142,7 +188,6 @@ def murmur3_scatter(
     rows = np.ascontiguousarray(rows, dtype=np.int64)
     if (
         lib is not None
-        and col_offset == 0
         and out.flags["C_CONTIGUOUS"]
         and out.dtype == np.float32
     ):
@@ -150,6 +195,7 @@ def murmur3_scatter(
         lib.tp_murmur3_scatter(
             buf, offsets, rows, len(tokens), seed & 0xFFFFFFFF,
             num_buckets, 1 if binary else 0, out, out.shape[1],
+            col_offset,
         )
         return out
     _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset)
@@ -182,7 +228,10 @@ def tokenize_hash_scatter(
         or out.dtype != np.float32
     ):
         return False
-    buf, offsets = _concat(texts)
+    ct = _concat_tokens(texts)
+    if ct is None:  # non-ASCII rows present — caller partitions
+        return False
+    buf, offsets = ct
     pref = prefix.encode("ascii")
     lib.tp_tokenize_hash_scatter(
         buf, offsets, np.ascontiguousarray(rows, dtype=np.int64),
@@ -193,6 +242,50 @@ def tokenize_hash_scatter(
     return True
 
 
+def tokenize_hash_coo(
+    texts: list,
+    rows: np.ndarray,
+    num_buckets: int,
+    seed: int = 42,
+    binary: bool = False,
+    to_lowercase: bool = True,
+    min_token_length: int = 1,
+    prefix: str = "",
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Fused tokenize+hash emitting COO (row, bucket) pairs — the sparse
+    SmartText hot path. Dense hash planes are ~99.8% zeros at 512 buckets,
+    and on low-memory-bandwidth hosts the dense output's page faults
+    dominate the whole text plane; pairs are ~50× smaller. Returns
+    (rows int32[nnz], cols int32[nnz]) with implicit value 1.0 per pair
+    (duplicates accumulate under add-combine; binary mode pre-dedupes), or
+    None when the native path can't take it (library missing or non-ASCII
+    rows present — caller falls back)."""
+    lib = _load()
+    if (
+        lib is None
+        or not hasattr(lib, "tp_tokenize_hash_coo")
+        or not hasattr(lib, "tp_count_tokens")
+    ):
+        return None
+    ct = _concat_tokens(texts)
+    if ct is None:
+        return None
+    buf, offsets = ct
+    cap = int(lib.tp_count_tokens(buf, offsets, len(texts), min_token_length))
+    out_rows = np.empty(max(cap, 1), dtype=np.int32)
+    out_cols = np.empty(max(cap, 1), dtype=np.int32)
+    pref = prefix.encode("ascii")
+    n = int(
+        lib.tp_tokenize_hash_coo(
+            buf, offsets, np.ascontiguousarray(rows, dtype=np.int64),
+            len(texts), seed & 0xFFFFFFFF, num_buckets,
+            1 if binary else 0, 1 if to_lowercase else 0, min_token_length,
+            pref, len(pref), out_rows, out_cols, cap,
+        )
+    )
+    return out_rows[:n], out_cols[:n]
+
+
 def clean_tokenstats(texts: list) -> tuple[list, np.ndarray] | None:
     """Batch TextUtils.cleanString + token-length histogram over ASCII
     strings in one native pass. Returns (cleaned_strings, length_hist) or
@@ -201,16 +294,20 @@ def clean_tokenstats(texts: list) -> tuple[list, np.ndarray] | None:
     lib = _load()
     if lib is None or not hasattr(lib, "tp_clean_tokenstats"):
         return None
-    buf, offsets = _concat(texts)
+    ct = _concat_tokens(texts)
+    if ct is None:  # non-ASCII rows present — caller partitions
+        return None
+    buf, offsets = ct
     out_buf = np.zeros(max(len(buf), 1), dtype=np.uint8)
     out_offsets = np.zeros(len(texts) + 1, dtype=np.int64)
     hist = np.zeros(256, dtype=np.int64)
     lib.tp_clean_tokenstats(
         buf, offsets, len(texts), out_buf, out_offsets, hist, hist.shape[0]
     )
-    raw = out_buf.tobytes()
+    # decode the cleaned buffer ONCE; per-row values are slices of it
+    raw = out_buf[: out_offsets[-1]].tobytes().decode("ascii")
     cleaned = [
-        raw[out_offsets[i]:out_offsets[i + 1]].decode("ascii")
+        raw[out_offsets[i]:out_offsets[i + 1]]
         for i in range(len(texts))
     ]
     return cleaned, hist

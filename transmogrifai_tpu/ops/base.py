@@ -25,10 +25,34 @@ def assemble_vector(
 ) -> VectorColumn:
     """Concatenate per-feature blocks [N, d_i] into one VectorColumn with
     flattened, reindexed metadata."""
+    from ..types.columns import SparseMatrix
+
     parts = [VectorMetadata(name, tuple(m)) for m in metas]
     metadata = VectorMetadata.flatten(name, parts)
-    if blocks:
-        values = np.concatenate([np.asarray(b, dtype=np.float32) for b in blocks], axis=1)
+    if any(isinstance(b, SparseMatrix) for b in blocks):
+        if len(blocks) == 1:
+            values = blocks[0]
+        else:
+            widths = [b.shape[1] for b in blocks]
+            values = SparseMatrix.hstack(
+                blocks, widths, blocks[0].shape[0]
+            )
+    elif len(blocks) == 1:
+        # single-buffer stages (e.g. SmartText) assemble in place — reuse
+        values = np.ascontiguousarray(blocks[0], dtype=np.float32)
+    elif blocks:
+        # one pass: dtype conversion happens during the copy into the
+        # preallocated output (np.concatenate of astype'd blocks pays an
+        # extra full-size temporary per block)
+        n = blocks[0].shape[0]
+        values = np.empty(
+            (n, sum(b.shape[1] for b in blocks)), dtype=np.float32
+        )
+        off = 0
+        for b in blocks:
+            w = b.shape[1]
+            values[:, off:off + w] = b
+            off += w
     else:
         values = np.zeros((0, 0), dtype=np.float32)
     assert values.shape[1] == metadata.size, (values.shape, metadata.size)

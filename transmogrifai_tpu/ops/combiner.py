@@ -23,21 +23,33 @@ class VectorsCombiner(Transformer):
         super().__init__("vecsCombine", uid=uid)
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        from ..types.columns import SparseMatrix
+
         vecs = []
         metas = []
+        any_sparse = False
         for c in cols:
             assert isinstance(c, VectorColumn), f"combine expects vectors, got {type(c)}"
-            vecs.append(np.asarray(c.values, dtype=np.float32))
+            any_sparse = any_sparse or c.is_sparse
+            vecs.append(c.values)
             metas.append(
                 c.metadata
                 if c.metadata is not None
                 else VectorMetadata("anon", ())
             )
-        values = (
-            np.concatenate(vecs, axis=1)
-            if vecs
-            else np.zeros((num_rows, 0), dtype=np.float32)
-        )
+        if any_sparse:
+            # sparse inputs stay sparse end-to-end: the combined vector is
+            # COO (dense sub-blocks carry their values via from_dense) —
+            # densification happens on device or on first dense touch
+            values = SparseMatrix.hstack(
+                vecs, [c.dim for c in cols], num_rows
+            )
+        elif vecs:
+            values = np.concatenate(
+                [np.asarray(v, dtype=np.float32) for v in vecs], axis=1
+            )
+        else:
+            values = np.zeros((num_rows, 0), dtype=np.float32)
         metadata = VectorMetadata.flatten(self.output_name, metas)
         if metadata.size != values.shape[1]:
             # tolerate missing metadata on inputs by padding unknown columns

@@ -410,34 +410,61 @@ class SmartTextMapModel(VectorizerModel):
     def blocks_for(self, cols: Sequence[Column], num_rows: int):
         blocks, metas = [], []
         slot = 0
+        nulls = 1 if self.track_nulls else 0
         for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
             rows = map_rows(col, self.clean_keys)
-            parts, metas_f = [], []
+            widths = []
             for ki, k in enumerate(self.keys[fi]):
+                method = self.methods[fi][ki]
+                if method == PIVOT:
+                    widths.append(len(self.vocabs[fi][ki]) + 1 + nulls)
+                elif method == HASH:
+                    widths.append(self.num_hashes + nulls)
+                else:
+                    widths.append(nulls)
+            # wide hash keys assemble SPARSE (see SmartTextModel.blocks_for)
+            if (
+                any(m == HASH for m in self.methods[fi])
+                and self.num_hashes >= 64
+            ):
+                sm = self._feature_sparse(
+                    fi, feat, rows, widths, num_rows, slot
+                )
+                if sm is not None:
+                    block, metas_f = sm
+                    slot += len(self.keys[fi])
+                    blocks.append(block)
+                    metas.append(metas_f)
+                    continue
+            # one float32 buffer per map feature; hash keys scatter into it
+            # via the native strided pass
+            out = np.zeros((num_rows, sum(widths)), dtype=np.float32)
+            metas_f: list[ColumnMeta] = []
+            off = 0
+            for ki, (k, width) in enumerate(zip(self.keys[fi], widths)):
                 method = self.methods[fi][ki]
                 values = [
                     None if m.get(k) is None else str(m.get(k)) for m in rows
                 ]
                 if method == PIVOT:
                     vocab = self.vocabs[fi][ki]
-                    parts.append(
-                        pivot_block(values, vocab, self.track_nulls,
-                                    self.clean_text, False)
+                    out[:, off:off + width] = pivot_block(
+                        values, vocab, self.track_nulls, self.clean_text,
+                        False,
                     )
                     metas_f.extend(
                         _pivot_key_metas(feat.name, feat.ftype, k, vocab,
                                          self.track_nulls)
                     )
                 elif method == HASH:
-                    parts.append(
-                        hash_block(
-                            values, self.num_hashes, slot, shared=False,
-                            binary_freq=DEFAULTS.BinaryFreq,
-                            to_lowercase=DEFAULTS.ToLowercase,
-                            min_token_length=DEFAULTS.MinTokenLength,
-                            seed=DEFAULTS.HashSeed,
-                            track_nulls=self.track_nulls,
-                        )
+                    hash_block(
+                        values, self.num_hashes, slot, shared=False,
+                        binary_freq=DEFAULTS.BinaryFreq,
+                        to_lowercase=DEFAULTS.ToLowercase,
+                        min_token_length=DEFAULTS.MinTokenLength,
+                        seed=DEFAULTS.HashSeed,
+                        track_nulls=self.track_nulls,
+                        out=out, col_offset=off,
                     )
                     metas_f.extend(
                         ColumnMeta((feat.name,), feat.ftype.__name__,
@@ -450,22 +477,86 @@ class SmartTextMapModel(VectorizerModel):
                                        grouping=k, indicator_value=NULL_STRING)
                         )
                 elif self.track_nulls:  # IGNORE
-                    null = np.array(
-                        [1.0 if v is None else 0.0 for v in values],
-                        dtype=np.float64,
-                    )[:, None]
-                    parts.append(null)
+                    for r, v in enumerate(values):
+                        if v is None:
+                            out[r, off] = 1.0
                     metas_f.append(
                         ColumnMeta((feat.name,), feat.ftype.__name__,
                                    grouping=k, indicator_value=NULL_STRING)
                     )
                 slot += 1
-            blocks.append(
-                np.concatenate(parts, axis=1)
-                if parts else np.zeros((num_rows, 0), dtype=np.float64)
-            )
+                off += width
+            blocks.append(out)
             metas.append(metas_f)
         return blocks, metas
+
+    def _feature_sparse(self, fi, feat, rows, widths, num_rows, slot0):
+        """Sparse assembly of one map feature; None → dense fallback."""
+        from ..types.columns import SparseMatrix
+        from .text import hash_block_sparse
+
+        blocks, metas_f, used_widths = [], [], []
+        slot = slot0
+        for ki, (k, width) in enumerate(zip(self.keys[fi], widths)):
+            method = self.methods[fi][ki]
+            if width == 0:
+                slot += 1
+                continue
+            used_widths.append(width)
+            values = [
+                None if m.get(k) is None else str(m.get(k)) for m in rows
+            ]
+            if method == PIVOT:
+                vocab = self.vocabs[fi][ki]
+                blocks.append(
+                    pivot_block(values, vocab, self.track_nulls,
+                                self.clean_text, False)
+                )
+                metas_f.extend(
+                    _pivot_key_metas(feat.name, feat.ftype, k, vocab,
+                                     self.track_nulls)
+                )
+            elif method == HASH:
+                sm = hash_block_sparse(
+                    values, self.num_hashes, slot, shared=False,
+                    binary_freq=DEFAULTS.BinaryFreq,
+                    to_lowercase=DEFAULTS.ToLowercase,
+                    min_token_length=DEFAULTS.MinTokenLength,
+                    seed=DEFAULTS.HashSeed,
+                    track_nulls=self.track_nulls,
+                )
+                if sm is None:
+                    return None
+                blocks.append(sm)
+                metas_f.extend(
+                    ColumnMeta((feat.name,), feat.ftype.__name__,
+                               grouping=k, descriptor_value=f"hash_{j}")
+                    for j in range(self.num_hashes)
+                )
+                if self.track_nulls:
+                    metas_f.append(
+                        ColumnMeta((feat.name,), feat.ftype.__name__,
+                                   grouping=k, indicator_value=NULL_STRING)
+                    )
+            else:  # IGNORE with track_nulls
+                nr = np.asarray(
+                    [r for r, v in enumerate(values) if v is None],
+                    dtype=np.int32,
+                )
+                blocks.append(
+                    SparseMatrix(
+                        nr, np.zeros(len(nr), dtype=np.int32),
+                        (num_rows, 1),
+                    )
+                )
+                metas_f.append(
+                    ColumnMeta((feat.name,), feat.ftype.__name__,
+                               grouping=k, indicator_value=NULL_STRING)
+                )
+            slot += 1
+        return (
+            SparseMatrix.hstack(blocks, used_widths, num_rows), metas_f
+        )
 
 
 class SmartTextMapVectorizer(VectorizerEstimator):

@@ -78,48 +78,67 @@ class TextStats:
 def batch_text_stats(
     values: Sequence, cardinality_cap: int, clean_text: bool
 ) -> TextStats:
-    """TextStats over a column of optional strings. ASCII rows ride ONE
-    native clean+tokenize pass (native/tptpu_native.cpp
-    tp_clean_tokenstats — the SmartText fit hot loop); non-ASCII rows keep
-    the exact-Unicode Python path. The capped value-count insertion runs
-    over cleaned values in the ORIGINAL row order, so results match the
-    sequential per-row loop exactly (the cap drops the same keys)."""
+    """TextStats over a column of optional strings. All non-null rows ride
+    ONE native clean+tokenize pass (native/tptpu_native.cpp
+    tp_clean_tokenstats — the SmartText fit hot loop; one bulk isascii
+    check); columns with non-ASCII content fall back to a per-row
+    partition keeping those rows on the exact-Unicode Python path.
+
+    The cardinality cap keeps the FIRST cap+1 distinct cleaned values in
+    row order with their full counts — ``Counter`` preserves
+    first-insertion order, so counting everything at C speed and slicing
+    the first cap+1 items reproduces the sequential capped-insertion loop
+    exactly."""
+    from itertools import islice
+
     from ..native import clean_tokenstats
     from ..utils.text import clean_string, tokenize
 
     stats = TextStats.empty(cardinality_cap)
-    strs: list[str | None] = [
-        None if v is None else (v if isinstance(v, str) else str(v))
-        for v in values
-    ]
-    ascii_idx = [i for i, s in enumerate(strs) if s is not None and s.isascii()]
-    res = clean_tokenstats([strs[i] for i in ascii_idx]) if ascii_idx else None
-    cleaned: list[str | None] = [None] * len(strs)
+    texts: list[str] = []
+    for v in values:
+        if v is not None:
+            texts.append(v if isinstance(v, str) else str(v))
+    if not texts:
+        return stats
+    res = clean_tokenstats(texts)
     if res is not None:
         native_cleaned, hist = res
-        for i, c in zip(ascii_idx, native_cleaned):
-            cleaned[i] = c if clean_text else strs[i]
+        cleaned = native_cleaned if clean_text else texts
         for length, count in enumerate(hist):
             if count:
                 stats.length_counts[length] += int(count)
-        slow = [
-            i for i, s in enumerate(strs)
-            if s is not None and not s.isascii()
-        ]
     else:
-        slow = [i for i, s in enumerate(strs) if s is not None]
-    for i in slow:
-        s = strs[i]
-        cleaned[i] = clean_string(s) if clean_text else s
-        for t in tokenize(s):
-            stats.length_counts[len(t)] += 1
-    for c in cleaned:
-        if c is not None:
-            if (
-                c in stats.value_counts
-                or len(stats.value_counts) <= cardinality_cap
-            ):
-                stats.value_counts[c] += 1
+        # mixed/non-ASCII column (or no native lib): per-row partition
+        cleaned = []
+        ascii_texts, ascii_pos = [], []
+        slow_pos = []
+        for i, s in enumerate(texts):
+            cleaned.append(None)
+            if s.isascii():
+                ascii_texts.append(s)
+                ascii_pos.append(i)
+            else:
+                slow_pos.append(i)
+        res2 = clean_tokenstats(ascii_texts) if ascii_texts else None
+        if res2 is not None:
+            nat, hist = res2
+            for i, c in zip(ascii_pos, nat):
+                cleaned[i] = c if clean_text else texts[i]
+            for length, count in enumerate(hist):
+                if count:
+                    stats.length_counts[length] += int(count)
+        else:
+            slow_pos = list(range(len(texts)))
+        for i in slow_pos:
+            s = texts[i]
+            cleaned[i] = clean_string(s) if clean_text else s
+            for t in tokenize(s):
+                stats.length_counts[len(t)] += 1
+    full = Counter(cleaned)
+    stats.value_counts.update(
+        dict(islice(full.items(), cardinality_cap + 1))
+    )
     return stats
 
 
@@ -154,6 +173,8 @@ def hash_block(
     min_token_length: int,
     seed: int,
     track_nulls: bool,
+    out: np.ndarray | None = None,
+    col_offset: int = 0,
 ) -> np.ndarray:
     """Feature-hash one text column into ``num_features`` buckets.
 
@@ -161,37 +182,63 @@ def hash_block(
     shared space every feature hashes into the same buckets (the caller then
     emits a single block). Always appends the null-indicator column when
     track_nulls (SmartTextVectorizer trackNulls semantics).
+
+    With ``out``/``col_offset`` the block lands directly in the caller's
+    float32 assembly buffer (the native scatter strides into it) — no
+    per-column temporary and no dtype copy downstream.
     """
     from ..native import murmur3_scatter, tokenize_hash_scatter
 
     n = len(values)
-    out = np.zeros((n, num_features + (1 if track_nulls else 0)), dtype=np.float32)
+    width = num_features + (1 if track_nulls else 0)
+    if out is None:
+        out = np.zeros((n, width), dtype=np.float32)
+        col_offset = 0
     prefix = f"{feature_slot}_" if shared else ""
+    null_col = col_offset + num_features
 
-    # fast path: whole ASCII rows go through the fused native
-    # tokenize+hash+scatter pass (one C call for the column); rows with
-    # non-ASCII content keep the exact-Unicode Python tokenizer
-    ascii_texts: list[str] = []
-    ascii_rows: list[int] = []
-    slow_rows: list[tuple[int, str]] = []
+    # fast path: ALL non-null rows in one fused native
+    # tokenize+hash+scatter call (one join + one encode + one C pass; the
+    # ASCII check is a single bulk isascii on the joined string). Only
+    # when the column holds non-ASCII content does the per-row partition
+    # run, keeping those rows on the exact-Unicode Python tokenizer.
+    texts: list[str] = []
+    rows_idx: list[int] = []
     for r, raw in enumerate(values):
         if raw is None:
             if track_nulls:
-                out[r, num_features] = 1.0
-        elif isinstance(raw, str) and raw.isascii():
-            ascii_texts.append(raw)
-            ascii_rows.append(r)
+                out[r, null_col] = 1.0
         else:
-            slow_rows.append((r, raw))
-    if ascii_texts:
+            texts.append(raw if isinstance(raw, str) else str(raw))
+            rows_idx.append(r)
+    slow_rows: list[tuple[int, str]] = []
+    if texts:
         ok = tokenize_hash_scatter(
-            ascii_texts, np.asarray(ascii_rows, dtype=np.int64),
+            texts, np.asarray(rows_idx, dtype=np.int64),
             num_features, out, seed=seed, binary=binary_freq,
             to_lowercase=to_lowercase, min_token_length=min_token_length,
-            prefix=prefix,
+            prefix=prefix, col_offset=col_offset,
         )
         if not ok:
-            slow_rows = [(r, v) for r, v in zip(ascii_rows, ascii_texts)] + slow_rows
+            # mixed/non-ASCII column (or no native lib): ASCII rows retry
+            # the native pass, the rest take the Python tokenizer
+            ascii_texts, ascii_rows = [], []
+            for r, v in zip(rows_idx, texts):
+                if v.isascii():
+                    ascii_texts.append(v)
+                    ascii_rows.append(r)
+                else:
+                    slow_rows.append((r, v))
+            if ascii_texts:
+                ok2 = tokenize_hash_scatter(
+                    ascii_texts, np.asarray(ascii_rows, dtype=np.int64),
+                    num_features, out, seed=seed, binary=binary_freq,
+                    to_lowercase=to_lowercase,
+                    min_token_length=min_token_length,
+                    prefix=prefix, col_offset=col_offset,
+                )
+                if not ok2:
+                    slow_rows = list(zip(ascii_rows, ascii_texts)) + slow_rows
     if slow_rows:
         tokens: list[str] = []
         rows: list[int] = []
@@ -206,8 +253,59 @@ def hash_block(
             murmur3_scatter(
                 tokens, np.asarray(rows, dtype=np.int64), n, num_features,
                 seed=seed, binary=binary_freq, out=out,
+                col_offset=col_offset,
             )
-    return out.astype(np.float64)
+    return out
+
+
+def hash_block_sparse(
+    values: list,
+    num_features: int,
+    feature_slot: int,
+    shared: bool,
+    binary_freq: bool,
+    to_lowercase: bool,
+    min_token_length: int,
+    seed: int,
+    track_nulls: bool,
+):
+    """Sparse (COO) variant of hash_block — identical nonzeros, ~50× fewer
+    bytes than the dense hash plane (SparseMatrix docstring). Returns None
+    when the native COO pass can't take the column (library missing or
+    non-ASCII rows) — caller falls back to the dense path."""
+    from ..native import tokenize_hash_coo
+    from ..types.columns import SparseMatrix
+
+    texts: list[str] = []
+    rows_idx: list[int] = []
+    none_rows: list[int] = []
+    for r, raw in enumerate(values):
+        if raw is None:
+            none_rows.append(r)
+        else:
+            texts.append(raw if isinstance(raw, str) else str(raw))
+            rows_idx.append(r)
+    prefix = f"{feature_slot}_" if shared else ""
+    if texts:
+        coo = tokenize_hash_coo(
+            texts, np.asarray(rows_idx, dtype=np.int64), num_features,
+            seed=seed, binary=binary_freq, to_lowercase=to_lowercase,
+            min_token_length=min_token_length, prefix=prefix,
+        )
+        if coo is None:
+            return None
+        rows, cols = coo
+    else:
+        rows = np.zeros(0, dtype=np.int32)
+        cols = np.zeros(0, dtype=np.int32)
+    width = num_features + (1 if track_nulls else 0)
+    if track_nulls and none_rows:
+        nr = np.asarray(none_rows, dtype=np.int32)
+        rows = np.concatenate([rows, nr])
+        cols = np.concatenate(
+            [cols, np.full(len(nr), num_features, dtype=np.int32)]
+        )
+    return SparseMatrix(rows, cols, (len(values), width))
 
 
 def hash_metas(
@@ -265,50 +363,133 @@ class SmartTextModel(VectorizerModel):
         }
 
     def blocks_for(self, cols: Sequence[Column], num_rows: int):
-        blocks, metas = [], []
-        for slot, (col, method, vocab, feat) in enumerate(
-            zip(cols, self.methods, self.vocabs, self.input_features)
+        nulls = 1 if self.track_nulls else 0
+        widths = []
+        for method, vocab in zip(self.methods, self.vocabs):
+            if method == PIVOT:
+                widths.append(len(vocab) + 1 + nulls)
+            elif method == HASH:
+                widths.append(self.num_hashes + nulls)
+            else:
+                widths.append(nulls)
+
+        # wide hash planes assemble SPARSE (COO from the native tokenize
+        # pass): at 512 buckets the dense block is ~99.8% zeros and its
+        # page-faulted writes dominate the whole text plane on
+        # memory-bandwidth-poor hosts. Pivot/null sub-blocks are narrow —
+        # they ride along via from_dense.
+        if any(m == HASH for m in self.methods) and self.num_hashes >= 64:
+            sparse = self._blocks_sparse(cols, num_rows, widths, nulls)
+            if sparse is not None:
+                return sparse
+
+        # dense fallback: one float32 assembly buffer for the whole stage;
+        # hash blocks scatter straight into it via the native strided pass
+        out = np.zeros((num_rows, sum(widths)), dtype=np.float32)
+        metas_flat: list[ColumnMeta] = []
+        off = 0
+        for slot, (col, method, vocab, feat, width) in enumerate(
+            zip(cols, self.methods, self.vocabs, self.input_features, widths)
         ):
             values = col.to_list()
             if method == PIVOT:
-                blocks.append(
-                    pivot_block(values, vocab, self.track_nulls, self.clean_text, False)
+                out[:, off:off + width] = pivot_block(
+                    values, vocab, self.track_nulls, self.clean_text, False
                 )
-                metas.append(pivot_metas(feat.name, feat.ftype, vocab, self.track_nulls))
+                metas_flat.extend(
+                    pivot_metas(feat.name, feat.ftype, vocab, self.track_nulls)
+                )
             elif method == HASH:
-                blocks.append(
-                    hash_block(
-                        values,
-                        self.num_hashes,
-                        slot,
-                        shared=False,
-                        binary_freq=self.binary_freq,
-                        to_lowercase=self.to_lowercase,
-                        min_token_length=self.min_token_length,
-                        seed=self.seed,
-                        track_nulls=self.track_nulls,
-                    )
+                hash_block(
+                    values,
+                    self.num_hashes,
+                    slot,
+                    shared=False,
+                    binary_freq=self.binary_freq,
+                    to_lowercase=self.to_lowercase,
+                    min_token_length=self.min_token_length,
+                    seed=self.seed,
+                    track_nulls=self.track_nulls,
+                    out=out,
+                    col_offset=off,
                 )
-                metas.append(
+                metas_flat.extend(
                     hash_metas(feat.name, feat.ftype, self.num_hashes, self.track_nulls)
                 )
-            else:  # IGNORE: null tracking only
-                if self.track_nulls:
-                    null = np.array(
-                        [1.0 if v is None else 0.0 for v in values], dtype=np.float64
-                    )[:, None]
-                    blocks.append(null)
-                    metas.append(
-                        [
-                            ColumnMeta(
-                                (feat.name,),
-                                feat.ftype.__name__,
-                                grouping=feat.name,
-                                indicator_value=NULL_STRING,
-                            )
-                        ]
+            elif self.track_nulls:  # IGNORE: null tracking only
+                for r, v in enumerate(values):
+                    if v is None:
+                        out[r, off] = 1.0
+                metas_flat.append(
+                    ColumnMeta(
+                        (feat.name,),
+                        feat.ftype.__name__,
+                        grouping=feat.name,
+                        indicator_value=NULL_STRING,
                     )
-        return blocks, metas
+                )
+            off += width
+        return [out], [metas_flat]
+
+    def _blocks_sparse(self, cols, num_rows, widths, nulls):
+        """Sparse assembly of the whole stage; None → dense fallback."""
+        from ..types.columns import SparseMatrix
+
+        blocks, metas_flat, used_widths = [], [], []
+        for slot, (col, method, vocab, feat, width) in enumerate(
+            zip(cols, self.methods, self.vocabs, self.input_features, widths)
+        ):
+            if width == 0:
+                continue
+            used_widths.append(width)
+            values = col.to_list()
+            if method == PIVOT:
+                blocks.append(
+                    pivot_block(
+                        values, vocab, self.track_nulls, self.clean_text,
+                        False,
+                    )
+                )
+                metas_flat.extend(
+                    pivot_metas(feat.name, feat.ftype, vocab, self.track_nulls)
+                )
+            elif method == HASH:
+                sm = hash_block_sparse(
+                    values, self.num_hashes, slot, shared=False,
+                    binary_freq=self.binary_freq,
+                    to_lowercase=self.to_lowercase,
+                    min_token_length=self.min_token_length,
+                    seed=self.seed, track_nulls=self.track_nulls,
+                )
+                if sm is None:
+                    return None
+                blocks.append(sm)
+                metas_flat.extend(
+                    hash_metas(
+                        feat.name, feat.ftype, self.num_hashes,
+                        self.track_nulls,
+                    )
+                )
+            else:  # IGNORE: null tracking only (width > 0 ⇒ track_nulls)
+                nr = np.asarray(
+                    [r for r, v in enumerate(values) if v is None],
+                    dtype=np.int32,
+                )
+                blocks.append(
+                    SparseMatrix(
+                        nr, np.zeros(len(nr), dtype=np.int32), (num_rows, 1)
+                    )
+                )
+                metas_flat.append(
+                    ColumnMeta(
+                        (feat.name,), feat.ftype.__name__,
+                        grouping=feat.name, indicator_value=NULL_STRING,
+                    )
+                )
+        return (
+            [SparseMatrix.hstack(blocks, used_widths, num_rows)],
+            [metas_flat],
+        )
 
 
 class SmartTextVectorizer(VectorizerEstimator):
