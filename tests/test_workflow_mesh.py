@@ -90,3 +90,188 @@ def test_selector_output_identical_sharded_vs_not():
         rtol=1e-4,
     )
     np.testing.assert_allclose(probs_single, probs_mesh, rtol=1e-3, atol=1e-5)
+
+
+def _needs_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    return make_mesh(n_data=8, n_model=1)
+
+
+def _strip_uids(s: str) -> str:
+    """Names embed stage uids from a process-global counter, so two builds
+    in one process never share them — strip for A/B comparison."""
+    import re
+
+    return re.sub(r"_[0-9a-f]{12}", "", str(s))
+
+
+@pytest.mark.skipif(not os.path.exists(TITANIC), reason="no titanic data")
+def test_rff_and_sanity_drop_decisions_mesh_parity():
+    """RawFeatureFilter + SanityChecker INSIDE a workflow: the blocklist,
+    the sanity-dropped columns, and the final holdout metric must be
+    identical sharded vs not (the drop rules consume monoid-reduced stats,
+    which are shard-order-invariant)."""
+    mesh = _needs_mesh()
+
+    def build(mesh_arg):
+        ds = infer_csv_dataset(TITANIC)
+        resp, preds = from_dataset(ds, response="Survived")
+        preds = [p for p in preds if p.name != "PassengerId"]
+        vector = transmogrify(preds)
+        checked = resp.transform_with(
+            SanityChecker(remove_bad_features=True), vector
+        )
+        selector = BinaryClassificationModelSelector(
+            seed=7,
+            models=[(LogisticRegression(), {"reg_param": [0.1]})],
+        )
+        pred = selector.set_input(resp, checked).get_output()
+        wf = (
+            Workflow()
+            .set_result_features(pred)
+            .set_input_dataset(ds)
+            .set_parallelism(mesh_arg)
+            .with_raw_feature_filter(min_fill=0.05)
+        )
+        model = wf.train()
+        summary = model.summary_json()
+        blocklist = sorted(
+            _strip_uids(b) for b in summary.get("blocklistedFeatures", [])
+        )
+        sanity_meta = next(
+            (
+                s.metadata
+                for s in model.fitted.values()
+                if type(s).__name__.startswith("SanityChecker")
+            ),
+            {},
+        )
+        dropped = sorted(
+            _strip_uids(c) for c in sanity_meta.get("droppedColumns", [])
+        )
+        return blocklist, dropped, summary["modelSelectorSummary"]
+
+    bl1, dr1, s1 = build(None)
+    bl8, dr8, s8 = build(mesh)
+    assert bl1 == bl8
+    assert dr1 == dr8
+    np.testing.assert_allclose(
+        s1["holdoutEvaluation"]["AuPR"], s8["holdoutEvaluation"]["AuPR"],
+        rtol=1e-3,
+    )
+
+
+IRIS = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data"
+
+
+@pytest.mark.skipif(not os.path.exists(IRIS), reason="no iris data")
+def test_multiclass_selector_mesh_parity():
+    """Multiclass selector (iris): same winner + fold metrics within 1e-3
+    sharded vs not."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.models.gbdt import RandomForestClassifier
+    from transmogrifai_tpu.selector import MultiClassificationModelSelector
+
+    mesh = _needs_mesh()
+    headers = ["sepalLength", "sepalWidth", "petalLength", "petalWidth",
+               "irisClass"]
+
+    def build(mesh_arg):
+        ds = infer_csv_dataset(IRIS, headers=headers, has_header=False)
+        label_text, preds = from_dataset(
+            ds, response="irisClass", response_type=T.PickList
+        )
+        label = label_text.string_indexed()
+        vector = transmogrify(preds)
+        selector = MultiClassificationModelSelector(
+            seed=11,
+            models=[
+                (LogisticRegression(), {"reg_param": [0.01, 0.1]}),
+                (
+                    RandomForestClassifier(num_trees=10),
+                    {"max_depth": [3]},
+                ),
+            ],
+        )
+        pred = selector.set_input(label, vector).get_output()
+        model = (
+            Workflow()
+            .set_result_features(pred)
+            .set_input_dataset(ds)
+            .set_parallelism(mesh_arg)
+            .train()
+        )
+        return model.summary_json()["modelSelectorSummary"]
+
+    s1 = build(None)
+    s8 = build(mesh)
+    assert _strip_uids(s1["bestModelName"]) == _strip_uids(s8["bestModelName"])
+    for r1, r8 in zip(s1["validationResults"], s8["validationResults"]):
+        assert r1["modelName"] == r8["modelName"] and r1["grid"] == r8["grid"]
+        np.testing.assert_allclose(
+            r1["metricValues"], r8["metricValues"], rtol=1e-3, atol=1e-3
+        )
+    np.testing.assert_allclose(
+        s1["holdoutEvaluation"]["F1"], s8["holdoutEvaluation"]["F1"],
+        rtol=1e-3,
+    )
+
+
+def test_mlp_fit_mesh_parity():
+    """MLP full-batch training sharded over the data axis must match the
+    single-device fit: identical seed/init, gradients psum over shards —
+    only float reassociation differs."""
+    from transmogrifai_tpu.models.mlp import MLPClassifier
+    from transmogrifai_tpu.parallel.mesh import use_execution_mesh
+
+    mesh = _needs_mesh()
+    rng = np.random.default_rng(3)
+    n = 400
+    x = rng.normal(size=(n, 12)).astype(np.float32)
+    w = rng.normal(size=12)
+    y = (x @ w > 0).astype(np.float64)
+    mask = np.ones(n, dtype=np.float32)
+
+    est = MLPClassifier(hidden_layers=(16,), max_iter=60, seed=5)
+    with use_execution_mesh(None):
+        m1 = est.fit_arrays(x, y, mask)
+    with use_execution_mesh(mesh):
+        m8 = est.fit_arrays(x, y, mask)
+    p1, prob1, _ = m1.predict_arrays(x)
+    p8, prob8, _ = m8.predict_arrays(x)
+    np.testing.assert_allclose(prob1, prob8, rtol=1e-3, atol=1e-4)
+    assert (p1 == p8).mean() > 0.995
+
+
+@pytest.mark.skipif(not os.path.exists(TITANIC), reason="no titanic data")
+def test_scoring_path_mesh_parity():
+    """A model trained single-device must score identically with and
+    without the mesh installed (the scoring path's transforms are
+    row-local; sharding only changes data placement)."""
+    mesh = _needs_mesh()
+    ds = infer_csv_dataset(TITANIC)
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(
+        SanityChecker(remove_bad_features=True), vector
+    )
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(XGBoostClassifier(num_round=8), {"max_depth": [3]})]
+    )
+    pred = selector.set_input(resp, checked).get_output()
+    model = (
+        Workflow()
+        .set_result_features(pred)
+        .set_input_dataset(ds)
+        .set_parallelism(None)
+        .train()
+    )
+    from transmogrifai_tpu.parallel.mesh import use_execution_mesh
+
+    with use_execution_mesh(None):
+        probs_single = np.asarray(model.score(dataset=ds)[pred.name].probability)
+    with use_execution_mesh(mesh):
+        probs_mesh = np.asarray(model.score(dataset=ds)[pred.name].probability)
+    np.testing.assert_allclose(probs_single, probs_mesh, rtol=1e-5, atol=1e-7)

@@ -148,12 +148,26 @@ class MLPClassifier(PredictorEstimator):
         }
 
     def fit_arrays(self, x, y, row_mask):
+        from ..parallel.mesh import data_row_multiple, shard_rows_if_active
+
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         sizes = (x.shape[1], *self.hidden_layers, num_classes)
+        # join the row-partitioned substrate (SURVEY §2.6): rows shard over
+        # the ambient mesh's data axis; GSPMD propagates the sharding
+        # through the scan body and psums the gradients over ICI. Mask-0
+        # padding rows are inert (loss is mask-weighted, n = mask.sum()).
+        x = np.asarray(x, dtype=np.float32)
+        y = np.asarray(y, dtype=np.float32)
+        row_mask = np.asarray(row_mask, dtype=np.float32)
+        pad = (-x.shape[0]) % data_row_multiple()
+        if pad:
+            x = np.pad(x, ((0, pad), (0, 0)))
+            y = np.pad(y, (0, pad))
+            row_mask = np.pad(row_mask, (0, pad))
         y1h = jax.nn.one_hot(y.astype(np.int32), num_classes, dtype=jnp.float32)
         params, losses = _train_mlp(
-            jnp.asarray(x, dtype=jnp.float32),
+            shard_rows_if_active(x),
             y1h,
             jnp.asarray(row_mask, dtype=jnp.float32),
             sizes,

@@ -38,11 +38,21 @@ def _load() -> ctypes.CDLL | None:
         if os.environ.get("TPTPU_DISABLE_NATIVE"):
             return None
         try:
-            if not os.path.exists(_SO_PATH):
+            src = os.path.join(_NATIVE_DIR, "tptpu_native.cpp")
+            stale = (
+                os.path.exists(_SO_PATH)
+                and os.path.exists(src)
+                and os.path.getmtime(_SO_PATH) < os.path.getmtime(src)
+            )
+            if not os.path.exists(_SO_PATH) or stale:
                 if not os.path.isdir(_NATIVE_DIR):
                     return None
+                # rebuild on stale too: loading an older .so against newer
+                # bindings is an in-place ABI mismatch (silently wrong
+                # columns, not an error)
                 subprocess.run(
-                    ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                    ["make", "-s", "-B"] if stale else ["make", "-s"],
+                    cwd=_NATIVE_DIR, check=True,
                     capture_output=True, timeout=120,
                 )
             lib = ctypes.CDLL(_SO_PATH)
@@ -188,6 +198,11 @@ def murmur3_scatter(
     rows = np.ascontiguousarray(rows, dtype=np.int64)
     if (
         lib is not None
+        # ABI guard: tp_murmur3_scatter gained col_offset in the same
+        # commit as tp_tokenize_hash_coo — a stale cached .so without that
+        # symbol has the old 9-arg scatter, which would silently ignore
+        # the offset and corrupt the first block of a shared buffer
+        and hasattr(lib, "tp_tokenize_hash_coo")
         and out.flags["C_CONTIGUOUS"]
         and out.dtype == np.float32
     ):

@@ -73,7 +73,9 @@ class SparseMatrix:
 
     def __array__(self, dtype=None, copy=None):
         out = self.toarray()
-        return out.astype(dtype) if dtype is not None else out
+        # copy=False returns the cached plane when the dtype matches —
+        # np.asarray(values, dtype=np.float32) is the hot consumer pattern
+        return out.astype(dtype, copy=False) if dtype is not None else out
 
     def astype(self, dtype, copy: bool = True):
         return self.toarray().astype(dtype, copy=copy)
@@ -90,6 +92,10 @@ class SparseMatrix:
             indices = np.nonzero(indices)[0]
         n = self.shape[0]
         src = np.where(indices < 0, indices + n, indices).astype(np.int64)
+        if src.size and (src.min() < 0 or src.max() >= n):
+            raise IndexError(
+                f"take_rows indices out of range for {n} rows"
+            )
         # CSR-style gather: group pairs by source row, then expand each
         # output position's row-range (an inverse-remap scatter keeps only
         # ONE output position per source row and silently zeroes duplicate
