@@ -304,18 +304,31 @@ def bench_wide_mlp(
 def main() -> None:
     import sys
 
-    if len(sys.argv) > 1 and sys.argv[1] == "scale":
-        scale = bench_boosted_scale()
+    scale_configs = {
+        # metric suffix: (rows, feats, rounds, depth, bins)
+        "scale": (1_000_000, 64, 20, 6, 32),
+        "scale256": (500_000, 64, 10, 6, 256),   # >128-bin kernel path
+        "scalewide": (1_000_000, 500, 10, 6, 32),  # BASELINE.json config-5 shape
+    }
+    if len(sys.argv) > 1 and sys.argv[1] in scale_configs:
+        rows, feats, rounds, depth, bins = scale_configs[sys.argv[1]]
+        scale = bench_boosted_scale(
+            n_rows=rows, n_feats=feats, num_rounds=rounds,
+            max_depth=depth, num_bins=bins,
+        )
         print(
             json.dumps(
                 {
-                    "metric": "boosted_trees_1m_x_64_train_wallclock",
+                    "metric": f"boosted_trees_{sys.argv[1]}_train_wallclock",
                     "value": round(scale["train_s"], 3),
                     "unit": "s",
                     "vs_baseline": 0.0,
                     "rows_x_rounds_per_sec": round(scale["rows_x_rounds_per_sec"]),
                     "train_accuracy": round(scale["train_accuracy"], 4),
-                    "config": "1M rows x 64 feats, 20 rounds depth 6, 32 bins",
+                    "config": (
+                        f"{rows} rows x {feats} feats, {rounds} rounds "
+                        f"depth {depth}, {bins} bins"
+                    ),
                 }
             )
         )

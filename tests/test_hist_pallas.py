@@ -29,6 +29,15 @@ class TestHistogramKernel:
         ref = build_histogram_scatter(binned, node, g, h, m, b)
         np.testing.assert_allclose(np.asarray(a), np.asarray(ref), atol=2e-4)
 
+    def test_parity_with_scatter_256_bins(self):
+        """>128 bins: the bin axis spans multiple 128-lane groups — the
+        kernel must keep parity (the round-2 fallback cliff shape)."""
+        binned, node, g, h, _, m = self._data(n=300, f=3, b=256)
+        a = build_histogram_pallas(binned, node, g, h, m, 256, row_tile=256,
+                                   interpret=True)
+        ref = build_histogram_scatter(binned, node, g, h, m, 256)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(ref), atol=2e-4)
+
     def test_dead_rows_do_not_contribute(self):
         binned, node, g, h, b, m = self._data()
         dead = jnp.full_like(node, -1)

@@ -1,0 +1,154 @@
+"""Phone parsing/validation fixture agreement.
+
+Fixtures are the reference's own PhoneNumberParserTest vectors
+(core/src/test/.../PhoneNumberParserTest.scala) — parse/validate answers,
+cleanNumber over printable ASCII, and the validCountryCode
+Jaccard-closest-country cases — plus region-rule spot checks against
+libphonenumber's documented metadata.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.phone import (
+    DEFAULT_COUNTRY_CODES,
+    INTERNATIONAL_CODE,
+    IsValidPhoneDefaultCountry,
+    IsValidPhoneMapDefaultCountry,
+    IsValidPhoneNumber,
+    ParsePhoneDefaultCountry,
+    ParsePhoneNumber,
+    clean_number,
+    parse_phone,
+    valid_country_code,
+    validate_phone,
+)
+from transmogrifai_tpu.types import BinaryMap, Phone, PhoneMap, Text
+from transmogrifai_tpu.types.columns import MapColumn, TextColumn, column_from_values
+
+_CODES = [c.upper() for c in DEFAULT_COUNTRY_CODES]
+_NAMES = [DEFAULT_COUNTRY_CODES[c].upper() for c in DEFAULT_COUNTRY_CODES]
+
+# PhoneNumberParserTest.scala reference vectors
+PNS = ["+15105556666", "510 555 6666", "+1+3456", "+1510334455667788", None]
+ANSWER_PARSE = ["+15105556666", "+15105556666", None, "+15103344556", None]
+ANSWER_VALID = [True, True, None, True, None]
+
+
+def test_clean_number_printable_ascii():
+    all_ascii = "".join(chr(c) for c in range(32, 127))
+    assert clean_number(all_ascii) == "+0123456789"
+
+
+def test_parse_reference_vectors():
+    got = [parse_phone(p, "US") for p in PNS]
+    # "+1+3456" parse: reference raises inside Try → None
+    assert got == ANSWER_PARSE
+
+
+def test_validate_reference_vectors():
+    got = [validate_phone(p, "US") for p in PNS]
+    assert got == ANSWER_VALID
+
+
+def test_validate_short_and_empty():
+    assert validate_phone("1", "US") is None      # < 2 chars
+    assert validate_phone("ab", "US") is False    # no digits
+    assert validate_phone(None, "US") is None
+
+
+def test_international_code_constant():
+    assert INTERNATIONAL_CODE == "ZZ"
+
+
+def test_valid_country_code_explicit_supported_region():
+    # an explicit SUPPORTED region outside the configured list is honored
+    assert valid_country_code("", "AF", "US", _CODES, _NAMES) == "AF"
+
+
+def test_valid_country_code_not_found_falls_to_default():
+    assert valid_country_code("", "FooBar", "US", (), ()) == "US"
+
+
+def test_valid_country_code_closest_name_match():
+    countries = ["uS", "United St America", "States of America", "Grece",
+                 "Switzland", "USA"]
+    got = [
+        valid_country_code("", c, "US", _CODES, _NAMES) for c in countries
+    ]
+    assert got == ["US", "US", "US", "GR", "CH", "US"]
+
+
+def test_valid_country_code_international_overrides():
+    assert (
+        valid_country_code("+1234566", "CN", "US", _CODES, _NAMES)
+        == INTERNATIONAL_CODE
+    )
+
+
+def test_valid_country_code_user_mapping():
+    codes = ["US", "CA", "ZW"]
+    names = ["UNITED STATES", "CANADA", "ZIMBABWE"]
+    cases = ["uS", "CD", "United", "Zimbwe", "USA"]
+    got = [valid_country_code("", c, "US", codes, names) for c in cases]
+    assert got == ["US", "CD", "US", "ZW", "US"]
+
+
+def test_region_rules_spot_checks():
+    # libphonenumber-documented validity facts
+    assert validate_phone("5105556666", "US") is True
+    assert validate_phone("15105556666", "US") is True    # own cc prefix
+    assert validate_phone("1234567890", "US") is False    # area code 1xx
+    assert validate_phone("0612345678", "US") is False    # area code 0xx
+    assert validate_phone("+4915123456789", "DE") is True   # DE mobile, 11
+    assert validate_phone("+33612345678", "FR") is True     # FR, 9 national
+    assert validate_phone("+3361234567", "FR") is False     # FR, 8 national
+    assert validate_phone("+919876543210", "IN") is True    # IN mobile
+    assert validate_phone("+911234543210", "IN") is False   # IN must start 6-9
+    assert validate_phone("+6591234567", "SG") is True      # SG 8 digits
+    assert validate_phone("+659123456", "SG") is False
+
+
+def test_truncate_too_long_non_strict_vs_strict():
+    long_num = "+1510334455667788"
+    assert parse_phone(long_num, "US", strict=False) == "+15103344556"
+    assert parse_phone(long_num, "US", strict=True) is None
+    assert validate_phone(long_num, "US", strict=True) is False
+
+
+def test_parse_phone_default_country_transformer():
+    col = column_from_values(Phone, PNS)
+    out = ParsePhoneDefaultCountry().transform_columns(
+        col, num_rows=len(PNS)
+    )
+    assert list(out.values) == ANSWER_PARSE
+
+
+def test_is_valid_phone_transformer_with_region_column():
+    phones = column_from_values(Phone, ["510 555 6666", "+15105556666"])
+    regions = column_from_values(Text, ["United St America", "CN"])
+    stage = IsValidPhoneNumber()
+    out = stage.transform_columns(phones, regions, num_rows=2)
+    assert out.to_list() == [True, True]
+
+
+def test_is_valid_phone_map_transformer():
+    maps = MapColumn(
+        PhoneMap,
+        [
+            {"home": "5105556666", "bad": "12", "none": None},
+            {},
+        ],
+    )
+    stage = IsValidPhoneMapDefaultCountry()
+    out = stage.transform_columns(maps, num_rows=2)
+    rows = out.to_list()
+    # 'bad' parses but is invalid → False kept; None (unparseable) drops
+    # (reference collects only SomeValue results)
+    assert rows[0] == {"home": True, "bad": False}
+    assert rows[1] == {}
+    assert out.feature_type is BinaryMap
+
+
+def test_set_codes_and_countries_rejects_garbage():
+    with pytest.raises(ValueError):
+        ParsePhoneNumber().set_codes_and_countries({"foo": "bar"})

@@ -1,0 +1,43 @@
+"""Probe the large-shape tree-growth paths on the real chip:
+256 bins at 500k x 64, and 1M x 500 at 32 bins."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from transmogrifai_tpu.models import trees as TR  # noqa: E402
+
+which = sys.argv[1] if len(sys.argv) > 1 else "bins256"
+
+
+def run(n_rows, n_feats, num_bins, rounds=3, depth=6):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(k1, (n_rows, n_feats), dtype=jnp.float32)
+    w = jax.random.normal(k2, (n_feats,), dtype=jnp.float32)
+    y = (x @ w + jax.random.normal(k3, (n_rows,)) > 0).astype(jnp.float32)
+    thr = TR.quantile_thresholds(np.asarray(x[:100_000]), max_bins=num_bins)
+    binned = TR.bin_data(x, jnp.asarray(thr))
+    mask = jnp.ones((1, n_rows), dtype=jnp.float32)
+    np.asarray(jnp.sum(binned))
+    t0 = time.perf_counter()
+    trees, margin = TR.fit_boosted_batched(
+        binned, y, mask, num_rounds=rounds, max_depth=depth,
+        num_bins=num_bins, eta=0.3, objective="binary:logistic",
+    )
+    np.asarray(jnp.sum(margin))
+    dt = time.perf_counter() - t0
+    acc = float(((margin[0] > 0) == (y > 0.5)).mean())
+    print(f"{n_rows}x{n_feats} bins={num_bins} rounds={rounds}: "
+          f"{dt:.2f}s acc={acc:.4f}")
+
+
+if which == "bins256":
+    run(500_000, 64, 256)
+elif which == "wide":
+    run(1_000_000, 500, 32)

@@ -148,7 +148,11 @@ class MLPClassifier(PredictorEstimator):
         }
 
     def fit_arrays(self, x, y, row_mask):
-        from ..parallel.mesh import data_row_multiple, shard_rows_if_active
+        from ..parallel.mesh import (
+            data_row_multiple,
+            pad_rows,
+            shard_rows_if_active,
+        )
 
         present = y[row_mask > 0]
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
@@ -157,14 +161,10 @@ class MLPClassifier(PredictorEstimator):
         # the ambient mesh's data axis; GSPMD propagates the sharding
         # through the scan body and psums the gradients over ICI. Mask-0
         # padding rows are inert (loss is mask-weighted, n = mask.sum()).
-        x = np.asarray(x, dtype=np.float32)
-        y = np.asarray(y, dtype=np.float32)
-        row_mask = np.asarray(row_mask, dtype=np.float32)
-        pad = (-x.shape[0]) % data_row_multiple()
-        if pad:
-            x = np.pad(x, ((0, pad), (0, 0)))
-            y = np.pad(y, (0, pad))
-            row_mask = np.pad(row_mask, (0, pad))
+        mult = data_row_multiple()
+        x, _ = pad_rows(np.asarray(x, dtype=np.float32), mult)
+        y, _ = pad_rows(np.asarray(y, dtype=np.float32), mult)
+        row_mask, _ = pad_rows(np.asarray(row_mask, dtype=np.float32), mult)
         y1h = jax.nn.one_hot(y.astype(np.int32), num_classes, dtype=jnp.float32)
         params, losses = _train_mlp(
             shard_rows_if_active(x),

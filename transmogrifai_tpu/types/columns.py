@@ -73,9 +73,12 @@ class SparseMatrix:
 
     def __array__(self, dtype=None, copy=None):
         out = self.toarray()
-        # copy=False returns the cached plane when the dtype matches —
-        # np.asarray(values, dtype=np.float32) is the hot consumer pattern
-        return out.astype(dtype, copy=False) if dtype is not None else out
+        if dtype is not None and np.dtype(dtype) != out.dtype:
+            return out.astype(dtype)
+        # matching dtype: hand back the cached plane unless the protocol
+        # explicitly demanded a copy (np.array(..., copy=True)) — mutating
+        # consumers must not corrupt the cache
+        return out.copy() if copy else out
 
     def astype(self, dtype, copy: bool = True):
         return self.toarray().astype(dtype, copy=copy)
