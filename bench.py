@@ -280,10 +280,16 @@ def bench_wide_mlp(
         # 1e-3 reaches ~0.99 train accuracy (bf16 == f32 loss to 1e-5)
         step_size=1e-3,
     )
-    t0 = time.perf_counter()
-    model = est.fit_arrays(x, y, mask)
-    jax.block_until_ready(jax.tree.leaves(model.get_arrays()))
-    train_s = time.perf_counter() - t0
+    # steady-state protocol: the first fit pays per-process tracing (and a
+    # one-time compile when the persistent cache is cold); the reported
+    # number is the second fit — chip throughput, not process startup
+    for _ in range(2):
+        t0 = time.perf_counter()
+        model = est.fit_arrays(x, y, mask)
+        # fence on the device-resident params (get_arrays would add a host
+        # download of every weight to the measured region)
+        jax.block_until_ready(jax.tree.leaves(model.params))
+        train_s = time.perf_counter() - t0
     pred, _, _ = model.predict_arrays(np.asarray(x[:10_000]))
     acc = float((pred == np.asarray(y[:10_000])).mean())
     # fwd+bwd matmul FLOPs: 2*N*din*dout per layer forward, x3 for backward

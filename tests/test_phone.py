@@ -52,7 +52,7 @@ def test_validate_reference_vectors():
 
 def test_validate_short_and_empty():
     assert validate_phone("1", "US") is None      # < 2 chars
-    assert validate_phone("ab", "US") is False    # no digits
+    assert validate_phone("ab", "US") is None     # NOT_A_NUMBER → None
     assert validate_phone(None, "US") is None
 
 

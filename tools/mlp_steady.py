@@ -1,0 +1,45 @@
+"""Steady-state wide-MLP fit timing (second fit in-process)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from transmogrifai_tpu.models.mlp import MLPClassifier, _train_mlp  # noqa: E402
+
+n_rows, n_feats, hidden = 250_000, 512, (2048, 2048)
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+x = jax.random.normal(k1, (n_rows, n_feats), dtype=jnp.float32)
+w = jax.random.normal(k2, (n_feats,), dtype=jnp.float32)
+y = (x @ w + jax.random.normal(k3, (n_rows,)) > 0).astype(jnp.float32)
+mask = jnp.ones(n_rows, dtype=jnp.float32)
+np.asarray(jnp.sum(x))
+
+sizes = (n_feats, *hidden, 2)
+flops100 = sum(6 * n_rows * a * b for a, b in zip(sizes[:-1], sizes[1:])) * 100
+
+est = MLPClassifier(hidden_layers=hidden, max_iter=100,
+                    compute_dtype="bfloat16", step_size=1e-3)
+for label in ("first", "second", "third"):
+    t0 = time.perf_counter()
+    m = est.fit_arrays(x, y, mask)
+    jax.block_until_ready(jax.tree.leaves(m.get_arrays()))
+    dt = time.perf_counter() - t0
+    print(f"{label} fit: {dt:6.2f}s  {flops100/dt/1e12:6.1f} TFLOP/s")
+
+# raw step: time the jitted train only (no model wrap / downloads)
+y1h = jax.nn.one_hot(y.astype(jnp.int32), 2, dtype=jnp.float32)
+params, losses = _train_mlp(x, y1h, mask, sizes, 100, 1e-3, 42,
+                            compute_dtype="bfloat16")
+np.asarray(losses[-1])
+t0 = time.perf_counter()
+params, losses = _train_mlp(x, y1h, mask, sizes, 100, 1e-3, 42,
+                            compute_dtype="bfloat16")
+np.asarray(losses[-1])
+dt = time.perf_counter() - t0
+print(f"raw _train_mlp: {dt:6.2f}s  {flops100/dt/1e12:6.1f} TFLOP/s")

@@ -1,0 +1,41 @@
+"""Trace the wide-MLP bench step to find the MFU gap."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from transmogrifai_tpu.models.mlp import MLPClassifier  # noqa: E402
+
+n_rows, n_feats, hidden = 250_000, 512, (2048, 2048)
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+x = jax.random.normal(k1, (n_rows, n_feats), dtype=jnp.float32)
+w = jax.random.normal(k2, (n_feats,), dtype=jnp.float32)
+y = (x @ w + jax.random.normal(k3, (n_rows,)) > 0).astype(jnp.float32)
+mask = jnp.ones(n_rows, dtype=jnp.float32)
+np.asarray(jnp.sum(x))
+
+est = MLPClassifier(hidden_layers=hidden, max_iter=10,
+                    compute_dtype="bfloat16", step_size=1e-3)
+est.fit_arrays(np.asarray(x[:1000]), np.asarray(y[:1000]), np.ones(1000, np.float32))  # warm small
+
+import time
+# warm the big shape
+t0 = time.perf_counter()
+m = est.fit_arrays(x, y, mask)
+np.asarray(jax.tree.leaves(m.params)[0])
+print(f"warm fit (10 iters): {time.perf_counter()-t0:.2f}s")
+
+t0 = time.perf_counter()
+jax.profiler.start_trace("/tmp/mlptrace")
+m = est.fit_arrays(x, y, mask)
+np.asarray(jax.tree.leaves(m.params)[0])
+jax.profiler.stop_trace()
+dt = time.perf_counter() - t0
+sizes = (n_feats, *hidden, 2)
+flops = sum(6 * n_rows * a * b for a, b in zip(sizes[:-1], sizes[1:])) * 10
+print(f"traced fit: {dt:.2f}s  {flops/dt/1e12:.1f} TFLOP/s")

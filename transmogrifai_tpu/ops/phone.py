@@ -232,8 +232,7 @@ def parse_phone(
         if m is None:
             return None
         cc, national = m
-        rules = [r for rs in ([_CC_RULES.get(cc)] if cc in _CC_RULES else [])
-                 for r in rs] or [(_GENERIC_LENGTHS, None)]
+        rules = _CC_RULES.get(cc) or [(_GENERIC_LENGTHS, None)]
     else:
         if not s.isdigit():
             return None
@@ -271,10 +270,11 @@ def validate_phone(
     if value is None or len(value) < 2:
         return None
     s = clean_number(value)
-    if not s:
-        return False
-    if s.startswith("+") and not s[1:].isdigit():
-        return None  # NumberParseException → Try.toOption → None
+    digits = s[1:] if s.startswith("+") else s
+    if not digits.isdigit() or len(digits) < 2:
+        # NOT_A_NUMBER / TOO_SHORT_NSN parse exceptions →
+        # Try.toOption → None (not False)
+        return None
     return parse_phone(value, region, strict) is not None
 
 
@@ -601,12 +601,11 @@ class IsValidPhoneMapDefaultCountry(Transformer):
 
 
 def is_valid_phone(value: str | None, region: str = DEFAULT_REGION) -> bool | None:
-    """Back-compat helper (round-1 API): None for missing, True/False
-    validity against ``region``."""
+    """None for missing OR unparseable (the reference's Binary(None) —
+    parse exceptions collapse to None, not False), True/False otherwise."""
     if value is None:
         return None
-    v = validate_phone(value, region)
-    return bool(v) if v is not None else False
+    return validate_phone(value, region)
 
 
 class PhoneVectorizer(VectorizerTransformer):

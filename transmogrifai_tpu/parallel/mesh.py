@@ -128,7 +128,9 @@ def shard_rows_if_active(x):
     mesh = execution_mesh()
     if mesh is None:
         return x
-    return shard_rows(mesh, np.ascontiguousarray(x))
+    if isinstance(x, np.ndarray):
+        x = np.ascontiguousarray(x)  # device arrays reshard directly
+    return shard_rows(mesh, x)
 
 
 def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
