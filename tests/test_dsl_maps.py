@@ -1,0 +1,128 @@
+"""dsl map-feature vocabulary (RichMapFeature.scala parity surface)."""
+import numpy as np
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import dsl  # noqa: F401 — attaches the vocabulary
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops.prediction import PredictionFieldExtractor
+from transmogrifai_tpu.types.columns import (
+    MapColumn,
+    NumericColumn,
+    PredictionColumn,
+)
+from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+
+
+def _map_ds(n=40):
+    rng = np.random.default_rng(0)
+    label = NumericColumn(
+        T.Integral, rng.integers(0, 2, n).astype(np.int64), np.ones(n, bool)
+    )
+    rm = [
+        {"a": float(i % 5), "b": float(i % 3), "junk": 1.0} for i in range(n)
+    ]
+    tm = [
+        {"color": ["red", "green", "blue"][i % 3], "note": f"text {i % 7}"}
+        for i in range(n)
+    ]
+    pm = [{"home": "5105556666" if i % 2 else "12"} for i in range(n)]
+    return Dataset.of(
+        {
+            "label": label,
+            "rm": MapColumn(T.RealMap, rm),
+            "tm": MapColumn(T.TextMap, tm),
+            "pm": MapColumn(T.PhoneMap, pm),
+        }
+    )
+
+
+def test_real_map_vectorize_with_knobs_and_key_filter():
+    ds = _map_ds()
+    _, preds = from_dataset(ds, response="label")
+    rm = next(p for p in preds if p.name == "rm")
+    vec = rm.vectorize(track_nulls=False, block_keys=["junk"])
+    data, _ = fit_and_transform_dag(ds, [vec])
+    col = data[vec.name]
+    groups = {m.grouping for m in col.metadata.columns}
+    assert "junk" not in groups and {"a", "b"} <= groups
+
+
+def test_text_map_smart_vectorize_knobs():
+    ds = _map_ds()
+    _, preds = from_dataset(ds, response="label")
+    tm = next(p for p in preds if p.name == "tm")
+    vec = tm.smart_vectorize(top_k=2, num_hashes=64)
+    data, _ = fit_and_transform_dag(ds, [vec])
+    col = data[vec.name]
+    # low-cardinality keys pivot with top_k=2: vocab ≤ 2 + OTHER + null
+    assert col.dim > 0
+    assert col.metadata is not None
+
+
+def test_scalar_vectorize_matches_defaults_override():
+    ds = _map_ds()
+    _, preds = from_dataset(ds, response="label")
+    rm = next(p for p in preds if p.name == "rm")
+    # defaults knob rides through dataclasses.replace
+    v1 = rm.vectorize(track_nulls=True)
+    v2 = rm.vectorize(track_nulls=False)
+    data, _ = fit_and_transform_dag(ds, [v1, v2])
+    assert data[v1.name].dim > data[v2.name].dim  # null cols present vs not
+
+
+def test_phone_map_dsl():
+    ds = _map_ds()
+    _, preds = from_dataset(ds, response="label")
+    pm = next(p for p in preds if p.name == "pm")
+    valid = pm.is_valid_phone_map()
+    data, _ = fit_and_transform_dag(ds, [valid])
+    rows = data[valid.name].to_list()
+    assert rows[1] == {"home": True}
+    assert rows[0] == {"home": False}  # "12" parses but is invalid
+
+
+def test_filter_keys_standalone():
+    ds = _map_ds()
+    _, preds = from_dataset(ds, response="label")
+    rm = next(p for p in preds if p.name == "rm")
+    filtered = rm.filter_keys(allow_keys=["a"])
+    data, _ = fit_and_transform_dag(ds, [filtered])
+    assert all(set(m) <= {"a"} for m in data[filtered.name].to_list())
+
+
+def test_prediction_field_extractor_columns():
+    n = 6
+    col = PredictionColumn(
+        T.Prediction,
+        prediction=np.arange(n, dtype=np.float64),
+        probability=np.tile([[0.3, 0.7]], (n, 1)),
+        raw=np.tile([[-1.0, 1.0]], (n, 1)),
+    )
+    pred = PredictionFieldExtractor(field="prediction").transform_columns(
+        col, num_rows=n
+    )
+    assert pred.feature_type is T.RealNN
+    assert list(pred.values) == list(range(n))
+    prob = PredictionFieldExtractor(field="probability").transform_columns(
+        col, num_rows=n
+    )
+    assert prob.values.shape == (n, 2)
+    raw = PredictionFieldExtractor(field="rawPrediction").transform_columns(
+        col, num_rows=n
+    )
+    assert float(raw.values[0, 1]) == 1.0
+
+
+def test_tupled_wiring():
+    ds = _map_ds()
+    _, preds = from_dataset(ds, response="label")
+    rm = next(p for p in preds if p.name == "rm")
+    # fabricate a Prediction-typed feature downstream of a transformer to
+    # exercise the dsl wiring (types only; no fit needed)
+    from transmogrifai_tpu.features.feature import Feature
+
+    fake_pred = Feature(name="p", ftype=T.Prediction, is_response=False)
+    p, r, pr = fake_pred.tupled()
+    assert p.ftype is T.RealNN
+    assert r.ftype is T.OPVector and pr.ftype is T.OPVector

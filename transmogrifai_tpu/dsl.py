@@ -18,6 +18,7 @@ from typing import Any, Callable, Sequence
 
 from .features.feature import Feature
 from .ops import math as _math
+from .ops import phone as _phone
 from .ops import simple as _simple
 from .ops.bucketizers import (
     DecisionTreeNumericBucketizer,
@@ -161,6 +162,138 @@ Feature.substring_of = _binary(_simple.SubstringTransformer)
 Feature.occurs = _unary(_simple.ToOccurTransformer)
 Feature.exists = _unary(_simple.ExistsTransformer)
 Feature.filter_map = _unary(_simple.FilterMap)
+
+
+# ---------------------------------------------------------------- map dsl
+# RichMapFeature.scala (1,157 LoC): per-map-type vectorize/smartVectorize
+# with explicit knobs, key filtering, map-specific transforms. Here ONE
+# type-directed ``vectorize`` covers every feature type (the reference's
+# per-type overloads differ only in which knobs exist — unknown knobs for
+# a type raise TypeError from the stage ctor), with the per-type stages
+# also directly importable from ops.*.
+
+#: vectorize() knobs that live on TransmogrifierDefaults rather than the
+#: stage ctor (RichMapFeature's topK/minSupport/cleanText/cleanKeys/...)
+_DEFAULTS_KNOBS = {
+    "top_k": "TopK",
+    "min_support": "MinSupport",
+    "clean_text": "CleanText",
+    "clean_keys": "CleanKeys",
+    "track_nulls": "TrackNulls",
+    "num_hashes": "DefaultNumOfFeatures",
+    "max_cardinality": "MaxCategoricalCardinality",
+    "coverage_pct": "CoveragePct",
+    "fill_with_mean": "FillWithMean",
+    "fill_with_mode": "FillWithMode",
+    "fill_value": "FillValue",
+    "binary_freq": "BinaryFreq",
+    "reference_date_ms": "ReferenceDateMs",
+}
+
+
+def _vectorize_feature(self: Feature, **kwargs: Any) -> Feature:
+    """Type-directed single-feature vectorization with explicit knobs —
+    ``realMap.vectorize(top_k=5, allow_keys=["a"])`` etc.
+    (RichMapFeature.vectorize and the scalar Rich*Feature.vectorize
+    overloads). Knobs shared with TransmogrifierDefaults override the
+    defaults; any remaining keyword goes to the type's vectorizer ctor
+    (e.g. ``default_region`` for phones); unknown knobs raise."""
+    import dataclasses
+
+    from .ops.defaults import DEFAULTS
+    from .ops.transmogrify import _vectorizer_for
+
+    allow = kwargs.pop("allow_keys", None)
+    block = kwargs.pop("block_keys", None)
+    d = DEFAULTS
+    defaults_knobs = {
+        k: kwargs.pop(k) for k in list(kwargs) if k in _DEFAULTS_KNOBS
+    }
+    if defaults_knobs:
+        d = dataclasses.replace(
+            d, **{_DEFAULTS_KNOBS[k]: v for k, v in defaults_knobs.items()}
+        )
+    src = self
+    if allow or block:
+        # RichMapFeature.filter(allowList, blockList) folded in
+        src = src.transform_with(
+            _simple.FilterMap(allow_keys=allow or (), block_keys=block or ())
+        )
+    stage = _vectorizer_for(src.ftype, d)
+    # a defaults knob the chosen vectorizer never reads is a typo or a
+    # wrong-type knob — silently accepting it would let the user believe
+    # it took effect (the reference's per-type overloads reject it at
+    # compile time)
+    params = stage.get_params()
+    _ALIASES = {
+        "fill_with_mean": ("fill", "fill_with_mean"),
+        "fill_with_mode": ("fill", "fill_with_mode"),
+        "num_hashes": ("num_hashes", "num_terms", "num_features"),
+        "binary_freq": ("binary_freq", "binary"),
+    }
+    for k in defaults_knobs:
+        accepted = _ALIASES.get(k, (k,))
+        if not any(a in params for a in accepted):
+            raise TypeError(
+                f"{type(stage).__name__} (for {src.ftype.__name__}) does "
+                f"not take vectorize knob {k!r}"
+            )
+    if kwargs:  # stage-specific extras beyond the shared defaults
+        stage = type(stage)(**{**params, **kwargs})
+    return src.transform_with(stage)
+
+
+Feature.vectorize = _vectorize_feature
+#: smartVectorize is the text/text-map vectorize (the dispatch already
+#: routes Text/TextArea/TextMap/TextAreaMap to the Smart* stages)
+Feature.smart_vectorize = _vectorize_feature
+
+
+def _map_keys_filtered(
+    self: Feature,
+    allow_keys: Sequence[str] = (),
+    block_keys: Sequence[str] = (),
+) -> Feature:
+    """RichMapFeature.filter(allowList, blockList)."""
+    return self.transform_with(
+        _simple.FilterMap(allow_keys=allow_keys, block_keys=block_keys)
+    )
+
+
+Feature.filter_keys = _map_keys_filtered
+Feature.is_valid_phone_map = _unary(_phone.IsValidPhoneMapDefaultCountry)
+Feature.parse_phone = _unary(_phone.ParsePhoneDefaultCountry)
+Feature.is_valid_phone = _unary(_phone.IsValidPhoneDefaultCountry)
+
+
+def _prediction_field(key: str):
+    """Prediction map accessors (RichMapFeature.scala:1118-1152):
+    pred.prediction_value() → RealNN; probability()/raw_prediction() →
+    OPVector (the output type comes from PredictionFieldExtractor)."""
+    def method(self: Feature) -> Feature:
+        from .ops.prediction import PredictionFieldExtractor
+
+        return self.transform_with(PredictionFieldExtractor(field=key))
+
+    return method
+
+
+Feature.prediction_value = _prediction_field("prediction")
+Feature.probability_vector = _prediction_field("probability")
+Feature.raw_prediction_vector = _prediction_field("rawPrediction")
+
+
+def _tupled(self: Feature) -> tuple[Feature, Feature, Feature]:
+    """pred.tupled() → (prediction RealNN, rawPrediction OPVector,
+    probability OPVector) — RichMapFeature.scala:1118."""
+    return (
+        self.prediction_value(),
+        self.raw_prediction_vector(),
+        self.probability_vector(),
+    )
+
+
+Feature.tupled = _tupled
 
 
 def _vectorize_collection(features: Sequence[Feature], **kwargs: Any) -> Feature:
