@@ -200,3 +200,56 @@ class TestRunnerFixes:
         _, p = parse_args(["Train", "--stage-params",
                            '{"SanityChecker": {"max_correlation": 0.8}}'])
         assert p.stage_params["SanityChecker"]["max_correlation"] == 0.8
+
+
+class TestFileStreaming:
+    def test_file_streaming_reader_batches_and_polling(self, setup, tmp_path):
+        """Each arriving file is one micro-batch (StreamingReaders.scala
+        file-source semantics), including files that appear AFTER the
+        stream starts (poll mode)."""
+        import csv as _csv
+        import threading
+        import time
+
+        from transmogrifai_tpu.readers import FileStreamingReader
+
+        ds, wf, pred, root = setup
+        model_loc = os.path.join(root, "model")
+        if not os.path.exists(os.path.join(model_loc, "manifest.json")):
+            WorkflowRunner(wf, train_reader=DatasetReader(ds)).run(
+                OpWorkflowRunType.TRAIN, OpParams(model_location=model_loc)
+            )
+
+        rows = ds.rows()
+        stream_dir = tmp_path / "incoming"
+        stream_dir.mkdir()
+
+        def write_file(name, batch):
+            path = stream_dir / name
+            tmp = stream_dir / (name + ".tmp")
+            with open(tmp, "w", newline="") as f:
+                w = _csv.writer(f)
+                w.writerow(["label", "x1", "x2"])
+                for r in batch:
+                    w.writerow([r["label"], r["x1"], r["x2"]])
+            os.rename(tmp, path)  # atomic arrival, and .tmp never matches
+
+        write_file("batch0.csv", rows[:60])
+        write_file("batch1.csv", rows[60:100])
+
+        # a late file lands while the poller is watching
+        late = threading.Thread(
+            target=lambda: (time.sleep(0.6), write_file("batch2.csv", rows[100:]))
+        )
+        late.start()
+        reader = FileStreamingReader(
+            str(stream_dir), pattern="*.csv", poll=True,
+            poll_interval_s=0.3, max_polls=8,
+        )
+        runner = WorkflowRunner(wf, streaming_reader=reader)
+        out = runner.run(
+            OpWorkflowRunType.STREAMING_SCORE, OpParams(model_location=model_loc)
+        )
+        late.join()
+        assert len(out.score_batches) == 3
+        assert sum(len(b) for b in out.score_batches) == len(ds)

@@ -155,7 +155,31 @@ class FeatureGeneratorStage(Transformer):
         )
 
     def extract_column(self, records: Iterable[Any]) -> Column:
-        values = [self.extract_fn(r) for r in records] if self.extract_fn else list(records)
+        records = list(records)
+        if self.extract_fn:
+            values = [self.extract_fn(r) for r in records]
+        elif records and isinstance(records[0], dict):
+            # from_dataset features carry no extract_fn (data arrives
+            # columnar at train time) — dict records (file/record streams
+            # scoring a trained model) extract by feature name so the same
+            # raw features work on both sources. A name present in SOME
+            # record distinguishes row-dicts from raw map VALUES; a name
+            # in no record is a schema mismatch (typo'd header) and must
+            # not silently become an all-missing column.
+            from .. import types as _T
+
+            if any(self.feature_name in r for r in records):
+                values = [r.get(self.feature_name) for r in records]
+            elif _T.is_subtype(self.ftype, _T.OPMap):
+                values = records  # records ARE the raw map values
+            else:
+                raise KeyError(
+                    f"Raw feature '{self.feature_name}' missing from the "
+                    f"record stream (record keys: "
+                    f"{sorted(records[0])[:8]}...)"
+                )
+        else:
+            values = records
         return column_from_values(self.ftype, values)
 
     def transform_columns(self, *cols: Column, num_rows: int) -> Column:

@@ -18,7 +18,7 @@ from .joins import (  # noqa: F401
     TimeColumn,
     join_datasets,
 )
-from .streaming import StreamingReader  # noqa: F401
+from .streaming import FileStreamingReader, StreamingReader  # noqa: F401
 from .parquet import (  # noqa: F401
     AvroReader,
     ParquetReader,

@@ -8,6 +8,7 @@ path: host loop feeding a compiled program, SURVEY.md §2.6 "async scoring").
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 from ..dataset import Dataset
@@ -33,4 +34,104 @@ class StreamingReader:
         for batch in self._batches:
             if not batch:
                 continue
+            yield SimpleReader(batch, self.key_fn).generate_dataset(raw_features)
+
+
+class FileStreamingReader(StreamingReader):
+    """Directory-monitoring micro-batch source — the file-stream analog of
+    ``StreamingReaders.Simple.avro`` (StreamingReaders.scala:50-70), where
+    Spark Streaming's file source turns each newly arrived file into one
+    micro-batch.
+
+    Each matching file (csv/avro/parquet by extension) becomes one batch of
+    records, in arrival (mtime, then name) order. ``poll`` mode keeps
+    watching the directory for files appearing after the stream started —
+    ``max_polls``/``poll_interval_s`` bound the watch so scoring loops
+    terminate deterministically in tests and batch jobs.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        pattern: str = "*.csv",
+        key_fn: Callable[[Any], str] | None = None,
+        poll: bool = False,
+        poll_interval_s: float = 0.5,
+        max_polls: int = 10,
+        headers: Sequence[str] | None = None,
+        has_header: bool | None = None,
+    ):
+        super().__init__((), key_fn)
+        self.directory = directory
+        self.pattern = pattern
+        self.poll = poll
+        self.poll_interval_s = poll_interval_s
+        self.max_polls = max_polls
+        #: csv schema passthrough — Spark-style part files have no header
+        #: row (CsvReader would otherwise consume row 1 as column names)
+        self.headers = list(headers) if headers is not None else None
+        self.has_header = has_header
+
+    def _read_file(self, path: str) -> list:
+        if path.endswith(".avro"):
+            from ..utils.avro import read_avro
+
+            return list(read_avro(path))
+        if path.endswith(".parquet"):
+            from .parquet import read_parquet
+
+            return read_parquet(path).rows()
+        from .csv import CsvReader
+
+        return list(
+            CsvReader(
+                path, headers=self.headers, has_header=self.has_header
+            ).read_records()
+        )
+
+    def _batches_iter(self) -> Iterator[list]:
+        import fnmatch
+        import time
+
+        seen: set[str] = set()
+        polls = 0
+        while True:
+            try:
+                entries = [
+                    os.path.join(self.directory, n)
+                    for n in os.listdir(self.directory)
+                    if fnmatch.fnmatch(n, self.pattern)
+                ]
+            except FileNotFoundError:
+                entries = []
+
+            def arrival(p):
+                # a file can vanish between listdir and stat (concurrent
+                # archiver) — sort the gone ones first, they're skipped on
+                # read below
+                try:
+                    return (os.path.getmtime(p), p)
+                except OSError:
+                    return (-1.0, p)
+
+            fresh = sorted((p for p in entries if p not in seen), key=arrival)
+            for p in fresh:
+                seen.add(p)
+                try:
+                    records = self._read_file(p)
+                except OSError:
+                    continue  # vanished/unreadable — next poll moves on
+                if records:
+                    yield records
+            if not self.poll:
+                return
+            polls += 1
+            if polls >= self.max_polls:
+                return
+            time.sleep(self.poll_interval_s)
+
+    def stream_datasets(
+        self, raw_features: Sequence[Feature]
+    ) -> Iterator[Dataset]:
+        for batch in self._batches_iter():
             yield SimpleReader(batch, self.key_fn).generate_dataset(raw_features)
