@@ -162,13 +162,18 @@ class FeatureGeneratorStage(Transformer):
             # from_dataset features carry no extract_fn (data arrives
             # columnar at train time) — dict records (file/record streams
             # scoring a trained model) extract by feature name so the same
-            # raw features work on both sources. A name present in SOME
-            # record distinguishes row-dicts from raw map VALUES; a name
-            # in no record is a schema mismatch (typo'd header) and must
-            # not silently become an all-missing column.
+            # raw features work on both sources. Row-dict streams from the
+            # readers carry every header key in every record, so "name in
+            # the first record" reliably separates row-dicts from raw map
+            # VALUES for OPMap features (a value-map coincidentally
+            # carrying a key equal to the feature name in record 0 is the
+            # one ambiguous case — pass an explicit extract_fn there). A
+            # missing name on a non-map feature is a schema mismatch
+            # (typo'd header) and must not silently become an all-missing
+            # column.
             from .. import types as _T
 
-            if any(self.feature_name in r for r in records):
+            if self.feature_name in records[0]:
                 values = [r.get(self.feature_name) for r in records]
             elif _T.is_subtype(self.ftype, _T.OPMap):
                 values = records  # records ARE the raw map values

@@ -8,12 +8,15 @@ path: host loop feeding a compiled program, SURVEY.md §2.6 "async scoring").
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 from ..dataset import Dataset
 from ..features.feature import Feature
 from .core import SimpleReader
+
+log = logging.getLogger(__name__)
 
 
 class StreamingReader:
@@ -43,11 +46,19 @@ class FileStreamingReader(StreamingReader):
     Spark Streaming's file source turns each newly arrived file into one
     micro-batch.
 
-    Each matching file (csv/avro/parquet by extension) becomes one batch of
-    records, in arrival (mtime, then name) order. ``poll`` mode keeps
-    watching the directory for files appearing after the stream started —
-    ``max_polls``/``poll_interval_s`` bound the watch so scoring loops
-    terminate deterministically in tests and batch jobs.
+    Each matching file (csv/avro/parquet by extension; anything else
+    raises) becomes one batch of records, in arrival (mtime, then name)
+    order. ``poll`` mode keeps watching the directory for files appearing
+    after the stream started — ``max_polls``/``poll_interval_s`` bound the
+    watch so scoring loops terminate deterministically in tests and batch
+    jobs.
+
+    Producers must move files INTO the directory atomically (write
+    elsewhere or to a non-matching name, then rename) — the Spark
+    file-source contract. For producers that write in place, set
+    ``settle_s`` > 0: files whose mtime is younger than that are left for
+    a later poll instead of being read mid-write. Transiently unreadable
+    files are retried on the next poll (and logged), not silently dropped.
     """
 
     def __init__(
@@ -60,6 +71,7 @@ class FileStreamingReader(StreamingReader):
         max_polls: int = 10,
         headers: Sequence[str] | None = None,
         has_header: bool | None = None,
+        settle_s: float = 0.0,
     ):
         super().__init__((), key_fn)
         self.directory = directory
@@ -71,6 +83,7 @@ class FileStreamingReader(StreamingReader):
         #: row (CsvReader would otherwise consume row 1 as column names)
         self.headers = list(headers) if headers is not None else None
         self.has_header = has_header
+        self.settle_s = settle_s
 
     def _read_file(self, path: str) -> list:
         if path.endswith(".avro"):
@@ -81,12 +94,17 @@ class FileStreamingReader(StreamingReader):
             from .parquet import read_parquet
 
             return read_parquet(path).rows()
-        from .csv import CsvReader
+        if path.endswith((".csv", ".tsv", ".txt")):
+            from .csv import CsvReader
 
-        return list(
-            CsvReader(
-                path, headers=self.headers, has_header=self.has_header
-            ).read_records()
+            return list(
+                CsvReader(
+                    path, headers=self.headers, has_header=self.has_header
+                ).read_records()
+            )
+        raise ValueError(
+            f"unsupported stream file format: {os.path.basename(path)} "
+            "(csv/tsv/txt, avro, parquet)"
         )
 
     def _batches_iter(self) -> Iterator[list]:
@@ -114,13 +132,23 @@ class FileStreamingReader(StreamingReader):
                 except OSError:
                     return (-1.0, p)
 
+            now = time.time()
             fresh = sorted((p for p in entries if p not in seen), key=arrival)
             for p in fresh:
-                seen.add(p)
+                if self.settle_s > 0:
+                    try:
+                        if now - os.path.getmtime(p) < self.settle_s:
+                            continue  # possibly mid-write — next poll
+                    except OSError:
+                        continue
                 try:
                     records = self._read_file(p)
-                except OSError:
-                    continue  # vanished/unreadable — next poll moves on
+                except OSError as e:
+                    # transiently unreadable (vanished, permissions, NFS):
+                    # retry next poll rather than silently dropping a batch
+                    log.warning("stream file %s unreadable (%s); will retry", p, e)
+                    continue
+                seen.add(p)
                 if records:
                     yield records
             if not self.poll:
