@@ -126,3 +126,67 @@ def test_tupled_wiring():
     p, r, pr = fake_pred.tupled()
     assert p.ftype is T.RealNN
     assert r.ftype is T.OPVector and pr.ftype is T.OPVector
+
+
+def test_date_to_unit_circle():
+    import datetime as _dt
+
+    from transmogrifai_tpu.ops.dates import DateToUnitCircleTransformer
+    from transmogrifai_tpu.types.columns import column_from_values
+
+    noon = int(_dt.datetime(2020, 1, 1, 12, tzinfo=_dt.timezone.utc)
+               .timestamp() * 1000)
+    six = int(_dt.datetime(2020, 1, 1, 6, tzinfo=_dt.timezone.utc)
+              .timestamp() * 1000)
+    col = column_from_values(T.Date, [noon, six, None])
+    out = DateToUnitCircleTransformer(time_period="HourOfDay").transform_columns(
+        col, num_rows=3
+    )
+    vals = np.asarray(out.values)
+    # DateToUnitCircle.convertToRandians: components are (cos, sin).
+    # noon: angle pi -> (-1, 0); 6am: pi/2 -> (0, 1)
+    np.testing.assert_allclose(vals[0], [-1.0, 0.0], atol=1e-12)
+    np.testing.assert_allclose(vals[1], [0.0, 1.0], atol=1e-12)
+    np.testing.assert_allclose(vals[2], [0.0, 0.0])  # missing -> origin
+
+
+def test_unit_circle_one_based_shift():
+    """1-based periods shift so the first period has angle 0
+    (getPeriodWithSize: value - 1 when min == 1)."""
+    import datetime as _dt
+
+    from transmogrifai_tpu.ops.dates import DateToUnitCircleTransformer
+    from transmogrifai_tpu.types.columns import column_from_values
+
+    # Monday 2021-01-04 → DayOfWeek 1 → shifted 0 → (cos 0, sin 0) = (1, 0)
+    monday = int(_dt.datetime(2021, 1, 4, tzinfo=_dt.timezone.utc)
+                 .timestamp() * 1000)
+    col = column_from_values(T.Date, [monday])
+    out = DateToUnitCircleTransformer(time_period="DayOfWeek").transform_columns(
+        col, num_rows=1
+    )
+    np.testing.assert_allclose(np.asarray(out.values)[0], [1.0, 0.0],
+                               atol=1e-12)
+    # MonthOfYear accepted (reference allows all 7 TimePeriods)
+    out2 = DateToUnitCircleTransformer(time_period="MonthOfYear").transform_columns(
+        col, num_rows=1
+    )
+    np.testing.assert_allclose(np.asarray(out2.values)[0], [1.0, 0.0],
+                               atol=1e-12)  # January → angle 0
+
+
+def test_mime_type_map_detector():
+    import base64
+
+    from transmogrifai_tpu.ops.text_stages import MimeTypeMapDetector
+
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n" + b"0" * 8).decode()
+    pdf = base64.b64encode(b"%PDF-1.4 stuff").decode()
+    col = MapColumn(
+        T.Base64Map,
+        [{"a": png, "b": pdf, "c": None}, {}],
+    )
+    out = MimeTypeMapDetector().transform_columns(col, num_rows=2)
+    rows = out.to_list()
+    assert rows[0] == {"a": "image/png", "b": "application/pdf"}
+    assert rows[1] == {}

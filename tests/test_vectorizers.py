@@ -197,11 +197,12 @@ def test_smart_text_vectorizer_hashes_high_cardinality():
 
 # --------------------------------- dates ------------------------------------
 def test_unit_circle_known_timestamp():
-    # 2020-01-01T06:00:00Z = hour 6 -> angle pi/2 -> sin 1, cos 0
+    # 2020-01-01T06:00:00Z = hour 6 -> angle pi/2 -> (cos, sin) = (0, 1)
+    # (DateToUnitCircle.convertToRandians component order)
     ms = np.array([1577858400000], dtype=np.int64)
     mask = np.array([True])
     out = unit_circle(ms, mask, "HourOfDay")
-    np.testing.assert_allclose(out, [[1.0, 0.0]], atol=1e-12)
+    np.testing.assert_allclose(out, [[0.0, 1.0]], atol=1e-12)
     # missing -> zeros
     out2 = unit_circle(ms, np.array([False]), "HourOfDay")
     np.testing.assert_allclose(out2, [[0.0, 0.0]])

@@ -33,10 +33,12 @@ from .ops.scalers import (
     ScalerTransformer,
     DescalerTransformer,
 )
+from .ops.dates import DateToUnitCircleTransformer
 from .ops.text_stages import (
     JaccardSimilarity,
     LangDetector,
     MimeTypeDetector,
+    MimeTypeMapDetector,
     NameEntityRecognizer,
     NGramSimilarity,
     OpCountVectorizer,
@@ -130,6 +132,7 @@ Feature.idf = _unary(OpIDF)
 Feature.string_indexed = _unary(OpStringIndexer)
 Feature.detect_languages = _unary(LangDetector)
 Feature.detect_mime_types = _unary(MimeTypeDetector)
+Feature.detect_mime_types_map = _unary(MimeTypeMapDetector)
 Feature.is_valid_email = _unary(ValidEmailTransformer)
 Feature.email_to_pick_list = _unary(EmailToPickListTransformer)
 Feature.url_map_to_pick_list_map = _unary(UrlMapToPickListMapTransformer)
@@ -150,6 +153,7 @@ def _tf_idf(self: Feature, num_terms: int = 512) -> Feature:
 Feature.tf_idf = _tf_idf
 
 # ------------------------------------------------------------------- date dsl
+Feature.to_unit_circle = _unary(DateToUnitCircleTransformer)
 Feature.to_time_period = _unary(TimePeriodTransformer)
 Feature.to_time_period_list = _unary(TimePeriodListTransformer)
 Feature.to_time_period_map = _unary(TimePeriodMapTransformer)

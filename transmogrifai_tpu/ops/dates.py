@@ -23,39 +23,91 @@ from .defaults import DEFAULTS
 
 _MS_PER_DAY = 86_400_000.0
 
-_PERIOD_SIZE = {
-    "HourOfDay": 24.0,
-    "DayOfWeek": 7.0,
-    "DayOfMonth": 31.0,
-    "DayOfYear": 366.0,
+#: period size = the joda TimePeriodVal max (DateToUnitCircleTransformer
+#: .scala getPeriodWithSize); True = 1-based (min == 1 → shift so the
+#: first period has angle 0)
+_PERIOD_SIZE: dict[str, tuple[float, bool]] = {
+    "HourOfDay": (24.0, False),
+    "DayOfWeek": (7.0, True),
+    "DayOfMonth": (31.0, True),
+    "DayOfYear": (366.0, True),
+    "MonthOfYear": (12.0, True),
+    "WeekOfMonth": (6.0, True),
+    "WeekOfYear": (53.0, True),
 }
 
 
 def _period_values(ms: np.ndarray, period: str) -> np.ndarray:
-    """Extract the integer time-period component from epoch-ms values."""
+    """Extract the integer time-period component from epoch-ms values
+    (shared calendar conventions live in ops/time_period.period_value)."""
+    from .time_period import period_value
+
     if period == "HourOfDay":
         return (ms // 3_600_000) % 24
     if period == "DayOfWeek":
         days = ms // 86_400_000
         return ((days + 3) % 7) + 1  # epoch day 0 = Thursday; joda Mon=1
-    dts = [
-        _dt.datetime.fromtimestamp(m / 1000.0, tz=_dt.timezone.utc) for m in ms
-    ]
-    if period == "DayOfMonth":
-        return np.array([d.day for d in dts], dtype=np.float64)
-    if period == "DayOfYear":
-        return np.array([d.timetuple().tm_yday for d in dts], dtype=np.float64)
-    raise ValueError(f"Unknown time period {period}")
+    return np.array(
+        [period_value(int(m), period) for m in ms], dtype=np.float64
+    )
 
 
 def unit_circle(ms: np.ndarray, mask: np.ndarray, period: str) -> np.ndarray:
-    """[N, 2] (sin, cos) encoding; missing -> (0, 0)
-    (DateToUnitCircleTransformer.scala)."""
+    """[N, 2] (cos, sin) encoding; missing → (0, 0).
+
+    DateToUnitCircle.convertToRandians semantics
+    (DateToUnitCircleTransformer.scala:109-120): 1-based periods shift by
+    one so the first period always has angle 0, and the components are
+    ordered (cos, sin) — the x_/y_ column pair."""
+    size, one_based = _PERIOD_SIZE[period]
     vals = _period_values(ms.astype(np.int64), period).astype(np.float64)
-    radians = 2.0 * np.pi * vals / _PERIOD_SIZE[period]
-    out = np.stack([np.sin(radians), np.cos(radians)], axis=1)
+    if one_based:
+        vals = vals - 1.0
+    radians = 2.0 * np.pi * vals / size
+    out = np.stack([np.cos(radians), np.sin(radians)], axis=1)
     out[~mask] = 0.0
     return out
+
+
+class DateToUnitCircleTransformer(VectorizerTransformer):
+    """Date/DateTime → OPVector [cos, sin] (the x_/y_ pair) for ONE time
+    period (DateToUnitCircleTransformer.scala; dsl
+    ``date.to_unit_circle()``, RichDateFeature / RichMapFeature
+    toUnitCircle). All 7 reference TimePeriods are accepted."""
+
+    def __init__(self, time_period: str = "HourOfDay", uid: str | None = None):
+        super().__init__("toUnitCircle", uid=uid)
+        if time_period not in _PERIOD_SIZE:
+            raise ValueError(
+                f"time_period must be one of {sorted(_PERIOD_SIZE)}"
+            )
+        self.time_period = time_period
+
+    def get_params(self):
+        return {"time_period": self.time_period}
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for i, col in enumerate(cols):
+            assert isinstance(col, NumericColumn)
+            feat = (
+                self.input_features[i]
+                if i < len(self.input_features)
+                else None
+            )
+            name = feat.name if feat is not None else f"date_{i}"
+            tname = feat.ftype.__name__ if feat is not None else "Date"
+            blocks.append(unit_circle(col.values, col.mask, self.time_period))
+            metas.append([
+                ColumnMeta(
+                    (name,), tname,
+                    # x_HourOfDay / y_HourOfDay — DateToUnitCircle
+                    # .metadataValues order, same as DateVectorizer's
+                    descriptor_value=f"{comp}_{self.time_period}",
+                )
+                for comp in ("x", "y")
+            ])
+        return blocks, metas
 
 
 class DateVectorizer(VectorizerTransformer):

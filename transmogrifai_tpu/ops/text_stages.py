@@ -39,6 +39,7 @@ from ..types import (
     NameStats,
     OPVector,
     PickList,
+    PickListMap,
     Real,
     RealMap,
     RealNN,
@@ -621,6 +622,28 @@ _MAGIC_BYTES: list[tuple[bytes, str]] = [
 ]
 
 
+def detect_mime(b64: str | None) -> str | None:
+    """Magic-byte MIME detection of a base64 payload (shared by the scalar
+    and map detectors); None for missing/undecodable."""
+    if not b64:
+        return None
+    try:
+        data = base64.b64decode(b64, validate=True)
+    except (binascii.Error, ValueError):
+        return None
+    if not data:
+        return None
+    head = data[:32]
+    for magic, mime in _MAGIC_BYTES:
+        if head.startswith(magic):
+            return mime
+    try:
+        data[:512].decode("utf-8")
+        return "text/plain"
+    except UnicodeDecodeError:
+        return "application/octet-stream"
+
+
 class MimeTypeDetector(Transformer):
     """Base64 → Text MIME type (MimeTypeDetector.scala; Tika replaced by a
     magic-byte table; undecodable/unknown → 'application/octet-stream',
@@ -631,31 +654,39 @@ class MimeTypeDetector(Transformer):
     def __init__(self, uid: str | None = None):
         super().__init__("mimeDetected", uid=uid)
 
-    def _detect(self, b64: str) -> str | None:
-        if not b64:
-            return None
-        try:
-            data = base64.b64decode(b64, validate=True)
-        except (binascii.Error, ValueError):
-            return None
-        if not data:
-            return None
-        head = data[:32]
-        for magic, mime in _MAGIC_BYTES:
-            if head.startswith(magic):
-                return mime
-        try:
-            data[:512].decode("utf-8")
-            return "text/plain"
-        except UnicodeDecodeError:
-            return "application/octet-stream"
-
     def transform_columns(self, *cols: Column, num_rows: int) -> TextColumn:
         col = cols[0]
         assert isinstance(col, TextColumn)
         out = np.empty(num_rows, dtype=object)
-        out[:] = [self._detect(v) for v in col.values]
+        out[:] = [detect_mime(v) for v in col.values]
         return TextColumn(Text, out)
+
+
+class MimeTypeMapDetector(Transformer):
+    """Base64Map → PickListMap of MIME types per key
+    (RichMapFeature.detectMimeTypes, RichMapFeature.scala:129) — the map
+    form of MimeTypeDetector; undetectable values drop out of the row."""
+
+    output_type = PickListMap
+
+    def __init__(self, uid: str | None = None):
+        super().__init__("mimeMapDetected", uid=uid)
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        col = cols[0]
+        assert isinstance(col, MapColumn)
+        out = []
+        for m in col.to_list():
+            if not m:
+                out.append({})
+                continue
+            row = {}
+            for k, v in m.items():
+                mime = detect_mime(v)
+                if mime is not None:
+                    row[k] = mime
+            out.append(row)
+        return MapColumn(PickListMap, out)
 
 
 _EMAIL_RE = re.compile(
