@@ -138,8 +138,11 @@ def test_date_to_unit_circle():
                .timestamp() * 1000)
     six = int(_dt.datetime(2020, 1, 1, 6, tzinfo=_dt.timezone.utc)
               .timestamp() * 1000)
+    from transmogrifai_tpu.features import FeatureBuilder
+
+    f = FeatureBuilder.Date("d").as_predictor()
     col = column_from_values(T.Date, [noon, six, None])
-    out = DateToUnitCircleTransformer(time_period="HourOfDay").transform_columns(
+    out = DateToUnitCircleTransformer(time_period="HourOfDay").set_input(f).transform_columns(
         col, num_rows=3
     )
     vals = np.asarray(out.values)
@@ -161,14 +164,18 @@ def test_unit_circle_one_based_shift():
     # Monday 2021-01-04 → DayOfWeek 1 → shifted 0 → (cos 0, sin 0) = (1, 0)
     monday = int(_dt.datetime(2021, 1, 4, tzinfo=_dt.timezone.utc)
                  .timestamp() * 1000)
+    from transmogrifai_tpu.features import FeatureBuilder
+
+    f = FeatureBuilder.Date("d").as_predictor()
     col = column_from_values(T.Date, [monday])
-    out = DateToUnitCircleTransformer(time_period="DayOfWeek").transform_columns(
+    out = DateToUnitCircleTransformer(time_period="DayOfWeek").set_input(f).transform_columns(
         col, num_rows=1
     )
     np.testing.assert_allclose(np.asarray(out.values)[0], [1.0, 0.0],
                                atol=1e-12)
     # MonthOfYear accepted (reference allows all 7 TimePeriods)
-    out2 = DateToUnitCircleTransformer(time_period="MonthOfYear").transform_columns(
+    f2 = FeatureBuilder.Date("d2").as_predictor()
+    out2 = DateToUnitCircleTransformer(time_period="MonthOfYear").set_input(f2).transform_columns(
         col, num_rows=1
     )
     np.testing.assert_allclose(np.asarray(out2.values)[0], [1.0, 0.0],

@@ -24,7 +24,7 @@ from transmogrifai_tpu.ops.maps import (
 from transmogrifai_tpu.ops.phone import PhoneVectorizer, is_valid_phone
 from transmogrifai_tpu.ops.transmogrify import transmogrify
 from transmogrifai_tpu.stages.metadata import NULL_STRING, OTHER_STRING
-from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.types.columns import ListColumn, MapColumn, column_from_values
 from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
 
 _DAY_MS = 86_400_000
@@ -254,3 +254,83 @@ def test_transmogrify_covers_lists_maps_phone():
     # every input feature contributed columns
     parents = {p for c in out.metadata.columns for p in c.parent_names}
     assert parents == {f.name for f in feats}
+
+
+# -------------------- round-3 completeness: small companion stages ----------
+def test_text_map_null_and_len_estimators():
+    from transmogrifai_tpu.ops.maps import TextMapLenEstimator, TextMapNullEstimator
+
+    ds = Dataset.of({
+        "m": MapColumn(T.TextMap, [
+            {"a": "hello world", "b": "x"},
+            {"a": None, "b": "longer words here"},
+            {},
+        ]),
+    })
+    f = FeatureBuilder.TextMap("m").as_predictor()
+
+    null_est = TextMapNullEstimator().set_input(f)
+    model = null_est.fit(ds)
+    out = model.transform(ds)[null_est.output_name]
+    vals = np.asarray(out.values)
+    # keys sorted [a, b]; row0 present/present, row1 a missing, row2 both
+    np.testing.assert_array_equal(vals, [[0, 0], [1, 0], [1, 1]])
+
+    len_est = TextMapLenEstimator().set_input(f)
+    lmodel = len_est.fit(ds)
+    lout = lmodel.transform(ds)[len_est.output_name]
+    lvals = np.asarray(lout.values)
+    # summed TOKEN lengths: "hello world" -> 10, "x" -> 1,
+    # "longer words here" -> 15
+    np.testing.assert_array_equal(lvals, [[10, 1], [0, 15], [0, 0]])
+
+
+def test_text_list_null_transformer():
+    from transmogrifai_tpu.ops.lists import TextListNullTransformer
+
+    ds = Dataset.of({
+        "t": ListColumn(T.TextList, [["a", "b"], [], ["c"]]),
+    })
+    f = FeatureBuilder.TextList("t").as_predictor()
+    stage = TextListNullTransformer().set_input(f)
+    out = stage.transform(ds)[stage.output_name]
+    np.testing.assert_array_equal(
+        np.asarray(out.values), [[0.0], [1.0], [0.0]]
+    )
+
+
+def test_decision_tree_numeric_map_bucketizer():
+    from transmogrifai_tpu.ops.maps import DecisionTreeNumericMapBucketizer
+
+    rng = np.random.default_rng(0)
+    n = 200
+    a = rng.normal(size=n)
+    label = (a > 0).astype(float)   # 'a' perfectly splits the label
+    maps = [
+        {"a": float(a[i]), "noise": float(rng.normal())} for i in range(n)
+    ]
+    maps[5] = {"noise": 0.1}  # one row missing 'a'
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "m": MapColumn(T.RealMap, maps),
+    })
+    lab = FeatureBuilder.RealNN("label").as_response()
+    f = FeatureBuilder.RealMap("m").as_predictor()
+    est = DecisionTreeNumericMapBucketizer().set_input(lab, f)
+    model = est.fit(ds)
+    out = model.transform(ds)[est.output_name]
+    metas = out.metadata.columns
+    groups = {m.grouping for m in metas}
+    assert groups == {"a", "noise"}
+    # 'a' got informative buckets; every present row lands in exactly one
+    should = est.metadata["shouldSplit"][0]
+    assert should[0] is True  # key 'a'
+    a_buckets = [i for i, m in enumerate(metas)
+                 if m.grouping == "a"
+                 and m.indicator_value not in ("NullIndicatorValue", "OTHER")]
+    vals = np.asarray(out.values)
+    present = np.ones(n, dtype=bool); present[5] = False
+    assert np.all(vals[present][:, a_buckets].sum(axis=1) == 1.0)
+    # split should separate the classes near 0
+    splits = [s for s in model.splits[0][0] if np.isfinite(s)]
+    assert any(abs(s) < 0.3 for s in splits)

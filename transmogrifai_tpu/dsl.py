@@ -114,9 +114,18 @@ Feature.calibrate_percentile = _unary(PercentileCalibrator)
 def _auto_bucketize(
     self: Feature, label: Feature, **kwargs: Any
 ) -> Feature:
-    """Supervised decision-tree binning
-    (RichNumericFeature.autoBucketize)."""
-    return label.transform_with(DecisionTreeNumericBucketizer(**kwargs), self)
+    """Supervised decision-tree binning (RichNumericFeature.autoBucketize;
+    numeric MAPS route to the per-key variant, RichMapFeature
+    .autoBucketize)."""
+    from . import types as _T
+    from .ops.maps import DecisionTreeNumericMapBucketizer
+
+    cls = (
+        DecisionTreeNumericMapBucketizer
+        if _T.is_subtype(self.ftype, _T.OPMap)
+        else DecisionTreeNumericBucketizer
+    )
+    return label.transform_with(cls(**kwargs), self)
 
 
 Feature.auto_bucketize = _auto_bucketize

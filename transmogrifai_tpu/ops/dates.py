@@ -88,19 +88,12 @@ class DateToUnitCircleTransformer(VectorizerTransformer):
 
     def blocks_for(self, cols: Sequence[Column], num_rows: int):
         blocks, metas = [], []
-        for i, col in enumerate(cols):
+        for col, feat in zip(cols, self.input_features):
             assert isinstance(col, NumericColumn)
-            feat = (
-                self.input_features[i]
-                if i < len(self.input_features)
-                else None
-            )
-            name = feat.name if feat is not None else f"date_{i}"
-            tname = feat.ftype.__name__ if feat is not None else "Date"
             blocks.append(unit_circle(col.values, col.mask, self.time_period))
             metas.append([
                 ColumnMeta(
-                    (name,), tname,
+                    (feat.name,), feat.ftype.__name__,
                     # x_HourOfDay / y_HourOfDay — DateToUnitCircle
                     # .metadataValues order, same as DateVectorizer's
                     descriptor_value=f"{comp}_{self.time_period}",

@@ -344,3 +344,31 @@ class GeolocationVectorizer(VectorizerEstimator):
                 fills.append(list(self.fill_value))
         self.metadata["geoFills"] = fills
         return GeolocationModel(fills, self.track_nulls)
+
+
+class TextListNullTransformer(VectorizerTransformer):
+    """One empty-list indicator column per TextList input
+    (TextListNullTransformer.scala: 1.0 when the list is empty/missing) —
+    the null-tracking companion the reference pairs with hashed text
+    lists."""
+
+    def __init__(self, uid: str | None = None):
+        super().__init__("textListNull", uid=uid)
+
+    def get_params(self):
+        return {}
+
+    def blocks_for(self, cols, num_rows: int):
+        blocks, metas = [], []
+        for col, feat in zip(cols, self.input_features):
+            values = col.to_list()
+            out = np.zeros((num_rows, 1), dtype=np.float32)
+            for r, v in enumerate(values):
+                if not v:
+                    out[r, 0] = 1.0
+            blocks.append(out)
+            metas.append([
+                ColumnMeta((feat.name,), feat.ftype.__name__,
+                           grouping=feat.name, indicator_value=NULL_STRING)
+            ])
+        return blocks, metas

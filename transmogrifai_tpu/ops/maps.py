@@ -783,3 +783,264 @@ class PhoneMapVectorizer(VectorizerEstimator):
         return PhoneMapModel(
             keys, self.default_region, self.clean_keys, self.track_nulls
         )
+
+
+class TextMapNullModel(VectorizerModel):
+    def __init__(self, keys: list[list[str]], clean_keys: bool, **kw):
+        super().__init__("textMapNull", **kw)
+        self.keys = keys
+        self.clean_keys = clean_keys
+
+    def get_params(self):
+        return {"keys": self.keys, "clean_keys": self.clean_keys}
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        blocks, metas = [], []
+        for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
+            keys = self.keys[fi]
+            rows = map_rows(col, self.clean_keys)
+            out = np.zeros((num_rows, len(keys)), dtype=np.float32)
+            for r, m in enumerate(rows):
+                for j, k in enumerate(keys):
+                    if m.get(k) is None:
+                        out[r, j] = 1.0
+            blocks.append(out)
+            metas.append([
+                ColumnMeta((feat.name,), feat.ftype.__name__, grouping=k,
+                           indicator_value=NULL_STRING)
+                for k in keys
+            ])
+        return blocks, metas
+
+
+class TextMapNullEstimator(VectorizerEstimator):
+    """Per-key null indicators for text maps (TextMapNullEstimator.scala) —
+    the null-tracking companion the reference pairs with hashed text maps."""
+
+    def __init__(self, clean_keys: bool = DEFAULTS.CleanKeys,
+                 uid: str | None = None):
+        super().__init__("textMapNull", uid=uid)
+        self.clean_keys = clean_keys
+
+    def get_params(self):
+        return {"clean_keys": self.clean_keys}
+
+    def fit_model(self, dataset: Dataset) -> TextMapNullModel:
+        keys = [
+            learn_keys(dataset[n], self.clean_keys) for n in self.input_names
+        ]
+        self.metadata["mapKeys"] = keys
+        return TextMapNullModel(keys, self.clean_keys)
+
+
+class TextMapLenModel(VectorizerModel):
+    def __init__(self, keys: list[list[str]], clean_keys: bool, **kw):
+        super().__init__("textLenMap", **kw)
+        self.keys = keys
+        self.clean_keys = clean_keys
+
+    def get_params(self):
+        return {"keys": self.keys, "clean_keys": self.clean_keys}
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        from ..utils.text import tokenize
+
+        blocks, metas = [], []
+        for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
+            keys = self.keys[fi]
+            rows = map_rows(col, self.clean_keys)
+            out = np.zeros((num_rows, len(keys)), dtype=np.float32)
+            for r, m in enumerate(rows):
+                for j, k in enumerate(keys):
+                    v = m.get(k)
+                    if v is not None:
+                        out[r, j] = float(
+                            sum(len(t) for t in tokenize(str(v)))
+                        )
+            blocks.append(out)
+            metas.append([
+                ColumnMeta((feat.name,), feat.ftype.__name__, grouping=k,
+                           descriptor_value="TextLen")
+                for k in keys
+            ])
+        return blocks, metas
+
+
+class TextMapLenEstimator(VectorizerEstimator):
+    """Per-key summed token lengths for text maps
+    (TextMapLenEstimator.scala / TextMapLenModel: tokenize each value,
+    sum token character lengths; missing key → 0). Feeds the LOCO text
+    aggregation the reference builds on text-length columns."""
+
+    def __init__(self, clean_keys: bool = DEFAULTS.CleanKeys,
+                 uid: str | None = None):
+        super().__init__("textLenMap", uid=uid)
+        self.clean_keys = clean_keys
+
+    def get_params(self):
+        return {"clean_keys": self.clean_keys}
+
+    def fit_model(self, dataset: Dataset) -> TextMapLenModel:
+        keys = [
+            learn_keys(dataset[n], self.clean_keys) for n in self.input_names
+        ]
+        self.metadata["mapKeys"] = keys
+        return TextMapLenModel(keys, self.clean_keys)
+
+
+class DecisionTreeNumericMapBucketizerModel(VectorizerModel):
+    def __init__(self, keys: list[list[str]], splits: list[list[list[float]]],
+                 should_split: list[list[bool]], clean_keys: bool,
+                 track_nulls: bool, track_invalid: bool, **kw):
+        super().__init__("dtNumericMapBucketized", **kw)
+        self.keys = keys
+        self.splits = splits
+        self.should_split = should_split
+        self.clean_keys = clean_keys
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+
+    def get_params(self):
+        return {
+            "keys": self.keys,
+            "splits": self.splits,
+            "should_split": self.should_split,
+            "clean_keys": self.clean_keys,
+            "track_nulls": self.track_nulls,
+            "track_invalid": self.track_invalid,
+        }
+
+    def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        # input 0 is the label (supervision only) — vectorize the maps.
+        # Per-key encoding/labels/invalid handling reuse the SCALAR
+        # bucketizer helpers so both variants agree bit-for-bit (same
+        # "lo-hi" bucket labels, same NaN → invalid-indicator routing).
+        import dataclasses
+
+        from .bucketizers import _bucket_metas, _encode
+
+        blocks, metas = [], []
+        for fi, (col, feat) in enumerate(
+            zip(cols[1:], self.input_features[1:])
+        ):
+            keys = self.keys[fi]
+            rows = map_rows(col, self.clean_keys)
+            parts, metas_f = [], []
+            for ki, k in enumerate(keys):
+                should = self.should_split[fi][ki]
+                vals = np.full(num_rows, np.nan, dtype=np.float64)
+                mask = np.zeros(num_rows, dtype=bool)
+                for r, m in enumerate(rows):
+                    v = m.get(k)
+                    if v is not None:
+                        vals[r] = float(v)
+                        mask[r] = True
+                if not should:
+                    # no useful split: null indicator only (scalar parity)
+                    if self.track_nulls:
+                        parts.append((~mask).astype(np.float32)[:, None])
+                        metas_f.append(
+                            ColumnMeta((feat.name,), feat.ftype.__name__,
+                                       grouping=k,
+                                       indicator_value=NULL_STRING)
+                        )
+                    continue
+                splits = np.asarray(self.splits[fi][ki], dtype=np.float64)
+                parts.append(
+                    _encode(vals, mask, splits, self.track_nulls,
+                            self.track_invalid)
+                )
+                metas_f.extend(
+                    dataclasses.replace(m_, grouping=k)
+                    for m_ in _bucket_metas(
+                        feat.name, feat.ftype.__name__, splits,
+                        self.track_nulls, self.track_invalid,
+                    )
+                )
+            blocks.append(
+                np.concatenate(parts, axis=1)
+                if parts else np.zeros((num_rows, 0), dtype=np.float32)
+            )
+            metas.append(metas_f)
+        return blocks, metas
+
+
+class DecisionTreeNumericMapBucketizer(VectorizerEstimator):
+    """Supervised per-key binning of numeric maps
+    (DecisionTreeNumericMapBucketizer.scala): each learned key's values fit
+    a single-feature decision tree against the label — keys whose tree
+    finds no informative split emit only their null indicator, exactly
+    like the scalar DecisionTreeNumericBucketizer."""
+
+    def __init__(
+        self,
+        max_depth: int = 5,
+        min_info_gain: float = 1e-7,
+        clean_keys: bool = DEFAULTS.CleanKeys,
+        track_nulls: bool = DEFAULTS.TrackNulls,
+        track_invalid: bool = True,
+        uid: str | None = None,
+    ):
+        super().__init__("dtNumericMapBucketized", uid=uid)
+        self.max_depth = max_depth
+        self.min_info_gain = min_info_gain
+        self.clean_keys = clean_keys
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+
+    def get_params(self):
+        return {
+            "max_depth": self.max_depth,
+            "min_info_gain": self.min_info_gain,
+            "clean_keys": self.clean_keys,
+            "track_nulls": self.track_nulls,
+            "track_invalid": self.track_invalid,
+        }
+
+    def fit_model(self, dataset: Dataset) -> DecisionTreeNumericMapBucketizerModel:
+        from ..types.columns import NumericColumn
+        from .bucketizers import _tree_splits
+
+        label_name = self.input_names[0]
+        label = dataset[label_name]
+        assert isinstance(label, NumericColumn)
+        all_keys, all_splits, all_should = [], [], []
+        for name in self.input_names[1:]:
+            col = dataset[name]
+            keys = learn_keys(col, self.clean_keys)
+            rows = map_rows(col, self.clean_keys)
+            splits_f, should_f = [], []
+            for k in keys:
+                xs, ys = [], []
+                for m, lv, lm in zip(rows, label.values, label.mask):
+                    v = m.get(k)
+                    if v is not None and lm and np.isfinite(float(v)):
+                        xs.append(float(v))
+                        ys.append(float(lv))
+                inner = (
+                    _tree_splits(
+                        np.asarray(xs), np.asarray(ys),
+                        max_depth=self.max_depth,
+                        min_info_gain=self.min_info_gain,
+                    )
+                    if xs else np.zeros(0)
+                )
+                should = inner.size > 0
+                splits = (
+                    np.concatenate(([-np.inf], inner, [np.inf]))
+                    if should else np.array([-np.inf, np.inf])
+                )
+                splits_f.append([float(s) for s in splits])
+                should_f.append(bool(should))
+            all_keys.append(keys)
+            all_splits.append(splits_f)
+            all_should.append(should_f)
+        self.metadata["mapKeys"] = all_keys
+        self.metadata["shouldSplit"] = all_should
+        return DecisionTreeNumericMapBucketizerModel(
+            all_keys, all_splits, all_should, self.clean_keys,
+            self.track_nulls, self.track_invalid,
+        )
+
+    def blocks_for(self, cols, num_rows):  # estimator itself never vectorizes
+        raise TypeError("fit first — the model emits the blocks")
