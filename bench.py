@@ -377,6 +377,11 @@ def main() -> None:
                 "transmogrify_width": thru["width"],
                 "text_transmogrify_rows_per_sec": round(text["rows_per_sec"]),
                 "text_transmogrify_width": text["width"],
+                # single fresh-process run; the tunneled shared chip's
+                # round-trip throughput varies hour-to-hour — measured
+                # quiet-chip best 9.3 s, congested episodes up to ~70 s
+                # with identical cache state (BASELINE.md round 3)
+                "variance_note": "tunnel-shared chip; quiet-best 9.3s",
             }
         )
     )
