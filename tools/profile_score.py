@@ -1,0 +1,51 @@
+"""Profile WorkflowModel.score on the Titanic flagship (CPU jax).
+
+Diagnoses the round-3 score_s regression (0.024 s -> 0.742 s on 891 rows).
+Run: JAX_PLATFORMS=cpu python tools/profile_score.py
+"""
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import time
+
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.readers import infer_csv_dataset
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
+def main() -> None:
+    ds = infer_csv_dataset(TITANIC)
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+    selector = BinaryClassificationModelSelector(seed=42)
+    pred = selector.set_input(resp, checked).get_output()
+    t0 = time.perf_counter()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    print(f"train: {time.perf_counter() - t0:.2f}s")
+
+    # warm-up + three timed passes
+    for i in range(4):
+        t1 = time.perf_counter()
+        model.score(dataset=ds)
+        print(f"score pass {i}: {time.perf_counter() - t1:.4f}s")
+
+    pr = cProfile.Profile()
+    pr.enable()
+    model.score(dataset=ds)
+    pr.disable()
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(35)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

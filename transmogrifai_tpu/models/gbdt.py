@@ -1109,6 +1109,16 @@ class DecisionTreeClassifier(RandomForestClassifier):
             min_info_gain=min_info_gain, max_bins=max_bins, uid=uid,
         )
 
+    def get_params(self):
+        # a single tree has no forest knobs (num_trees/subsampling/seed);
+        # params must mirror __init__ so the persistence round trip holds
+        return {
+            "max_depth": self.max_depth,
+            "min_instances_per_node": self.min_instances_per_node,
+            "min_info_gain": self.min_info_gain,
+            "max_bins": self.max_bins,
+        }
+
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned, fgroups = self._binned(x)
         present = y[row_mask > 0]
@@ -1142,6 +1152,8 @@ class DecisionTreeRegressor(RandomForestRegressor):
             min_instances_per_node=min_instances_per_node,
             min_info_gain=min_info_gain, max_bins=max_bins, uid=uid,
         )
+
+    get_params = DecisionTreeClassifier.get_params
 
     def fit_arrays(self, x, y, row_mask):
         thresholds, binned, fgroups = self._binned(x)
