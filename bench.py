@@ -93,9 +93,39 @@ def bench_titanic() -> dict:
     t1 = time.perf_counter()
     model.score(dataset=ds)
     score_s = time.perf_counter() - t1
+
+    # serving path: compiled per-row closure (local/scoring.py)
+    from transmogrifai_tpu.local.scoring import score_function
+
+    f = score_function(model)
+    names = [feat.name for feat in model.raw_features]
+    rows = [
+        {n: v for n, v in zip(names, vals)}
+        for vals in zip(*(ds[n].to_list() for n in names))
+    ]
+    f(rows[0])  # warm the size-1 bucket
+    lat = []
+    for r in rows[:50]:
+        t2 = time.perf_counter()
+        f(r)
+        lat.append(time.perf_counter() - t2)
+    lat.sort()
+    f.batch(rows)  # warm the batch bucket
+    t2 = time.perf_counter()
+    f.batch(rows)
+    batch_s = time.perf_counter() - t2
+    chk = checked.origin_stage.metadata.get("sanityCheckerSummary", {})
     return {
         "train_s": train_s,
         "score_s": score_s,
+        "serve_row_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        "serve_batch_rows_per_sec": round(len(rows) / batch_s),
+        # reference-default dispatch width: 512-dim text hashing etc.
+        # (Transmogrifier.scala:56 DefaultNumOfFeatures)
+        "flagship_width_raw": chk.get("numColumns"),
+        "flagship_width_checked": (
+            chk.get("numColumns", 0) - chk.get("numDropped", 0) or None
+        ),
         "holdout_aupr": sel["holdoutEvaluation"]["AuPR"],
         "holdout_auroc": sel["holdoutEvaluation"]["AuROC"],
         "n_candidates": len(sel["validationResults"]),
@@ -373,6 +403,10 @@ def main() -> None:
                 "holdout_auroc": round(titanic["holdout_auroc"], 4),
                 "candidates": titanic["n_candidates"],
                 "score_s": round(titanic["score_s"], 3),
+                "serve_row_p50_ms": titanic["serve_row_p50_ms"],
+                "serve_batch_rows_per_sec": titanic["serve_batch_rows_per_sec"],
+                "flagship_width_raw": titanic["flagship_width_raw"],
+                "flagship_width_checked": titanic["flagship_width_checked"],
                 "transmogrify_rows_per_sec": round(thru["rows_per_sec"]),
                 "transmogrify_width": thru["width"],
                 "text_transmogrify_rows_per_sec": round(text["rows_per_sec"]),

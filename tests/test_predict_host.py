@@ -1,0 +1,59 @@
+"""Host (numpy) predict twins must match the device programs bit-for-bit.
+
+The serving path (local scoring, small-batch model.score) predicts in numpy
+(trees.predict_*_host); the device programs (predict_*_raw) serve scale
+batches. Both must route rows identically.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import transmogrifai_tpu.models.trees as TR  # noqa: E402
+
+
+def _random_trees(rng, R, D, M, F, B):
+    sf = rng.integers(-1, F, size=(R, D, M)).astype(np.int32)
+    sb = rng.integers(0, B - 1, size=(R, D, M)).astype(np.int32)
+    lv = rng.normal(size=(R, M)).astype(np.float32)
+    return TR.Tree(split_feat=sf, split_bin=sb, leaf_value=lv)
+
+
+@pytest.mark.parametrize("n", [1, 7, 891])
+def test_boosted_host_matches_device(n):
+    rng = np.random.default_rng(3)
+    F, B, R, D, M = 17, 8, 5, 3, 8
+    thr = np.sort(rng.normal(size=(F, B - 1)), axis=1).astype(np.float32)
+    trees = _random_trees(rng, R, D, M, F, B)
+    x = rng.normal(size=(n, F)).astype(np.float32)
+    x[rng.random(size=x.shape) < 0.1] = np.nan  # missing values bin to 0
+    host = TR.predict_boosted_host(x, thr, trees, 0.3, 0.5)
+    dev = np.asarray(TR.predict_boosted_raw(
+        jnp.asarray(x), jnp.asarray(thr),
+        jax.tree.map(jnp.asarray, trees), jnp.float32(0.3), jnp.float32(0.5),
+    ))
+    np.testing.assert_allclose(host, dev, rtol=1e-6, atol=1e-6)
+
+
+def test_forest_host_matches_device():
+    rng = np.random.default_rng(4)
+    F, B, R, D, M = 9, 16, 12, 4, 16
+    thr = np.sort(rng.normal(size=(F, B - 1)), axis=1).astype(np.float32)
+    trees = _random_trees(rng, R, D, M, F, B)
+    x = rng.normal(size=(64, F)).astype(np.float32)
+    host = TR.predict_forest_host(x, thr, trees)
+    dev = np.asarray(TR.predict_forest_raw(
+        jnp.asarray(x), jnp.asarray(thr), jax.tree.map(jnp.asarray, trees)
+    ))
+    np.testing.assert_allclose(host, dev, rtol=1e-6, atol=1e-6)
+
+
+def test_bin_host_matches_device_on_threshold_ties():
+    # equality at a threshold must bin identically (x > thr is strict)
+    thr = np.array([[0.0, 1.0, 2.0]], dtype=np.float32)
+    x = np.array([[-1.0], [0.0], [0.5], [1.0], [2.0], [3.0], [np.nan]],
+                 dtype=np.float32)
+    host = TR.bin_data_host(x, thr)
+    dev = np.asarray(TR.bin_data(jnp.asarray(x), jnp.asarray(thr)))
+    np.testing.assert_array_equal(host, dev)

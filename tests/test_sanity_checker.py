@@ -195,3 +195,103 @@ def test_titanic_transmogrify_plus_sanity_check(titanic_path):
     assert np.isfinite(np.asarray(out.values)).all()
     summary = checker.metadata["sanityCheckerSummary"]
     assert summary["numColumns"] == before
+
+
+# ---------------- sampling caps (SanityChecker.scala:356-361,562-564) -------
+def test_sample_fraction_clamps():
+    est = SanityChecker()
+    # small data: lower limit forces full fraction
+    assert est._sample_fraction(500) == 1.0
+    # above the upper limit: fraction caps the checked rows at the limit
+    assert est._sample_fraction(4_000_000) == pytest.approx(0.25)
+    # check_sample below the lower-limit floor gets raised to it
+    est2 = SanityChecker(check_sample=0.0001)
+    assert est2._sample_fraction(100_000) == pytest.approx(0.01)
+    # explicit fraction honored when inside the clamp window
+    est3 = SanityChecker(check_sample=0.5)
+    assert est3._sample_fraction(100_000) == pytest.approx(0.5)
+
+
+def test_sampled_check_is_deterministic_and_bounded(rng):
+    n = 5000
+    y = rng.integers(0, 2, n).astype(float)
+    leak = y + rng.normal(scale=1e-4, size=n)
+    good = rng.normal(size=n)
+    x = np.stack([leak, good], axis=1)
+    metas = [_col("leak"), _col("good")]
+    ds = _vec_ds(x, metas, y)
+    lbl, vec = _checker_inputs()
+    est = SanityChecker(
+        remove_bad_features=True, check_sample=0.1,
+        sample_lower_limit=100, sample_upper_limit=1000,
+    ).set_input(lbl, vec)
+    model = est.fit(ds)
+    summary = est.metadata["sanityCheckerSummary"]
+    assert summary["numRows"] == 500  # 0.1 * 5000, inside [100, 1000]
+    assert model.indices_to_keep == [1]  # leak caught on the sample
+    # same seed -> same sample -> same decisions
+    est2 = SanityChecker(
+        remove_bad_features=True, check_sample=0.1,
+        sample_lower_limit=100, sample_upper_limit=1000,
+    ).set_input(lbl, vec)
+    assert est2.fit(ds).indices_to_keep == model.indices_to_keep
+
+
+# --------- text shared-hash protection (DerivedFeatureFilterUtils) ----------
+def _hash_block_with_leaky_pivot(rng, n=400):
+    y = rng.integers(0, 2, n).astype(float)
+    pivot_a = (y == 0).astype(float)  # leaky indicator, parent "desc"
+    hash_0 = rng.normal(size=n)       # shared-hash block, same parent
+    hash_1 = rng.normal(size=n)
+    good = rng.normal(size=n)
+    x = np.stack([pivot_a, hash_0, hash_1, good], axis=1)
+    metas = [
+        _col("desc", grouping="desc", indicator_value="A", parent_type="Text"),
+        _col("desc", parent_type="Text", descriptor_value="hash_0"),
+        _col("desc", parent_type="Text", descriptor_value="hash_1"),
+        _col("good"),
+    ]
+    return _vec_ds(x, metas, y), y
+
+
+def test_leaky_pivot_takes_sibling_hash_block_by_default(rng):
+    ds, _ = _hash_block_with_leaky_pivot(rng)
+    lbl, vec = _checker_inputs()
+    est = SanityChecker(remove_bad_features=True).set_input(lbl, vec)
+    model = est.fit(ds)
+    # reference default (protectTextSharedHash=false): parent-level removal
+    # takes the hash block down with the leaky pivot
+    assert model.indices_to_keep == [3]
+
+
+def test_protect_text_shared_hash_keeps_hash_block(rng):
+    ds, _ = _hash_block_with_leaky_pivot(rng)
+    lbl, vec = _checker_inputs()
+    est = SanityChecker(
+        remove_bad_features=True, protect_text_shared_hash=True
+    ).set_input(lbl, vec)
+    model = est.fit(ds)
+    # hashes survive; the leaky pivot still goes
+    assert model.indices_to_keep == [1, 2, 3]
+
+
+def test_correlation_exclusion_hashed_text(rng):
+    n = 400
+    y = rng.integers(0, 2, n).astype(float)
+    leaky_hash = y + rng.normal(scale=1e-4, size=n)  # a hash col that leaks
+    good = rng.normal(size=n)
+    x = np.stack([leaky_hash, good], axis=1)
+    metas = [
+        _col("desc", parent_type="Text", descriptor_value="hash_0"),
+        _col("good"),
+    ]
+    ds = _vec_ds(x, metas, y)
+    lbl, vec = _checker_inputs()
+    # excluded from correlation checks -> survives despite the leak
+    est = SanityChecker(
+        remove_bad_features=True, correlation_exclusion="HashedText"
+    ).set_input(lbl, vec)
+    assert est.fit(ds).indices_to_keep == [0, 1]
+    # default NoExclusion catches it
+    est2 = SanityChecker(remove_bad_features=True).set_input(lbl, vec)
+    assert est2.fit(ds).indices_to_keep == [1]

@@ -1,0 +1,72 @@
+"""Scoring wall-clock pins — the round-3 score regression (score_s
+0.024 s -> 0.742 s) must not silently return.
+
+Bounds are generous (CI boxes are noisy, shared 1-vCPU hosts throttle) but
+catch order-of-magnitude regressions: a re-walk of the DAG per row, a lost
+metadata cache, or a predict path that re-compiles/re-syncs per call all
+blow through them.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import NumericColumn, TextColumn
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    rng = np.random.default_rng(0)
+    n = 400
+    y = rng.integers(0, 2, n)
+    cols = {
+        "label": NumericColumn(T.Integral, y.astype(np.int64), np.ones(n, bool)),
+        "a": NumericColumn(T.Real, rng.normal(size=n) + y, np.ones(n, bool)),
+        "b": NumericColumn(T.Real, rng.normal(size=n), rng.random(n) > 0.1),
+    }
+    cats = np.array(["x", "y", "z"], dtype=object)
+    arr = np.empty(n, dtype=object)
+    arr[:] = cats[rng.integers(0, 3, n)]
+    cols["c"] = TextColumn(T.PickList, arr)
+    ds = Dataset.of(cols)
+    resp, preds = from_dataset(ds, response="label")
+    vector = transmogrify(preds)
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+
+    selector = BinaryClassificationModelSelector(
+        models=[(LogisticRegression(), {"reg_param": [0.01]})], seed=7
+    )
+    pred = selector.set_input(resp, vector).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    return model, ds
+
+
+@pytest.mark.slow
+def test_warm_full_score_is_fast(fitted_model):
+    model, ds = fitted_model
+    model.score(dataset=ds)  # warm caches
+    t0 = time.perf_counter()
+    model.score(dataset=ds)
+    assert time.perf_counter() - t0 < 0.5, "400-row warm score must be <0.5s"
+
+
+@pytest.mark.slow
+def test_per_row_serving_latency(fitted_model):
+    model, _ = fitted_model
+    f = score_function(model)
+    row = {"a": 1.0, "b": None, "c": "x"}
+    f(row)  # warm the size-1 bucket
+    lat = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        f(row)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    assert lat[25] < 0.02, f"per-row p50 {lat[25]*1e3:.1f} ms must be <20 ms"

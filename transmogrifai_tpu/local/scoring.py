@@ -2,30 +2,37 @@
 
 Reference: local/.../OpWorkflowModelLocal.scala:43-126 — the fitted workflow
 exports a plain closure that scores one record dict at a time without any
-cluster runtime (there via MLeap; here the fitted DAG is already a pure
-function, so local scoring is just the columnar transform on length-1
-columns — no separate serving runtime needed, SURVEY.md §2.5 item 4).
+cluster runtime (there via MLeap precomputed per-stage closures,
+OpWorkflowModelLocal.scala:79-121; here the fitted DAG is walked ONCE at
+closure-build time into a flat stage plan, so each call runs column codecs +
+the per-stage transforms with no Dataset assembly or DAG re-walk).
 
-For throughput, ``score_function(..., batch=True)`` accepts a list of dicts
-and scores them as one columnar batch.
+Batch sizes are padded up to power-of-two buckets so the jitted model
+predict compiles one program per bucket instead of one per distinct batch
+length (single-row calls always hit the size-1 program).
+
+For throughput, ``score_function(model)(...)`` exposes ``.batch`` accepting
+a list of dicts scored as one columnar batch.
 """
 from __future__ import annotations
 
 from typing import Any, Callable
 
-from ..dataset import Dataset
 from ..types.columns import column_from_values
 from ..workflow.workflow import WorkflowModel
 
+_BUCKET_CAP = 8192
 
-def _rows_to_dataset(model: WorkflowModel, rows: list[dict[str, Any]]) -> Dataset:
-    cols = {}
-    for f in model.raw_features:
-        vals = [r.get(f.name) for r in rows]
-        if f.is_response and all(v is None for v in vals):
-            vals = [0] * len(rows)  # score-time null labels
-        cols[f.name] = column_from_values(f.ftype, vals)
-    return Dataset.of(cols)
+
+def _bucket(n: int) -> int:
+    """Smallest power-of-two >= n (capped), else next multiple of the cap:
+    bounded program count, <=2x padding overhead."""
+    if n >= _BUCKET_CAP:
+        return -(-n // _BUCKET_CAP) * _BUCKET_CAP
+    b = 1
+    while b < n:
+        b *= 2
+    return b
 
 
 def score_function(
@@ -35,20 +42,54 @@ def score_function(
     OpWorkflowModelLocal.scala:79). Result keys are the result-feature names;
     Prediction features expand to their reference map keys
     (prediction/probability_*/rawPrediction_*)."""
+    from ..workflow.dag import compute_dag
+
+    from ..stages.base import Estimator
+
+    # ---- build-time: flatten the fitted DAG into an ordered stage plan
+    plan = []
+    for layer in compute_dag(list(model.result_features)):
+        for stage in layer:
+            t = model.fitted.get(stage.uid, stage)
+            if isinstance(t, Estimator):
+                # same guard as apply_transformations_dag — fail at
+                # closure-build time, not deep inside the first call
+                raise ValueError(f"Stage {t} was never fitted")
+            plan.append(t)
+    raw_features = list(model.raw_features)
+    result_names = [f.name for f in model.result_features]
+
+    def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        n = len(rows)
+        if n == 0:
+            return []
+        b = _bucket(n)
+        cols: dict[str, Any] = {}
+        for f in raw_features:
+            vals = [r.get(f.name) for r in rows]
+            if f.is_response and all(v is None for v in vals):
+                vals = [0] * n  # score-time null labels
+            if b > n:
+                # pad with copies of the first row: valid for every column
+                # type (incl. non-nullable RealNN); padded outputs are
+                # sliced off below
+                vals = vals + [vals[0]] * (b - n)
+            cols[f.name] = column_from_values(f.ftype, vals)
+        for t in plan:
+            ins = [cols[name] for name in t.input_names]
+            cols[t.output_name] = t.transform_columns(*ins, num_rows=b)
+        out: list[dict[str, Any]] = [{} for _ in range(n)]
+        for name in result_names:
+            if name not in cols:
+                continue
+            # to_list renders Prediction columns as reference-keyed maps
+            rendered = cols[name].to_list()
+            for i in range(n):
+                out[i][name] = rendered[i]
+        return out
 
     def score_one(row: dict[str, Any]) -> dict[str, Any]:
         return score_batch([row])[0]
-
-    def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
-        ds = _rows_to_dataset(model, rows)
-        scored = model.score(dataset=ds)
-        out: list[dict[str, Any]] = [{} for _ in rows]
-        for name in scored:
-            # to_list already renders Prediction columns as reference-keyed
-            # maps (prediction/probability_*/rawPrediction_*)
-            for i, v in enumerate(scored[name].to_list()):
-                out[i][name] = v
-        return out
 
     score_one.batch = score_batch  # type: ignore[attr-defined]
     return score_one

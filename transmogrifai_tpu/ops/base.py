@@ -25,10 +25,16 @@ def assemble_vector(
 ) -> VectorColumn:
     """Concatenate per-feature blocks [N, d_i] into one VectorColumn with
     flattened, reindexed metadata."""
-    from ..types.columns import SparseMatrix
-
     parts = [VectorMetadata(name, tuple(m)) for m in metas]
     metadata = VectorMetadata.flatten(name, parts)
+    values = _assemble_values(blocks)
+    assert values.shape[1] == metadata.size, (values.shape, metadata.size)
+    return VectorColumn(OPVector, values, metadata)
+
+
+def _assemble_values(blocks: Sequence[np.ndarray]) -> np.ndarray:
+    from ..types.columns import SparseMatrix
+
     if any(isinstance(b, SparseMatrix) for b in blocks):
         if len(blocks) == 1:
             values = blocks[0]
@@ -55,11 +61,30 @@ def assemble_vector(
             off += w
     else:
         values = np.zeros((0, 0), dtype=np.float32)
-    assert values.shape[1] == metadata.size, (values.shape, metadata.size)
-    return VectorColumn(OPVector, values, metadata)
+    return values
 
 
-class VectorizerModel(Model):
+class _CachedMetaVectorizer:
+    """Mixin: column metadata is fit-static (it describes columns, not
+    rows), but blocks_for re-derives it every call — ~30-40 ms of dataclass
+    churn per scoring call on a wide plane. The first transform caches the
+    flattened VectorMetadata; later calls only assemble values."""
+
+    _meta_cache: VectorMetadata | None = None
+
+    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        blocks, metas = self.blocks_for(cols, num_rows)
+        cached = self._meta_cache
+        if cached is not None:
+            values = _assemble_values(blocks)
+            if values.shape[1] == cached.size:
+                return VectorColumn(OPVector, values, cached)
+        out = assemble_vector(self.output_name, blocks, metas)
+        self._meta_cache = out.metadata
+        return out
+
+
+class VectorizerModel(_CachedMetaVectorizer, Model):
     """Base fitted vectorizer: subclasses implement ``blocks_for`` returning
     (block matrix [N, d], column metas) per input feature column."""
 
@@ -70,16 +95,12 @@ class VectorizerModel(Model):
     ) -> tuple[list[np.ndarray], list[list[ColumnMeta]]]:
         raise NotImplementedError
 
-    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
-        blocks, metas = self.blocks_for(cols, num_rows)
-        return assemble_vector(self.output_name, blocks, metas)
-
 
 class VectorizerEstimator(Estimator):
     output_type = OPVector
 
 
-class VectorizerTransformer(Transformer):
+class VectorizerTransformer(_CachedMetaVectorizer, Transformer):
     """Fit-free vectorizer (pure transformer)."""
 
     output_type = OPVector
@@ -88,7 +109,3 @@ class VectorizerTransformer(Transformer):
         self, cols: Sequence[Column], num_rows: int
     ) -> tuple[list[np.ndarray], list[list[ColumnMeta]]]:
         raise NotImplementedError
-
-    def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
-        blocks, metas = self.blocks_for(cols, num_rows)
-        return assemble_vector(self.output_name, blocks, metas)

@@ -17,6 +17,7 @@ scoring path.
 from __future__ import annotations
 
 from collections import Counter
+from functools import lru_cache
 from typing import Sequence
 
 import numpy as np
@@ -105,21 +106,37 @@ def pivot_metas(
 ) -> list[ColumnMeta]:
     """Metas for one pivot group: vocab columns + OTHER (+ null indicator).
     ``grouping`` defaults to the feature name; map vectorizers pass the map
-    key so per-key groups drop together in the SanityChecker."""
+    key so per-key groups drop together in the SanityChecker. Memoized —
+    metas are fit-static and ColumnMeta is frozen, but constructing one
+    dataclass per vocab entry per scoring call dominates wide-plane serving
+    latency; callers must not mutate the returned list."""
+    return _pivot_metas_cached(
+        name, parent_type.__name__, tuple(vocab), track_nulls, grouping
+    )
+
+
+@lru_cache(maxsize=8192)
+def _pivot_metas_cached(
+    name: str,
+    parent_type_name: str,
+    vocab: tuple[str, ...],
+    track_nulls: bool,
+    grouping: str | None,
+) -> list[ColumnMeta]:
     group = grouping if grouping is not None else name
     metas = [
-        ColumnMeta((name,), parent_type.__name__, grouping=group, indicator_value=v)
+        ColumnMeta((name,), parent_type_name, grouping=group, indicator_value=v)
         for v in vocab
     ]
     metas.append(
         ColumnMeta(
-            (name,), parent_type.__name__, grouping=group, indicator_value=OTHER_STRING
+            (name,), parent_type_name, grouping=group, indicator_value=OTHER_STRING
         )
     )
     if track_nulls:
         metas.append(
             ColumnMeta(
-                (name,), parent_type.__name__, grouping=group, indicator_value=NULL_STRING
+                (name,), parent_type_name, grouping=group, indicator_value=NULL_STRING
             )
         )
     return metas

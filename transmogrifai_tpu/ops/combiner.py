@@ -21,6 +21,21 @@ class VectorsCombiner(Transformer):
 
     def __init__(self, uid: str | None = None):
         super().__init__("vecsCombine", uid=uid)
+        # (input metadata objects, flattened result) — upstream vectorizers
+        # cache their metadata, so repeated scoring passes identical objects
+        # and the flatten (one dataclass replace per column) runs once
+        self._flatten_cache: tuple[tuple, VectorMetadata] | None = None
+
+    def _flatten(self, metas: list[VectorMetadata]) -> VectorMetadata:
+        cached = self._flatten_cache
+        key = tuple(metas)
+        if cached is not None and len(cached[0]) == len(key) and all(
+            a is b for a, b in zip(cached[0], key)
+        ):
+            return cached[1]
+        out = VectorMetadata.flatten(self.output_name, metas)
+        self._flatten_cache = (key, out)
+        return out
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
         from ..types.columns import SparseMatrix
@@ -50,7 +65,7 @@ class VectorsCombiner(Transformer):
             )
         else:
             values = np.zeros((num_rows, 0), dtype=np.float32)
-        metadata = VectorMetadata.flatten(self.output_name, metas)
+        metadata = self._flatten(metas)
         if metadata.size != values.shape[1]:
             # tolerate missing metadata on inputs by padding unknown columns
             metadata = None

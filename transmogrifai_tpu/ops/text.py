@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import Counter
+from functools import lru_cache
 from typing import Sequence
 
 import numpy as np
@@ -311,14 +312,26 @@ def hash_block_sparse(
 def hash_metas(
     name: str, parent_type: type, num_features: int, track_nulls: bool
 ) -> list[ColumnMeta]:
+    """Memoized (metas are fit-static, ColumnMeta frozen): constructing one
+    dataclass per hash bucket per scoring call dominates wide-plane serving
+    latency. Callers must not mutate the returned list."""
+    return _hash_metas_cached(
+        name, parent_type.__name__, num_features, track_nulls
+    )
+
+
+@lru_cache(maxsize=8192)
+def _hash_metas_cached(
+    name: str, parent_type_name: str, num_features: int, track_nulls: bool
+) -> list[ColumnMeta]:
     metas = [
-        ColumnMeta((name,), parent_type.__name__, grouping=None,
+        ColumnMeta((name,), parent_type_name, grouping=None,
                    descriptor_value=f"hash_{j}")
         for j in range(num_features)
     ]
     if track_nulls:
         metas.append(
-            ColumnMeta((name,), parent_type.__name__, grouping=name,
+            ColumnMeta((name,), parent_type_name, grouping=name,
                        indicator_value=NULL_STRING)
         )
     return metas

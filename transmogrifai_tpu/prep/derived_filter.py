@@ -66,5 +66,7 @@ class FeatureRemovalModel(Model):
         values = np.asarray(vec.values)[:, self.indices_to_keep]
         meta = self.new_metadata
         if meta is None and vec.metadata is not None:
-            meta = vec.metadata.select(self.indices_to_keep)
+            # select() reindexes one dataclass per kept column — fit-static,
+            # so cache it for repeated scoring calls
+            meta = self.new_metadata = vec.metadata.select(self.indices_to_keep)
         return VectorColumn(OPVector, values, meta)

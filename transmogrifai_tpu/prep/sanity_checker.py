@@ -38,6 +38,12 @@ from .derived_filter import FeatureRemovalModel
 CHECK_SAMPLE = 1.0
 SAMPLE_LOWER_LIMIT = 1_000
 SAMPLE_UPPER_LIMIT = 1_000_000
+PROTECT_TEXT_SHARED_HASH = False  # SanityChecker.ProtectTextSharedHash
+#: parent types whose shared-hash columns protect_text_shared_hash shields
+#: (DerivedFeatureFilterUtils.isTextSharedHash)
+_TEXT_HASH_PARENT_TYPES = frozenset(
+    {"Text", "TextArea", "TextMap", "TextAreaMap"}
+)
 MAX_CORRELATION = 0.95
 MAX_FEATURE_CORR = 0.99
 MIN_CORRELATION = 0.0
@@ -76,7 +82,13 @@ class SanityChecker(Estimator):
         min_required_rule_support: float = MIN_REQUIRED_RULE_SUPPORT,
         remove_bad_features: bool = False,
         remove_feature_group: bool = True,
+        protect_text_shared_hash: bool = PROTECT_TEXT_SHARED_HASH,
         correlation_type: str = "pearson",
+        correlation_exclusion: str = "NoExclusion",  # or "HashedText"
+        check_sample: float = CHECK_SAMPLE,
+        sample_lower_limit: int = SAMPLE_LOWER_LIMIT,
+        sample_upper_limit: int = SAMPLE_UPPER_LIMIT,
+        sample_seed: int = 42,
         uid: str | None = None,
     ):
         super().__init__("sanityCheck", uid=uid)
@@ -89,7 +101,21 @@ class SanityChecker(Estimator):
         self.min_required_rule_support = min_required_rule_support
         self.remove_bad_features = remove_bad_features
         self.remove_feature_group = remove_feature_group
+        self.protect_text_shared_hash = protect_text_shared_hash
         self.correlation_type = correlation_type
+        self.correlation_exclusion = correlation_exclusion
+        self.check_sample = check_sample
+        self.sample_lower_limit = sample_lower_limit
+        self.sample_upper_limit = sample_upper_limit
+        self.sample_seed = sample_seed
+
+    def _sample_fraction(self, total: int) -> float:
+        """SanityChecker.fraction (SanityChecker.scala:356-361): clamp the
+        requested check_sample fraction so the checked row count lands in
+        [sample_lower_limit, sample_upper_limit]."""
+        min_fraction = min(1.0, self.sample_lower_limit / max(total, 1))
+        max_fraction = max(0.0, self.sample_upper_limit / max(total, 1))
+        return max(min(self.check_sample, max_fraction), min_fraction)
 
     def get_params(self) -> dict[str, Any]:
         return {
@@ -102,7 +128,13 @@ class SanityChecker(Estimator):
             "min_required_rule_support": self.min_required_rule_support,
             "remove_bad_features": self.remove_bad_features,
             "remove_feature_group": self.remove_feature_group,
+            "protect_text_shared_hash": self.protect_text_shared_hash,
             "correlation_type": self.correlation_type,
+            "correlation_exclusion": self.correlation_exclusion,
+            "check_sample": self.check_sample,
+            "sample_lower_limit": self.sample_lower_limit,
+            "sample_upper_limit": self.sample_upper_limit,
+            "sample_seed": self.sample_seed,
         }
 
     # ------------------------------------------------------------------ fit
@@ -114,6 +146,18 @@ class SanityChecker(Estimator):
 
         x = np.asarray(vec_col.values, dtype=np.float64)
         y = label_col.values.astype(np.float64)
+        n_total = x.shape[0]
+        frac = self._sample_fraction(n_total)
+        if frac < 1.0:
+            # stats on a seeded row sample (SanityChecker.scala:356-361,
+            # 562-564): the checker's cost is bounded by sample_upper_limit
+            # rows no matter the dataset size
+            rng = np.random.default_rng(self.sample_seed)
+            take = rng.choice(
+                n_total, size=max(1, round(frac * n_total)), replace=False
+            )
+            take.sort()
+            x, y = x[take], y[take]
         n, d = x.shape
         meta = vec_col.metadata or VectorMetadata(vector_name, ())
         names = (
@@ -125,8 +169,24 @@ class SanityChecker(Estimator):
             corr = S.spearman_correlation_matrix(x, y)
         else:
             corr = S.correlation_matrix(x, y)
-        corr_label = corr[:d, d]
-        corr_features = corr[:d, :d]
+        corr_label = corr[:d, d].copy()
+        corr_features = corr[:d, :d].copy()
+
+        # CorrelationExclusion.HashedText (SanityChecker.scala:428):
+        # text-shared-hash columns sit out the correlation checks entirely
+        if self.correlation_exclusion == "HashedText" and meta.size == d:
+            excluded = np.array(
+                [
+                    c.parent_type in _TEXT_HASH_PARENT_TYPES
+                    and c.grouping is None
+                    and c.indicator_value is None
+                    for c in meta.columns
+                ],
+                dtype=bool,
+            )
+            corr_label[excluded] = np.nan
+            corr_features[excluded, :] = 0.0
+            corr_features[:, excluded] = 0.0
 
         # label one-hot for categorical stats (binary or small multiclass)
         classes = np.unique(y)
@@ -180,12 +240,72 @@ class SanityChecker(Estimator):
                         ):
                             drop(i, f"ruleConfidence={conf[ci]:.4f}")
 
-        # 5. group-wise removal: leakage drops take the whole pivot group
+        # 5. group-wise removal at PARENT-FEATURE granularity
+        # (DerivedFeatureFilterUtils.reasonsToRemove parentExclusionReasons):
+        # a leaky categorical group takes down every column of the same
+        # parent feature — incl. its hashed-text block and null indicator —
+        # unless the column is a text shared hash and protection is on
+        # (isTextSharedHash: Text-family parent, no grouping, no indicator).
         if self.remove_feature_group and meta.size == d:
+
+            def parent_key(c):
+                base = "_".join(c.parent_names)
+                if c.grouping and c.grouping != base:
+                    return f"{base}_{c.grouping}"  # parentNamesWithMapKeys
+                return base
+
+            def no_keys(c):
+                return "_".join(c.parent_names)
+
+            # max |corrLabel| and max Cramér's V per parent (NaN-filtered,
+            # makeColumnStatistics.maxByParent)
+            parent_corr: dict[str, float] = {}
+            parent_corr_nk: dict[str, float] = {}
+            for j in range(d):
+                c = abs(corr_label[j])
+                if np.isnan(c):
+                    continue
+                for table, key in (
+                    (parent_corr, parent_key(meta.columns[j])),
+                    (parent_corr_nk, no_keys(meta.columns[j])),
+                ):
+                    table[key] = max(table.get(key, 0.0), float(c))
+            parent_v: dict[str, float] = {}
+            parent_v_nk: dict[str, float] = {}
+            for key, v in group_v.items():
+                if np.isnan(v):
+                    continue
+                for i in group_cols[key]:
+                    for table, pk in (
+                        (parent_v, parent_key(meta.columns[i])),
+                        (parent_v_nk, no_keys(meta.columns[i])),
+                    ):
+                        table[pk] = max(table.get(pk, 0.0), float(v))
+
+            def is_text_shared_hash(c) -> bool:
+                return (
+                    c.parent_type in _TEXT_HASH_PARENT_TYPES
+                    and c.grouping is None
+                    and c.indicator_value is None
+                )
+
+            for j in range(d):
+                c = meta.columns[j]
+                if self.protect_text_shared_hash and is_text_shared_hash(c):
+                    continue
+                pk, nk = parent_key(c), no_keys(c)
+                pv = parent_v.get(pk, parent_v_nk.get(nk))
+                if pv is not None and pv > self.max_cramers_v:
+                    drop(j, f"parentCramersV={pv:.4f}>{self.max_cramers_v}")
+                pc = parent_corr.get(pk, parent_corr_nk.get(nk))
+                if pc is not None and pc > self.max_correlation:
+                    drop(j, f"parentCorr={pc:.4f}>{self.max_correlation}")
+
+            # rule-confidence drops still take their indicator group
+            # (removedGroups in getFeaturesToDrop)
             groups = meta.index_of_group()
-            leak_reasons = ("corrLabel", "cramersV", "ruleConfidence")
             for j in list(drop_reasons):
-                if not any(r.startswith(("|corrLabel|", "cramersV", "ruleConfidence"))
+                if not any(r.startswith("ruleConfidence")
                            for r in drop_reasons[j]):
                     continue
                 key = meta.columns[j].grouped_key()
