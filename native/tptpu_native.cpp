@@ -204,11 +204,11 @@ int64_t tp_tokenize_hash_coo(const uint8_t* buf, const int64_t* offsets,
   std::string seen;
   if (binary) seen.assign((size_t)((num_buckets + 7) / 8), '\0');
   int64_t w = 0;
+  bool row_touched = false;
   for (int64_t i = 0; i < n_strings; i++) {
     const uint8_t* s = buf + offsets[i];
     int64_t len = offsets[i + 1] - offsets[i];
     int64_t start = -1;
-    bool row_touched = false;
     for (int64_t k = 0; k <= len; k++) {
       bool word = false;
       if (k < len) {
@@ -252,8 +252,14 @@ int64_t tp_tokenize_hash_coo(const uint8_t* buf, const int64_t* offsets,
         start = -1;
       }
     }
-    if (binary && row_touched) {
+    // clear only when the next string belongs to a different row:
+    // consecutive same-row strings share one dedup scope, so binary mode
+    // matches the dense path even when a caller maps several strings onto
+    // one row (callers must pass same-row strings consecutively)
+    if (binary && row_touched &&
+        (i + 1 >= n_strings || rows[i + 1] != rows[i])) {
       std::memset(&seen[0], 0, seen.size());
+      row_touched = false;
     }
   }
   return w;

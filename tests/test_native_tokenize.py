@@ -47,3 +47,28 @@ def test_min_token_length_and_case():
     np.testing.assert_array_equal(out_native, out_py)
     # min length 2 keeps "ab"/"ABC" only in row 0 and nothing in row 1
     assert out_native[0].sum() == 2.0 and out_native[1].sum() == 0.0
+
+
+def test_coo_binary_dedups_across_same_row_strings():
+    """Two strings mapped to ONE row must share a dedup scope in binary
+    mode: a bucket emitted by the first string must not re-emit from the
+    second (add-combine would otherwise yield 2.0 where dense binary
+    yields 1.0)."""
+    from transmogrifai_tpu import native
+
+    out = native.tokenize_hash_coo(
+        ["alpha beta", "beta gamma"], np.array([5, 5]),
+        num_buckets=64, binary=True,
+    )
+    if out is None:
+        pytest.skip("native library unavailable")
+    rows, cols = out
+    pairs = list(zip(rows.tolist(), cols.tolist()))
+    assert len(pairs) == len(set(pairs)), f"duplicate pairs: {pairs}"
+    # distinct rows still dedup independently (beta appears in both)
+    out2 = native.tokenize_hash_coo(
+        ["alpha beta", "beta gamma"], np.array([0, 1]),
+        num_buckets=64, binary=True,
+    )
+    rows2, cols2 = out2
+    assert len(rows2) == 4  # 2 tokens per row, no cross-row suppression

@@ -152,3 +152,11 @@ def test_is_valid_phone_map_transformer():
 def test_set_codes_and_countries_rejects_garbage():
     with pytest.raises(ValueError):
         ParsePhoneNumber().set_codes_and_countries({"foo": "bar"})
+
+
+def test_parse_zw_default_region_reference_vector():
+    """PhoneNumberParserTest 'need a country identifyer when the local does
+    not match the default': under default region ZW, a bare US-shaped local
+    number must NOT validate — only explicit +1 numbers survive."""
+    got = [parse_phone(p, "ZW") for p in PNS]
+    assert got == ["+15105556666", None, None, "+15103344556", None]

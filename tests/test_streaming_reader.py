@@ -1,0 +1,41 @@
+"""FileStreamingReader single-pass (poll=False) robustness: files inside
+the settle window get ONE bounded retry instead of a silent drop (the
+docstring's 'not silently dropped' contract has no next poll to lean on)."""
+import csv
+import os
+import time
+
+from transmogrifai_tpu.readers import FileStreamingReader
+
+
+def _write_csv(path, rows):
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["a", "b"])
+        for r in rows:
+            w.writerow(r)
+
+
+def test_single_pass_reads_settling_file_after_retry(tmp_path):
+    p = tmp_path / "batch1.csv"
+    _write_csv(p, [[1, 2], [3, 4]])
+    # file mtime is 'now' -> inside the settle window on the first pass
+    reader = FileStreamingReader(
+        str(tmp_path), pattern="*.csv", poll=False, settle_s=0.2
+    )
+    batches = list(reader._batches_iter())
+    assert len(batches) == 1 and len(batches[0]) == 2
+
+
+def test_single_pass_reads_settled_files_immediately(tmp_path):
+    p = tmp_path / "batch1.csv"
+    _write_csv(p, [[1, 2]])
+    old = time.time() - 10
+    os.utime(p, (old, old))
+    reader = FileStreamingReader(
+        str(tmp_path), pattern="*.csv", poll=False, settle_s=0.2
+    )
+    t0 = time.perf_counter()
+    batches = list(reader._batches_iter())
+    assert len(batches) == 1
+    assert time.perf_counter() - t0 < 0.15  # no retry sleep when settled

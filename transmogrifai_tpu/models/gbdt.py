@@ -187,6 +187,39 @@ class _BinnedModel(PredictorModel):
             self._dev_cache = jax.tree.map(jnp.asarray, trees)
         return self._dev_cache
 
+    def _predict_stacks(self, x, trees, boosted: bool) -> np.ndarray:
+        """float64 [N, k] of margins (boosted) or mean-leaf values (forest)
+        — k=1 for a single stacked-tree pytree, one column per class for a
+        list. The ONLY host-vs-device dispatch point for scoring."""
+        many = isinstance(trees, list)
+        if self._use_host(x):
+            hs = self._host(trees)
+            hs = hs if many else [hs]
+            if boosted:
+                outs = [
+                    TR.predict_boosted_host(
+                        x, self.thresholds, t, self.eta, self.base_score
+                    )
+                    for t in hs
+                ]
+            else:
+                outs = [TR.predict_forest_host(x, self.thresholds, t)
+                        for t in hs]
+        else:
+            xj = jnp.asarray(x, dtype=jnp.float32)
+            thr = jnp.asarray(self.thresholds)
+            ds = self._dev(trees)
+            ds = ds if many else [ds]
+            if boosted:
+                eta = jnp.float32(self.eta)
+                base = jnp.float32(self.base_score)
+                outs = [np.asarray(_aot_predict_boosted(xj, thr, t, eta, base))
+                        for t in ds]
+            else:
+                outs = [np.asarray(_aot_predict_forest(xj, thr, t))
+                        for t in ds]
+        return np.stack(outs, axis=1).astype(np.float64)
+
     def detach_from_sweep(self):
         """Cut every reference to the stacked sweep arrays: materialize this
         model's own lane (a small independent device array) and drop the
@@ -243,20 +276,7 @@ class BoostedBinaryModel(_BinnedModel):
         )
 
     def predict_arrays(self, x):
-        if self._use_host(x):
-            margin = TR.predict_boosted_host(
-                x, self.thresholds, self._host(self.trees),
-                self.eta, self.base_score,
-            ).astype(np.float64)
-        else:
-            margin = np.asarray(
-                _aot_predict_boosted(
-                    jnp.asarray(x, dtype=jnp.float32),
-                    jnp.asarray(self.thresholds), self._dev(self.trees),
-                    jnp.float32(self.eta), jnp.float32(self.base_score),
-                ),
-                dtype=np.float64,
-            )
+        margin = self._predict_stacks(x, self.trees, boosted=True)[:, 0]
         return self.predictions_from_sweep(margin)
 
     # ---- batched sweep-eval protocol (validators._sweep_family) ----------
@@ -300,28 +320,7 @@ class BoostedMultiModel(_BinnedModel):
         )
 
     def predict_arrays(self, x):
-        if self._use_host(x):
-            margins = np.stack(
-                [
-                    TR.predict_boosted_host(
-                        x, self.thresholds, t, self.eta, self.base_score
-                    )
-                    for t in self._host(self.trees_per_class)
-                ],
-                axis=1,
-            ).astype(np.float64)
-        else:
-            xj = jnp.asarray(x, dtype=jnp.float32)
-            thr = jnp.asarray(self.thresholds)
-            eta = jnp.float32(self.eta)
-            base = jnp.float32(self.base_score)
-            margins = np.stack(
-                [
-                    np.asarray(_aot_predict_boosted(xj, thr, t, eta, base))
-                    for t in self._dev(self.trees_per_class)
-                ],
-                axis=1,
-            ).astype(np.float64)
+        margins = self._predict_stacks(x, self.trees_per_class, boosted=True)
         p = _sigmoid(margins)
         prob = p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
         return prob.argmax(axis=1).astype(np.float64), prob, margins
@@ -354,20 +353,7 @@ class BoostedRegressionModel(_BinnedModel):
         )
 
     def predict_arrays(self, x):
-        if self._use_host(x):
-            pred = TR.predict_boosted_host(
-                x, self.thresholds, self._host(self.trees),
-                self.eta, self.base_score,
-            ).astype(np.float64)
-        else:
-            pred = np.asarray(
-                _aot_predict_boosted(
-                    jnp.asarray(x, dtype=jnp.float32),
-                    jnp.asarray(self.thresholds), self._dev(self.trees),
-                    jnp.float32(self.eta), jnp.float32(self.base_score),
-                ),
-                dtype=np.float64,
-            )
+        pred = self._predict_stacks(x, self.trees, boosted=True)[:, 0]
         return pred, None, None
 
     sweep_mode = "boost"
@@ -400,24 +386,7 @@ class ForestClassifierModel(_BinnedModel):
         return cls(arrays["thresholds"], _class_trees_from_arrays(arrays))
 
     def predict_arrays(self, x):
-        if self._use_host(x):
-            probs = np.stack(
-                [
-                    TR.predict_forest_host(x, self.thresholds, t)
-                    for t in self._host(self.forests_per_class)
-                ],
-                axis=1,
-            ).astype(np.float64)
-        else:
-            xj = jnp.asarray(x, dtype=jnp.float32)
-            thr = jnp.asarray(self.thresholds)
-            probs = np.stack(
-                [
-                    np.asarray(_aot_predict_forest(xj, thr, t))
-                    for t in self._dev(self.forests_per_class)
-                ],
-                axis=1,
-            ).astype(np.float64)
+        probs = self._predict_stacks(x, self.forests_per_class, boosted=False)
         return self._probs_to_predictions(probs)
 
     @staticmethod
@@ -463,18 +432,7 @@ class ForestRegressionModel(_BinnedModel):
         }
 
     def predict_arrays(self, x):
-        if self._use_host(x):
-            pred = TR.predict_forest_host(
-                x, self.thresholds, self._host(self.trees)
-            ).astype(np.float64)
-        else:
-            pred = np.asarray(
-                _aot_predict_forest(
-                    jnp.asarray(x, dtype=jnp.float32),
-                    jnp.asarray(self.thresholds), self._dev(self.trees),
-                ),
-                dtype=np.float64,
-            )
+        pred = self._predict_stacks(x, self.trees, boosted=False)[:, 0]
         return pred, None, None
 
     sweep_mode = "forest"

@@ -110,7 +110,14 @@ _REGION_RULES: dict[str, tuple[str, tuple[int, ...], re.Pattern | None]] = {
     "CO": ("57", (8, 10), None),
     "PE": ("51", (8, 9), None),
     "VE": ("58", (10,), None),
-    "ZW": ("263", (8, 9, 10), None),
+    # libphonenumber ZW plan: fixed lines lead with 2 (area codes 24x-29x),
+    # mobiles 71/73/77/78, VoIP/toll 8x — nothing leads with 5, so a
+    # US-shaped local ('5105556666' or any truncation) must NOT validate
+    # under default region ZW (PhoneNumberParserTest "need a country
+    # identifyer when the local does not match the default")
+    "ZW": ("263", (7, 8, 9, 10), re.compile(
+        r"^(?:2\d{6,9}|7[1378]\d{7}|8\d{8,9})$"
+    )),
     "CD": ("243", (9,), None),
 }
 

@@ -134,12 +134,17 @@ class FileStreamingReader(StreamingReader):
 
             now = time.time()
             fresh = sorted((p for p in entries if p not in seen), key=arrival)
+            deferred: list[str] = []
             for p in fresh:
                 if self.settle_s > 0:
                     try:
                         if now - os.path.getmtime(p) < self.settle_s:
-                            continue  # possibly mid-write — next poll
+                            # possibly mid-write — next poll (single-pass
+                            # mode retries below instead)
+                            deferred.append(p)
+                            continue
                     except OSError:
+                        deferred.append(p)
                         continue
                 try:
                     records = self._read_file(p)
@@ -147,11 +152,47 @@ class FileStreamingReader(StreamingReader):
                     # transiently unreadable (vanished, permissions, NFS):
                     # retry next poll rather than silently dropping a batch
                     log.warning("stream file %s unreadable (%s); will retry", p, e)
+                    deferred.append(p)
                     continue
                 seen.add(p)
                 if records:
                     yield records
             if not self.poll:
+                # single pass has no next poll: wait out the settle window
+                # once and retry the deferred files; what still fails is
+                # dropped LOUDLY (docstring contract)
+                if deferred:
+                    time.sleep(self.settle_s if self.settle_s > 0 else 0.05)
+                    for p in deferred:
+                        if self.settle_s > 0:
+                            try:
+                                age = time.time() - os.path.getmtime(p)
+                            except OSError as e:
+                                log.error(
+                                    "stream file %s dropped after retry "
+                                    "(%s)", p, e,
+                                )
+                                continue
+                            if age < self.settle_s:
+                                # mtime still moving: the writer is active
+                                # and a read now would yield a TRUNCATED
+                                # batch — drop loudly instead
+                                log.error(
+                                    "stream file %s still being written "
+                                    "after settle retry; dropped", p,
+                                )
+                                continue
+                        try:
+                            records = self._read_file(p)
+                        except OSError as e:
+                            log.error(
+                                "stream file %s dropped after retry (%s)",
+                                p, e,
+                            )
+                            continue
+                        seen.add(p)
+                        if records:
+                            yield records
                 return
             polls += 1
             if polls >= self.max_polls:
