@@ -58,6 +58,25 @@ def _reference_titanic_train_s() -> float:
 
 REFERENCE_TITANIC_TRAIN_S = _reference_titanic_train_s()
 
+
+def _cpu_workload_baseline(name: str) -> dict | None:
+    """Measured CPU entry for a scale workload (baseline_cpu.py writes
+    BASELINE_CPU.json['workloads'][name])."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BASELINE_CPU.json"
+    )
+    try:
+        with open(path) as f:
+            return json.load(f)["workloads"].get(name)
+    except FileNotFoundError:
+        return None
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        # a malformed baseline must not silently read as "never measured"
+        import sys
+
+        print(f"WARNING: BASELINE_CPU.json unusable ({e})", file=sys.stderr)
+        return None
+
 TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
 
 
@@ -275,6 +294,48 @@ def bench_boosted_scale(
     }
 
 
+def bench_logistic_sweep(
+    n_rows: int = 100_000, n_feats: int = 256
+) -> dict:
+    """The candidate-pool workload, head-to-head with the measured CPU
+    baseline (baseline_cpu.py logistic): 24-point elastic-net grid x 3 CV
+    folds = 72 fits, batched as ONE GEMM FISTA program on the fit axis
+    (models/solvers.fit_logistic_binary_batched — the reference fits these
+    sequentially on a parallelism-8 driver pool, OpValidator.scala:371)."""
+    import numpy as np
+
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((n_rows, n_feats), dtype=np.float32)
+    w = rng.standard_normal(n_feats, dtype=np.float32)
+    y = (x @ w + rng.standard_normal(n_rows, dtype=np.float32) > 0
+         ).astype(np.float32)
+    grid = [
+        {"reg_param": reg, "elastic_net_param": en}
+        for reg in [0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.5]
+        for en in [0.0, 0.1, 0.5]
+    ]
+    folds = np.ones((3, n_rows), dtype=np.float32)
+    for k in range(3):
+        folds[k, k::3] = 0.0  # leave fold k out
+    est = LogisticRegression()
+    # steady-state: first call pays per-process tracing/compile
+    for _ in range(2):
+        t0 = time.perf_counter()
+        models = est.fit_arrays_batched_masks(x, y, list(folds), grid)
+        train_s = time.perf_counter() - t0
+    # spot-check quality on the held-out third of fold 0
+    va = np.arange(n_rows)[0::3]
+    pred, prob, _ = models[0][3].predict_arrays(x[va])
+    acc = float((pred == y[va]).mean())
+    return {
+        "train_s": train_s,
+        "fits": len(grid) * 3,
+        "holdout_accuracy": acc,
+    }
+
+
 def bench_wide_mlp(
     n_rows: int = 250_000, n_feats: int = 512,
     hidden: tuple = (2048, 2048), max_iter: int = 100,
@@ -352,19 +413,46 @@ def main() -> None:
             n_rows=rows, n_feats=feats, num_rounds=rounds,
             max_depth=depth, num_bins=bins,
         )
+        base = _cpu_workload_baseline(sys.argv[1])
         print(
             json.dumps(
                 {
                     "metric": f"boosted_trees_{sys.argv[1]}_train_wallclock",
                     "value": round(scale["train_s"], 3),
                     "unit": "s",
-                    "vs_baseline": 0.0,
+                    "vs_baseline": (
+                        round(base["value"] / scale["train_s"], 3)
+                        if base else 0.0
+                    ),
+                    "baseline_s": base.get("value") if base else None,
+                    "baseline_hw": base.get("hardware") if base else None,
                     "rows_x_rounds_per_sec": round(scale["rows_x_rounds_per_sec"]),
                     "train_accuracy": round(scale["train_accuracy"], 4),
                     "config": (
                         f"{rows} rows x {feats} feats, {rounds} rounds "
                         f"depth {depth}, {bins} bins"
                     ),
+                }
+            )
+        )
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "logsweep":
+        ls = bench_logistic_sweep()
+        base = _cpu_workload_baseline("logistic_sweep")
+        print(
+            json.dumps(
+                {
+                    "metric": "logistic_sweep_72fits_wallclock",
+                    "value": round(ls["train_s"], 3),
+                    "unit": "s",
+                    "vs_baseline": (
+                        round(base["value"] / ls["train_s"], 3) if base else 0.0
+                    ),
+                    "baseline_s": base.get("value") if base else None,
+                    "baseline_hw": base.get("hardware") if base else None,
+                    "fits": ls["fits"],
+                    "holdout_accuracy": round(ls["holdout_accuracy"], 4),
+                    "config": "100k rows x 256 feats, 24-point grid x 3 folds",
                 }
             )
         )

@@ -6,19 +6,24 @@ small local mesh exercises the same code paths as real hardware.
 """
 import os
 
-# force CPU even if the session env points at the real chip (JAX_PLATFORMS=axon)
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# force CPU even if the session env points at the real chip — EXCEPT when
+# explicitly running the on-device suites (TPTPU_TPU_TESTS=1)
+_ON_DEVICE = os.environ.get("TPTPU_TPU_TESTS", "") == "1"
+if not _ON_DEVICE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-# the axon PJRT plugin (registered by sitecustomize) latches the platform
-# even when JAX_PLATFORMS=cpu is in the env; the config update wins.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_DEVICE:
+    # the axon PJRT plugin (registered by sitecustomize) latches the
+    # platform even when JAX_PLATFORMS=cpu is in the env; the config
+    # update wins.
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

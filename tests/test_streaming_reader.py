@@ -27,7 +27,7 @@ def test_single_pass_reads_settling_file_after_retry(tmp_path):
     assert len(batches) == 1 and len(batches[0]) == 2
 
 
-def test_single_pass_reads_settled_files_immediately(tmp_path):
+def test_single_pass_reads_settled_files_immediately(tmp_path, monkeypatch):
     p = tmp_path / "batch1.csv"
     _write_csv(p, [[1, 2]])
     old = time.time() - 10
@@ -35,7 +35,8 @@ def test_single_pass_reads_settled_files_immediately(tmp_path):
     reader = FileStreamingReader(
         str(tmp_path), pattern="*.csv", poll=False, settle_s=0.2
     )
-    t0 = time.perf_counter()
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
     batches = list(reader._batches_iter())
     assert len(batches) == 1
-    assert time.perf_counter() - t0 < 0.15  # no retry sleep when settled
+    assert sleeps == []  # no retry sleep when the file is already settled

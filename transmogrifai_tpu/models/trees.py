@@ -65,6 +65,75 @@ def bin_data(x: jax.Array, thresholds: jax.Array) -> jax.Array:
     return codes
 
 
+# --------------------------------------------------------------------------
+# small-table primitives — TPU scatters serialize per index and per-element
+# gathers from small tables lower to slow dynamic-gathers; the one-hot
+# compare/select forms are plain VPU reductions that XLA fuses (measured at
+# [1M] rows, 64-entry tables, in-program: gather 3.3 ms vs 2.4; per-row
+# feature select 14 ms vs ~2; occupancy scatter 10.2 ms vs 2.3).
+# --------------------------------------------------------------------------
+_ONEHOT_MAX_WIDTH = 512
+
+
+def _small_table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """out[k, r] = table[k, idx[k, r]] — one-hot select for small tables,
+    take_along_axis beyond the fusion-friendly width. idx must be in
+    [0, M)."""
+    m = table.shape[-1]
+    if m > _ONEHOT_MAX_WIDTH:
+        return jnp.take_along_axis(table, idx, axis=-1)
+    iot = jnp.arange(m, dtype=jnp.int32)
+    zero = jnp.zeros((), dtype=table.dtype)
+    return jnp.where(
+        idx[..., None] == iot, table[..., None, :], zero
+    ).sum(-1)
+
+
+def _row_feature_select(binned: jax.Array, feat: jax.Array) -> jax.Array:
+    """code[..., r] = binned[r, max(feat[..., r], 0)] — the per-row
+    feature gather of tree routing, as a one-hot select over the feature
+    axis (one fused pass over binned)."""
+    f = binned.shape[1]
+    if f > _ONEHOT_MAX_WIDTH:
+        def one(rf):
+            return jnp.take_along_axis(
+                binned, jnp.maximum(rf, 0)[:, None], axis=1
+            )[:, 0]
+
+        return one(feat) if feat.ndim == 1 else jax.vmap(one)(feat)
+    iot = jnp.arange(f, dtype=jnp.int32)
+    sel = jnp.maximum(feat, 0)[..., None] == iot
+    return jnp.where(sel, binned, 0).sum(-1)
+
+
+def _occupancy(idx: jax.Array, size: int) -> jax.Array:
+    """count of idx == m per m in [0, size) for idx [K, N] (out-of-range
+    ids drop out) — compare-reduce for small sizes, scatter-add beyond."""
+    if size > _ONEHOT_MAX_WIDTH:
+        return jax.vmap(
+            lambda nd: jnp.zeros(size + 1, jnp.int32).at[
+                jnp.minimum(nd, size)
+            ].add(1)
+        )(idx)[:, :size]
+    iot = jnp.arange(size, dtype=jnp.int32)
+    return (idx[..., None] == iot).astype(jnp.int32).sum(axis=-2)
+
+
+def _segment_sum_small(values: jax.Array, idx: jax.Array, size: int) -> jax.Array:
+    """out[k, m] = Σ_r values[k, r]·1[idx[k, r] == m] — one fused
+    compare/select reduction for small segment counts."""
+    if size > _ONEHOT_MAX_WIDTH:
+        return jax.vmap(
+            lambda nd, v: jnp.zeros(size + 1, values.dtype).at[
+                jnp.minimum(nd, size)
+            ].add(v)
+        )(idx, values)[:, :size]
+    iot = jnp.arange(size, dtype=jnp.int32)
+    return jnp.where(
+        idx[..., None] == iot, values[..., None], 0.0
+    ).sum(axis=-2)
+
+
 def grow_tree(
     binned: jax.Array,     # [N, F] int32 codes in [0, num_bins)
     grad: jax.Array,       # [N] float32
@@ -125,7 +194,7 @@ def grow_tree_batched(
         reg_lambda=reg_lambda, gamma=gamma,
         min_child_weight=min_child_weight, min_info_gain=min_info_gain,
         hist_impl=hist_impl, lowp=lowp, feature_groups=feature_groups,
-    )
+    )[0]
 
 
 def _grow_tree_impl(
@@ -336,7 +405,7 @@ def _grow_tree_impl(
             hist = build_histogram_gemm(gbinned, loc, chunk_nodes, gb)
         elif impl == "pallas":
             hist = build_histogram_pallas_batched(
-                gbinned, loc, g, h, chunk_nodes, gb
+                gbinned, loc, g, h, chunk_nodes, gb, lowp=lowp
             )
         else:
             hist = build_histogram_scatter_batched(
@@ -410,7 +479,7 @@ def _grow_tree_impl(
             split_feat=jnp.full((k_fits, 0, 1), -1, dtype=jnp.int32),
             split_bin=jnp.zeros((k_fits, 0, 1), dtype=jnp.int32),
             leaf_value=-leaf_g0 / (leaf_h0 + vec(reg_lambda)[:, None]),
-        )
+        ), jnp.zeros((k_fits, n), dtype=jnp.int32)
 
     # ---- lax.scan over levels with ONE shared body. Program bytes are the
     # binding constraint on the tunneled chip (serialized executables ship
@@ -438,16 +507,14 @@ def _grow_tree_impl(
         psums first. Returns ((live, rank), slot): live/rank are
         [K, max_nodes] masks/prefix-ranks used to densify per-slot results
         back into global node-id space gather-side."""
-        occ = jax.vmap(
-            lambda nd: jnp.zeros(max_nodes + 1, jnp.int32).at[nd].add(1)
-        )(hist_node)[:, :max_nodes]
+        occ = _occupancy(hist_node, max_nodes)
         if axis_name is not None:
             occ = jax.lax.psum(occ, axis_name)
         live = occ > 0
         live_i = live.astype(jnp.int32)
         rank = jnp.cumsum(live_i, axis=1) - live_i  # exclusive prefix
-        slot = jnp.take_along_axis(
-            rank, jnp.minimum(hist_node, max_nodes - 1), axis=1
+        slot = _small_table_lookup(
+            rank, jnp.minimum(hist_node, max_nodes - 1)
         )
         slot = jnp.where(hist_node >= max_nodes, sentinel, slot).astype(
             jnp.int32
@@ -544,13 +611,9 @@ def _grow_tree_impl(
 
         # ---- route rows to children (gather via compact slots — cheaper)
         slot = jnp.clip(local, 0, n_nodes - 1)
-        row_feat = jnp.take_along_axis(feats_c, slot, axis=1)  # [K, N]
-        row_thr = jnp.take_along_axis(bins_c, slot, axis=1)
-        code = jax.vmap(
-            lambda rf: jnp.take_along_axis(
-                binned, jnp.maximum(rf, 0)[:, None], axis=1
-            )[:, 0]
-        )(row_feat)
+        row_feat = _small_table_lookup(feats_c, slot)  # [K, N]
+        row_thr = _small_table_lookup(bins_c, slot)
+        code = _row_feature_select(binned, row_feat)
         go_right = active & (row_feat >= 0) & (code > row_thr)
         node = node * 2 + go_right.astype(jnp.int32)
         active = active & (row_feat >= 0)
@@ -569,17 +632,17 @@ def _grow_tree_impl(
     feats = jnp.swapaxes(feats_s, 0, 1)  # [K, depth, max_nodes]
     bins = jnp.swapaxes(bins_s, 0, 1)
 
-    leaf_g = jax.vmap(
-        lambda nd, gk: jnp.zeros(max_nodes, dtype=jnp.float32).at[nd].add(gk)
-    )(node, g)
-    leaf_h = jax.vmap(
-        lambda nd, hk: jnp.zeros(max_nodes, dtype=jnp.float32).at[nd].add(hk)
-    )(node, h)
+    leaf_g = _segment_sum_small(g, node, max_nodes)
+    leaf_h = _segment_sum_small(h, node, max_nodes)
     if axis_name is not None:
         leaf_g = jax.lax.psum(leaf_g, axis_name)
         leaf_h = jax.lax.psum(leaf_h, axis_name)
     leaf_value = -leaf_g / (leaf_h + vec(reg_lambda)[:, None])
-    return Tree(split_feat=feats, split_bin=bins, leaf_value=leaf_value)
+    tree = Tree(split_feat=feats, split_bin=bins, leaf_value=leaf_value)
+    # `node` is each row's final leaf slot — boosting's margin update reuses
+    # it (leaf_value lookup) instead of re-traversing the tree (measured
+    # ~100 ms/round of serialized gathers at 1M rows)
+    return tree, node
 
 
 def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
@@ -590,11 +653,9 @@ def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
 
     def level(node, sfsb):
         sf, sb = sfsb
-        feat = sf[node]
-        thr = sb[node]
-        code = jnp.take_along_axis(
-            binned, jnp.maximum(feat, 0)[:, None], axis=1
-        )[:, 0]
+        feat = _small_table_lookup(sf[None, :], node[None, :])[0]
+        thr = _small_table_lookup(sb[None, :], node[None, :])[0]
+        code = _row_feature_select(binned, feat)
         go_right = (feat >= 0) & (code > thr)
         return node * 2 + go_right.astype(jnp.int32), None
 
@@ -602,7 +663,7 @@ def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
         level, jnp.zeros(n, dtype=jnp.int32),
         (tree.split_feat, tree.split_bin),
     )
-    return tree.leaf_value[node]
+    return _small_table_lookup(tree.leaf_value[None, :], node[None, :])[0]
 
 
 # --------------------------------------------------------------------------
@@ -831,7 +892,7 @@ def _forest_trees_scan(
 
     def body(_, tk):
         rm_t, fm_t = _bag_masks(tk, sub, col, row_mask, n, f, bootstrap)
-        tree = _grow_tree_impl(
+        tree, _ = _grow_tree_impl(
             binned, gb, ones, rm_t, fm_t,
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=0.0, gamma=0.0,
@@ -988,7 +1049,7 @@ def _boost_chunk_body(
 
     def round_step(margin, _):
         g, h = grads(margin)
-        tree = _grow_tree_impl(
+        tree, leaf_slot = _grow_tree_impl(
             binned, g, h, row_mask, feat_mask,
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=reg_lambda, gamma=gamma,
@@ -996,7 +1057,9 @@ def _boost_chunk_body(
             axis_name=axis_name, axis_size=axis_size, hist_impl=hist_impl,
             feature_groups=feature_groups,
         )
-        step = jax.vmap(lambda t: predict_tree(binned, t))(tree)  # [K, N]
+        # margin update straight from the grower's final routing — one
+        # small-table lookup instead of a full predict_tree re-traversal
+        step = _small_table_lookup(tree.leaf_value, leaf_slot)  # [K, N]
         margin = margin + eta_v[:, None] * step
         return margin, tree
 
@@ -1149,7 +1212,7 @@ def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
             min_info_gain=mig, hist_impl=hist_impl, lowp=lowp,
             axis_name=DATA_AXIS, axis_size=size,
             feature_groups=grp if grp else None,
-        )
+        )[0]
 
     rep = P()
     sm = shard_map(
@@ -1190,7 +1253,7 @@ def _sharded_forest_scan_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
 
         def one_tree(_, rm_fm):
             rm_t, fm_t = rm_fm
-            tree = _grow_tree_impl(
+            tree, _ = _grow_tree_impl(
                 binned, gb, ones, rm_t, fm_t,
                 max_depth=max_depth, num_bins=num_bins,
                 reg_lambda=0.0, gamma=0.0,

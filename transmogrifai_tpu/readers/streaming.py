@@ -132,66 +132,64 @@ class FileStreamingReader(StreamingReader):
                 except OSError:
                     return (-1.0, p)
 
-            now = time.time()
             fresh = sorted((p for p in entries if p not in seen), key=arrival)
-            deferred: list[str] = []
-            for p in fresh:
+
+            def try_read(p: str, final: bool):
+                """(records | None, ok). Not-ok files are deferred (poll /
+                first pass) or dropped LOUDLY (final retry): a file inside
+                the settle window may still be mid-write — reading it would
+                yield a TRUNCATED batch."""
                 if self.settle_s > 0:
                     try:
-                        if now - os.path.getmtime(p) < self.settle_s:
-                            # possibly mid-write — next poll (single-pass
-                            # mode retries below instead)
-                            deferred.append(p)
-                            continue
-                    except OSError:
-                        deferred.append(p)
-                        continue
-                try:
-                    records = self._read_file(p)
-                except OSError as e:
-                    # transiently unreadable (vanished, permissions, NFS):
-                    # retry next poll rather than silently dropping a batch
-                    log.warning("stream file %s unreadable (%s); will retry", p, e)
-                    deferred.append(p)
-                    continue
-                seen.add(p)
-                if records:
-                    yield records
-            if not self.poll:
-                # single pass has no next poll: wait out the settle window
-                # once and retry the deferred files; what still fails is
-                # dropped LOUDLY (docstring contract)
-                if deferred:
-                    time.sleep(self.settle_s if self.settle_s > 0 else 0.05)
-                    for p in deferred:
-                        if self.settle_s > 0:
-                            try:
-                                age = time.time() - os.path.getmtime(p)
-                            except OSError as e:
-                                log.error(
-                                    "stream file %s dropped after retry "
-                                    "(%s)", p, e,
-                                )
-                                continue
-                            if age < self.settle_s:
-                                # mtime still moving: the writer is active
-                                # and a read now would yield a TRUNCATED
-                                # batch — drop loudly instead
-                                log.error(
-                                    "stream file %s still being written "
-                                    "after settle retry; dropped", p,
-                                )
-                                continue
-                        try:
-                            records = self._read_file(p)
-                        except OSError as e:
+                        settling = (
+                            time.time() - os.path.getmtime(p) < self.settle_s
+                        )
+                    except OSError as e:
+                        settling = True
+                        if final:
                             log.error(
                                 "stream file %s dropped after retry (%s)",
                                 p, e,
                             )
-                            continue
-                        seen.add(p)
-                        if records:
+                            return None, False
+                    if settling:
+                        if final:
+                            log.error(
+                                "stream file %s still being written after "
+                                "settle retry; dropped", p,
+                            )
+                        return None, False
+                try:
+                    records = self._read_file(p)
+                except OSError as e:
+                    if final:
+                        log.error(
+                            "stream file %s dropped after retry (%s)", p, e
+                        )
+                    else:
+                        log.warning(
+                            "stream file %s unreadable (%s); will retry",
+                            p, e,
+                        )
+                    return None, False
+                seen.add(p)
+                return records, True
+
+            deferred: list[str] = []
+            for p in fresh:
+                records, ok = try_read(p, final=False)
+                if not ok:
+                    deferred.append(p)
+                elif records:
+                    yield records
+            if not self.poll:
+                # single pass has no next poll: wait out the settle window
+                # once and retry the deferred files
+                if deferred:
+                    time.sleep(self.settle_s if self.settle_s > 0 else 0.05)
+                    for p in deferred:
+                        records, ok = try_read(p, final=True)
+                        if ok and records:
                             yield records
                 return
             polls += 1
