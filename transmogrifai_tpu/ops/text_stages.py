@@ -14,9 +14,9 @@ Reference stages replaced (core/.../stages/impl/feature/):
   * JaccardSimilarity.scala — |A∩B| / |A∪B| over token sets.
   * NGramSimilarity.scala — character-n-gram similarity (Lucene
     NGramDistance replaced by a Jaccard over char n-grams).
-  * LangDetector.scala — Optimaize profiles → stopword/charset heuristic
-    over 12 languages (documented divergence; same output shape
-    RealMap[lang → confidence]).
+  * LangDetector.scala — Optimaize profiles → nlp/langid.py (script census
+    + function-word/diacritic voting, ~55 languages; measured per-language
+    accuracy in PARITY.md; same output shape RealMap[lang → confidence]).
   * MimeTypeDetector.scala — Tika → magic-byte table over common formats.
   * ValidEmailTransformer.scala — RFC-lite regex validation.
   * HumanNameDetector.scala / NameEntityRecognizer.scala — OpenNLP models →
@@ -28,6 +28,7 @@ from __future__ import annotations
 import base64
 import binascii
 import re
+from functools import lru_cache as _lru_cache
 
 import numpy as np
 
@@ -552,26 +553,15 @@ class NGramSimilarity(Transformer):
 
 # ------------------------------------------------------------------ detectors
 
-_LANG_MARKERS: dict[str, frozenset] = {
-    "en": frozenset("the and of to in is you that it he was for on are with as at be this have from".split()),
-    "de": frozenset("der die und in den von zu das mit sich des auf für ist im nicht ein als auch es".split()),
-    "fr": frozenset("le de la et les des en un du une que est pour qui dans par sur au plus".split()),
-    "es": frozenset("el la de que y en un ser se no haber por con su para como estar tener le lo".split()),
-    "pt": frozenset("o de a e do da em um para é com não uma os no se na por mais as dos como".split()),
-    "it": frozenset("di e il la che in un a per è una sono non con si da come io questo ma".split()),
-    "nl": frozenset("de het een en van ik te dat die in je niet zijn is was op aan met als voor".split()),
-    "da": frozenset("og i jeg det at en den til er som på de med han af for ikke der var".split()),
-    "sv": frozenset("och det att i jag en som på är av för med den till inte har de om ett".split()),
-    "fi": frozenset("ja on ei se että en oli hän mutta niin kun min sin nyt mitä tämä ole".split()),
-    "pl": frozenset("i w nie na to że się z do jest jak po co tak o ale mnie jego być ja".split()),
-    "ro": frozenset("de și în a la cu pe este un o care nu mai din ce se pentru sau dar".split()),
-}
+# language detection lives in nlp/langid.py (script census +
+# function-word voting, ~55 languages)
 
 
 class LangDetector(Transformer):
     """Text → RealMap[language → confidence] (LangDetector.scala; the
-    Optimaize profile model is replaced by stop-word voting over 12
-    languages — documented divergence, same output shape/keying)."""
+    Optimaize profile model is replaced by nlp/langid.py — script census +
+    function-word/diacritic voting over ~55 languages; measured per-language
+    accuracy in PARITY.md, same output shape/keying)."""
 
     input_types = (Text,)
     output_type = RealMap
@@ -580,27 +570,11 @@ class LangDetector(Transformer):
         super().__init__("langDetected", uid=uid)
 
     def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        from ..nlp.langid import detect_scores
+
         col = cols[0]
         assert isinstance(col, TextColumn)
-        out = []
-        for v in col.values:
-            if not v:
-                out.append({})
-                continue
-            toks = tokenize(v)
-            if not toks:
-                out.append({})
-                continue
-            scores = {
-                lang: sum(1 for t in toks if t in markers) / len(toks)
-                for lang, markers in _LANG_MARKERS.items()
-            }
-            top = {k: v2 for k, v2 in scores.items() if v2 > 0}
-            if not top:
-                out.append({})
-                continue
-            total = sum(top.values())
-            out.append({k: v2 / total for k, v2 in sorted(top.items(), key=lambda kv: -kv[1])[:3]})
+        out = [detect_scores(v) if v else {} for v in col.values]
         return MapColumn(RealMap, out)
 
 
@@ -732,7 +706,9 @@ keith roger terry sean austin carl arthur lawrence dylan jesse jordan bryan
 emma olivia ava isabella sophia charlotte mia amelia harper evelyn abigail
 ella scarlett grace chloe victoria riley aria lily aubrey zoey penelope
 lillian addison layla natalie camila hannah brooklyn zoe nora leah savannah
-audrey claire eleanor skylar anna caroline maria christopher
+audrey claire eleanor skylar anna caroline maria christopher chad georgia
+virginia chelsea sierra india dakota israel francis diana sofia lucas
+gabriel julian isaac juan luis carlos miguel antonio angel diego alejandro
 """.split())
 
 
@@ -741,6 +717,19 @@ audrey claire eleanor skylar anna caroline maria christopher
 _MALE_HONORIFICS = frozenset({"mr", "mister", "sir"})
 _FEMALE_HONORIFICS = frozenset({"ms", "mrs", "miss", "madam"})
 _HONORIFICS = _MALE_HONORIFICS | _FEMALE_HONORIFICS
+
+
+#: tokens that mark a NON-name context (street/geo designators): surnames
+#: inside "McDaniel Avenue" / "Phelan Road" must not read as people — the
+#: OpenNLP chunker got this from sentence context; measured on the
+#: reference's testkit streets/cities/countries in tools/nlp_agreement.py
+_NON_NAME_CONTEXT = frozenset(
+    """avenue street road lane boulevard blvd drive court plaza terrace
+    highway route way circle square expressway freeway parkway alley pike
+    city town village county state province republic kingdom united states
+    islands island coast bay lake river mount mountains valley beach port
+    north south east west upper lower new old fort""".split()
+)
 
 
 def _is_name_token(t: str, names: frozenset, use_model: bool) -> bool:
@@ -754,6 +743,66 @@ def _is_name_token(t: str, names: frozenset, use_model: bool) -> bool:
 
         return is_probable_name(t, threshold=0.7)
     return False
+
+
+#: all UN-member (plus common observer/territory) country names, tokenized —
+#: 'Ecuador' or 'United States' must not read as a person no matter how
+#: name-shaped the characters are
+_COUNTRY_NAMES = """
+afghanistan albania algeria andorra angola antigua barbuda argentina armenia
+australia austria azerbaijan bahamas bahrain bangladesh barbados belarus
+belgium belize benin bhutan bolivia bosnia herzegovina botswana brazil brunei
+bulgaria burkina faso burundi cambodia cameroon canada verde chad chile china
+colombia comoros congo costa rica croatia cuba cyprus czechia denmark
+djibouti dominica dominican ecuador egypt salvador eritrea estonia eswatini
+ethiopia fiji finland france gabon gambia georgia germany ghana greece
+grenada guatemala guinea bissau guyana haiti honduras hungary iceland india
+indonesia iran iraq ireland israel italy jamaica japan jordan kazakhstan
+kenya kiribati korea kosovo kuwait kyrgyzstan laos latvia lebanon lesotho
+liberia libya liechtenstein lithuania luxembourg madagascar malawi malaysia
+maldives mali malta mauritania mauritius mexico micronesia moldova monaco
+mongolia montenegro morocco mozambique myanmar namibia nauru nepal
+netherlands zealand nicaragua niger nigeria macedonia norway oman pakistan
+palau panama papua paraguay peru philippines poland portugal qatar romania
+russia rwanda lucia samoa marino senegal serbia seychelles sierra leone
+singapore slovakia slovenia solomon somalia spain lanka sudan suriname
+sweden switzerland syria taiwan tajikistan tanzania thailand timor togo
+tonga trinidad tobago tunisia turkey turkmenistan tuvalu uganda ukraine
+emirates uruguay uzbekistan vanuatu venezuela vietnam yemen zambia zimbabwe
+federation swaziland sao tome principe burma zaire czechoslovakia yugoslavia
+ivory
+""".split()
+
+
+@_lru_cache(maxsize=1)
+def _country_tokens() -> frozenset:
+    """Country-name tokens: the authored list above plus the phone plane's
+    region → name table (localized spellings like España ride along)."""
+    from .phone import DEFAULT_COUNTRY_CODES
+
+    toks = set(_COUNTRY_NAMES)
+    for name in DEFAULT_COUNTRY_CODES.values():
+        for t in tokenize(name):
+            toks.add(t)
+    return frozenset(toks)
+
+
+def _row_is_name(text: str, names: frozenset, use_model: bool) -> bool:
+    """Row-level decision: any name token AND no geo/street designator or
+    country-name token (context veto — see _NON_NAME_CONTEXT). A token that
+    is ALSO a dictionary name never vetoes: 'Jordan Smith' and 'Georgia
+    Brown' are people even though Jordan/Georgia are countries (name
+    particles like de/la/san were dropped from the veto list for the same
+    reason — Hispanic compound surnames must keep their recall)."""
+    toks = tokenize(text)
+    if not toks:
+        return False
+    if any(
+        (t in _NON_NAME_CONTEXT or t in _country_tokens()) and t not in names
+        for t in toks
+    ):
+        return False
+    return any(_is_name_token(t, names, use_model) for t in toks)
 
 
 class HumanNameDetector(Estimator):
@@ -791,10 +840,7 @@ class HumanNameDetector(Estimator):
             if not v:
                 continue
             total += 1
-            toks = tokenize(v)
-            if toks and any(
-                _is_name_token(t, self.names, self.use_model) for t in toks
-            ):
+            if _row_is_name(v, self.names, self.use_model):
                 hits += 1
         is_name = total > 0 and (hits / total) >= self.threshold
         self.metadata["treatAsName"] = bool(is_name)

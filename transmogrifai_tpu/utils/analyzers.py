@@ -112,6 +112,38 @@ STOPWORDS: dict[str, frozenset[str]] = {
         varför varje vilka ditt vem vilket sitta sådana vart dina vars
         vårt våra ert era vilkas""".split()
     ),
+    "fr": frozenset(
+        """au aux avec ce ces dans de des du elle en et eux il ils je la le
+        les leur lui ma mais me même mes moi mon ne nos notre nous on ou où
+        par pas pour qu que qui sa se ses son sur ta te tes toi ton tu un
+        une vos votre vous c d j l à m n s t y été étée étées étés étant
+        suis es est sommes êtes sont serai sera seront étais était étions
+        fus fut ai as avons avez ont aurai aura auront avais avait avions
+        eus eut""".split()
+    ),
+    "it": frozenset(
+        """ad al allo ai agli alla alle con col coi da dal dallo dai dagli
+        dalla dalle di del dello dei degli della delle in nel nello nei
+        negli nella nelle su sul sullo sui sugli sulla sulle per tra fra io
+        tu lui lei noi voi loro mio mia miei mie tuo tua tuoi tue suo sua
+        suoi sue nostro nostra nostri nostre vostro vostra vostri vostre
+        che chi cui non come dove quale quanto quanti quanta quante questo
+        questi questa queste quello quelli quella quelle si tutto tutti a e
+        ed o ho hai ha abbiamo avete hanno è sono sei siamo siete era erano
+        sarà sia ma se perché anche più""".split()
+    ),
+    "ru": frozenset(
+        """и в во не что он на я с со как а то все она так его но да ты к у
+        же вы за бы по ее мне было вот от меня еще нет о из ему теперь
+        когда даже ну ли если уже или ни быть был него до вас нибудь вам
+        сказал себя ей может они есть надо ней для мы тебя их чем была сам
+        чтоб без будто чего раз тоже себе под будет тогда кто этот того
+        потому этого какой ним здесь этом один почти мой тем чтобы нее
+        были куда зачем всех можно при об хоть после над больше тот через
+        эти нас про всего них какая много разве эту моя свою этой перед
+        иногда лучше чуть том такой им более всегда конечно всю между
+        это""".split()
+    ),
 }
 
 _VOWELS = {
@@ -487,6 +519,86 @@ class LanguageAnalyzer:
         return [t for t in toks if len(t) >= min_token_length]
 
 
+# --------------------------------------------------------------------------
+# French / Italian / Russian — light Snowball-style suffix stripping
+# (round-4 breadth: the reference's Lucene FrenchLightStemFilter /
+# ItalianLightStemFilter / RussianLightStemFilter equivalents)
+# --------------------------------------------------------------------------
+def french_stem(w: str) -> str:
+    if len(w) < 5:
+        return w
+    for a, b in (("à", "a"), ("â", "a"), ("è", "e"), ("é", "e"), ("ê", "e"),
+                 ("î", "i"), ("ô", "o"), ("û", "u"), ("ç", "c")):
+        w = w.replace(a, b)
+    if w.endswith(("issements", "issement")):
+        return w[:-9 if w.endswith("issements") else -8] + "i"
+    for suf in ("ements", "ement"):
+        if w.endswith(suf) and len(w) > len(suf) + 3:
+            return w[: -len(suf)]
+    for suf in ("ations", "ation"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    if w.endswith("eaux"):
+        return w[:-1]          # chateaux -> chateau (plural x)
+    if w.endswith("aux") and len(w) > 4:
+        return w[:-3] + "al"   # journaux -> journal
+    if w.endswith("eux"):
+        return w[:-1]
+    if w.endswith("ées"):
+        return w[:-3]
+    if w.endswith(("ée", "és", "er", "ez")):
+        return w[:-2]
+    if w.endswith("es"):
+        return w[:-2]
+    if w.endswith(("s", "e")):
+        return w[:-1]
+    return w
+
+
+def italian_stem(w: str) -> str:
+    if len(w) < 5:
+        return w
+    for a, b in (("à", "a"), ("è", "e"), ("é", "e"), ("ì", "i"), ("ò", "o"),
+                 ("ù", "u")):
+        w = w.replace(a, b)
+    for suf in ("azioni", "azione"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    for suf in ("amenti", "amento", "imenti", "imento"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    if w.endswith(("che", "chi")):
+        return w[:-2]
+    if w.endswith(("ie", "ii")):
+        return w[:-2] + "i"
+    if w.endswith(("i", "e", "o", "a")):
+        return w[:-1]
+    return w
+
+
+def russian_stem(w: str) -> str:
+    if len(w) < 5:
+        return w
+    w = w.replace("ё", "е")
+    # verb/participle endings first (longest match), then case endings
+    for suf in ("ировать", "ованный", "ующий", "ывать", "ивать", "уется",
+                "ается", "яется"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    for suf in ("иями", "ями", "ами", "ием", "ией", "иях",
+                "ого", "его", "ому", "ему", "ыми", "ими"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    for suf in ("ов", "ев", "ей", "ий", "ый", "ой", "ая", "яя", "ое", "ее",
+                "ие", "ые", "ом", "ем", "ам", "ым", "им", "ах", "ях", "ую",
+                "юю"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    if w.endswith(("а", "я", "о", "е", "и", "ы", "у", "ю", "ь")):
+        return w[:-1]
+    return w
+
+
 _STEMMERS: dict[str, Callable[[str], str]] = {
     "en": porter_stem,
     "da": danish_stem,
@@ -495,6 +607,9 @@ _STEMMERS: dict[str, Callable[[str], str]] = {
     "es": spanish_stem,
     "pt": portuguese_stem,
     "nl": dutch_stem,
+    "fr": french_stem,
+    "it": italian_stem,
+    "ru": russian_stem,
 }
 
 ANALYZERS: dict[str, LanguageAnalyzer] = {
@@ -520,17 +635,12 @@ def analyzer_for(language: str | None) -> LanguageAnalyzer:
 
 
 def detect_language(text: str) -> str | None:
-    """Lightweight stopword-voting language detection (OptimaizeLanguage-
-    Detector stand-in) over the analyzer languages."""
-    toks = tokenize(text)
-    if not toks:
-        return None
-    best, best_score = None, 0.0
-    for lang, sw in STOPWORDS.items():
-        score = sum(1 for t in toks if t in sw) / len(toks)
-        if score > best_score:
-            best, best_score = lang, score
-    return best if best_score > 0 else None
+    """Language detection (OptimaizeLanguageDetector stand-in) — delegates
+    to nlp/langid.py's ~55-language script-census + function-word voter;
+    languages without a shipped analyzer fall back to STANDARD downstream."""
+    from ..nlp.langid import detect
+
+    return detect(text)
 
 
 def analyze(
