@@ -57,3 +57,36 @@ def test_bin_host_matches_device_on_threshold_ties():
     host = TR.bin_data_host(x, thr)
     dev = np.asarray(TR.bin_data(jnp.asarray(x), jnp.asarray(thr)))
     np.testing.assert_array_equal(host, dev)
+
+
+def test_per_lane_depth_cap_matches_static_depth():
+    """fit_forest_batched(max_depth=12, max_depth_v=[3,3]) must grow the
+    SAME splits as a static depth-3 program in its first 3 levels and none
+    after (the one-program-per-sweep capability in _grow_tree_impl)."""
+    rng = np.random.default_rng(5)
+    n, f = 400, 6
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    thr = TR.quantile_thresholds(x, max_bins=8)
+    binned = TR.bin_data(jnp.asarray(x), jnp.asarray(thr))
+    masks = jnp.asarray(np.ones((2, n), np.float32))
+    kw = dict(num_trees=3, num_bins=8, subsample_rate=1.0,
+              colsample_rate=1.0, min_instances=1.0, min_info_gain=0.0,
+              seed=9, bootstrap=True)
+    capped = TR.fit_forest_batched(
+        binned, jnp.asarray(y), masks, max_depth=12,
+        max_depth_v=jnp.asarray([3, 3], jnp.int32), **kw)
+    # levels >= 3 must be all leaves
+    assert int((np.asarray(capped.split_feat)[:, :, 3:] >= 0).sum()) == 0
+    static = TR.fit_forest_batched(
+        binned, jnp.asarray(y), masks, max_depth=3, **kw)
+    # same bagged draws (same seed, same [K, N] mask shape) -> identical
+    # splits in the shared levels
+    np.testing.assert_array_equal(
+        np.asarray(capped.split_feat)[:, :, :3, :8],
+        np.asarray(static.split_feat),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(capped.split_bin)[:, :, :3, :8],
+        np.asarray(static.split_bin),
+    )

@@ -43,17 +43,20 @@ def eval_langid() -> list[tuple[str, float, int]]:
     return rows
 
 
-def eval_names(n: int = 500) -> dict:
+def eval_names(n: int = 500, ref: str = REF) -> dict:
+    """Shared by tests/test_langid.py (floor pins): ONE definition of the
+    sampling + predicate, so the PARITY.md numbers and the pinned test
+    floors cannot drift apart."""
     import random
 
     from transmogrifai_tpu.ops.text_stages import _COMMON_NAMES, _row_is_name
 
-    name_set = frozenset(n.lower() for n in _COMMON_NAMES)
+    name_set = frozenset(nm.lower() for nm in _COMMON_NAMES)
 
     rng = random.Random(7)
 
     def lines(fn):
-        with open(os.path.join(REF, fn)) as f:
+        with open(os.path.join(ref, fn)) as f:
             return [ln.strip() for ln in f if ln.strip()]
 
     firsts, lasts = lines("firstnames.txt"), lines("lastnames.txt")

@@ -991,6 +991,11 @@ class RandomForestClassifier(_TreeEstimator):
             "seed": self.seed,
         }
 
+    # max_depth STAYS static by default: collapsing the depth groups into
+    # one max-depth program via max_depth_v measured SLOWER end-to-end on
+    # the tunneled chip (one fat program compiles/loads worse than three
+    # slim ones, and every lane pays deep-level eval). run_batched still
+    # wires per-lane caps for custom groupings that mix depths.
     _STATIC_GRID_KEYS = ("num_trees", "max_depth", "max_bins", "seed")
 
     @staticmethod
@@ -1036,10 +1041,15 @@ class RandomForestClassifier(_TreeEstimator):
         yj = jnp.asarray((y == 1).astype(np.float32))
 
         def run_batched(binned, m0, row_mask_k, knob, fgroups):
+            # depth rides the lane axis: ONE program at the grid's max
+            # depth serves every depth point (program acquisition, not
+            # execution, dominates the flagship sweep)
+            depth_arr = np.asarray(knob("max_depth"))
+            uniform = bool((depth_arr == depth_arr[0]).all())
             return TR.fit_forest_batched(
                 binned, yj, row_mask_k,
                 num_trees=int(m0["num_trees"]),
-                max_depth=int(m0["max_depth"]),
+                max_depth=int(depth_arr.max()),
                 num_bins=int(m0["max_bins"]),
                 subsample_rate=knob("subsampling_rate"),
                 colsample_rate=float(colsample),
@@ -1048,6 +1058,10 @@ class RandomForestClassifier(_TreeEstimator):
                 seed=int(m0["seed"]),
                 lowp=True,  # one-vs-rest indicators are bf16-exact
                 feature_groups=fgroups,
+                max_depth_v=(
+                    None if uniform
+                    else jnp.asarray(depth_arr, dtype=jnp.int32)
+                ),
             )
 
         return self._batched_group_fit(
@@ -1078,6 +1092,11 @@ class RandomForestRegressor(_TreeEstimator):
         self.seed = seed
 
     get_params = RandomForestClassifier.get_params
+    # max_depth STAYS static by default: collapsing the depth groups into
+    # one max-depth program via max_depth_v measured SLOWER end-to-end on
+    # the tunneled chip (one fat program compiles/loads worse than three
+    # slim ones, and every lane pays deep-level eval). run_batched still
+    # wires per-lane caps for custom groupings that mix depths.
     _STATIC_GRID_KEYS = ("num_trees", "max_depth", "max_bins", "seed")
 
     @staticmethod
@@ -1109,10 +1128,12 @@ class RandomForestRegressor(_TreeEstimator):
         yj = jnp.asarray(y, dtype=jnp.float32)
 
         def run_batched(binned, m0, row_mask_k, knob, fgroups):
+            depth_arr = np.asarray(knob("max_depth"))
+            uniform = bool((depth_arr == depth_arr[0]).all())
             return TR.fit_forest_batched(
                 binned, yj, row_mask_k,
                 num_trees=int(m0["num_trees"]),
-                max_depth=int(m0["max_depth"]),
+                max_depth=int(depth_arr.max()),
                 num_bins=int(m0["max_bins"]),
                 subsample_rate=knob("subsampling_rate"),
                 colsample_rate=float(colsample),
@@ -1120,6 +1141,10 @@ class RandomForestRegressor(_TreeEstimator):
                 min_info_gain=knob("min_info_gain"),
                 seed=int(m0["seed"]),
                 feature_groups=fgroups,
+                max_depth_v=(
+                    None if uniform
+                    else jnp.asarray(depth_arr, dtype=jnp.int32)
+                ),
             )
 
         return self._batched_group_fit(

@@ -214,9 +214,14 @@ def _grow_tree_impl(
     axis_name: str | None = None,
     axis_size: int = 1,
     feature_groups: tuple[jax.Array, jax.Array] | None = None,
+    max_depth_v: jax.Array | None = None,
 ) -> Tree:
     """Tree-growth body shared by the single-device jit wrapper and the
-    shard_map'd path. With ``axis_name`` set, the function runs per-shard
+    shard_map'd path. ``max_depth_v`` ([K] int32, optional) caps each
+    LANE's depth at runtime: levels >= a lane's cap emit no splits, so one
+    compiled program at the grid's max depth serves every depth point of a
+    hyperparameter sweep (3 RF depth groups -> one program: acquisition,
+    not execution, is the flagship's wall-clock). With ``axis_name`` set, the function runs per-shard
     inside shard_map: rows are the LOCAL shard, each level's histogram is
     psum'd over the mesh axis before the split search, node compaction uses
     a psum'd global occupancy mask, and leaf sums are psum'd — the direct
@@ -521,7 +526,7 @@ def _grow_tree_impl(
         )
         return (live, rank), slot
 
-    def level_body(carry, _):
+    def level_body(carry, level_idx):
         # rows whose node failed to split are DEAD for histogram purposes:
         # a non-split node's child holds the same rows, hence the same
         # histogram and the same failed gain test (the hereditary no-split
@@ -592,6 +597,13 @@ def _grow_tree_impl(
                     jnp.zeros((k_fits, n_nodes), dtype=jnp.int32),
                 ),
             )
+        if max_depth_v is not None:
+            # per-lane depth cap: a lane past its depth emits no splits
+            # (identical trees to a program compiled at that lane's depth —
+            # dead levels route left and add nothing)
+            lane_live = (level_idx < max_depth_v)[:, None]
+            feats_c = jnp.where(lane_live, feats_c, -1)
+            bins_c = jnp.where(lane_live, bins_c, 0)
         alive = (feats_c >= 0).any()
 
         # write per-slot decisions into the GLOBAL node-slot tree arrays —
@@ -626,8 +638,7 @@ def _grow_tree_impl(
             jnp.ones((k_fits, n), dtype=bool),
             jnp.asarray(True),
         ),
-        None,
-        length=max_depth,
+        jnp.arange(max_depth, dtype=jnp.int32),
     )
     feats = jnp.swapaxes(feats_s, 0, 1)  # [K, depth, max_nodes]
     bins = jnp.swapaxes(bins_s, 0, 1)
@@ -868,7 +879,7 @@ def _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap):
 )
 def _forest_trees_scan(
     binned, target, row_mask, tkeys, sub, col, min_instances, min_info_gain,
-    feature_groups=None, *,
+    feature_groups=None, max_depth_v=None, *,
     max_depth, num_bins, bootstrap, lowp, hist_impl=None,
 ) -> Tree:
     """The whole bagged forest as ONE program: ``lax.scan`` over the
@@ -898,6 +909,7 @@ def _forest_trees_scan(
             reg_lambda=0.0, gamma=0.0,
             min_child_weight=mi_k, min_info_gain=mg_k,
             hist_impl=hist_impl, lowp=lowp, feature_groups=feature_groups,
+            max_depth_v=max_depth_v,
         )
         return None, tree
 
@@ -921,6 +933,7 @@ def fit_forest_batched(
     lowp: bool = False,
     mesh=None,
     feature_groups=None,
+    max_depth_v=None,     # [K] int32: per-lane depth caps (see _grow_tree_impl)
 ) -> Tree:
     """K random forests batched over the fit axis, the whole bagged forest
     as ONE scan-over-trees program (_forest_trees_scan — one tree-growth
@@ -946,6 +959,10 @@ def fit_forest_batched(
 
         mesh = execution_mesh()
     if mesh is not None:
+        if max_depth_v is not None:
+            raise NotImplementedError(
+                "per-lane depth caps are single-device only (the sweep path)"
+            )
         return _fit_forest_batched_sharded(
             mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
             num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
@@ -955,7 +972,8 @@ def fit_forest_batched(
 
     return aot_call(
         "forest_scan", _forest_trees_scan,
-        (binned, target, row_mask, tkeys, sub, col, mi, mg, feature_groups),
+        (binned, target, row_mask, tkeys, sub, col, mi, mg, feature_groups,
+         max_depth_v),
         dict(max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
              # lowp is only sound when target values are bf16-exact
              # (classification indicators); regression keeps f32

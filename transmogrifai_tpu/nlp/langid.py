@@ -336,8 +336,9 @@ def _disambiguate(text, stop_sets, marker_sets, default) -> dict[str, float]:
         if markers:
             # normalized + capped like the Latin tier: one stray foreign
             # marker char (a quoted word, a name) must not outvote a whole
-            # sentence of function-word evidence
-            hits = sum(1 for ch in text if ch in markers)
+            # sentence of function-word evidence; lowercase first so
+            # all-caps headlines keep their marker evidence
+            hits = sum(1 for ch in text.lower() if ch in markers)
             s += 0.4 * min(hits, 5) / n
         if s > 0:
             scores[lang] = s
@@ -348,10 +349,11 @@ def _disambiguate(text, stop_sets, marker_sets, default) -> dict[str, float]:
     return {k: v / norm for k, v in top}
 
 
-@lru_cache(maxsize=4096)
 def detect(text: str) -> str | None:
-    """Best language for ``text`` (None when undecidable)."""
-    scores = detect_scores(text)
+    """Best language for ``text`` (None when undecidable). Caching lives in
+    _detect_scores_cached — a second cache layer here would just pin more
+    row strings in memory."""
+    scores = _detect_scores_cached(text)
     if not scores:
         return None
-    return max(scores.items(), key=lambda kv: kv[1])[0]
+    return scores[0][0]  # items are sorted descending

@@ -879,9 +879,9 @@ class HumanNameDetectorModel(Model):
                 out.append({"isName": "false"} if v else {})
                 continue
             toks = tokenize(v)
-            is_name = any(
-                _is_name_token(t, self.names, self.use_model) for t in toks
-            )
+            # same row predicate as fit (context veto included) — fit and
+            # transform must agree on what counts as a name row
+            is_name = _row_is_name(v, self.names, self.use_model)
             stats = {"isName": "true" if is_name else "false"}
             if is_name:
                 first = next(
