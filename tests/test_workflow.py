@@ -95,7 +95,12 @@ def test_titanic_evaluate_and_summary_pretty(titanic_model):
     assert metrics["AuROC"] > 0.7  # full-data eval of the selected model
     pretty = model.summary_pretty()
     assert "LogisticRegression" in pretty
-    assert "AuPR" in pretty and "Holdout" in pretty
+    # reference README rendering (README.md:63-96): lead sentence, param
+    # table, combined metric table, correlation-ranked insights
+    assert "AuPR" in pretty and "Hold Out Set Value" in pretty
+    assert "Selected model" in pretty and "Model Param" in pretty
+    assert "Top model insights computed using correlation:" in pretty
+    assert "Top Positive Insights" in pretty
 
 
 def test_iris_multiclass_workflow():

@@ -532,44 +532,126 @@ class WorkflowModel:
         }
 
     def summary_pretty(self) -> str:
-        """Human-readable training summary (OpWorkflowModel.summaryPretty,
-        rendered like the reference README tables)."""
+        """Human-readable training summary matching the reference
+        README's summaryPretty rendering (/root/reference/README.md:63-96):
+        the evaluated-families lead, the selected model's PARAMETER table,
+        one combined holdout/training metric table, and the
+        correlation-ranked top-insights + contributions tables."""
         from ..utils.table import render_table
 
         s = self.summary_json()
         lines: list[str] = []
         sel = s.get("modelSelectorSummary")
         if sel:
-            lines.append("Evaluated model candidates (CV means):")
+            results = sel["validationResults"]
             by_family: dict[str, list[float]] = {}
-            for r in sel["validationResults"]:
+            for r in results:
                 by_family.setdefault(r["modelName"], []).append(r["metricMean"])
-            rows = [
-                [name, str(len(vals)),
-                 f"[{min(vals):.4f}, {max(vals):.4f}]"]
-                for name, vals in sorted(by_family.items())
-            ]
+            metric = sel["evaluationMetric"]
+            n_folds = len(results[0].get("metricValues", [])) if results else 0
+            lines.append(
+                f"Evaluated {', '.join(sorted(by_family))} models with "
+                f"{n_folds} folds and {metric} metric."
+            )
+            for name, vals in sorted(by_family.items()):
+                lines.append(
+                    f"Evaluated {len(vals)} {name} models with {metric} "
+                    f"between [{min(vals)}, {max(vals)}]"
+                )
+            lines.append("")
+            # selected-model parameter table (README: "Selected model Random
+            # Forest classifier with parameters")
+            lines.append(
+                f"Selected model {sel['bestModelType']} with parameters:"
+            )
+            params: dict[str, Any] = {"modelType": sel["bestModelType"]}
+            best_model = None
+            if self.selector_info is not None:
+                stage = self.fitted.get(self.selector_info["estimatorUid"])
+                best_model = getattr(stage, "best_model", None)
+            if best_model is not None:
+                params.update(best_model.get_params())
+            params.update(sel.get("bestGrid", {}))
             lines.append(
                 render_table(
-                    ["Model", "Candidates", f"{sel['evaluationMetric']} range"], rows
+                    ["Model Param", "Value"],
+                    [[k, str(v)] for k, v in sorted(params.items())],
                 )
             )
-            lines.append(f"Selected model: {sel['bestModelType']} {sel['bestGrid']}")
-            for split_name, key in (
-                ("Train", "trainEvaluation"),
-                ("Holdout", "holdoutEvaluation"),
-            ):
-                m = sel.get(key)
-                if m:
-                    scalars = {
-                        k: v for k, v in m.items() if isinstance(v, (int, float))
-                    }
-                    lines.append(
-                        render_table(
-                            ["Metric", split_name],
-                            [[k, f"{v:.4f}"] for k, v in scalars.items()],
-                        )
+            lines.append("")
+            # ONE combined metric table, holdout + training side by side
+            train_m = sel.get("trainEvaluation") or {}
+            hold_m = sel.get("holdoutEvaluation") or {}
+            keys = [
+                k for k in {**hold_m, **train_m}
+                if isinstance((hold_m.get(k, train_m.get(k))), (int, float))
+            ]
+            if keys:
+                lines.append("Model evaluation metrics:")
+                lines.append(
+                    render_table(
+                        ["Metric Name", "Hold Out Set Value",
+                         "Training Set Value"],
+                        [
+                            [k, str(hold_m.get(k, "")), str(train_m.get(k, ""))]
+                            for k in keys
+                        ],
                     )
+                )
+                lines.append("")
+            # top insights by label correlation + model contributions
+            # (README: "Top model insights computed using correlation")
+            try:
+                from ..insights.model_insights import model_insights
+
+                ins = model_insights(self)
+                derived = [
+                    d
+                    for f in ins.get("features", [])
+                    for d in f.get("derivedFeatures", [])
+                ]
+                ilines: list[str] = []
+                with_corr = [
+                    d for d in derived
+                    if isinstance(d.get("corr"), (int, float))
+                    and np.isfinite(d["corr"])
+                ]
+                with_corr.sort(key=lambda d: -d["corr"])
+                pos = [d for d in with_corr if d["corr"] >= 0]
+                if with_corr:
+                    ilines.append(
+                        "Top model insights computed using correlation:"
+                    )
+                    if pos:
+                        ilines.append(render_table(
+                            ["Top Positive Insights", "Correlation"],
+                            [[d["derivedFeatureName"], f"{d['corr']:.4f}"]
+                             for d in pos[:7]],
+                        ))
+                    negs = [d for d in reversed(with_corr) if d["corr"] < 0]
+                    if negs:
+                        ilines.append(render_table(
+                            ["Top Negative Insights", "Correlation"],
+                            [[d["derivedFeatureName"], f"{d['corr']:.4f}"]
+                             for d in negs[:7]],
+                        ))
+                    ilines.append("")
+                with_contrib = [
+                    d for d in derived
+                    if isinstance(d.get("contribution"), (int, float))
+                ]
+                with_contrib.sort(key=lambda d: -abs(d["contribution"]))
+                if with_contrib and any(d["contribution"] for d in with_contrib):
+                    ilines.append("Top Contributions:")
+                    ilines.append(render_table(
+                        ["Top Contributions", "Value"],
+                        [[d["derivedFeatureName"], f"{d['contribution']:.4f}"]
+                         for d in with_contrib[:7]],
+                    ))
+                    ilines.append("")
+                lines.extend(ilines)  # all-or-nothing: no dangling headers
+            except Exception as e:  # insights are best-effort here
+                log.debug("summary_pretty insights skipped: %s", e)
         lines.append(
             f"Trained on {s['trainRows']} rows (holdout {s['holdoutRows']}); "
             f"{len(s['rawFeatures'])} raw features"
