@@ -658,8 +658,11 @@ def _grow_tree_impl(
 
 def predict_tree(binned: jax.Array, tree: Tree) -> jax.Array:
     """Leaf value per row — lax.scan over the [depth, ...] level arrays
-    (one shared gather body; an unrolled depth loop multiplies program
-    bytes by depth, which is what ships over the tunneled link)."""
+    (one shared gather body). An unrolled depth loop with level-sliced
+    one-hot lookups was measured: warm eval 1.55 -> 1.33 s, but the
+    vmapped sweep programs grew ~depth×, and re-banking/contention cost far
+    more than the exec win — program bytes ship over the tunneled link, so
+    the scan stays."""
     n = binned.shape[0]
 
     def level(node, sfsb):
