@@ -1,0 +1,108 @@
+"""Sentence splitter fixtures (OpenNLP SentenceDetector replacement —
+nlp/sentences.py; NameEntityRecognizer runs per sentence)."""
+from transmogrifai_tpu.nlp.sentences import split_sentences
+
+
+def test_basic_split():
+    s = split_sentences("The cat sat. The dog barked! Did it rain? Yes.")
+    assert s == ["The cat sat.", "The dog barked!", "Did it rain?", "Yes."]
+
+
+def test_abbreviations_do_not_split():
+    s = split_sentences("Mr. Smith met Dr. Jones at 5 p.m. yesterday. "
+                        "They talked.")
+    assert len(s) == 2
+    assert s[0].startswith("Mr. Smith") and s[1] == "They talked."
+
+
+def test_initials_do_not_split():
+    s = split_sentences("J. K. Rowling wrote it. I read it.")
+    assert s == ["J. K. Rowling wrote it.", "I read it."]
+
+
+def test_decimals_and_numbers():
+    s = split_sentences("Pi is 3.14 roughly. The price rose 2.5 percent.")
+    assert len(s) == 2
+
+
+def test_dotted_acronyms():
+    s = split_sentences("She moved to the U.S. in May. He stayed.")
+    assert s == ["She moved to the U.S. in May.", "He stayed."]
+
+
+def test_quotes_and_closers():
+    s = split_sentences('He said "stop." Then he left.')
+    assert s == ['He said "stop."', "Then he left."]
+
+
+def test_german_abbrevs_and_ordinals():
+    s = split_sentences(
+        "Das Treffen ist am 3. Oktober. Dr. Meier kommt z.B. später. Gut.",
+        language="de",
+    )
+    assert len(s) == 3
+    assert s[0] == "Das Treffen ist am 3. Oktober."
+
+
+def test_spanish_abbrevs():
+    s = split_sentences(
+        "El Sr. García llegó tarde. La Dra. López no vino.", language="es"
+    )
+    assert s == ["El Sr. García llegó tarde.", "La Dra. López no vino."]
+
+
+def test_empty_and_single():
+    assert split_sentences("") == []
+    assert split_sentences("   ") == []
+    assert split_sentences("One sentence without a period") == [
+        "One sentence without a period"
+    ]
+
+
+def test_ellipsis_kept_with_sentence():
+    s = split_sentences("Well… Maybe so. It happened.")
+    assert s[-1] == "It happened."
+
+
+def test_ner_sentence_opener_discounted():
+    """'The' opening a sentence is not an entity; real names still are."""
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.ops.text_stages import NameEntityRecognizer
+    from transmogrifai_tpu.types import Text
+    from transmogrifai_tpu.types.columns import column_from_values
+
+    f = FeatureBuilder.Text("t").as_predictor()
+    ner = NameEntityRecognizer().set_input(f)
+    col = column_from_values(Text, [
+        "The weather was bad. John Smith stayed home. Nothing happened.",
+    ])
+    out = ner.transform_columns(col, num_rows=1).to_list()[0]
+    persons = out.get("Person", frozenset())
+    assert "john" in persons and "smith" in persons
+    all_toks = set().union(*out.values()) if out else set()
+    assert "the" not in all_toks and "nothing" not in all_toks
+
+
+def test_decimal_at_sentence_end_splits():
+    s = split_sentences("The price was 3.5. Next day it fell.")
+    assert s == ["The price was 3.5.", "Next day it fell."]
+
+
+def test_ner_sentence_final_and_quoted_openers():
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.ops.text_stages import NameEntityRecognizer
+    from transmogrifai_tpu.types import Text
+    from transmogrifai_tpu.types.columns import column_from_values
+
+    f = FeatureBuilder.Text("t").as_predictor()
+    ner = NameEntityRecognizer().set_input(f)
+    col = column_from_values(Text, [
+        "He met John.",                      # entity abuts the final period
+        '"The dog barked." Mary left.',      # quoted opener still discounted
+        "North is cold. It snowed.",         # LOC-hint opener survives
+    ])
+    rows = ner.transform_columns(col, num_rows=3).to_list()
+    assert "john" in rows[0].get("Person", frozenset()), rows[0]
+    assert "mary" in rows[1].get("Person", frozenset()), rows[1]
+    assert "the" not in set().union(*rows[1].values()), rows[1]
+    assert "north" in rows[2].get("Location", frozenset()), rows[2]

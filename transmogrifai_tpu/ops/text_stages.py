@@ -927,6 +927,15 @@ class NameEntityRecognizer(Transformer):
         self.use_model = use_model
 
     def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        # reference pipeline shape: sentence-split -> tokenize -> find
+        # (NameEntityRecognizer.scala with the OpenNLP sentence model —
+        # here nlp/sentences.py): a capitalized SENTENCE OPENER is only an
+        # entity when the dictionary/char-model recognizes it, which kills
+        # the 'every sentence start is a Misc entity' false positives of
+        # whole-text capital-run scanning
+        from ..nlp.langid import detect
+        from ..nlp.sentences import split_sentences
+
         col = cols[0]
         assert isinstance(col, TextColumn)
         out = []
@@ -935,20 +944,38 @@ class NameEntityRecognizer(Transformer):
                 out.append({})
                 continue
             ents: dict[str, set] = {}
-            for run in re.findall(r"(?:[A-Z][\w'-]*(?:\s+|$))+", v):
-                toks = run.split()
-                lows = [t.lower().strip(".,") for t in toks]
-                if any(
-                    _is_name_token(t, self.names, self.use_model)
-                    for t in lows
+            for sent in split_sentences(v, language=detect(v) or "en"):
+                # index of the first non-quote/bracket char: the opener
+                # discount must also apply to '"The dog barked."'
+                lead = 0
+                while lead < len(sent) and sent[lead] in "\"'«“‘([":
+                    lead += 1
+                for m in re.finditer(
+                    r"[A-Z][\w'-]*(?:\s+[A-Z][\w'-]*)*", sent
                 ):
-                    kind = "Person"
-                elif any(t in self._ORG_HINTS for t in lows):
-                    kind = "Organization"
-                elif any(t in self._LOC_HINTS for t in lows):
-                    kind = "Location"
-                else:
-                    kind = "Misc"
-                ents.setdefault(kind, set()).update(lows)
+                    toks = m.group(0).split()
+                    lows = [t.lower() for t in toks]
+                    if (
+                        m.start() == lead
+                        and len(toks) == 1
+                        and not _is_name_token(
+                            lows[0], self.names, self.use_model
+                        )
+                        and lows[0] not in self._ORG_HINTS
+                        and lows[0] not in self._LOC_HINTS
+                    ):
+                        continue  # bare sentence opener, not an entity
+                    if any(
+                        _is_name_token(t, self.names, self.use_model)
+                        for t in lows
+                    ):
+                        kind = "Person"
+                    elif any(t in self._ORG_HINTS for t in lows):
+                        kind = "Organization"
+                    elif any(t in self._LOC_HINTS for t in lows):
+                        kind = "Location"
+                    else:
+                        kind = "Misc"
+                    ents.setdefault(kind, set()).update(lows)
             out.append({k: frozenset(s) for k, s in ents.items()})
         return MapColumn(MultiPickListMap, out)
