@@ -105,3 +105,21 @@ def test_name_detection_bounds():
     nm = mod.eval_names(n=200, ref=ref)
     assert nm["recall"] >= 0.6, nm
     assert nm["precision"] >= 0.75, nm
+
+
+def test_es_nl_ner_recall_floor():
+    """The reference ships es/nl person finders — our measured recall on
+    the shared fixtures must stay above the floor (same harness as
+    PARITY.md)."""
+    import importlib.util
+
+    tool = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "nlp_agreement.py",
+    )
+    spec = importlib.util.spec_from_file_location("nlp_agreement2", tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.eval_ner()
+    assert rec["es"] >= 0.9, rec
+    assert rec["nl"] >= 0.7, rec

@@ -82,6 +82,51 @@ def eval_names(n: int = 500, ref: str = REF) -> dict:
     }
 
 
+#: es/nl NER fixtures (the reference ships OpenNLP person-finder binaries
+#: for exactly these two languages, models/README.md): authored sentences,
+#: gold person tokens
+_NER_FIXTURES = {
+    "es": [
+        ("María García llegó tarde a la reunión.", {"maría", "garcía"}),
+        ("El informe fue escrito por Carlos Hernández.", {"carlos", "hernández"}),
+        ("Lucía Fernández y Diego Martínez viajaron juntos.",
+         {"lucía", "fernández", "diego", "martínez"}),
+        ("La empresa contrató a Javier López en marzo.", {"javier", "lópez"}),
+        ("Ana Torres presentó los resultados.", {"ana", "torres"}),
+    ],
+    "nl": [
+        ("Jan van der Berg woont in Amsterdam.", {"jan", "berg"}),
+        ("Het rapport is geschreven door Pieter de Vries.", {"pieter", "vries"}),
+        ("Anna Bakker en Willem Jansen reisden samen.",
+         {"anna", "bakker", "willem", "jansen"}),
+        ("Het bedrijf nam Sophie van Dijk aan.", {"sophie", "dijk"}),
+        ("Daan Visser presenteerde de resultaten.", {"daan", "visser"}),
+    ],
+}
+
+
+def eval_ner() -> dict[str, float]:
+    """Person-token recall per language on the authored fixtures."""
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.ops.text_stages import NameEntityRecognizer
+    from transmogrifai_tpu.types import Text
+    from transmogrifai_tpu.types.columns import column_from_values
+
+    f = FeatureBuilder.Text("t").as_predictor()
+    ner = NameEntityRecognizer().set_input(f)
+    out = {}
+    for lang, cases in _NER_FIXTURES.items():
+        col = column_from_values(Text, [s for s, _ in cases])
+        rows = ner.transform_columns(col, num_rows=len(cases)).to_list()
+        hit = total = 0
+        for (_, gold), row in zip(cases, rows):
+            persons = row.get("Person", frozenset())
+            hit += len(gold & set(persons))
+            total += len(gold)
+        out[lang] = hit / max(total, 1)
+    return out
+
+
 def main() -> None:
     rows = eval_langid()
     total = sum(n for _, _, n in rows)
@@ -100,6 +145,11 @@ def main() -> None:
     print(f"precision {nm['precision']:.1%} / recall {nm['recall']:.1%} "
           f"on {nm['n_pos']} name pairs vs {nm['n_neg']} "
           f"street/country/city negatives ({nm['source']})")
+
+    ner = eval_ner()
+    print("\n## es/nl entity recognition (NameEntityRecognizer)\n")
+    for lang, rec in sorted(ner.items()):
+        print(f"{lang}: person-token recall {rec:.0%} on authored fixtures")
 
 
 if __name__ == "__main__":

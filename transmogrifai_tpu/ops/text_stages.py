@@ -951,7 +951,7 @@ class NameEntityRecognizer(Transformer):
                 while lead < len(sent) and sent[lead] in "\"'«“‘([":
                     lead += 1
                 for m in re.finditer(
-                    r"[A-Z][\w'-]*(?:\s+[A-Z][\w'-]*)*", sent
+                    r"[A-ZÀ-Þ][\w'-]*(?:\s+(?:(?:van|de|der|den|ter|te|la|del|da|di|von|el)\s+)*[A-ZÀ-Þ][\w'-]*)*", sent
                 ):
                     toks = m.group(0).split()
                     lows = [t.lower() for t in toks]
