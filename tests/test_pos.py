@@ -1,0 +1,61 @@
+"""POS tagger + NP chunker fixtures (OpenNLP pos-maxent/chunker
+replacement — nlp/pos.py). Accuracy is measured over an authored gold
+corpus and the floor pinned; tools/nlp_agreement.py reports the number."""
+from transmogrifai_tpu.nlp.pos import chunk_noun_phrases, pos_tag
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "nlp_agreement.py",
+)
+_spec = importlib.util.spec_from_file_location("nlp_agreement_pos", _TOOL)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+measured_accuracy = _mod.eval_pos
+GOLD = _mod.POS_GOLD
+
+
+def test_pos_accuracy_floor():
+    # PARITY.md reports the measured number; this floor must stay within
+    # rounding of it so the claim cannot silently go stale
+    acc = measured_accuracy()
+    assert acc >= 0.9, f"POS accuracy regressed: {acc:.1%}"
+
+
+def test_closed_class_words():
+    assert pos_tag(["the"]) == ["DT"]
+    assert pos_tag(["between"]) == ["IN"]
+    assert pos_tag(["would"]) == ["MD"]
+
+
+def test_shape_rules():
+    tags = pos_tag(["He", "sadly", "watched", "the", "sinking", "ship"])
+    assert tags[1] == "RB" and tags[2] == "VBD" and tags[4] in ("VBG", "JJ")
+
+
+def test_contextual_patches():
+    # verb-shaped noun after a determiner
+    assert pos_tag(["the", "building"])[-1] == "NN"
+    # base verb after 'to' and after a modal
+    assert pos_tag(["to", "work"])[-1] == "VB"
+    assert pos_tag(["they", "must", "report"])[-1] == "VB"
+
+
+def test_np_chunker():
+    toks = "The old house had a beautiful garden".split()
+    nps = chunk_noun_phrases(toks)
+    assert "The old house" in nps
+    assert any(np.endswith("garden") for np in nps)
+
+
+def test_np_chunker_proper_nouns():
+    toks = "Mary Johnson visited the London office".split()
+    nps = chunk_noun_phrases(toks)
+    assert any("Mary Johnson" in np for np in nps)
+    assert any("office" in np for np in nps)
+
+
+def test_punctuation_tags():
+    assert pos_tag(["Stop", "!"])[-1] == "."

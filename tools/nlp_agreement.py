@@ -127,6 +127,44 @@ def eval_ner() -> dict[str, float]:
     return out
 
 
+#: (tokens, gold tags) — authored everyday-English gold corpus; the ONE
+#: definition shared with tests/test_pos.py (same pattern as eval_names)
+POS_GOLD = [
+    (["The", "dog", "barked", "at", "the", "mailman"],
+     ["DT", "NN", "VBD", "IN", "DT", "NN"]),
+    (["She", "quickly", "finished", "her", "homework"],
+     ["PRP", "RB", "VBD", "PRP$", "NN"]),
+    (["John", "will", "visit", "London", "next", "week"],
+     ["NNP", "MD", "VB", "NNP", "JJ", "NN"]),
+    (["The", "old", "house", "was", "very", "cold"],
+     ["DT", "JJ", "NN", "VB", "RB", "JJ"]),
+    (["They", "want", "to", "build", "a", "new", "school"],
+     ["PRP", "VB", "TO", "VB", "DT", "JJ", "NN"]),
+    (["Three", "students", "missed", "the", "morning", "meeting"],
+     ["CD", "NNS", "VBD", "DT", "NN", "NN"]),
+    (["He", "is", "reading", "an", "interesting", "book"],
+     ["PRP", "VB", "VBG", "DT", "JJ", "NN"]),
+    (["The", "committee", "rejected", "the", "proposal", "again"],
+     ["DT", "NN", "VBD", "DT", "NN", "RB"]),
+    (["Mary", "and", "Peter", "walked", "in", "the", "park"],
+     ["NNP", "CC", "NNP", "VBD", "IN", "DT", "NN"]),
+    (["We", "should", "leave", "before", "the", "storm"],
+     ["PRP", "MD", "VB", "IN", "DT", "NN"]),
+]
+
+
+def eval_pos() -> float:
+    """POS token accuracy over POS_GOLD."""
+    from transmogrifai_tpu.nlp.pos import pos_tag
+
+    hits = total = 0
+    for toks, gold in POS_GOLD:
+        tags = pos_tag(toks)
+        hits += sum(1 for a, b in zip(tags, gold) if a == b)
+        total += len(gold)
+    return hits / total
+
+
 def main() -> None:
     rows = eval_langid()
     total = sum(n for _, _, n in rows)
@@ -150,6 +188,9 @@ def main() -> None:
     print("\n## es/nl entity recognition (NameEntityRecognizer)\n")
     for lang, rec in sorted(ner.items()):
         print(f"{lang}: person-token recall {rec:.0%} on authored fixtures")
+
+    print("\n## POS tagging (nlp/pos.py, English)\n")
+    print(f"token accuracy {eval_pos():.1%} on the authored gold corpus")
 
 
 if __name__ == "__main__":

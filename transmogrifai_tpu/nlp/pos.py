@@ -1,0 +1,186 @@
+"""Part-of-speech tagging + noun-phrase chunking — the OpenNLP
+``*-pos-maxent.bin`` / ``*-chunker.bin`` replacement.
+
+Reference: the OpenNLP binaries under
+/root/reference/models/src/main/resources/OpenNLP/ include POS and chunker
+models (models/README.md); the NER pipeline family uses them for
+sentence → token → tag → chunk analysis. The Maxent models are replaced by
+a transparent three-layer rule tagger:
+
+  1. closed-class lexicon — determiners, prepositions, pronouns,
+     conjunctions, modals, auxiliaries, numbers (closed classes ARE a
+     lexicon; no model needed);
+  2. open-class suffix/shape rules — -ly → RB, -ing → VBG, -ed → VBD,
+     -tion/-ment/-ness → NN, -ous/-ful/-ive → JJ, capitalized → NNP,
+     digits → CD;
+  3. contextual patches (Brill-style) — e.g. after a determiner or
+     adjective, a verb-shaped token is re-tagged noun ("the building"),
+     after "to" a base verb wins, after a modal a base verb wins.
+
+Tags are the familiar Penn coarse set. Accuracy is fixture-measured
+(tests/test_pos.py pins the floor; tools/nlp_agreement.py reports it) —
+the goal is honest utility for the chunker and downstream feature
+engineering, not treebank SOTA.
+"""
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------- lexicons
+_CLOSED: dict[str, str] = {}
+for _w in "the a an this that these those each every some any no".split():
+    _CLOSED[_w] = "DT"
+for _w in ("in on at by for with from of to about into over under between "
+           "through during against among without within across behind "
+           "below above near before after since until").split():
+    _CLOSED[_w] = "IN"
+for _w in "i you he she it we they me him us them".split():
+    _CLOSED[_w] = "PRP"
+for _w in "my your his its our their her".split():
+    # 'her' defaults possessive (determiner position dominates noun-phrase
+    # text); the contextual patch below flips clause-final/pre-verb uses
+    _CLOSED[_w] = "PRP$"
+for _w in "and or but nor yet so".split():
+    _CLOSED[_w] = "CC"
+for _w in "can could may might must shall should will would".split():
+    _CLOSED[_w] = "MD"
+for _w in ("is am are was were be been being has have had do does did "
+           "doing").split():
+    _CLOSED[_w] = "VB"      # auxiliaries tag as verbs (coarse)
+for _w in "not n't never".split():
+    _CLOSED[_w] = "RB"
+for _w in ("one two three four five six seven eight nine ten hundred "
+           "thousand million billion").split():
+    _CLOSED[_w] = "CD"
+for _w in "who what when where why how which whose whom".split():
+    _CLOSED[_w] = "WP"
+for _w in "there here".split():
+    _CLOSED[_w] = "RB"
+_CLOSED["to"] = "TO"
+
+#: frequent open-class words whose suffix shape misleads
+_OPEN: dict[str, str] = {}
+for _w in ("time year day man woman people child world life hand part "
+           "place work week case point company number house water money "
+           "story month lot right study book eye job word business issue "
+           "side kind head far group problem fact price market result "
+           "morning weather plan report meeting dog cat car park").split():
+    _OPEN[_w] = "NN"
+for _w in ("said says go went gone come came get got make made know knew "
+           "think thought take took see saw want use find found give gave "
+           "tell told ask asked seem felt leave left call put mean kept "
+           "let begin began show showed hear heard run ran move moved "
+           "like live lived believe bring brought happen happened write "
+           "wrote sit sat stand stood lose lost pay paid meet met include "
+           "set learn learned stayed arrived explained barked failed "
+           "decided talked stopped walked rose fell").split():
+    _OPEN[_w] = "VBD" if _w.endswith("ed") or _w in (
+        "went", "came", "got", "made", "knew", "thought", "took", "saw",
+        "gave", "told", "found", "felt", "began", "heard", "ran", "wrote",
+        "sat", "stood", "lost", "paid", "met", "said", "kept", "left",
+        "brought", "rose", "fell",
+    ) else "VB"
+for _w in ("good new first last long great little own other old big high "
+           "small large next early young important few public bad same "
+           "able cold hot warm late red blue green dark bright").split():
+    _OPEN[_w] = "JJ"
+for _w in ("very also just now then even still too well really quite "
+           "always never often already yesterday today tomorrow soon "
+           "maybe perhaps again later").split():
+    _OPEN[_w] = "RB"
+
+_NUM_RE = re.compile(r"^\d[\d.,]*$")
+
+
+def _shape_tag(tok: str, sentence_initial: bool) -> str:
+    low = tok.lower()
+    if _NUM_RE.match(tok):
+        return "CD"
+    if tok[:1].isupper() and not sentence_initial:
+        return "NNP"
+    if low.endswith("ly"):
+        return "RB"
+    if low.endswith("ing") and len(low) > 4:
+        return "VBG"
+    if low.endswith("ed") and len(low) > 3:
+        return "VBD"
+    if low.endswith(("tion", "sion", "ment", "ness", "ity", "ance", "ence",
+                     "ship", "ism", "er", "or", "ist")):
+        return "NN"
+    if low.endswith(("ous", "ful", "ive", "able", "ible", "al", "ic")):
+        return "JJ"
+    if low.endswith("s") and not low.endswith(("ss", "us", "is")) and len(low) > 3:
+        return "NNS"
+    return "NN"
+
+
+def pos_tag(tokens: list[str]) -> list[str]:
+    """Penn-style coarse tags for a tokenized ENGLISH sentence (the only
+    language the rule layers cover — the reference ships POS binaries for
+    more, a documented gap)."""
+    tags: list[str] = []
+    for i, tok in enumerate(tokens):
+        low = tok.lower()
+        if not any(c.isalnum() for c in tok):
+            tags.append(".")
+            continue
+        tag = _CLOSED.get(low) or _OPEN.get(low) or _shape_tag(tok, i == 0)
+        tags.append(tag)
+    # Brill-style contextual patches
+    for i in range(len(tags)):
+        prev = tags[i - 1] if i else None
+        nxt = tags[i + 1] if i + 1 < len(tags) else None
+        if prev in ("DT", "JJ", "PRP$") and tags[i] in ("VB", "VBD"):
+            tags[i] = "NN"           # "the building", "his work"
+        elif (
+            prev in ("DT", "PRP$") and tags[i] == "VBG"
+            and nxt not in ("NN", "NNS", "NNP")
+        ):
+            tags[i] = "NN"           # "the building stood" vs "the sinking ship"
+        elif (
+            tags[i] == "JJ" and prev in ("DT", "JJ", "PRP$")
+            and nxt not in ("NN", "NNS", "NNP", "JJ", "VBG", "CD")
+        ):
+            tags[i] = "NN"           # headless adjective = -al noun
+                                     # ("a new proposal", "the arrival")
+        elif prev == "TO" and tags[i] in ("NN", "VBD"):
+            tags[i] = "VB"           # "to work"
+        elif prev == "MD" and tags[i] in ("NN", "VBD"):
+            tags[i] = "VB"           # "will report"
+        elif prev == "PRP" and tags[i] == "NN" and i == 1:
+            tags[i] = "VB"           # "I work ..."
+        if (
+            tokens[i].lower() == "her"
+            and (nxt is None or nxt in ("VB", "VBD", "MD", "IN", "."))
+        ):
+            tags[i] = "PRP"          # object 'her': "saw her", "told her."
+    return tags
+
+
+#: NP := (DT)? (JJ|VBG|CD|NNP)* (NN|NNS|NNP)+   — the classic regexp chunk
+_NP_RE = re.compile(r"(DT )?((?:JJ |VBG |CD |NNP )*)((?:NN[SP]? )+)")
+
+
+def chunk_noun_phrases(tokens: list[str], tags: list[str] | None = None
+                       ) -> list[str]:
+    """Noun phrases as token strings (OpenNLP chunker stand-in: the
+    classic tag-regexp NP grammar over the rule tagger's output)."""
+    if tags is None:
+        tags = pos_tag(tokens)
+    tag_str = "".join(t + " " for t in tags)
+    out: list[str] = []
+    # map char offsets in tag_str back to token indices
+    starts = []
+    off = 0
+    for t in tags:
+        starts.append(off)
+        off += len(t) + 1
+    for m in _NP_RE.finditer(tag_str):
+        first = starts.index(m.start())
+        last_char = m.end() - 1
+        last = next(
+            i for i in range(len(starts) - 1, -1, -1)
+            if starts[i] < last_char
+        )
+        out.append(" ".join(tokens[first:last + 1]))
+    return out
