@@ -1,0 +1,20 @@
+"""profile_bench with DEBUG logging on the gbdt/validators loggers and
+timestamped sweep internals."""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logging.basicConfig(
+    level=logging.INFO, format="%(asctime)s.%(msecs)03d %(name)s %(message)s",
+    datefmt="%H:%M:%S",
+)
+for name in ("transmogrifai_tpu.models.gbdt",
+             "transmogrifai_tpu.selector.validators"):
+    logging.getLogger(name).setLevel(logging.DEBUG)
+
+sys.argv = [sys.argv[0]]
+from tools import profile_bench  # noqa: E402
+
+profile_bench.main()

@@ -1,0 +1,74 @@
+"""Time the flagship RF sweep in isolation, phase by phase.
+
+Usage: python tools/profile_rf.py [--debug]
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (enables the compile cache)
+import numpy as np  # noqa: E402
+
+if "--debug" in sys.argv:
+    logging.basicConfig(level=logging.DEBUG,
+                        format="%(asctime)s %(name)s %(message)s")
+    logging.getLogger("jax").setLevel(logging.WARNING)
+
+
+def main() -> None:
+    import threading
+
+    from transmogrifai_tpu.utils import aot
+
+    warm = threading.Thread(target=aot.prewarm, daemon=True)
+    warm.start()
+
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.prep import SanityChecker
+    from transmogrifai_tpu.readers import infer_csv_dataset
+    from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+
+    ds = infer_csv_dataset(bench.TITANIC)
+    resp, preds = from_dataset(ds, response="Survived")
+    preds = [p for p in preds if p.name != "PassengerId"]
+    vector = transmogrify(preds)
+    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+    data, _ = fit_and_transform_dag(ds, [checked, resp])
+    x = np.asarray(data[checked.name].values, dtype=np.float32)
+    y = np.asarray(data[resp.name].values, dtype=np.float64)
+    print(f"x {x.shape}")
+
+    from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+    from transmogrifai_tpu.models import RandomForestClassifier
+    from transmogrifai_tpu.selector.model_selector import _rf_grid
+    from transmogrifai_tpu.selector.validators import CrossValidator, expand_grid
+
+    est = RandomForestClassifier()
+    points = expand_grid(_rf_grid())
+    cv = CrossValidator(num_folds=3, seed=42)
+    folds = cv.split_masks(y)
+    evaluator = BinaryClassificationEvaluator()
+    extra = [np.ones(len(y), dtype=np.float32)]
+
+    # phase 1: the batched fit
+    all_masks = [tm.astype(np.float32) for tm, _ in folds] + extra
+    for rep in range(2):
+        t0 = time.perf_counter()
+        models_by_fold = est.fit_arrays_batched_masks(x, y, all_masks, points)
+        t1 = time.perf_counter()
+        vals = est.sweep_eval_batched(
+            models_by_fold[: len(folds)], x, y, folds, evaluator
+        )
+        t2 = time.perf_counter()
+        print(f"rep{rep}: fit {t1-t0:6.2f}s  sweep_eval {t2-t1:6.2f}s  "
+              f"total {t2-t0:6.2f}s  (vals ok={vals is not None})")
+
+
+if __name__ == "__main__":
+    main()

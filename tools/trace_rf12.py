@@ -1,0 +1,57 @@
+"""Trace the flagship depth-12 RF group program on the real chip."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import bench as B  # noqa: E402
+from transmogrifai_tpu.features import from_dataset  # noqa: E402
+from transmogrifai_tpu.models import trees as TR  # noqa: E402
+from transmogrifai_tpu.models.gbdt import _feature_bin_groups  # noqa: E402
+from transmogrifai_tpu.ops import transmogrify  # noqa: E402
+from transmogrifai_tpu.prep import SanityChecker  # noqa: E402
+from transmogrifai_tpu.readers import infer_csv_dataset  # noqa: E402
+from transmogrifai_tpu.workflow.fit import fit_and_transform_dag  # noqa: E402
+
+ds = infer_csv_dataset(B.TITANIC)
+resp, preds = from_dataset(ds, response="Survived")
+preds = [p for p in preds if p.name != "PassengerId"]
+vector = transmogrify(preds)
+checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
+data, _ = fit_and_transform_dag(ds, [checked, resp])
+x = np.asarray(data[checked.name].values, dtype=np.float32)
+y = np.asarray(data[resp.name].values, dtype=np.float64)
+n = len(y)
+
+thr = TR.quantile_thresholds(x, 32)
+binned = TR.bin_data(jnp.asarray(x), jnp.asarray(thr))
+fg = _feature_bin_groups(x)
+rng = np.random.default_rng(0)
+masks = np.stack([(rng.random(n) < 0.67).astype(np.float32) for _ in range(4)])
+rm24 = jnp.asarray(np.repeat(masks, 6, axis=0))
+yj = jnp.asarray((y == 1).astype(np.float32))
+colsample = 1.0 / np.sqrt(x.shape[1])
+
+
+def run():
+    trees, outs = TR.fit_forest_batched(
+        binned, yj, rm24, num_trees=50, max_depth=12,
+        num_bins=32, subsample_rate=1.0, colsample_rate=float(colsample),
+        min_instances=10.0, min_info_gain=0.001, seed=42,
+        lowp=True, feature_groups=fg, return_outputs=True,
+    )
+    jax.block_until_ready(outs)
+
+
+run()
+t0 = time.perf_counter(); run(); print(f"warm {time.perf_counter()-t0:.2f}s")
+jax.profiler.start_trace("/tmp/jaxtrace_rf12")
+run()
+jax.profiler.stop_trace()
+print("trace done")

@@ -58,6 +58,16 @@ def score_function(
             plan.append(t)
     raw_features = list(model.raw_features)
     result_names = [f.name for f in model.result_features]
+    # build-time validation: every result feature must be produced by the
+    # plan (or be a raw input) — a stage-plan bug must fail here, not
+    # surface as rows silently missing keys at score time
+    produced = {f.name for f in raw_features}
+    produced.update(t.output_name for t in plan)
+    missing = [n for n in result_names if n not in produced]
+    if missing:
+        raise ValueError(
+            f"stage plan does not produce result feature(s) {missing}"
+        )
 
     def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
         n = len(rows)
@@ -80,8 +90,6 @@ def score_function(
             cols[t.output_name] = t.transform_columns(*ins, num_rows=b)
         out: list[dict[str, Any]] = [{} for _ in range(n)]
         for name in result_names:
-            if name not in cols:
-                continue
             # to_list renders Prediction columns as reference-keyed maps
             rendered = cols[name].to_list()
             for i in range(n):

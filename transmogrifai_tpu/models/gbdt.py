@@ -31,6 +31,13 @@ log = logging.getLogger(__name__)
 from .base import PredictorEstimator, PredictorModel
 from . import trees as TR
 
+import threading as _threading
+
+# (matrix, max_bins) -> (x-ref, thresholds, binned, fgroups); see
+# _TreeEstimator._binned
+_BINNED_CACHE: dict = {}
+_BINNED_LOCK = _threading.Lock()
+
 
 def _sigmoid(m: np.ndarray) -> np.ndarray:
     return 1.0 / (1.0 + np.exp(-m))
@@ -107,7 +114,12 @@ class _LazySlice:
                 # _batched_group_fit): plain numpy view
                 out = jax.tree.map(lambda a: a[self.lane], trees)
             else:
-                out = _stack_lane(trees, jnp.int32(self.lane))
+                from ..utils.aot import aot_call
+
+                out = aot_call(
+                    "stack_lane", _stack_lane,
+                    (trees, np.int32(self.lane)), {},
+                )
             cache[self.lane] = out
         return out
 
@@ -459,11 +471,34 @@ class _TreeEstimator(PredictorEstimator):
         self.max_bins = max_bins
 
     def _binned(self, x: np.ndarray):
-        """(thresholds, binned codes, narrow/wide feature groups)."""
+        """(thresholds, binned codes, narrow/wide feature groups).
+
+        Cached per (matrix, max_bins) across estimators and threads: every
+        family of a candidate sweep bins the SAME training matrix (XGB + 3
+        RF depth groups = 4 redundant device bin_data dispatches + host
+        quantile passes on the flagship otherwise). The cache keeps a
+        strong reference to x, so buffer-address keys cannot alias."""
+        key = (
+            x.__array_interface__["data"][0] if isinstance(x, np.ndarray)
+            else id(x),
+            getattr(x, "shape", None), getattr(x, "strides", None),
+            int(self.max_bins),
+        )
+        with _BINNED_LOCK:
+            hit = _BINNED_CACHE.get(key)
+        if hit is not None:
+            return hit[1], hit[2], hit[3]
         thresholds = TR.quantile_thresholds(x, self.max_bins)
-        return thresholds, TR.bin_data(
-            jnp.asarray(x, dtype=jnp.float32), jnp.asarray(thresholds)
-        ), _feature_bin_groups(x)
+        binned = TR.bin_data(
+            jnp.asarray(np.asarray(x, dtype=np.float32)),
+            jnp.asarray(thresholds),
+        )
+        fgroups = _feature_bin_groups(x)
+        with _BINNED_LOCK:
+            _BINNED_CACHE[key] = (x, thresholds, binned, fgroups)
+            while len(_BINNED_CACHE) > 4:
+                _BINNED_CACHE.pop(next(iter(_BINNED_CACHE)))
+        return thresholds, binned, fgroups
 
     def _fit_group_masks(self, x, y, masks, group_points):
         """Fit len(masks) × len(group_points) same-static-shape models in
@@ -502,7 +537,14 @@ class _TreeEstimator(PredictorEstimator):
             groups.setdefault(key, []).append(i)
         models: list[list] = [[None] * len(points) for _ in masks]
         mask_arr = np.stack(masks)
-        for idxs in groups.values():
+        # deepest group first: its program is the sweep's long pole on the
+        # chip, so putting it at the head of the device queue overlaps its
+        # execution with the shallower groups' host phases
+        def _depth_of(key_idxs):
+            merged = {**self.get_params(), **points[key_idxs[1][0]]}
+            return -int(merged.get("max_depth", 0) or 0)
+
+        for _, idxs in sorted(groups.items(), key=_depth_of):
             fitted = self._fit_group_masks(
                 x, y, mask_arr, [points[i] for i in idxs]
             )
@@ -550,12 +592,22 @@ class _TreeEstimator(PredictorEstimator):
             import time as _t
 
             _t0 = _t.perf_counter()
-            xj = jnp.asarray(x, dtype=jnp.float32)
+            xj = None
             outputs: dict[int, np.ndarray] = {}
             for m in flat:
                 stack = m._sweep_stack
                 sid = id(stack)
                 if sid in outputs:
+                    continue
+                if stack.get("outputs") is not None:
+                    # the fit program already computed every lane's raw
+                    # outputs on the training matrix — one tiny download,
+                    # no traversal program, no x upload
+                    outputs[sid] = np.asarray(stack["outputs"])
+                    log.debug(
+                        "sweep_eval outputs reused +%.2fs",
+                        _t.perf_counter() - _t0,
+                    )
                     continue
                 log.debug("sweep_eval stack start +%.2fs", _t.perf_counter() - _t0)
                 k = stack["k"]
@@ -572,6 +624,8 @@ class _TreeEstimator(PredictorEstimator):
                     if mode == "boost"
                     else TR.sweep_forest_outputs
                 )
+                if xj is None:
+                    xj = jnp.asarray(x, dtype=jnp.float32)
                 out = aot_call(
                     f"sweep_{mode}_outputs", fn,
                     (
@@ -612,24 +666,42 @@ class _TreeEstimator(PredictorEstimator):
         (fit k = mask_index * n_points + point_index), run the family's
         batched trainer, slice the [K, ...] tree pytree back out.
 
-        ``run_batched(binned, m0, row_mask_K, knob) -> [K, ...] tree pytree``
-        where ``knob(name)`` returns the [K] float32 array for a param;
+        ``run_batched(binned, m0, row_mask_K, knob) -> ([K, ...] tree
+        pytree, [K, N] training outputs-or-None)`` where ``knob(name)``
+        returns the [K] float32 array for a param;
         ``make_model(thresholds, sliced_trees, merged_params, mask_index)``.
+        The training outputs (every lane's raw model output on the full
+        training matrix, computed by the fit program itself) ride the stack
+        so sweep_eval_batched needs no re-traversal program.
         """
+        import time as _t
+
+        _t0 = _t.perf_counter()
         base = self.with_params(**group_points[0])
         thresholds, binned, fgroups = base._binned(x)
         self._last_feature_groups = fgroups
+        log.debug(
+            "%s group fit: binned in %.2fs", type(self).__name__,
+            _t.perf_counter() - _t0,
+        )
         norm = normalize or (lambda m: m)
         merged = [norm({**self.get_params(), **p}) for p in group_points]
         n_masks, n_pts = masks.shape[0], len(merged)
         row_mask_k = jnp.asarray(np.repeat(masks, n_pts, axis=0))
 
         def knob(name):
-            return jnp.asarray(
-                [float(m[name]) for m in merged] * n_masks, dtype=jnp.float32
+            # numpy (not jnp): eager dtype-converting transfers compile a
+            # device program per process on the axon backend; the batched
+            # trainers transfer these once inside their jitted calls
+            return np.asarray(
+                [float(m[name]) for m in merged] * n_masks, dtype=np.float32
             )
 
-        trees = run_batched(binned, merged[0], row_mask_k, knob, fgroups)
+        trees, outputs = run_batched(binned, merged[0], row_mask_k, knob, fgroups)
+        log.debug(
+            "%s group fit: dispatched at %.2fs", type(self).__name__,
+            _t.perf_counter() - _t0,
+        )
         # the stacked trees STAY on device for sweep_eval_batched (one
         # validation program per stack); per-model tree arrays materialize
         # lazily via _LazySlice — eager host pulls cost a ~44 MB download
@@ -648,6 +720,11 @@ class _TreeEstimator(PredictorEstimator):
             "trees": trees,
             "thresholds": thresholds,
             "k": n_masks * n_pts,
+            # [K, N] raw outputs on the training matrix straight from the
+            # fit program (device-resident until eval time; ~85 KB at
+            # flagship shapes). sweep_eval_batched downloads it instead of
+            # dispatching a traversal program + x upload per stack.
+            "outputs": outputs,
         }
         models = [
             [
@@ -746,10 +823,10 @@ class XGBoostClassifier(_TreeEstimator):
         num_classes = max(int(present.max()) + 1 if len(present) else 2, 2)
         if num_classes != 2:
             return None  # one-vs-rest loops stay sequential
-        yj = jnp.asarray(y, dtype=jnp.float32)
+        yj = np.asarray(y, dtype=np.float32)
 
         def run_batched(binned, m0, row_mask_k, knob, fgroups):
-            trees, _ = TR.fit_boosted_batched(
+            trees, margin = TR.fit_boosted_batched(
                 binned, yj, row_mask_k,
                 num_rounds=int(m0["num_round"]),
                 max_depth=int(m0["max_depth"]),
@@ -761,7 +838,8 @@ class XGBoostClassifier(_TreeEstimator):
                 objective="binary:logistic",
                 feature_groups=fgroups,
             )
-            return trees
+            # the final margin IS each lane's raw output on every row
+            return trees, margin
 
         return self._batched_group_fit(
             x, masks, group_points, run_batched,
@@ -798,7 +876,7 @@ class XGBoostRegressor(_TreeEstimator):
     _normalize_boost = XGBoostClassifier._normalize_boost
 
     def _fit_group_masks(self, x, y, masks, group_points):
-        yj = jnp.asarray(y, dtype=jnp.float32)
+        yj = np.asarray(y, dtype=np.float32)
         # per-mask base score = mean target over that mask's rows
         sums = masks @ y.astype(np.float64)
         cnts = masks.sum(axis=1)
@@ -806,10 +884,8 @@ class XGBoostRegressor(_TreeEstimator):
         n_pts = len(group_points)
 
         def run_batched(binned, m0, row_mask_k, knob, fgroups):
-            base_k = jnp.asarray(
-                np.repeat(base_scores, n_pts), dtype=jnp.float32
-            )
-            trees, _ = TR.fit_boosted_batched(
+            base_k = np.repeat(base_scores, n_pts).astype(np.float32)
+            trees, margin = TR.fit_boosted_batched(
                 binned, yj, row_mask_k,
                 num_rounds=int(m0["num_round"]),
                 max_depth=int(m0["max_depth"]),
@@ -822,7 +898,7 @@ class XGBoostRegressor(_TreeEstimator):
                 objective="reg:squarederror",
                 feature_groups=fgroups,
             )
-            return trees
+            return trees, margin
 
         return self._batched_group_fit(
             x, masks, group_points, run_batched,
@@ -1038,7 +1114,7 @@ class RandomForestClassifier(_TreeEstimator):
         if num_classes != 2:
             return None
         colsample = self._colsample(x.shape[1])
-        yj = jnp.asarray((y == 1).astype(np.float32))
+        yj = np.asarray((y == 1), dtype=np.float32)
 
         def run_batched(binned, m0, row_mask_k, knob, fgroups):
             # depth rides the lane axis: ONE program at the grid's max
@@ -1060,8 +1136,9 @@ class RandomForestClassifier(_TreeEstimator):
                 feature_groups=fgroups,
                 max_depth_v=(
                     None if uniform
-                    else jnp.asarray(depth_arr, dtype=jnp.int32)
+                    else depth_arr.astype(np.int32)
                 ),
+                return_outputs=True,
             )
 
         return self._batched_group_fit(
@@ -1125,7 +1202,7 @@ class RandomForestRegressor(_TreeEstimator):
 
     def _fit_group_masks(self, x, y, masks, group_points):
         colsample = self._colsample(x.shape[1])
-        yj = jnp.asarray(y, dtype=jnp.float32)
+        yj = np.asarray(y, dtype=np.float32)
 
         def run_batched(binned, m0, row_mask_k, knob, fgroups):
             depth_arr = np.asarray(knob("max_depth"))
@@ -1143,8 +1220,9 @@ class RandomForestRegressor(_TreeEstimator):
                 feature_groups=fgroups,
                 max_depth_v=(
                     None if uniform
-                    else jnp.asarray(depth_arr, dtype=jnp.int32)
+                    else depth_arr.astype(np.int32)
                 ),
+                return_outputs=True,
             )
 
         return self._batched_group_fit(

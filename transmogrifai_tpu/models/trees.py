@@ -43,9 +43,13 @@ class Tree(NamedTuple):
 
 def quantile_thresholds(x: np.ndarray, max_bins: int = 32) -> np.ndarray:
     """Per-feature quantile bin edges [F, max_bins-1] (XGBoost 'hist' sketch
-    equivalent; computed host-side once per dataset)."""
+    equivalent; computed host-side once per dataset). NaN-free input takes
+    the plain-quantile path (np.nanquantile walks a per-column masked slow
+    path — ~45× slower on a 891×957 matrix)."""
     qs = np.linspace(0, 1, max_bins + 1)[1:-1]
-    thr = np.nanquantile(np.asarray(x, dtype=np.float64), qs, axis=0).T
+    xd = np.asarray(x, dtype=np.float64)
+    qf = np.quantile if not np.isnan(xd).any() else np.nanquantile
+    thr = qf(xd, qs, axis=0).T
     # make strictly non-decreasing; duplicate edges simply yield empty bins
     return np.ascontiguousarray(thr, dtype=np.float32)
 
@@ -73,14 +77,26 @@ def bin_data(x: jax.Array, thresholds: jax.Array) -> jax.Array:
 # feature select 14 ms vs ~2; occupancy scatter 10.2 ms vs 2.3).
 # --------------------------------------------------------------------------
 _ONEHOT_MAX_WIDTH = 512
+# beyond the always-on width, the fused compare/select form is still the
+# winner as long as the TOTAL lane-op count (index count × table width)
+# stays around a millisecond of VPU time — deep AutoML trees at sub-4k row
+# counts sit far under this (24 lanes × 891 rows × 4096 node ids ≈ 87M),
+# while the 1M-row scale paths fall back to scatter/gather exactly as
+# before (measured: the flagship depth-12 RF program 2.05 → 1.72 s and the
+# 200-round XGB sweep 1.64 → 1.12 s from this alone).
+_ONEHOT_OPS_BUDGET = 1 << 28
+
+
+def _use_onehot(n_idx: int, width: int) -> bool:
+    return width <= _ONEHOT_MAX_WIDTH or n_idx * width <= _ONEHOT_OPS_BUDGET
 
 
 def _small_table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     """out[k, r] = table[k, idx[k, r]] — one-hot select for small tables,
-    take_along_axis beyond the fusion-friendly width. idx must be in
+    take_along_axis beyond the fused-form ops budget. idx must be in
     [0, M)."""
     m = table.shape[-1]
-    if m > _ONEHOT_MAX_WIDTH:
+    if not _use_onehot(idx.size, m):
         return jnp.take_along_axis(table, idx, axis=-1)
     iot = jnp.arange(m, dtype=jnp.int32)
     zero = jnp.zeros((), dtype=table.dtype)
@@ -94,7 +110,7 @@ def _row_feature_select(binned: jax.Array, feat: jax.Array) -> jax.Array:
     feature gather of tree routing, as a one-hot select over the feature
     axis (one fused pass over binned)."""
     f = binned.shape[1]
-    if f > _ONEHOT_MAX_WIDTH:
+    if not _use_onehot(feat.size, f):
         def one(rf):
             return jnp.take_along_axis(
                 binned, jnp.maximum(rf, 0)[:, None], axis=1
@@ -108,8 +124,9 @@ def _row_feature_select(binned: jax.Array, feat: jax.Array) -> jax.Array:
 
 def _occupancy(idx: jax.Array, size: int) -> jax.Array:
     """count of idx == m per m in [0, size) for idx [K, N] (out-of-range
-    ids drop out) — compare-reduce for small sizes, scatter-add beyond."""
-    if size > _ONEHOT_MAX_WIDTH:
+    ids drop out) — compare-reduce while fused-form ops fit the budget,
+    scatter-add beyond."""
+    if not _use_onehot(idx.size, size):
         return jax.vmap(
             lambda nd: jnp.zeros(size + 1, jnp.int32).at[
                 jnp.minimum(nd, size)
@@ -122,7 +139,7 @@ def _occupancy(idx: jax.Array, size: int) -> jax.Array:
 def _segment_sum_small(values: jax.Array, idx: jax.Array, size: int) -> jax.Array:
     """out[k, m] = Σ_r values[k, r]·1[idx[k, r] == m] — one fused
     compare/select reduction for small segment counts."""
-    if size > _ONEHOT_MAX_WIDTH:
+    if not _use_onehot(idx.size, size):
         return jax.vmap(
             lambda nd, v: jnp.zeros(size + 1, values.dtype).at[
                 jnp.minimum(nd, size)
@@ -257,25 +274,28 @@ def _grow_tree_impl(
 
     if feature_groups is not None:
         narrow_idx, wide_idx = feature_groups
-        if narrow_idx.shape[0] == 0:
-            feature_groups = None  # degenerate partition gains nothing
-    if feature_groups is not None:
         # (binned columns, per-fit feature mask, bin count, orig ids).
         # Narrow features hold exactly two values {0, t} in code space
         # (duplicate quantile edges put the '1' value at code t = #zeros);
         # recoding (code > 0) compresses them to b=2 while the stored split
         # bin 0 routes identically in ORIGINAL code space (code > 0 ⇔
-        # value is the upper one) — predict needs no remapping.
-        groups = [
-            (
-                (binned[:, narrow_idx] > 0).astype(jnp.int32),
-                feat_mask[:, narrow_idx], 2, narrow_idx,
-            ),
-        ]
+        # value is the upper one) — predict needs no remapping. Index
+        # arrays may be traced (per-tree colsample subsets); shapes are
+        # static, values aren't. Empty groups simply drop out.
+        groups = []
+        if narrow_idx.shape[0]:
+            groups.append(
+                (
+                    (binned[:, narrow_idx] > 0).astype(jnp.int32),
+                    feat_mask[:, narrow_idx], 2, narrow_idx,
+                )
+            )
         if wide_idx.shape[0]:
             groups.append(
                 (binned[:, wide_idx], feat_mask[:, wide_idx], b, wide_idx)
             )
+        if not groups:
+            groups = [(binned, feat_mask, b, None)]
     else:
         groups = [(binned, feat_mask, b, None)]
 
@@ -311,6 +331,21 @@ def _grow_tree_impl(
     # plain jnp, and the psum below reduces its per-shard histograms.
     use_gemm = (impl == "gemm") or (impl == "pallas" and n <= 4096)
 
+    # one-hot bin codes are loop-invariant across the level scan (and the
+    # tree scan above it) — precompute ONCE per group so the GEMM
+    # histogram's per-level work is the node one-hot + two einsums. XLA's
+    # loop-invariant code motion is not reliable through scan+cond+fori
+    # nesting, and the [N, Fg·Bg] temporary is small at GEMM row counts.
+    if use_gemm:
+        dt1h = jnp.bfloat16 if lowp else jnp.float32
+        groups = [
+            (gb_, gm, bb, gi,
+             jax.nn.one_hot(gb_, bb, dtype=dt1h).reshape(gb_.shape[0], -1))
+            for gb_, gm, bb, gi in groups
+        ]
+    else:
+        groups = [(gb_, gm, bb, gi, None) for gb_, gm, bb, gi in groups]
+
     # fused split search: gains + arg-best computed inside the kernel while
     # histograms are VMEM-resident — nothing [M, F, B]-sized touches HBM.
     # Only possible when every row fits one VMEM tile and the bins fit the
@@ -329,7 +364,7 @@ def _grow_tree_impl(
     # maxMemoryInMB node-group equivalent). With feature groups the total
     # histogram width is Σ_g f_g·b_g, and VMEM kernel caps take the min
     # over groups.
-    hist_width = sum(gb.shape[1] * bb for gb, _, bb, _ in groups)
+    hist_width = sum(gb.shape[1] * bb for gb, _, bb, _, _ in groups)
     budget_elems = max((1 << 25) // k_fits, 1 << 20)
     chunk_cap = max(1, budget_elems // max(hist_width, 1))
     while chunk_cap & (chunk_cap - 1):
@@ -370,17 +405,16 @@ def _grow_tree_impl(
     gam_k = jnp.broadcast_to(vec(gamma), (k_fits,))
     mcw_k = jnp.broadcast_to(vec(min_child_weight), (k_fits,))
 
-    def build_histogram_gemm(gbinned, loc, chunk_nodes, gb):
+    def build_histogram_gemm(gbinned, loc, chunk_nodes, gb, codes1h):
         """[K, M, Fg, Bg, 2] histogram as TWO one-hot GEMMs — the MXU-native
         formulation for small row counts. The pallas kernel's grid economics
         only win at large N; at AutoML-tabular sizes (≤4k rows) the whole
         per-level histogram is a [K·M, N] @ [N, Fg·Bg] matmul pair that XLA
         fuses into the surrounding program (measured: the depth-12 RF group
-        fell from ~25 s of kernel passes to GEMM noise)."""
-        nloc = gbinned.shape[0]
+        fell from ~25 s of kernel passes to GEMM noise). ``codes1h``
+        [N, Fg·Bg] is precomputed outside the level scan (loop-invariant)."""
         fg = gbinned.shape[1]
         dt = jnp.bfloat16 if lowp else jnp.float32
-        codes1h = jax.nn.one_hot(gbinned, gb, dtype=dt).reshape(nloc, fg * gb)
         node1h = jax.nn.one_hot(loc, chunk_nodes, dtype=jnp.float32)  # [K,N,M]
         gw = (node1h * g[:, :, None]).astype(dt)
         hw = (node1h * h[:, :, None]).astype(dt)
@@ -394,7 +428,7 @@ def _grow_tree_impl(
             loc.shape[0], chunk_nodes, fg, gb, 2
         )
 
-    def group_stats(gbinned, gmask, gb, gidx, loc, chunk_nodes):
+    def group_stats(gbinned, gmask, gb, gidx, codes1h, loc, chunk_nodes):
         """(gain, orig feat, bin) of the best split per compact slot for
         ONE feature group."""
         if use_fused:
@@ -407,7 +441,7 @@ def _grow_tree_impl(
                 bf = gidx[jnp.maximum(bf, 0)].astype(jnp.int32)
             return bg, bf, bb
         if use_gemm:
-            hist = build_histogram_gemm(gbinned, loc, chunk_nodes, gb)
+            hist = build_histogram_gemm(gbinned, loc, chunk_nodes, gb, codes1h)
         elif impl == "pallas":
             hist = build_histogram_pallas_batched(
                 gbinned, loc, g, h, chunk_nodes, gb, lowp=lowp
@@ -453,9 +487,9 @@ def _grow_tree_impl(
         active = (local >= c0) & (local < c0 + chunk_nodes)
         loc = jnp.where(active, local - c0, -1)  # [K, N]
         bg, bf, bb = None, None, None
-        for gbinned, gmask, grp_b, gidx in groups:
+        for gbinned, gmask, grp_b, gidx, codes1h in groups:
             gg, gf, gbin = group_stats(
-                gbinned, gmask, grp_b, gidx, loc, chunk_nodes
+                gbinned, gmask, grp_b, gidx, codes1h, loc, chunk_nodes
             )
             if bg is None:
                 bg, bf, bb = gg, gf, gbin
@@ -614,12 +648,12 @@ def _grow_tree_impl(
         # SLOWER than these per-level gathers — the [depth, K, max_nodes]
         # batched gather schedules worse than the level-sized ones.)
         rank_c = jnp.minimum(rank, n_nodes - 1)
-        feats_d = jnp.where(
-            live, jnp.take_along_axis(feats_c, rank_c, axis=1), -1
-        )
-        bins_d = jnp.where(
-            live, jnp.take_along_axis(bins_c, rank_c, axis=1), 0
-        )
+        # one-hot select, NOT take_along_axis: the [K, max_nodes] gather
+        # from [K, cap] lowered to a serializing custom-fusion gather
+        # measured at ~1 ms per level — 1.2 s of the 1.7 s depth-12 RF
+        # program (trace: tools/trace_rf12.py)
+        feats_d = jnp.where(live, _small_table_lookup(feats_c, rank_c), -1)
+        bins_d = jnp.where(live, _small_table_lookup(bins_c, rank_c), 0)
 
         # ---- route rows to children (gather via compact slots — cheaper)
         slot = jnp.clip(local, 0, n_nodes - 1)
@@ -770,6 +804,12 @@ def bin_data_host(x: np.ndarray, thresholds: np.ndarray) -> np.ndarray:
     thresholds (quantile_thresholds guarantees it); NaN x bins to 0."""
     xs = np.asarray(x, dtype=np.float32)
     thr = np.asarray(thresholds, dtype=np.float32)
+    # canonicalize NaN thresholds to the positive-NaN bit pattern: a NaN
+    # with the sign bit set would key BELOW all finite values via the ~b
+    # branch, binning rows one higher than the device path (where
+    # x > NaN is always False). Unreachable via quantile_thresholds but
+    # this function is public API for other callers.
+    thr = np.where(np.isnan(thr), np.float32(np.nan), thr)
     n, num_f = xs.shape
     bm1 = thr.shape[1]
     xk = _f32_order_keys(xs).astype(np.int64)
@@ -878,13 +918,16 @@ def _bag_masks(tkey, sub, col, row_mask, n, f, bootstrap):
 
 @partial(
     jax.jit,
-    static_argnames=("max_depth", "num_bins", "bootstrap", "lowp", "hist_impl"),
+    static_argnames=(
+        "num_trees", "max_depth", "num_bins", "bootstrap", "lowp", "hist_impl",
+    ),
 )
 def _forest_trees_scan(
-    binned, target, row_mask, tkeys, sub, col, min_instances, min_info_gain,
-    feature_groups=None, max_depth_v=None, *,
-    max_depth, num_bins, bootstrap, lowp, hist_impl=None,
-) -> Tree:
+    binned, target, row_mask, seed_arr, sub, col, min_instances,
+    min_info_gain,
+    feature_groups=None, max_depth_v=None, subset_n=None, subset_w=None, *,
+    num_trees, max_depth, num_bins, bootstrap, lowp, hist_impl=None,
+) -> tuple[Tree, jax.Array]:
     """The whole bagged forest as ONE program: ``lax.scan`` over the
     per-tree PRNG keys with a single tree-growth body (the same shape as
     the boosting rounds scan, which runs 200 rounds in under a second on
@@ -892,7 +935,19 @@ def _forest_trees_scan(
     tree over the tunneled link) and the tree-folded K'=trees×K kernels
     (whose wide grids schedule badly and defeat the early level exit).
     Masks are drawn per tree from the same keys, so forests are
-    bit-identical to the per-tree path. Returns Tree arrays [K, T, ...]."""
+    bit-identical to the per-tree path.
+
+    ``subset_n``/``subset_w`` ([T, n_sub] int32, optional) are per-tree
+    colsample feature subsets (narrow/wide partition) sampled host-side by
+    ``fit_forest_batched``: each tree's histogram work runs over only its
+    ~√F sampled columns via the feature_groups gather machinery instead of
+    masking gains over the full one-hot width (a ~30× FLOP cut on
+    transmogrified matrices, where most columns are indicators).
+
+    Returns (Tree arrays [K, T, ...], training outputs [K, N]) — the
+    outputs are each lane's mean-leaf prediction over ALL rows, read from
+    the grower's own final routing, so the CV sweep needs no separate
+    eval traversal program."""
     k_fits, n = row_mask.shape
     f = binned.shape[1]
     gb = jnp.broadcast_to(-target[None, :], (k_fits, n))
@@ -903,21 +958,38 @@ def _forest_trees_scan(
     mg_k = jnp.broadcast_to(
         jnp.asarray(min_info_gain, dtype=jnp.float32).reshape(-1), (k_fits,)
     )
+    # per-tree keys derived IN-PROGRAM (same threefry ops → identical draws
+    # to the old eager derivation; keeps PRNGKey/split eager compiles off
+    # the per-process critical path)
+    tkeys = jax.random.split(
+        jax.random.PRNGKey(seed_arr[0].astype(jnp.uint32)), num_trees
+    )
 
-    def body(_, tk):
-        rm_t, fm_t = _bag_masks(tk, sub, col, row_mask, n, f, bootstrap)
-        tree, _ = _grow_tree_impl(
+    def body(_, xs):
+        tk, sn, sw = xs
+        rm_t, fm_t = _bag_masks(
+            tk, sub, jnp.ones_like(col) if sn is not None else col,
+            row_mask, n, f, bootstrap,
+        )
+        grp = (sn, sw) if sn is not None else feature_groups
+        tree, node = _grow_tree_impl(
             binned, gb, ones, rm_t, fm_t,
             max_depth=max_depth, num_bins=num_bins,
             reg_lambda=0.0, gamma=0.0,
             min_child_weight=mi_k, min_info_gain=mg_k,
-            hist_impl=hist_impl, lowp=lowp, feature_groups=feature_groups,
+            hist_impl=hist_impl, lowp=lowp, feature_groups=grp,
             max_depth_v=max_depth_v,
         )
-        return None, tree
+        # this tree's prediction for EVERY row from the grower's own final
+        # routing (leaf lookup — no re-traversal)
+        pred_t = _small_table_lookup(tree.leaf_value, node)
+        return None, (tree, pred_t)
 
-    _, trees = jax.lax.scan(body, None, tkeys)  # [T, K, ...]
-    return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees)
+    _, (trees, preds) = jax.lax.scan(
+        body, None, (tkeys, subset_n, subset_w)
+    )  # [T, K, ...]
+    outs = preds.mean(axis=0)  # [K, N] forest mean-leaf outputs
+    return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees), outs
 
 
 def fit_forest_batched(
@@ -937,26 +1009,73 @@ def fit_forest_batched(
     mesh=None,
     feature_groups=None,
     max_depth_v=None,     # [K] int32: per-lane depth caps (see _grow_tree_impl)
+    return_outputs: bool = False,
 ) -> Tree:
     """K random forests batched over the fit axis, the whole bagged forest
     as ONE scan-over-trees program (_forest_trees_scan — one tree-growth
     body, no per-tree dispatches, no tree-folded wide kernels). Returns
-    stacked Tree arrays [K, T, ...].
+    stacked Tree arrays [K, T, ...]; with ``return_outputs`` also the
+    [K, N] training-matrix mean-leaf outputs (each lane's predictions on
+    every row — the CV sweep evaluates from these instead of re-traversing).
+
+    A static ``colsample_rate`` < 1 with ``feature_groups`` samples an
+    EXACT-COUNT feature subset per tree host-side (Spark's
+    featureSubsetStrategy picks an exact number of features, not a
+    Bernoulli mask; subsets are proportionally stratified over the
+    narrow/wide bin groups) and the histogram work gathers only those
+    columns — ~30× less one-hot GEMM width at √F rates on transmogrified
+    matrices.
 
     With ``mesh`` set, rows shard over the mesh's data axis and each level's
     histogram psums over it (grows the same trees as the unsharded path —
     see _grow_tree_impl)."""
     k_fits, n = row_mask.shape
-    key = jax.random.PRNGKey(seed)
-    tkeys = jax.random.split(key, num_trees)
-    sub = jnp.broadcast_to(
-        jnp.asarray(subsample_rate, dtype=jnp.float32).reshape(-1), (k_fits,)
+    # ---- exact-count per-tree feature subsets (static rate only: the
+    # flagship RF path passes a python float; per-lane traced rates keep
+    # the dense-mask path)
+    subset_n = subset_w = None
+    rate = (
+        float(colsample_rate)
+        if isinstance(colsample_rate, (int, float)) else None
     )
-    col = jnp.broadcast_to(
-        jnp.asarray(colsample_rate, dtype=jnp.float32).reshape(-1), (k_fits,)
-    )
-    mi = jnp.asarray(min_instances, dtype=jnp.float32)
-    mg = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    if rate is not None and rate < 1.0 and feature_groups is not None:
+        narrow_idx = np.asarray(feature_groups[0])
+        wide_idx = np.asarray(feature_groups[1])
+        f_n, f_w = len(narrow_idx), len(wide_idx)
+        f_all = f_n + f_w
+        n_sub = max(1, int(round(f_all * rate)))
+        if n_sub < f_all:
+            n_sub_n = min(f_n, int(round(n_sub * f_n / max(f_all, 1))))
+            n_sub_w = min(f_w, n_sub - n_sub_n)
+            n_sub_n = min(f_n, n_sub - n_sub_w)
+            rng = np.random.default_rng([int(seed), 0x5EED])
+            def draw(idx, k):
+                return np.stack([
+                    np.sort(rng.choice(idx, size=k, replace=False))
+                    for _ in range(num_trees)
+                ]).astype(np.int32) if k else np.zeros(
+                    (num_trees, 0), dtype=np.int32
+                )
+            subset_n = jnp.asarray(draw(narrow_idx, n_sub_n))
+            subset_w = jnp.asarray(draw(wide_idx, n_sub_w))
+            colsample_rate = 1.0  # masks are all-ones under subsets
+    # host-side numpy for every small knob: a dtype-converting or
+    # broadcasting jnp op here is an EAGER device program, and on the
+    # axon backend even trivial eager compiles cost 0.1-0.7 s per process
+    # (JAX_LOG_COMPILES evidence in BASELINE.md round 5); f32 numpy arrays
+    # transfer without compiling anything, and the broadcasts/PRNG-key
+    # derivation happen INSIDE the jitted program
+    def _vec_np(v):
+        return np.asarray(
+            np.broadcast_to(np.asarray(v, dtype=np.float32).reshape(-1),
+                            (k_fits,))
+        )
+
+    sub = _vec_np(subsample_rate)
+    col = _vec_np(colsample_rate)
+    mi = np.asarray(min_instances, dtype=np.float32)
+    mg = np.asarray(min_info_gain, dtype=np.float32)
+    seed_arr = np.asarray([seed], dtype=np.uint32)
     if mesh is None:
         from ..parallel.mesh import execution_mesh
 
@@ -966,18 +1085,24 @@ def fit_forest_batched(
             raise NotImplementedError(
                 "per-lane depth caps are single-device only (the sweep path)"
             )
-        return _fit_forest_batched_sharded(
-            mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
+        key = jax.random.PRNGKey(seed)
+        tkeys = jax.random.split(key, num_trees)
+        trees, outs = _fit_forest_batched_sharded(
+            mesh, binned, target, row_mask, tkeys, jnp.asarray(sub),
+            jnp.asarray(col), mi, mg,
             num_trees=num_trees, max_depth=max_depth, num_bins=num_bins,
             bootstrap=bootstrap, lowp=lowp, feature_groups=feature_groups,
+            subset_n=subset_n, subset_w=subset_w,
         )
+        return (trees, outs) if return_outputs else trees
     from ..utils.aot import aot_call
 
-    return aot_call(
+    trees, outs = aot_call(
         "forest_scan", _forest_trees_scan,
-        (binned, target, row_mask, tkeys, sub, col, mi, mg, feature_groups,
-         max_depth_v),
-        dict(max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
+        (binned, target, row_mask, seed_arr, sub, col, mi, mg,
+         feature_groups, max_depth_v, subset_n, subset_w),
+        dict(num_trees=num_trees,
+             max_depth=max_depth, num_bins=num_bins, bootstrap=bootstrap,
              # lowp is only sound when target values are bf16-exact
              # (classification indicators); regression keeps f32
              lowp=lowp,
@@ -985,6 +1110,7 @@ def fit_forest_batched(
              # see the trace-time impl choice
              hist_impl=_resolved_impl()),
     )
+    return (trees, outs) if return_outputs else trees
 
 
 @partial(
@@ -1148,27 +1274,38 @@ def fit_boosted_batched(
     margins live sharded, per-level histograms psum over ICI, and trees come
     back replicated — the Rabit-tracker topology with XLA collectives."""
     k_fits, n = row_mask.shape
-    eta_v = jnp.broadcast_to(
-        jnp.asarray(eta, dtype=jnp.float32).reshape(-1), (k_fits,)
+    # numpy, not eager jnp: dtype-converting/broadcasting eager ops each
+    # compile a device program per process (~0.1-0.7 s each on the axon
+    # backend); f32 numpy transfers compile nothing
+    def _np_f32(v):
+        return np.asarray(v, dtype=np.float32)
+
+    eta_v = np.asarray(
+        np.broadcast_to(_np_f32(eta).reshape(-1), (k_fits,))
     )
-    lam = jnp.asarray(reg_lambda, dtype=jnp.float32)
-    gam = jnp.asarray(gamma, dtype=jnp.float32)
-    mcw = jnp.asarray(min_child_weight, dtype=jnp.float32)
-    mig = jnp.asarray(min_info_gain, dtype=jnp.float32)
+    lam = _np_f32(reg_lambda)
+    gam = _np_f32(gamma)
+    mcw = _np_f32(min_child_weight)
+    mig = _np_f32(min_info_gain)
     if mesh is None:
         from ..parallel.mesh import execution_mesh
 
         mesh = execution_mesh()
     if mesh is not None:
         return _fit_boosted_batched_sharded(
-            mesh, binned, y, row_mask, eta_v, lam, gam, mcw, mig,
+            mesh, binned, y, row_mask, jnp.asarray(eta_v), jnp.asarray(lam),
+            jnp.asarray(gam), jnp.asarray(mcw), jnp.asarray(mig),
             base_score=base_score, num_rounds=num_rounds,
             max_depth=max_depth, num_bins=num_bins, objective=objective,
             feature_groups=feature_groups,
         )
-    margin = jnp.broadcast_to(
-        jnp.asarray(base_score, dtype=jnp.float32).reshape(-1, 1), (k_fits, n)
-    ).astype(jnp.float32)
+    # f32 numpy broadcast (no eager compile), then ONE device transfer so
+    # chunk 1 and chunks 2+ present the same leaf type to the AOT key
+    # (a numpy leaf has no .sharding; mixing host/device margins would
+    # key-split the identical chunk program under TPTPU_BOOST_CHUNK)
+    margin = jnp.asarray(np.asarray(np.broadcast_to(
+        _np_f32(base_score).reshape(-1, 1), (k_fits, n)
+    )))
     from ..utils.aot import aot_call
 
     chunks = []
@@ -1254,11 +1391,13 @@ def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
 
 @lru_cache(maxsize=None)
 def _sharded_forest_scan_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
-                                has_groups=False):
+                                has_groups=False, has_subsets=False):
     """jit(shard_map(scan-over-trees)): the sharded counterpart of
     _forest_trees_scan. Per-tree masks are drawn OUTSIDE (global-row
     semantics) and enter sharded on the row axis; the scan carries the
-    whole forest in one program, psum'ing each level's histograms."""
+    whole forest in one program, psum'ing each level's histograms. Also
+    emits [K, N] training outputs (row-sharded) like the single-device
+    scan."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -1266,27 +1405,37 @@ def _sharded_forest_scan_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
 
     size = mesh.shape[DATA_AXIS]
 
-    def body_fn(binned, target, rmasks, fmasks, mi_k, mg_k, *grp):
+    def body_fn(binned, target, rmasks, fmasks, mi_k, mg_k, *rest):
+        if has_subsets:
+            subset_n, subset_w = rest[-2:]
+            rest = rest[:-2]
+        else:
+            subset_n = subset_w = None
+        grp = rest if rest else None
         k_fits = rmasks.shape[1]
         n_local = binned.shape[0]
         gb = jnp.broadcast_to(-target[None, :], (k_fits, n_local))
         ones = jnp.ones((k_fits, n_local), dtype=jnp.float32)
 
-        def one_tree(_, rm_fm):
-            rm_t, fm_t = rm_fm
-            tree, _ = _grow_tree_impl(
+        def one_tree(_, xs):
+            rm_t, fm_t, sn, sw = xs
+            tree, node = _grow_tree_impl(
                 binned, gb, ones, rm_t, fm_t,
                 max_depth=max_depth, num_bins=num_bins,
                 reg_lambda=0.0, gamma=0.0,
                 min_child_weight=mi_k, min_info_gain=mg_k,
                 hist_impl=hist_impl, lowp=lowp,
                 axis_name=DATA_AXIS, axis_size=size,
-                feature_groups=grp if grp else None,
+                feature_groups=(sn, sw) if sn is not None else grp,
             )
-            return None, tree
+            pred_t = _small_table_lookup(tree.leaf_value, node)
+            return None, (tree, pred_t)
 
-        _, trees = jax.lax.scan(one_tree, None, (rmasks, fmasks))
-        return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees)
+        _, (trees, preds) = jax.lax.scan(
+            one_tree, None, (rmasks, fmasks, subset_n, subset_w)
+        )
+        outs = preds.mean(axis=0)  # [K, n_local]
+        return jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trees), outs
 
     rep = P()
     sm = shard_map(
@@ -1298,8 +1447,12 @@ def _sharded_forest_scan_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
             P(None, None, DATA_AXIS),  # rmasks [T, K, N]
             rep,                       # fmasks [T, K, F]
             rep, rep,
-        ) + ((rep, rep) if has_groups else ()),
-        out_specs=Tree(split_feat=rep, split_bin=rep, leaf_value=rep),
+        ) + ((rep, rep) if has_groups else ())
+          + ((rep, rep) if has_subsets else ()),
+        out_specs=(
+            Tree(split_feat=rep, split_bin=rep, leaf_value=rep),
+            P(None, DATA_AXIS),
+        ),
         check_vma=False,
     )
     return jax.jit(sm)
@@ -1308,7 +1461,8 @@ def _sharded_forest_scan_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
 def _fit_forest_batched_sharded(
     mesh, binned, target, row_mask, tkeys, sub, col, mi, mg,
     num_trees, max_depth, num_bins, bootstrap, lowp, feature_groups=None,
-) -> Tree:
+    subset_n=None, subset_w=None,
+) -> tuple[Tree, np.ndarray]:
     from ..parallel.mesh import DATA_AXIS
 
     size = mesh.shape[DATA_AXIS]
@@ -1328,11 +1482,18 @@ def _fit_forest_batched_sharded(
     kern = _sharded_forest_scan_kernel(
         mesh, max_depth, num_bins, _resolved_impl(), lowp,
         has_groups=feature_groups is not None,
+        has_subsets=subset_n is not None,
     )
     grp_args = tuple(feature_groups) if feature_groups is not None else ()
-    trees = kern(binned_p, target_p, rmasks, fmasks, mi_k, mg_k, *grp_args)
+    if subset_n is not None:
+        grp_args = grp_args + (subset_n, subset_w)
+    trees, outs = kern(binned_p, target_p, rmasks, fmasks, mi_k, mg_k,
+                       *grp_args)
     # pull replicated trees to HOST once (memory: xla-cpu-mesh-gotchas)
-    return jax.tree.map(lambda a: np.asarray(a), trees)
+    return (
+        jax.tree.map(lambda a: np.asarray(a), trees),
+        np.asarray(outs)[:, :n],
+    )
 
 
 @lru_cache(maxsize=None)

@@ -919,6 +919,12 @@ class NameEntityRecognizer(Transformer):
     _ORG_HINTS = ("inc", "corp", "llc", "ltd", "co", "company", "corporation")
     _LOC_HINTS = ("city", "county", "street", "avenue", "lake", "river",
                   "north", "south", "east", "west")
+    # capital class matches sentences.py's opener class (A-ZÀ-ÖØ-Þ — the
+    # À-Þ range alone would admit × U+00D7) plus Latin-Extended-A capitals
+    # (Š, Č, Ł, İ, …) so cs/pl/tr/hr entity runs are detected consistently
+    _CAP = "A-ZÀ-ÖØ-Þ" + "".join(
+        chr(c) for c in range(0x100, 0x180) if chr(c).isupper()
+    )
 
     def __init__(self, names: frozenset = _COMMON_NAMES,
                  use_model: bool = True, uid: str | None = None):
@@ -951,7 +957,7 @@ class NameEntityRecognizer(Transformer):
                 while lead < len(sent) and sent[lead] in "\"'«“‘([":
                     lead += 1
                 for m in re.finditer(
-                    r"[A-ZÀ-Þ][\w'-]*(?:\s+(?:(?:van|de|der|den|ter|te|la|del|da|di|von|el)\s+)*[A-ZÀ-Þ][\w'-]*)*", sent
+                    rf"[{self._CAP}][\w'-]*(?:\s+(?:(?:van|de|der|den|ter|te|la|del|da|di|von|el)\s+)*[{self._CAP}][\w'-]*)*", sent
                 ):
                     toks = m.group(0).split()
                     lows = [t.lower() for t in toks]

@@ -326,10 +326,22 @@ class ModelSelector(PredictorEstimator):
         # refit directly — batched when possible so the program comes from
         # the AOT executable bank
         best_model = None
+        refit_raw = None
         if best.model_uid in prefit:
             points, extra_rows = prefit[best.model_uid]
             if best.grid in points and extra_rows:
                 best_model = extra_rows[0][points.index(best.grid)]
+                # the refit lane's raw outputs on xt were computed by the
+                # fit program itself — grab them BEFORE detach frees the
+                # stack, so train evaluation needs no re-predict
+                stack = getattr(best_model, "_sweep_stack", None)
+                if (
+                    stack is not None and stack.get("outputs") is not None
+                    and hasattr(best_model, "predictions_from_sweep")
+                ):
+                    refit_raw = np.asarray(stack["outputs"])[
+                        best_model._sweep_lane
+                    ]
                 # free the sweep stacks: keep only the winner's own lane
                 detach = getattr(best_model, "detach_from_sweep", None)
                 if detach is not None:
@@ -344,7 +356,10 @@ class ModelSelector(PredictorEstimator):
             else:
                 best_model = final_est.fit_arrays(xt, yt, final_mask)
 
-        pred, prob, _ = best_model.predict_arrays(xt)
+        if refit_raw is not None:
+            pred, prob, _ = best_model.predictions_from_sweep(refit_raw)
+        else:
+            pred, prob, _ = best_model.predict_arrays(xt)
         train_metrics = self.evaluator.evaluate_arrays(yt, pred, prob)
         extra_train = {
             ev.name: ev.evaluate_arrays(yt, pred, prob)
