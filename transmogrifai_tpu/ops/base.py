@@ -68,19 +68,26 @@ class _CachedMetaVectorizer:
     """Mixin: column metadata is fit-static (it describes columns, not
     rows), but blocks_for re-derives it every call — ~30-40 ms of dataclass
     churn per scoring call on a wide plane. The first transform caches the
-    flattened VectorMetadata; later calls only assemble values."""
+    flattened VectorMetadata; later calls only assemble values.
 
-    _meta_cache: VectorMetadata | None = None
+    The cache key is the per-block (width, meta-count) layout, not just
+    the total width: a blocks_for whose metas shifted between calls while
+    total width stayed constant would otherwise silently attach stale
+    metadata to scored vectors."""
+
+    _meta_cache: tuple | None = None  # (layout key, VectorMetadata)
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
         blocks, metas = self.blocks_for(cols, num_rows)
+        layout = tuple(
+            (b.shape[1], len(ms)) for b, ms in zip(blocks, metas)
+        )
         cached = self._meta_cache
-        if cached is not None:
+        if cached is not None and cached[0] == layout:
             values = _assemble_values(blocks)
-            if values.shape[1] == cached.size:
-                return VectorColumn(OPVector, values, cached)
+            return VectorColumn(OPVector, values, cached[1])
         out = assemble_vector(self.output_name, blocks, metas)
-        self._meta_cache = out.metadata
+        self._meta_cache = (layout, out.metadata)
         return out
 
 
