@@ -46,7 +46,8 @@ def _hist_pack(num_bins: int) -> tuple[int, int]:
 
 
 def _hist_kernel(binned_ref, node_ref, g_ref, h_ref, outg_ref, outh_ref,
-                 *, m_pad, b_pad, pack, sub_lanes, lowp, feat_tile):
+                 *, m_pad, b_pad, pack, sub_lanes, lowp, feat_tile,
+                 comb="base"):
     """One (fit, feature-tile, row-tile) step: accumulate grad/hess
     histograms for one batched fit (separate outputs — a trailing dim of 2
     would be tile-padded to 128 and blow VMEM). Output lanes are PACKED:
@@ -103,12 +104,19 @@ def _hist_kernel(binned_ref, node_ref, g_ref, h_ref, outg_ref, outh_ref,
         # ONE compare per group: broadcast each sub-feature's codes onto its
         # own lane segment with nested selects, then a single 128-lane
         # equality — the per-sub compare+convert+add loop was the VPU cost
-        # that dominated the whole build (trace: 18.0 of 18.6 s at 1M x 500)
-        code_b = binned_ref[q * pack + 0, :][:, None]
-        for sub in range(1, pack):
-            seg = binned_ref[q * pack + sub, :][:, None] + sub * sub_lanes
-            code_b = jnp.where(iota_b < sub * sub_lanes, code_b, seg)
-        comb_oh = (code_b == iota_b).astype(jnp.bfloat16)
+        # that dominated the whole build (trace: 18.0 of 18.6 s at 1M x 500).
+        # comb='const' is a timing probe (wrong results) isolating the
+        # dot+stack cost from the comb construction; round-5 measured the
+        # chain at 333 of 408 ms per 1M×500×32 build, which motivated the
+        # bin-loop kernel below (the default for ≤64 bins).
+        if comb == "const":
+            comb_oh = jnp.full((t, b_pad), jnp.bfloat16(1.0))
+        else:
+            code_b = binned_ref[q * pack + 0, :][:, None]
+            for sub in range(1, pack):
+                seg = binned_ref[q * pack + sub, :][:, None] + sub * sub_lanes
+                code_b = jnp.where(iota_b < sub * sub_lanes, code_b, seg)
+            comb_oh = (code_b == iota_b).astype(jnp.bfloat16)
         out = lax.dot_general(
             stack, comb_oh, contract,
             preferred_element_type=jnp.float32,
@@ -132,11 +140,40 @@ def _hist_kernel(binned_ref, node_ref, g_ref, h_ref, outg_ref, outh_ref,
             outh_ref[0, q, :, :] = outh_ref[0, q, :, :] + hh
 
 
+def build_histogram_pallas_batched(
+    binned, node, grad, hess, num_nodes, num_bins,
+    row_tile=None, lowp=False, interpret=False, comb=None,
+):
+    """hist [K, num_nodes, F, num_bins, 2] via the MXU one-hot formulation
+    (bin-axis packing + hi/lo bf16 value split — see _hist_kernel).
+
+    K batched fits (grid points × CV folds) share one binned matrix; the fit
+    axis rides the kernel grid, so the whole hyperparameter sweep's
+    histograms build in one custom call.
+
+    ``comb``: 'base' (default) or 'const' (a timing probe producing WRONG
+    results — isolates dot+stack cost from comb construction). The
+    TPTPU_HIST_COMB env knob is resolved HERE, outside the traced body, so
+    the jit cache keys on the resolved string (an env change between calls
+    can never serve a stale trace), and the knob also salts the AOT bank
+    (utils/aot.py) so probe executables cannot leak across processes."""
+    if comb is None:
+        import os
+
+        comb = os.environ.get("TPTPU_HIST_COMB", "base")
+    return _build_histogram_pallas_batched(
+        binned, node, grad, hess, num_nodes, num_bins,
+        row_tile=row_tile, lowp=lowp, interpret=interpret, comb=comb,
+    )
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("num_nodes", "num_bins", "row_tile", "lowp", "interpret"),
+    static_argnames=(
+        "num_nodes", "num_bins", "row_tile", "lowp", "interpret", "comb",
+    ),
 )
-def build_histogram_pallas_batched(
+def _build_histogram_pallas_batched(
     binned: jax.Array,   # [N, F] int32 codes in [0, num_bins), SHARED
     node: jax.Array,     # [K, N] int32 node slot per row per fit (-1 = dead)
     grad: jax.Array,     # [K, N] f32 (pre-masked)
@@ -146,13 +183,8 @@ def build_histogram_pallas_batched(
     row_tile: int | None = None,
     lowp: bool = False,
     interpret: bool = False,
+    comb: str = "base",
 ) -> jax.Array:
-    """hist [K, num_nodes, F, num_bins, 2] via the MXU one-hot formulation
-    (bin-axis packing + hi/lo bf16 value split — see _hist_kernel).
-
-    K batched fits (grid points × CV folds) share one binned matrix; the fit
-    axis rides the kernel grid, so the whole hyperparameter sweep's
-    histograms build in one custom call."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -212,7 +244,7 @@ def build_histogram_pallas_batched(
     out_g, out_h = pl.pallas_call(
         functools.partial(
             _hist_kernel, m_pad=m_pad, b_pad=b_pad, pack=pack,
-            sub_lanes=sub_lanes, lowp=lowp, feat_tile=feat_tile,
+            sub_lanes=sub_lanes, lowp=lowp, feat_tile=feat_tile, comb=comb,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((k_fits, groups, m_pad, b_pad), jnp.float32),
@@ -260,6 +292,188 @@ def build_histogram_pallas_batched(
 
     out = jnp.stack([unpack(out_g), unpack(out_h)], axis=-1)
     return jnp.transpose(out[:, :f, :num_nodes, :num_bins, :], (0, 2, 1, 3, 4))
+
+
+def _hist_binloop_kernel(binned_ref, node_ref, g_ref, h_ref, outg_ref,
+                         outh_ref, *, m_pad, num_bins, lowp):
+    """Bin-loop histogram step: one whole-block compare per bin instead of
+    the per-group select-chain assembly. The comb construction drops from
+    ~5 VPU ops per one-hot element to 2 (compare + convert) — the
+    select-chain was measured at 333 ms of the 408 ms level cost at
+    1M×500×32 (comb='const' probe). Layout: binned block [feat_tile, T]
+    (features on sublanes), stack [T, nvar·M]; per bin b the dot
+    [feat_tile, T] @ [T, nvar·M] emits that bin's [feat_tile, nvar·M]
+    plane, written at a static outermost index."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)
+
+    nodes = node_ref[0, 0, :]
+    g = g_ref[0, 0, :]
+    h = h_ref[0, 0, :]
+    t = nodes.shape[0]
+
+    nvar = 2 if lowp else 4
+    iota_s = lax.broadcasted_iota(jnp.int32, (t, nvar * m_pad), 1)
+    m_lane = iota_s % m_pad
+    variant = iota_s // m_pad
+    oh = nodes[:, None] == m_lane
+    if lowp:
+        val = jnp.where(variant == 0, g[:, None], h[:, None])
+    else:
+        g_hi = g.astype(jnp.bfloat16).astype(jnp.float32)
+        g_lo = g - g_hi
+        h_hi = h.astype(jnp.bfloat16).astype(jnp.float32)
+        h_lo = h - h_hi
+        val = jnp.where(
+            variant == 0, g_hi[:, None],
+            jnp.where(
+                variant == 1, g_lo[:, None],
+                jnp.where(variant == 2, h_hi[:, None], h_lo[:, None]),
+            ),
+        )
+    stack = jnp.where(oh, val, 0.0).astype(jnp.bfloat16)
+    codes = binned_ref[...]  # [feat_tile, T] int32
+    contract = (((1,), (0,)), ((), ()))  # contract the row-tile axis
+
+    for b in range(num_bins):
+        comb = (codes == b).astype(jnp.bfloat16)  # [feat_tile, T]
+        out = lax.dot_general(
+            comb, stack, contract,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT,
+        )  # [feat_tile, nvar·M]
+        if lowp:
+            hg = out[:, :m_pad]
+            hh = out[:, m_pad:]
+        else:
+            hg = out[:, :m_pad] + out[:, m_pad:2 * m_pad]
+            hh = out[:, 2 * m_pad:3 * m_pad] + out[:, 3 * m_pad:]
+
+        @pl.when(j == 0)
+        def _(b=b, hg=hg, hh=hh):
+            outg_ref[0, b, :, :] = hg
+            outh_ref[0, b, :, :] = hh
+
+        @pl.when(j > 0)
+        def _(b=b, hg=hg, hh=hh):
+            outg_ref[0, b, :, :] = outg_ref[0, b, :, :] + hg
+            outh_ref[0, b, :, :] = outh_ref[0, b, :, :] + hh
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_nodes", "num_bins", "row_tile", "lowp", "interpret"),
+)
+def build_histogram_pallas_binloop(
+    binned: jax.Array,   # [N, F] int32 codes in [0, num_bins), SHARED
+    node: jax.Array,     # [K, N] int32 node slot per row per fit (-1 = dead)
+    grad: jax.Array,     # [K, N] f32 (pre-masked)
+    hess: jax.Array,     # [K, N] f32
+    num_nodes: int,
+    num_bins: int,
+    row_tile: int | None = None,
+    lowp: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """hist [K, num_nodes, F, num_bins, 2] via the bin-loop kernel (see
+    _hist_binloop_kernel). Same contract as build_histogram_pallas_batched."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k_fits, n = node.shape
+    f = binned.shape[1]
+    m_pad = _round_up(max(num_nodes, 8), 8)
+    nvar = 2 if lowp else 4
+    if row_tile is None:
+        # 2048 measured best at the 1M-row scale shapes (1024: 190 ms,
+        # 2048: 141 ms, 4096: 263 ms per build at 1M×500×32, M=64)
+        row_tile = max(
+            128, min(2048, ((1 << 20) // (nvar * m_pad)) // 128 * 128)
+        )
+
+    def vmem_bytes(ft: int) -> int:
+        # binned block + 2 output accumulators + stacked operand + comb
+        return (
+            ft * row_tile * 4
+            + 2 * num_bins * ft * m_pad * 4
+            + row_tile * nvar * m_pad * 2
+            + row_tile * (3 * m_pad * 4 + ft * 2)
+        )
+
+    # budget 6 MB by this model: Mosaic double-buffers grid blocks and
+    # carries dot/select temporaries the model does not count (measured
+    # ~2x) — 12 MB nominal blew the 16 MB scoped-vmem stack
+    feat_tile = FEAT_TILE
+    while (
+        feat_tile * 2 <= _round_up(f, FEAT_TILE)
+        and vmem_bytes(feat_tile * 2) <= (6 << 20)
+    ):
+        feat_tile *= 2
+    while vmem_bytes(feat_tile) > (6 << 20) and row_tile > 512:
+        row_tile //= 2
+    n_pad = _round_up(max(n, row_tile), row_tile)
+    f_pad = _round_up(f, feat_tile)
+
+    binned_t = jnp.full((f_pad, n_pad), -1, dtype=jnp.int32)
+    binned_t = binned_t.at[:f, :n].set(binned.T)
+    node_p = jnp.full((k_fits, 1, n_pad), -1, dtype=jnp.int32).at[:, 0, :n].set(node)
+    g_p = jnp.zeros((k_fits, 1, n_pad), dtype=jnp.float32).at[:, 0, :n].set(grad)
+    h_p = jnp.zeros((k_fits, 1, n_pad), dtype=jnp.float32).at[:, 0, :n].set(hess)
+
+    grid = (k_fits, f_pad // feat_tile, n_pad // row_tile)
+
+    out_g, out_h = pl.pallas_call(
+        functools.partial(
+            _hist_binloop_kernel, m_pad=m_pad, num_bins=num_bins, lowp=lowp,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(
+                (k_fits, num_bins, f_pad, m_pad), jnp.float32
+            ),
+            jax.ShapeDtypeStruct(
+                (k_fits, num_bins, f_pad, m_pad), jnp.float32
+            ),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (feat_tile, row_tile), lambda k, i, j: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, row_tile), lambda k, i, j: (k, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, row_tile), lambda k, i, j: (k, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, row_tile), lambda k, i, j: (k, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (1, num_bins, feat_tile, m_pad),
+                lambda k, i, j: (k, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, num_bins, feat_tile, m_pad),
+                lambda k, i, j: (k, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        interpret=interpret,
+    )(binned_t, node_p, g_p, h_p)
+
+    # [K, B, F, M] -> [K, M, F, B, 2]
+    out = jnp.stack([out_g, out_h], axis=-1)
+    out = jnp.transpose(out, (0, 3, 2, 1, 4))
+    return out[:, :num_nodes, :f, :, :]
 
 
 def build_histogram_pallas(

@@ -260,6 +260,7 @@ def _grow_tree_impl(
         FUSED_SPLIT_MAX_ROWS,
         build_best_split_pallas,
         build_histogram_pallas_batched,
+        build_histogram_pallas_binloop,
         build_histogram_scatter_batched,
         default_impl,
     )
@@ -443,9 +444,20 @@ def _grow_tree_impl(
         if use_gemm:
             hist = build_histogram_gemm(gbinned, loc, chunk_nodes, gb, codes1h)
         elif impl == "pallas":
-            hist = build_histogram_pallas_batched(
-                gbinned, loc, g, h, chunk_nodes, gb, lowp=lowp
-            )
+            # bin-loop kernel for narrow bin counts: one whole-block
+            # compare per bin instead of the select-chain lane assembly —
+            # 381 -> 141 ms per build at 1M×500×32, bit-identical
+            # histograms (see _hist_binloop_kernel). Its cost is linear in
+            # num_bins, so wide-bin fits (e.g. 256-bin sketches) keep the
+            # lane-packed kernel (measured 2.2x better there).
+            if gb <= 64:
+                hist = build_histogram_pallas_binloop(
+                    gbinned, loc, g, h, chunk_nodes, gb, lowp=lowp
+                )
+            else:
+                hist = build_histogram_pallas_batched(
+                    gbinned, loc, g, h, chunk_nodes, gb, lowp=lowp
+                )
         else:
             hist = build_histogram_scatter_batched(
                 gbinned, loc, g, h, chunk_nodes, gb

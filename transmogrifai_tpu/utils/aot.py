@@ -104,7 +104,8 @@ def _version_salt() -> str:
         # trace-time env knobs are program identity too: a blob exported
         # under one knob value must not be served to a process expecting
         # another (TPTPU_HIST additionally rides the explicit statics)
-        for knob in ("TPTPU_HIST", "TPTPU_GEMM_MCAP", "TPTPU_BOOST_CHUNK"):
+        for knob in ("TPTPU_HIST", "TPTPU_HIST_COMB", "TPTPU_GEMM_MCAP",
+                     "TPTPU_BOOST_CHUNK"):
             h.update(f"{knob}={os.environ.get(knob, '')}".encode())
         _SALT = h.hexdigest()[:16]
     return _SALT
