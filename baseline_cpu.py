@@ -178,6 +178,189 @@ def bench_text_cpu(n_rows: int = 100_000) -> None:
     })
 
 
+def bench_iris_cpu() -> None:
+    """MultiClassificationModelSelector workload shape on Iris: LR grid 8 +
+    RF grid 18 × 3-fold CV + refit + 10% holdout (default candidates per
+    MultiClassificationModelSelector.scala:61-63)."""
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import f1_score
+    from sklearn.model_selection import StratifiedKFold
+
+    path = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data"
+    rows = [line.strip().split(",") for line in open(path) if line.strip()]
+    x = np.array([[float(v) for v in r[:4]] for r in rows])
+    labels = sorted({r[4] for r in rows})
+    y = np.array([labels.index(r[4]) for r in rows], dtype=np.float64)
+    rng = np.random.default_rng(42)
+    perm = rng.permutation(len(y))
+    cut = int(len(y) * 0.9)
+    tr, ho = perm[:cut], perm[cut:]
+    xt, yt, xh, yh = x[tr], y[tr], x[ho], y[ho]
+
+    candidates = []
+    for reg in [0.001, 0.01, 0.1, 0.2]:
+        for en in [0.1, 0.5]:
+            candidates.append(lambda reg=reg, en=en: LogisticRegression(
+                solver="saga", l1_ratio=en,
+                C=1.0 / max(reg * len(yt), 1e-12), max_iter=200, n_jobs=-1,
+            ))
+    for depth in [3, 6, 12]:
+        for mi in [10, 100]:
+            for mg in [0.001, 0.01, 0.1]:
+                candidates.append(
+                    lambda depth=depth, mi=mi, mg=mg: RandomForestClassifier(
+                        n_estimators=50, max_depth=depth,
+                        min_samples_leaf=mi, min_impurity_decrease=mg,
+                        random_state=0, n_jobs=-1,
+                    ))
+    skf = StratifiedKFold(n_splits=3, shuffle=True, random_state=42)
+    t0 = time.perf_counter()
+    results = []
+    for make in candidates:
+        scores = []
+        for tri, vai in skf.split(xt, yt):
+            m = make().fit(xt[tri], yt[tri])
+            scores.append(
+                f1_score(yt[vai], m.predict(xt[vai]), average="weighted")
+            )
+        results.append((float(np.mean(scores)), make))
+    best = max(results, key=lambda r: r[0])
+    final = best[1]().fit(xt, yt)
+    acc = float((final.predict(xh) == yh).mean())
+    wall = time.perf_counter() - t0
+    _merge_workload("iris", {
+        "value": round(wall, 3), "unit": "s",
+        "candidates": len(candidates), "cv_fits": len(candidates) * 3,
+        "holdout_accuracy": round(acc, 4),
+        "config": "Iris 150 rows, LR 8 + RF 18 x 3-fold CV + refit + holdout",
+        "hardware": f"{os.cpu_count()} vCPU (container), sklearn n_jobs=-1",
+    })
+
+
+def bench_boston_cpu() -> None:
+    """RegressionModelSelector workload shape on Boston housing: LinReg 8 +
+    RF 18 + GBT 18, single 0.75 train/validation split + refit + 10%
+    holdout RMSE (RegressionModelSelector.scala:61-63 defaults)."""
+    from sklearn.ensemble import (
+        GradientBoostingRegressor,
+        RandomForestRegressor,
+    )
+    from sklearn.linear_model import ElasticNet
+    from sklearn.metrics import mean_squared_error
+
+    path = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
+            "housingData.csv")
+    rows = [line.strip().split(",") for line in open(path) if line.strip()]
+    x = np.array([[float(v) for v in r[1:14]] for r in rows])
+    y = np.array([float(r[14]) for r in rows])
+    rng = np.random.default_rng(42)
+    perm = rng.permutation(len(y))
+    cut = int(len(y) * 0.9)
+    tr, ho = perm[:cut], perm[cut:]
+    xt, yt, xh, yh = x[tr], y[tr], x[ho], y[ho]
+    tv = rng.random(len(yt)) < 0.75  # TrainValidationSplit default ratio
+
+    candidates = []
+    for reg in [0.001, 0.01, 0.1, 0.2]:
+        for en in [0.1, 0.5]:
+            candidates.append(lambda reg=reg, en=en: ElasticNet(
+                alpha=reg, l1_ratio=en, max_iter=2000,
+            ))
+    for depth in [3, 6, 12]:
+        for mi in [10, 100]:
+            for mg in [0.001, 0.01, 0.1]:
+                candidates.append(
+                    lambda depth=depth, mi=mi, mg=mg: RandomForestRegressor(
+                        n_estimators=50, max_depth=depth,
+                        min_samples_leaf=mi, min_impurity_decrease=mg,
+                        random_state=0, n_jobs=-1,
+                    ))
+    for depth in [3, 6, 12]:
+        for mi in [10, 100]:
+            for mg in [0.001, 0.01, 0.1]:
+                candidates.append(
+                    lambda depth=depth, mi=mi, mg=mg: GradientBoostingRegressor(
+                        n_estimators=20, learning_rate=0.1, max_depth=depth,
+                        min_samples_leaf=mi, min_impurity_decrease=mg,
+                        random_state=0,
+                    ))
+    t0 = time.perf_counter()
+    results = []
+    for make in candidates:
+        m = make().fit(xt[tv], yt[tv])
+        rmse = float(np.sqrt(mean_squared_error(
+            yt[~tv], m.predict(xt[~tv]))))
+        results.append((rmse, make))
+    best = min(results, key=lambda r: r[0])
+    final = best[1]().fit(xt, yt)
+    rmse_h = float(np.sqrt(mean_squared_error(yh, final.predict(xh))))
+    wall = time.perf_counter() - t0
+    _merge_workload("boston", {
+        "value": round(wall, 3), "unit": "s",
+        "candidates": len(candidates),
+        "holdout_rmse": round(rmse_h, 3),
+        "config": ("Boston 506 rows, LinReg 8 + RF 18 + GBT 18, "
+                   ".75 train/validation split + refit + holdout"),
+        "hardware": f"{os.cpu_count()} vCPU (container), sklearn n_jobs=-1",
+    })
+
+
+def bench_serving_cpu() -> None:
+    """Local-scoring anchor (the comparable for serve_row_p50_ms /
+    serve_batch_rows_per_sec): an sklearn Pipeline(ColumnTransformer +
+    RandomForest) fitted on Titanic, then timed on per-row dict scoring
+    (DataFrame of one row per call — the MLeap-style request path,
+    OpWorkflowModelLocal.scala:79) and one full-batch predict."""
+    import pandas as pd
+    from sklearn.compose import ColumnTransformer
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.impute import SimpleImputer
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import OneHotEncoder
+
+    path = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+    df = pd.read_csv(path)
+    y = df["Survived"].astype(float).to_numpy()
+    feats = df[["Pclass", "Age", "SibSp", "Parch", "Fare", "Sex",
+                "Embarked", "Cabin"]].copy()
+    num_cols = ["Pclass", "Age", "SibSp", "Parch", "Fare"]
+    cat_cols = ["Sex", "Embarked", "Cabin"]
+    pipe = Pipeline([
+        ("prep", ColumnTransformer([
+            ("num", SimpleImputer(strategy="median"), num_cols),
+            ("cat", Pipeline([
+                ("imp", SimpleImputer(strategy="constant", fill_value="")),
+                ("oh", OneHotEncoder(handle_unknown="ignore", max_categories=30)),
+            ]), cat_cols),
+        ])),
+        ("rf", RandomForestClassifier(n_estimators=50, max_depth=6,
+                                      random_state=0, n_jobs=-1)),
+    ])
+    pipe.fit(feats, y)
+    row = feats.iloc[[0]]
+    pipe.predict_proba(row)  # warm
+    lat = []
+    for i in range(50):
+        r = feats.iloc[[i % len(feats)]]
+        t0 = time.perf_counter()
+        pipe.predict_proba(r)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    pipe.predict_proba(feats)  # warm batch
+    t0 = time.perf_counter()
+    pipe.predict_proba(feats)
+    batch_s = time.perf_counter() - t0
+    _merge_workload("serving", {
+        "row_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        "batch_rows_per_sec": round(len(feats) / batch_s),
+        "config": ("sklearn Pipeline(ColumnTransformer+RF50) on Titanic; "
+                   "per-row = 1-row DataFrame predict_proba"),
+        "estimator": "sklearn Pipeline.predict_proba",
+        "hardware": f"{os.cpu_count()} vCPU (container)",
+    })
+
+
 def load_titanic(path: str) -> tuple[np.ndarray, np.ndarray]:
     rows = list(csv.DictReader(open(path)))
     n = len(rows)
@@ -332,5 +515,11 @@ if __name__ == "__main__":
         bench_logistic_cpu()
     elif cmd == "text":
         bench_text_cpu()
+    elif cmd == "iris":
+        bench_iris_cpu()
+    elif cmd == "boston":
+        bench_boston_cpu()
+    elif cmd == "serving":
+        bench_serving_cpu()
     else:
         main()

@@ -151,6 +151,64 @@ def bench_titanic() -> dict:
     }
 
 
+def bench_iris() -> dict:
+    """BASELINE.json config-2: Iris MultiClassificationModelSelector
+    end-to-end (examples/iris.py flow), timed."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.readers.csv import infer_csv_dataset
+    from transmogrifai_tpu.selector import MultiClassificationModelSelector
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    data = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data"
+    headers = ["sepalLength", "sepalWidth", "petalLength", "petalWidth",
+               "irisClass"]
+    t0 = time.perf_counter()
+    ds = infer_csv_dataset(data, headers=headers, has_header=False)
+    label_text, predictors = from_dataset(
+        ds, response="irisClass", response_type=T.PickList
+    )
+    label = label_text.string_indexed()
+    vector = transmogrify(predictors)
+    pred = (
+        MultiClassificationModelSelector(seed=42)
+        .set_input(label, vector).get_output()
+    )
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    train_s = time.perf_counter() - t0
+    holdout = model.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]
+    return {"train_s": train_s,
+            "holdout_accuracy": (
+                1.0 - holdout["Error"] if "Error" in holdout else None
+            )}
+
+
+def bench_boston() -> dict:
+    """BASELINE.json config-3: Boston RegressionModelSelector end-to-end
+    (examples/boston.py flow), timed."""
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.readers.csv import infer_csv_dataset
+    from transmogrifai_tpu.selector import RegressionModelSelector
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    data = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
+            "housingData.csv")
+    headers = ["rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age",
+               "dis", "rad", "tax", "ptratio", "b", "lstat", "medv"]
+    t0 = time.perf_counter()
+    ds = infer_csv_dataset(data, headers=headers, has_header=False)
+    medv, predictors = from_dataset(ds, response="medv")
+    predictors = [p for p in predictors if p.name != "rowId"]
+    vector = transmogrify(predictors)
+    pred = RegressionModelSelector(seed=42).set_input(medv, vector).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    train_s = time.perf_counter() - t0
+    holdout = model.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]
+    return {"train_s": train_s, "holdout_rmse": holdout.get("RMSE")}
+
+
 def bench_transmogrify_throughput(n_rows: int = 200_000) -> dict:
     """rows/sec/chip through the numeric vectorizer plane."""
     import transmogrifai_tpu.types as T
@@ -414,16 +472,19 @@ def main() -> None:
             max_depth=depth, num_bins=bins,
         )
         base = _cpu_workload_baseline(sys.argv[1])
+        vsb = round(base["value"] / scale["train_s"], 3) if base else 0.0
         print(
             json.dumps(
                 {
                     "metric": f"boosted_trees_{sys.argv[1]}_train_wallclock",
                     "value": round(scale["train_s"], 3),
                     "unit": "s",
-                    "vs_baseline": (
-                        round(base["value"] / scale["train_s"], 3)
-                        if base else 0.0
-                    ),
+                    "vs_baseline": vsb,
+                    # honest multi-core framing: the CPU anchor ran on ONE
+                    # vCPU while the reference's candidate pool assumes 8
+                    # cores (OpValidator.scala:371-379) — this divides by 8
+                    # as if the anchor scaled perfectly
+                    "vs_8core_cpu_est": round(vsb / 8.0, 3),
                     "baseline_s": base.get("value") if base else None,
                     "baseline_hw": base.get("hardware") if base else None,
                     "rows_x_rounds_per_sec": round(scale["rows_x_rounds_per_sec"]),
@@ -439,15 +500,15 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "logsweep":
         ls = bench_logistic_sweep()
         base = _cpu_workload_baseline("logistic_sweep")
+        vsb = round(base["value"] / ls["train_s"], 3) if base else 0.0
         print(
             json.dumps(
                 {
                     "metric": "logistic_sweep_72fits_wallclock",
                     "value": round(ls["train_s"], 3),
                     "unit": "s",
-                    "vs_baseline": (
-                        round(base["value"] / ls["train_s"], 3) if base else 0.0
-                    ),
+                    "vs_baseline": vsb,
+                    "vs_8core_cpu_est": round(vsb / 8.0, 3),
                     "baseline_s": base.get("value") if base else None,
                     "baseline_hw": base.get("hardware") if base else None,
                     "fits": ls["fits"],
@@ -476,23 +537,60 @@ def main() -> None:
         )
         return
     titanic = bench_titanic()
+    iris = bench_iris()
+    boston = bench_boston()
     thru = bench_transmogrify_throughput()
     text = bench_transmogrify_text()
     value = titanic["train_s"]
+    iris_base = _cpu_workload_baseline("iris")
+    boston_base = _cpu_workload_baseline("boston")
+    serve_base = _cpu_workload_baseline("serving")
+    vsb = round(REFERENCE_TITANIC_TRAIN_S / value, 3)
     print(
         json.dumps(
             {
                 "metric": "titanic_binary_selector_train_wallclock",
                 "value": round(value, 3),
                 "unit": "s",
-                "vs_baseline": round(REFERENCE_TITANIC_TRAIN_S / value, 3),
+                "vs_baseline": vsb,
+                # the CPU anchor is single-core; the reference assumes a
+                # parallelism-8 pool (OpValidator.scala:371-379) — the
+                # per-core-honest estimate divides by 8
+                "vs_8core_cpu_est": round(vsb / 8.0, 3),
                 "baseline_s": REFERENCE_TITANIC_TRAIN_S,
                 "holdout_aupr": round(titanic["holdout_aupr"], 4),
                 "holdout_auroc": round(titanic["holdout_auroc"], 4),
                 "candidates": titanic["n_candidates"],
+                "iris_train_s": round(iris["train_s"], 3),
+                "iris_vs_baseline": (
+                    round(iris_base["value"] / iris["train_s"], 3)
+                    if iris_base else 0.0
+                ),
+                "iris_holdout_accuracy": iris.get("holdout_accuracy"),
+                "boston_train_s": round(boston["train_s"], 3),
+                "boston_vs_baseline": (
+                    round(boston_base["value"] / boston["train_s"], 3)
+                    if boston_base else 0.0
+                ),
+                "boston_holdout_rmse": (
+                    round(boston["holdout_rmse"], 3)
+                    if boston.get("holdout_rmse") is not None else None
+                ),
                 "score_s": round(titanic["score_s"], 3),
                 "serve_row_p50_ms": titanic["serve_row_p50_ms"],
+                "serve_row_p50_vs_sklearn": (
+                    round(
+                        serve_base["row_p50_ms"] / titanic["serve_row_p50_ms"],
+                        2,
+                    ) if serve_base else None
+                ),
                 "serve_batch_rows_per_sec": titanic["serve_batch_rows_per_sec"],
+                "serve_batch_vs_sklearn": (
+                    round(
+                        titanic["serve_batch_rows_per_sec"]
+                        / serve_base["batch_rows_per_sec"], 3,
+                    ) if serve_base else None
+                ),
                 "flagship_width_raw": titanic["flagship_width_raw"],
                 "flagship_width_checked": titanic["flagship_width_checked"],
                 "transmogrify_rows_per_sec": round(thru["rows_per_sec"]),

@@ -962,7 +962,14 @@ def _forest_trees_scan(
     eval traversal program."""
     k_fits, n = row_mask.shape
     f = binned.shape[1]
-    gb = jnp.broadcast_to(-target[None, :], (k_fits, n))
+    # target: [N] shared, or [K, N] per-lane (one-vs-rest class indicators
+    # ride the fit axis — the multiclass RF sweep trains every
+    # class × fold × grid-point forest in this one program)
+    target = jnp.asarray(target)
+    if target.ndim == 1:
+        gb = jnp.broadcast_to(-target[None, :], (k_fits, n))
+    else:
+        gb = -target
     ones = jnp.ones((k_fits, n), dtype=jnp.float32)
     mi_k = jnp.broadcast_to(
         jnp.asarray(min_instances, dtype=jnp.float32).reshape(-1), (k_fits,)
@@ -1096,6 +1103,11 @@ def fit_forest_batched(
         if max_depth_v is not None:
             raise NotImplementedError(
                 "per-lane depth caps are single-device only (the sweep path)"
+            )
+        if getattr(target, "ndim", 1) != 1:
+            raise NotImplementedError(
+                "per-lane targets are single-device only (the multiclass "
+                "sweep path); shard multiclass one class at a time"
             )
         key = jax.random.PRNGKey(seed)
         tkeys = jax.random.split(key, num_trees)

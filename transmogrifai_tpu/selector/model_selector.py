@@ -335,13 +335,14 @@ class ModelSelector(PredictorEstimator):
                 # fit program itself — grab them BEFORE detach frees the
                 # stack, so train evaluation needs no re-predict
                 stack = getattr(best_model, "_sweep_stack", None)
-                if (
-                    stack is not None and stack.get("outputs") is not None
-                    and hasattr(best_model, "predictions_from_sweep")
-                ):
-                    refit_raw = np.asarray(stack["outputs"])[
-                        best_model._sweep_lane
-                    ]
+                if stack is not None and stack.get("outputs") is not None:
+                    lanes = getattr(best_model, "_sweep_lanes", None)
+                    if lanes is not None:
+                        refit_raw = ("multi", np.asarray(
+                            stack["outputs"])[lanes])
+                    elif hasattr(best_model, "predictions_from_sweep"):
+                        refit_raw = ("single", np.asarray(
+                            stack["outputs"])[best_model._sweep_lane])
                 # free the sweep stacks: keep only the winner's own lane
                 detach = getattr(best_model, "detach_from_sweep", None)
                 if detach is not None:
@@ -357,7 +358,11 @@ class ModelSelector(PredictorEstimator):
                 best_model = final_est.fit_arrays(xt, yt, final_mask)
 
         if refit_raw is not None:
-            pred, prob, _ = best_model.predictions_from_sweep(refit_raw)
+            kind, raw = refit_raw
+            if kind == "multi":
+                pred, prob, _ = best_model.predictions_from_sweep_multi(raw)
+            else:
+                pred, prob, _ = best_model.predictions_from_sweep(raw)
         else:
             pred, prob, _ = best_model.predict_arrays(xt)
         train_metrics = self.evaluator.evaluate_arrays(yt, pred, prob)
