@@ -59,3 +59,62 @@ def test_np_chunker_proper_nouns():
 
 def test_punctuation_tags():
     assert pos_tag(["Stop", "!"])[-1] == "."
+
+
+# ---------------------------------------------------------------------
+# the six non-English reference POS languages (OpenNLP binaries for
+# da/de/es/nl/pt/sv — models/README.md): accuracy floors on the authored
+# gold corpora + per-language chunking
+# ---------------------------------------------------------------------
+import json
+import os
+
+_GOLD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "pos_gold.json"
+)
+
+
+def _gold():
+    with open(_GOLD_PATH) as f:
+        return json.load(f)
+
+
+def test_pos_gold_floors_all_languages():
+    gold = _gold()
+    assert sorted(gold) == ["da", "de", "es", "nl", "pt", "sv"]
+    for lang, sents in gold.items():
+        hits = total = 0
+        for toks, gt in sents:
+            tags = pos_tag(toks, language=lang)
+            assert len(tags) == len(gt)
+            hits += sum(a == b for a, b in zip(tags, gt))
+            total += len(gt)
+        assert hits / total >= 0.9, f"{lang}: {hits}/{total}"
+
+
+def test_pos_unknown_language_falls_back_to_english():
+    assert pos_tag(["the", "dog"], language="zz") == ["DT", "NN"]
+
+
+def test_chunker_german():
+    nps = chunk_noun_phrases(
+        "Die Lehrerin las eine interessante Geschichte .".split(),
+        language="de",
+    )
+    assert "Die Lehrerin" in nps
+    assert "eine interessante Geschichte" in nps
+
+
+def test_chunker_spanish_postnominal():
+    nps = chunk_noun_phrases(
+        "Ella compró una casa nueva en la ciudad .".split(), language="es"
+    )
+    assert "una casa nueva" in nps  # postnominal adjective joins the NP
+    assert "la ciudad" in nps
+
+
+def test_chunker_swedish():
+    nps = chunk_noun_phrases(
+        "Hon köpte ett stort hus i staden .".split(), language="sv"
+    )
+    assert "ett stort hus" in nps

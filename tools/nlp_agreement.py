@@ -165,6 +165,30 @@ def eval_pos() -> float:
     return hits / total
 
 
+def eval_pos_languages() -> dict[str, tuple[float, int]]:
+    """Per-language POS accuracy over the authored gold corpora
+    (tests/fixtures/pos_gold.json — da, de, es, nl, pt, sv)."""
+    import json as _json
+
+    from transmogrifai_tpu.nlp.pos import pos_tag
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fixtures", "pos_gold.json",
+    )
+    with open(path) as f:
+        gold = _json.load(f)
+    out = {}
+    for lang, sents in sorted(gold.items()):
+        hits = total = 0
+        for toks, gt in sents:
+            tags = pos_tag(toks, language=lang)
+            hits += sum(a == b for a, b in zip(tags, gt))
+            total += len(gt)
+        out[lang] = (hits / total, total)
+    return out
+
+
 def main() -> None:
     rows = eval_langid()
     total = sum(n for _, _, n in rows)
@@ -189,8 +213,10 @@ def main() -> None:
     for lang, rec in sorted(ner.items()):
         print(f"{lang}: person-token recall {rec:.0%} on authored fixtures")
 
-    print("\n## POS tagging (nlp/pos.py, English)\n")
-    print(f"token accuracy {eval_pos():.1%} on the authored gold corpus")
+    print("\n## POS tagging (nlp/pos.py)\n")
+    print(f"en: token accuracy {eval_pos():.1%} on the authored gold corpus")
+    for lang, (acc, n) in eval_pos_languages().items():
+        print(f"{lang}: token accuracy {acc:.1%} on {n} gold tokens")
 
 
 if __name__ == "__main__":
