@@ -361,6 +361,167 @@ def bench_serving_cpu() -> None:
     })
 
 
+def make_topic_corpus(n_docs=5000, n_topics=10, words_per_topic=200,
+                      doc_len=40, noise=0.1, seed=7):
+    """Synthetic clustered-topic corpus with KNOWN structure, shared by the
+    TPU bench (bench.py embeddings) and the CPU anchors below: every word
+    belongs to one generative topic ('t{k}_w{i}'), documents draw 90% of
+    tokens from their own topic. Quality metrics measure recovery of that
+    known structure (word-neighbor precision, topic purity)."""
+    rng = np.random.default_rng(seed)
+    vocab = [
+        f"t{k}_w{i}" for k in range(n_topics) for i in range(words_per_topic)
+    ]
+    v = n_topics * words_per_topic
+    doc_topics = rng.integers(0, n_topics, n_docs)
+    ids = np.empty((n_docs, doc_len), np.int32)
+    for d in range(n_docs):
+        own = (rng.integers(0, words_per_topic, doc_len)
+               + doc_topics[d] * words_per_topic)
+        noise_mask = rng.random(doc_len) < noise
+        ids[d] = np.where(noise_mask, rng.integers(0, v, doc_len), own)
+    return vocab, ids, doc_topics
+
+
+def w2v_neighbor_precision(vocab, vectors, words_per_topic, k=10,
+                           sample=200, seed=3):
+    """precision@k: fraction of a word's k cosine neighbors sharing its
+    generative topic (random baseline = 1/n_topics)."""
+    rng = np.random.default_rng(seed)
+    w = np.asarray(vectors, dtype=np.float64)
+    w = w / np.maximum(np.linalg.norm(w, axis=1, keepdims=True), 1e-12)
+    topics = np.array([int(t.split("_")[0][1:]) for t in vocab])
+    idx = rng.choice(len(vocab), size=min(sample, len(vocab)), replace=False)
+    hits = total = 0
+    sims = w[idx] @ w.T
+    for row, i in enumerate(idx):
+        order = np.argsort(-sims[row])
+        nbrs = [j for j in order if j != i][:k]
+        hits += sum(topics[j] == topics[i] for j in nbrs)
+        total += k
+    return hits / total
+
+
+def lda_quality(topic_word, doc_topic, doc_topics_true, words_per_topic,
+                top=20):
+    """(topic purity over top words, greedy-matched doc accuracy)."""
+    tw = np.asarray(topic_word, dtype=np.float64)
+    n_topics_true = int(doc_topics_true.max()) + 1
+    purities = []
+    for krow in tw:
+        top_words = np.argsort(-krow)[:top]
+        gen = top_words // words_per_topic
+        purities.append(np.bincount(gen, minlength=n_topics_true).max() / top)
+    # greedy 1-1 matching of learned topics to generative topics
+    pred = np.argmax(np.asarray(doc_topic), axis=1)
+    conf = np.zeros((tw.shape[0], n_topics_true))
+    for p, t in zip(pred, doc_topics_true):
+        conf[p, t] += 1
+    mapping = {}
+    used = set()
+    for _ in range(min(conf.shape)):
+        p, t = np.unravel_index(
+            np.argmax(np.where(
+                np.isin(np.arange(conf.shape[1]), list(used))[None, :]
+                | np.isin(np.arange(conf.shape[0]),
+                          list(mapping))[:, None],
+                -1, conf,
+            )), conf.shape,
+        )
+        mapping[p] = t
+        used.add(t)
+    acc = np.mean([
+        mapping.get(p, -1) == t for p, t in zip(pred, doc_topics_true)
+    ])
+    return float(np.mean(purities)), float(acc)
+
+
+def _w2v_pairs(ids: np.ndarray, window: int = 5):
+    """Skip-gram pairs over id sequences (same construction as
+    OpWord2Vec.fit_model at min_count<=doc frequency)."""
+    pairs = []
+    for row in ids:
+        n = len(row)
+        for i in range(n):
+            for j in range(max(0, i - window), min(n, i + window + 1)):
+                if j != i:
+                    pairs.append((row[i], row[j]))
+    return np.asarray(pairs, dtype=np.int32)
+
+
+def bench_w2v_cpu() -> None:
+    """Numpy SGNS with the same schedule as ops/embeddings._sgns_train —
+    the CPU stand-in (no gensim wheel in this image; like HistGBM stands
+    in for libxgboost, same algorithm family on optimized C loops)."""
+    vocab, ids, _ = make_topic_corpus()
+    pairs = _w2v_pairs(ids)
+    v, dim, batch, num_neg, lr = len(vocab), 100, 1024, 5, 8.0
+    steps = max(200, -(-2 * len(pairs) // batch))
+    rng = np.random.default_rng(42)
+    idx = rng.integers(0, len(pairs), size=(steps, batch))
+    neg = rng.integers(0, v, size=(steps, batch, num_neg))
+    w_in = rng.standard_normal((v, dim)).astype(np.float64) / dim
+    w_out = np.zeros((v, dim), dtype=np.float64)
+    lr_sched = lr * (1.0 - np.arange(steps) / steps)  # classic decay
+    t0 = time.perf_counter()
+    for s in range(steps):
+        lr_t = lr_sched[s]
+        c = pairs[idx[s], 0]
+        ctx = pairs[idx[s], 1]
+        ng = neg[s]
+        vv = w_in[c]
+        u_pos = w_out[ctx]
+        u_neg = w_out[ng]
+        pos = np.einsum("bd,bd->b", vv, u_pos)
+        negs = np.einsum("bd,bgd->bg", vv, u_neg)
+        sp = 1.0 / (1.0 + np.exp(-pos))
+        sn = 1.0 / (1.0 + np.exp(negs))
+        g_pos = -(1.0 - sp) / batch
+        # d/dx of -log sigmoid(-x) is sigmoid(x)
+        g_neg = (1.0 - sn) / batch
+        gv = g_pos[:, None] * u_pos + np.einsum("bg,bgd->bd", g_neg, u_neg)
+        gp = g_pos[:, None] * vv
+        gn = g_neg[..., None] * vv[:, None, :]
+        np.add.at(w_in, c, -lr_t * gv)
+        np.add.at(w_out, ctx, -lr_t * gp)
+        np.add.at(w_out, ng.reshape(-1), -lr_t * gn.reshape(-1, dim))
+    wall = time.perf_counter() - t0
+    p10 = w2v_neighbor_precision(vocab, w_in, 200)
+    _merge_workload("word2vec", {
+        "value": round(wall, 3), "unit": "s",
+        "steps": int(steps),
+        "neighbor_precision_at_10": round(p10, 4),
+        "config": "5000 docs x 40 tokens, vocab 2000, dim 100, 2 epochs SGNS",
+        "estimator": "numpy SGNS (no gensim wheel in image)",
+        "hardware": f"{os.cpu_count()} vCPU (container)",
+    })
+
+
+def bench_lda_cpu() -> None:
+    from sklearn.decomposition import LatentDirichletAllocation
+
+    vocab, ids, doc_topics = make_topic_corpus()
+    v = len(vocab)
+    counts = np.zeros((len(ids), v), dtype=np.float64)
+    for d, row in enumerate(ids):
+        np.add.at(counts[d], row, 1.0)
+    t0 = time.perf_counter()
+    lda = LatentDirichletAllocation(
+        n_components=10, max_iter=20, random_state=0, n_jobs=-1
+    )
+    theta = lda.fit_transform(counts)
+    wall = time.perf_counter() - t0
+    purity, acc = lda_quality(lda.components_, theta, doc_topics, 200)
+    _merge_workload("lda", {
+        "value": round(wall, 3), "unit": "s",
+        "topic_purity_top20": round(purity, 4),
+        "doc_topic_accuracy": round(acc, 4),
+        "config": "5000 docs x vocab 2000, k=10, 20 iters",
+        "estimator": "sklearn LatentDirichletAllocation (batch)",
+        "hardware": f"{os.cpu_count()} vCPU (container)",
+    })
+
+
 def load_titanic(path: str) -> tuple[np.ndarray, np.ndarray]:
     rows = list(csv.DictReader(open(path)))
     n = len(rows)
@@ -521,5 +682,9 @@ if __name__ == "__main__":
         bench_boston_cpu()
     elif cmd == "serving":
         bench_serving_cpu()
+    elif cmd == "w2v":
+        bench_w2v_cpu()
+    elif cmd == "lda":
+        bench_lda_cpu()
     else:
         main()

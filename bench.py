@@ -209,6 +209,69 @@ def bench_boston() -> dict:
     return {"train_s": train_s, "holdout_rmse": holdout.get("RMSE")}
 
 
+def bench_embeddings() -> dict:
+    """Word2Vec + LDA quality and wall-clock on the shared synthetic
+    clustered-topic corpus (baseline_cpu.make_topic_corpus), through the
+    real stage API (OpWord2Vec/OpLDA)."""
+    import baseline_cpu as BC
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.ops.embeddings import OpLDA, OpWord2Vec
+    from transmogrifai_tpu.stages.metadata import ColumnMeta, VectorMetadata
+    from transmogrifai_tpu.types.columns import ListColumn, VectorColumn
+
+    vocab, ids, doc_topics = BC.make_topic_corpus()
+    docs = np.empty(len(ids), dtype=object)
+    for d, row in enumerate(ids):
+        docs[d] = [vocab[i] for i in row]
+
+    ds = Dataset.of({"text": ListColumn(T.TextList, docs)})
+    feat = FeatureBuilder.TextList("text").as_predictor()
+
+    est = OpWord2Vec(min_count=1, max_vocab=len(vocab))
+    est.set_input(feat)
+    t0 = time.perf_counter()
+    model = est.fit_model(ds)
+    w2v_s = time.perf_counter() - t0
+    order = [model.vocab.index(t) if t in model.vocab else -1 for t in vocab]
+    vecs = np.stack([
+        model.vectors[i] if i >= 0 else np.zeros(model.vectors.shape[1])
+        for i in order
+    ])
+    p10 = BC.w2v_neighbor_precision(vocab, vecs, 200)
+
+    counts = np.zeros((len(ids), len(vocab)), dtype=np.float32)
+    for d, row in enumerate(ids):
+        np.add.at(counts[d], row, 1.0)
+    metas = tuple(
+        ColumnMeta(parent_names=("text",), parent_type="TextList",
+                   grouping="text", descriptor_value=v_, index=i)
+        for i, v_ in enumerate(vocab)
+    )
+    cds = Dataset.of({
+        "counts": VectorColumn(
+            T.OPVector, counts, VectorMetadata("counts", metas)
+        ),
+    })
+    cfeat = FeatureBuilder.OPVector("counts").as_predictor()
+    lda = OpLDA(k=10, max_iter=20)
+    lda.set_input(cfeat)
+    t0 = time.perf_counter()
+    lmodel = lda.fit_model(cds)
+    lmodel.set_input(cfeat)
+    theta = lmodel.transform_columns(
+        cds["counts"], num_rows=len(ids)
+    ).values
+    lda_s = time.perf_counter() - t0
+    purity, acc = BC.lda_quality(lmodel.topic_word, theta, doc_topics, 200)
+    return {
+        "w2v_train_s": w2v_s, "w2v_neighbor_p10": p10,
+        "lda_train_s": lda_s, "lda_topic_purity": purity,
+        "lda_doc_accuracy": acc,
+    }
+
+
 def bench_transmogrify_throughput(n_rows: int = 200_000) -> dict:
     """rows/sec/chip through the numeric vectorizer plane."""
     import transmogrifai_tpu.types as T
@@ -493,6 +556,46 @@ def main() -> None:
                         f"{rows} rows x {feats} feats, {rounds} rounds "
                         f"depth {depth}, {bins} bins"
                     ),
+                }
+            )
+        )
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "embeddings":
+        emb = bench_embeddings()
+        w2v_base = _cpu_workload_baseline("word2vec")
+        lda_base = _cpu_workload_baseline("lda")
+        print(
+            json.dumps(
+                {
+                    "metric": "embeddings_w2v_lda_wallclock",
+                    "value": round(emb["w2v_train_s"] + emb["lda_train_s"], 3),
+                    "unit": "s",
+                    "vs_baseline": (
+                        round(
+                            (w2v_base["value"] + lda_base["value"])
+                            / (emb["w2v_train_s"] + emb["lda_train_s"]), 3,
+                        ) if (w2v_base and lda_base) else 0.0
+                    ),
+                    "w2v_train_s": round(emb["w2v_train_s"], 3),
+                    "w2v_baseline_s": (
+                        w2v_base.get("value") if w2v_base else None
+                    ),
+                    "w2v_neighbor_p10": round(emb["w2v_neighbor_p10"], 4),
+                    "w2v_baseline_p10": (
+                        w2v_base.get("neighbor_precision_at_10")
+                        if w2v_base else None
+                    ),
+                    "lda_train_s": round(emb["lda_train_s"], 3),
+                    "lda_baseline_s": (
+                        lda_base.get("value") if lda_base else None
+                    ),
+                    "lda_topic_purity": round(emb["lda_topic_purity"], 4),
+                    "lda_doc_accuracy": round(emb["lda_doc_accuracy"], 4),
+                    "lda_baseline_purity": (
+                        lda_base.get("topic_purity_top20")
+                        if lda_base else None
+                    ),
+                    "config": "5000 docs x 40 tokens, vocab 2000 (shared corpus with baseline_cpu)",
                 }
             )
         )
