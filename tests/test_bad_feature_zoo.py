@@ -185,3 +185,273 @@ class TestRawFeatureFilterZoo:
             "jsDivergence" in r for r in rff.results.excluded["drift"]
         )
         assert "ok" not in excluded
+
+
+def _words(rng, n, choices):
+    arr = np.empty(n, dtype=object)
+    vals = np.asarray(choices, dtype=object)[rng.integers(0, len(choices), n)]
+    arr[:] = vals
+    return arr
+
+
+class TestBadFeatureZooReferenceParity:
+    """The remaining BadFeatureZooTest constructions (901-LoC reference
+    suite), run END-TO-END through transmogrify → SanityChecker so the
+    round-4/5 checker features (parent-level group removal, hashed-text
+    exclusion/protection, sampling) are exercised on the workflow path.
+    Each test cites the reference scenario (BadFeatureZooTest.scala line)."""
+
+    def test_all_features_dropped_still_summarizes(self):
+        """:173 'not fail to run or serialize when passed empty features' —
+        when every predictor column is droppable the checker must still
+        produce a summary (and the workflow must not crash)."""
+        y = _label()
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "c1": _num(np.full(N, 1.0)),
+            "c2": _num(y),  # leak — also dropped
+        })
+        assert any(n.startswith("c1") for n in dropped)
+        assert any(n.startswith("c2") for n in dropped)
+
+    def test_cramers_v_picklist_leak_ignores_text_columns(self):
+        """:216/:308 — PickList leakage flagged via Cramer's V while TEXT
+        (hashed) columns sit out the categorical stats."""
+        y = _label()
+        rng = np.random.default_rng(5)
+        cat = np.where(y > 0.5, "survived", "died").astype(object)
+        freetext = np.array(
+            [" ".join(
+                str(w) for w in rng.choice(
+                    ["alpha", "beta", "gamma", "delta", "omega", "sigma",
+                     "kappa", "lambda"], size=6)
+             ) for _ in range(N)], dtype=object)
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "catleak": TextColumn(T.PickList, cat),
+            "freetext": TextColumn(T.Text, freetext),
+        })
+        cat_cols = [n for n in dropped if "catleak" in n]
+        assert cat_cols
+        assert any(
+            "cramersV" in r or "ruleConfidence" in r
+            for n in cat_cols for r in dropped[n]
+        )
+        # hashed free-text columns must not be flagged by Cramer's V
+        text_reasons = [
+            r for n in dropped if "freetext" in n for r in dropped[n]
+        ]
+        assert not any("cramersV" in r for r in text_reasons)
+
+    def test_no_cramers_v_for_continuous_label(self):
+        """:264/:628 — a continuous (non-categorical) label must not get
+        Cramer's V treatment against categorical features."""
+        rng = np.random.default_rng(6)
+        y = rng.normal(size=N) * 10  # continuous label, many levels
+        cat = _words(rng, N, ["a", "b", "c"])
+        ds = Dataset.of({
+            "label": _num(y, T.RealNN),
+            "cat": TextColumn(T.PickList, cat),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(preds)
+        checked = resp.transform_with(
+            SanityChecker(remove_bad_features=True), vec
+        )
+        _, stages = fit_and_transform_dag(ds, [checked])
+        checker = next(
+            s for s in stages.values()
+            if s.metadata.get("sanityCheckerSummary") is not None
+        )
+        summary = checker.metadata["sanityCheckerSummary"]
+        all_reasons = [
+            r for c in summary["columns"] for r in c.get("reasons", [])
+        ]
+        assert not any("cramersV" in r for r in all_reasons)
+
+    def test_null_indicator_leak_drops_parent_value_column(self):
+        """:354 — missingness that encodes the label: the null-indicator
+        column leaks, and parent-level removal takes the VALUE column of
+        the same feature with it."""
+        y = _label()
+        rng = np.random.default_rng(7)
+        mask = y > 0.5  # present exactly when label = 1
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "nullleak": _num(rng.normal(size=N), mask=mask),
+            "ok": _num(rng.normal(size=N)),
+        })
+        leak_cols = [n for n in dropped if "nullleak" in n]
+        # both the null indicator AND the value column of the parent go
+        assert any("NullIndicator" in n for n in leak_cols), leak_cols
+        assert any("NullIndicator" not in n for n in leak_cols), leak_cols
+
+    def test_null_indicator_leak_drops_hashed_text_parent(self):
+        """:401 — a TEXT feature missing exactly when the label fires: its
+        null indicator leaks and ALL hashed columns of that text feature
+        are removed with the parent."""
+        y = _label()
+        rng = np.random.default_rng(8)
+        words = ["alpha", "beta", "gamma", "delta", "omega", "sigma",
+                 "kappa", "lambda", "mu", "nu", "xi", "rho"]
+        text = np.empty(N, dtype=object)
+        for i in range(N):
+            text[i] = (
+                " ".join(str(w) for w in rng.choice(words, size=8))
+                if y[i] > 0.5 else None
+            )
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "textleak": TextColumn(T.Text, text),
+            "ok": _num(rng.normal(size=N)),
+        })
+        leak_cols = [n for n in dropped if "textleak" in n]
+        assert any("NullIndicator" in n for n in leak_cols), leak_cols
+        hashed = [
+            n for n in leak_cols if "NullIndicator" not in n
+        ]
+        assert hashed, f"hashed text columns survived: {list(dropped)}"
+
+    def test_correlated_hashed_text_drops_whole_parent(self):
+        """:474 — text CONTENT that encodes the label: enough hashed
+        columns correlate that the whole text feature is removed
+        (correlation_exclusion=NoExclusion, the reference test's setting)."""
+        y = _label()
+        text = np.empty(N, dtype=object)
+        for i in range(N):
+            text[i] = "good great win" if y[i] > 0.5 else "bad loss fail"
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "sentiment": TextColumn(T.Text, text),
+            "ok": _num(RNG.normal(size=N)),
+        }, correlation_exclusion="NoExclusion",
+           protect_text_shared_hash=False)
+        leak_cols = [n for n in dropped if "sentiment" in n]
+        assert leak_cols, f"correlated text survived: {list(dropped)}"
+
+    def test_binned_numeric_leak_dropped(self):
+        """:549 — a numeric whose BUCKETS encode the label (the reference's
+        autoBucketize age scenario): the bucketized columns leak."""
+        from transmogrifai_tpu.ops.bucketizers import (
+            DecisionTreeNumericBucketizer,
+        )
+
+        y = _label()
+        rng = np.random.default_rng(9)
+        # age < 50 exactly when label = 0 (+tiny noise keeps it numeric)
+        age = np.where(y > 0.5, 60.0, 30.0) + rng.normal(scale=2.0, size=N)
+        ds = Dataset.of({
+            "label": _num(y, T.RealNN),
+            "age": _num(age),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        age_feat = next(p for p in preds if p.name == "age")
+        binned = resp.transform_with(
+            DecisionTreeNumericBucketizer(), age_feat
+        )
+        from transmogrifai_tpu.ops.combiner import VectorsCombiner
+
+        vec = transmogrify(list(preds))
+        both = VectorsCombiner().set_input(vec, binned).get_output()
+        checked = resp.transform_with(
+            SanityChecker(remove_bad_features=True), both
+        )
+        _, stages = fit_and_transform_dag(ds, [checked])
+        checker = next(
+            s for s in stages.values()
+            if s.metadata.get("sanityCheckerSummary") is not None
+        )
+        summary = checker.metadata["sanityCheckerSummary"]
+        dropped = [c["name"] for c in summary["columns"] if c["dropped"]]
+        assert any("age" in n for n in dropped), dropped
+
+    def test_multipicklist_modified_cramers_v(self):
+        """:664 — MultiPickList whose set membership encodes the label."""
+        from transmogrifai_tpu.types.columns import column_from_values
+
+        y = _label()
+        rng = np.random.default_rng(10)
+        vals = []
+        for i in range(N):
+            base = ["red"] if y[i] > 0.5 else ["blue"]
+            extra = [str(w) for w in
+                     rng.choice(["x", "y", "z"], size=1)]
+            vals.append(base + extra)
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "tags": column_from_values(T.MultiPickList, vals),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        tag_cols = [n.lower() for n in dropped if "tags" in n]
+        assert any("red" in n or "blue" in n for n in tag_cols), (
+            f"multipicklist leak survived: {list(dropped)}"
+        )
+
+    def test_high_parent_correlation_drops_sibling_group(self):
+        """:720 — when a feature's columns correlate too hard with the
+        label, the WHOLE parent group goes (remove_feature_group=True),
+        not just the flagged sibling."""
+        from transmogrifai_tpu.types.columns import MapColumn
+
+        y = _label()
+        rng = np.random.default_rng(11)
+        maps = np.empty(N, dtype=object)
+        for i in range(N):
+            maps[i] = {
+                "leaky": float(y[i]),
+                "noisy": float(rng.normal()),
+            }
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "m": MapColumn(T.RealMap, maps),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        m_cols = [n for n in dropped if n.startswith("m_") or "m-" in n
+                  or "leaky" in n or "noisy" in n]
+        assert any("leaky" in n for n in m_cols), f"dropped={list(dropped)}"
+        # parent-group removal takes the clean sibling too
+        assert any("noisy" in n for n in m_cols), f"dropped={list(dropped)}"
+
+    def test_absolute_value_correlation_combination(self):
+        """:765 — sibling features with +r and −r must aggregate by
+        ABSOLUTE value at the parent level (a −0.95 sibling is as leaky as
+        a +0.95 one)."""
+        from transmogrifai_tpu.types.columns import MapColumn
+
+        y = _label()
+        maps = np.empty(N, dtype=object)
+        for i in range(N):
+            maps[i] = {"pos": float(y[i]), "neg": float(-y[i])}
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "m": MapColumn(T.RealMap, maps),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        assert any("neg" in n for n in dropped), (
+            f"negative-correlation sibling survived: {list(dropped)}"
+        )
+
+    def test_titanic_body_rule_confidence(self):
+        """:807 — the 'titanic body' scenario: a category present for only
+        some rows but PERFECTLY deciding the label when present (body id
+        recovered → died) must drop on rule confidence even though overall
+        correlation is modest."""
+        rng = np.random.default_rng(12)
+        y = _label()
+        cat = np.empty(N, dtype=object)
+        for i in range(N):
+            if y[i] < 0.5 and rng.random() < 0.4:
+                cat[i] = "body_recovered"   # only ever label=0
+            else:
+                cat[i] = str(rng.choice(["crew", "first", "second"]))
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "status": TextColumn(T.PickList, cat),
+            "ok": _num(RNG.normal(size=N)),
+        }, max_rule_confidence=0.99, min_required_rule_support=0.05)
+        status_cols = [n for n in dropped if "status" in n]
+        assert status_cols, f"rule-confidence leak survived: {list(dropped)}"
+        assert any(
+            "ruleConfidence" in r or "cramersV" in r
+            for n in status_cols for r in dropped[n]
+        )

@@ -188,8 +188,16 @@ class SanityChecker(Estimator):
             corr_features[excluded, :] = 0.0
             corr_features[:, excluded] = 0.0
 
-        # label one-hot for categorical stats (binary or small multiclass)
+        # label one-hot for categorical stats (binary or small multiclass).
+        # A CONTINUOUS label gets no Cramér's V / association-rule
+        # treatment at all (SanityChecker.scala categoricalLabel
+        # auto-detection: the label counts as categorical only when its
+        # distinct-value count is small relative to the row count;
+        # BadFeatureZooTest :264/:628 pin the skip).
         classes = np.unique(y)
+        label_is_categorical = len(classes) <= min(
+            100, max(2, int(0.1 * len(y)))
+        )
         label_onehot = (y[:, None] == classes[None, :]).astype(np.float64)
 
         drop_reasons: dict[int, list[str]] = {}
@@ -217,7 +225,7 @@ class SanityChecker(Estimator):
         # 4. categorical groups: Cramér's V + association rules
         group_v: dict[tuple, float] = {}
         group_cols: dict[tuple, list[int]] = {}
-        if meta.size == d:
+        if meta.size == d and label_is_categorical:
             for key, idxs in meta.index_of_group().items():
                 cats = [
                     i for i in idxs if meta.columns[i].indicator_value is not None
