@@ -808,13 +808,10 @@ def _f32_order_keys(a: np.ndarray) -> np.ndarray:
     return np.where(b >> 31 != 0, ~b, b | np.uint32(0x80000000))
 
 
-def bin_data_host(x: np.ndarray, thresholds: np.ndarray) -> np.ndarray:
-    """Host bin_data: ONE searchsorted over per-feature-offset integer keys
-    — O(N·F·log(F·B)) with no Python per-feature loop, vs the device scan's
-    O(N·F·B). Exact (integer key space, see _f32_order_keys): ties at a
-    threshold bin identically to the device path. Requires per-row sorted
-    thresholds (quantile_thresholds guarantees it); NaN x bins to 0."""
-    xs = np.asarray(x, dtype=np.float32)
+def _threshold_flat_keys(thresholds: np.ndarray) -> np.ndarray:
+    """Per-feature-offset int64 keys of a threshold matrix (the serving
+    path calls bin_data_host per batch with FIXED model thresholds —
+    callers cache this)."""
     thr = np.asarray(thresholds, dtype=np.float32)
     # canonicalize NaN thresholds to the positive-NaN bit pattern: a NaN
     # with the sign bit set would key BELOW all finite values via the ~b
@@ -822,13 +819,30 @@ def bin_data_host(x: np.ndarray, thresholds: np.ndarray) -> np.ndarray:
     # x > NaN is always False). Unreachable via quantile_thresholds but
     # this function is public API for other callers.
     thr = np.where(np.isnan(thr), np.float32(np.nan), thr)
+    seg = np.arange(thr.shape[0], dtype=np.int64) << 32
+    return (_f32_order_keys(thr).astype(np.int64) + seg[:, None]).ravel()
+
+
+def bin_data_host(
+    x: np.ndarray, thresholds: np.ndarray,
+    flat_keys: np.ndarray | None = None,
+) -> np.ndarray:
+    """Host bin_data: ONE searchsorted over per-feature-offset integer keys
+    — O(N·F·log(F·B)) with no Python per-feature loop, vs the device scan's
+    O(N·F·B). Exact (integer key space, see _f32_order_keys): ties at a
+    threshold bin identically to the device path. Requires per-row sorted
+    thresholds (quantile_thresholds guarantees it); NaN x bins to 0.
+    ``flat_keys`` (from _threshold_flat_keys) skips re-keying fixed model
+    thresholds on every serving batch."""
+    xs = np.asarray(x, dtype=np.float32)
     n, num_f = xs.shape
-    bm1 = thr.shape[1]
+    bm1 = np.asarray(thresholds).shape[1]
     xk = _f32_order_keys(xs).astype(np.int64)
     xk[np.isnan(xs)] = 0  # device: NaN > thr is False -> bin 0
     seg = np.arange(num_f, dtype=np.int64) << 32
-    flat = (_f32_order_keys(thr).astype(np.int64) + seg[:, None]).ravel()
-    idx = np.searchsorted(flat, (xk + seg[None, :]).ravel(), side="left")
+    if flat_keys is None:
+        flat_keys = _threshold_flat_keys(thresholds)
+    idx = np.searchsorted(flat_keys, (xk + seg[None, :]).ravel(), side="left")
     return (
         idx.reshape(n, num_f) - np.arange(num_f, dtype=np.int64) * bm1
     ).astype(np.int32)
@@ -852,22 +866,28 @@ def _traverse_host(binned: np.ndarray, sf, sb, lv) -> np.ndarray:
 def predict_boosted_host(
     x: np.ndarray, thresholds: np.ndarray, trees: Tree,
     eta: float, base_score: float,
+    binned: np.ndarray | None = None,
 ) -> np.ndarray:
-    """Numpy twin of predict_boosted_raw; ``trees`` must hold host arrays."""
+    """Numpy twin of predict_boosted_raw; ``trees`` must hold host arrays.
+    ``binned`` lets multi-stack callers bin x once across stacks."""
+    if binned is None:
+        binned = bin_data_host(x, thresholds)
     leaf = _traverse_host(
-        bin_data_host(x, thresholds),
-        trees.split_feat, trees.split_bin, trees.leaf_value,
+        binned, trees.split_feat, trees.split_bin, trees.leaf_value,
     )
     return np.float32(base_score) + np.float32(eta) * leaf.sum(axis=0)
 
 
 def predict_forest_host(
-    x: np.ndarray, thresholds: np.ndarray, trees: Tree
+    x: np.ndarray, thresholds: np.ndarray, trees: Tree,
+    binned: np.ndarray | None = None,
 ) -> np.ndarray:
-    """Numpy twin of predict_forest_raw; ``trees`` must hold host arrays."""
+    """Numpy twin of predict_forest_raw; ``trees`` must hold host arrays.
+    ``binned`` lets multi-stack callers bin x once across stacks."""
+    if binned is None:
+        binned = bin_data_host(x, thresholds)
     leaf = _traverse_host(
-        bin_data_host(x, thresholds),
-        trees.split_feat, trees.split_bin, trees.leaf_value,
+        binned, trees.split_feat, trees.split_bin, trees.leaf_value,
     )
     return leaf.mean(axis=0)
 
