@@ -704,7 +704,7 @@ def main() -> None:
                 # round-trip throughput varies hour-to-hour — measured
                 # quiet-chip best 9.3 s, congested episodes up to ~70 s
                 # with identical cache state (BASELINE.md round 3)
-                "variance_note": "tunnel-shared chip, run-to-run +-60%; round-5 warm median ~5.2s (samples 4.99/5.09/5.17/5.49/5.76) vs the 6.51s 1-vCPU sklearn anchor; congestion episodes 13-40s with identical cache state",
+                "variance_note": "tunnel-shared chip; round-5 warm samples across the day: quiet windows 4.99-6.69s (median ~5.2s in the best window, ~6.5-7s in busier ones) vs the 6.51s 1-vCPU sklearn anchor; congestion episodes 12-42s with identical cache state; first run after a source edit re-banks AOT blobs (+5-30s)",
             }
         )
     )

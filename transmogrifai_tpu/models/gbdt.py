@@ -78,6 +78,9 @@ def _feature_bin_groups(x: np.ndarray):
     return jnp.asarray(narrow), jnp.asarray(wide)
 
 
+_bin_data_jit = jax.jit(TR.bin_data)
+
+
 @jax.jit
 def _stack_lane(trees, lane):
     """One lane of a stacked-trees pytree, sliced ON DEVICE (lane is a
@@ -508,9 +511,16 @@ class _TreeEstimator(PredictorEstimator):
         if hit is not None:
             return hit[1], hit[2], hit[3]
         thresholds = TR.quantile_thresholds(x, self.max_bins)
-        binned = TR.bin_data(
-            jnp.asarray(np.asarray(x, dtype=np.float32)),
-            jnp.asarray(thresholds),
+        # through the AOT executable bank: a plain bin_data call pays a
+        # per-process remote compile-cache load (~0.3-0.8 s on the axon
+        # backend) exactly once, on the sweep's critical path
+        from ..utils.aot import aot_call
+
+        binned = aot_call(
+            "bin_data", _bin_data_jit,
+            (jnp.asarray(np.asarray(x, dtype=np.float32)),
+             jnp.asarray(thresholds)),
+            {},
         )
         fgroups = _feature_bin_groups(x)
         with _BINNED_LOCK:

@@ -101,9 +101,21 @@ class Validator:
         errors: list[str] = []
         self.last_extra_models: dict[str, tuple[list, list]] = {}
 
-        def run(est, grid):
+        # grids expand ONCE, defensively: a malformed grid must stay a
+        # per-candidate failure (caught at f.result() below), never abort
+        # the sweep from the submission loop or the ordering sort
+        points_list: list = []
+        for _, grid in candidates:
+            try:
+                points_list.append(expand_grid(grid))
+            except Exception as e:
+                points_list.append(e)
+
+        def run(est, points):
+            if isinstance(points, Exception):
+                raise points
             return self._sweep_family(
-                est, expand_grid(grid), folds, x, y, evaluator,
+                est, points, folds, x, y, evaluator,
                 extra_masks=extra_masks,
             )
 
@@ -121,12 +133,27 @@ class Validator:
             n_workers = 1
         else:
             n_workers = max(1, min(self.parallelism, len(candidates)))
+        # longest grid first: the biggest family's dispatch chain heads the
+        # single-device queue, so its uploads don't wait behind a shorter
+        # family's executing program (the RF sweep's first dispatch was
+        # measured blocking ~3.4 s behind the XGB chunk when submitted
+        # later)
+        order = sorted(
+            range(len(candidates)),
+            key=lambda i: -(
+                len(points_list[i]) if isinstance(points_list[i], list)
+                else 0
+            ),
+        )
         with ThreadPoolExecutor(max_workers=n_workers) as pool:
-            futs = [pool.submit(run, est, grid) for est, grid in candidates]
+            futs_by_cand = {}
+            for i in order:
+                est, _ = candidates[i]
+                futs_by_cand[i] = pool.submit(run, est, points_list[i])
             outs = []
-            for f in futs:
+            for i in range(len(candidates)):
                 try:
-                    outs.append(f.result())
+                    outs.append(futs_by_cand[i].result())
                 except Exception as e:
                     outs.append(e)
         for (est, _), out in zip(candidates, outs):
