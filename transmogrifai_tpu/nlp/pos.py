@@ -209,8 +209,11 @@ _LANGS: dict[str, dict] = {
         open=_lex({
             "stor store stort ny nye nyt god gode godt gammel gamle "
             "lille lang lange kort korte ung unge smuk smukke varm varme "
-            "kold kolde koldt interessant": "JJ",
+            "kold kolde koldt interessant vigtig vigtige": "JJ",
             "gerne": "RB",
+            "bor komme hjælpe spise gå se høre tale købe bo": "VB",
+            "lå sad gik kom fik tog så skrev": "VBD",
+            "bøger fugle børn huse biler": "NN",
         }),
     ),
     "de": dict(
@@ -227,8 +230,8 @@ _LANGS: dict[str, dict] = {
             "mein meine meinen meinem dein deine seine seinen seinem ihre "
             "ihren ihrem unser unsere unseren euer": "PRP$",
             "und oder aber sondern denn": "CC",
-            "kann konnte können muss musste müssen soll sollte will "
-            "wollte wollen darf mag möchte würde wird werden": "MD",
+            "kann kannst konnte können muss musste müssen soll sollte "
+            "will wollte wollen darf mag möchte würde wird werden": "MD",
             "ist sind war waren hat habe haben hatte hatten bin bist "
             "sein gewesen worden wurde wurden": "VB",
             "nicht nie auch sehr jetzt hier dort immer oft schon wieder "
@@ -252,10 +255,12 @@ _LANGS: dict[str, dict] = {
             "läuft geht kommt sieht spielt kauft liest schreibt wohnt "
             "arbeitet arbeiten lernt sagt macht gibt steht fährt": "VB",
             "ging kam sah aß schrieb las fuhr sprach stand lief traf "
-            "nahm gab fand blieb": "VBD",
+            "nahm gab fand blieb lagen sahen gingen kamen standen "
+            "nahmen": "VBD",
             "klein kleine kleinen groß große großen gut gute guten alt "
             "alte alten neu neue neues neuen jung schön schöne warm kalt "
-            "rot blau grün lang kurz hoch interessant interessante": "JJ",
+            "rot blau grün lang lange langen kurz hoch interessant "
+            "interessante wichtig wichtige": "JJ",
         }),
     ),
     "es": dict(
@@ -283,7 +288,8 @@ _LANGS: dict[str, dict] = {
             "habla come vive trabaja estudia escribe lee corre juega "
             "canta hablan comen viven trabajan estudian escriben leen "
             "corren juegan cantan compra compran vende venden abre "
-            "abren": "VB2",  # frequent present-tense verbs (suffix-opaque)
+            "abren leemos vivimos hablamos comemos trabajamos "
+            "estudiamos": "VB2",  # frequent present-tense verbs (suffix-opaque)
         }),
         suffixes=[
             ("ciones", "NNS"), ("siones", "NNS"), ("dades", "NNS"),
@@ -302,10 +308,11 @@ _LANGS: dict[str, dict] = {
         ],
         plural=("s",),
         open=_lex({
-            "pequeño pequeña grande bueno buena nuevo nueva viejo vieja "
-            "joven bonito bonita blanco blanca rojo roja verde azul largo "
-            "corto alto alta frío fría caliente importante interesante "
-            "feliz": "JJ",
+            "pequeño pequeña pequeños pequeñas grande grandes bueno "
+            "buena buenos buenas nuevo nueva nuevos nuevas viejo vieja "
+            "joven bonito bonita bonitos bonitas blanco blanca rojo roja "
+            "verde azul largo corto alto alta frío fría caliente "
+            "importante importantes interesante interesantes feliz": "JJ",
         }),
     ),
     "nl": dict(
@@ -343,11 +350,12 @@ _LANGS: dict[str, dict] = {
             "loopt komt ziet speelt koopt leest schrijft woont werkt "
             "leert zegt maakt geeft staat eet rijdt": "VB",
             "kocht ging kwam zag at schreef las reed sprak stond liep "
-            "nam gaf vond bleef": "VBD",
+            "nam gaf vond bleef lagen zagen gingen kwamen stonden": "VBD",
             "klein kleine groot grote goed goede oud oude nieuw nieuwe "
             "jong jonge mooi mooie warm koud koude rood blauw groen lang "
             "kort hoog belangrijk belangrijke interessant "
             "interessante": "JJ",
+            "boeken vogels kinderen huizen": "NN",
         }),
     ),
     "pt": dict(
@@ -376,7 +384,8 @@ _LANGS: dict[str, dict] = {
             "fala come mora trabalha estuda escreve lê corre gosta joga "
             "canta falam comem moram trabalham estudam escrevem correm "
             "gostam jogam cantam compra compram vende vendem abre "
-            "abrem": "VB2",
+            "abrem lemos moramos falamos comemos trabalhamos "
+            "estudamos": "VB2",
         }),
         suffixes=[
             ("ções", "NNS"), ("sões", "NNS"), ("dades", "NNS"),
@@ -397,10 +406,11 @@ _LANGS: dict[str, dict] = {
         plural=("s",),
         open=_lex({
             "leu deu viu fez disse veio": "VBD",
-            "pequeno pequena grande bom boa novo nova velho velha jovem "
-            "bonito bonita branco branca vermelho verde azul longo curto "
-            "alto alta frio fria quente importante interessante "
-            "feliz": "JJ",
+            "pequeno pequena pequenos pequenas grande grandes bom boa "
+            "bons boas novo nova novos novas velho velha jovem bonito "
+            "bonita bonitos bonitas branco branca vermelho verde azul "
+            "longo curto alto alta frio fria quente importante "
+            "importantes interessante interessantes feliz": "JJ",
         }),
     ),
     "sv": dict(
@@ -433,10 +443,14 @@ _LANGS: dict[str, dict] = {
             ("ar", "VB"), ("er", "VB"),
         ],
         open=_lex({
-            "åt gick kom såg skrev for stod sprang tog gav fann blev": "VBD",
+            "åt gick kom såg skrev for stod sprang tog gav fann blev "
+            "låg satt fick": "VBD",
             "snäll snälla stor stora stort ny nya nytt god goda gammal "
-            "gamla liten små lång kort hög ung vacker varm kall kallt "
-            "röd blå grön vit svart intressant": "JJ",
+            "gamla liten litet små lång långa kort hög ung vacker vackra "
+            "varm kall kallt röd blå grön vit svart intressant "
+            "viktig viktiga": "JJ",
+            "bor komma hjälpa se höra tala köpa åka bo": "VB",
+            "fåglar böcker hundar bilar barn": "NN",
         }),
     ),
 }
