@@ -133,12 +133,20 @@ def bench_titanic() -> dict:
     t2 = time.perf_counter()
     f.batch(rows)
     batch_s = time.perf_counter() - t2
+    # columnar batch (fn.columns): dataset in, columns out — the direct
+    # analog of sklearn pipeline.predict(dataframe), which also takes
+    # columnar input and returns arrays (no per-value row-dict codec)
+    f.columns(ds)
+    t2 = time.perf_counter()
+    f.columns(ds)
+    cols_s = time.perf_counter() - t2
     chk = checked.origin_stage.metadata.get("sanityCheckerSummary", {})
     return {
         "train_s": train_s,
         "score_s": score_s,
         "serve_row_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
         "serve_batch_rows_per_sec": round(len(rows) / batch_s),
+        "serve_columns_rows_per_sec": round(len(rows) / cols_s),
         # reference-default dispatch width: 512-dim text hashing etc.
         # (Transmogrifier.scala:56 DefaultNumOfFeatures)
         "flagship_width_raw": chk.get("numColumns"),
@@ -691,6 +699,15 @@ def main() -> None:
                 "serve_batch_vs_sklearn": (
                     round(
                         titanic["serve_batch_rows_per_sec"]
+                        / serve_base["batch_rows_per_sec"], 3,
+                    ) if serve_base else None
+                ),
+                "serve_columns_rows_per_sec": titanic[
+                    "serve_columns_rows_per_sec"
+                ],
+                "serve_columns_vs_sklearn": (
+                    round(
+                        titanic["serve_columns_rows_per_sec"]
                         / serve_base["batch_rows_per_sec"], 3,
                     ) if serve_base else None
                 ),

@@ -374,4 +374,44 @@ void tp_parse_doubles(const char* buf, const int64_t* offsets, int64_t n,
   }
 }
 
+// Serving-size tree predict: route every row through R stacked dense
+// perfect-binary trees (models/trees.py Tree layout: split_feat/split_bin
+// [r, depth, width] int32 with feat < 0 = leaf/route-left, leaf_value
+// [r, leaf_width] float32) over pre-binned codes [n, num_f] int32, and
+// reduce per row: out[i] = sum over trees of the leaf value. The numpy
+// traversal does 3 full-array gathers per level; the flagship winner is a
+// 200-tree depth-10 stack where this scalar walk measures ~4x cheaper.
+void tp_tree_predict_sum(const int32_t* binned, int64_t n, int64_t num_f,
+                         const int32_t* sf, const int32_t* sb,
+                         const float* lv, int64_t r, int64_t depth,
+                         int64_t width, int64_t leaf_width, float* out) {
+  for (int64_t i = 0; i < n; i++) out[i] = 0.0f;
+  for (int64_t t = 0; t < r; t++) {
+    const int32_t* sft = sf + t * depth * width;
+    const int32_t* sbt = sb + t * depth * width;
+    const float* lvt = lv + t * leaf_width;
+    // skip trailing all-leaf levels: a split-free level maps node->2*node
+    // unconditionally, folded into one shift at the end
+    int64_t eff = 0;
+    for (int64_t d = 0; d < depth; d++) {
+      const int32_t* lvl = sft + d * width;
+      int64_t w = ((int64_t)1) << d;
+      if (w > width) w = width;
+      for (int64_t k = 0; k < w; k++) {
+        if (lvl[k] >= 0) { eff = d + 1; break; }
+      }
+    }
+    for (int64_t i = 0; i < n; i++) {
+      const int32_t* row = binned + i * num_f;
+      int64_t node = 0;
+      for (int64_t d = 0; d < eff; d++) {
+        int32_t f = sft[d * width + node];
+        int go = (f >= 0) && (row[f] > sbt[d * width + node]);
+        node = node * 2 + go;
+      }
+      out[i] += lvt[node << (depth - eff)];
+    }
+  }
+}
+
 }  // extern "C"

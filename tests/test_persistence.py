@@ -114,6 +114,44 @@ def test_local_score_function_batch(trained):
     assert all(pred.name in o for o in outs)
 
 
+def test_local_score_function_columns(trained):
+    """Columnar scoring (fn.columns) matches the row-dict batch path and
+    tolerates a dataset with the response column absent."""
+    ds, pred, model = trained
+    fn = score_function(model)
+    out = fn.columns(ds)
+    assert pred.name in out
+    rows = ds.rows()
+    dict_outs = fn.batch(rows)
+    col_rendered = out[pred.name].to_list()
+    assert len(col_rendered) == len(rows)
+    for i in (0, 1, len(rows) - 1):
+        assert col_rendered[i]["probability_1"] == pytest.approx(
+            dict_outs[i][pred.name]["probability_1"], abs=1e-9
+        )
+    # response column absent -> scored with null labels
+    out2 = fn.columns(ds.drop(["Survived"]))
+    assert np.allclose(
+        np.asarray(out[pred.name].prediction),
+        np.asarray(out2[pred.name].prediction),
+    )
+    # absent predictor column -> all-null, same tolerance as the row path
+    some_pred = next(
+        f.name for f in model.raw_features
+        if not f.is_response and f.name in ds
+    )
+    out3 = fn.columns(ds.drop([some_pred]))
+    rows_missing = [
+        {k: v for k, v in r.items() if k != some_pred} for r in rows
+    ]
+    dict3 = fn.batch(rows_missing)
+    col3 = out3[pred.name].to_list()
+    for i in (0, len(rows) - 1):
+        assert col3[i]["probability_1"] == pytest.approx(
+            dict3[i][pred.name]["probability_1"], abs=1e-9
+        )
+
+
 def test_local_score_missing_label(trained):
     ds, pred, model = trained
     fn = score_function(model)

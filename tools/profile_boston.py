@@ -1,0 +1,101 @@
+"""Phase breakdown of the Boston regression bench (BASELINE config-3 red:
+~2.5 s vs the 1.43 s 1-vCPU sklearn anchor).
+
+Run: python tools/profile_boston.py  (chip; uses the bench compile cache)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (enables the compile cache)
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import threading
+
+    from transmogrifai_tpu.utils import aot
+
+    warm = threading.Thread(target=aot.prewarm, daemon=True)
+    warm.start()
+
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.readers.csv import infer_csv_dataset
+    from transmogrifai_tpu.selector import RegressionModelSelector
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    data = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
+            "housingData.csv")
+    headers = ["rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age",
+               "dis", "rad", "tax", "ptratio", "b", "lstat", "medv"]
+    for rep in range(3):
+        t0 = time.perf_counter()
+        ds = infer_csv_dataset(data, headers=headers, has_header=False)
+        medv, predictors = from_dataset(ds, response="medv")
+        predictors = [p for p in predictors if p.name != "rowId"]
+        vector = transmogrify(predictors)
+        t1 = time.perf_counter()
+        pred = (
+            RegressionModelSelector(seed=42).set_input(medv, vector)
+            .get_output()
+        )
+        model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        t2 = time.perf_counter()
+        print(f"rep{rep}: setup {t1-t0:5.2f}s  train {t2-t1:5.2f}s  "
+              f"total {t2-t0:5.2f}s", flush=True)
+
+    # per-family breakdown on the prepared matrix
+    from transmogrifai_tpu.evaluators import RegressionEvaluator
+    from transmogrifai_tpu.models import (
+        GBTRegressor,
+        LinearRegression,
+        RandomForestRegressor,
+    )
+    from transmogrifai_tpu.selector.model_selector import (
+        _gbt_grid,
+        _lr_grid,
+        _rf_grid,
+    )
+    from transmogrifai_tpu.selector.validators import (
+        CrossValidator,
+        expand_grid,
+    )
+    from transmogrifai_tpu.workflow.fit import fit_and_transform_dag
+
+    dsd, _ = fit_and_transform_dag(ds, [vector, medv])
+    x = np.asarray(dsd[vector.name].values, dtype=np.float32)
+    y = np.asarray(dsd[medv.name].values, dtype=np.float64)
+    print(f"matrix: {x.shape}")
+
+    cv = CrossValidator(num_folds=3, seed=42)
+    folds = cv.split_masks(y)
+    evaluator = RegressionEvaluator()
+    all_masks = [tm.astype(np.float32) for tm, _ in folds] + [
+        np.ones(len(y), dtype=np.float32)
+    ]
+    fams = {
+        "rf": (RandomForestRegressor(), expand_grid(_rf_grid())),
+        "lin": (LinearRegression(), expand_grid(_lr_grid())),
+        "gbt": (GBTRegressor(), expand_grid(_gbt_grid())),
+    }
+    for name, (est, points) in fams.items():
+        for rep in range(2):
+            t0 = time.perf_counter()
+            models = est.fit_arrays_batched_masks(x, y, all_masks, points)
+            t1 = time.perf_counter()
+            se = getattr(est, "sweep_eval_batched", None)
+            if se:
+                se(models[: len(folds)], x, y, folds, evaluator)
+            t2 = time.perf_counter()
+            print(f"{name} rep{rep}: fit {t1-t0:6.2f}s  eval {t2-t1:6.2f}s "
+                  f"({len(points)} pts, sweep={'y' if se else 'n'})",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

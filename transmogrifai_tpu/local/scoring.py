@@ -96,8 +96,47 @@ def score_function(
                 out[i][name] = rendered[i]
         return out
 
+    def score_columns(dataset) -> dict[str, Any]:
+        """Columnar scoring: Dataset in, ``{result_name: Column}`` out.
+
+        The counterpart of sklearn's ``pipeline.predict(dataframe)`` — the
+        input is already columnar, so the per-value row-dict codec
+        (``column_from_values`` per raw feature, ``to_list`` per result) is
+        skipped entirely. Rows are padded to the same power-of-two buckets
+        by replicating row 0; outputs are sliced back with ``take``."""
+        import numpy as np
+
+        n = len(dataset)
+        if n == 0:
+            return {}
+        b = _bucket(n)
+        cols: dict[str, Any] = {}
+        pad = None
+        if b > n:
+            pad = np.concatenate(
+                [np.arange(n), np.zeros(b - n, dtype=np.int64)]
+            )
+        for f in raw_features:
+            if f.name not in dataset:
+                # same tolerance as the row path (r.get): absent response
+                # scores with null labels, absent predictors as all-null
+                fill = 0 if f.is_response else None
+                cols[f.name] = column_from_values(f.ftype, [fill] * b)
+                continue
+            c = dataset[f.name]
+            cols[f.name] = c if pad is None else c.take(pad)
+        for t in plan:
+            ins = [cols[name] for name in t.input_names]
+            cols[t.output_name] = t.transform_columns(*ins, num_rows=b)
+        keep = np.arange(n)
+        return {
+            name: (cols[name] if b == n else cols[name].take(keep))
+            for name in result_names
+        }
+
     def score_one(row: dict[str, Any]) -> dict[str, Any]:
         return score_batch([row])[0]
 
     score_one.batch = score_batch  # type: ignore[attr-defined]
+    score_one.columns = score_columns  # type: ignore[attr-defined]
     return score_one

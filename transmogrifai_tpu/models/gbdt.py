@@ -176,6 +176,7 @@ class _BinnedModel(PredictorModel):
         self.thresholds = np.asarray(thresholds, dtype=np.float32)
         self._dev_cache = None
         self._host_cache = None
+        self._serve_plan = None
 
     def _use_host(self, x) -> bool:
         """Serving-size batches predict in numpy on the host: a jax result
@@ -208,28 +209,37 @@ class _BinnedModel(PredictorModel):
         list. The ONLY host-vs-device dispatch point for scoring."""
         many = isinstance(trees, list)
         if self._use_host(x):
-            hs = self._host(trees)
-            hs = hs if many else [hs]
-            # thresholds are fixed for a fitted model: key them once (the
-            # per-batch re-key was ~1/4 of serving-batch predict time),
-            # and bin x ONCE for all class stacks
-            fk = getattr(self, "_flat_keys", None)
-            if fk is None:
-                fk = TR._threshold_flat_keys(self.thresholds)
-                self._flat_keys = fk
-            binned = TR.bin_data_host(x, self.thresholds, flat_keys=fk)
+            # Fixed for a fitted model, built once: the used-feature subset
+            # (trees touch tens of the flagship's 928 columns), its
+            # threshold keys, and feature-remapped stacks — then each batch
+            # bins ONLY those columns, once across all class stacks.
+            plan = getattr(self, "_serve_plan", None)
+            if plan is None:
+                hs0 = self._host(trees)
+                plan = TR.host_serving_plan(
+                    self.thresholds, hs0 if many else [hs0]
+                )
+                self._serve_plan = plan
+                # the full-width host stacks are only needed to build the
+                # plan — keeping them would double host serving memory
+                self._host_cache = None
+            used, thr_used, fk, hs = plan
+            # xu/thr_used stay consistent with the REMAPPED stacks: if a
+            # future path ever let ``binned`` default inside predict_*_host,
+            # it would still bin in the compact feature space
+            xu = np.asarray(x, dtype=np.float32)[:, used]
+            binned = TR.bin_data_host(xu, thr_used, flat_keys=fk)
             if boosted:
                 outs = [
                     TR.predict_boosted_host(
-                        x, self.thresholds, t, self.eta, self.base_score,
+                        xu, thr_used, t, self.eta, self.base_score,
                         binned=binned,
                     )
                     for t in hs
                 ]
             else:
                 outs = [
-                    TR.predict_forest_host(x, self.thresholds, t,
-                                           binned=binned)
+                    TR.predict_forest_host(xu, thr_used, t, binned=binned)
                     for t in hs
                 ]
         else:
@@ -265,6 +275,7 @@ class _BinnedModel(PredictorModel):
         # stack — clearing them is part of the contract
         self._dev_cache = None
         self._host_cache = None
+        self._serve_plan = None
         for attr in ("trees", "trees_per_class", "forests_per_class"):
             t = getattr(self, attr, None)
             if isinstance(t, _LazySlice):

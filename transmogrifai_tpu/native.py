@@ -121,6 +121,17 @@ def _load() -> ctypes.CDLL | None:
                 np.ctypeslib.ndpointer(np.float32, flags="C"),
                 ctypes.c_int64, ctypes.c_int64,
             ]
+        if hasattr(lib, "tp_tree_predict_sum"):
+            lib.tp_tree_predict_sum.argtypes = [
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                ctypes.c_int64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.float32, flags="C"),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.float32, flags="C"),
+            ]
         _LIB = lib
         return _LIB
 
@@ -335,6 +346,29 @@ def _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset):
         out[rows, j] = 1.0
     else:
         np.add.at(out, (rows, j), 1.0)
+
+
+def tree_predict_sum(
+    binned: np.ndarray, sf: np.ndarray, sb: np.ndarray, lv: np.ndarray,
+) -> np.ndarray | None:
+    """Per-row sum of leaf values across R stacked trees (serving predict
+    hot loop — see trees._traverse_host for the layout and semantics).
+    Returns float32 [n], or None when the library is unavailable (caller
+    falls back to the numpy traversal)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tp_tree_predict_sum"):
+        return None
+    binned = np.ascontiguousarray(binned, dtype=np.int32)
+    sf = np.ascontiguousarray(sf, dtype=np.int32)
+    sb = np.ascontiguousarray(sb, dtype=np.int32)
+    lv = np.ascontiguousarray(lv, dtype=np.float32)
+    n, num_f = binned.shape
+    r, depth, width = sf.shape
+    out = np.empty(n, dtype=np.float32)
+    lib.tp_tree_predict_sum(
+        binned, n, num_f, sf, sb, lv, r, depth, width, lv.shape[1], out,
+    )
+    return out
 
 
 def parse_doubles(values: list) -> tuple[np.ndarray, np.ndarray]:
