@@ -97,16 +97,31 @@ def bench_titanic() -> dict:
     from transmogrifai_tpu.selector import BinaryClassificationModelSelector
     from transmogrifai_tpu.workflow.workflow import Workflow
 
-    t0 = time.perf_counter()
-    ds = infer_csv_dataset(TITANIC)
-    resp, preds = from_dataset(ds, response="Survived")
-    preds = [p for p in preds if p.name != "PassengerId"]
-    vector = transmogrify(preds)
-    checked = resp.transform_with(SanityChecker(remove_bad_features=True), vector)
-    selector = BinaryClassificationModelSelector(seed=42)
-    pred = selector.set_input(resp, checked).get_output()
-    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
-    train_s = time.perf_counter() - t0
+    # median of 3 full end-to-end repetitions (CSV parse -> features ->
+    # transmogrify -> checker -> selector -> holdout). A single draw from
+    # the tunnel-shared chip's wall-clock distribution varies +-60% with
+    # identical cache state (BASELINE.md); the median over three
+    # back-to-back runs is the honest point estimate. Nothing is excluded:
+    # rep 0 pays any per-process program acquisition the prewarm thread
+    # has not finished hiding.
+    samples = []
+    model = None
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        ds = infer_csv_dataset(TITANIC)
+        resp, preds = from_dataset(ds, response="Survived")
+        preds = [p for p in preds if p.name != "PassengerId"]
+        vector = transmogrify(preds)
+        checked = resp.transform_with(
+            SanityChecker(remove_bad_features=True), vector
+        )
+        selector = BinaryClassificationModelSelector(seed=42)
+        pred = selector.set_input(resp, checked).get_output()
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        )
+        samples.append(time.perf_counter() - t0)
+    train_s = sorted(samples)[len(samples) // 2]
 
     sel = model.summary_json()["modelSelectorSummary"]
     t1 = time.perf_counter()
@@ -143,6 +158,7 @@ def bench_titanic() -> dict:
     chk = checked.origin_stage.metadata.get("sanityCheckerSummary", {})
     return {
         "train_s": train_s,
+        "train_samples_s": [round(s, 3) for s in samples],
         "score_s": score_s,
         "serve_row_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
         "serve_batch_rows_per_sec": round(len(rows) / batch_s),
@@ -172,21 +188,28 @@ def bench_iris() -> dict:
     data = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data"
     headers = ["sepalLength", "sepalWidth", "petalLength", "petalWidth",
                "irisClass"]
-    t0 = time.perf_counter()
-    ds = infer_csv_dataset(data, headers=headers, has_header=False)
-    label_text, predictors = from_dataset(
-        ds, response="irisClass", response_type=T.PickList
-    )
-    label = label_text.string_indexed()
-    vector = transmogrify(predictors)
-    pred = (
-        MultiClassificationModelSelector(seed=42)
-        .set_input(label, vector).get_output()
-    )
-    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
-    train_s = time.perf_counter() - t0
+    samples = []
+    model = None
+    for _rep in range(3):  # median of 3, same policy as the flagship row
+        t0 = time.perf_counter()
+        ds = infer_csv_dataset(data, headers=headers, has_header=False)
+        label_text, predictors = from_dataset(
+            ds, response="irisClass", response_type=T.PickList
+        )
+        label = label_text.string_indexed()
+        vector = transmogrify(predictors)
+        pred = (
+            MultiClassificationModelSelector(seed=42)
+            .set_input(label, vector).get_output()
+        )
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        )
+        samples.append(time.perf_counter() - t0)
+    train_s = sorted(samples)[len(samples) // 2]
     holdout = model.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]
     return {"train_s": train_s,
+            "train_samples_s": [round(s, 3) for s in samples],
             "holdout_accuracy": (
                 1.0 - holdout["Error"] if "Error" in holdout else None
             )}
@@ -205,16 +228,27 @@ def bench_boston() -> dict:
             "housingData.csv")
     headers = ["rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age",
                "dis", "rad", "tax", "ptratio", "b", "lstat", "medv"]
-    t0 = time.perf_counter()
-    ds = infer_csv_dataset(data, headers=headers, has_header=False)
-    medv, predictors = from_dataset(ds, response="medv")
-    predictors = [p for p in predictors if p.name != "rowId"]
-    vector = transmogrify(predictors)
-    pred = RegressionModelSelector(seed=42).set_input(medv, vector).get_output()
-    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
-    train_s = time.perf_counter() - t0
+    samples = []
+    model = None
+    for _rep in range(3):  # median of 3, same policy as the flagship row
+        t0 = time.perf_counter()
+        ds = infer_csv_dataset(data, headers=headers, has_header=False)
+        medv, predictors = from_dataset(ds, response="medv")
+        predictors = [p for p in predictors if p.name != "rowId"]
+        vector = transmogrify(predictors)
+        pred = (
+            RegressionModelSelector(seed=42).set_input(medv, vector)
+            .get_output()
+        )
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        )
+        samples.append(time.perf_counter() - t0)
+    train_s = sorted(samples)[len(samples) // 2]
     holdout = model.summary_json()["modelSelectorSummary"]["holdoutEvaluation"]
-    return {"train_s": train_s, "holdout_rmse": holdout.get("RMSE")}
+    return {"train_s": train_s,
+            "train_samples_s": [round(s, 3) for s in samples],
+            "holdout_rmse": holdout.get("RMSE")}
 
 
 def bench_embeddings() -> dict:
@@ -669,16 +703,19 @@ def main() -> None:
                 # per-core-honest estimate divides by 8
                 "vs_8core_cpu_est": round(vsb / 8.0, 3),
                 "baseline_s": REFERENCE_TITANIC_TRAIN_S,
+                "train_samples_s": titanic["train_samples_s"],
                 "holdout_aupr": round(titanic["holdout_aupr"], 4),
                 "holdout_auroc": round(titanic["holdout_auroc"], 4),
                 "candidates": titanic["n_candidates"],
                 "iris_train_s": round(iris["train_s"], 3),
+                "iris_train_samples_s": iris["train_samples_s"],
                 "iris_vs_baseline": (
                     round(iris_base["value"] / iris["train_s"], 3)
                     if iris_base else 0.0
                 ),
                 "iris_holdout_accuracy": iris.get("holdout_accuracy"),
                 "boston_train_s": round(boston["train_s"], 3),
+                "boston_train_samples_s": boston["train_samples_s"],
                 "boston_vs_baseline": (
                     round(boston_base["value"] / boston["train_s"], 3)
                     if boston_base else 0.0
@@ -721,7 +758,7 @@ def main() -> None:
                 # round-trip throughput varies hour-to-hour — measured
                 # quiet-chip best 9.3 s, congested episodes up to ~70 s
                 # with identical cache state (BASELINE.md round 3)
-                "variance_note": "tunnel-shared chip; round-5 warm samples across the day: quiet windows 4.99-6.69s (median ~5.2s in the best window, ~6.5-7s in busier ones) vs the 6.51s 1-vCPU sklearn anchor; congestion episodes 12-42s with identical cache state; first run after a source edit re-banks AOT blobs (+5-30s)",
+                "variance_note": "tunnel-shared chip; selector rows report the MEDIAN of 3 back-to-back end-to-end runs (all samples in *_train_samples_s; nothing excluded). Round-5 warm samples across the day: quiet windows 4.99-6.69s vs the 6.51s 1-vCPU sklearn anchor; congestion episodes 12-42s with identical cache state; first run after a source edit re-banks AOT blobs (+5-30s)",
             }
         )
     )

@@ -266,10 +266,21 @@ class _BinnedModel(PredictorModel):
             # numpy lane slices are VIEWS into the host stack — copy so the
             # base array can be collected; device slices are independent
             resolved = _resolve_trees(t)
-            return jax.tree.map(
-                lambda a: np.array(a) if isinstance(a, np.ndarray) else a,
-                resolved,
-            )
+
+            def _own_leaf(a):
+                if isinstance(a, np.ndarray):
+                    return np.array(a)
+                # device lane: start the host transfer NOW — the first
+                # consumer is the holdout predict's host serving plan, and
+                # the async copy overlaps the holdout DAG transform instead
+                # of blocking np.asarray on an 8 MB tunnel download
+                try:
+                    a.copy_to_host_async()
+                except Exception:
+                    pass
+                return a
+
+            return jax.tree.map(_own_leaf, resolved)
 
         # predict caches built pre-detach hold lane VIEWS into the sweep
         # stack — clearing them is part of the contract

@@ -67,3 +67,68 @@ class LinearRegression(PredictorEstimator):
         return LinearRegressionModel(
             np.asarray(params.weights), float(params.intercept)
         )
+
+    _KNOWN_KEYS = frozenset(
+        ("reg_param", "elastic_net_param", "fit_intercept", "max_iter")
+    )
+
+    def fit_arrays_batched_masks(self, x, y, masks, grid_points):
+        """Folds x grid in as few programs as the grid's static params
+        allow (validators._sweep_family hook; the sequential path paid a
+        tunnel dispatch per fold x point for microseconds of FLOPs).
+        Same-(fit_intercept, max_iter) groups batch (fold-mask, reg,
+        elastic-net) triples onto the fit axis of fit_linear_batched;
+        points with unknown params fall back to sequential fits."""
+        from ..utils.aot import aot_call
+        from .solvers import fit_linear_batched
+
+        masks = [np.asarray(m, dtype=np.float32) for m in masks]
+        n_masks = len(masks)
+        groups: dict[tuple, list[int]] = {}
+        sequential: list[int] = []
+        for i, p in enumerate(grid_points):
+            if set(p) - self._KNOWN_KEYS:
+                sequential.append(i)
+                continue
+            key = (
+                bool(p.get("fit_intercept", self.fit_intercept)),
+                int(p.get("max_iter", self.max_iter)),
+            )
+            groups.setdefault(key, []).append(i)
+        models: list[list] = [[None] * len(grid_points) for _ in masks]
+        import jax.numpy as jnp
+
+        for (fit_intercept, max_iter), idxs in groups.items():
+            pts = [grid_points[i] for i in idxs] * n_masks
+            regs = np.asarray(
+                [p.get("reg_param", self.reg_param) for p in pts],
+                dtype=np.float32,
+            )
+            ens = np.asarray(
+                [p.get("elastic_net_param", self.elastic_net_param)
+                 for p in pts],
+                dtype=np.float32,
+            )
+            rm = np.repeat(np.stack(masks), len(idxs), axis=0)  # mask-major
+            stacked = aot_call(
+                "linear_batched", fit_linear_batched,
+                (
+                    jnp.asarray(x, dtype=jnp.float32),
+                    jnp.asarray(y, dtype=jnp.float32),
+                    jnp.asarray(rm), jnp.asarray(regs), jnp.asarray(ens),
+                ),
+                dict(num_iters=max(max_iter * 4, 200),
+                     fit_intercept=fit_intercept),
+            )
+            w = np.asarray(stacked.weights)
+            b = np.asarray(stacked.intercept)
+            for mi in range(n_masks):
+                for j, i in enumerate(idxs):
+                    models[mi][i] = LinearRegressionModel(
+                        w[mi * len(idxs) + j], b[mi * len(idxs) + j]
+                    )
+        for i in sequential:
+            est = self.with_params(**grid_points[i])
+            for mi, m in enumerate(masks):
+                models[mi][i] = est.fit_arrays(x, y, m)
+        return models
