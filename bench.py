@@ -758,7 +758,7 @@ def main() -> None:
                 # round-trip throughput varies hour-to-hour — measured
                 # quiet-chip best 9.3 s, congested episodes up to ~70 s
                 # with identical cache state (BASELINE.md round 3)
-                "variance_note": "tunnel-shared chip; selector rows report the MEDIAN of 3 back-to-back end-to-end runs (all samples in *_train_samples_s; nothing excluded). Round-5 warm samples across the day: quiet windows 4.99-6.69s vs the 6.51s 1-vCPU sklearn anchor; congestion episodes 12-42s with identical cache state; first run after a source edit re-banks AOT blobs (+5-30s)",
+                "variance_note": "tunnel-shared chip; selector rows report the MEDIAN of 3 back-to-back in-process end-to-end runs, all samples disclosed in *_train_samples_s. Protocol asymmetry stated plainly: TPU reps 1-2 amortize per-process program-bank loads that rep 0 pays (sklearn has no analogous cost; its in-process median-of-3 anchor is 6.8s, the recorded 6.508s anchor is the CPU's fastest-ever single run - harder). FRESH-process single-shot TPU runs measure 4.99-6.69s in quiet windows (median >=1.0 vs the anchor, congestion episodes 12-42s); the in-process median is the steady-state number, the fresh-process range is what one cold training run pays",
             }
         )
     )

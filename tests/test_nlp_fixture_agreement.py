@@ -383,6 +383,84 @@ def test_analyzers_v2_golden():
             assert analyze(text, language=lang) == expect, (lang, text)
 
 
+# ---------------------------------------------------------------------------
+# round-5 tier 3 — the rest of the Lucene per-language set (bg ca eu fa gl
+# hi hy id ga lv; 32 codes total vs LuceneTextAnalyzer's ~35): stopword
+# removal + light stemming goldens, two fixtures per language
+# ---------------------------------------------------------------------------
+ANALYZER_GOLDEN_V3 = {
+    "bg": [("новите книги в библиотеката", ["нов", "книг", "библиотек"]),
+           ("студентите четат статиите", ["студент", "четат", "стати"])],
+    "ca": [("els nous llibres de les biblioteques",
+            ["nou", "llibr", "bibliote"]),
+           ("els estudiants llegeixen articles",
+            ["estudiant", "llegeixen", "articl"])],
+    "eu": [("liburu berriak liburutegietan",
+            ["liburu", "berri", "liburutegi"]),
+           ("ikasleek artikuluak irakurtzen dituzte",
+            ["ikasle", "artikulu", "irakurtz", "dituzte"])],
+    # Persian: ZWNJ-joined plural کتاب‌های splits and normalizes; no
+    # stemming (PersianAnalyzer behavior)
+    "fa": [("کتاب‌های جدید در کتابخانه", ["کتاب", "جدید", "کتابخانه"]),
+           ("دانشجویان مقاله می‌خوانند",
+            ["دانشجویان", "مقاله", "خوانند"])],
+    "gl": [("os novos libros das bibliotecas", ["nov", "libr", "bibliotec"]),
+           ("os estudantes len artigos interesantes",
+            ["estudant", "len", "artig", "interesant"])],
+    # Hindi: Devanagari words stay whole (matras are combining marks the
+    # standard tokenizer would split at); digit-led ordinals split at the
+    # script boundary, never mid-word
+    "hi": [("पुस्तकालयों में नयी किताबें", ["पुस्तकालय", "नय", "किताब"]),
+           ("5वीं कक्षा के छात्र लेख पढ़ते हैं",
+            ["5", "वीं", "कक्ष", "छात्र", "लेख", "पढ़"])],
+    "hy": [("նոր գրքերը գրադարաններում", ["նոր", "գրք", "գրադարան"]),
+           ("ուսանողները կարդում են հոդվածներ",
+            ["ուսանող", "կարդ", "հոդված"])],
+    # Indonesian: prefix+suffix strips (per-pustaka-an, mem-baca,
+    # artikel-nya)
+    "id": [("buku-buku baru di perpustakaan",
+            ["buku", "buku", "baru", "pustaka"]),
+           ("para mahasiswa membaca artikelnya",
+            ["para", "mahasiswa", "baca", "artikel"])],
+    # Irish: prothetic t- strips before tokenization ('an t-alt' → alt)
+    "ga": [("na leabhair nua sa leabharlann",
+            ["leabhair", "nua", "leabharlann"]),
+           ("léann na mic léinn ailt agus an t-alt",
+            ["léann", "mic", "léinn", "ailt", "alt"])],
+    "lv": [("jaunās grāmatas bibliotēkās",
+            ["jaunā", "grāmat", "bibliotēkā"]),
+           ("studenti lasa rakstus", ["student", "las", "rakst"])],
+}
+
+
+def test_analyzers_v3_golden():
+    from transmogrifai_tpu.utils.analyzers import ANALYZERS, analyze
+
+    assert len(ANALYZERS) >= 32
+    for lang, cases in ANALYZER_GOLDEN_V3.items():
+        for text, expect in cases:
+            assert analyze(text, language=lang) == expect, (lang, text)
+
+
+def test_tier3_morphological_unification():
+    """Variants of the same lemma must map to one stem — the property the
+    hashing vectorizer needs for cross-document token agreement."""
+    from transmogrifai_tpu.utils.analyzers import ANALYZERS
+
+    pairs = [
+        ("bg", "котка", "котките"), ("bg", "градът", "градове"),
+        ("ca", "gat", "gats"), ("eu", "katua", "katuarekin"),
+        ("gl", "gato", "gatos"), ("hy", "կատուն", "կատուների"),
+        ("id", "makanan", "makan"), ("id", "membaca", "baca"),
+        ("lv", "kaķis", "kaķiem"), ("hi", "बिल्ली", "बिल्लियों"),
+        # Persian normalization: Arabic kaf folds to Farsi keheh
+        ("fa", "كتاب", "کتاب"),
+    ]
+    for lang, a, b in pairs:
+        sa, sb = ANALYZERS[lang].stem(a), ANALYZERS[lang].stem(b)
+        assert sa == sb, (lang, a, sa, b, sb)
+
+
 def test_turkish_analyzer_casefold_and_apostrophe():
     from transmogrifai_tpu.utils.analyzers import analyze
 

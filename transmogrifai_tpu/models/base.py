@@ -86,3 +86,24 @@ class PredictorEstimator(Estimator):
                 raise AttributeError(f"{type(self).__name__} has no param {k}")
             setattr(c, k, v)
         return c
+
+
+def group_grid_by_statics(points, known_keys, statics_of):
+    """Group grid-point indices by their STATIC (shape-affecting) params so
+    dynamic params batch as lanes of one program; points carrying unknown
+    keys fall out to a sequential list. Shared by the logistic and linear
+    batched-masks sweeps (the grouping logic diverging between families was
+    exactly how the round-1 'statics compared against ctor defaults' bug
+    hid — see LogisticRegression._static_groups history).
+
+    ``statics_of(point) -> hashable key``; returns (groups, sequential)
+    where groups maps key -> [point indices].
+    """
+    groups: dict[Any, list[int]] = {}
+    sequential: list[int] = []
+    for i, p in enumerate(points):
+        if set(p) - known_keys:
+            sequential.append(i)
+            continue
+        groups.setdefault(statics_of(p), []).append(i)
+    return groups, sequential

@@ -80,21 +80,18 @@ class LinearRegression(PredictorEstimator):
         elastic-net) triples onto the fit axis of fit_linear_batched;
         points with unknown params fall back to sequential fits."""
         from ..utils.aot import aot_call
+        from .base import group_grid_by_statics
         from .solvers import fit_linear_batched
 
         masks = [np.asarray(m, dtype=np.float32) for m in masks]
         n_masks = len(masks)
-        groups: dict[tuple, list[int]] = {}
-        sequential: list[int] = []
-        for i, p in enumerate(grid_points):
-            if set(p) - self._KNOWN_KEYS:
-                sequential.append(i)
-                continue
-            key = (
+        groups, sequential = group_grid_by_statics(
+            grid_points, self._KNOWN_KEYS,
+            lambda p: (
                 bool(p.get("fit_intercept", self.fit_intercept)),
                 int(p.get("max_iter", self.max_iter)),
-            )
-            groups.setdefault(key, []).append(i)
+            ),
+        )
         models: list[list] = [[None] * len(grid_points) for _ in masks]
         import jax.numpy as jnp
 

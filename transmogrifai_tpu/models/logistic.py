@@ -154,24 +154,20 @@ class LogisticRegression(PredictorEstimator):
     def _static_groups(self, points) -> tuple[dict, list[int]]:
         """Group point indices by their STATIC params (fit_intercept,
         max_iter, standardization) — reg/elastic-net vary freely inside a
-        group and batch as GEMM lanes. Points carrying unknown keys fall
-        out to the sequential list. (Round 1 compared statics against the
-        estimator's ctor defaults, so the default grid's max_iter=50 vs
-        ctor 100 silently disabled batching — every default sweep ran 24
-        sequential fits.)"""
-        groups: dict[tuple, list[int]] = {}
-        sequential: list[int] = []
-        for i, p in enumerate(points):
-            if set(p) - self._KNOWN_KEYS:
-                sequential.append(i)
-                continue
-            key = (
+        group and batch as GEMM lanes. (Round 1 compared statics against
+        the estimator's ctor defaults, so the default grid's max_iter=50
+        vs ctor 100 silently disabled batching — every default sweep ran
+        24 sequential fits.)"""
+        from .base import group_grid_by_statics
+
+        return group_grid_by_statics(
+            points, self._KNOWN_KEYS,
+            lambda p: (
                 bool(p.get("fit_intercept", self.fit_intercept)),
                 int(p.get("max_iter", self.max_iter)),
                 bool(p.get("standardization", self.standardization)),
-            )
-            groups.setdefault(key, []).append(i)
-        return groups, sequential
+            ),
+        )
 
     def _grid_values(self, points) -> tuple[np.ndarray, np.ndarray]:
         regs = np.asarray(

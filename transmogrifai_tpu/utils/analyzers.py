@@ -757,6 +757,172 @@ def turkish_stem(w: str) -> str:
     return w
 
 
+# ---- tier 3 (round 5): the rest of the Lucene per-language analyzer set
+# (LuceneTextAnalyzer.scala wires ~35; langid already routes these codes).
+# Light approximations of the published Lucene stemmers, same approach as
+# the tier-2 set above: longest-match suffix strips with minimum-stem
+# guards.
+
+
+def bulgarian_stem(w: str) -> str:
+    """BulgarianStemmer (light, Nakov): definite article THEN plural —
+    sequential, so 'котките' (article те + plural и) meets 'котка'
+    (plural а) at the same stem."""
+    if len(w) < 4:
+        return w
+    for suf in ("ията", "ият", "ът", "ят", "та", "то", "те"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            w = w[: -len(suf)]
+            break
+    for suf in ("овци", "ища", "ове", "еве", "йки", "ия", "а", "я", "о",
+                "е", "и"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            w = w[: -len(suf)]
+            break
+    return w
+
+
+def catalan_stem(w: str) -> str:
+    """Catalan light stemmer (Snowball-Catalan approximation): plurals,
+    verbal/derivational endings."""
+    if len(w) < 4:
+        return w
+    for suf in ("aments", "ament", "adora", "adors", "ances", "atges",
+                "esses", "etes", "eres", "ança", "ques", "osos", "oses",
+                "ista", "able", "ible", "isme", "ció", "ats", "ades",
+                "ers", "era", "es", "os", "a", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
+def basque_stem(w: str) -> str:
+    """Basque light stemmer (Snowball-Basque approximation): case endings
+    (ergative/genitive/locative) and determiners."""
+    if len(w) < 4:
+        return w
+    for suf in ("arekin", "etako", "etara", "aren", "ekin", "etan", "eta",
+                "ari", "ak", "ek", "en", "an", "ra", "a", "k"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
+_FA_NORM = str.maketrans({
+    "ي": "ی", "ك": "ک", "ة": "ه", "آ": "ا", "أ": "ا", "إ": "ا",
+    "ۀ": "ه", "‌": " ",  # zero-width non-joiner -> space
+})
+
+
+def persian_normalize(w: str) -> str:
+    """PersianAnalyzer behavior: orthographic normalization, NO stemming
+    (Lucene ships PersianNormalizationFilter + stopwords only)."""
+    return w.translate(_FA_NORM).strip()
+
+
+def galician_stem(w: str) -> str:
+    """Galician light stemmer (RSLP-style plural/gender reduction)."""
+    if len(w) < 4:
+        return w
+    if w.endswith("ns") and len(w) > 4:
+        return w[:-2] + "n"
+    if (w.endswith("ais") or w.endswith("eis")) and len(w) > 5:
+        return w[:-2] + "l"
+    for suf in ("cións", "ción", "mente", "ista", "ismo", "es", "as", "os",
+                "a", "o", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
+def hindi_stem(w: str) -> str:
+    """HindiStemmer (light; Ramanathan & Rao) — the Lucene filter: strip
+    the longest of the published suffix list."""
+    if len(w) < 3:
+        return w
+    for suf in ("ियों", "ाओं", "ियां", "ताओं", "नाओं", "ियाँ", "ाएं",
+                "ुओं", "ुएं", "ुआं", "ों", "ें", "ीं", "ाँ", "ां", "ता",
+                "ते", "ना", "ती", "ी", "ू", "ु", "ा", "े", "ो", "ि"):
+        if w.endswith(suf) and len(w) - len(suf) >= 2:
+            return w[: -len(suf)]
+    return w
+
+
+def armenian_stem(w: str) -> str:
+    """Armenian light stemmer (Snowball-Armenian approximation): plural +
+    case endings."""
+    if len(w) < 4:
+        return w
+    for suf in ("ությունների", "ություններ", "ության", "ություն",
+                "ներում", "ներին", "ներով", "ները", "ների", "երին",
+                "երից", "երով", "երը", "ներ", "ում", "երի", "ով", "եր",
+                "ին", "ից", "ը", "ի", "ն"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
+def indonesian_stem(w: str) -> str:
+    """IndonesianStemmer (light; Asian et al.): particle/possessive
+    suffixes, derivational -kan/-an/-i, prefixes di-/ke-/se-/me*/be*/pe*/
+    te*."""
+    if len(w) < 4:
+        return w
+    for suf in ("kah", "lah", "pun", "nya", "ku", "mu"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            w = w[: -len(suf)]
+            break
+    for pre in ("meng", "meny", "men", "mem", "me", "peng", "peny", "pen",
+                "pem", "di", "ter", "ke", "se", "ber", "be", "per", "pe"):
+        if w.startswith(pre) and len(w) - len(pre) >= 3:
+            w = w[len(pre):]
+            break
+    for suf in ("kan", "an", "i"):
+        # >= 4 remaining: root words like 'makan' must not lose their
+        # final syllable (the full Asian-et-al stemmer checks derivation
+        # conditions; the length guard is the light equivalent)
+        if w.endswith(suf) and len(w) - len(suf) >= 4:
+            w = w[: -len(suf)]
+            break
+    return w
+
+
+def irish_lower(w: str) -> str:
+    """IrishLowerCaseFilter: strip prothetic n-/t- before a vowel-initial
+    word ('n-athair' → 'athair', 'tAthair' → 'athair') before folding."""
+    if len(w) > 2 and w[0] in "nt" and w[1] == "-":
+        w = w[2:]
+    elif len(w) > 1 and w[0] in "nt" and w[1] in "AEIOUÁÉÍÓÚ":
+        w = w[1:]
+    return w.lower()
+
+
+def irish_stem(w: str) -> str:
+    """Irish light stemmer (Snowball-Irish approximation): plural/case
+    endings after Irish-specific lowercasing."""
+    w = irish_lower(w)
+    if len(w) < 4:
+        return w
+    for suf in ("aíocht", "eanna", "eacha", "acha", "anna", "anta",
+                "íocht", "acht", "aí", "ta", "te", "e", "a"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
+def latvian_stem(w: str) -> str:
+    """LatvianStemmer (light): noun/adjective declension endings, longest
+    first."""
+    if len(w) < 4:
+        return w
+    for suf in ("ajiem", "ajām", "ajam", "ajai", "iem", "ajā", "ais",
+                "ai", "ei", "ij", "am", "ām", "ie", "as", "es", "os",
+                "is", "us", "a", "e", "i", "u", "o", "s", "š"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
 _CJK_RUN = re.compile(
     "[一-鿿㐀-䶿぀-ゟ゠-ヿ가-힯"
     "豈-﫿]+"
@@ -795,6 +961,36 @@ _cjk_tokenize = _script_bigram_tokenizer(_CJK_RUN)
 _thai_tokenize = _script_bigram_tokenizer(_THAI_RUN)
 
 _APOSTROPHE_TAIL = re.compile(r"['’][^\s]*")
+
+
+#: Devanagari vowel signs are combining marks (category Mn) — \W to the
+#: regex engine — so the standard tokenizer would split every Hindi word
+#: at its matras; keep Devanagari runs (letters + marks + virama) whole
+#: the non-Devanagari alternative must EXCLUDE the Devanagari block, or a
+#: digit/Latin-led token swallows the following consonant and strands its
+#: matra ("5वीं" → "5व", "ीं")
+_DEVANAGARI_TOKEN = re.compile(r"[ऀ-ॿ]+|[^\s\W_ऀ-ॿ]+", re.UNICODE)
+
+
+def _hindi_tokenize(text: str, to_lowercase: bool, min_token_length: int):
+    if to_lowercase:
+        text = text.lower()
+    return [
+        t for t in _DEVANAGARI_TOKEN.findall(text)
+        if len(t) >= min_token_length
+    ]
+
+
+_GA_PROTHESIS = re.compile(r"\b[nt]-(?=[aeiouáéíóú])|\b[nt](?=[AEIOUÁÉÍÓÚ])")
+
+
+def _irish_tokenize(text: str, to_lowercase: bool, min_token_length: int):
+    """Irish prothesis (IrishLowerCaseFilter behavior) must run BEFORE
+    tokenization: the word regex would split 'n-athair' at the hyphen and
+    the lowercased token stream can no longer tell 'nAthair' from a word
+    that begins with n."""
+    text = _GA_PROTHESIS.sub("", text)
+    return tokenize(text, to_lowercase, min_token_length)
 
 
 def _turkish_tokenize(text: str, to_lowercase: bool, min_token_length: int):
@@ -879,6 +1075,81 @@ STOPWORDS.update({
         อยู่ อย่าง จาก ถึง ด้วย แล้ว ยัง ต้อง เมื่อ ความ""".split()
     ),
     "cjk": frozenset(),
+    # ---- tier 3 (round 5)
+    "bg": frozenset(
+        """а и в на с за не се да по от е са ще това той тя то те ние вие
+        аз ти ни ви го я му ѝ им ми ли но или ако като който която което
+        които кой коя кое кои защото защо кога къде как там тук при до из
+        над под пред след без че бил била било били съм си сме сте е беше
+        бяха има няма може трябва още вече само също така тези този тази
+        това му ги""".split()
+    ),
+    "ca": frozenset(
+        """de la el els les un una uns unes i o a en amb per què que es el
+        al del dels no sí és són era eren ser estar ha han he hem heu hi
+        ho aquest aquesta aquests aquestes aquell aquella allò això jo tu
+        ell ella nosaltres vosaltres ells elles em et es ens us li com més
+        molt poc tot tots tota totes també ja encara quan on si doncs
+        però sense sobre sota entre fins des com""".split()
+    ),
+    "eu": frozenset(
+        """eta edo ez da dira zen ziren izan du dute zuen zuten bat batzuk
+        hau hori hura hauek horiek haiek ni zu gu zuek bera beraiek nire
+        zure gure haren baina ere oso asko gutxi guztiak dena zer nor non
+        noiz nola zergatik zein baldin gero orain hemen hor han arte kontra
+        gabe bezala baino ondoren aurretik artean""".split()
+    ),
+    "fa": frozenset(
+        """و در به از که این آن را با برای است بود شد های می ها او ما شما
+        آنها من تو خود هم نیز یا اما اگر تا بر هر چه چرا کجا چگونه کی
+        بین روی زیر پیش پس بدون درباره مانند باید شاید هست نیست بودند
+        هستند کرد کردند کند کنند شود شده دارد دارند داشت یک دو
+        آیا""".split()
+    ),
+    "gl": frozenset(
+        """de a o as os un unha uns unhas e ou en con por para que non si
+        é son era eran ser estar hai ha han ao aos á ás do da dos das no
+        na nos nas este esta estes estas ese esa eses esas aquel aquela eu
+        ti el ela nós vós eles elas me te se nos vos lle lles como máis
+        moi pouco todo todos toda todas tamén xa aínda cando onde entre
+        ata desde sen sobre baixo despois antes""".split()
+    ),
+    "hi": frozenset(
+        """का की के में है हैं को से पर और या नहीं यह वह ये वे मैं तुम आप हम
+        उसका उसकी उनके इस उस इन उन एक दो था थी थे हो होता होती होते
+        किया करना करता करती करते गया गयी गये हुआ हुई हुए भी तो ही अब
+        जब तब कब क्यों कैसे कौन क्या जो कि अगर लेकिन फिर बहुत कुछ सब
+        अपना साथ बाद पहले लिए द्वारा""".split()
+    ),
+    "hy": frozenset(
+        """և եւ ու է են էր էին եմ ես ենք եք չի չեն չէր այս այդ այն սա դա
+        նա մենք դուք նրանք ես դու իմ քո իր մեր ձեր նրանց որ ով ինչ երբ
+        որտեղ ինչպես ինչու քանի թե եթե բայց կամ նաև միայն շատ քիչ բոլոր
+        ամեն մեջ վրա տակ մոտ հետ առանց մասին համար ըստ դեպի մինչև
+        այնտեղ այստեղ""".split()
+    ),
+    "id": frozenset(
+        """yang dan di ke dari untuk pada dengan adalah ini itu tidak ada
+        akan telah sudah belum bisa dapat harus juga atau tetapi tapi
+        karena jika kalau saya aku kamu anda dia kami kita mereka nya ya
+        bukan saja hanya lebih sangat semua setiap antara dalam luar atas
+        bawah sebagai seperti sampai hingga ketika saat oleh bagi tentang
+        maka lalu kemudian masih pernah sedang""".split()
+    ),
+    "ga": frozenset(
+        """agus an na is ní tá bhí níl sé sí mé tú muid sibh siad a ar as
+        ag do de i le go chun faoi ó roimh thar trí gan mar nach má dá cé
+        cad conas cathain cá fáth seo sin siúd é í iad ach nó más bheith
+        raibh beidh bhfuil dom duit dó di dúinn daoibh dóibh mo do a ár
+        bhur ina sa san leis len lena ag""".split()
+    ),
+    "lv": frozenset(
+        """un ir nav bija būs es tu viņš viņa mēs jūs viņi viņas tas tā
+        šis šī tie tās kas ko kam par ar uz no pie pēc pirms bez virs zem
+        starp pret līdz kā kad kur kāpēc vai bet ja tad jo arī vēl tikai
+        ļoti daudz maz viss visi visas katrs savs mans tavs mūsu jūsu
+        sava""".split()
+    ),
 })
 
 _LIGHT_STEMMERS: dict[str, Callable[[str], str]] = {
@@ -890,6 +1161,17 @@ _LIGHT_STEMMERS: dict[str, Callable[[str], str]] = {
     "no": norwegian_stem,
     "ro": romanian_stem,
     "tr": turkish_stem,
+    # tier 3
+    "bg": bulgarian_stem,
+    "ca": catalan_stem,
+    "eu": basque_stem,
+    "fa": persian_normalize,  # PersianAnalyzer: normalization, no stemming
+    "gl": galician_stem,
+    "hi": hindi_stem,
+    "hy": armenian_stem,
+    "id": indonesian_stem,
+    "ga": irish_stem,
+    "lv": latvian_stem,
 }
 
 _STEMMERS: dict[str, Callable[[str], str]] = {
@@ -913,6 +1195,14 @@ ANALYZERS: dict[str, LanguageAnalyzer] = {
 #: Turkish: apostrophe filter + Turkish casefold before tokenization
 ANALYZERS["tr"] = LanguageAnalyzer(
     "tr", STOPWORDS["tr"], turkish_stem, tokenizer=_turkish_tokenize
+)
+#: Irish: prothetic n-/t- stripping must precede tokenization
+ANALYZERS["ga"] = LanguageAnalyzer(
+    "ga", STOPWORDS["ga"], irish_stem, tokenizer=_irish_tokenize
+)
+#: Hindi: Devanagari-run tokenizer (matras are combining marks)
+ANALYZERS["hi"] = LanguageAnalyzer(
+    "hi", STOPWORDS["hi"], hindi_stem, tokenizer=_hindi_tokenize
 )
 #: Thai: script-run bigram tokenization (no ICU segmenter), no stemming
 ANALYZERS["th"] = LanguageAnalyzer(
