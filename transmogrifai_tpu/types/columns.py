@@ -204,9 +204,11 @@ class NumericColumn(Column):
         return len(self.values)
 
     def to_list(self) -> list:
+        # ndarray.tolist() converts to Python scalars in one C pass; the
+        # per-element .item() loop was a serving-batch hot spot
         return [
-            (v.item() if m else None)
-            for v, m in zip(self.values, self.mask)
+            (v if m else None)
+            for v, m in zip(self.values.tolist(), self.mask.tolist())
         ]
 
     def take(self, indices: np.ndarray) -> "NumericColumn":
@@ -359,17 +361,18 @@ class PredictionColumn(Column):
 
     def to_list(self) -> list:
         """Row-wise Prediction maps with reference key names."""
-        out = []
-        for i in range(len(self.prediction)):
-            m = {Prediction.KEY_PREDICTION: float(self.prediction[i])}
-            if self.probability is not None:
-                for j, p in enumerate(np.asarray(self.probability[i])):
-                    m[f"{Prediction.KEY_PROB}_{j}"] = float(p)
-            if self.raw is not None:
-                for j, p in enumerate(np.asarray(self.raw[i])):
-                    m[f"{Prediction.KEY_RAW}_{j}"] = float(p)
-            out.append(m)
-        return out
+        keys = [Prediction.KEY_PREDICTION]
+        cols = [np.asarray(self.prediction).tolist()]
+        if self.probability is not None:
+            prob = np.asarray(self.probability)
+            keys += [f"{Prediction.KEY_PROB}_{j}" for j in range(prob.shape[1])]
+            cols += [prob[:, j].tolist() for j in range(prob.shape[1])]
+        if self.raw is not None:
+            rawm = np.asarray(self.raw)
+            keys += [f"{Prediction.KEY_RAW}_{j}" for j in range(rawm.shape[1])]
+            cols += [rawm[:, j].tolist() for j in range(rawm.shape[1])]
+        # strict: a length-mismatched field must fail loudly, not truncate
+        return [dict(zip(keys, row)) for row in zip(*cols, strict=True)]
 
     def take(self, indices: np.ndarray) -> "PredictionColumn":
         return PredictionColumn(
@@ -409,6 +412,24 @@ def column_from_values(feature_type: type, raw: Sequence[Any]) -> Column:
     place that knows how each feature family is physically represented.
     """
     storage = feature_type.storage
+    if storage in (Storage.REAL, Storage.INTEGRAL, Storage.DATE):
+        # fast path for already-typed rows (the serving batch hot loop):
+        # numpy converts None -> nan directly for float targets and raises
+        # for strings/None-with-int, so a clean numeric list skips the
+        # per-value _coerce entirely with identical semantics (NaN and
+        # None both mean missing; bools widen to 1/0 either way)
+        lst = raw if isinstance(raw, list) else list(raw)
+        dtype = _STORAGE_DTYPE[storage]
+        try:
+            vals = np.asarray(lst, dtype=dtype)
+            if vals.dtype == np.float64:
+                mask = ~np.isnan(vals)
+                vals = np.where(mask, vals, 0.0)
+            else:
+                mask = np.ones(len(lst), dtype=bool)
+            return NumericColumn(feature_type, vals, mask)
+        except (TypeError, ValueError, OverflowError):
+            raw = lst  # strings / missing ints -> per-value coercion
     if storage in _STORAGE_DTYPE:
         def _coerce(v: Any) -> Any:
             if isinstance(v, bool) or v is None:
