@@ -189,10 +189,10 @@ def bench_iris_cpu() -> None:
 
     path = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data"
     samples = []
-    # median of 3 back-to-back in-process runs, each timing the FULL flow
+    # median of 5 back-to-back in-process runs, each timing the FULL flow
     # (data load + split + grid setup + fits + refit + holdout) — the same
     # region bench.py's TPU reps time
-    for _rep in range(3):
+    for _rep in range(5):
         t0 = time.perf_counter()
         rows = [line.strip().split(",") for line in open(path) if line.strip()]
         x = np.array([[float(v) for v in r[:4]] for r in rows])
@@ -262,10 +262,10 @@ def bench_boston_cpu() -> None:
     path = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
             "housingData.csv")
     samples = []
-    # median of 3 back-to-back in-process runs, each timing the FULL flow
+    # median of 5 back-to-back in-process runs, each timing the FULL flow
     # (data load + split + grid setup + fits + refit + holdout) — the same
     # region bench.py's TPU reps time
-    for _rep in range(3):
+    for _rep in range(5):
         t0 = time.perf_counter()
         rows = [line.strip().split(",") for line in open(path) if line.strip()]
         x = np.array([[float(v) for v in r[1:14]] for r in rows])
@@ -369,9 +369,12 @@ def bench_serving_cpu() -> None:
         lat.append(time.perf_counter() - t0)
     lat.sort()
     pipe.predict_proba(feats)  # warm batch
-    t0 = time.perf_counter()
-    pipe.predict_proba(feats)
-    batch_s = time.perf_counter() - t0
+    ts = []
+    for _ in range(5):  # median of 5, same protocol as bench.py's side
+        t0 = time.perf_counter()
+        pipe.predict_proba(feats)
+        ts.append(time.perf_counter() - t0)
+    batch_s = sorted(ts)[len(ts) // 2]
     _merge_workload("serving", {
         "row_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
         "batch_rows_per_sec": round(len(feats) / batch_s),
@@ -597,11 +600,11 @@ def main() -> None:
     from sklearn.model_selection import StratifiedKFold
 
     path = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
-    # median of 3 back-to-back in-process runs — the SAME protocol the TPU
+    # median of 5 back-to-back in-process runs — the SAME protocol the TPU
     # bench reports (bench.py bench_titanic), so vs_baseline stays
     # like-for-like; all samples recorded
     samples = []
-    for _rep in range(3):
+    for _rep in range(5):
         t0 = time.perf_counter()
         x, y = load_titanic(path)
         n = len(y)

@@ -63,7 +63,13 @@ class FeatureRemovalModel(Model):
         assert isinstance(vec, VectorColumn)
         if not self.remove_bad_features:
             return vec
-        values = np.asarray(vec.values)[:, self.indices_to_keep]
+        idx = getattr(self, "_idx_arr", None)
+        if idx is None:
+            # fancy indexing with a Python list re-builds the index array
+            # every scoring call; indices_to_keep is fit-static (set in
+            # __init__/from_params, never rebound), so cache unconditionally
+            idx = self._idx_arr = np.asarray(self.indices_to_keep, dtype=np.intp)
+        values = np.asarray(vec.values)[:, idx]
         meta = self.new_metadata
         if meta is None and vec.metadata is not None:
             # select() reindexes one dataclass per kept column — fit-static,
