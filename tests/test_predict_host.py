@@ -90,3 +90,35 @@ def test_per_lane_depth_cap_matches_static_depth():
         np.asarray(capped.split_bin)[:, :, :3, :8],
         np.asarray(static.split_bin),
     )
+
+
+def test_multiclass_serving_plan_parity(monkeypatch):
+    """The per-model used-feature serving plan (host_serving_plan) must be
+    bit-identical to the full-width path for MULTICLASS stack lists: one
+    shared used-set, per-class remapped stacks, x binned once."""
+    from transmogrifai_tpu.models.gbdt import BoostedMultiModel
+
+    # the host path must engage regardless of the caller's serving knob
+    monkeypatch.setenv("TPTPU_HOST_PREDICT_MAX", "16384")
+
+    rng = np.random.default_rng(11)
+    F, B, R, D, M, C, n = 23, 8, 4, 3, 8, 3, 97
+    thr = np.sort(rng.normal(size=(F, B - 1)), axis=1).astype(np.float32)
+    stacks = [_random_trees(rng, R, D, M, F, B) for _ in range(C)]
+    x = rng.normal(size=(n, F)).astype(np.float32)
+    x[rng.random(size=x.shape) < 0.1] = np.nan
+
+    m = BoostedMultiModel(thr, stacks, eta=0.3, base_score=0.5)
+    pred, prob, margins = m.predict_arrays(x)  # builds + uses the plan
+    assert m._serve_plan is not None
+
+    # full-width reference: per-class host predict with the ORIGINAL stacks
+    binned = TR.bin_data_host(x, thr)
+    ref = np.stack([
+        TR.predict_boosted_host(x, thr, t, 0.3, 0.5, binned=binned)
+        for t in stacks
+    ], axis=1).astype(np.float64)
+    np.testing.assert_array_equal(margins, ref)
+    assert prob.shape == (n, C)
+    p_ref = 1.0 / (1.0 + np.exp(-ref))
+    np.testing.assert_array_equal(pred, p_ref.argmax(axis=1).astype(np.float64))
