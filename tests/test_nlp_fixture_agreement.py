@@ -430,13 +430,24 @@ ANALYZER_GOLDEN_V3 = {
     "lv": [("jaunās grāmatas bibliotēkās",
             ["jaunā", "grāmat", "bibliotēkā"]),
            ("studenti lasa rakstus", ["student", "las", "rakst"])],
+    # Bengali: script-run tokenization (vowel signs are combining marks)
+    "bn": [("ছাত্ররা পুরনো বইগুলো পড়ে", ["ছাত্র", "পুরন", "বই", "পড়"]),
+           ("নতুন লাইব্রেরিতে অনেক বই", ["নতুন", "লাইব্রেরি", "বই"])],
+    "lt": [("studentai skaito naujas knygas bibliotekose",
+            ["student", "skait", "nauj", "knyg", "bibliotek"]),
+           ("nauji universitetai miestuose",
+            ["nauj", "universitet", "miest"])],
+    "uk": [("студенти читають нові книги в бібліотеках",
+            ["студент", "читают", "нов", "книг", "бібліотек"]),
+           ("нова школа у великому місті",
+            ["нов", "школ", "велик", "міст"])],
 }
 
 
 def test_analyzers_v3_golden():
     from transmogrifai_tpu.utils.analyzers import ANALYZERS, analyze
 
-    assert len(ANALYZERS) >= 32
+    assert len(ANALYZERS) >= 35
     for lang, cases in ANALYZER_GOLDEN_V3.items():
         for text, expect in cases:
             assert analyze(text, language=lang) == expect, (lang, text)
@@ -455,6 +466,8 @@ def test_tier3_morphological_unification():
         ("lv", "kaķis", "kaķiem"), ("hi", "बिल्ली", "बिल्लियों"),
         # Persian normalization: Arabic kaf folds to Farsi keheh
         ("fa", "كتاب", "کتاب"),
+        ("bn", "বই", "বইগুলো"), ("lt", "knyga", "knygas"),
+        ("uk", "бібліотека", "бібліотеках"),
     ]
     for lang, a, b in pairs:
         sa, sb = ANALYZERS[lang].stem(a), ANALYZERS[lang].stem(b)

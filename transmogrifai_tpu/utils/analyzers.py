@@ -910,6 +910,51 @@ def irish_stem(w: str) -> str:
     return w
 
 
+def bengali_stem(w: str) -> str:
+    """Bengali light stemmer (Lucene BengaliStemmer family): case/plural
+    particles and vowel-sign endings, longest first."""
+    if len(w) < 3:
+        return w
+    for suf in ("দেরকে", "গুলোর", "গুলির", "গুলো", "গুলি", "খানা",
+                "দের", "েরা", "দিকে", "টির", "টার", "ছিল", "বেন",
+                "ের", "কে", "রা", "টা", "টি", "তে", "েই", "ে", "ি",
+                "া", "ী", "ো"):
+        if w.endswith(suf) and len(w) - len(suf) >= 2:
+            return w[: -len(suf)]
+    return w
+
+
+def lithuanian_stem(w: str) -> str:
+    """Lithuanian light stemmer (Snowball-Lithuanian approximation): noun/
+    adjective declension endings."""
+    if len(w) < 4:
+        return w
+    for suf in ("iausias", "iausia", "uosiuose", "uose", "iams", "ams",
+                "ose", "ėse", "yse", "uje", "oje", "ėje", "iai", "ius",
+                "ių", "ais", "oms", "ėms", "as", "is", "ys", "us",
+                "ai", "os", "ės", "ų", "ą", "ę", "į", "ė", "a", "e", "i",
+                "o", "u", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
+def ukrainian_stem(w: str) -> str:
+    """Ukrainian light stemmer (the Lucene build uses a morfologik
+    dictionary; this is the standard Slavic-light suffix reduction, same
+    approach as the Russian light stemmer above)."""
+    if len(w) < 4:
+        return w
+    for suf in ("ськими", "ського", "ському", "істю", "ення", "іння",
+                "ість", "ами", "ями", "ових", "ого", "ому", "ими", "іми",
+                "ах", "ях", "ів", "ей", "ом", "ем", "ою", "ею",
+                "ий", "ій", "ії", "ія", "ію", "и", "і", "а", "я", "у",
+                "ю", "о", "е", "ь"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
 def latvian_stem(w: str) -> str:
     """LatvianStemmer (light): noun/adjective declension endings, longest
     first."""
@@ -977,6 +1022,20 @@ def _hindi_tokenize(text: str, to_lowercase: bool, min_token_length: int):
         text = text.lower()
     return [
         t for t in _DEVANAGARI_TOKEN.findall(text)
+        if len(t) >= min_token_length
+    ]
+
+
+#: Bengali script (U+0980–U+09FF) has the same combining-vowel-sign issue
+#: as Devanagari — keep script runs whole
+_BENGALI_TOKEN = re.compile(r"[ঀ-৿]+|[^\s\W_ঀ-৿]+", re.UNICODE)
+
+
+def _bengali_tokenize(text: str, to_lowercase: bool, min_token_length: int):
+    if to_lowercase:
+        text = text.lower()
+    return [
+        t for t in _BENGALI_TOKEN.findall(text)
         if len(t) >= min_token_length
     ]
 
@@ -1150,6 +1209,27 @@ STOPWORDS.update({
         ļoti daudz maz viss visi visas katrs savs mans tavs mūsu jūsu
         sava""".split()
     ),
+    "bn": frozenset(
+        """এই ও এবং যে যা কি না হয় হবে ছিল করে করা হতে থেকে জন্য সঙ্গে সাথে
+        মধ্যে উপর নিচে আগে পরে কিন্তু অথবা যদি তবে তাই আমি তুমি আপনি সে
+        তারা আমরা তোমরা তার তাদের আমার আমাদের এক দুই আর এটা সেটা কোন কেন
+        কীভাবে কখন কোথায় কেউ কিছু সব অনেক আরও শুধু এখন তখন এখানে সেখানে
+        দিয়ে নিয়ে হয়ে গিয়ে""".split()
+    ),
+    "lt": frozenset(
+        """ir yra nėra buvo bus aš tu jis ji mes jūs jie jos tai šis ši
+        tas ta kas ką kam su iš į ant po prie per nuo iki be prieš už virš
+        tarp kaip kada kur kodėl ar bet jei tada nes taip pat dar tik
+        labai daug mažai visas visi visos kiekvienas savo mano tavo mūsų
+        jūsų apie""".split()
+    ),
+    "uk": frozenset(
+        """і й та в у на з із зі до від за під над при про через для без
+        між це цей ця ці той та те ті він вона воно вони ми ви я ти мій
+        твій наш ваш свій його її їх що як коли де чому хто або але якщо
+        то тому так ні не є був була було були буде бути може треба вже
+        ще тільки дуже багато мало весь вся все всі кожен інший""".split()
+    ),
 })
 
 _LIGHT_STEMMERS: dict[str, Callable[[str], str]] = {
@@ -1172,6 +1252,9 @@ _LIGHT_STEMMERS: dict[str, Callable[[str], str]] = {
     "id": indonesian_stem,
     "ga": irish_stem,
     "lv": latvian_stem,
+    "bn": bengali_stem,
+    "lt": lithuanian_stem,
+    "uk": ukrainian_stem,
 }
 
 _STEMMERS: dict[str, Callable[[str], str]] = {
@@ -1203,6 +1286,10 @@ ANALYZERS["ga"] = LanguageAnalyzer(
 #: Hindi: Devanagari-run tokenizer (matras are combining marks)
 ANALYZERS["hi"] = LanguageAnalyzer(
     "hi", STOPWORDS["hi"], hindi_stem, tokenizer=_hindi_tokenize
+)
+#: Bengali: same script-run treatment as Devanagari
+ANALYZERS["bn"] = LanguageAnalyzer(
+    "bn", STOPWORDS["bn"], bengali_stem, tokenizer=_bengali_tokenize
 )
 #: Thai: script-run bigram tokenization (no ICU segmenter), no stemming
 ANALYZERS["th"] = LanguageAnalyzer(
