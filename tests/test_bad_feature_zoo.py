@@ -455,3 +455,70 @@ class TestBadFeatureZooReferenceParity:
             "ruleConfidence" in r or "cramersV" in r
             for n in status_cols for r in dropped[n]
         )
+
+    def test_textmap_key_pivot_leak_dropped(self):
+        """A TextMap KEY whose categorical value mirrors the label: the
+        per-key pivot columns (maps.py TextMapPivotVectorizer path) must be
+        dropped — categorical-vs-categorical leakage surfacing through a
+        map container, not a top-level picklist (BadFeatureZooTest's map
+        zoos)."""
+        from transmogrifai_tpu.types.columns import MapColumn
+
+        y = _label()
+        rng = np.random.default_rng(23)
+        maps = np.empty(N, dtype=object)
+        moods = np.array(["happy", "sad", "meh"])
+        for i in range(N):
+            maps[i] = {
+                "status": "approved" if y[i] > 0.5 else "denied",  # leak
+                "mood": str(moods[rng.integers(0, 3)]),            # clean
+            }
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "tm": MapColumn(T.TextMap, maps),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        status_cols = [n for n in dropped if "status" in n]
+        assert status_cols, f"TextMap key leak survived: {list(dropped)}"
+        # the drop must be for LEAKAGE (not just a constant sibling's
+        # variance rule) — a full categorical-leak regression would
+        # otherwise pass via the OTHER/NullIndicator variance drops
+        leak_reasons = [r for n in status_cols for r in dropped[n]]
+        assert any(
+            "cramersV" in r or "corrLabel" in r or "ruleConfidence" in r
+            for r in leak_reasons
+        ), f"no leakage reason on the status columns: {leak_reasons}"
+
+    def test_datemap_constant_key_dropped_for_variance(self):
+        """A DateMap key frozen at one timestamp vectorizes to constant
+        circular-encoding columns — the variance rule must remove them
+        while the varying key survives."""
+        from transmogrifai_tpu.types.columns import MapColumn
+
+        y = _label()
+        rng = np.random.default_rng(29)
+        day = 86_400_000
+        maps = np.empty(N, dtype=object)
+        for i in range(N):
+            maps[i] = {
+                "frozen": 1_500_000_000_000,                    # constant
+                "active": 1_500_000_000_000 + int(rng.integers(0, 365)) * day,
+            }
+        dropped = _run_checker({
+            "label": _num(y, T.RealNN),
+            "dm": MapColumn(T.DateMap, maps),
+            "ok": _num(RNG.normal(size=N)),
+        })
+        frozen_cols = [n for n in dropped if "frozen" in n]
+        assert frozen_cols, f"constant DateMap key survived: {list(dropped)}"
+        assert any(
+            "variance" in r.lower()
+            for n in frozen_cols for r in dropped[n]
+        ), f"expected a variance reason, got {dropped}"
+        # the varying key's date-granularity encodings must SURVIVE
+        # (HourOfDay/null-indicator legitimately drop at day granularity)
+        assert not any(
+            "active" in n and ("DayOfYear" in n or "DayOfMonth" in n
+                               or "MonthOfYear" in n)
+            for n in dropped
+        ), f"varying DateMap key was dropped: {list(dropped)}"
