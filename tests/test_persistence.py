@@ -158,3 +158,29 @@ def test_local_score_missing_label(trained):
     row = {k: v for k, v in ds.rows()[3].items() if k != "Survived"}
     out = fn(row)
     assert 0.0 <= out[pred.name]["probability_1"] <= 1.0
+
+
+def test_local_score_batch_above_bucket_cap(trained):
+    """Batches above _BUCKET_CAP pad to the next multiple of the cap
+    instead of the next power of two (bounded program count, <=2x pad);
+    outputs must match the plain batch path row-for-row."""
+    from transmogrifai_tpu.local import scoring as S
+
+    ds, pred, model = trained
+    fn = score_function(model)
+    rows = ds.rows()
+    # replicate the dataset past the 8192 cap (8910 rows -> 16384 pad)
+    big = (rows * 11)[: S._BUCKET_CAP + 718]
+    assert len(big) > S._BUCKET_CAP
+    outs = fn.batch(big)
+    assert len(outs) == len(big)
+    small = fn.batch(rows[:5])
+    for i in range(5):
+        assert outs[i][pred.name]["probability_1"] == pytest.approx(
+            small[i][pred.name]["probability_1"], abs=1e-9
+        )
+    # wrap-around replica must score identically to its original row
+    j = len(rows)  # first repeated row == rows[0]
+    assert outs[j][pred.name]["probability_1"] == pytest.approx(
+        outs[0][pred.name]["probability_1"], abs=1e-9
+    )
