@@ -764,7 +764,7 @@ def main() -> None:
                 # round-trip throughput varies hour-to-hour — measured
                 # quiet-chip best 9.3 s, congested episodes up to ~70 s
                 # with identical cache state (BASELINE.md round 3)
-                "variance_note": "tunnel-shared chip; selector rows report the MEDIAN of 5 back-to-back in-process end-to-end runs, all samples disclosed in *_train_samples_s. Protocol asymmetry stated plainly: TPU reps 1+ amortize per-process program-bank loads that rep 0 pays (sklearn has no analogous cost; its own 5-rep in-process protocol measures 6.362s median, the recorded 5.974s anchor is the CPU's fastest-ever single rep - harder). FRESH-process single-shot TPU runs measure 4.99-6.69s in quiet windows (median >=1.0 vs the anchor, congestion episodes 12-42s); the in-process median is the steady-state number, the fresh-process range is what one cold training run pays",
+                "variance_note": "tunnel-shared chip; selector rows report the MEDIAN of 5 back-to-back in-process end-to-end runs, all samples disclosed in *_train_samples_s. Protocol asymmetry stated plainly: TPU reps 1+ amortize per-process program-bank loads that rep 0 pays (sklearn has no analogous cost; its own 5-rep in-process protocol measures 6.362s median, the recorded 5.974s anchor is the CPU's fastest-ever single rep - harder). FRESH-process single-shot TPU runs measure 4.99-6.69s in quiet windows (~parity with the anchor: 0.94-1.05x measured post-optimization; congestion episodes 12-42s); the in-process median is the steady-state number, the fresh-process range is what one cold training run pays",
             }
         )
     )
