@@ -44,49 +44,76 @@ def _sgns_train(
     import jax
     import jax.numpy as jnp
 
+    from ..utils.aot import aot_call
+
     rng = np.random.default_rng(seed)
     # pre-sample batches + negatives host-side for a static scan
     idx = rng.integers(0, len(pairs), size=(steps, batch))
     neg = rng.integers(0, vocab_size, size=(steps, batch, num_neg))
     centers = pairs[idx, 0]
     contexts = pairs[idx, 1]
-
-    key = jax.random.PRNGKey(seed)
-    w_in = jax.random.normal(key, (vocab_size, dim), dtype=jnp.float32) / dim
-    w_out = jnp.zeros((vocab_size, dim), dtype=jnp.float32)
-
-    def step(params, inputs):
-        w_in, w_out = params
-        c, ctx, ng, lr_t = inputs
-
-        def loss_fn(w_in, w_out):
-            v = w_in[c]                    # [B, D]
-            u_pos = w_out[ctx]             # [B, D]
-            u_neg = w_out[ng]              # [B, G, D]
-            pos = jnp.sum(v * u_pos, axis=-1)
-            negs = jnp.einsum("bd,bgd->bg", v, u_neg)
-            return -(
-                jnp.mean(jax.nn.log_sigmoid(pos))
-                + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), axis=-1))
-            )
-
-        g_in, g_out = jax.grad(loss_fn, argnums=(0, 1))(w_in, w_out)
-        return (w_in - lr_t * g_in, w_out - lr_t * g_out), None
-
     # classic word2vec linear lr decay — the high batch-scaled initial
     # rate needs the cool-down to stay stable on small corpora
     lr_sched = (lr * (1.0 - np.arange(steps) / steps)).astype(np.float32)
-    (w_in, w_out), _ = jax.lax.scan(
-        step,
-        (w_in, w_out),
+    w_in = aot_call(
+        "sgns_scan", _make_sgns_scan(),
         (
             jnp.asarray(centers, dtype=jnp.int32),
             jnp.asarray(contexts, dtype=jnp.int32),
             jnp.asarray(neg, dtype=jnp.int32),
             jnp.asarray(lr_sched),
+            jnp.int32(seed),
         ),
+        dict(vocab_size=vocab_size, dim=dim),
     )
     return np.asarray(w_in)
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _make_sgns_scan():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("vocab_size", "dim"))
+    def sgns_scan(centers, contexts, neg, lr_sched, seed, *, vocab_size, dim):
+        """The device half of _sgns_train as ONE jitted program — routed
+        through the AOT executable bank so fresh processes skip the
+        trace+compile (the embeddings bench paid ~20 s of it)."""
+        key = jax.random.PRNGKey(seed)
+        w_in = (
+            jax.random.normal(key, (vocab_size, dim), dtype=jnp.float32) / dim
+        )
+        w_out = jnp.zeros((vocab_size, dim), dtype=jnp.float32)
+
+        def step(params, inputs):
+            w_in, w_out = params
+            c, ctx, ng, lr_t = inputs
+
+            def loss_fn(w_in, w_out):
+                v = w_in[c]                    # [B, D]
+                u_pos = w_out[ctx]             # [B, D]
+                u_neg = w_out[ng]              # [B, G, D]
+                pos = jnp.sum(v * u_pos, axis=-1)
+                negs = jnp.einsum("bd,bgd->bg", v, u_neg)
+                return -(
+                    jnp.mean(jax.nn.log_sigmoid(pos))
+                    + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), axis=-1))
+                )
+
+            g_in, g_out = jax.grad(loss_fn, argnums=(0, 1))(w_in, w_out)
+            return (w_in - lr_t * g_in, w_out - lr_t * g_out), None
+
+        (w_in, w_out), _ = jax.lax.scan(
+            step, (w_in, w_out), (centers, contexts, neg, lr_sched)
+        )
+        return w_in
+
+    return sgns_scan
 
 
 class OpWord2Vec(Estimator):
@@ -224,43 +251,70 @@ def _lda_fit(
     import jax.numpy as jnp
     from jax.scipy.special import digamma
 
-    n, v = x.shape
+    from ..utils.aot import aot_call
+
     alpha = alpha if alpha is not None else 1.0 / k  # Spark default 1/k (+1 offset for em)
     eta = eta if eta is not None else 1.0 / k
-    key = jax.random.PRNGKey(seed)
-    lam = jax.random.gamma(key, 100.0, (k, v)) * 0.01  # topic-word
-
-    xj = jnp.asarray(x, dtype=jnp.float32)
-
-    def e_step(lam):
-        e_log_beta = digamma(lam) - digamma(lam.sum(1, keepdims=True))  # [K, V]
-        gamma = jnp.ones((n, k), dtype=jnp.float32)
-
-        def body(gamma, _):
-            e_log_theta = digamma(gamma) - digamma(gamma.sum(1, keepdims=True))
-            # phi_nk ∝ exp(E[log θ_nk] + E[log β_k,w]) aggregated over words
-            log_phi = e_log_theta[:, :, None] + e_log_beta[None, :, :]  # [N,K,V]
-            phi = jax.nn.softmax(log_phi, axis=1)
-            gamma = alpha + jnp.einsum("nv,nkv->nk", xj, phi)
-            return gamma, None
-
-        gamma, _ = jax.lax.scan(body, gamma, None, length=e_iters)
-        e_log_theta = digamma(gamma) - digamma(gamma.sum(1, keepdims=True))
-        log_phi = e_log_theta[:, :, None] + e_log_beta[None, :, :]
-        phi = jax.nn.softmax(log_phi, axis=1)
-        return gamma, phi
-
-    def m_step(phi):
-        return eta + jnp.einsum("nv,nkv->kv", xj, phi)
-
-    def em(lam, _):
-        _, phi = e_step(lam)
-        return m_step(phi), None
-
-    lam, _ = jax.lax.scan(em, lam, None, length=iters)
-    gamma, _ = e_step(lam)
-    theta = gamma / gamma.sum(1, keepdims=True)
+    lam, theta = aot_call(
+        "lda_scan", _make_lda_scan(),
+        (
+            jnp.asarray(x, dtype=jnp.float32),
+            jnp.float32(alpha), jnp.float32(eta), jnp.int32(seed),
+        ),
+        dict(k=k, iters=iters, e_iters=e_iters),
+    )
     return np.asarray(lam), np.asarray(theta)
+
+
+@_functools.lru_cache(maxsize=1)
+def _make_lda_scan():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.special import digamma
+
+    @functools.partial(jax.jit, static_argnames=("k", "iters", "e_iters"))
+    def lda_scan(xj, alpha, eta, seed, *, k, iters, e_iters):
+        """The device half of _lda_fit as ONE jitted program — AOT-banked
+        so fresh processes skip the trace+compile."""
+        n, v = xj.shape
+        key = jax.random.PRNGKey(seed)
+        lam = jax.random.gamma(key, 100.0, (k, v)) * 0.01  # topic-word
+
+        def e_step(lam):
+            e_log_beta = digamma(lam) - digamma(lam.sum(1, keepdims=True))
+            gamma = jnp.ones((n, k), dtype=jnp.float32)
+
+            def body(gamma, _):
+                e_log_theta = digamma(gamma) - digamma(
+                    gamma.sum(1, keepdims=True)
+                )
+                # phi_nk ∝ exp(E[log θ_nk] + E[log β_k,w]) over words
+                log_phi = e_log_theta[:, :, None] + e_log_beta[None, :, :]
+                phi = jax.nn.softmax(log_phi, axis=1)
+                gamma = alpha + jnp.einsum("nv,nkv->nk", xj, phi)
+                return gamma, None
+
+            gamma, _ = jax.lax.scan(body, gamma, None, length=e_iters)
+            e_log_theta = digamma(gamma) - digamma(gamma.sum(1, keepdims=True))
+            log_phi = e_log_theta[:, :, None] + e_log_beta[None, :, :]
+            phi = jax.nn.softmax(log_phi, axis=1)
+            return gamma, phi
+
+        def m_step(phi):
+            return eta + jnp.einsum("nv,nkv->kv", xj, phi)
+
+        def em(lam, _):
+            _, phi = e_step(lam)
+            return m_step(phi), None
+
+        lam, _ = jax.lax.scan(em, lam, None, length=iters)
+        gamma, _ = e_step(lam)
+        theta = gamma / gamma.sum(1, keepdims=True)
+        return lam, theta
+
+    return lda_scan
 
 
 class OpLDA(Estimator):

@@ -92,9 +92,11 @@ def _version_salt() -> str:
     if _SALT is None:
         h = hashlib.sha256()
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # every file that DEFINES an aot_call-routed jit_fn must be listed,
+        # or editing it serves stale banked executables of the old code
         for rel in (
             "models/trees.py", "models/hist_pallas.py", "models/solvers.py",
-            "models/gbdt.py",
+            "models/gbdt.py", "ops/embeddings.py",
         ):
             try:
                 with open(os.path.join(pkg, rel), "rb") as fh:
