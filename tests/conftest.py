@@ -42,6 +42,17 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture
+def fault_plan():
+    """An installed, empty FaultPlan — tests script faults onto it and the
+    fixture guarantees uninstall (resilience.faults is process-global)."""
+    from transmogrifai_tpu.resilience import faults
+
+    plan = faults.FaultPlan()
+    with faults.installed(plan):
+        yield plan
+
+
 TITANIC_CSV = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
 
 

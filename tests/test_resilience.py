@@ -1,0 +1,731 @@
+"""Fault-tolerance suite (resilience/): retry policy, layer checkpoint /
+resume, deterministic fault injection, corrupt-blob recovery, and score-time
+NaN guards.
+
+All fault scenarios are scripted through a seeded FaultPlan and an
+injectable clock, so the whole suite is deterministic and sleeps zero real
+seconds (pyproject marker: faults).
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.models.naive_bayes import NaiveBayes
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.readers.core import SimpleReader
+from transmogrifai_tpu.resilience import (
+    CheckpointManager,
+    FatalError,
+    FaultPlan,
+    RetryPolicy,
+    ScoreGuard,
+    ScoreGuardError,
+    SimulatedCrash,
+    TransientError,
+    installed,
+    is_transient,
+)
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.selector.validators import CrossValidator
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.dag import compute_dag
+from transmogrifai_tpu.workflow.persistence import ModelLoadError
+from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+
+pytestmark = pytest.mark.faults
+
+GRID = {"reg_param": [0.01, 0.1], "elastic_net_param": [0.1]}
+
+
+class FakeClock:
+    """Injectable clock/sleep pair: backoff schedules run in zero wall time."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def time(self):
+        return self.now
+
+    def sleep(self, d):
+        self.sleeps.append(d)
+        self.now += d
+
+
+def fast_policy(**kw):
+    clk = FakeClock()
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("base_delay", 1.0)
+    kw.setdefault("jitter", 0.0)
+    policy = RetryPolicy(sleep=clk.sleep, clock=clk.time, **kw)
+    return policy, clk
+
+
+def _binary_ds(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = (x1 + 0.5 * x2 + 0.3 * rng.normal(size=n) > 0).astype(float)
+    return Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+    })
+
+
+def _graph(ds, seed=5, **checker_kwargs):
+    """Multi-layer DAG: transmogrify -> SanityChecker (estimator) ->
+    selector, so there is a real layer boundary to crash at."""
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    checked = resp.transform_with(
+        SanityChecker(remove_bad_features=True, **checker_kwargs), vec
+    )
+    selector = BinaryClassificationModelSelector(
+        seed=seed, models=[(LogisticRegression(), GRID)], num_folds=2
+    )
+    pred = selector.set_input(resp, checked).get_output()
+    return pred, selector
+
+
+def _arrays_of(model: WorkflowModel) -> dict:
+    out = {}
+    for uid, stage in model.fitted.items():
+        get = getattr(stage, "get_arrays", None)
+        if get is not None:
+            for k, v in get().items():
+                out[f"{uid}__{k}"] = np.asarray(v)
+    return out
+
+
+# ------------------------------------------------------------------ retry
+class TestRetryPolicy:
+    def test_transient_retries_then_succeeds(self):
+        policy, clk = fast_policy(max_attempts=4, multiplier=2.0)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TransientError("flaky")
+            return "ok"
+
+        out, attempts = policy.call(fn)
+        assert out == "ok" and attempts == 3
+        assert clk.sleeps == [1.0, 2.0]  # exponential, jitter disabled
+
+    def test_fatal_never_retries(self):
+        policy, clk = fast_policy()
+
+        with pytest.raises(ValueError) as ei:
+            policy.call(lambda: (_ for _ in ()).throw(ValueError("bad grid")))
+        assert clk.sleeps == []
+        assert getattr(ei.value, "_retry_attempts") == 1
+
+    def test_deadline_cuts_backoff_short(self):
+        policy, clk = fast_policy(max_attempts=10, deadline=2.5)
+
+        def always():
+            raise TransientError("down")
+
+        with pytest.raises(TransientError) as ei:
+            policy.call(always)
+        # 1s + 2s sleeps would blow the 2.5s budget on the second delay
+        assert clk.sleeps == [1.0]
+        assert ei.value._retry_attempts == 2
+
+    def test_jitter_is_seeded_deterministic(self):
+        d1 = [
+            RetryPolicy(seed=7).delay_for(a, __import__("random").Random(7))
+            for a in (1, 2, 3)
+        ]
+        d2 = [
+            RetryPolicy(seed=7).delay_for(a, __import__("random").Random(7))
+            for a in (1, 2, 3)
+        ]
+        assert d1 == d2
+
+    def test_classification(self):
+        assert is_transient(TransientError("x"))
+        assert is_transient(ConnectionResetError())
+        assert is_transient(TimeoutError())
+        assert not is_transient(FatalError("x"))
+        assert not is_transient(ValueError("x"))
+        assert not is_transient(FileNotFoundError(2, "gone"))
+
+
+# -------------------------------------------------------- checkpoint/resume
+class TestCheckpointResume:
+    def test_crash_after_layer_resumes_bit_identical(self, tmp_path):
+        """Acceptance: a DAG fit killed after layer k resumes from checkpoint
+        and produces bit-identical fitted arrays and scores to an
+        uninterrupted run."""
+        ds = _binary_ds()
+        ckpt_dir = str(tmp_path / "ck")
+
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        layers = compute_dag([pred])
+        k = len(layers) - 2  # the layer right before the selector
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+
+        plan = FaultPlan().crash_after_layer(k)
+        with installed(plan):
+            with pytest.raises(SimulatedCrash):
+                wf.train(checkpoint_dir=ckpt_dir)
+        assert plan.fired == [("crash", f"layer-{k}")]
+        for i in range(k + 1):
+            assert os.path.isdir(
+                os.path.join(ckpt_dir, "layers", f"layer-{i:03d}")
+            )
+
+        # resume must NOT refit anything up to layer k (SanityChecker spies)
+        fit_calls = []
+        orig_fit = SanityChecker.fit
+
+        def spy(self, dataset):
+            fit_calls.append(self.uid)
+            return orig_fit(self, dataset)
+
+        SanityChecker.fit = spy
+        try:
+            resumed = wf.train(checkpoint_dir=ckpt_dir, resume=True)
+        finally:
+            SanityChecker.fit = orig_fit
+        assert fit_calls == []
+
+        # uninterrupted reference run: identical construction order =>
+        # identical uids => comparable fitted dicts
+        uid_util.reset()
+        pred2, _ = _graph(ds)
+        ref = (
+            Workflow().set_result_features(pred2).set_input_dataset(ds).train()
+        )
+
+        a_res, a_ref = _arrays_of(resumed), _arrays_of(ref)
+        assert set(a_res) == set(a_ref) and a_res
+        for key in a_ref:
+            np.testing.assert_array_equal(a_res[key], a_ref[key])
+
+        s_res = resumed.score(dataset=ds)[pred.name]
+        s_ref = ref.score(dataset=ds)[pred2.name]
+        np.testing.assert_array_equal(
+            np.asarray(s_res.prediction), np.asarray(s_ref.prediction)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_res.probability), np.asarray(s_ref.probability)
+        )
+
+    def test_corrupt_layer_checkpoint_is_refit_not_crash(self, tmp_path):
+        ds = _binary_ds(n=120, seed=3)
+        ckpt_dir = str(tmp_path / "ck")
+
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        layers = compute_dag([pred])
+        k = len(layers) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with installed(FaultPlan().crash_after_layer(k)):
+            with pytest.raises(SimulatedCrash):
+                wf.train(checkpoint_dir=ckpt_dir)
+
+        # tear the FIRST layer's arrays the way a killed writer would; the
+        # whole prefix from there is refit, silently and correctly
+        FaultPlan.truncate_file(
+            os.path.join(ckpt_dir, "layers", "layer-000", "arrays.npz"),
+            keep=10,
+        )
+        resumed = wf.train(checkpoint_dir=ckpt_dir, resume=True)
+
+        uid_util.reset()
+        pred2, _ = _graph(ds)
+        ref = (
+            Workflow().set_result_features(pred2).set_input_dataset(ds).train()
+        )
+        a_res, a_ref = _arrays_of(resumed), _arrays_of(ref)
+        for key in a_ref:
+            np.testing.assert_array_equal(a_res[key], a_ref[key])
+
+    def test_resume_survives_uid_drift_across_processes(self, tmp_path):
+        """A restarted process regenerates stage uids from the global
+        counter; if anything extra was constructed first, every uid shifts.
+        Checkpoints match stages by (layer, position), so resume must still
+        restore instead of silently refitting everything."""
+        ds = _binary_ds(n=120, seed=40)
+        ckpt_dir = str(tmp_path / "ck")
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with installed(FaultPlan().crash_after_layer(k)):
+            with pytest.raises(SimulatedCrash):
+                wf.train(checkpoint_dir=ckpt_dir)
+
+        # "restarted process": unrelated feature construction first, so the
+        # rebuilt (identical) workflow gets entirely different uids
+        from_dataset(_binary_ds(n=8, seed=41), response="label")
+        pred2, _ = _graph(ds)
+        wf2 = Workflow().set_result_features(pred2).set_input_dataset(ds)
+        fit_calls = []
+        orig_fit = SanityChecker.fit
+        SanityChecker.fit = lambda self, d: fit_calls.append(self.uid) or orig_fit(self, d)
+        try:
+            resumed = wf2.train(checkpoint_dir=ckpt_dir, resume=True)
+        finally:
+            SanityChecker.fit = orig_fit
+        assert fit_calls == []  # restored from checkpoint despite uid drift
+
+        uid_util.reset()
+        pred3, _ = _graph(ds)
+        ref = (
+            Workflow().set_result_features(pred3).set_input_dataset(ds).train()
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resumed.score(dataset=ds)[pred2.name].prediction),
+            np.asarray(ref.score(dataset=ds)[pred3.name].prediction),
+        )
+
+    def test_stale_dag_signature_is_ignored(self, tmp_path):
+        ckpt = CheckpointManager(str(tmp_path / "ck"))
+        ckpt.save_layer(0, "sig-old", [])
+        assert ckpt.load_layers("sig-new", [[]]) == {}
+        # the stale dir is dropped so it cannot shadow the re-save
+        assert not os.path.isdir(ckpt.layer_path(0))
+
+    def test_changed_hyperparams_invalidate_checkpoints(self, tmp_path):
+        """The DAG signature covers stage params: resuming after editing a
+        hyperparameter must refit, not restore stale stages."""
+        ds = _binary_ds(n=120, seed=44)
+        ckpt_dir = str(tmp_path / "ck")
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with installed(FaultPlan().crash_after_layer(k)):
+            with pytest.raises(SimulatedCrash):
+                wf.train(checkpoint_dir=ckpt_dir)
+
+        uid_util.reset()
+        pred2, _ = _graph(ds, min_variance=1e-9)  # edited hyperparameter
+        wf2 = Workflow().set_result_features(pred2).set_input_dataset(ds)
+        fit_calls = []
+        orig_fit = SanityChecker.fit
+        SanityChecker.fit = (
+            lambda self, d: fit_calls.append(self.uid) or orig_fit(self, d)
+        )
+        try:
+            wf2.train(checkpoint_dir=ckpt_dir, resume=True)
+        finally:
+            SanityChecker.fit = orig_fit
+        assert fit_calls  # refit, no stale restore
+
+    def test_changed_data_invalidates_checkpoints(self, tmp_path):
+        """The DAG signature carries a dataset fingerprint: resuming against
+        different input data must refit everything."""
+        ds = _binary_ds(n=120, seed=45)
+        ckpt_dir = str(tmp_path / "ck")
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with installed(FaultPlan().crash_after_layer(k)):
+            with pytest.raises(SimulatedCrash):
+                wf.train(checkpoint_dir=ckpt_dir)
+
+        ds2 = _binary_ds(n=120, seed=46)  # same shape, different content
+        uid_util.reset()
+        pred2, _ = _graph(ds2)
+        wf2 = Workflow().set_result_features(pred2).set_input_dataset(ds2)
+        fit_calls = []
+        orig_fit = SanityChecker.fit
+        SanityChecker.fit = (
+            lambda self, d: fit_calls.append(self.uid) or orig_fit(self, d)
+        )
+        try:
+            wf2.train(checkpoint_dir=ckpt_dir, resume=True)
+        finally:
+            SanityChecker.fit = orig_fit
+        assert fit_calls  # refit, no cross-dataset restore
+
+    def test_fresh_train_clears_stale_checkpoints(self, tmp_path):
+        """resume=False with a reused checkpoint dir purges old-generation
+        layers, so a later crash + resume can never stitch two runs."""
+        ds = _binary_ds(n=120, seed=47)
+        ckpt_dir = str(tmp_path / "ck")
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        wf.train(checkpoint_dir=ckpt_dir)  # full run: all layers on disk
+        n_layers = len(os.listdir(os.path.join(ckpt_dir, "layers")))
+        assert n_layers > 2
+
+        uid_util.reset()
+        pred2, _ = _graph(ds)
+        wf2 = Workflow().set_result_features(pred2).set_input_dataset(ds)
+        with installed(FaultPlan().crash_after_layer(0)):
+            with pytest.raises(SimulatedCrash):
+                wf2.train(checkpoint_dir=ckpt_dir)  # fresh: clears first
+        assert os.listdir(os.path.join(ckpt_dir, "layers")) == ["layer-000"]
+
+    def test_resume_requires_checkpoint_dir(self):
+        ds = _binary_ds(n=40)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            wf.train(resume=True)
+
+
+# -------------------------------------------------------------- fault plan
+class TestFaultPlan:
+    def test_fail_nth_stage_fit_raises_in_train(self):
+        ds = _binary_ds(n=60, seed=30)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with installed(FaultPlan().fail_stage_fit(nth=1, transient=False)):
+            with pytest.raises(FatalError, match="injected fit failure"):
+                wf.train()
+
+    def test_fixture_installs_and_uninstalls(self, fault_plan):
+        from transmogrifai_tpu.resilience import faults
+
+        assert faults.active() is fault_plan
+        fault_plan.fail_stage_fit(target="SanityChecker", times=1)
+        ds = _binary_ds(n=60, seed=31)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with pytest.raises(TransientError):
+            wf.train()
+        assert fault_plan.fired == [("fit", fault_plan.fired[0][1])]
+
+
+# ------------------------------------------------------------- CV resilience
+def _xy(n=160, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return x, y
+
+
+class TestCVFaults:
+    def test_transient_candidate_retries_then_included(self):
+        """Acceptance: a candidate that fails transiently twice completes
+        with its attempt count recorded; a fatally failing one is excluded
+        (after zero retries) with its error string surfaced."""
+        x, y = _xy()
+        v = CrossValidator(num_folds=2, seed=9)
+        v.retry_policy, clk = fast_policy(max_attempts=3)
+        plan = (
+            FaultPlan()
+            .fail_candidate("LogisticRegression", times=2, transient=True)
+            .fail_candidate("NaiveBayes", times=1, transient=False)
+        )
+        candidates = [
+            (LogisticRegression(), GRID),
+            (NaiveBayes(), {"smoothing": [1.0]}),
+        ]
+        with installed(plan):
+            results = v.validate(
+                candidates, x, y, BinaryClassificationEvaluator()
+            )
+        by_name = {i["modelName"]: i for i in v.last_attempt_info}
+        lr, nb = by_name["LogisticRegression"], by_name["NaiveBayes"]
+        assert lr["attempts"] == 3 and not lr["excluded"]
+        assert nb["excluded"] and "injected" in nb["error"]
+        assert nb["attempts"] == 1  # fatal: no retry burned
+        assert {r.model_name for r in results} == {"LogisticRegression"}
+        assert len(clk.sleeps) == 2  # two backoffs, zero real seconds
+
+    def test_selector_summary_records_attempts(self):
+        x, y = _xy(seed=4)
+        selector = BinaryClassificationModelSelector(
+            seed=11, models=[(LogisticRegression(), GRID)], num_folds=2
+        )
+        selector.validator.retry_policy, _ = fast_policy(max_attempts=3)
+        plan = FaultPlan().fail_candidate(
+            "LogisticRegression", times=1, transient=True
+        )
+        with installed(plan):
+            model = selector.fit_arrays(
+                x, y, np.ones(len(y), dtype=np.float32)
+            )
+        attempts = model.summary["candidateAttempts"]
+        assert attempts[0]["modelName"] == "LogisticRegression"
+        assert attempts[0]["attempts"] == 2 and not attempts[0]["excluded"]
+
+    def test_summary_pretty_shows_retries_and_exclusions(self):
+        x, y = _xy(seed=6)
+        selector = BinaryClassificationModelSelector(
+            seed=12,
+            models=[
+                (LogisticRegression(), GRID),
+                (NaiveBayes(), {"smoothing": [1.0]}),
+            ],
+            num_folds=2,
+        )
+        selector.validator.retry_policy, _ = fast_policy(max_attempts=2)
+        plan = (
+            FaultPlan()
+            .fail_candidate("LogisticRegression", times=1, transient=True)
+            .fail_candidate("NaiveBayes", times=1, transient=False)
+        )
+        with installed(plan):
+            sel_model = selector.fit_arrays(
+                x, y, np.ones(len(y), dtype=np.float32)
+            )
+        # render through the workflow summary path
+        wm = WorkflowModel(
+            result_features=(),
+            raw_features=(),
+            fitted={selector.uid: sel_model},
+            selector_info={"estimatorUid": selector.uid},
+        )
+        pretty = wm.summary_pretty()
+        assert "Retried LogisticRegression: succeeded on attempt 2" in pretty
+        assert "Excluded NaiveBayes" in pretty and "injected" in pretty
+
+    def test_cv_candidate_checkpoint_skips_finished(self, tmp_path):
+        x, y = _xy(seed=2)
+        ckpt = CheckpointManager(str(tmp_path / "cv"))
+        candidates = [(LogisticRegression(), GRID)]
+        ev = BinaryClassificationEvaluator()
+
+        v1 = CrossValidator(num_folds=2, seed=21)
+        r1 = v1.validate(candidates, x, y, ev, checkpoint=ckpt)
+        assert not v1.last_attempt_info[0]["fromCheckpoint"]
+
+        # a "resumed" selection: same sweep identity AND same data, fresh
+        # validator — candidate results come from the checkpoint, no fit runs
+        v2 = CrossValidator(num_folds=2, seed=21)
+        orig = CrossValidator._sweep_family
+        ran = []
+        CrossValidator._sweep_family = lambda self, *a, **kw: ran.append(1)
+        try:
+            r2 = v2.validate(
+                candidates, x, y, ev, checkpoint=ckpt, resume=True
+            )
+        finally:
+            CrossValidator._sweep_family = orig
+        assert ran == []
+        assert v2.last_attempt_info[0]["fromCheckpoint"]
+        assert [r.metric_values for r in r2] == [
+            r.metric_values for r in r1
+        ]
+
+    def test_cv_checkpoint_ignored_without_resume_and_on_new_data(self, tmp_path):
+        x, y = _xy(seed=2)
+        ckpt = CheckpointManager(str(tmp_path / "cv"))
+        candidates = [(LogisticRegression(), GRID)]
+        ev = BinaryClassificationEvaluator()
+        CrossValidator(num_folds=2, seed=21).validate(
+            candidates, x, y, ev, checkpoint=ckpt
+        )
+
+        # resume=False: a fresh train must re-sweep, not consume stale metrics
+        v = CrossValidator(num_folds=2, seed=21)
+        v.validate(candidates, x, y, ev, checkpoint=ckpt)
+        assert not v.last_attempt_info[0]["fromCheckpoint"]
+
+        # resume=True but DIFFERENT data: the fingerprint in the candidate
+        # key must miss, so selection never runs on another dataset's metrics
+        x2, y2 = _xy(seed=99)
+        v2 = CrossValidator(num_folds=2, seed=21)
+        v2.validate(candidates, x2, y2, ev, checkpoint=ckpt, resume=True)
+        assert not v2.last_attempt_info[0]["fromCheckpoint"]
+
+
+# ------------------------------------------------------- persistence atomics
+class TestAtomicPersistence:
+    @pytest.fixture(scope="class")
+    def trained(self, tmp_path_factory):
+        uid_util.reset()
+        ds = _binary_ds(n=120, seed=8)
+        pred, _ = _graph(ds, seed=13)
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        )
+        return ds, pred, model
+
+    def test_save_leaves_no_temp_dirs(self, trained, tmp_path):
+        _, _, model = trained
+        path = str(tmp_path / "model")
+        model.save(path)
+        model.save(path)  # overwrite goes through the same atomic swap
+        siblings = os.listdir(tmp_path)
+        assert siblings == ["model"]
+        assert sorted(os.listdir(path)) == ["arrays.npz", "manifest.json"]
+
+    def test_load_names_missing_manifest(self, trained, tmp_path):
+        with pytest.raises(ModelLoadError, match="manifest.json"):
+            WorkflowModel.load(str(tmp_path / "nothing-here"))
+
+    def test_load_names_corrupt_arrays(self, trained, tmp_path):
+        _, _, model = trained
+        path = str(tmp_path / "model")
+        model.save(path)
+        FaultPlan.truncate_file(os.path.join(path, "arrays.npz"), keep=8)
+        with pytest.raises(ModelLoadError, match="arrays.npz"):
+            WorkflowModel.load(path)
+
+    def test_load_names_missing_member(self, trained, tmp_path):
+        _, _, model = trained
+        path = str(tmp_path / "model")
+        model.save(path)
+        # arrays.npz valid as a zip but stripped of every model member: the
+        # torn-write shape that used to surface as a raw KeyError
+        np.savez(os.path.join(path, "arrays.npz"), dummy=np.zeros(1))
+        with pytest.raises(ModelLoadError, match="missing member"):
+            WorkflowModel.load(path)
+
+    def test_roundtrip_still_scores_identically(self, trained, tmp_path):
+        ds, pred, model = trained
+        path = str(tmp_path / "model")
+        model.save(path)
+        loaded = WorkflowModel.load(path)
+        s1 = model.score(dataset=ds)[pred.name]
+        s2 = loaded.score(dataset=ds)[pred.name]
+        np.testing.assert_array_equal(
+            np.asarray(s1.prediction), np.asarray(s2.prediction)
+        )
+
+
+# ------------------------------------------------------------- AOT recovery
+class TestCorruptAotBlob:
+    def test_truncated_blob_is_deleted_and_recompiled(self, tmp_path, monkeypatch):
+        import jax
+
+        from transmogrifai_tpu.utils import aot
+
+        monkeypatch.setattr(aot, "_exec_dir", lambda: str(tmp_path))
+        fn = jax.jit(lambda a: a * 2.0)
+        args = (np.arange(4, dtype=np.float32),)
+        key = aot._key("resilience_test", args, {})
+        path = os.path.join(tmp_path, f"{aot._version_salt()}-{key}.jaxexec")
+
+        # garbage bytes: not even a pickle
+        with open(path, "wb") as fh:
+            fh.write(b"\x00garbage-not-a-pickle")
+        out = aot.aot_call("resilience_test", fn, args, {})
+        np.testing.assert_allclose(np.asarray(out), args[0] * 2.0)
+
+    def test_acquire_banked_guards_valid_pickle_wrong_payload(self, tmp_path):
+        from transmogrifai_tpu.utils import aot
+
+        path = str(tmp_path / "x.jaxexec")
+        with open(path, "wb") as fh:
+            fh.write(pickle.dumps({"not": "an executable"}))
+        assert aot._acquire_banked(path, "n", "k") is None
+        assert not os.path.exists(path)  # deleted, so first-use re-saves
+
+
+# ----------------------------------------------------------- score-time guard
+class TestScoreGuards:
+    @pytest.fixture(scope="class")
+    def trained(self):
+        uid_util.reset()
+        ds = _binary_ds(n=120, seed=15)
+        pred, _ = _graph(ds, seed=17)
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        )
+        return ds, pred, model
+
+    def test_nan_prediction_falls_back_to_default(self, trained):
+        from transmogrifai_tpu.local.scoring import score_function
+
+        ds, pred, model = trained
+        rows = ds.rows()[:4]
+        plan = FaultPlan().nan_output(pred.name, rows=(0,))
+        fn = score_function(model)
+        with installed(plan):
+            out = fn.batch(rows)
+        assert plan.fired == [("nan", pred.name)]
+        # degraded row 0: default prediction + uniform probabilities
+        assert out[0][pred.name]["prediction"] == 0.0
+        assert out[0][pred.name]["probability_0"] == pytest.approx(0.5)
+        # other rows untouched
+        assert np.isfinite(out[1][pred.name]["prediction"])
+        assert fn.guard.counts[pred.name] == 1
+        assert fn.metadata()["scoreGuard"]["guardedRows"] == 1
+
+    def test_guard_raise_mode_escalates(self, trained):
+        from transmogrifai_tpu.local.scoring import score_function
+
+        ds, pred, model = trained
+        rows = ds.rows()[:2]
+        plan = FaultPlan().nan_output(pred.name, rows=(0,))
+        fn = score_function(model, guard=ScoreGuard(fallback="raise"))
+        with installed(plan):
+            with pytest.raises(ScoreGuardError, match="non-finite"):
+                fn.batch(rows)
+
+    def test_padding_replicas_do_not_inflate_counters(self, trained):
+        from transmogrifai_tpu.local.scoring import score_function
+
+        ds, pred, model = trained
+        rows = ds.rows()[:3]  # bucket pads 3 -> 4 by replicating row 0
+        plan = FaultPlan().nan_output(pred.name, rows=(0, 3))
+        fn = score_function(model)
+        with installed(plan):
+            out = fn.batch(rows)
+        # row 0 real + row 3 padded replica corrupted: counter reports 1
+        assert fn.metadata()["scoreGuard"]["guardedRows"] == 1
+        assert out[0][pred.name]["prediction"] == 0.0
+
+    def test_guard_off_passes_nan_through(self, trained):
+        from transmogrifai_tpu.local.scoring import score_function
+
+        ds, pred, model = trained
+        rows = ds.rows()[:2]
+        plan = FaultPlan().nan_output(pred.name, rows=(0,))
+        fn = score_function(model, guard=ScoreGuard(fallback="off"))
+        with installed(plan):
+            out = fn.batch(rows)
+        assert np.isnan(out[0][pred.name]["prediction"])
+
+
+# ------------------------------------------------------------- reader retry
+class TestReaderRetry:
+    def test_transient_reads_retry(self):
+        ds = _binary_ds(n=24, seed=19)
+        resp, preds = from_dataset(ds, response="label")
+
+        class Flaky(SimpleReader):
+            calls = 0
+
+            def read_records(self):
+                Flaky.calls += 1
+                if Flaky.calls <= 2:
+                    raise TransientError("blip")
+                return self._records
+
+        reader = Flaky(ds.rows())
+        reader.retry_policy, clk = fast_policy(max_attempts=3)
+        out = reader.generate_dataset([resp, *preds])
+        assert out.num_rows == 24
+        assert Flaky.calls == 3 and len(clk.sleeps) == 2
+
+    def test_fatal_read_fails_immediately(self):
+        ds = _binary_ds(n=8, seed=20)
+        resp, preds = from_dataset(ds, response="label")
+
+        class Broken(SimpleReader):
+            def read_records(self):
+                raise ValueError("schema mismatch")
+
+        reader = Broken(ds.rows())
+        reader.retry_policy, clk = fast_policy(max_attempts=5)
+        with pytest.raises(ValueError, match="schema mismatch"):
+            reader.generate_dataset([resp, *preds])
+        assert clk.sleeps == []

@@ -13,11 +13,19 @@ length (single-row calls always hit the size-1 program).
 
 For throughput, ``score_function(model)(...)`` exposes ``.batch`` accepting
 a list of dicts scored as one columnar batch.
+
+Graceful degradation (resilience/): every stage output passes through a
+``ScoreGuard`` — rows that come out NaN/Inf are replaced with deterministic
+defaults (or escalated, per stage) instead of crashing the serving path or
+silently polluting downstream results; degraded-row counters surface on
+``score_fn.guard`` / ``score_fn.metadata()``.
 """
 from __future__ import annotations
 
 from typing import Any, Callable
 
+from ..resilience import faults
+from ..resilience.guards import ScoreGuard
 from ..types.columns import column_from_values
 from ..workflow.workflow import WorkflowModel
 
@@ -37,11 +45,18 @@ def _bucket(n: int) -> int:
 
 def score_function(
     model: WorkflowModel,
+    guard: ScoreGuard | None = None,
 ) -> Callable[[dict[str, Any]], dict[str, Any]]:
     """Returns ``row_dict -> result_dict`` (model.scoreFunction,
     OpWorkflowModelLocal.scala:79). Result keys are the result-feature names;
     Prediction features expand to their reference map keys
-    (prediction/probability_*/rawPrediction_*)."""
+    (prediction/probability_*/rawPrediction_*).
+
+    ``guard`` configures NaN/Inf containment per stage (default: replace
+    bad rows with defaults and count them; pass
+    ``ScoreGuard(fallback="raise")`` to escalate, or ``"off"`` to opt out).
+    The installed guard is exposed as ``score_fn.guard`` and its counters
+    via ``score_fn.metadata()``."""
     from ..workflow.dag import compute_dag
 
     from ..stages.base import Estimator
@@ -68,6 +83,24 @@ def score_function(
         raise ValueError(
             f"stage plan does not produce result feature(s) {missing}"
         )
+    guard = guard if guard is not None else ScoreGuard()
+    result_name_set = set(result_names)
+
+    def _guarded(t, col, num_rows):
+        """Per-stage output: fault-injection hook, then the NaN/Inf guard
+        (default scope guards result-feature outputs only, so intermediate
+        columns match batch WorkflowModel.score bit for bit; ``num_rows``
+        keeps bucket-padding replicas out of the degradation counters)."""
+        fault_plan = faults.active()
+        if fault_plan is not None:
+            corrupted = fault_plan.on_stage_output(t, col)
+            if corrupted is not None:
+                col = corrupted
+        return guard.apply(
+            t, col,
+            is_result=t.output_name in result_name_set,
+            num_rows=num_rows,
+        )
 
     def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
         n = len(rows)
@@ -87,7 +120,9 @@ def score_function(
             cols[f.name] = column_from_values(f.ftype, vals)
         for t in plan:
             ins = [cols[name] for name in t.input_names]
-            cols[t.output_name] = t.transform_columns(*ins, num_rows=b)
+            cols[t.output_name] = _guarded(
+                t, t.transform_columns(*ins, num_rows=b), n
+            )
         out: list[dict[str, Any]] = [{} for _ in range(n)]
         for name in result_names:
             # to_list renders Prediction columns as reference-keyed maps
@@ -127,7 +162,9 @@ def score_function(
             cols[f.name] = c if pad is None else c.take(pad)
         for t in plan:
             ins = [cols[name] for name in t.input_names]
-            cols[t.output_name] = t.transform_columns(*ins, num_rows=b)
+            cols[t.output_name] = _guarded(
+                t, t.transform_columns(*ins, num_rows=b), n
+            )
         keep = np.arange(n)
         return {
             name: (cols[name] if b == n else cols[name].take(keep))
@@ -137,6 +174,12 @@ def score_function(
     def score_one(row: dict[str, Any]) -> dict[str, Any]:
         return score_batch([row])[0]
 
+    def metadata() -> dict[str, Any]:
+        """Score-path health metadata: degradation counters from the guard."""
+        return {"scoreGuard": guard.stats()}
+
     score_one.batch = score_batch  # type: ignore[attr-defined]
     score_one.columns = score_columns  # type: ignore[attr-defined]
+    score_one.guard = guard  # type: ignore[attr-defined]
+    score_one.metadata = metadata  # type: ignore[attr-defined]
     return score_one

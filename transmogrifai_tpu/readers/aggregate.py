@@ -187,7 +187,7 @@ class AggregateReader(DataReader):
     def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
         ts_fn = self.params.timestamp_fn
         groups: dict[str, list[tuple[int, Any]]] = {}
-        for r in self.read_records():
+        for r in self._read_records_with_retry():
             groups.setdefault(self.key_fn(r), []).append(
                 (ts_fn(r) if ts_fn else 0, r)
             )
@@ -261,7 +261,7 @@ class ConditionalReader(DataReader):
         p = self.params
         rng = random.Random(p.seed)
         groups: dict[str, list[tuple[int, Any]]] = {}
-        for r in self.read_records():
+        for r in self._read_records_with_retry():
             groups.setdefault(self.key_fn(r), []).append((p.timestamp_fn(r), r))
         keys, cutoffs = [], []
         now_ms = int(time.time() * 1000)

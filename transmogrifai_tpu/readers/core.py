@@ -20,15 +20,34 @@ from ..features.feature import Feature, FeatureGeneratorStage
 class DataReader:
     """Base reader (DataReader.scala:57)."""
 
+    #: retry policy for record I/O — None picks the module default
+    #: (resilience.retry.default_io_policy): transient errors (flaky
+    #: network/disk) back off and retry, real errors fail immediately
+    retry_policy = None
+
     def __init__(self, key_fn: Callable[[Any], str] | None = None):
         self.key_fn = key_fn
 
     def read_records(self) -> Iterable[Any]:  # pragma: no cover - abstract
         raise NotImplementedError
 
+    def _read_records_with_retry(self) -> list[Any]:
+        from ..resilience.retry import default_io_policy
+
+        policy = self.retry_policy or default_io_policy()
+        records, attempts = policy.call(lambda: list(self.read_records()))
+        if attempts > 1:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "reader %s succeeded after %d attempts",
+                type(self).__name__, attempts,
+            )
+        return records
+
     def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
         """readDataset + generateRow (DataReader.scala:106,190)."""
-        records = list(self.read_records())
+        records = self._read_records_with_retry()
         cols = {}
         for f in raw_features:
             stage = f.origin_stage

@@ -1,0 +1,23 @@
+"""Fault tolerance for training and scoring (ISSUE 1).
+
+Four pieces, wired through the workflow stack:
+
+* :mod:`.retry` — ``RetryPolicy``: exponential backoff + seeded jitter +
+  deadline over transient-classified errors, with an injectable clock;
+* :mod:`.checkpoint` — ``CheckpointManager``: atomic per-layer fitted-stage
+  checkpoints and per-candidate CV checkpoints (manifest+npz format);
+* :mod:`.faults` — ``FaultPlan``: deterministic seeded fault injection
+  (fit failures, mid-DAG crashes, NaN corruption, torn files);
+* :mod:`.guards` — ``ScoreGuard``: NaN/Inf containment at score time with
+  per-stage fallback and degradation counters.
+"""
+from .checkpoint import CheckpointError, CheckpointManager, dag_signature  # noqa: F401
+from .faults import FaultPlan, SimulatedCrash, installed  # noqa: F401
+from .guards import ScoreGuard, ScoreGuardError  # noqa: F401
+from .retry import (  # noqa: F401
+    FatalError,
+    RetryPolicy,
+    TransientError,
+    default_io_policy,
+    is_transient,
+)

@@ -1,0 +1,237 @@
+"""Deterministic fault injection for the training and scoring stack.
+
+A ``FaultPlan`` is a seeded, declarative script of failures — "raise on the
+Nth estimator fit", "die after layer k was checkpointed", "corrupt this
+stage's output with NaN" — installed process-globally (``installed(plan)``)
+and consulted from cheap hooks inside ``workflow/fit.py``,
+``selector/validators.py`` and ``local/scoring.py``. Because every firing
+is counted, the same plan replays the same failure sequence on every run:
+the recovery paths (checkpoint/resume, retry-with-backoff, score-time
+guards) are exercised deterministically in tier-1, no flaky process
+killing required.
+
+``SimulatedCrash`` derives from ``BaseException`` on purpose: it models a
+process death (preemption, OOM-kill) and must sail through every
+``except Exception`` failure-isolation layer the way a real SIGKILL would.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import Any, Iterator
+
+import numpy as np
+
+from .retry import FatalError, TransientError
+
+log = logging.getLogger(__name__)
+
+
+class SimulatedCrash(BaseException):
+    """Process-equivalent death: not an Exception, so candidate isolation
+    and other broad handlers cannot swallow it."""
+
+
+def _matches(stage: Any, target: str) -> bool:
+    """A target names a stage by uid, class name, operation name, or output
+    column name."""
+    if target == stage.uid or target == type(stage).__name__:
+        return True
+    if target == getattr(stage, "operation_name", None):
+        return True
+    try:
+        return target == stage.output_name
+    except Exception:
+        return False
+
+
+class FaultPlan:
+    """Seeded script of injectable failures; every fault fires a bounded
+    number of ``times`` and every firing lands in ``self.fired`` for test
+    assertions."""
+
+    def __init__(self, seed: int = 42):
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._fit_count = 0
+        self._stage_fit_faults: list[dict[str, Any]] = []
+        self._candidate_faults: list[dict[str, Any]] = []
+        self._crash_layers: list[dict[str, Any]] = []
+        self._nan_faults: list[dict[str, Any]] = []
+        #: chronological record of fired faults: (kind, detail)
+        self.fired: list[tuple[str, str]] = []
+
+    # ------------------------------------------------------------ configure
+    def fail_stage_fit(
+        self,
+        target: str | None = None,
+        nth: int | None = None,
+        times: int = 1,
+        transient: bool = True,
+    ) -> "FaultPlan":
+        """Raise when a matching estimator fit starts: ``target`` selects by
+        uid/class/operation/output name, ``nth`` by the global 1-based fit
+        counter. Transient faults raise ``TransientError`` (retryable);
+        fatal ones raise ``FatalError``."""
+        self._stage_fit_faults.append(
+            {"target": target, "nth": nth, "times": times, "count": 0,
+             "transient": transient}
+        )
+        return self
+
+    def crash_after_layer(self, layer_index: int, times: int = 1) -> "FaultPlan":
+        """Raise ``SimulatedCrash`` after layer ``layer_index`` finished
+        (and, when checkpointing, was persisted) — the mid-DAG kill."""
+        self._crash_layers.append(
+            {"layer": layer_index, "times": times, "count": 0}
+        )
+        return self
+
+    def fail_candidate(
+        self, model_name: str, times: int = 1, transient: bool = True
+    ) -> "FaultPlan":
+        """Raise when the named model family starts a CV sweep attempt."""
+        self._candidate_faults.append(
+            {"target": model_name, "times": times, "count": 0,
+             "transient": transient}
+        )
+        return self
+
+    def nan_output(
+        self, target: str, rows: tuple[int, ...] = (0,), times: int = 1
+    ) -> "FaultPlan":
+        """Overwrite the given rows of a matching stage's output column with
+        NaN (numeric / vector / prediction columns)."""
+        self._nan_faults.append(
+            {"target": target, "rows": tuple(rows), "times": times, "count": 0}
+        )
+        return self
+
+    @staticmethod
+    def truncate_file(path: str, keep: int = 20) -> None:
+        """Tear a checkpoint / AOT blob the way a killed writer would."""
+        with open(path, "r+b") as fh:
+            fh.truncate(keep)
+
+    # ----------------------------------------------------------------- hooks
+    # every check-then-increment of a fault's firing count holds the plan
+    # lock: CV candidates run on a thread pool, and a times=1 fault racing
+    # two threads must still fire exactly once (determinism is the product)
+
+    def on_stage_fit(self, stage: Any) -> None:
+        with self._lock:
+            self._fit_count += 1
+            n = self._fit_count
+            for f in self._stage_fit_faults:
+                if f["count"] >= f["times"]:
+                    continue
+                if f["nth"] is not None and f["nth"] != n:
+                    continue
+                if f["target"] is not None and not _matches(stage, f["target"]):
+                    continue
+                f["count"] += 1
+                self.fired.append(("fit", stage.uid))
+                exc = TransientError if f["transient"] else FatalError
+                raise exc(
+                    f"injected fit failure on {type(stage).__name__}({stage.uid})"
+                )
+
+    def on_layer_end(self, layer_index: int) -> None:
+        with self._lock:
+            for f in self._crash_layers:
+                if f["count"] >= f["times"] or f["layer"] != layer_index:
+                    continue
+                f["count"] += 1
+                self.fired.append(("crash", f"layer-{layer_index}"))
+                raise SimulatedCrash(
+                    f"injected crash after layer {layer_index}"
+                )
+
+    def on_candidate_fit(self, est: Any) -> None:
+        name = type(est).__name__
+        with self._lock:
+            for f in self._candidate_faults:
+                if f["count"] >= f["times"] or f["target"] != name:
+                    continue
+                f["count"] += 1
+                self.fired.append(("candidate", name))
+                exc = TransientError if f["transient"] else FatalError
+                raise exc(f"injected candidate failure on {name}")
+
+    def on_stage_output(self, stage: Any, column: Any) -> Any | None:
+        """Return a corrupted replacement column, or None to keep the
+        original."""
+        with self._lock:
+            targets = [
+                f for f in self._nan_faults
+                if f["count"] < f["times"] and _matches(stage, f["target"])
+            ]
+            for f in targets:
+                corrupted = _inject_nan(column, f["rows"])
+                if corrupted is None:
+                    continue  # column type has no float plane to corrupt
+                f["count"] += 1
+                self.fired.append(("nan", stage.output_name))
+                return corrupted
+        return None
+
+
+def _inject_nan(column: Any, rows: tuple[int, ...]) -> Any | None:
+    import dataclasses
+
+    from ..types.columns import NumericColumn, PredictionColumn, VectorColumn
+
+    idx = [r for r in rows if r < len(column)]
+    if not idx:
+        return None
+    if isinstance(column, NumericColumn):
+        if not np.issubdtype(column.values.dtype, np.floating):
+            return None
+        vals = np.array(column.values, copy=True)
+        vals[idx] = np.nan
+        return dataclasses.replace(column, values=vals)
+    if isinstance(column, VectorColumn):
+        if column.is_sparse:
+            return None
+        vals = np.array(np.asarray(column.values), copy=True)
+        vals[idx, :] = np.nan
+        return dataclasses.replace(column, values=vals)
+    if isinstance(column, PredictionColumn):
+        pred = np.array(column.prediction, copy=True)
+        pred[idx] = np.nan
+        prob = column.probability
+        if prob is not None:
+            prob = np.array(prob, copy=True)
+            prob[idx, :] = np.nan
+        return dataclasses.replace(column, prediction=pred, probability=prob)
+    return None
+
+
+# --------------------------------------------------------------- installation
+_ACTIVE: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a FaultPlan is already installed")
+    _ACTIVE = plan
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> FaultPlan | None:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def installed(plan: FaultPlan) -> Iterator[FaultPlan]:
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()

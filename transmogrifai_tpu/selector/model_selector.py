@@ -262,6 +262,12 @@ class ModelSelector(PredictorEstimator):
         #: set by workflow-level CV (workflow/cv.py): validation already ran
         #: with per-fold DAG refits, so fit skips the internal validator
         self.precomputed_results: list | None = None
+        #: set by Workflow.train(checkpoint_dir=...): a resilience
+        #: CheckpointManager; the validator checkpoints per-candidate sweep
+        #: results there so a resumed selection re-runs only unfinished ones
+        #: (_checkpoint_resume gates CONSUMING them — writes always happen)
+        self._checkpoint = None
+        self._checkpoint_resume = False
 
     def get_params(self):
         return {
@@ -289,6 +295,7 @@ class ModelSelector(PredictorEstimator):
         if self.splitter is not None and not isinstance(self.splitter, DataCutter):
             final_mask = self.splitter.prepare(yt).astype(np.float32)
 
+        attempt_info: list = []
         if self.precomputed_results is not None:
             # consume-once: stale fold metrics must not leak into a later
             # re-train on different data
@@ -299,8 +306,13 @@ class ModelSelector(PredictorEstimator):
             results = self.validator.validate(
                 self.models, xt, yt, self.evaluator,
                 extra_masks=[final_mask],
+                checkpoint=self._checkpoint,
+                resume=self._checkpoint_resume,
             )
             prefit = getattr(self.validator, "last_extra_models", {})
+            attempt_info = list(
+                getattr(self.validator, "last_attempt_info", [])
+            )
         best = Validator.best(results, self.evaluator)
         log.info(
             "ModelSelector best: %s %s (%s=%.4f over %d candidates)",
@@ -379,6 +391,7 @@ class ModelSelector(PredictorEstimator):
             "bestModelType": best.model_name,
             "bestGrid": best.grid,
             "validationResults": [r.to_json() for r in results],
+            "candidateAttempts": attempt_info,
             "trainEvaluation": train_metrics,
             "extraTrainEvaluations": extra_train,
             "holdoutEvaluation": None,

@@ -15,6 +15,8 @@ legacy fallback, and families with neither hook fit sequentially.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import logging
 from typing import Any, Sequence
 
@@ -22,6 +24,8 @@ import numpy as np
 
 from ..evaluators.base import Evaluator
 from ..models.base import PredictorEstimator, PredictorModel
+from ..resilience import faults
+from ..resilience.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
 
@@ -55,14 +59,78 @@ def expand_grid(grid: dict[str, Sequence[Any]]) -> list[dict[str, Any]]:
     return points
 
 
+def _data_fingerprint(x: np.ndarray, y: np.ndarray) -> str:
+    """Cheap content fingerprint of the sweep's training arrays, so a CV
+    checkpoint recorded against one dataset can never answer for another.
+    Bounded sampling via resilience.checkpoint.update_array_sample — never
+    a full-array scan/copy for big data."""
+    from ..resilience.checkpoint import update_array_sample
+
+    h = hashlib.sha256()
+    for a in (x, y):
+        update_array_sample(h, a)
+    return h.hexdigest()[:16]
+
+
+def _folds_fingerprint(
+    folds: Sequence[tuple[np.ndarray, np.ndarray]]
+) -> str:
+    """Fingerprint of the actual fold masks — covers every split-shaping
+    knob (validator class, seed, num_folds, stratify, train ratio) at once,
+    so checkpointed fold metrics can never answer for a differently-split
+    resume."""
+    h = hashlib.sha256()
+    for train_mask, val_mask in folds:
+        h.update(np.packbits(np.asarray(train_mask, dtype=bool)).tobytes())
+        h.update(np.packbits(np.asarray(val_mask, dtype=bool)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _candidate_key(
+    index: int,
+    est: PredictorEstimator,
+    points: list[dict[str, Any]],
+    folds_fp: str,
+    evaluator: Evaluator,
+    data_fp: str,
+) -> str:
+    """Stable checkpoint key for one candidate family's sweep: the family
+    class + position + a hash of (grid points, fold masks, metric, data
+    fingerprint). Uids are process-local, so they stay out of the key on
+    purpose — a resumed process regenerates them but the sweep identity is
+    unchanged."""
+    blob = json.dumps(
+        {
+            "model": type(est).__name__,
+            "points": points,
+            "folds": folds_fp,
+            "metric": evaluator.default_metric,
+            "data": data_fp,
+        },
+        sort_keys=True,
+        default=str,
+    )
+    digest = hashlib.sha256(blob.encode()).hexdigest()[:12]
+    return f"{type(est).__name__}-{index}-{digest}"
+
+
 class Validator:
     """Shared candidate-sweep logic; subclasses provide the fold masks."""
+
+    #: retry policy for candidate sweeps: transient failures (preempted
+    #: device, torn I/O) back off and retry BEFORE the candidate-exclusion
+    #: path; fatal errors (bad grid, shape mismatch) exclude immediately
+    retry_policy: RetryPolicy = RetryPolicy(max_attempts=3, base_delay=0.25,
+                                            max_delay=2.0)
 
     def __init__(self, seed: int = 42):
         self.seed = seed
         #: family_uid -> (points, models[extra_mask_i][point_i]) from the
         #: last validate(extra_masks=...) call — pre-fitted refit lanes
         self.last_extra_models: dict[str, tuple[list, list]] = {}
+        #: per-candidate attempt accounting from the last validate() call:
+        #: [{modelName, modelUID, attempts, error, excluded, fromCheckpoint}]
+        self.last_attempt_info: list[dict[str, Any]] = []
 
     def split_masks(self, y: np.ndarray) -> list[tuple[np.ndarray, np.ndarray]]:
         raise NotImplementedError
@@ -81,6 +149,8 @@ class Validator:
         y: np.ndarray,
         evaluator: Evaluator,
         extra_masks: Sequence[np.ndarray] = (),
+        checkpoint=None,
+        resume: bool = False,
     ) -> list[CandidateResult]:
         """Fit every model family x grid point on every fold; returns results
         with per-fold metric values. Failed families are skipped
@@ -93,13 +163,27 @@ class Validator:
         refit program to acquire/execute). Results land in
         ``self.last_extra_models[family_uid] = (points, models)`` with
         ``models[mask_i][point_i]``; families without the batched-masks
-        hook are omitted (the selector falls back to a direct refit)."""
+        hook are omitted (the selector falls back to a direct refit).
+
+        ``checkpoint`` (a resilience.CheckpointManager) persists each
+        finished family's fold metrics; with ``resume=True`` a matching
+        entry (same grid/fold-masks/metric AND data fingerprint) is
+        consumed so only unfinished candidates re-run — a fresh train
+        always re-sweeps. A checkpoint hit skips the family's sweep
+        entirely, so its ``extra_masks`` refit lanes stay empty and the
+        selector pays one direct refit of the winner (metrics are the
+        expensive part; that trade is deliberate). Transient per-candidate
+        failures retry under ``self.retry_policy`` before the exclusion
+        path, and attempt counts land in ``self.last_attempt_info``."""
         from concurrent.futures import ThreadPoolExecutor
 
         folds = self.split_masks(y)
+        data_fp = _data_fingerprint(x, y) if checkpoint is not None else ""
+        folds_fp = _folds_fingerprint(folds) if checkpoint is not None else ""
         results: list[CandidateResult] = []
         errors: list[str] = []
         self.last_extra_models: dict[str, tuple[list, list]] = {}
+        self.last_attempt_info = []
 
         # grids expand ONCE, defensively: a malformed grid must stay a
         # per-candidate failure (caught at f.result() below), never abort
@@ -111,13 +195,50 @@ class Validator:
             except Exception as e:
                 points_list.append(e)
 
-        def run(est, points):
+        def run(i, est, points):
+            """One candidate: checkpoint hit, or retried sweep + save.
+            Returns (CandidateResults, attempts, from_checkpoint)."""
             if isinstance(points, Exception):
                 raise points
-            return self._sweep_family(
-                est, points, folds, x, y, evaluator,
-                extra_masks=extra_masks,
+            key = None
+            if checkpoint is not None:
+                key = _candidate_key(
+                    i, est, points, folds_fp, evaluator, data_fp
+                )
+            if key is not None and resume:
+                cached = checkpoint.load_candidate(key)
+                if cached is not None and len(
+                    cached.get("metricValues", [])
+                ) == len(points):
+                    out = [
+                        CandidateResult(
+                            model_name=type(est).__name__,
+                            model_uid=est.uid,
+                            grid=points[gi],
+                            metric_values=list(cached["metricValues"][gi]),
+                        )
+                        for gi in range(len(points))
+                    ]
+                    log.info(
+                        "CV checkpoint hit: %s (%d points)", key, len(points)
+                    )
+                    return out, int(cached.get("attempts", 1)), True
+            out, attempts = self.retry_policy.call(
+                lambda: self._sweep_family(
+                    est, points, folds, x, y, evaluator,
+                    extra_masks=extra_masks,
+                )
             )
+            if key is not None:
+                checkpoint.save_candidate(
+                    key,
+                    {
+                        "modelName": type(est).__name__,
+                        "metricValues": [r.metric_values for r in out],
+                        "attempts": attempts,
+                    },
+                )
+            return out, attempts, False
 
         import jax
 
@@ -149,7 +270,7 @@ class Validator:
             futs_by_cand = {}
             for i in order:
                 est, _ = candidates[i]
-                futs_by_cand[i] = pool.submit(run, est, points_list[i])
+                futs_by_cand[i] = pool.submit(run, i, est, points_list[i])
             outs = []
             for i in range(len(candidates)):
                 try:
@@ -157,13 +278,33 @@ class Validator:
                 except Exception as e:
                     outs.append(e)
         for (est, _), out in zip(candidates, outs):
+            name = type(est).__name__
             if isinstance(out, Exception):  # candidate-level isolation
+                attempts = getattr(out, "_retry_attempts", 1)
                 log.warning(
-                    "Model %s failed validation: %s", type(est).__name__, out
+                    "Model %s failed validation after %d attempt(s): %s",
+                    name, attempts, out,
                 )
-                errors.append(f"{type(est).__name__}: {out}")
+                errors.append(f"{name}: {out}")
+                self.last_attempt_info.append({
+                    "modelName": name,
+                    "modelUID": est.uid,
+                    "attempts": attempts,
+                    "error": str(out),
+                    "excluded": True,
+                    "fromCheckpoint": False,
+                })
             else:
-                results.extend(out)
+                cand_results, attempts, from_ckpt = out
+                results.extend(cand_results)
+                self.last_attempt_info.append({
+                    "modelName": name,
+                    "modelUID": est.uid,
+                    "attempts": attempts,
+                    "error": None,
+                    "excluded": False,
+                    "fromCheckpoint": from_ckpt,
+                })
         if not results:
             raise RuntimeError(
                 f"All model candidates failed validation: {errors}"
@@ -182,6 +323,11 @@ class Validator:
     ) -> list[CandidateResult]:
         import os
 
+        plan = faults.active()
+        if plan is not None:
+            # inside the retried region: each retry attempt re-consults the
+            # plan, so "fails twice then succeeds" scripts exactly
+            plan.on_candidate_fit(est)
         per_point_values: list[list[float]] = [[] for _ in points]
         batched_masks = getattr(est, "fit_arrays_batched_masks", None)
         if os.environ.get("TPTPU_BATCHED_FITS") == "0":

@@ -431,6 +431,29 @@ class RandomVector:
         return RandomGenerator(T.OPVector, producer, seed=seed)
 
 
+# -------------------------------------------------------------- fault testkit
+def fault_plan(seed: int = 42) -> "Any":
+    """A fresh resilience ``FaultPlan`` — the deterministic fault-injection
+    harness (raise on the Nth fit, crash after a layer, NaN a stage output,
+    tear a file). Install it over a block with ``install_faults``::
+
+        plan = testkit.fault_plan().crash_after_layer(1)
+        with testkit.install_faults(plan):
+            workflow.train(checkpoint_dir=d)   # dies after layer 1
+    """
+    from .resilience.faults import FaultPlan
+
+    return FaultPlan(seed=seed)
+
+
+def install_faults(plan: "Any"):
+    """Context manager installing a FaultPlan process-globally (see
+    resilience.faults.installed)."""
+    from .resilience.faults import installed
+
+    return installed(plan)
+
+
 # ----------------------------------------------------------------- RandomData
 def random_dataset(
     generators: dict[str, RandomGenerator], n: int, seed: int | None = None

@@ -133,19 +133,46 @@ def _key(name: str, args: tuple, statics: dict) -> str:
 
 
 def _load_exec(path: str):
-    """pickle → deserialize_and_load → callable, or None."""
+    """pickle → deserialize_and_load → callable; raises on a corrupt or
+    truncated blob (callers delete-and-recompile)."""
     from jax.experimental import serialize_executable as SE
 
     t0 = _time.monotonic()
     with open(path, "rb") as fh:
-        payload, in_tree, out_tree = pickle.loads(fh.read())
+        blob = pickle.loads(fh.read())
+    if not isinstance(blob, tuple) or len(blob) != 3:
+        # pickle decoded but the payload is not ours — a torn write that
+        # happened to truncate on a valid pickle boundary
+        raise ValueError(f"malformed executable blob (got {type(blob).__name__})")
+    payload, in_tree, out_tree = blob
     compiled = SE.deserialize_and_load(payload, in_tree, out_tree)
     log.info(
         "AOT load %s (%.1f MB) in %.2f s", os.path.basename(path),
         os.path.getsize(path) / 1e6, _time.monotonic() - t0,
     )
-    os.utime(path)  # recency marker for pruning
+    try:
+        os.utime(path)  # recency marker for pruning
+    except OSError:
+        pass
     return lambda *a: compiled(*a)
+
+
+def _acquire_banked(path: str, name: str, key: str):
+    """Lazy (non-prewarm) acquire of a banked executable, guarded the same
+    way ``prewarm`` guards its loads: a corrupt/truncated ``.jaxexec`` is
+    deleted so the caller recompiles, instead of crashing the sweep thread
+    that happened to touch it first. Returns a callable or None."""
+    if not os.path.exists(path):
+        return None
+    try:
+        return _load_exec(path)
+    except Exception as e:
+        log.info("AOT executable %s unusable (%s); removing", key, e)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
 
 
 def prewarm(max_workers: int = 8, max_bytes: int = 32_000_000) -> int:
@@ -233,15 +260,16 @@ def aot_call(
         path = os.path.join(
             _exec_dir(), f"{_version_salt()}-{key}.jaxexec"
         )
-        if os.path.exists(path):
+        call = _acquire_banked(path, name, key)
+        if call is not None:
             try:
-                call = _load_exec(path)
                 out = call(*args)
                 with _LOCK:
                     _MEM[key] = call
                 return out
             except Exception as e:
-                # corrupt/stale blob: remove it so a future first-use
+                # blob deserialized but the executable is broken (stale
+                # runtime, torn payload): remove it so a future first-use
                 # re-saves instead of permanently disabling the cache
                 log.info("AOT executable %s unusable (%s); removing", key, e)
                 try:

@@ -6,6 +6,12 @@ on the current dataset, then apply all of the layer's (fitted) transformers.
 The reference bulk-applies row-level transformers in one RDD map; here a
 layer's transforms append columns to the columnar Dataset (the numeric plane
 stays in arrays; XLA fusion happens in the compiled scoring path).
+
+Fault tolerance (resilience/): when a ``CheckpointManager`` is supplied,
+every completed layer's fitted stages are persisted atomically, so a killed
+run resumes via the ``prefitted`` warm-start seam instead of refitting the
+whole DAG. An installed ``FaultPlan`` gets a hook before each estimator
+fit, after each transform, and at each layer boundary.
 """
 from __future__ import annotations
 
@@ -13,6 +19,7 @@ from typing import Iterable
 
 from ..dataset import Dataset
 from ..features.feature import Feature
+from ..resilience import faults
 from ..stages.base import Estimator, Model, PipelineStage, Transformer
 from .dag import compute_dag
 
@@ -21,17 +28,27 @@ def fit_and_transform_dag(
     dataset: Dataset,
     result_features: Iterable[Feature],
     prefitted: dict[str, PipelineStage] | None = None,
+    checkpoint=None,
 ) -> tuple[Dataset, dict[str, PipelineStage]]:
     """Fit the whole DAG; returns (transformed dataset, fitted stage by
     original-stage uid). Fitted models replace their estimators keyed by the
     estimator uid (FitStagesUtil.scala:251-290). ``prefitted`` supplies
     already-fitted models by estimator uid — those estimators are skipped
-    (warm start, OpWorkflow.withModelStages OpWorkflow.scala:468-472)."""
+    (warm start, OpWorkflow.withModelStages OpWorkflow.scala:468-472).
+    ``checkpoint`` (a resilience.CheckpointManager) persists each completed
+    layer's fitted estimators so an interrupted run can resume."""
     layers = compute_dag(list(result_features))
     fitted: dict[str, PipelineStage] = {}
     prefitted = prefitted or {}
-    for layer in layers:
+    plan = faults.active()
+    signature = None
+    if checkpoint is not None:
+        from ..resilience.checkpoint import dag_signature, dataset_fingerprint
+
+        signature = dag_signature(layers, dataset_fingerprint(dataset))
+    for li, layer in enumerate(layers):
         transformers: list[Transformer] = []
+        newly_fitted = False
         for stage in layer:
             if stage.uid in prefitted:
                 model = prefitted[stage.uid]
@@ -39,9 +56,12 @@ def fit_and_transform_dag(
                 fitted[stage.uid] = model
                 transformers.append(model)
             elif isinstance(stage, Estimator):
+                if plan is not None:
+                    plan.on_stage_fit(stage)
                 model = stage.fit(dataset)
                 fitted[stage.uid] = model
                 transformers.append(model)
+                newly_fitted = True
             elif isinstance(stage, Transformer):
                 fitted[stage.uid] = stage
                 transformers.append(stage)
@@ -49,6 +69,26 @@ def fit_and_transform_dag(
                 raise TypeError(f"Cannot fit {stage}")
         for t in transformers:
             dataset = t.transform(dataset)
+            if plan is not None:
+                corrupted = plan.on_stage_output(t, dataset[t.output_name])
+                if corrupted is not None:
+                    dataset = dataset.with_column(t.output_name, corrupted)
+        if checkpoint is not None and (
+            newly_fitted or not checkpoint.has_layer(li)
+        ):
+            # resume skips re-serializing layers restored intact from disk
+            # (large fitted arrays make that pure wasted compression/IO)
+            checkpoint.save_layer(
+                li,
+                signature,
+                [
+                    (pos, s.uid, fitted[s.uid])
+                    for pos, s in enumerate(layer)
+                    if isinstance(fitted[s.uid], Model)
+                ],
+            )
+        if plan is not None:
+            plan.on_layer_end(li)
     return dataset, fitted
 
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Any
 
 import numpy as np
@@ -26,6 +27,12 @@ import numpy as np
 from .. import types as T
 from ..features.feature import Feature, FeatureGeneratorStage
 from ..stages.base import Model, PipelineStage, Transformer
+
+
+class ModelLoadError(ValueError):
+    """A saved model/checkpoint is missing or corrupt; the message names the
+    offending file or npz member so a torn write is diagnosable."""
+
 
 #: class-name -> class registry for stage reconstruction
 _REGISTRY: dict[str, type] = {}
@@ -83,27 +90,99 @@ def construct_stage(
     return cls(**params)
 
 
+def stage_to_entry(
+    est_uid: str, stage: PipelineStage, arrays_out: dict[str, np.ndarray]
+) -> dict[str, Any]:
+    """One manifest entry for a fitted stage; fitted arrays are collected
+    into ``arrays_out`` keyed ``<stage_uid>__<name>`` (shared by model
+    persistence and layer checkpoints)."""
+    if isinstance(stage, Model):
+        for k, v in stage.get_arrays().items():
+            arrays_out[f"{stage.uid}__{k}"] = np.asarray(v)
+    return {
+        "estimatorUid": est_uid,
+        "class": type(stage).__name__,
+        "uid": stage.uid,
+        "operationName": stage.operation_name,
+        "params": stage.get_params(),
+        "inputFeatures": [f.name for f in stage.input_features],
+        "outputName": stage.output_name,
+        "metadata": stage.metadata,
+    }
+
+
+def stage_arrays_from_npz(npz: Any, uid: str, source: str) -> dict[str, np.ndarray]:
+    """Extract a stage's arrays from an open npz, naming the corrupt member
+    on failure instead of surfacing a raw zlib/KeyError."""
+    prefix = f"{uid}__"
+    out: dict[str, np.ndarray] = {}
+    for k in npz.files:
+        if not k.startswith(prefix):
+            continue
+        try:
+            out[k[len(prefix):]] = npz[k]
+        except Exception as e:
+            raise ModelLoadError(
+                f"{source}: member '{k}' (stage {uid}) is corrupt or "
+                f"truncated: {e}"
+            ) from e
+    return out
+
+
+def construct_stage_checked(
+    entry: dict[str, Any], arrays: dict[str, np.ndarray], source: str
+) -> PipelineStage:
+    """``construct_stage`` with torn-write diagnostics: a KeyError from a
+    stage's ``from_params`` means an expected array member is missing."""
+    try:
+        return construct_stage(entry["class"], entry["params"], arrays)
+    except KeyError as e:
+        raise ModelLoadError(
+            f"{source}: stage {entry['uid']} ({entry['class']}) is missing "
+            f"member {e} — the save was likely torn; delete and refit"
+        ) from e
+
+
+def atomic_write_model_dir(
+    path: str, manifest: dict[str, Any], arrays: dict[str, np.ndarray]
+) -> None:
+    """Write a manifest.json + arrays.npz directory atomically: fill a temp
+    sibling, then swap it in. An existing dir is renamed aside for the swap
+    window (never rmtree'd first), so a kill at any instant leaves either
+    the old complete dir, the new complete dir, or the old one parked at
+    ``<path>.old-<pid>`` — never nothing. Unrelated files the user kept
+    alongside the model (reports, notes) are carried over after the swap.
+    Shared by model persistence and layer checkpoints."""
+    base = path.rstrip(os.sep)
+    tmp = f"{base}.tmp-{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=2, default=_json_default)
+    np.savez_compressed(os.path.join(tmp, "arrays.npz"), **arrays)
+    if os.path.exists(path):
+        old = f"{base}.old-{os.getpid()}"
+        shutil.rmtree(old, ignore_errors=True)
+        os.rename(path, old)
+        os.rename(tmp, path)
+        for entry in os.listdir(old):
+            if entry not in ("manifest.json", "arrays.npz"):
+                os.rename(
+                    os.path.join(old, entry), os.path.join(path, entry)
+                )
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(tmp, path)
+
+
 def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F821
     from .workflow import WorkflowModel  # noqa: F401
 
-    os.makedirs(path, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
-    stages_json: list[dict[str, Any]] = []
-    for est_uid, stage in model.fitted.items():
-        entry = {
-            "estimatorUid": est_uid,
-            "class": type(stage).__name__,
-            "uid": stage.uid,
-            "operationName": stage.operation_name,
-            "params": stage.get_params(),
-            "inputFeatures": [f.name for f in stage.input_features],
-            "outputName": stage.output_name,
-            "metadata": stage.metadata,
-        }
-        if isinstance(stage, Model):
-            for k, v in stage.get_arrays().items():
-                arrays[f"{stage.uid}__{k}"] = np.asarray(v)
-        stages_json.append(entry)
+    stages_json: list[dict[str, Any]] = [
+        stage_to_entry(est_uid, stage, arrays)
+        for est_uid, stage in model.fitted.items()
+    ]
 
     manifest = {
         "version": 1,
@@ -127,9 +206,7 @@ def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F82
         "blocklisted": model.blocklisted,
         "sensitiveFeatures": model.sensitive_info,
     }
-    with open(os.path.join(path, "manifest.json"), "w") as fh:
-        json.dump(manifest, fh, indent=2, default=_json_default)
-    np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
+    atomic_write_model_dir(path, manifest, arrays)
 
 
 def _json_default(o: Any):
@@ -145,9 +222,28 @@ def _json_default(o: Any):
 def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
     from .workflow import WorkflowModel
 
-    with open(os.path.join(path, "manifest.json")) as fh:
-        manifest = json.load(fh)
-    npz = np.load(os.path.join(path, "arrays.npz"), allow_pickle=False)
+    manifest_path = os.path.join(path, "manifest.json")
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    except FileNotFoundError:
+        raise ModelLoadError(
+            f"{path}: no manifest.json — not a saved model directory "
+            "(or the save was interrupted before commit)"
+        ) from None
+    except json.JSONDecodeError as e:
+        raise ModelLoadError(
+            f"{manifest_path} is corrupt or truncated: {e}"
+        ) from e
+    npz_path = os.path.join(path, "arrays.npz")
+    try:
+        npz = np.load(npz_path, allow_pickle=False)
+    except FileNotFoundError:
+        raise ModelLoadError(f"{path}: missing arrays.npz") from None
+    except Exception as e:
+        raise ModelLoadError(
+            f"{npz_path} is corrupt or truncated: {e}"
+        ) from e
 
     raw_features = []
     feature_by_name: dict[str, Feature] = {}
@@ -163,11 +259,8 @@ def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
 
     fitted: dict[str, PipelineStage] = {}
     for entry in manifest["stages"]:
-        prefix = f"{entry['uid']}__"
-        stage_arrays = {
-            k[len(prefix):]: npz[k] for k in npz.files if k.startswith(prefix)
-        }
-        stage = construct_stage(entry["class"], entry["params"], stage_arrays)
+        stage_arrays = stage_arrays_from_npz(npz, entry["uid"], npz_path)
+        stage = construct_stage_checked(entry, stage_arrays, npz_path)
         stage.uid = entry["uid"]
         stage.operation_name = entry["operationName"]
         stage.metadata = entry.get("metadata", {})

@@ -172,11 +172,21 @@ class Workflow:
         data, _ = fit_and_transform_dag(raw, targets, prefitted=self._prefitted)
         return data
 
-    def train(self) -> "WorkflowModel":
+    def train(
+        self,
+        checkpoint_dir: str | None = None,
+        resume: bool = False,
+    ) -> "WorkflowModel":
+        """Fit the DAG. With ``checkpoint_dir``, every completed layer (and
+        every finished CV candidate sweep) is persisted atomically there;
+        ``resume=True`` restores completed layers into the ``prefitted``
+        warm-start dict so only unfinished work re-runs (docs/robustness.md)."""
         if not self.result_features:
             raise ValueError("setResultFeatures must be called before train")
         if self.reader is None:
             raise ValueError("No input data: call set_input_dataset or set_reader")
+        if resume and checkpoint_dir is None:
+            raise ValueError("resume=True requires checkpoint_dir")
         stages = self._stages()
         self._apply_overrides(stages)
         selectors = [s for s in stages if isinstance(s, ModelSelector)]
@@ -235,27 +245,67 @@ class Workflow:
                 train_data = raw.take(train_idx)
                 holdout_data = raw.take(holdout_idx)
 
+        # checkpoint/resume (resilience/): completed layers restore into the
+        # prefitted warm-start dict; the selector checkpoints CV candidates
+        ckpt = None
+        prefitted = dict(self._prefitted)
+        if checkpoint_dir is not None:
+            from ..resilience.checkpoint import (
+                CheckpointManager,
+                dag_signature,
+                dataset_fingerprint,
+            )
+
+            ckpt = CheckpointManager(checkpoint_dir)
+            if resume:
+                layers = compute_dag(self.result_features)
+                signature = dag_signature(
+                    layers, dataset_fingerprint(train_data)
+                )
+                prefitted.update(ckpt.load_layers(signature, layers))
+            else:
+                # fresh train: stale entries from a previous run in the
+                # same dir must never mix into a later crash + resume
+                ckpt.clear()
+            if selector is not None:
+                selector._checkpoint = ckpt
+                # candidate RESULTS are only consumed on an explicit resume;
+                # a fresh train always re-sweeps (and overwrites the files)
+                selector._checkpoint_resume = resume
+
         # every estimator fit below runs under the ambient execution mesh:
         # tree fits shard_map rows with psum'd histograms, solver fits ride
         # GSPMD row sharding; None (single device) = plain jit
         from ..parallel.mesh import use_execution_mesh
 
         mesh = self._resolve_mesh()
-        with use_execution_mesh(mesh):
-            if self._workflow_cv and selector is not None:
-                from .cv import workflow_cv_results
+        try:
+            with use_execution_mesh(mesh):
+                if self._workflow_cv and selector is not None:
+                    from .cv import workflow_cv_results
 
-                selector.precomputed_results = workflow_cv_results(
-                    selector, train_data, prefitted=self._prefitted
-                )
-                log.info(
-                    "Workflow-level CV: %d candidate results from per-fold DAG refits",
-                    len(selector.precomputed_results),
-                )
+                    # NOTE: checkpoint-restored stages deliberately stay OUT
+                    # of the per-fold refits — they were fit on the full
+                    # training split, and prefitting them here would leak
+                    # validation rows into candidate selection; only the
+                    # user's explicit warm-start stages are honored (same
+                    # semantics as an uninterrupted withWorkflowCV train)
+                    selector.precomputed_results = workflow_cv_results(
+                        selector, train_data, prefitted=self._prefitted
+                    )
+                    log.info(
+                        "Workflow-level CV: %d candidate results from per-fold DAG refits",
+                        len(selector.precomputed_results),
+                    )
 
-            fitted_data, fitted = fit_and_transform_dag(
-                train_data, self.result_features, prefitted=self._prefitted
-            )
+                fitted_data, fitted = fit_and_transform_dag(
+                    train_data, self.result_features, prefitted=prefitted,
+                    checkpoint=ckpt,
+                )
+        finally:
+            if selector is not None:
+                selector._checkpoint = None
+                selector._checkpoint_resume = False
 
         selector_info = None
         if selector is not None:
@@ -558,6 +608,19 @@ class WorkflowModel:
                     f"Evaluated {len(vals)} {name} models with {metric} "
                     f"between [{min(vals)}, {max(vals)}]"
                 )
+            # retry/exclusion ledger (resilience): candidates that needed
+            # more than one attempt, or were excluded after exhausting them
+            for a in sel.get("candidateAttempts") or []:
+                if a.get("excluded"):
+                    lines.append(
+                        f"Excluded {a['modelName']} after "
+                        f"{a.get('attempts', 1)} attempt(s): {a.get('error')}"
+                    )
+                elif a.get("attempts", 1) > 1:
+                    lines.append(
+                        f"Retried {a['modelName']}: succeeded on attempt "
+                        f"{a['attempts']}"
+                    )
             lines.append("")
             # selected-model parameter table (README: "Selected model Random
             # Forest classifier with parameters")
