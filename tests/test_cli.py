@@ -29,6 +29,13 @@ class TestProblemKind:
         assert infer_problem_kind(col, 50) == "Regression"
 
 
+_TITANIC = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+_needs_titanic = pytest.mark.skipif(
+    not os.path.exists(_TITANIC), reason="Titanic fixture data not available"
+)
+
+
+@_needs_titanic
 class TestGenerateProject:
     def test_gen_titanic(self, tmp_path):
         out = str(tmp_path / "proj")
@@ -117,6 +124,7 @@ class TestGeneratedProjectRuns:
         assert "AuPR" in proc.stdout or "AuROC" in proc.stdout, proc.stdout
 
 
+@_needs_titanic
 class TestAvroSchemaSource:
     """CommandParser.scala:111 / SchemaSource.scala:85,158 — the generator
     accepts an Avro .avsc record schema as the typed-project source, with

@@ -18,11 +18,16 @@ from transmogrifai_tpu.workflow.workflow import Workflow
 LR_MODELS = [(LogisticRegression(), {"reg_param": [0.01, 0.1]})]
 
 
+TITANIC_CSV = "/root/reference/test-data/PassengerDataAllWithHeader.csv"
+
+
 @pytest.fixture(scope="module")
 def titanic_trained():
-    ds = infer_csv_dataset(
-        "/root/reference/test-data/PassengerDataAllWithHeader.csv"
-    )
+    import os
+
+    if not os.path.exists(TITANIC_CSV):
+        pytest.skip("Titanic fixture data not available")
+    ds = infer_csv_dataset(TITANIC_CSV)
     resp, preds = from_dataset(ds, response="Survived")
     preds = [p for p in preds if p.name != "PassengerId"]
     vector = transmogrify(preds)

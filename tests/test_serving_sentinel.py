@@ -1,0 +1,813 @@
+"""Serving-path hardening suite (resilience/sentinel.py + local/scoring.py):
+schema sentinel, per-row quarantine, train/serve drift detection, and the
+scoring circuit breaker — all driven through deterministic fault plans and
+injectable clocks (zero real sleeps; markers: serving, faults).
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.resilience import (
+    BreakerConfig,
+    DriftConfig,
+    FaultPlan,
+    SchemaSentinel,
+    SchemaViolationError,
+    SentinelPolicy,
+    installed,
+)
+from transmogrifai_tpu.resilience.sentinel import (
+    DriftSentinel,
+    histogram_js_divergence,
+)
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.utils.streaming_histogram import (
+    StreamingHistogram,
+    histogram_from_values,
+)
+from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+
+pytestmark = [pytest.mark.serving, pytest.mark.faults]
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def _binary_ds(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = (x1 + 0.5 * x2 + 0.3 * rng.normal(size=n) > 0).astype(float)
+    return Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+    })
+
+
+@pytest.fixture(scope="module")
+def trained():
+    uid_util.reset()
+    ds = _binary_ds(n=160, seed=3)
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    )
+    pred = selector.set_input(resp, vec).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    return ds, pred, model
+
+
+# ------------------------------------------------------------ schema sentinel
+class TestSchemaSentinel:
+    def _features(self, ds):
+        resp, preds = from_dataset(ds, response="label")
+        return [resp, *preds]
+
+    def test_default_policy_coerces_and_quarantines(self):
+        ds = _binary_ds(8)
+        s = SchemaSentinel(self._features(ds))
+        clean, q = s.check_row({"x1": "3.5", "x2": 1.0})
+        assert q == [] and clean["x1"] == 3.5
+        assert s.counts["wrong_type"] == 1
+        clean, q = s.check_row({"x1": "zzz", "x2": 1.0})
+        assert len(q) == 1 and q[0][0] == "x1" and q[0][1] == "unparseable"
+        clean, q = s.check_row({"x1": float("nan"), "x2": float("inf")})
+        assert q == [] and clean["x1"] is None and clean["x2"] is None
+        assert s.counts["non_finite"] == 2
+
+    def test_missing_key_is_normal_sparsity_not_a_violation(self):
+        """An absent optional field under the default policy is ordinary
+        sparse data: scored as missing, NOT counted — real violations must
+        not drown in fill-rate noise (that's the drift sentinel's job)."""
+        ds = _binary_ds(8)
+        s = SchemaSentinel(self._features(ds))
+        clean, q = s.check_row({"x1": 1.0})
+        assert q == [] and clean.get("x2") is None
+        assert not s.counts and not s.by_feature
+
+    def test_response_features_never_validated(self):
+        ds = _binary_ds(8)
+        s = SchemaSentinel(self._features(ds))
+        clean, q = s.check_row({"x1": 1.0, "x2": 2.0, "label": "garbage"})
+        assert q == [] and clean["label"] == "garbage"
+        assert s.counts["unparseable"] == 0
+
+    def test_raise_policy_escalates(self):
+        ds = _binary_ds(8)
+        s = SchemaSentinel(
+            self._features(ds),
+            policy=SentinelPolicy(unparseable="raise"),
+        )
+        with pytest.raises(SchemaViolationError, match="x1"):
+            s.check_row({"x1": "zzz", "x2": 1.0})
+
+    def test_per_feature_policy_override(self):
+        ds = _binary_ds(8)
+        s = SchemaSentinel(
+            self._features(ds),
+            per_feature={"x1": SentinelPolicy(missing="quarantine")},
+        )
+        _, q = s.check_row({"x2": 1.0})  # x1 missing -> quarantine
+        assert len(q) == 1 and q[0][0] == "x1"
+        _, q = s.check_row({"x1": 1.0})  # x2 missing -> default policy
+        assert q == []
+
+    def test_off_policy_allows_everything(self):
+        ds = _binary_ds(8)
+        s = SchemaSentinel(self._features(ds), policy=SentinelPolicy.off())
+        clean, q = s.check_row({"x1": "zzz"})
+        assert q == [] and clean["x1"] == "zzz" and not s.counts
+
+    def test_copy_on_write(self):
+        ds = _binary_ds(8)
+        s = SchemaSentinel(self._features(ds))
+        row = {"x1": 1.0, "x2": 2.0}
+        clean, _ = s.check_row(row)
+        assert clean is row  # untouched rows are not copied
+
+    def test_numpy_scalars_are_valid(self):
+        """np.float64/np.int64/np.bool_ rows (pandas to_dict output) must
+        pass validation untouched — they scored fine pre-sentinel."""
+        ds = _binary_ds(8)
+        s = SchemaSentinel(self._features(ds))
+        row = {"x1": np.float64(1.5), "x2": np.int64(3)}
+        clean, q = s.check_row(row)
+        assert q == [] and clean is row and not s.counts
+        clean, q = s.check_row({"x1": np.bool_(True), "x2": np.float32(2.0)})
+        assert q == [] and not s.counts
+
+    def test_binary_garbage_strings_do_not_coerce_to_false(self):
+        from transmogrifai_tpu.resilience.sentinel import _inspect_value
+
+        assert _inspect_value(T.Binary, "yes") == ("wrong_type", True)
+        assert _inspect_value(T.Binary, "false") == ("wrong_type", False)
+        assert _inspect_value(T.Binary, np.bool_(True)) == (None, True)
+        kind, coerced = _inspect_value(T.Binary, "N/A")
+        assert kind == "unparseable"  # garbage must not score as False
+
+
+# --------------------------------------------------------- per-row quarantine
+class TestQuarantine:
+    def test_k_malformed_rows_quarantine_exactly_k(self, trained):
+        """Acceptance: a batch with k malformed rows returns n scored rows
+        with exactly k quarantine records, counters matching exactly."""
+        ds, pred, model = trained
+        rows = ds.rows()[:10]
+        fn = score_function(model)
+        plan = (
+            FaultPlan()
+            .malform_row("x1", rows=(2,), value="##bad##")
+            .malform_row("x2", rows=(7,), value=object())
+        )
+        with installed(plan):
+            out = fn.batch(rows)
+        assert len(out) == 10  # every row came back scored
+        recs = fn.quarantine.last
+        assert sorted(r.index for r in recs) == [2, 7]
+        assert len(recs) == 2
+        # quarantined rows got the default prediction, not a crash
+        for i in (2, 7):
+            assert out[i][pred.name]["prediction"] is not None
+        # others scored normally
+        clean_out = fn.batch(rows)  # no faults
+        for i in (0, 1, 3, 4, 5, 6, 8, 9):
+            assert out[i][pred.name] == clean_out[i][pred.name]
+        md = fn.metadata()
+        assert md["quarantine"]["quarantinedRows"] == 2
+        assert md["sentinel"]["violations"]["unparseable"] == 1
+        assert md["sentinel"]["violations"]["wrong_type"] >= 1
+
+    def test_unparseable_value_no_longer_kills_the_batch(self, trained):
+        ds, pred, model = trained
+        rows = ds.rows()[:4]
+        bad = dict(rows[1])
+        bad["x1"] = "not-a-number"
+        fn = score_function(model)
+        out = fn.batch([rows[0], bad, rows[2], rows[3]])
+        assert len(out) == 4
+        assert [r.index for r in fn.quarantine.last] == [1]
+
+    def test_stage_poison_isolates_per_row(self, trained):
+        """A row that poisons a stage is quarantined; the other rows keep
+        their REAL scores (recovered by per-row isolation)."""
+        ds, pred, model = trained
+        rows = ds.rows()[:6]
+        fn = score_function(model)
+        clean_out = fn.batch(rows)
+        plan = FaultPlan().fail_stage_transform(
+            pred.name, rows=(3,), times=None
+        )
+        with installed(plan):
+            out = fn.batch(rows)
+        recs = fn.quarantine.last
+        assert [r.index for r in recs] == [3] and recs[0].kind == "stage"
+        for i in (0, 1, 2, 4, 5):
+            assert out[i][pred.name] == clean_out[i][pred.name]
+
+    def test_empty_batch(self, trained):
+        _, _, model = trained
+        assert score_function(model).batch([]) == []
+
+    def test_multi_violation_row_counts_as_one_row(self, trained):
+        ds, pred, model = trained
+        rows = ds.rows()[:4]
+        bad = dict(rows[1])
+        bad["x1"] = "zzz"
+        bad["x2"] = "www"  # two violating features, ONE quarantined row
+        fn = score_function(model)
+        fn.batch([rows[0], bad, rows[2], rows[3]])
+        md = fn.metadata()["quarantine"]
+        assert md["quarantinedRows"] == 1 and md["records"] == 2
+
+    def test_quarantined_rows_never_reach_the_plan(self, trained):
+        """A quarantined row must not be scored as an all-missing
+        placeholder — it could poison a stage and feed the breaker. The
+        fault targeting the quarantined row's index must never fire."""
+        ds, pred, model = trained
+        rows = ds.rows()[:6]
+        fn = score_function(model)
+        plan = (
+            FaultPlan()
+            .malform_row("x1", rows=(2,), value="##bad##")
+            .fail_stage_transform(pred.name, rows=(2,), times=None)
+        )
+        with installed(plan):
+            out = fn.batch(rows)
+        assert len(out) == 6
+        # row 2 was quarantined at validation; the stage fault keyed to
+        # row 2 never fired because the row never entered the plan
+        assert [r.index for r in fn.quarantine.last] == [2]
+        assert fn.quarantine.last[0].kind == "unparseable"
+        assert ("transform", pred.name) not in plan.fired
+
+    def test_deterministic_total_failure_is_budget_bounded(self, trained):
+        """A stage failing for EVERY row must not turn one batch into
+        O(n) plan re-runs: the isolation budget caps the re-runs and the
+        remaining rows are quarantined wholesale."""
+        ds, pred, model = trained
+        rows = ds.rows()[:64]
+        fn = score_function(model)
+        plan = FaultPlan().fail_stage_transform(pred.name, times=None)
+        with installed(plan):
+            out = fn.batch(rows)
+        assert len(out) == 64
+        assert sorted(r.index for r in fn.quarantine.last) == list(range(64))
+        # the fault's internal count = number of plan executions; the
+        # budget keeps it well under the unbounded 2n-1 = 127 re-runs
+        # (1 primary + ~44 budgeted + exhausted siblings' single runs)
+        executions = plan._transform_faults[0]["count"]
+        assert executions <= 70
+
+    def test_bisection_isolates_multiple_poisoned_rows(self, trained):
+        ds, pred, model = trained
+        rows = ds.rows()[:9]
+        fn = score_function(model)
+        clean_out = fn.batch(rows)
+        plan = FaultPlan().fail_stage_transform(
+            pred.name, rows=(0, 5, 8), times=None
+        )
+        with installed(plan):
+            out = fn.batch(rows)
+        assert sorted(r.index for r in fn.quarantine.last) == [0, 5, 8]
+        for i in (1, 2, 3, 4, 6, 7):
+            assert out[i][pred.name] == clean_out[i][pred.name]
+
+    def test_open_breaker_plus_fresh_failure_does_not_kill_batch(self, trained):
+        """An open breaker on stage A must stay skipped during the per-row
+        isolation triggered by a DIFFERENT stage's failure — A's persistent
+        failure must not quarantine the whole batch."""
+        ds, pred, model = trained
+        rows = ds.rows()[:6]
+        clk = FakeClock()
+        fn = score_function(
+            model,
+            breaker=BreakerConfig(
+                failure_threshold=1, recovery_time=1000.0, clock=clk
+            ),
+        )
+        # open the breaker on the terminal stage (stage A)
+        with installed(FaultPlan().fail_stage_transform(pred.name, times=1)):
+            fn(rows[0])
+        assert fn.breakers[pred.name].state == "open"
+        # now a different (upstream) stage fails freshly on row 2: the
+        # isolation re-runs must keep skipping A instead of executing it
+        vec_stage = next(
+            t for t in model.fitted.values() if t.output_name != pred.name
+        )
+        plan = FaultPlan().fail_stage_transform(
+            vec_stage.output_name, rows=(2,), times=None
+        )
+        with installed(plan):
+            out = fn.batch(rows)
+        assert len(out) == 6
+        # only the genuinely poisoning row is quarantined
+        assert [r.index for r in fn.quarantine.last] == [2]
+        # breaker untouched by the observe-mode re-runs
+        br = fn.breakers[pred.name]
+        assert br.state == "open"
+        assert br.stats()["transitions"] == {"closed->open": 1}
+
+    def test_score_columns_stage_poison_isolates_per_row(self, trained):
+        ds, pred, model = trained
+        sub = ds.take(np.arange(6))
+        fn = score_function(model)
+        clean = fn.columns(sub.drop(["label"]))[pred.name]
+        plan = FaultPlan().fail_stage_transform(
+            pred.name, rows=(2,), times=None
+        )
+        fn2 = score_function(model)
+        with installed(plan):
+            out = fn2.columns(sub.drop(["label"]))[pred.name]
+        assert len(out) == 6
+        recs = fn2.quarantine.last
+        assert [r.index for r in recs] == [2]
+        clean_pred = np.asarray(clean.prediction)
+        got_pred = np.asarray(out.prediction)
+        keep = [0, 1, 3, 4, 5]
+        np.testing.assert_allclose(got_pred[keep], clean_pred[keep])
+
+
+# ------------------------------------------------- score_one / batch parity
+class TestScoreOneParity:
+    def test_parity_under_malformed_input(self, trained):
+        ds, pred, model = trained
+        row = ds.rows()[0]
+        plan = FaultPlan().malform_row("x1", rows=(0,), value="##bad##")
+        fn_one = score_function(model)
+        with installed(plan):
+            one = fn_one(row)
+        plan2 = FaultPlan().malform_row("x1", rows=(0,), value="##bad##")
+        fn_batch = score_function(model)
+        with installed(plan2):
+            batch = fn_batch.batch([row])
+        assert one == batch[0]
+        assert (
+            [(r.feature, r.kind) for r in fn_one.quarantine.last]
+            == [(r.feature, r.kind) for r in fn_batch.quarantine.last]
+        )
+
+    def test_parity_under_nan_fault(self, trained):
+        ds, pred, model = trained
+        row = ds.rows()[0]
+        fn_one = score_function(model)
+        with installed(FaultPlan().nan_output(pred.name, rows=(0,))):
+            one = fn_one(row)
+        fn_batch = score_function(model)
+        with installed(FaultPlan().nan_output(pred.name, rows=(0,))):
+            batch = fn_batch.batch([row])
+        assert one == batch[0]
+        assert fn_one.guard.counts == fn_batch.guard.counts
+
+    def test_parity_clean(self, trained):
+        ds, pred, model = trained
+        row = ds.rows()[5]
+        fn = score_function(model)
+        assert fn(row) == fn.batch([row])[0]
+
+
+# ------------------------------------------------------------ circuit breaker
+class TestCircuitBreaker:
+    def test_opens_after_k_failures_and_recovers_via_half_open(self, trained):
+        """Acceptance: breaker opens after K injected stage failures and
+        recovers via half-open probe (injected clock, no sleeps)."""
+        ds, pred, model = trained
+        rows = ds.rows()
+        clk = FakeClock()
+        fn = score_function(
+            model,
+            breaker=BreakerConfig(
+                failure_threshold=3, recovery_time=10.0, clock=clk
+            ),
+        )
+        plan = FaultPlan().fail_stage_transform(pred.name, times=3)
+        with installed(plan):
+            for i in range(3):
+                out = fn(rows[i])  # each fails once; defaults returned
+                assert out[pred.name]["prediction"] is not None
+            br = fn.breakers[pred.name]
+            assert br.state == "open"
+            assert br.stats()["transitions"] == {"closed->open": 1}
+            # open: short-circuits without executing the stage
+            out = fn(rows[3])
+            assert br.stats()["shortCircuits"] == 1
+            # not yet recovered
+            clk.now = 5.0
+            fn(rows[4])
+            assert br.state == "open"
+            # past recovery_time: half-open probe runs the stage for real
+            clk.now = 11.0
+            out = fn(rows[5])
+            assert br.state == "closed"
+            assert br.stats()["transitions"]["open->half_open"] == 1
+            assert br.stats()["transitions"]["half_open->closed"] == 1
+            assert np.isfinite(out[pred.name]["prediction"])
+        assert len(plan.fired) == 1  # one fired entry per configured fault
+
+    def test_failed_probe_reopens(self, trained):
+        ds, pred, model = trained
+        rows = ds.rows()
+        clk = FakeClock()
+        fn = score_function(
+            model,
+            breaker=BreakerConfig(
+                failure_threshold=2, recovery_time=10.0, clock=clk
+            ),
+        )
+        plan = FaultPlan().fail_stage_transform(pred.name, times=3)
+        with installed(plan):
+            fn(rows[0])
+            fn(rows[1])
+            br = fn.breakers[pred.name]
+            assert br.state == "open"
+            clk.now = 11.0
+            fn(rows[2])  # probe consumes the third injected failure
+            assert br.state == "open"
+            assert br.stats()["transitions"]["half_open->open"] == 1
+            clk.now = 22.0
+            out = fn(rows[3])  # next probe succeeds
+            assert br.state == "closed"
+            assert np.isfinite(out[pred.name]["prediction"])
+
+    def test_short_circuit_degrades_not_crashes(self, trained):
+        ds, pred, model = trained
+        rows = ds.rows()
+        clk = FakeClock()
+        fn = score_function(
+            model,
+            breaker=BreakerConfig(
+                failure_threshold=1, recovery_time=100.0, clock=clk
+            ),
+        )
+        with installed(FaultPlan().fail_stage_transform(pred.name, times=1)):
+            fn(rows[0])
+        # breaker open, no faults installed: batch still degrades to
+        # defaults (the stage is skipped entirely)
+        out = fn.batch(rows[:4])
+        assert len(out) == 4
+        br = fn.breakers[pred.name]
+        assert br.stats()["shortCircuits"] == 1
+        assert all(r[pred.name]["prediction"] is not None for r in out)
+
+    def test_deadline_overruns_count_as_failures(self, trained):
+        ds, pred, model = trained
+        rows = ds.rows()
+
+        class TickClock:
+            """Each clock() call advances 1s: every stage 'takes' 1s."""
+
+            def __init__(self):
+                self.now = 0.0
+
+            def __call__(self):
+                self.now += 1.0
+                return self.now
+
+        fn = score_function(
+            model,
+            breaker=BreakerConfig(
+                failure_threshold=100, recovery_time=1.0,
+                deadline=0.5, clock=TickClock(),
+            ),
+        )
+        fn(rows[0])
+        stats = fn.metadata()["breakers"]
+        assert all(s["deadlineOverruns"] >= 1 for s in stats.values())
+        assert all(s["consecutiveFailures"] >= 1 for s in stats.values())
+
+    def test_breaker_disabled(self, trained):
+        ds, pred, model = trained
+        fn = score_function(model, breaker=False)
+        fn(ds.rows()[0])
+        assert fn.breakers == {} and fn.metadata()["breakers"] == {}
+
+
+# -------------------------------------------------------------- drift sentinel
+class TestDriftSentinel:
+    def test_profiles_captured_and_persisted(self, trained, tmp_path):
+        ds, pred, model = trained
+        profs = model.serving_profiles
+        assert set(profs) == {"x1", "x2"}  # response never profiled
+        assert profs["x1"]["count"] > 0
+        assert profs["x1"]["histogram"] is not None
+        model.save(str(tmp_path / "m"))
+        m2 = WorkflowModel.load(str(tmp_path / "m"))
+        assert m2.serving_profiles == profs
+
+    def test_in_distribution_stream_stays_quiet(self, trained):
+        ds, pred, model = trained
+        fn = score_function(
+            model, drift=DriftConfig(min_rows=30, js_threshold=0.35)
+        )
+        for r in ds.rows()[:80]:
+            fn(r)
+        rep = fn.metadata()["drift"]
+        assert rep["enabled"] and rep["alerts"] == []
+        assert rep["driftAlertsTotal"] == 0
+        assert rep["features"]["x1"]["status"] == "ok"
+        assert rep["features"]["x1"]["jsDivergence"] < 0.35
+
+    def test_shifted_stream_trips_js_alert(self, trained):
+        """Acceptance: a serve stream drawn from a shifted distribution
+        trips the drift sentinel while an in-distribution stream does not
+        (previous test)."""
+        ds, pred, model = trained
+        fn = score_function(
+            model, drift=DriftConfig(min_rows=30, js_threshold=0.35)
+        )
+        plan = FaultPlan().shift_feature("x1", offset=25.0)
+        with installed(plan):
+            for r in ds.rows()[:80]:
+                fn(r)
+        rep = fn.metadata()["drift"]
+        assert rep["alerts"] == ["x1"]
+        assert rep["driftAlertsTotal"] == 1
+        assert rep["features"]["x1"]["jsDivergence"] > 0.35
+        assert rep["features"]["x2"]["status"] == "ok"
+        assert plan.fired == [("drift", "x1")]
+        # alert counter counts TRANSITIONS, not reports
+        assert fn.metadata()["drift"]["driftAlertsTotal"] == 1
+
+    def test_fill_rate_collapse_trips_alert(self, trained):
+        ds, pred, model = trained
+        fn = score_function(
+            model,
+            drift=DriftConfig(min_rows=30, fill_ratio_threshold=5.0),
+        )
+        for r in ds.rows()[:60]:
+            r = dict(r)
+            r.pop("x2", None)  # feature vanished from the serve stream
+            fn(r)
+        rep = fn.metadata()["drift"]
+        assert "x2" in rep["alerts"]
+        # an infinite ratio reports null so the metadata stays strict-JSON
+        assert rep["features"]["x2"]["fillRatio"] is None
+        import json
+
+        json.dumps(rep, allow_nan=False)  # whole report is serializable
+
+    def test_sliding_window_forgets_old_drift(self, trained):
+        ds, pred, model = trained
+        cfg = DriftConfig(window=40, chunks=4, min_rows=20, js_threshold=0.35)
+        fn = score_function(model, drift=cfg)
+        plan = FaultPlan().shift_feature("x1", offset=25.0, times=40)
+        with installed(plan):
+            for r in ds.rows()[:40]:
+                fn(r)
+        assert fn.metadata()["drift"]["alerts"] == ["x1"]
+        # stream recovers: the shifted chunks age out of the window
+        for r in ds.rows()[40:120]:
+            fn(r)
+        rep = fn.metadata()["drift"]
+        assert rep["alerts"] == []
+        assert rep["driftAlertsTotal"] == 1  # the historical alert remains
+
+    def test_torn_profile_disables_feature_not_scoring(self, trained):
+        """Acceptance: torn profiles degrade monitoring, never scoring."""
+        ds, pred, model = trained
+        plan = FaultPlan().tear_profile("x1")
+        with installed(plan):
+            fn = score_function(model)
+            out = fn(ds.rows()[0])
+        assert np.isfinite(out[pred.name]["prediction"])
+        rep = fn.metadata()["drift"]
+        assert rep["tornProfiles"] == ["x1"]
+        assert "x1" not in rep["features"] and plan.fired == [("profile", "x1")]
+
+    def test_corrupt_profile_json_is_torn_not_fatal(self):
+        sent = DriftSentinel({"x1": {"count": "??", "nulls": None}})
+        assert sent.torn == ["x1"] and sent.profiles == {}
+
+    def test_model_without_profiles_is_inert(self, trained):
+        ds, pred, model = trained
+        stripped = WorkflowModel(
+            result_features=model.result_features,
+            raw_features=model.raw_features,
+            fitted=model.fitted,
+            selector_info=model.selector_info,
+        )
+        fn = score_function(stripped)
+        fn(ds.rows()[0])
+        rep = fn.metadata()["drift"]
+        assert rep["enabled"] is False and rep["features"] == {}
+
+    def test_testkit_drifted_stream_trips_sentinel(self, trained):
+        """testkit.drifted() builds the covariate-shifted serve stream
+        without a FaultPlan — same generator, same seed, offset values."""
+        from transmogrifai_tpu import testkit as tk
+        from transmogrifai_tpu.dataset import Dataset
+
+        ds, pred, model = trained
+        base = tk.RandomReal.normal(0.0, 1.0, seed=9)
+        shifted = tk.drifted(base, offset=30.0)
+        n = 80
+        serve = Dataset.of({
+            "x1": shifted.to_column(n),
+            "x2": tk.RandomReal.normal(0.0, 1.0, seed=10).to_column(n),
+        })
+        fn = score_function(
+            model, drift=DriftConfig(min_rows=30, js_threshold=0.35)
+        )
+        fn.columns(serve)
+        rep = fn.metadata()["drift"]
+        assert rep["alerts"] == ["x1"]
+        # the un-shifted twin draws the same sequence minus the offset
+        vals = np.asarray(shifted.to_column(n).values)
+        np.testing.assert_allclose(
+            vals - 30.0, np.asarray(base.to_column(n).values)
+        )
+
+    def test_isolation_reruns_do_not_inflate_guard_counters(self, trained):
+        """Bisection re-runs sanitize NaN outputs but never count them:
+        guard counters reflect the PRIMARY pass only, so a failure that
+        triggers O(log n) re-runs cannot multiply the degradation stats."""
+        ds, pred, model = trained
+        rows = ds.rows()[:8]
+        fn = score_function(model)
+        vec_stage = next(
+            t for t in model.fitted.values() if t.output_name != pred.name
+        )
+        plan = (
+            FaultPlan()
+            .nan_output(pred.name, rows=(1,), times=10)
+            .fail_stage_transform(vec_stage.output_name, rows=(5,), times=None)
+        )
+        with installed(plan):
+            out = fn.batch(rows)
+        assert len(out) == 8
+        assert [r.index for r in fn.quarantine.last] == [5]
+        # pred only ran inside the re-runs (its input stage failed in the
+        # primary pass): outputs are still sanitized, counters untouched
+        assert fn.metadata()["scoreGuard"]["guardedRows"] == 0
+        for r in out:
+            assert np.isfinite(r[pred.name]["prediction"])
+
+    def test_columns_path_observes_drift(self, trained):
+        ds, pred, model = trained
+        fn = score_function(
+            model, drift=DriftConfig(min_rows=30, js_threshold=0.35)
+        )
+        fn.columns(ds.drop(["label"]))
+        rep = fn.metadata()["drift"]
+        assert rep["rowsObserved"] == ds.num_rows
+        assert rep["features"]["x1"]["status"] == "ok"
+
+
+# -------------------------------------------------------- histogram invariants
+class TestStreamingHistogramInvariants:
+    """Deterministic invariant sweeps (the hypothesis @given twins live in
+    test_property_based.py and run where hypothesis is installed); the
+    drift sentinel's JS math depends on all three."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_merge_preserves_total_count(self, seed):
+        rng = np.random.default_rng(seed)
+        a = StreamingHistogram(16)
+        b = StreamingHistogram(16)
+        for v in rng.normal(size=50):
+            a.update(float(v))
+        for v in rng.exponential(size=37):
+            b.update(float(v))
+        merged = a.merge(b)
+        assert merged.total_count == pytest.approx(
+            a.total_count + b.total_count
+        )
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_quantiles_monotone_in_q(self, seed):
+        rng = np.random.default_rng(seed + 100)
+        h = StreamingHistogram(12)
+        for v in rng.normal(size=80):
+            h.update(float(v))
+        qs = [h.quantile(q) for q in np.linspace(0.0, 1.0, 21)]
+        assert all(q2 >= q1 - 1e-9 for q1, q2 in zip(qs, qs[1:]))
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_shrink_never_drops_mass(self, seed):
+        rng = np.random.default_rng(seed + 200)
+        h = StreamingHistogram(4)  # tiny capacity: every update shrinks
+        total = 0.0
+        for v in rng.uniform(-5, 5, size=60):
+            h.update(float(v))
+            total += 1.0
+            assert h.total_count == pytest.approx(total)
+        assert len(h.bins) <= 4
+
+    def test_bulk_builder_matches_incremental_when_exact(self):
+        vals = [1.0, 2.0, 2.0, 5.0, 9.0]
+        bulk = histogram_from_values(vals, max_bins=16)
+        inc = StreamingHistogram(16)
+        for v in vals:
+            inc.update(v)
+        assert bulk.bins == inc.bins
+
+    def test_bulk_builder_preserves_mass_when_approximate(self):
+        rng = np.random.default_rng(7)
+        vals = rng.normal(size=5000)
+        h = histogram_from_values(vals, max_bins=32)
+        assert h.total_count == pytest.approx(5000)
+        assert len(h.bins) <= 32
+
+    def test_js_divergence_bounds(self):
+        rng = np.random.default_rng(1)
+        a = histogram_from_values(rng.normal(size=500), max_bins=32)
+        b = histogram_from_values(rng.normal(size=500) + 0.01, max_bins=32)
+        far = histogram_from_values(rng.normal(size=500) + 100.0, max_bins=32)
+        near_js = histogram_js_divergence(a, b)
+        far_js = histogram_js_divergence(a, far)
+        assert 0.0 <= near_js < 0.2
+        assert far_js > 0.9  # disjoint supports approach the log2 bound 1.0
+        assert histogram_js_divergence(a, a) == pytest.approx(0.0, abs=1e-9)
+
+
+# -------------------------------------------------------------------- metadata
+class TestMetadataAndSummary:
+    def test_counters_match_injected_counts_exactly(self, trained):
+        """Acceptance: metadata() counters match injected counts exactly."""
+        ds, pred, model = trained
+        rows = ds.rows()[:12]
+        fn = score_function(model)
+        plan = (
+            FaultPlan()
+            .malform_row("x1", rows=(1, 4, 9), value="##bad##")
+            .nan_output(pred.name, rows=(0,))
+        )
+        with installed(plan):
+            out = fn.batch(rows)
+        assert len(out) == 12
+        md = fn.metadata()
+        assert md["quarantine"]["quarantinedRows"] == 3
+        assert md["quarantine"]["byKind"] == {"unparseable": 3}
+        assert md["sentinel"]["violations"]["unparseable"] == 3
+        assert md["scoreGuard"]["guardedRows"] == 1
+        # quarantined rows never reach the plan, so the drift window holds
+        # the 9 surviving rows only
+        assert md["drift"]["rowsObserved"] == 9
+        assert len([f for f in plan.fired if f[0] == "malform"]) == 3
+
+    def test_summary_pretty_reports_serving_counters(self, trained):
+        ds, pred, model = trained
+        fn = score_function(model)
+        bad = dict(ds.rows()[0])
+        bad["x1"] = "not-a-number"
+        fn.batch([bad, ds.rows()[1]])
+        text = model.summary_pretty()
+        assert "Serving resilience:" in text
+        assert "quarantined row(s)" in text
+
+    def test_true_flags_mean_defaults(self, trained):
+        ds, pred, model = trained
+        fn = score_function(model, sentinel=True, breaker=True, drift=True)
+        out = fn(ds.rows()[0])
+        assert np.isfinite(out[pred.name]["prediction"])
+        assert fn.sentinel is not None and fn.metadata()["drift"]["enabled"]
+
+    def test_isolation_raise_restores_fail_fast(self, trained):
+        from transmogrifai_tpu.resilience import TransientError
+
+        ds, pred, model = trained
+        fn = score_function(model, isolation="raise")
+        plan = FaultPlan().fail_stage_transform(pred.name, times=1)
+        with installed(plan):
+            with pytest.raises(TransientError, match="injected"):
+                fn.batch(ds.rows()[:4])
+        # the breaker still recorded the failure before propagating
+        assert fn.breakers[pred.name].stats()["consecutiveFailures"] == 1
+        with pytest.raises(ValueError, match="isolation"):
+            score_function(model, isolation="nope")
+
+    def test_default_values_do_not_alias_between_rows(self, trained):
+        ds, pred, model = trained
+        rows = ds.rows()[:4]
+        bad1, bad2 = dict(rows[0]), dict(rows[1])
+        bad1["x1"] = "zzz"
+        bad2["x1"] = "www"
+        fn = score_function(model)
+        out = fn.batch([bad1, bad2])
+        out[0][pred.name]["prediction"] = 99.0
+        assert out[1][pred.name]["prediction"] != 99.0
+
+    def test_guard_still_escalates_in_raise_mode(self, trained):
+        """PR-1 semantics preserved: ScoreGuard(raise) is an explicit
+        escalation and must NOT be swallowed by stage isolation."""
+        from transmogrifai_tpu.resilience import ScoreGuard, ScoreGuardError
+
+        ds, pred, model = trained
+        fn = score_function(model, guard=ScoreGuard(fallback="raise"))
+        with installed(FaultPlan().nan_output(pred.name, rows=(0,))):
+            with pytest.raises(ScoreGuardError, match="non-finite"):
+                fn.batch(ds.rows()[:2])

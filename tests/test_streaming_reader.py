@@ -1,11 +1,15 @@
 """FileStreamingReader single-pass (poll=False) robustness: files inside
 the settle window get ONE bounded retry instead of a silent drop (the
-docstring's 'not silently dropped' contract has no next poll to lean on)."""
+docstring's 'not silently dropped' contract has no next poll to lean on),
+and chunk fetches retry transient errors through the RetryPolicy."""
 import csv
 import os
 import time
 
+import pytest
+
 from transmogrifai_tpu.readers import FileStreamingReader
+from transmogrifai_tpu.resilience import FaultPlan, RetryPolicy, installed
 
 
 def _write_csv(path, rows):
@@ -40,3 +44,59 @@ def test_single_pass_reads_settled_files_immediately(tmp_path, monkeypatch):
     batches = list(reader._batches_iter())
     assert len(batches) == 1
     assert sleeps == []  # no retry sleep when the file is already settled
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def time(self):
+        return self.now
+
+    def sleep(self, d):
+        self.sleeps.append(d)
+        self.now += d
+
+
+@pytest.mark.faults
+def test_chunk_fetch_retries_injected_transient_errors(tmp_path):
+    """The PR-1 RetryPolicy now wraps streaming chunk fetches: two injected
+    transient failures back off (zero real sleeps) and the chunk is still
+    delivered in ONE pass, no defer-to-next-poll needed."""
+    p = tmp_path / "batch1.csv"
+    _write_csv(p, [[1, 2], [3, 4]])
+    old = time.time() - 10
+    os.utime(p, (old, old))
+    reader = FileStreamingReader(str(tmp_path), pattern="*.csv", poll=False)
+    clk = _FakeClock()
+    reader.retry_policy = RetryPolicy(
+        max_attempts=3, base_delay=1.0, jitter=0.0,
+        sleep=clk.sleep, clock=clk.time,
+    )
+    plan = FaultPlan().fail_chunk_read(times=2)
+    with installed(plan):
+        batches = list(reader._batches_iter())
+    assert len(batches) == 1 and len(batches[0]) == 2
+    assert len(plan.fired) == 2  # two injected failures, both retried
+    assert clk.sleeps == [1.0, 2.0]  # exponential backoff, no real sleep
+
+
+@pytest.mark.faults
+def test_chunk_fetch_exhausted_retries_defer_not_crash(tmp_path):
+    """A chunk that keeps failing transiently after max_attempts must fall
+    into the existing defer/drop handling — never kill the stream."""
+    p = tmp_path / "batch1.csv"
+    _write_csv(p, [[1, 2]])
+    old = time.time() - 10
+    os.utime(p, (old, old))
+    reader = FileStreamingReader(str(tmp_path), pattern="*.csv", poll=False)
+    clk = _FakeClock()
+    reader.retry_policy = RetryPolicy(
+        max_attempts=2, base_delay=0.01, jitter=0.0,
+        sleep=clk.sleep, clock=clk.time,
+    )
+    plan = FaultPlan().fail_chunk_read(times=100)
+    with installed(plan):
+        batches = list(reader._batches_iter())  # must not raise
+    assert batches == []

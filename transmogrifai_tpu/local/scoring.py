@@ -14,20 +14,43 @@ length (single-row calls always hit the size-1 program).
 For throughput, ``score_function(model)(...)`` exposes ``.batch`` accepting
 a list of dicts scored as one columnar batch.
 
-Graceful degradation (resilience/): every stage output passes through a
-``ScoreGuard`` — rows that come out NaN/Inf are replaced with deterministic
-defaults (or escalated, per stage) instead of crashing the serving path or
-silently polluting downstream results; degraded-row counters surface on
-``score_fn.guard`` / ``score_fn.metadata()``.
+Serving sentinels (resilience/sentinel.py): every incoming row passes a
+**SchemaSentinel** (missing / wrong-type / non-finite / unparseable values
+handled per a configurable policy); rows that fail validation or poison a
+stage are **quarantined** — recorded with (row index, feature, reason) and
+replaced by the default prediction — so one bad row never kills a batch.
+Stage execution runs behind a per-stage **circuit breaker** (K consecutive
+failures open it; scoring degrades to default predictions for the affected
+result features until a half-open probe recovers), and a **drift sentinel**
+compares the live stream's per-feature fill rate and value distribution
+against the training profiles captured by ``Workflow.train()``. Stage
+outputs still pass the PR-1 ``ScoreGuard`` NaN/Inf containment. All
+counters surface on ``score_fn.metadata()``.
 """
 from __future__ import annotations
 
+import logging
+import weakref
 from typing import Any, Callable
 
+import numpy as np
+
 from ..resilience import faults
-from ..resilience.guards import ScoreGuard
-from ..types.columns import column_from_values
+from ..resilience.guards import ScoreGuard, ScoreGuardError
+from ..resilience.sentinel import (
+    BreakerConfig,
+    CircuitBreaker,
+    DriftConfig,
+    DriftSentinel,
+    QuarantineLog,
+    QuarantineRecord,
+    SchemaSentinel,
+    SchemaViolationError,
+)
+from ..types.columns import column_from_values, concat_columns, empty_like
 from ..workflow.workflow import WorkflowModel
+
+log = logging.getLogger(__name__)
 
 _BUCKET_CAP = 8192
 
@@ -46,6 +69,10 @@ def _bucket(n: int) -> int:
 def score_function(
     model: WorkflowModel,
     guard: ScoreGuard | None = None,
+    sentinel: SchemaSentinel | bool | None = None,
+    breaker: BreakerConfig | bool | None = None,
+    drift: DriftConfig | bool | None = None,
+    isolation: str = "degrade",
 ) -> Callable[[dict[str, Any]], dict[str, Any]]:
     """Returns ``row_dict -> result_dict`` (model.scoreFunction,
     OpWorkflowModelLocal.scala:79). Result keys are the result-feature names;
@@ -53,10 +80,18 @@ def score_function(
     (prediction/probability_*/rawPrediction_*).
 
     ``guard`` configures NaN/Inf containment per stage (default: replace
-    bad rows with defaults and count them; pass
-    ``ScoreGuard(fallback="raise")`` to escalate, or ``"off"`` to opt out).
-    The installed guard is exposed as ``score_fn.guard`` and its counters
-    via ``score_fn.metadata()``."""
+    bad rows with defaults and count them); ``sentinel`` the schema
+    validation (default policy coerces what it can and quarantines
+    unparseable rows; pass ``False`` to disable); ``breaker`` the per-stage
+    circuit breaker config (``False`` disables); ``drift`` the drift
+    sentinel config (active when the model carries training profiles;
+    ``False`` disables). ``isolation="degrade"`` (the default) contains a
+    stage exception to quarantined rows / degraded result features;
+    ``"raise"`` restores fail-fast propagation for callers that prefer an
+    error over silent default predictions. The installed components are
+    exposed as ``score_fn.guard`` / ``.sentinel`` / ``.breakers`` /
+    ``.drift`` / ``.quarantine`` and their counters via
+    ``score_fn.metadata()``."""
     from ..workflow.dag import compute_dag
 
     from ..stages.base import Estimator
@@ -73,6 +108,7 @@ def score_function(
             plan.append(t)
     raw_features = list(model.raw_features)
     result_names = [f.name for f in model.result_features]
+    result_ftypes = {f.name: f.ftype for f in model.result_features}
     # build-time validation: every result feature must be produced by the
     # plan (or be a raw input) — a stage-plan bug must fail here, not
     # surface as rows silently missing keys at score time
@@ -86,11 +122,34 @@ def score_function(
     guard = guard if guard is not None else ScoreGuard()
     result_name_set = set(result_names)
 
-    def _guarded(t, col, num_rows):
+    # ---- serving sentinels (None or True = defaults, False = off)
+    if sentinel is None or sentinel is True:
+        sentinel = SchemaSentinel(raw_features)
+    elif sentinel is False:
+        sentinel = None
+    if breaker is None or breaker is True:
+        breaker = BreakerConfig()
+    elif breaker is False:
+        breaker = None
+    breakers: dict[str, CircuitBreaker] = {}
+    profiles = getattr(model, "serving_profiles", None)
+    if drift is False:
+        profiles, drift = None, None
+    drift_sentinel = DriftSentinel(
+        profiles, drift if isinstance(drift, DriftConfig) else None
+    )
+    qlog = QuarantineLog()
+    raise_on_stage_error = isolation == "raise"
+    if isolation not in ("degrade", "raise"):
+        raise ValueError(f"unknown isolation mode {isolation!r}")
+
+    def _guarded(t, col, num_rows, count=True):
         """Per-stage output: fault-injection hook, then the NaN/Inf guard
         (default scope guards result-feature outputs only, so intermediate
         columns match batch WorkflowModel.score bit for bit; ``num_rows``
-        keeps bucket-padding replicas out of the degradation counters)."""
+        keeps bucket-padding replicas out of the degradation counters;
+        ``count=False`` for isolation re-runs, whose degradation the
+        primary run already counted)."""
         fault_plan = faults.active()
         if fault_plan is not None:
             corrupted = fault_plan.on_stage_output(t, col)
@@ -100,16 +159,101 @@ def score_function(
             t, col,
             is_result=t.output_name in result_name_set,
             num_rows=num_rows,
+            count=count,
         )
 
-    def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
-        n = len(rows)
-        if n == 0:
-            return []
-        b = _bucket(n)
+    def _run_plan(
+        cols: dict[str, Any],
+        b: int,
+        n: int,
+        row_indices: tuple[int, ...] | None,
+        breaker_mode: str = "active",
+        skip: frozenset = frozenset(),
+    ) -> tuple[set, list, dict]:
+        """Execute the stage plan over already-built raw columns, with
+        per-stage fault isolation. Returns ``(dead, failures, cause)``:
+        ``dead`` holds output names not produced (failed, short-circuited
+        by an open breaker, or downstream of either), ``failures`` the
+        ``(stage, exception)`` pairs from this run, and ``cause`` maps each
+        dead name to ``"failure"`` or ``"short_circuit"`` (short-circuit
+        wins on mixed ancestry so recovery re-runs never bypass an open
+        breaker). ``breaker_mode="active"`` gates and records; ``"observe"``
+        (the isolation re-runs) touches no breaker — it skips the stages in
+        ``skip``, the snapshot of breakers already open BEFORE the primary
+        run, so a pre-existing short circuit is honored while the stage
+        whose fresh failure is being isolated can still be probed.
+        ``ScoreGuardError``/``SchemaViolationError`` are explicit
+        escalations and propagate."""
+        fp = faults.active()
+        dead: set[str] = set()
+        failures: list[tuple[Any, Exception]] = []
+        cause: dict[str, str] = {}
+        for t in plan:
+            if any(nm in dead for nm in t.input_names):
+                dead.add(t.output_name)
+                up = {cause.get(nm) for nm in t.input_names if nm in dead}
+                cause[t.output_name] = (
+                    "short_circuit" if "short_circuit" in up else "failure"
+                )
+                continue
+            br = None
+            if breaker is not None:
+                if breaker_mode == "active":
+                    br = breakers.get(t.output_name)
+                    if br is None:
+                        br = breakers[t.output_name] = CircuitBreaker(
+                            t.output_name, breaker
+                        )
+                    if not br.allow():
+                        dead.add(t.output_name)
+                        cause[t.output_name] = "short_circuit"
+                        continue
+                elif t.output_name in skip:
+                    dead.add(t.output_name)
+                    cause[t.output_name] = "short_circuit"
+                    continue
+            try:
+                if fp is not None:
+                    fp.on_stage_transform(t, row_indices)
+                t0 = breaker.clock() if br is not None else 0.0
+                col = t.transform_columns(
+                    *[cols[nm] for nm in t.input_names], num_rows=b
+                )
+                elapsed = breaker.clock() - t0 if br is not None else 0.0
+                cols[t.output_name] = _guarded(
+                    t, col, n, count=breaker_mode == "active"
+                )
+            except (ScoreGuardError, SchemaViolationError):
+                raise
+            except Exception as e:
+                if br is not None:
+                    br.record_failure()
+                if raise_on_stage_error:
+                    raise  # isolation="raise": fail-fast, breaker recorded
+                dead.add(t.output_name)
+                cause[t.output_name] = "failure"
+                failures.append((t, e))
+                log.warning(
+                    "stage %s failed at score time (%s: %s)",
+                    t.output_name, type(e).__name__, e,
+                )
+                continue
+            if br is not None:
+                if breaker.deadline is not None and elapsed > breaker.deadline:
+                    br.deadline_overruns += 1
+                    br.record_failure()
+                else:
+                    br.record_success()
+        return dead, failures, cause
+
+    def _raw_columns(
+        prepared: list[dict[str, Any] | None], n: int, b: int
+    ) -> dict[str, Any]:
+        """Raw columns from validated rows; quarantined slots (None) become
+        all-missing rows so batch shape stays stable."""
         cols: dict[str, Any] = {}
         for f in raw_features:
-            vals = [r.get(f.name) for r in rows]
+            vals = [None if p is None else p.get(f.name) for p in prepared]
             if f.is_response and all(v is None for v in vals):
                 vals = [0] * n  # score-time null labels
             if b > n:
@@ -118,17 +262,226 @@ def score_function(
                 # sliced off below
                 vals = vals + [vals[0]] * (b - n)
             cols[f.name] = column_from_values(f.ftype, vals)
-        for t in plan:
-            ins = [cols[name] for name in t.input_names]
-            cols[t.output_name] = _guarded(
-                t, t.transform_columns(*ins, num_rows=b), n
+        return cols
+
+    # ---- default predictions: the all-missing row scored once, plainly
+    # (no fault hooks, guards, or breakers — defaults must stay
+    # deterministic even under an installed FaultPlan)
+    _neutral: dict[str, Any] = {}
+
+    def _neutral_columns() -> dict[str, Any]:
+        if "cols" not in _neutral:
+            cols = {
+                f.name: column_from_values(
+                    f.ftype, [0] if f.is_response else [None]
+                )
+                for f in raw_features
+            }
+            dead: set[str] = set()
+            for t in plan:
+                if any(nm in dead for nm in t.input_names):
+                    dead.add(t.output_name)
+                    continue
+                try:
+                    col = t.transform_columns(
+                        *[cols[nm] for nm in t.input_names], num_rows=1
+                    )
+                    # the default prediction must honor the guard too — a
+                    # NaN neutral score would otherwise fan out to every
+                    # quarantined row unsanitized (no fault hooks, no
+                    # counting; guard 'raise' mode lands in the dead set)
+                    cols[t.output_name] = guard.apply(
+                        t, col,
+                        is_result=t.output_name in result_name_set,
+                        num_rows=1, count=False,
+                    )
+                except Exception:
+                    dead.add(t.output_name)
+            _neutral["cols"] = {
+                name: None if name in dead or name not in cols else cols[name]
+                for name in result_names
+            }
+        return _neutral["cols"]
+
+    def _default_value(name: str) -> Any:
+        vals = _neutral.get("values")
+        if vals is None:
+            vals = _neutral["values"] = {
+                nm: None if col is None else col.to_list()[0]
+                for nm, col in _neutral_columns().items()
+            }
+        v = vals[name]
+        # rows must not alias one shared mutable default (Prediction maps)
+        if isinstance(v, dict):
+            return dict(v)
+        if isinstance(v, list):
+            return list(v)
+        return v
+
+    def _default_column(name: str, n: int) -> Any:
+        col = _neutral_columns()[name]
+        if col is not None:
+            return col.take(np.zeros(n, dtype=np.int64))
+        return empty_like(result_ftypes[name], n)
+
+    def _prepare_rows(
+        rows: list[dict[str, Any]],
+    ) -> tuple[list[dict[str, Any] | None], dict[int, list]]:
+        """Fault hook → schema validation, per row. Returns the sanitized
+        rows (None = quarantined) and the quarantine reasons by row index.
+        (Drift observes the BUILT raw columns afterwards — one vectorized
+        bulk merge per feature instead of a per-row histogram update.)"""
+        fp = faults.active()
+        prepared: list[dict[str, Any] | None] = []
+        invalid: dict[int, list] = {}
+        for i, row in enumerate(rows):
+            if fp is not None:
+                corrupted = fp.on_score_row(row, i)
+                if corrupted is not None:
+                    row = corrupted
+            if sentinel is not None:
+                clean, reasons = sentinel.check_row(row)
+            else:
+                clean, reasons = row, []
+            if reasons:
+                invalid[i] = reasons
+                prepared.append(None)
+            else:
+                prepared.append(clean)
+        return prepared, invalid
+
+    def _pre_open_snapshot() -> frozenset:
+        """Output names whose breaker is short-circuiting RIGHT NOW — taken
+        before a primary run so the isolation pass can honor pre-existing
+        open breakers without being blinded by ones the failure under
+        isolation just opened."""
+        return frozenset(
+            nm for nm, br in breakers.items() if br.would_short_circuit()
+        )
+
+    def _bisect_rows(
+        indices, build_cols, on_ok, on_poisoned, skip, budget=None
+    ) -> None:
+        """Binary-search the poisoning rows after a batch-level stage
+        failure: run the plan on half-batches, splitting only the failing
+        halves, down to single rows — O(k log n) plan executions for k bad
+        rows instead of n single-row re-runs. Subsets are visited left to
+        right, so callbacks fire in original row order. Breakers are never
+        touched; stages in ``skip`` (open before the primary run) stay
+        skipped. The re-run ``budget`` bounds the blowup when a stage
+        fails DETERMINISTICALLY for every row (a misdeployed model must
+        not multiply serving latency by the batch size): once exhausted,
+        remaining failing subsets are quarantined wholesale."""
+        if budget is None:
+            budget = {"left": 16 + 4 * max(1, len(indices)).bit_length()}
+        m = len(indices)
+        bb = _bucket(m)
+        cols2 = build_cols(indices, bb)
+        budget["left"] -= 1
+        _, fails2, _ = _run_plan(
+            cols2, bb, m, tuple(indices), breaker_mode="observe", skip=skip
+        )
+        if not fails2:
+            on_ok(indices, cols2, m)
+            return
+        t, e = fails2[0]
+        if m == 1:
+            on_poisoned(indices[0], t, e)
+            return
+        if budget["left"] <= 0:
+            log.warning(
+                "isolation budget exhausted: quarantining %d rows "
+                "wholesale after persistent failure of '%s'",
+                m, t.output_name,
             )
+            for i in indices:
+                on_poisoned(i, t, e)
+            return
+        mid = m // 2
+        _bisect_rows(indices[:mid], build_cols, on_ok, on_poisoned, skip, budget)
+        _bisect_rows(indices[mid:], build_cols, on_ok, on_poisoned, skip, budget)
+
+    def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        n = len(rows)
+        if n == 0:
+            return []
+        qlog.start_batch()
+        prepared, invalid = _prepare_rows(rows)
+        # quarantined rows are COMPACTED OUT before the plan runs: a bad
+        # row must never reach a stage (an all-missing placeholder could
+        # still poison one and feed the breaker), so only survivors score
+        survivors = [i for i in range(n) if i not in invalid]
         out: list[dict[str, Any]] = [{} for _ in range(n)]
-        for name in result_names:
-            # to_list renders Prediction columns as reference-keyed maps
-            rendered = cols[name].to_list()
-            for i in range(n):
-                out[i][name] = rendered[i]
+        m = len(survivors)
+        degraded: list[str] = []
+        fail_names: list[str] = []
+        failures: list = []
+        poisoned: dict[int, tuple[str, Exception]] = {}
+        if m:
+            b = _bucket(m)
+            cols = _raw_columns([prepared[i] for i in survivors], m, b)
+            if drift_sentinel.enabled:
+                # observed post codec (typed, coerced values), one
+                # vectorized bulk merge per feature; quarantined rows never
+                # reach the plan, so they are not part of the window
+                drift_sentinel.observe_columns(cols, m)
+            pre_open = _pre_open_snapshot()
+            dead, failures, cause = _run_plan(cols, b, m, tuple(survivors))
+            degraded = [nm for nm in result_names if nm in dead]
+            for name in result_names:
+                if name in degraded:
+                    continue
+                # to_list renders Prediction columns as reference-keyed maps
+                rendered = cols[name].to_list()
+                for j, i in enumerate(survivors):
+                    out[i][name] = rendered[j]
+            # per-row isolation: a fresh stage failure bisects the
+            # survivors so only the poisoning row(s) are quarantined;
+            # results dead from an OPEN breaker are NOT recovered (that
+            # would bypass the short circuit) — they degrade batch-wide
+            fail_names = [
+                nm for nm in degraded if cause.get(nm) == "failure"
+            ]
+            if failures and fail_names:
+                if m == 1:
+                    # no re-run for a single row: the batch WAS the row (a
+                    # transiently-injected fault must count exactly once)
+                    t, e = failures[0]
+                    poisoned[survivors[0]] = (t.output_name, e)
+                else:
+                    def _build(idxs, bb):
+                        return _raw_columns(
+                            [prepared[i] for i in idxs], len(idxs), bb
+                        )
+
+                    def _ok(idxs, cols2, mm):
+                        for nm in fail_names:
+                            if nm not in cols2:
+                                continue  # downstream of an open breaker
+                            rendered = cols2[nm].to_list()
+                            for j, i in enumerate(idxs):
+                                out[i][nm] = rendered[j]
+
+                    def _poison(i, t, e):
+                        poisoned[i] = (t.output_name, e)
+
+                    _bisect_rows(survivors, _build, _ok, _poison, pre_open)
+        # whatever is still missing degrades to the default prediction
+        for nm in degraded:
+            for i in survivors:
+                if nm not in out[i]:
+                    out[i][nm] = _default_value(nm)
+        for i, reasons in invalid.items():
+            for feat, kind, reason in reasons:
+                qlog.add(QuarantineRecord(i, feat, kind, reason))
+            for nm in result_names:
+                out[i][nm] = _default_value(nm)
+        for i, (stage_name, e) in poisoned.items():
+            qlog.add(QuarantineRecord(
+                i, stage_name, "stage", f"{type(e).__name__}: {e}"
+            ))
+            for nm in result_names:
+                out[i][nm] = _default_value(nm)
         return out
 
     def score_columns(dataset) -> dict[str, Any]:
@@ -137,13 +490,17 @@ def score_function(
         The counterpart of sklearn's ``pipeline.predict(dataframe)`` — the
         input is already columnar, so the per-value row-dict codec
         (``column_from_values`` per raw feature, ``to_list`` per result) is
-        skipped entirely. Rows are padded to the same power-of-two buckets
-        by replicating row 0; outputs are sliced back with ``take``."""
-        import numpy as np
-
+        skipped entirely — and with it the row-dict schema validation
+        (typed columns can't carry wrong-typed values; the drift sentinel,
+        breakers, and stage isolation still apply). Rows are padded to the
+        same power-of-two buckets by replicating row 0; outputs are sliced
+        back with ``take``. A stage failure isolates per row: poisoning
+        rows get default values in the AFFECTED result columns only (the
+        row-dict path quarantines the whole row)."""
         n = len(dataset)
         if n == 0:
             return {}
+        qlog.start_batch()
         b = _bucket(n)
         cols: dict[str, Any] = {}
         pad = None
@@ -160,26 +517,93 @@ def score_function(
                 continue
             c = dataset[f.name]
             cols[f.name] = c if pad is None else c.take(pad)
-        for t in plan:
-            ins = [cols[name] for name in t.input_names]
-            cols[t.output_name] = _guarded(
-                t, t.transform_columns(*ins, num_rows=b), n
-            )
+        if drift_sentinel.enabled:
+            drift_sentinel.observe_columns(cols, n)
+        pre_open = _pre_open_snapshot()
+        dead, failures, cause = _run_plan(cols, b, n, tuple(range(n)))
         keep = np.arange(n)
-        return {
+        degraded = [nm for nm in result_names if nm in dead]
+        out = {
             name: (cols[name] if b == n else cols[name].take(keep))
             for name in result_names
+            if name not in degraded
         }
+        fail_names = [nm for nm in degraded if cause.get(nm) == "failure"]
+        if failures and fail_names and n > 1:
+            segments: dict[str, list] = {nm: [] for nm in fail_names}
+
+            def _build(idxs, bb):
+                arr = np.asarray(
+                    list(idxs) + [idxs[0]] * (bb - len(idxs)), dtype=np.int64
+                )
+                return {f.name: cols[f.name].take(arr) for f in raw_features}
+
+            def _ok(idxs, cols2, m):
+                trim = np.arange(m)
+                for nm in fail_names:
+                    if nm not in cols2:  # downstream of an open breaker
+                        segments[nm].append(_default_column(nm, m))
+                        continue
+                    seg = cols2[nm]
+                    segments[nm].append(
+                        seg if len(seg) == m else seg.take(trim)
+                    )
+
+            def _poison(i, t, e):
+                qlog.add(QuarantineRecord(
+                    i, t.output_name, "stage", f"{type(e).__name__}: {e}"
+                ))
+                for nm in fail_names:
+                    segments[nm].append(_default_column(nm, 1))
+
+            # callbacks fire in index order, so the segments concatenate
+            # back into the original row order
+            _bisect_rows(list(range(n)), _build, _ok, _poison, pre_open)
+            for nm in fail_names:
+                try:
+                    out[nm] = concat_columns(segments[nm])
+                except Exception:  # mixed shapes: degrade the whole column
+                    out[nm] = _default_column(nm, n)
+        elif failures and fail_names:  # n == 1
+            t, e = failures[0]
+            qlog.add(QuarantineRecord(
+                0, t.output_name, "stage", f"{type(e).__name__}: {e}"
+            ))
+        for nm in degraded:
+            if nm not in out:
+                out[nm] = _default_column(nm, n)
+        return out
 
     def score_one(row: dict[str, Any]) -> dict[str, Any]:
+        # single-row scoring IS batch scoring: one shared quarantine /
+        # guard / breaker / drift path, pinned by the parity tests
         return score_batch([row])[0]
 
     def metadata() -> dict[str, Any]:
-        """Score-path health metadata: degradation counters from the guard."""
-        return {"scoreGuard": guard.stats()}
+        """Score-path health: guard + sentinel + quarantine + breaker +
+        drift counters, one report."""
+        return {
+            "scoreGuard": guard.stats(),
+            "sentinel": None if sentinel is None else sentinel.stats(),
+            "quarantine": qlog.stats(),
+            "breakers": {nm: br.stats() for nm, br in breakers.items()},
+            "drift": drift_sentinel.report(),
+        }
 
     score_one.batch = score_batch  # type: ignore[attr-defined]
     score_one.columns = score_columns  # type: ignore[attr-defined]
     score_one.guard = guard  # type: ignore[attr-defined]
+    score_one.sentinel = sentinel  # type: ignore[attr-defined]
+    score_one.breakers = breakers  # type: ignore[attr-defined]
+    score_one.drift = drift_sentinel  # type: ignore[attr-defined]
+    score_one.quarantine = qlog  # type: ignore[attr-defined]
     score_one.metadata = metadata  # type: ignore[attr-defined]
+    # the model keeps weak references to its live score functions so
+    # summary_pretty() can report serve-side resilience counters next to
+    # the train-side retry ledger
+    monitors = getattr(model, "_serving_monitors", None)
+    if monitors is None:
+        monitors = model._serving_monitors = []  # type: ignore[attr-defined]
+    monitors[:] = [r for r in monitors if r() is not None]  # prune dead refs
+    monitors.append(weakref.ref(score_one))
     return score_one

@@ -14,6 +14,7 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 
 from ..dataset import Dataset
 from ..features.feature import Feature
+from ..resilience.retry import TransientError
 from .core import SimpleReader
 
 log = logging.getLogger(__name__)
@@ -61,6 +62,13 @@ class FileStreamingReader(StreamingReader):
     files are retried on the next poll (and logged), not silently dropped.
     """
 
+    #: retry policy for chunk fetches — None picks the module default
+    #: (resilience.retry.default_io_policy). A transient error mid-fetch
+    #: (flaky NFS, object-store hiccup) backs off and retries INSIDE one
+    #: poll before the defer-to-next-poll path even engages; fatal errors
+    #: (bad format, permissions) fail immediately as before.
+    retry_policy = None
+
     def __init__(
         self,
         directory: str,
@@ -84,6 +92,27 @@ class FileStreamingReader(StreamingReader):
         self.headers = list(headers) if headers is not None else None
         self.has_header = has_header
         self.settle_s = settle_s
+
+    def _fetch_chunk(self, path: str) -> list:
+        """One chunk fetch behind the RetryPolicy: transient errors (and
+        injected ``fail_chunk_read`` faults) back off and retry before the
+        caller's defer/drop handling sees anything."""
+        from ..resilience import faults
+        from ..resilience.retry import default_io_policy
+
+        def fetch() -> list:
+            plan = faults.active()
+            if plan is not None:
+                plan.on_stream_chunk(path)
+            return self._read_file(path)
+
+        policy = self.retry_policy or default_io_policy()
+        records, attempts = policy.call(fetch)
+        if attempts > 1:
+            log.warning(
+                "stream chunk %s fetched after %d attempts", path, attempts
+            )
+        return records
 
     def _read_file(self, path: str) -> list:
         if path.endswith(".avro"):
@@ -160,8 +189,11 @@ class FileStreamingReader(StreamingReader):
                             )
                         return None, False
                 try:
-                    records = self._read_file(p)
-                except OSError as e:
+                    records = self._fetch_chunk(p)
+                except (OSError, TimeoutError, TransientError) as e:
+                    # the RetryPolicy exhausted its attempts on a transient
+                    # error (or the error was fatal): defer to the next
+                    # poll / final retry exactly as before
                     if final:
                         log.error(
                             "stream file %s dropped after retry (%s)", p, e

@@ -1,15 +1,19 @@
-"""Fault tolerance for training and scoring (ISSUE 1).
+"""Fault tolerance for training and scoring (ISSUE 1 + ISSUE 2).
 
-Four pieces, wired through the workflow stack:
+Five pieces, wired through the workflow stack:
 
 * :mod:`.retry` — ``RetryPolicy``: exponential backoff + seeded jitter +
   deadline over transient-classified errors, with an injectable clock;
 * :mod:`.checkpoint` — ``CheckpointManager``: atomic per-layer fitted-stage
   checkpoints and per-candidate CV checkpoints (manifest+npz format);
 * :mod:`.faults` — ``FaultPlan``: deterministic seeded fault injection
-  (fit failures, mid-DAG crashes, NaN corruption, torn files);
+  (fit failures, mid-DAG crashes, NaN corruption, torn files, malformed
+  serving rows, torn profiles, drifted streams, stage/chunk failures);
 * :mod:`.guards` — ``ScoreGuard``: NaN/Inf containment at score time with
-  per-stage fallback and degradation counters.
+  per-stage fallback and degradation counters;
+* :mod:`.sentinel` — serving sentinels: ``SchemaSentinel`` row validation,
+  per-row quarantine, ``DriftSentinel`` train/serve skew detection, and a
+  per-stage ``CircuitBreaker`` with deadline (ISSUE 2).
 """
 from .checkpoint import CheckpointError, CheckpointManager, dag_signature  # noqa: F401
 from .faults import FaultPlan, SimulatedCrash, installed  # noqa: F401
@@ -20,4 +24,15 @@ from .retry import (  # noqa: F401
     TransientError,
     default_io_policy,
     is_transient,
+)
+from .sentinel import (  # noqa: F401
+    BreakerConfig,
+    CircuitBreaker,
+    DriftConfig,
+    DriftSentinel,
+    QuarantineRecord,
+    SchemaSentinel,
+    SchemaViolationError,
+    SentinelPolicy,
+    compute_serving_profiles,
 )

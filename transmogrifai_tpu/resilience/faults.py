@@ -59,6 +59,11 @@ class FaultPlan:
         self._candidate_faults: list[dict[str, Any]] = []
         self._crash_layers: list[dict[str, Any]] = []
         self._nan_faults: list[dict[str, Any]] = []
+        self._transform_faults: list[dict[str, Any]] = []
+        self._row_faults: list[dict[str, Any]] = []
+        self._profile_faults: list[dict[str, Any]] = []
+        self._drift_faults: list[dict[str, Any]] = []
+        self._chunk_faults: list[dict[str, Any]] = []
         #: chronological record of fired faults: (kind, detail)
         self.fired: list[tuple[str, str]] = []
 
@@ -105,6 +110,71 @@ class FaultPlan:
         NaN (numeric / vector / prediction columns)."""
         self._nan_faults.append(
             {"target": target, "rows": tuple(rows), "times": times, "count": 0}
+        )
+        return self
+
+    # ------------------------------------------------ serving-path faults
+    def fail_stage_transform(
+        self,
+        target: str | None = None,
+        rows: tuple[int, ...] | None = None,
+        times: int | None = 1,
+        transient: bool = True,
+    ) -> "FaultPlan":
+        """Raise when a matching stage executes on the scoring path.
+        ``rows`` limits firing to executions covering any of those original
+        row indices (so per-row isolation re-runs only re-fail for the
+        poisoned rows); ``times=None`` means unlimited."""
+        self._transform_faults.append(
+            {"target": target, "rows": None if rows is None else set(rows),
+             "times": times, "count": 0, "transient": transient}
+        )
+        return self
+
+    def malform_row(
+        self,
+        feature: str,
+        rows: tuple[int, ...] = (0,),
+        value: Any = "##not-a-number##",
+        times: int | None = None,
+    ) -> "FaultPlan":
+        """Corrupt ``feature`` in the given incoming rows before schema
+        validation (the malformed-producer scenario). Unlimited by default
+        so score_one/score_batch parity tests replay the same corruption."""
+        self._row_faults.append(
+            {"feature": feature, "rows": set(rows), "value": value,
+             "times": times, "count": 0}
+        )
+        return self
+
+    def tear_profile(
+        self, feature: str | None = None, times: int | None = None
+    ) -> "FaultPlan":
+        """Drop a matching training profile at drift-sentinel build time —
+        the torn-artifact scenario (monitoring must degrade, not scoring)."""
+        self._profile_faults.append(
+            {"feature": feature, "times": times, "count": 0}
+        )
+        return self
+
+    def shift_feature(
+        self, feature: str, offset: float, times: int | None = None
+    ) -> "FaultPlan":
+        """Shift every observed value of ``feature`` at the drift sentinel's
+        intake — a deterministic drifted stream without regenerating data."""
+        self._drift_faults.append(
+            {"feature": feature, "offset": float(offset), "times": times,
+             "count": 0}
+        )
+        return self
+
+    def fail_chunk_read(
+        self, times: int = 1, transient: bool = True
+    ) -> "FaultPlan":
+        """Raise on streaming-reader chunk fetches (readers/streaming.py) —
+        exercises the chunk-level RetryPolicy."""
+        self._chunk_faults.append(
+            {"times": times, "count": 0, "transient": transient}
         )
         return self
 
@@ -158,6 +228,97 @@ class FaultPlan:
                 self.fired.append(("candidate", name))
                 exc = TransientError if f["transient"] else FatalError
                 raise exc(f"injected candidate failure on {name}")
+
+    def on_stage_transform(
+        self, stage: Any, row_indices: tuple[int, ...] | None = None
+    ) -> None:
+        """Serving-path stage execution hook (local/scoring.py).
+        ``row_indices`` are the ORIGINAL batch indices covered by this
+        execution (per-row isolation re-runs pass a single index)."""
+        with self._lock:
+            for f in self._transform_faults:
+                if f["times"] is not None and f["count"] >= f["times"]:
+                    continue
+                if f["target"] is not None and not _matches(stage, f["target"]):
+                    continue
+                if f["rows"] is not None and (
+                    row_indices is None or not f["rows"].intersection(row_indices)
+                ):
+                    continue
+                f["count"] += 1
+                if f["count"] == 1:
+                    self.fired.append(("transform", stage.output_name))
+                exc = TransientError if f["transient"] else FatalError
+                raise exc(
+                    f"injected transform failure on "
+                    f"{type(stage).__name__}({stage.uid})"
+                )
+
+    def on_score_row(self, row: dict, index: int) -> dict | None:
+        """Return a corrupted copy of an incoming row, or None to keep it."""
+        with self._lock:
+            out = None
+            for f in self._row_faults:
+                if f["times"] is not None and f["count"] >= f["times"]:
+                    continue
+                if index not in f["rows"]:
+                    continue
+                f["count"] += 1
+                if out is None:
+                    out = dict(row)
+                out[f["feature"]] = f["value"]
+                self.fired.append(("malform", f"{f['feature']}@{index}"))
+            return out
+
+    def on_profile_load(self, name: str) -> bool:
+        """True = tear this training profile (drift sentinel build time)."""
+        with self._lock:
+            for f in self._profile_faults:
+                if f["times"] is not None and f["count"] >= f["times"]:
+                    continue
+                if f["feature"] is not None and f["feature"] != name:
+                    continue
+                f["count"] += 1
+                self.fired.append(("profile", name))
+                return True
+        return False
+
+    def wants_drift(self, name: str) -> bool:
+        """Cheap pre-check so the drift sentinel only leaves its
+        vectorized bulk path when a shift fault actually targets this
+        feature (an installed plan with unrelated faults must not force a
+        per-value Python loop over every serving batch)."""
+        return any(f["feature"] == name for f in self._drift_faults)
+
+    def on_drift_observe(self, name: str, value: Any) -> Any:
+        """Possibly shift a value at the drift sentinel's intake. Fires per
+        value; only the FIRST firing per fault lands in ``fired`` (a stream
+        fires thousands of times)."""
+        with self._lock:
+            for f in self._drift_faults:
+                if f["feature"] != name:
+                    continue
+                if f["times"] is not None and f["count"] >= f["times"]:
+                    continue
+                f["count"] += 1
+                if f["count"] == 1:
+                    self.fired.append(("drift", name))
+                try:
+                    value = float(value) + f["offset"]
+                except (TypeError, ValueError):
+                    pass
+        return value
+
+    def on_stream_chunk(self, path: str) -> None:
+        """Streaming-reader chunk fetch hook (readers/streaming.py)."""
+        with self._lock:
+            for f in self._chunk_faults:
+                if f["count"] >= f["times"]:
+                    continue
+                f["count"] += 1
+                self.fired.append(("chunk", path))
+                exc = TransientError if f["transient"] else FatalError
+                raise exc(f"injected chunk-read failure on {path}")
 
     def on_stage_output(self, stage: Any, column: Any) -> Any | None:
         """Return a corrupted replacement column, or None to keep the

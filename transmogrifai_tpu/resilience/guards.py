@@ -81,12 +81,16 @@ class ScoreGuard:
         column: Any,
         is_result: bool = True,
         num_rows: int | None = None,
+        count: bool = True,
     ) -> Any:
         """Return ``column`` (possibly sanitized); raises under 'raise'.
         ``num_rows`` bounds the rows that COUNT: scoring pads batches to
         power-of-two buckets by replicating row 0, and those replicas must
         not inflate the degradation counters or error messages (the whole
-        column is still sanitized — padding is sliced off by the caller)."""
+        column is still sanitized — padding is sliced off by the caller).
+        ``count=False`` sanitizes without counting or logging — the
+        per-row isolation re-runs re-execute stages whose degradation the
+        primary run already counted."""
         mode = self.mode_for(stage, is_result=is_result)
         if mode == MODE_OFF:
             return column
@@ -103,11 +107,12 @@ class ScoreGuard:
                 f"non-finite values in {n_bad} row(s) of "
                 f"'{stage.output_name}'"
             )
-        self.counts[stage.output_name] += n_bad
-        log.warning(
-            "score guard: %d non-finite row(s) in '%s' replaced with "
-            "defaults", n_bad, stage.output_name,
-        )
+        if count:
+            self.counts[stage.output_name] += n_bad
+            log.warning(
+                "score guard: %d non-finite row(s) in '%s' replaced with "
+                "defaults", n_bad, stage.output_name,
+            )
         return sanitized
 
 

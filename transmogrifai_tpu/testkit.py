@@ -435,11 +435,22 @@ class RandomVector:
 def fault_plan(seed: int = 42) -> "Any":
     """A fresh resilience ``FaultPlan`` — the deterministic fault-injection
     harness (raise on the Nth fit, crash after a layer, NaN a stage output,
-    tear a file). Install it over a block with ``install_faults``::
+    tear a file; serving side: malform incoming rows, fail a scoring
+    stage, tear a training profile, shift a feature's observed stream,
+    fail streaming chunk reads). Install it over a block with
+    ``install_faults``::
 
         plan = testkit.fault_plan().crash_after_layer(1)
         with testkit.install_faults(plan):
             workflow.train(checkpoint_dir=d)   # dies after layer 1
+
+        plan = (testkit.fault_plan()
+                .malform_row("age", rows=(2,))         # quarantine row 2
+                .fail_stage_transform("pred", times=3)  # trip the breaker
+                .shift_feature("age", offset=50.0))     # drifted stream
+        with testkit.install_faults(plan):
+            fn = score_function(model)
+            fn.batch(rows)
     """
     from .resilience.faults import FaultPlan
 
@@ -452,6 +463,23 @@ def install_faults(plan: "Any"):
     from .resilience.faults import installed
 
     return installed(plan)
+
+
+def drifted(generator: RandomGenerator, offset: float) -> RandomGenerator:
+    """A shifted copy of a numeric generator — the covariate-shifted serve
+    stream for drift-sentinel tests (same seed, same draw sequence, every
+    value offset by ``offset``)."""
+    inner = generator._producer
+    if isinstance(inner, _StatefulProducer):
+        raise TypeError("drifted() supports stateless numeric generators")
+
+    def producer(r: np.random.Generator):
+        return float(inner(r)) + offset
+
+    return RandomGenerator(
+        generator.ftype, producer,
+        generator.probability_of_empty, generator.seed,
+    )
 
 
 # ----------------------------------------------------------------- RandomData

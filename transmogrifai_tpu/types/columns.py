@@ -404,6 +404,11 @@ _STORAGE_DTYPE = {
     Storage.BINARY: bool,
 }
 
+#: string forms the Binary codec reads as True / False — shared with the
+#: serving SchemaSentinel so validation and coercion can never disagree
+TRUE_TOKENS = frozenset(("true", "1", "1.0", "yes", "t"))
+FALSE_TOKENS = frozenset(("false", "0", "0.0", "no", "f"))
+
 
 def column_from_values(feature_type: type, raw: Sequence[Any]) -> Column:
     """Build the right physical column for ``feature_type`` from row values.
@@ -438,7 +443,7 @@ def column_from_values(feature_type: type, raw: Sequence[Any]) -> Column:
                 return None
             if storage is Storage.BINARY:
                 if isinstance(v, str):
-                    return v.strip().lower() in ("true", "1", "1.0", "yes", "t")
+                    return v.strip().lower() in TRUE_TOKENS
                 return bool(v)
             if isinstance(v, str):
                 v = v.strip()
@@ -488,6 +493,51 @@ def column_from_values(feature_type: type, raw: Sequence[Any]) -> Column:
             )
         return VectorColumn(feature_type, arr)
     raise ValueError(f"No physical column for storage {storage}")
+
+
+def concat_columns(cols: Sequence[Column]) -> Column:
+    """Row-wise concatenation of same-typed columns — the inverse of
+    per-row ``take`` slicing (used by the serving path to stitch per-row
+    isolation results back into one batch column)."""
+    c0 = cols[0]
+    if len(cols) == 1:
+        return c0
+    if isinstance(c0, NumericColumn):
+        return NumericColumn(
+            c0.feature_type,
+            np.concatenate([c.values for c in cols]),
+            np.concatenate([c.mask for c in cols]),
+        )
+    if isinstance(c0, TextColumn):
+        return TextColumn(
+            c0.feature_type, np.concatenate([c.values for c in cols])
+        )
+    if isinstance(c0, (SetColumn, ListColumn, MapColumn)):
+        return type(c0)(
+            c0.feature_type, [v for c in cols for v in c.values]
+        )
+    if isinstance(c0, VectorColumn):
+        return VectorColumn(
+            c0.feature_type,
+            np.concatenate(
+                [np.asarray(c.values, dtype=np.float32) for c in cols], axis=0
+            ),
+            c0.metadata,
+        )
+    if isinstance(c0, PredictionColumn):
+        def _cat(field):
+            parts = [getattr(c, field) for c in cols]
+            if any(p is None for p in parts):
+                return None  # mixed shapes degrade to prediction-only
+            return np.concatenate([np.asarray(p) for p in parts], axis=0)
+
+        return PredictionColumn(
+            c0.feature_type,
+            np.concatenate([np.asarray(c.prediction) for c in cols]),
+            _cat("probability"),
+            _cat("raw"),
+        )
+    raise TypeError(f"cannot concatenate {type(c0).__name__}")
 
 
 def empty_like(feature_type: type, n: int) -> Column:

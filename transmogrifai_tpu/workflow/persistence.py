@@ -205,6 +205,7 @@ def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F82
         "rffResults": model.rff_results,
         "blocklisted": model.blocklisted,
         "sensitiveFeatures": model.sensitive_info,
+        "servingProfiles": model.serving_profiles,
     }
     atomic_write_model_dir(path, manifest, arrays)
 
@@ -295,4 +296,6 @@ def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
         rff_results=manifest.get("rffResults"),
         blocklisted=manifest.get("blocklisted", []),
         sensitive_info=manifest.get("sensitiveFeatures"),
+        # absent on pre-drift-sentinel saves: the sentinel just stays inert
+        serving_profiles=manifest.get("servingProfiles"),
     )

@@ -341,6 +341,14 @@ class Workflow:
                 fitted_data, selector_info, self.result_features
             )
 
+        # serving-drift profiles (resilience/sentinel.py): per-raw-feature
+        # fill rate + value histogram over the training rows, persisted in
+        # the model artifact so score_function's drift sentinel can compare
+        # the live stream against what the model was trained on
+        from ..resilience.sentinel import compute_serving_profiles
+
+        serving_profiles = compute_serving_profiles(train_data, raw_features)
+
         model = WorkflowModel(
             result_features=self.result_features,
             raw_features=tuple(raw_features),
@@ -353,6 +361,7 @@ class Workflow:
             sensitive_info=sensitive_info,
             label_summary=label_summary,
             training_params=dict(self._stage_overrides),
+            serving_profiles=serving_profiles,
         )
         if selector is not None:
             # keep the live evaluator object so custom evaluators keep working
@@ -421,6 +430,7 @@ class WorkflowModel:
         sensitive_info: list[dict[str, Any]] | None = None,
         label_summary: dict[str, Any] | None = None,
         training_params: dict[str, Any] | None = None,
+        serving_profiles: dict[str, Any] | None = None,
     ):
         self.result_features = result_features
         self.raw_features = raw_features
@@ -433,6 +443,10 @@ class WorkflowModel:
         self.sensitive_info = sensitive_info
         self.label_summary = label_summary
         self.training_params = training_params or {}
+        #: per-raw-feature training distributions for the serve-time drift
+        #: sentinel (fill rate + StreamingHistogram JSON); None on models
+        #: saved before this field existed
+        self.serving_profiles = serving_profiles
 
     # --------------------------------------------------------- persistence
     def save(self, path: str) -> None:
@@ -715,8 +729,44 @@ class WorkflowModel:
                 lines.extend(ilines)  # all-or-nothing: no dangling headers
             except Exception as e:  # insights are best-effort here
                 log.debug("summary_pretty insights skipped: %s", e)
+        serve = self._serving_resilience_line()
+        if serve:
+            lines.append(serve)
         lines.append(
             f"Trained on {s['trainRows']} rows (holdout {s['holdoutRows']}); "
             f"{len(s['rawFeatures'])} raw features"
         )
         return "\n".join(lines)
+
+    def _serving_resilience_line(self) -> str | None:
+        """Aggregate serve-side counters from every live score function
+        built off this model (local.scoring keeps weak references), so one
+        report covers train-side retries AND serve-side degradation."""
+        quarantined = guarded = drift_alerts = breaker_trips = 0
+        seen = False
+        for ref in getattr(self, "_serving_monitors", []):
+            fn = ref()
+            if fn is None:
+                continue
+            try:
+                md = fn.metadata()
+            except Exception as e:  # monitoring must never break the summary
+                log.debug("serving monitor skipped: %s", e)
+                continue
+            seen = True
+            quarantined += md["quarantine"]["quarantinedRows"]
+            guarded += md["scoreGuard"]["guardedRows"]
+            drift = md.get("drift") or {}
+            drift_alerts += drift.get("driftAlertsTotal", 0)
+            for br in md["breakers"].values():
+                t = br["transitions"]
+                breaker_trips += t.get("closed->open", 0) + t.get(
+                    "half_open->open", 0
+                )
+        if not seen:
+            return None
+        return (
+            f"Serving resilience: {quarantined} quarantined row(s), "
+            f"{guarded} guarded row(s), {drift_alerts} drift alert(s), "
+            f"{breaker_trips} breaker trip(s)"
+        )
