@@ -1,0 +1,747 @@
+"""Distributed-training resilience suite (resilience/distributed.py):
+heartbeat/straggler sentinel, collective guard, elastic degraded-mesh
+failover, mesh-shape-portable checkpoints, and the per-host ingest retry.
+
+Hosts are SIMULATED: the 8-device CPU mesh is partitioned into host blocks
+and every failure is scripted through the seeded FaultPlan with injectable
+clocks — zero real sleeps, zero real process kills, deterministic replay
+(pyproject marker: dist)."""
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.parallel import (
+    global_column_stats,
+    ingest_global_array,
+    make_mesh,
+    make_multihost_mesh,
+    read_host_block,
+)
+from transmogrifai_tpu.parallel.reductions import pcolumn_stats
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.resilience import (
+    CheckpointMeshMismatch,
+    CollectiveGuard,
+    FailoverController,
+    FaultPlan,
+    HeartbeatConfig,
+    HostLostError,
+    HostSentinel,
+    RetryPolicy,
+    SimulatedCrash,
+    adopt_orphans,
+    host_blocks,
+    installed,
+    installed_controller,
+    mesh_fingerprint,
+)
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.dag import compute_dag
+from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+
+pytestmark = pytest.mark.dist
+
+GRID = {"reg_param": [0.01, 0.1], "elastic_net_param": [0.1]}
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, d):
+        self.now += d
+
+
+def _config(**kw):
+    clk = FakeClock()
+    kw.setdefault("clock", clk)
+    return HeartbeatConfig(**kw), clk
+
+
+def _binary_ds(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = (x1 + 0.5 * x2 + 0.3 * rng.normal(size=n) > 0).astype(float)
+    return Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+    })
+
+
+def _graph(ds, seed=5):
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    checked = resp.transform_with(
+        SanityChecker(remove_bad_features=True), vec
+    )
+    selector = BinaryClassificationModelSelector(
+        seed=seed, models=[(LogisticRegression(), GRID)], num_folds=2
+    )
+    pred = selector.set_input(resp, checked).get_output()
+    return pred, selector
+
+
+def _reference_model(ds):
+    """Fault-free reference run (fresh uids, identical construction)."""
+    uid_util.reset()
+    pred, _ = _graph(ds)
+    model = (
+        Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    )
+    return pred, model
+
+
+def _assert_same_scores(model_a, name_a, model_b, name_b, ds):
+    """Predictions must be IDENTICAL; probabilities may drift by float32
+    reduction-order noise when the mesh shape changed (different psum
+    trees through the solver iterations)."""
+    sa = model_a.score(dataset=ds)[name_a]
+    sb = model_b.score(dataset=ds)[name_b]
+    np.testing.assert_array_equal(
+        np.asarray(sa.prediction), np.asarray(sb.prediction)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sa.probability), np.asarray(sb.probability), atol=1e-3
+    )
+
+
+# ------------------------------------------------------------ host sentinel
+class TestHostSentinel:
+    def test_heartbeat_timeout_declares_dead(self):
+        cfg, clk = _config(timeout=10.0)
+        s = HostSentinel(range(4), cfg)
+        clk.advance(5.0)
+        s.beat_all()
+        clk.advance(8.0)
+        assert s.dead_hosts() == []
+        clk.advance(3.0)  # 11s since the last beat
+        assert s.dead_hosts() == [0, 1, 2, 3]
+
+    def test_dropped_heartbeats_age_one_host_out(self):
+        cfg, clk = _config(timeout=10.0)
+        s = HostSentinel(range(3), cfg)
+        plan = FaultPlan().drop_heartbeat(2)
+        with installed(plan):
+            clk.advance(6.0)
+            s.beat_all()          # host 2's beat is swallowed
+            clk.advance(6.0)
+            s.beat_all()
+            assert s.dead_hosts() == [2]
+        assert s.counters["heartbeatsDropped"] == 2
+        assert ("heartbeat", "2") in plan.fired
+
+    def test_lost_hosts_leave_the_live_set(self):
+        s = HostSentinel(range(3), _config()[0])
+        s.declare_lost(1)
+        assert s.live_hosts() == [0, 2]
+        assert s.stats()["lostHosts"] == [1]
+
+    def test_p99_adaptive_deadline(self):
+        cfg, _ = _config(min_deadline=0.01, straggler_multiplier=3.0)
+        s = HostSentinel(range(2), cfg)
+        assert s.deadline_for("pxtx") == 0.01  # cold start: the floor
+        for _ in range(100):
+            s.record_duration("pxtx", 0.1)
+        assert s.deadline_for("pxtx") == pytest.approx(0.3, rel=1e-6)
+        # the floor still wins when history is fast
+        cfg2, _ = _config(min_deadline=5.0, straggler_multiplier=3.0)
+        s2 = HostSentinel(range(2), cfg2)
+        s2.record_duration("pxtx", 0.1)
+        assert s2.deadline_for("pxtx") == 5.0
+
+
+# --------------------------------------------------------- collective guard
+class TestCollectiveGuard:
+    def _guard(self, **cfg_kw):
+        # min_samples=0 enforces the cold-start floor immediately — the
+        # grace path has its own test below
+        cfg_kw.setdefault("min_samples", 0)
+        cfg, clk = _config(**cfg_kw)
+        sentinel = HostSentinel(range(4), cfg)
+        return CollectiveGuard(
+            sentinel, max_retries=cfg.max_collective_retries
+        ), sentinel, clk
+
+    def test_straggler_retries_then_succeeds(self):
+        guard, sentinel, _ = self._guard(min_deadline=30.0)
+        plan = FaultPlan().straggle_collective(
+            "pcolumn_stats", delay=1e6, times=1
+        )
+        with installed(plan):
+            out = guard.run("pcolumn_stats", lambda: "ok")
+        assert out == "ok"
+        assert guard.counters["collectivesRetried"] == 1
+        assert sentinel.counters["stragglersDetected"] == 1
+        assert plan.fired == [("straggle", "pcolumn_stats")]
+
+    def test_persistent_straggler_declares_host_dead(self):
+        guard, _, _ = self._guard(min_deadline=30.0, max_collective_retries=1)
+        plan = FaultPlan().straggle_collective(
+            "pxtx", delay=1e6, host=3, times=5
+        )
+        with installed(plan):
+            with pytest.raises(HostLostError) as ei:
+                guard.run("pxtx", lambda: "never-counted")
+        assert ei.value.host == 3
+        assert "deadline" in ei.value.reason
+
+    def test_cold_start_slow_collective_is_accepted_not_killed(self):
+        """Default min_samples=1: with no duration history, a slow first
+        call seeds the deadline instead of escalating — a healthy cluster
+        whose reductions legitimately exceed the 30s floor (XLA compile,
+        big data) must never lose a host over an unknown baseline."""
+        guard, sentinel, _ = self._guard(min_samples=1)
+        plan = FaultPlan().straggle_collective("pxtx", delay=1e6, times=1)
+        with installed(plan):
+            out = guard.run("pxtx", lambda: "kept")
+        assert out == "kept"
+        assert guard.counters["collectivesRetried"] == 0
+        assert sentinel.counters["stragglersDetected"] == 0
+        # the slow observation raised the adaptive deadline for next time
+        assert sentinel.deadline_for("pxtx") > 1e6
+
+    def test_solo_host_straggler_is_monitored_never_escalated(self):
+        """One live host has no one to fail over to: the straggler is
+        counted but the (correct) result is kept — the default
+        single-process controller can never abort a healthy train."""
+        cfg, _ = _config(min_samples=0)
+        sentinel = HostSentinel(range(1), cfg)
+        guard = CollectiveGuard(sentinel, max_retries=1)
+        plan = FaultPlan().straggle_collective("pxtx", delay=1e6, times=5)
+        with installed(plan):
+            out = guard.run("pxtx", lambda: "kept")
+        assert out == "kept"
+        assert sentinel.counters["stragglersDetected"] == 1
+        assert guard.counters["collectivesRetried"] == 0
+
+    def test_recovered_straggler_does_not_blind_the_detector(self):
+        """An enforced miss records at most the deadline: one recovered
+        600s stall must not 10x the p99 and mask every later straggler."""
+        guard, sentinel, _ = self._guard(min_deadline=30.0)
+        plan = FaultPlan().straggle_collective("pxtx", delay=600.0, times=1)
+        with installed(plan):
+            assert guard.run("pxtx", lambda: "ok") == "ok"
+        # window holds [clamped 30, fast retry] — deadline stays anchored
+        assert sentinel.deadline_for("pxtx") <= 300.0
+
+    def test_single_device_host_loss_is_unrecoverable(self):
+        """mesh=None has one participant; losing it cannot fail over —
+        the error re-raises instead of 'continuing' on the dead host."""
+        controller = FailoverController(n_hosts=1).bind(None)
+        with pytest.raises(HostLostError):
+            controller.failover(HostLostError(0, reason="test"))
+        assert controller.counters["failovers"] == 0
+
+    def test_fail_host_during_collective(self):
+        guard, _, _ = self._guard()
+        plan = FaultPlan().fail_host(2, collective="phistogram")
+        with installed(plan):
+            with pytest.raises(HostLostError) as ei:
+                guard.run("phistogram", lambda: "unreached")
+        assert ei.value.host == 2
+        assert plan.fired == [("host", "2@phistogram")]
+
+    def test_guarded_reduction_end_to_end(self, rng):
+        """pcolumn_stats behind an installed controller: the injected
+        straggler burns one retry, the retried result matches numpy."""
+        mesh = make_mesh()
+        controller = FailoverController(
+            n_hosts=4, config=HeartbeatConfig(min_samples=0)
+        ).bind(mesh)
+        x = rng.normal(size=(64, 5)) * 2 + 1
+        plan = FaultPlan().straggle_collective(
+            "pcolumn_stats", delay=1e6, times=1
+        )
+        with installed_controller(controller), installed(plan):
+            stats = pcolumn_stats(x.astype(np.float32), mesh)
+        assert controller.guard.counters["collectivesRetried"] == 1
+        np.testing.assert_allclose(stats["mean"], x.mean(0), atol=1e-4)
+
+
+# ------------------------------------------------- row blocks / re-slicing
+class TestRowResharding:
+    def test_host_blocks_partition_everything(self):
+        blocks = host_blocks(103, 4)
+        assert blocks[0] == slice(0, 26)
+        assert blocks[-1].stop == 103
+        covered = np.concatenate([np.arange(s.start, s.stop) for s in blocks])
+        np.testing.assert_array_equal(covered, np.arange(103))
+
+    def test_host_blocks_pad_multiple_matches_host_row_slice(self):
+        """With pad_multiple = the mesh's total device count, host_blocks
+        reproduces host_row_slice's padded-space chunking — the form that
+        feeds make_global_array (trailing hosts own part padding)."""
+        from transmogrifai_tpu.parallel import host_row_slice, padded_rows
+
+        mesh = make_multihost_mesh()  # 8 devices, 1 process
+        # single process: host_row_slice(10, mesh) = all real rows, chunk
+        # derived from the padded space (12 rows on 8 devices)
+        assert host_blocks(10, 1, pad_multiple=8)[0] == host_row_slice(10, mesh)
+        # the multi-host shape: padded to 16 on 8 devices, chunk 8 per
+        # host -> [0:8), [8:10) — host 1's block is part padding
+        blocks = host_blocks(10, 2, pad_multiple=8)
+        assert blocks == [slice(0, 8), slice(8, 10)]
+        assert padded_rows(10, mesh) // 2 == 8
+
+    def test_adopt_orphans_covers_all_rows(self):
+        blocks = adopt_orphans(103, 4, lost=[2])
+        assert len(blocks) == 3
+        covered = np.concatenate([np.arange(s.start, s.stop) for s in blocks])
+        np.testing.assert_array_equal(covered, np.arange(103))
+        with pytest.raises(ValueError, match="surviving"):
+            adopt_orphans(10, 2, lost=[0, 1])
+
+    def test_repartitioned_stats_are_bit_identical(self, rng):
+        """The commutative-reduce contract: re-slicing the row space over
+        fewer hosts feeds the SAME global array to the same mesh, so the
+        statistics match bit for bit."""
+        mesh = make_multihost_mesh()
+        x = (rng.normal(size=(103, 3)) * 3 + 5).astype(np.float32)
+        before = global_column_stats(x, mesh, 103)
+        order = np.concatenate([
+            np.arange(s.start, s.stop) for s in adopt_orphans(103, 4, [1])
+        ])
+        after = global_column_stats(x[order], mesh, 103)
+        assert before["count"] == after["count"]
+        np.testing.assert_array_equal(before["mean"], after["mean"])
+        np.testing.assert_array_equal(before["var"], after["var"])
+
+    def test_mesh_fingerprint(self):
+        assert mesh_fingerprint(None) == {
+            "deviceCount": 1, "axes": {}, "layout": "replicated",
+        }
+        fp = mesh_fingerprint(make_mesh())
+        assert fp["deviceCount"] == 8
+        assert fp["axes"] == {"data": 8, "model": 1}
+
+
+# ------------------------------------------------------- per-host ingest
+class TestHostIngestRetry:
+    def test_transient_chunk_read_retries(self):
+        clk_sleeps = []
+        policy = RetryPolicy(
+            max_attempts=3, base_delay=1.0, jitter=0.0,
+            sleep=clk_sleeps.append, clock=lambda: 0.0,
+        )
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        plan = FaultPlan().fail_chunk_read(times=2)
+        with installed(plan):
+            block = read_host_block(
+                lambda sl: x[sl], 20, retry_policy=policy
+            )
+        np.testing.assert_array_equal(block, x)
+        assert len(clk_sleeps) == 2  # two backoffs, zero real seconds
+        assert len(plan.fired) == 2 and plan.fired[0][0] == "chunk"
+
+    def test_fatal_chunk_read_fails_immediately(self):
+        policy = RetryPolicy(max_attempts=5, sleep=lambda d: None)
+        plan = FaultPlan().fail_chunk_read(times=1, transient=False)
+        with installed(plan):
+            with pytest.raises(Exception, match="injected chunk-read"):
+                read_host_block(
+                    lambda sl: np.zeros((20, 2)), 20, retry_policy=policy
+                )
+        assert len(plan.fired) == 1
+
+    def test_ingest_global_array_roundtrip(self, rng):
+        mesh = make_multihost_mesh()
+        x = rng.normal(size=(103, 3)).astype(np.float32)
+        plan = FaultPlan().fail_chunk_read(times=1)
+        policy = RetryPolicy(max_attempts=3, sleep=lambda d: None)
+        with installed(plan):
+            g = ingest_global_array(lambda sl: x[sl], 103, mesh, policy)
+        assert g.shape[0] == 104  # padded to the 8-device multiple
+        np.testing.assert_allclose(np.asarray(g)[:103], x, rtol=1e-6)
+
+    def test_ingest_global_array_requires_a_mesh(self):
+        with pytest.raises(ValueError, match="requires a mesh"):
+            ingest_global_array(lambda sl: np.zeros((4, 2)), 4, None)
+
+
+# --------------------------------------------------- workflow failover
+class TestElasticFailover:
+    def test_host_loss_after_layer_resumes_on_degraded_mesh(self, tmp_path):
+        """Acceptance: a seeded FaultPlan kills one simulated host
+        mid-train; the run completes on the degraded mesh with predictions
+        identical to the fault-free run."""
+        ds = _binary_ds()
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4)
+        plan = FaultPlan().fail_host(1, after_layer=k)
+        with installed_controller(controller), installed(plan):
+            model = wf.train(checkpoint_dir=str(tmp_path / "ck"))
+        assert plan.fired == [("host", f"1@layer-{k}")]
+        assert controller.counters["hostsLost"] == 1
+        assert controller.counters["failovers"] == 1
+        assert controller.sentinel.lost == [1]
+        # 8 devices, 4 hosts of 2 -> 6 devices after the loss
+        assert [m["deviceCount"] for m in controller.mesh_history] == [8, 6]
+
+        pred_ref, ref = _reference_model(ds)
+        _assert_same_scores(model, pred.name, ref, pred_ref.name, ds)
+
+    def test_host_loss_without_checkpoint_still_fails_over(self):
+        ds = _binary_ds(n=120, seed=7)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4)
+        plan = FaultPlan().fail_host(0, after_layer=0)
+        with installed_controller(controller), installed(plan):
+            model = wf.train()  # no checkpoint: full refit, degraded mesh
+        assert controller.counters["failovers"] == 1
+        pred_ref, ref = _reference_model(ds)
+        _assert_same_scores(model, pred.name, ref, pred_ref.name, ds)
+
+    def test_host_loss_mid_reduction(self, tmp_path, monkeypatch):
+        """A host dies DURING a guarded collective (the stats plane is
+        forced onto the mesh path): the reduction's HostLostError sails out
+        of the estimator fit into the failover loop, and the retried
+        reduction on the degraded mesh completes the run."""
+        from transmogrifai_tpu.utils import stats as stats_mod
+
+        monkeypatch.setattr(stats_mod, "_DEVICE_THRESHOLD", 1)
+        ds = _binary_ds(n=120, seed=11)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4)
+        plan = FaultPlan().fail_host(3, collective="pcolumn_stats")
+        with installed_controller(controller), installed(plan):
+            model = wf.train(checkpoint_dir=str(tmp_path / "ck"))
+        assert ("host", "3@pcolumn_stats") in plan.fired
+        assert controller.counters["hostsLost"] == 1
+
+        uid_util.reset()
+        pred_ref, _ = _graph(ds)
+        ref = (
+            Workflow().set_result_features(pred_ref)
+            .set_input_dataset(ds).train()
+        )
+        _assert_same_scores(model, pred.name, ref, pred_ref.name, ds)
+
+    def test_straggler_only_recovers_without_host_loss(self, monkeypatch):
+        """A transient straggler burns a collective retry but no failover:
+        the mesh never degrades and the outputs match the fault-free run."""
+        from transmogrifai_tpu.utils import stats as stats_mod
+
+        monkeypatch.setattr(stats_mod, "_DEVICE_THRESHOLD", 1)
+        ds = _binary_ds(n=120, seed=13)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(
+            n_hosts=4, config=HeartbeatConfig(min_samples=0)
+        )
+        plan = FaultPlan().straggle_collective(
+            "pcolumn_stats", delay=1e6, host=2, times=1
+        )
+        with installed_controller(controller), installed(plan):
+            model = wf.train()
+        assert controller.guard.counters["collectivesRetried"] == 1
+        assert controller.sentinel.counters["stragglersDetected"] == 1
+        assert controller.counters["hostsLost"] == 0
+        assert controller.counters["failovers"] == 0
+        assert [m["deviceCount"] for m in controller.mesh_history] == [8]
+
+        uid_util.reset()
+        pred_ref, _ = _graph(ds)
+        ref = (
+            Workflow().set_result_features(pred_ref)
+            .set_input_dataset(ds).train()
+        )
+        _assert_same_scores(model, pred.name, ref, pred_ref.name, ds)
+
+    def test_failover_reshards_even_under_strict_mesh_policy(self, tmp_path):
+        """on_mesh_mismatch="raise" guards USER-initiated resumes; a
+        mid-run failover changed the mesh on purpose, so its own reload
+        must reshard instead of turning recovery into a crash."""
+        ds = _binary_ds(n=120, seed=43)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4)
+        plan = FaultPlan().fail_host(1, after_layer=k)
+        with installed_controller(controller), installed(plan):
+            wf.train(
+                checkpoint_dir=str(tmp_path / "ck"), on_mesh_mismatch="raise"
+            )
+        assert controller.counters["failovers"] == 1
+        # every checkpointed layer (0..k) reloaded under the 6-device mesh
+        assert controller.counters["reshardEvents"] == k + 1
+
+    def test_failover_budget_exhausted_reraises(self):
+        ds = _binary_ds(n=80, seed=17)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4, max_failovers=0)
+        plan = FaultPlan().fail_host(1, after_layer=0)
+        with installed_controller(controller), installed(plan):
+            with pytest.raises(HostLostError):
+                wf.train()
+        assert controller.counters["failovers"] == 0
+
+    def test_completed_workflow_cv_sweep_survives_failover(
+        self, tmp_path, monkeypatch
+    ):
+        """A host lost AFTER the workflow-CV sweep finished must not re-run
+        it: the aggregated candidate results are re-handed to the selector
+        on the failover retry (the sweep is the most expensive phase)."""
+        ds = _binary_ds(n=100, seed=59)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        # the SELECTOR layer: it exists only in the final full-DAG fit, so
+        # the fault cannot fire early inside a per-fold sub-DAG refit
+        k = len(compute_dag([pred])) - 1
+        wf = (
+            Workflow().set_result_features(pred).set_input_dataset(ds)
+            .with_workflow_cv()
+        )
+        from transmogrifai_tpu.workflow import cv as cv_mod
+
+        calls = []
+        orig = cv_mod.workflow_cv_results
+        monkeypatch.setattr(
+            cv_mod, "workflow_cv_results",
+            lambda *a, **kw: calls.append(1) or orig(*a, **kw),
+        )
+        controller = FailoverController(n_hosts=4)
+        plan = FaultPlan().fail_host(1, after_layer=k)
+        with installed_controller(controller), installed(plan):
+            model = wf.train(checkpoint_dir=str(tmp_path / "ck"))
+        assert controller.counters["failovers"] == 1
+        assert calls == [1]  # the finished sweep ran exactly once
+        assert model.summary_json()["distributedResilience"]["hostsLost"] == 1
+
+    def test_rebind_resets_the_per_train_ledger(self, tmp_path):
+        """One controller reused across trains: the second train must not
+        inherit the first one's failover count (stale budget, spurious
+        checkpoint reloads) or its lost hosts."""
+        ds = _binary_ds(n=100, seed=47)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4)
+        plan = FaultPlan().fail_host(1, after_layer=0)
+        with installed_controller(controller), installed(plan):
+            wf.train()
+            assert controller.counters["failovers"] == 1
+            uid_util.reset()
+            pred2, _ = _graph(ds)
+            wf2 = Workflow().set_result_features(pred2).set_input_dataset(ds)
+            model2 = wf2.train()  # fault exhausted: clean run
+        assert controller.counters["failovers"] == 0
+        assert controller.counters["hostsLost"] == 0
+        assert model2.dist_summary["failovers"] == 0
+        assert [m["deviceCount"] for m in model2.dist_summary["meshHistory"]] \
+            == [8]
+
+    def test_double_host_loss_degrades_twice(self, tmp_path):
+        ds = _binary_ds(n=120, seed=19)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4, max_failovers=2)
+        plan = (
+            FaultPlan()
+            .fail_host(1, after_layer=0)
+            .fail_host(3, after_layer=k)
+        )
+        with installed_controller(controller), installed(plan):
+            model = wf.train(checkpoint_dir=str(tmp_path / "ck"))
+        assert controller.sentinel.lost == [1, 3]
+        # 8 -> 6 -> 4 devices
+        assert [m["deviceCount"] for m in controller.mesh_history] == [8, 6, 4]
+        pred_ref, ref = _reference_model(ds)
+        _assert_same_scores(model, pred.name, ref, pred_ref.name, ds)
+
+
+# ----------------------------------------------- mesh-portable checkpoints
+class TestMeshPortableCheckpoints:
+    def _crash_under_mesh(self, ds, ckpt_dir, mesh):
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = (
+            Workflow().set_result_features(pred).set_input_dataset(ds)
+            .set_parallelism(mesh)
+        )
+        with installed(FaultPlan().crash_after_layer(k)):
+            with pytest.raises(SimulatedCrash):
+                wf.train(checkpoint_dir=ckpt_dir)
+
+    def _resume_under_mesh(self, ds, ckpt_dir, mesh, **train_kw):
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = (
+            Workflow().set_result_features(pred).set_input_dataset(ds)
+            .set_parallelism(mesh)
+        )
+        fit_calls = []
+        orig_fit = SanityChecker.fit
+        SanityChecker.fit = (
+            lambda self, d: fit_calls.append(self.uid) or orig_fit(self, d)
+        )
+        try:
+            model = wf.train(
+                checkpoint_dir=ckpt_dir, resume=True, **train_kw
+            )
+        finally:
+            SanityChecker.fit = orig_fit
+        return pred, model, fit_calls
+
+    def test_resume_reshards_4_to_2_and_to_1_device(self, tmp_path):
+        """Acceptance: a checkpoint written under a 4-device mesh resumes
+        and finishes on 2 devices AND on 1 device (mesh=None), restoring —
+        not refitting — the completed layers, with identical outputs."""
+        import jax
+
+        devices = jax.devices()
+        ds = _binary_ds(n=120, seed=23)
+        ckpt_dir = str(tmp_path / "ck")
+        self._crash_under_mesh(ds, ckpt_dir, make_mesh(4, devices=devices[:4]))
+
+        manifest_mesh = None
+        import json
+
+        with open(os.path.join(ckpt_dir, "layers", "layer-000",
+                               "manifest.json")) as fh:
+            manifest_mesh = json.load(fh)["mesh"]
+        assert manifest_mesh["deviceCount"] == 4
+
+        uid_util.reset()
+        pred_ref, _ = _graph(ds)
+        ref = (
+            Workflow().set_result_features(pred_ref).set_input_dataset(ds)
+            .set_parallelism(make_mesh(4, devices=devices[:4])).train()
+        )
+
+        pred2, on_two, fits2 = self._resume_under_mesh(
+            ds, ckpt_dir, make_mesh(2, devices=devices[:2])
+        )
+        assert fits2 == []  # resharded restore, not a refit
+        _assert_same_scores(on_two, pred2.name, ref, pred_ref.name, ds)
+
+        pred1, on_one, fits1 = self._resume_under_mesh(ds, ckpt_dir, None)
+        assert fits1 == []
+        _assert_same_scores(on_one, pred1.name, ref, pred_ref.name, ds)
+
+    def test_unknown_mesh_policy_is_rejected(self):
+        ds = _binary_ds(n=40, seed=61)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with pytest.raises(ValueError, match="on_mesh_mismatch"):
+            wf.train(on_mesh_mismatch="strict")
+
+    def test_strict_mesh_policy_raises_clear_error(self, tmp_path):
+        import jax
+
+        devices = jax.devices()
+        ds = _binary_ds(n=120, seed=29)
+        ckpt_dir = str(tmp_path / "ck")
+        self._crash_under_mesh(ds, ckpt_dir, make_mesh(4, devices=devices[:4]))
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        wf = (
+            Workflow().set_result_features(pred).set_input_dataset(ds)
+            .set_parallelism(make_mesh(2, devices=devices[:2]))
+        )
+        with pytest.raises(CheckpointMeshMismatch, match="reshard"):
+            wf.train(
+                checkpoint_dir=ckpt_dir, resume=True, on_mesh_mismatch="raise"
+            )
+
+    def test_corrupt_shard_truncates_prefix_and_refits(self, tmp_path):
+        ds = _binary_ds(n=120, seed=31)
+        ckpt_dir = str(tmp_path / "ck")
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with installed(FaultPlan().crash_after_layer(k)):
+            with pytest.raises(SimulatedCrash):
+                wf.train(checkpoint_dir=ckpt_dir)
+
+        uid_util.reset()
+        pred2, _ = _graph(ds)
+        wf2 = Workflow().set_result_features(pred2).set_input_dataset(ds)
+        plan = FaultPlan().corrupt_shard(layer=0)
+        with installed(plan):
+            resumed = wf2.train(checkpoint_dir=ckpt_dir, resume=True)
+        assert plan.fired == [("shard", "layer-0")]
+
+        pred_ref, ref = _reference_model(ds)
+        _assert_same_scores(resumed, pred2.name, ref, pred_ref.name, ds)
+
+
+# ----------------------------------------------------- counters surfacing
+class TestCountersSurfacing:
+    @pytest.fixture(scope="class")
+    def failed_over(self, tmp_path_factory):
+        ds = _binary_ds(n=120, seed=37)
+        uid_util.reset()
+        pred, _ = _graph(ds)
+        k = len(compute_dag([pred])) - 2
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        controller = FailoverController(n_hosts=4)
+        plan = FaultPlan().fail_host(2, after_layer=k)
+        ckpt = str(tmp_path_factory.mktemp("ck"))
+        with installed_controller(controller), installed(plan):
+            model = wf.train(checkpoint_dir=ckpt)
+        return ds, pred, model
+
+    def test_selector_summary_and_summary_json(self, failed_over):
+        _, _, model = failed_over
+        dist = model.summary_json()["distributedResilience"]
+        assert dist["hostsLost"] == 1 and dist["failovers"] == 1
+        assert dist["lostHosts"] == [2]
+        assert [m["deviceCount"] for m in dist["meshHistory"]] == [8, 6]
+        sel = model.summary_json()["modelSelectorSummary"]
+        assert sel["distributedResilience"]["hostsLost"] == 1
+
+    def test_summary_pretty_renders_dist_line(self, failed_over):
+        _, _, model = failed_over
+        pretty = model.summary_pretty()
+        assert "Distributed resilience: 1 host(s) lost, 1 failover(s)" in pretty
+
+    def test_scoring_metadata_carries_dist_ledger(self, failed_over):
+        ds, _, model = failed_over
+        fn = score_function(model)
+        fn.batch(ds.rows()[:2])
+        assert fn.metadata()["distributed"]["hostsLost"] == 1
+
+    def test_dist_ledger_survives_save_load(self, failed_over, tmp_path):
+        ds, pred, model = failed_over
+        path = str(tmp_path / "model")
+        model.save(path)
+        loaded = WorkflowModel.load(path)
+        assert loaded.dist_summary["hostsLost"] == 1
+        assert "Distributed resilience" in loaded.summary_pretty()
+
+    def test_clean_train_reports_no_dist_line(self):
+        ds = _binary_ds(n=80, seed=41)
+        _, model = _reference_model(ds)
+        dist = model.summary_json()["distributedResilience"]
+        assert dist["hostsLost"] == 0
+        assert "Distributed resilience" not in model.summary_pretty()

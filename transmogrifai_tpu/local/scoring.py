@@ -581,13 +581,16 @@ def score_function(
 
     def metadata() -> dict[str, Any]:
         """Score-path health: guard + sentinel + quarantine + breaker +
-        drift counters, one report."""
+        drift counters, one report — plus the training-side distributed
+        ledger (hosts lost, failovers, reshards) so serving ops can see
+        the model behind this closure finished on a degraded mesh."""
         return {
             "scoreGuard": guard.stats(),
             "sentinel": None if sentinel is None else sentinel.stats(),
             "quarantine": qlog.stats(),
             "breakers": {nm: br.stats() for nm, br in breakers.items()},
             "drift": drift_sentinel.report(),
+            "distributed": getattr(model, "dist_summary", None),
         }
 
     score_one.batch = score_batch  # type: ignore[attr-defined]

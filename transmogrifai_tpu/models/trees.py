@@ -1511,7 +1511,7 @@ def _sharded_grow_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
     """jit(shard_map(grow)) for one (mesh, statics) combo, built once —
     rebuilding per call would retrace every tree. Feature-group index
     arrays (when present) are replicated: the feature axis is unsharded."""
-    from jax import shard_map
+    from ..parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS
@@ -1555,7 +1555,7 @@ def _sharded_forest_scan_kernel(mesh, max_depth, num_bins, hist_impl, lowp,
     whole forest in one program, psum'ing each level's histograms. Also
     emits [K, N] training outputs (row-sharded) like the single-device
     scan."""
-    from jax import shard_map
+    from ..parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS
@@ -1658,7 +1658,7 @@ def _sharded_boost_kernel(mesh, num_rounds, max_depth, num_bins, objective,
                           hist_impl=None, has_groups=False):
     """jit(shard_map(boost-round-chunk)): margins stay row-sharded across
     the scan; each round's histogram build psums over the data axis."""
-    from jax import shard_map
+    from ..parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS

@@ -42,10 +42,12 @@ from .multihost import (  # noqa: F401
     dcn_data_spec,
     global_column_stats,
     host_row_slice,
+    ingest_global_array,
     initialize_distributed,
     make_global_array,
     make_multihost_mesh,
     padded_rows,
+    read_host_block,
 )
 from .segments import (  # noqa: F401
     aggregate_events_on_device,

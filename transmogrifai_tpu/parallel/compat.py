@@ -1,0 +1,30 @@
+"""JAX API compatibility for the sharded-collective plane.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (where the
+replication-check kwarg is ``check_rep``) to top-level ``jax.shard_map``
+(where it is ``check_vma``). Every kernel in transmogrifai_tpu.parallel and
+models/trees.py goes through this wrapper so the whole sharded reduction
+plane — pcolumn_stats, pxtx, phistogram, ring_gram, segment reduces, the
+tree grower — runs on either JAX generation instead of dying with an
+ImportError on the first collective.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+
+def shard_map(f=None, **kwargs):
+    """Version-portable ``shard_map``; accepts the new-style ``check_vma``
+    kwarg and translates for the experimental API. Usable directly or as
+    ``partial(shard_map, mesh=..., ...)`` like the real one."""
+    import jax
+
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl
+
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return partial(impl, **kwargs)
+    return impl(f, **kwargs)

@@ -27,12 +27,15 @@ column, exactly like parallel.reductions.
 """
 from __future__ import annotations
 
+import logging
 import os
 from functools import lru_cache, partial
 
 import numpy as np
 
 from .mesh import DATA_AXIS, MODEL_AXIS
+
+log = logging.getLogger(__name__)
 
 #: DCN (cross-host) mesh axis name — leading so cross-host traffic is the
 #: outermost collective dimension
@@ -150,6 +153,64 @@ def host_row_slice(num_rows: int, mesh=None) -> slice:
     return slice(min(pid * chunk, num_rows), min((pid + 1) * chunk, num_rows))
 
 
+def read_host_block(
+    fetch, num_rows: int, mesh=None, retry_policy=None
+) -> np.ndarray:
+    """This host's real-row block via ``fetch(slice)``, behind the PR-1
+    ``RetryPolicy`` — parity with readers/streaming.py chunk fetches, which
+    already retried while per-host ingest did not. Transient errors
+    (flaky NFS, object-store hiccups, injected ``fail_chunk_read`` faults)
+    back off and retry; fatal ones fail immediately."""
+    from ..resilience import faults
+    from ..resilience.retry import default_io_policy
+
+    sl = host_row_slice(num_rows, mesh)
+    token = f"host-block[{sl.start}:{sl.stop})"
+
+    def attempt():
+        plan = faults.active()
+        if plan is not None:
+            plan.on_stream_chunk(token)
+        return fetch(sl)
+
+    policy = retry_policy or default_io_policy()
+    rows, attempts = policy.call(attempt)
+    if attempts > 1:
+        log.warning("host ingest %s fetched after %d attempts", token, attempts)
+    return np.asarray(rows)
+
+
+def ingest_global_array(fetch, num_rows: int, mesh, retry_policy=None):
+    """The resilient per-host ingest path: ``host_row_slice`` → retried
+    ``fetch`` → zero-pad to this host's block → ``make_global_array``.
+    ``fetch(slice)`` returns this host's REAL rows; trailing hosts whose
+    block is partly padding get the remainder zero-filled here (padding
+    rows are excluded from statistics via the validity column, as
+    everywhere in parallel.reductions)."""
+    import jax
+
+    if mesh is None:
+        raise ValueError(
+            "ingest_global_array requires a mesh (the global array's "
+            "sharding); single-device callers can use read_host_block "
+            "directly — their block is all the real rows"
+        )
+    local = read_host_block(fetch, num_rows, mesh, retry_policy)
+    padded = padded_rows(num_rows, mesh)
+    chunk = padded // jax.process_count()
+    if local.shape[0] > chunk:
+        raise ValueError(
+            f"fetch returned {local.shape[0]} rows, more than this host's "
+            f"{chunk}-row block"
+        )
+    if local.shape[0] < chunk:
+        pad = np.zeros(
+            (chunk - local.shape[0],) + local.shape[1:], dtype=local.dtype
+        )
+        local = np.concatenate([local, pad], axis=0)
+    return make_global_array(local, mesh, padded)
+
+
 def make_global_array(local_rows: np.ndarray, mesh, num_rows: int):
     """Assemble a globally row-sharded array from this host's row block.
 
@@ -187,7 +248,7 @@ def make_global_array(local_rows: np.ndarray, mesh, num_rows: int):
 def _global_stats_kernels(mesh):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = (DCN_AXIS, DATA_AXIS)
@@ -229,8 +290,17 @@ def global_column_stats(x_local: np.ndarray, mesh, num_rows: int) -> dict:
     here, and the variance uses the same two-pass centered-M2 scheme as
     `parallel.reductions.pcolumn_stats` (raw-moment variance cancels
     catastrophically in float32). Cross-host traffic is one psum of the
-    per-column partials per pass — never the data.
+    per-column partials per pass — never the data. Runs behind the active
+    CollectiveGuard when a FailoverController is installed.
     """
+    from .reductions import _guarded
+
+    return _guarded(
+        "global_column_stats", _global_column_stats, x_local, mesh, num_rows
+    )
+
+
+def _global_column_stats(x_local: np.ndarray, mesh, num_rows: int) -> dict:
     import jax
 
     n_hosts = jax.process_count()

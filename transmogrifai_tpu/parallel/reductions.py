@@ -25,6 +25,19 @@ def _data_spec(*trailing):
     return P(DATA_AXIS, *trailing)
 
 
+def _guarded(name, fn, *args):
+    """Run one reduction behind the active CollectiveGuard when a
+    FailoverController is installed (resilience/distributed.py): straggler
+    deadline + bounded retry, then HostLostError. No controller = direct
+    call, zero extra work on the hot path."""
+    from ..resilience import distributed
+
+    guard = distributed.active_collective_guard()
+    if guard is None:
+        return fn(*args)
+    return guard.run(name, fn, *args)
+
+
 # Jitted shard_map kernels are built once per mesh (jax.sharding.Mesh is
 # hashable) and reused — a fresh closure + jax.jit per call would retrace and
 # recompile on every reduction, costing SanityChecker/RawFeatureFilter
@@ -33,7 +46,7 @@ def _data_spec(*trailing):
 def _stats_kernels(mesh):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @partial(
@@ -80,8 +93,13 @@ def pcolumn_stats(x: np.ndarray, mesh) -> dict[str, np.ndarray]:
     then CENTERED squared deviations — because device arithmetic is float32
     and raw-moment variance (sumsq - n·mean²) catastrophically cancels for
     columns with |mean| >> std. Padding rows are excluded via the
-    row-validity weight column appended internally.
+    row-validity weight column appended internally. Runs behind the active
+    CollectiveGuard when a FailoverController is installed.
     """
+    return _guarded("pcolumn_stats", _pcolumn_stats, x, mesh)
+
+
+def _pcolumn_stats(x: np.ndarray, mesh) -> dict[str, np.ndarray]:
     n_shards = mesh.shape[DATA_AXIS]
     xp, n = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
     valid = np.zeros((xp.shape[0], 1), dtype=np.float32)
@@ -128,7 +146,7 @@ def pcentered_gram(x: np.ndarray, mesh) -> tuple[np.ndarray, np.ndarray, float]:
 @lru_cache(maxsize=None)
 def _gram_kernels(mesh):
     import jax
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @partial(
@@ -162,8 +180,13 @@ def pxtx(x: np.ndarray, mesh) -> np.ndarray:
 
     The correlation/covariance building block (SanityChecker's feature-label
     and feature-feature correlation matrix, SanityChecker.scala:464-470).
-    Zero padding rows are monoid-neutral.
+    Zero padding rows are monoid-neutral. Runs behind the active
+    CollectiveGuard when a FailoverController is installed.
     """
+    return _guarded("pxtx", _pxtx, x, mesh)
+
+
+def _pxtx(x: np.ndarray, mesh) -> np.ndarray:
     n_shards = mesh.shape[DATA_AXIS]
     xp, _ = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
     return np.asarray(_xtx_kernel(mesh)(shard_rows(mesh, xp)), dtype=np.float64)
@@ -172,7 +195,7 @@ def pxtx(x: np.ndarray, mesh) -> np.ndarray:
 @lru_cache(maxsize=None)
 def _xtx_kernel(mesh):
     import jax
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @partial(
@@ -194,7 +217,14 @@ def phistogram(
     """Per-column histograms of integer codes: one-hot matmul per shard +
     psum (RawFeatureFilter's FeatureDistribution bins, the GBDT histogram
     primitive). codes [N, F] int32 in [0, num_bins); rows with code < 0 are
-    skipped (doubles as the padding mask)."""
+    skipped (doubles as the padding mask). Runs behind the active
+    CollectiveGuard when a FailoverController is installed."""
+    return _guarded("phistogram", _phistogram, codes, num_bins, mesh, weights)
+
+
+def _phistogram(
+    codes: np.ndarray, num_bins: int, mesh, weights: np.ndarray | None
+) -> np.ndarray:
     n_shards = mesh.shape[DATA_AXIS]
     codes = np.asarray(codes, dtype=np.int32)
     cp, n = pad_rows(codes + 1, n_shards)  # padding rows become code 0 = skip
@@ -212,7 +242,7 @@ def phistogram(
 def _hist_kernel(mesh, num_bins: int):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @partial(
@@ -268,7 +298,7 @@ def pcontingency(
 @lru_cache(maxsize=None)
 def _contingency_kernel(mesh):
     import jax
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @partial(

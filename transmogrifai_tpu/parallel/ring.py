@@ -53,7 +53,8 @@ def shard_cols(mesh, x):
 def _ring_gram_kernel(mesh):
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     d = mesh.shape[DATA_AXIS]

@@ -35,7 +35,7 @@ _NEUTRAL = {
 def _segment_kernels(mesh, num_segments: int, op: str):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     neutral = _NEUTRAL[op]

@@ -1,21 +1,47 @@
-"""Fault tolerance for training and scoring (ISSUE 1 + ISSUE 2).
+"""Fault tolerance for training, scoring, and the distributed substrate
+(ISSUE 1 + ISSUE 2 + ISSUE 3).
 
-Five pieces, wired through the workflow stack:
+Six pieces, wired through the workflow stack:
 
 * :mod:`.retry` — ``RetryPolicy``: exponential backoff + seeded jitter +
   deadline over transient-classified errors, with an injectable clock;
 * :mod:`.checkpoint` — ``CheckpointManager``: atomic per-layer fitted-stage
   checkpoints and per-candidate CV checkpoints (manifest+npz format);
+  manifests record the device topology so resume reshards N→M instead of
+  trusting the saved layout (``CheckpointMeshMismatch`` in strict mode);
 * :mod:`.faults` — ``FaultPlan``: deterministic seeded fault injection
   (fit failures, mid-DAG crashes, NaN corruption, torn files, malformed
-  serving rows, torn profiles, drifted streams, stage/chunk failures);
+  serving rows, torn profiles, drifted streams, stage/chunk failures,
+  host losses, stragglers, dropped heartbeats, corrupt shards);
 * :mod:`.guards` — ``ScoreGuard``: NaN/Inf containment at score time with
   per-stage fallback and degradation counters;
 * :mod:`.sentinel` — serving sentinels: ``SchemaSentinel`` row validation,
   per-row quarantine, ``DriftSentinel`` train/serve skew detection, and a
-  per-stage ``CircuitBreaker`` with deadline (ISSUE 2).
+  per-stage ``CircuitBreaker`` with deadline (ISSUE 2);
+* :mod:`.distributed` — distributed-training resilience (ISSUE 3):
+  ``HostSentinel`` heartbeats + p99-adaptive straggler deadlines,
+  ``CollectiveGuard`` timeout/retry around the sharded reductions, and
+  the ``FailoverController`` driving elastic degraded-mesh failover with
+  checkpoint resume in ``Workflow.train``.
 """
-from .checkpoint import CheckpointError, CheckpointManager, dag_signature  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointError,
+    CheckpointManager,
+    CheckpointMeshMismatch,
+    dag_signature,
+)
+from .distributed import (  # noqa: F401
+    CollectiveGuard,
+    FailoverController,
+    HeartbeatConfig,
+    HostLostError,
+    HostSentinel,
+    adopt_orphans,
+    host_blocks,
+    installed_controller,
+    mesh_fingerprint,
+    simulated_host_count,
+)
 from .faults import FaultPlan, SimulatedCrash, installed  # noqa: F401
 from .guards import ScoreGuard, ScoreGuardError  # noqa: F401
 from .retry import (  # noqa: F401

@@ -39,6 +39,15 @@ class CheckpointError(RuntimeError):
     """A checkpoint member is missing, torn, or stale."""
 
 
+class CheckpointMeshMismatch(CheckpointError):
+    """A layer checkpoint was written under a different device topology
+    than the resuming run's mesh, and the caller asked for strict layout
+    matching (``mesh_policy="raise"``). The default policy ("reshard")
+    re-places the saved arrays onto the live mesh instead — saved stage
+    arrays are replicated host-level numpy, so an N→M (including M=1)
+    resume is a re-placement, not a gather."""
+
+
 def dag_signature(layers: Sequence[Sequence[Any]], data_token: str = "") -> str:
     """Fingerprint of the run a checkpoint is valid for: per layer, each
     stage's class, operation name, arity, AND constructor params, in order,
@@ -121,6 +130,8 @@ class CheckpointManager:
         self.root = root
         self.layers_dir = os.path.join(root, "layers")
         self.cv_dir = os.path.join(root, "cv")
+        #: mesh-mismatch reshard loads performed by the last load_layers
+        self.reshard_events = 0
         os.makedirs(self.layers_dir, exist_ok=True)
         os.makedirs(self.cv_dir, exist_ok=True)
 
@@ -145,12 +156,16 @@ class CheckpointManager:
         index: int,
         signature: str,
         fitted_stages: Sequence[tuple[int, str, Any]],
+        mesh_info: dict[str, Any] | None = None,
     ) -> None:
         """Atomically persist one layer's fitted stages as
         ``(position_in_layer, estimator_uid, fitted_stage)`` triples — the
         position is the restore identity (uids are process-local). Layers
         with no estimators still write an (empty) manifest so the completed
-        prefix stays contiguous."""
+        prefix stays contiguous. ``mesh_info`` records the device topology
+        the layer was fitted under (resilience.distributed.mesh_fingerprint)
+        so resume can detect an N→M mesh change instead of trusting the
+        layout blindly."""
         from ..workflow.persistence import atomic_write_model_dir, stage_to_entry
 
         arrays: dict[str, np.ndarray] = {}
@@ -163,19 +178,34 @@ class CheckpointManager:
             "version": 1,
             "layer": index,
             "dagSignature": signature,
+            "mesh": mesh_info,
             "stages": entries,
         }
         atomic_write_model_dir(self.layer_path(index), manifest, arrays)
         log.debug("checkpointed layer %d (%d stages)", index, len(entries))
 
     def load_layers(
-        self, signature: str, layers: Sequence[Sequence[Any]]
+        self,
+        signature: str,
+        layers: Sequence[Sequence[Any]],
+        mesh_info: dict[str, Any] | None = None,
+        mesh_policy: str = "reshard",
     ) -> dict[str, Any]:
         """Restore the longest contiguous prefix of valid layer checkpoints
         as a ``prefitted`` dict keyed by the LIVE estimator uid — entries
         match live stages by (layer, position), so resume survives a
-        restarted process whose uid counter drifted."""
+        restarted process whose uid counter drifted.
+
+        ``mesh_info`` is the CURRENT mesh fingerprint; a layer saved under
+        a different topology is, with ``mesh_policy="reshard"`` (default),
+        resharded onto the live mesh — the saved arrays are replicated
+        host-level numpy, so resharding is the re-placement that happens
+        when the restored stages execute; ``self.reshard_events`` counts
+        these loads. ``mesh_policy="raise"`` raises a clear
+        :class:`CheckpointMeshMismatch` instead (for callers that treat a
+        topology change as a deployment error)."""
         prefitted: dict[str, Any] = {}
+        self.reshard_events = 0
         index = 0
         while index < len(layers):
             d = self.layer_path(index)
@@ -183,8 +213,13 @@ class CheckpointManager:
                 break
             try:
                 prefitted.update(
-                    self._load_layer(d, signature, layers[index])
+                    self._load_layer(
+                        d, signature, layers[index], index,
+                        mesh_info, mesh_policy,
+                    )
                 )
+            except CheckpointMeshMismatch:
+                raise  # an explicit strict-policy error, not a torn file
             except Exception as e:
                 log.warning(
                     "checkpoint layer %d unusable (%s); refitting from "
@@ -202,8 +237,15 @@ class CheckpointManager:
         return prefitted
 
     def _load_layer(
-        self, d: str, signature: str, live_layer: Sequence[Any]
+        self,
+        d: str,
+        signature: str,
+        live_layer: Sequence[Any],
+        index: int = 0,
+        mesh_info: dict[str, Any] | None = None,
+        mesh_policy: str = "reshard",
     ) -> dict[str, Any]:
+        from . import faults
         from ..workflow.persistence import (
             construct_stage_checked,
             stage_arrays_from_npz,
@@ -219,6 +261,33 @@ class CheckpointManager:
             raise CheckpointError(
                 f"stale DAG signature {manifest.get('dagSignature')!r} "
                 f"(live DAG is {signature!r})"
+            )
+        saved_mesh = manifest.get("mesh")
+        resharding = (
+            saved_mesh is not None
+            and mesh_info is not None
+            and saved_mesh != mesh_info
+        )
+        if resharding:
+            if mesh_policy == "raise":
+                raise CheckpointMeshMismatch(
+                    f"layer {index} was checkpointed under a "
+                    f"{saved_mesh.get('deviceCount')}-device mesh "
+                    f"{saved_mesh.get('axes')} but the current mesh is "
+                    f"{mesh_info.get('deviceCount')}-device "
+                    f"{mesh_info.get('axes')}; resume with "
+                    f"on_mesh_mismatch='reshard' (the default) to reshard "
+                    f"the saved arrays onto the current mesh"
+                )
+            log.info(
+                "checkpoint layer %d: resharding %s-device arrays onto "
+                "the %s-device mesh", index,
+                saved_mesh.get("deviceCount"), mesh_info.get("deviceCount"),
+            )
+        plan = faults.active()
+        if plan is not None and plan.on_shard_load(index):
+            raise CheckpointError(
+                f"injected shard corruption on layer {index}"
             )
         npz_path = os.path.join(d, "arrays.npz")
         try:
@@ -253,6 +322,10 @@ class CheckpointManager:
             stage.input_features = tuple(live.input_features)
             stage._fixed_output_name = live.output_name
             out[live.uid] = stage
+        if resharding:
+            # counted only after the layer actually restored — a torn layer
+            # that the caller truncates and refits was never resharded
+            self.reshard_events += 1
         return out
 
     # ------------------------------------------------------------- CV side

@@ -1,0 +1,563 @@
+"""Distributed-training resilience: heartbeats, stragglers, elastic failover.
+
+PR 1 made single-host training survive crashes and PR 2 hardened the
+serving path; this layer makes the *distributed substrate* survive partial
+failure. The reference stack leaned on Spark's executor re-scheduling and
+XGBoost's Rabit tracker for exactly this fault class (SURVEY.md §5.8); the
+TPU-native rebuild gets its own equivalent, built on the monoid-reduce
+discipline of parallel/reductions.py: every statistic is a commutative
+reduce over row shards, so any re-partitioning of the surviving rows onto
+a smaller mesh reproduces the same global result — a lost host costs a
+row re-slice plus a resume from the PR-1 layer checkpoint, never a
+restart from scratch.
+
+Pieces:
+
+* :class:`HostSentinel` — injectable-clock heartbeat tracking per mesh
+  participant (simulated hosts on CPU, real processes on a pod), plus a
+  per-collective duration history driving a p99-based adaptive straggler
+  deadline;
+* :class:`CollectiveGuard` — wraps the sharded reductions
+  (``pcolumn_stats`` / ``pxtx`` / ``phistogram`` /
+  ``global_column_stats``) with that deadline and a bounded retry before
+  declaring a host dead (:class:`HostLostError`);
+* :class:`FailoverController` — the workflow-level driver: on a declared
+  host loss it re-derives a smaller mesh over the surviving hosts'
+  devices (``make_mesh``), re-slices the host row blocks so survivors
+  adopt the orphaned rows (:func:`adopt_orphans`), and lets
+  ``Workflow.train`` re-enter the fit — restoring completed layers from
+  the checkpoint — instead of aborting;
+* :func:`mesh_fingerprint` / :func:`host_blocks` / :func:`adopt_orphans`
+  — the mesh-shape bookkeeping that makes checkpoints portable across
+  device counts (N→M resume, including M=1 local recovery).
+
+Like ``faults.FaultPlan``, a controller can be installed process-globally
+(:func:`installed_controller`) so tests inject clocks and host counts;
+``Workflow.train`` creates a default controller when none is installed.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class HostLostError(BaseException):
+    """A mesh participant is gone: heartbeat timeout, exhausted collective
+    retries, or an injected ``fail_host`` fault. Derives from
+    ``BaseException`` like ``SimulatedCrash``: infrastructure loss must
+    sail through candidate isolation and retry layers (which catch
+    ``Exception``) — only the workflow failover loop may handle it."""
+
+    def __init__(self, host: Any = None, reason: str = "host lost"):
+        self.host = host
+        self.reason = reason
+        super().__init__(f"host {host!r} lost: {reason}")
+
+
+def simulated_host_count() -> int:
+    """How many mesh participants to track: TPTPU_SIM_HOSTS (the CPU
+    simulation knob the dist test tier sets) or the real process count."""
+    env = os.environ.get("TPTPU_SIM_HOSTS", "")
+    if env:
+        return max(1, int(env))
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+# ------------------------------------------------------------ row re-slicing
+def host_blocks(
+    num_rows: int, n_hosts: int, pad_multiple: int = 1
+) -> list[slice]:
+    """Equal contiguous row blocks per host, clipped to the real rows.
+
+    ``pad_multiple`` rounds the partitioned row space up first: pass the
+    mesh's TOTAL device count to reproduce the padded-block chunking of
+    ``parallel.multihost.host_row_slice`` (whose trailing hosts own part
+    padding) — required when the blocks feed ``make_global_array``. The
+    default 1 partitions the real rows only."""
+    if n_hosts <= 0:
+        raise ValueError(f"n_hosts must be positive, got {n_hosts}")
+    padded = (num_rows + pad_multiple - 1) // pad_multiple * pad_multiple
+    chunk = (padded + n_hosts - 1) // n_hosts
+    return [
+        slice(min(h * chunk, num_rows), min((h + 1) * chunk, num_rows))
+        for h in range(n_hosts)
+    ]
+
+
+def adopt_orphans(
+    num_rows: int, n_hosts: int, lost: Sequence[int], pad_multiple: int = 1
+) -> list[slice]:
+    """Row blocks after failover: the survivors re-partition the FULL row
+    space, adopting the lost hosts' orphaned rows. Because every reduction
+    is a commutative monoid over rows (parallel/reductions.py), statistics
+    computed from the re-sliced blocks on the degraded mesh match the
+    original partition — re-slicing is free of correctness risk.
+
+    This is the re-slice rule for PER-HOST INGEST consumers
+    (``read_host_block``/``ingest_global_array`` callers re-fetch their
+    new block after a failover); in-memory training data needs no
+    explicit call — rows re-pad and re-place under the degraded mesh on
+    the next fit."""
+    survivors = n_hosts - len(set(lost))
+    if survivors <= 0:
+        raise ValueError("no surviving hosts to adopt the orphaned rows")
+    return host_blocks(num_rows, survivors, pad_multiple)
+
+
+def mesh_fingerprint(mesh) -> dict[str, Any]:
+    """JSON-able topology record for checkpoint manifests: device count and
+    per-axis sizes. Stage arrays are checkpointed replicated (host-level
+    numpy), so ``layout`` records that resuming = re-placing them under
+    whatever mesh is live, not a physical gather."""
+    if mesh is None:
+        return {"deviceCount": 1, "axes": {}, "layout": "replicated"}
+    axes = {name: int(mesh.shape[name]) for name in mesh.axis_names}
+    count = 1
+    for v in axes.values():
+        count *= v
+    return {"deviceCount": count, "axes": axes, "layout": "replicated"}
+
+
+# ----------------------------------------------------------------- sentinel
+@dataclasses.dataclass
+class HeartbeatConfig:
+    """Knobs for heartbeat + straggler detection. Defaults are deliberately
+    conservative (no deadline under 30s, 10x the p99) so healthy runs never
+    trip; tests inject a FakeClock and tighter thresholds."""
+
+    #: seconds without a heartbeat before a host is declared dead
+    timeout: float = 300.0
+    #: straggler deadline = max(min_deadline, multiplier * p99(history))
+    straggler_multiplier: float = 10.0
+    #: deadline floor, and the cold-start deadline before history exists
+    min_deadline: float = 30.0
+    #: per-collective duration window feeding the p99
+    history: int = 128
+    #: observations of a collective required before its deadline is
+    #: ENFORCED (cold-start grace): with no history the floor deadline is
+    #: only a guess, and a healthy-but-slow first call (XLA compile, a
+    #: genuinely large reduction) must seed the history, not get a host
+    #: killed. 0 enforces the floor from the very first call (tests).
+    min_samples: int = 1
+    #: bounded retries of a timed-out collective before HostLostError
+    max_collective_retries: int = 2
+    clock: Callable[[], float] = time.monotonic
+
+
+class HostSentinel:
+    """Heartbeat + collective-duration tracking per mesh participant.
+
+    ``beat`` consults the installed FaultPlan (``drop_heartbeat``) so lost
+    heartbeats are injectable; ``dead_hosts`` compares last beats against
+    the injectable clock; ``deadline_for`` derives the per-collective
+    straggler deadline from the p99 of observed durations.
+
+    Beat source: in the CPU simulation the driving process beats on
+    behalf of every live simulated host at layer/fold boundaries, so only
+    ``drop_heartbeat`` (or an externally wired beat feed) makes a host go
+    silent. A real multi-host deployment must wire each process's
+    liveness into ``beat`` (control-plane RPC) — the sentinel is the
+    bookkeeping, not the transport."""
+
+    def __init__(
+        self, hosts: Sequence[Any], config: HeartbeatConfig | None = None
+    ):
+        self.config = config or HeartbeatConfig()
+        self.hosts = list(hosts)
+        now = self.config.clock()
+        self._last_beat = {h: now for h in self.hosts}
+        self.lost: list[Any] = []
+        self._durations: dict[str, deque] = {}
+        self.counters = {"heartbeatsDropped": 0, "stragglersDetected": 0}
+
+    def beat(self, host: Any) -> bool:
+        """Record a heartbeat; returns False when the FaultPlan dropped it."""
+        from . import faults
+
+        plan = faults.active()
+        if plan is not None and plan.on_heartbeat(host):
+            self.counters["heartbeatsDropped"] += 1
+            return False
+        self._last_beat[host] = self.config.clock()
+        return True
+
+    def beat_all(self) -> None:
+        for h in self.live_hosts():
+            self.beat(h)
+
+    def live_hosts(self) -> list[Any]:
+        return [h for h in self.hosts if h not in self.lost]
+
+    def dead_hosts(self) -> list[Any]:
+        """Live hosts whose last heartbeat is older than the timeout."""
+        now = self.config.clock()
+        return [
+            h
+            for h in self.live_hosts()
+            if now - self._last_beat[h] > self.config.timeout
+        ]
+
+    def declare_lost(self, host: Any) -> None:
+        if host not in self.lost:
+            self.lost.append(host)
+
+    # ------------------------------------------------- straggler detection
+    def record_duration(self, name: str, seconds: float) -> None:
+        self._durations.setdefault(
+            name, deque(maxlen=self.config.history)
+        ).append(float(seconds))
+
+    def observations(self, name: str) -> int:
+        return len(self._durations.get(name, ()))
+
+    def deadline_for(self, name: str) -> float:
+        """p99-adaptive per-collective deadline (floored at min_deadline —
+        the cold-start value until history accumulates)."""
+        hist = self._durations.get(name)
+        if not hist:
+            return self.config.min_deadline
+        p99 = float(np.percentile(np.asarray(hist), 99.0))
+        return max(
+            self.config.min_deadline, self.config.straggler_multiplier * p99
+        )
+
+    def note_straggler(self, name: str, seconds: float) -> None:
+        self.counters["stragglersDetected"] += 1
+        log.warning(
+            "straggler: collective %s took %.3fs (deadline %.3fs)",
+            name, seconds, self.deadline_for(name),
+        )
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "hosts": len(self.hosts),
+            "lostHosts": list(self.lost),
+            **self.counters,
+        }
+
+
+class CollectiveGuard:
+    """Straggler deadline + bounded retry around one sharded reduction.
+
+    Durations are measured with the sentinel's injectable clock and the
+    deadline is evaluated POST-HOC — after the collective returns — which
+    detects stragglers and (via the bounded re-issue) models a
+    transport-level retry, but cannot preempt a collective that never
+    returns; a hard hang needs an external watchdog. The installed
+    FaultPlan can inflate durations (``straggle_collective``, the
+    simulation's stand-in for a stalled participant) or kill a host
+    outright (``fail_host(collective=...)``). A collective that misses
+    its deadline is retried up to ``max_retries`` times — transient
+    stragglers usually recover, and re-running a deterministic reduction
+    is correctness-free — before the slow participant is declared dead
+    via :class:`HostLostError`, which the workflow failover loop turns
+    into a degraded-mesh resume. With a single live host there is no one
+    to fail over to, so a solo participant (the default single-process
+    controller included) gets straggler MONITORING but never escalation.
+
+    Known limitation: duration history is keyed by collective name only,
+    not input size — when one name covers wildly different input sizes,
+    raise ``min_deadline``/``straggler_multiplier`` (or ``min_samples``)
+    to keep legitimate large reductions under the deadline."""
+
+    def __init__(self, sentinel: HostSentinel, max_retries: int = 2):
+        self.sentinel = sentinel
+        self.max_retries = max_retries
+        self.counters = {"collectivesRetried": 0}
+
+    def run(self, name: str, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        from . import faults
+
+        cfg = self.sentinel.config
+        # snapshot ONCE per run: mid-run recordings must not move the bar
+        # between attempts, and an unenforced (cold-start) deadline must
+        # not start enforcing halfway through a retry loop
+        enforced = self.sentinel.observations(name) >= cfg.min_samples
+        deadline = self.sentinel.deadline_for(name)
+        attempt = 0
+        while True:
+            attempt += 1
+            extra, straggler = 0.0, None
+            plan = faults.active()
+            if plan is not None:
+                # may raise HostLostError (fail_host during a collective)
+                extra, straggler = plan.on_collective(name)
+            start = cfg.clock()
+            out = fn(*args, **kwargs)
+            duration = cfg.clock() - start + extra
+            # every observation feeds the adaptive window, but an ENFORCED
+            # miss records at most the deadline: one recovered 600s stall
+            # must not 10x the p99 and blind the detector for the next 128
+            # calls. A legitimately slower regime still drifts the window
+            # upward (deadline-valued entries raise the p99 gradually);
+            # cold-start observations record in full — they ARE the
+            # baseline estimate.
+            self.sentinel.record_duration(
+                name, min(duration, deadline) if enforced else duration
+            )
+            if duration <= deadline:
+                return out
+            if not enforced:
+                # cold start: this observation IS the baseline estimate —
+                # accept the (correct) result and let the recorded
+                # duration set the deadline, never kill a host over an
+                # unknown baseline
+                log.warning(
+                    "collective %s took %.3fs on a cold-start %.3fs "
+                    "deadline; accepting and seeding the history",
+                    name, duration, deadline,
+                )
+                return out
+            self.sentinel.note_straggler(name, duration)
+            if len(self.sentinel.live_hosts()) <= 1:
+                # a single participant has no one to fail over to —
+                # declaring it dead would just kill a working run, so a
+                # solo host gets monitoring (the straggler is counted)
+                # but never escalation. This also protects the default
+                # single-process controller every train installs.
+                return out
+            if attempt <= self.max_retries:
+                # discard the (correct) result and re-issue: in the
+                # simulation this stands in for the transport-level
+                # retry of a collective that would not have returned at
+                # all; an integration with real transport timeouts would
+                # surface the failure as fn raising instead
+                self.counters["collectivesRetried"] += 1
+                log.warning(
+                    "collective %s missed deadline (%.3fs > %.3fs); "
+                    "retry %d/%d", name, duration, deadline, attempt,
+                    self.max_retries,
+                )
+                continue
+            raise HostLostError(
+                straggler,
+                reason=(
+                    f"collective {name} exceeded its {deadline:.3f}s "
+                    f"deadline on {attempt} attempts"
+                ),
+            )
+
+
+# -------------------------------------------------------------- controller
+class FailoverController:
+    """The elastic degraded-mesh driver installed around Workflow.train.
+
+    ``bind`` snapshots the mesh's devices and partitions them into
+    ``n_hosts`` simulated (or real) host blocks. On ``failover`` the lost
+    host's devices are dropped, a smaller ("data", "model") mesh is
+    re-derived over the survivors via ``make_mesh`` (None once fewer than
+    two devices survive — the M=1 plain-jit local recovery), and the row
+    blocks implied by ``host_blocks`` are re-sliced so survivors adopt the
+    orphaned rows. Counters feed the selector summary, ``summary_pretty``
+    and score-function metadata."""
+
+    def __init__(
+        self,
+        n_hosts: int | None = None,
+        max_failovers: int = 2,
+        config: HeartbeatConfig | None = None,
+    ):
+        self.requested_hosts = n_hosts
+        self.max_failovers = max_failovers
+        self.config = config or HeartbeatConfig()
+        self.counters = {"hostsLost": 0, "failovers": 0, "reshardEvents": 0}
+        self.mesh = None
+        self.checkpoint = None
+        self.sentinel: HostSentinel | None = None
+        self.guard: CollectiveGuard | None = None
+        self.mesh_history: list[dict[str, Any]] = []
+        self._devices: list = []
+        self._n_model = 1
+        self.n_hosts = 1
+
+    def bind(self, mesh, checkpoint=None) -> "FailoverController":
+        """Attach to a concrete mesh (None = single device) for one train.
+
+        Re-binding resets ALL per-train state — counters included — so a
+        controller reused across train() calls never carries one run's
+        failover ledger (or its exhausted budget) into the next."""
+        self.counters = {"hostsLost": 0, "failovers": 0, "reshardEvents": 0}
+        self.mesh = mesh
+        self.checkpoint = checkpoint
+        if mesh is None:
+            self._devices = []
+            self._n_model = 1
+            n = 1
+        else:
+            from ..parallel.mesh import MODEL_AXIS
+
+            self._devices = list(np.asarray(mesh.devices).reshape(-1))
+            self._n_model = (
+                int(mesh.shape[MODEL_AXIS])
+                if MODEL_AXIS in mesh.axis_names
+                else 1
+            )
+            n = self.requested_hosts or simulated_host_count()
+            n = max(1, min(n, len(self._devices)))
+        self.n_hosts = n
+        self.sentinel = HostSentinel(list(range(n)), self.config)
+        self.guard = CollectiveGuard(
+            self.sentinel, self.config.max_collective_retries
+        )
+        self.mesh_history = [mesh_fingerprint(mesh)]
+        return self
+
+    # ------------------------------------------------------ progress hooks
+    def _check_pulse(self, where: str) -> None:
+        if self.sentinel is None:
+            return
+        self.sentinel.beat_all()
+        dead = self.sentinel.dead_hosts()
+        if dead:
+            raise HostLostError(
+                dead[0],
+                reason=(
+                    f"no heartbeat for {self.config.timeout}s ({where})"
+                ),
+            )
+
+    def on_layer_end(self, index: int) -> None:
+        """Layer boundary (workflow/fit.py): surviving hosts heartbeat,
+        then silent ones are declared dead — the checkpoint for this layer
+        is already on disk, so failover resumes right here."""
+        self._check_pulse(f"layer {index}")
+
+    def on_fold(self, index: int) -> None:
+        """CV fold boundary (workflow/cv.py) — same pulse check."""
+        self._check_pulse(f"fold {index}")
+
+    # ------------------------------------------------------------ failover
+    def failover(self, err: HostLostError):
+        """Degrade the mesh after a declared host loss; returns the new
+        mesh (None = single-device recovery). Re-raises ``err`` when no
+        failover is possible (unbound, budget exhausted, or no
+        survivors)."""
+        if self.sentinel is None:
+            raise err
+        if self.counters["failovers"] >= self.max_failovers:
+            log.error(
+                "failover budget exhausted (%d); giving up",
+                self.max_failovers,
+            )
+            raise err
+        live = self.sentinel.live_hosts()
+        host = err.host
+        if host is None or host not in live:
+            # a timed-out collective may not know WHICH participant hung;
+            # drop the last live host block (deterministic, documented)
+            host = live[-1] if live else None
+        if host is None:
+            raise err
+        self.sentinel.declare_lost(host)
+        self.counters["hostsLost"] += 1
+        survivors = self._surviving_devices()
+        if not survivors:
+            # losing the only participant — the single-device (mesh=None)
+            # run included — is unrecoverable, not a failover
+            raise err
+        self.counters["failovers"] += 1
+        self.mesh = self._degraded_mesh(survivors)
+        # rows re-shard implicitly: in-memory training data re-pads and
+        # re-places under the new mesh on the next fit; per-host ingest
+        # consumers re-derive their blocks via adopt_orphans. The
+        # reshardEvents counter tracks resharded CHECKPOINT layer loads
+        # (CheckpointManager.reshard_events), not this mesh change —
+        # meshHistory records that.
+        self.mesh_history.append(mesh_fingerprint(self.mesh))
+        log.warning(
+            "failover: host %r lost (%s); continuing on %d device(s)",
+            host, err.reason, max(1, len(survivors)),
+        )
+        return self.mesh
+
+    def _surviving_devices(self) -> list:
+        if not self._devices:
+            return []
+        blocks = host_blocks(len(self._devices), self.n_hosts)
+        lost = set(self.sentinel.lost) if self.sentinel is not None else set()
+        out: list = []
+        for h, sl in enumerate(blocks):
+            if h not in lost:
+                out.extend(self._devices[sl])
+        return out
+
+    def _degraded_mesh(self, devices: list):
+        """The survivors' mesh: a flat ("data", "model") make_mesh. A
+        3-axis multihost ("dcn", ...) mesh degrades to this flat form too
+        — correct for the CPU simulation (all devices are local), but
+        re-forming a DCN-spanning mesh after a REAL process loss needs
+        the control plane to re-initialize, which is out of scope here."""
+        if len(devices) < 2 or len(devices) < self._n_model:
+            return None  # M=1 (or degenerate) recovery: plain jit
+        from ..parallel.mesh import make_mesh
+
+        n_data = len(devices) // self._n_model
+        return make_mesh(
+            n_data, self._n_model, devices=devices[: n_data * self._n_model]
+        )
+
+    def summary(self) -> dict[str, Any]:
+        """One merged counter dict, the shape persisted in the model
+        manifest and surfaced by selector summary / summary_pretty /
+        score-function metadata."""
+        out = dict(self.counters)
+        if self.sentinel is not None:
+            out.update(self.sentinel.counters)
+            out["hosts"] = self.n_hosts
+            out["lostHosts"] = list(self.sentinel.lost)
+        if self.guard is not None:
+            out.update(self.guard.counters)
+        out["meshHistory"] = list(self.mesh_history)
+        return out
+
+
+# ------------------------------------------------------------- installation
+_CONTROLLER: FailoverController | None = None
+
+
+def install_controller(controller: FailoverController) -> None:
+    global _CONTROLLER
+    if _CONTROLLER is not None:
+        raise RuntimeError("a FailoverController is already installed")
+    _CONTROLLER = controller
+
+
+def uninstall_controller() -> None:
+    global _CONTROLLER
+    _CONTROLLER = None
+
+
+def active_controller() -> FailoverController | None:
+    return _CONTROLLER
+
+
+def active_collective_guard() -> CollectiveGuard | None:
+    """The installed controller's guard, or None — the zero-cost answer the
+    parallel reductions check before wrapping themselves."""
+    c = _CONTROLLER
+    return None if c is None else c.guard
+
+
+@contextlib.contextmanager
+def installed_controller(
+    controller: FailoverController,
+) -> Iterator[FailoverController]:
+    install_controller(controller)
+    try:
+        yield controller
+    finally:
+        uninstall_controller()

@@ -2,9 +2,11 @@
 
 A ``FaultPlan`` is a seeded, declarative script of failures — "raise on the
 Nth estimator fit", "die after layer k was checkpointed", "corrupt this
-stage's output with NaN" — installed process-globally (``installed(plan)``)
-and consulted from cheap hooks inside ``workflow/fit.py``,
-``selector/validators.py`` and ``local/scoring.py``. Because every firing
+stage's output with NaN", "kill simulated host 1 mid-collective" —
+installed process-globally (``installed(plan)``) and consulted from cheap
+hooks inside ``workflow/fit.py``, ``selector/validators.py``,
+``local/scoring.py``, and the distributed plane
+(``resilience/distributed.py``, ``parallel/reductions.py``). Because every firing
 is counted, the same plan replays the same failure sequence on every run:
 the recovery paths (checkpoint/resume, retry-with-backoff, score-time
 guards) are exercised deterministically in tier-1, no flaky process
@@ -64,6 +66,10 @@ class FaultPlan:
         self._profile_faults: list[dict[str, Any]] = []
         self._drift_faults: list[dict[str, Any]] = []
         self._chunk_faults: list[dict[str, Any]] = []
+        self._host_faults: list[dict[str, Any]] = []
+        self._straggle_faults: list[dict[str, Any]] = []
+        self._heartbeat_faults: list[dict[str, Any]] = []
+        self._shard_faults: list[dict[str, Any]] = []
         #: chronological record of fired faults: (kind, detail)
         self.fired: list[tuple[str, str]] = []
 
@@ -178,6 +184,67 @@ class FaultPlan:
         )
         return self
 
+    # ------------------------------------------------- distributed faults
+    def fail_host(
+        self,
+        host: Any,
+        after_layer: int | None = None,
+        collective: str | None = None,
+        times: int = 1,
+    ) -> "FaultPlan":
+        """Declare simulated host ``host`` dead: at the end of DAG layer
+        ``after_layer`` (fires AFTER that layer's checkpoint was written —
+        the mid-train kill), or while a matching ``collective`` reduction
+        runs (``pcolumn_stats`` / ``pxtx`` / ``phistogram`` / ...). Raises
+        ``HostLostError``, which only the workflow failover loop handles."""
+        if after_layer is None and collective is None:
+            raise ValueError("fail_host needs after_layer or collective")
+        self._host_faults.append(
+            {"host": host, "layer": after_layer, "collective": collective,
+             "times": times, "count": 0}
+        )
+        return self
+
+    def straggle_collective(
+        self,
+        name: str | None = None,
+        delay: float = 1e6,
+        host: Any = None,
+        times: int = 1,
+    ) -> "FaultPlan":
+        """Inflate the observed duration of a matching collective by
+        ``delay`` simulated seconds (``name=None`` matches any) — the
+        CollectiveGuard sees a straggler without any real sleep. ``host``
+        optionally names the slow participant so an exhausted retry budget
+        declares the right host dead."""
+        self._straggle_faults.append(
+            {"name": name, "delay": float(delay), "host": host,
+             "times": times, "count": 0}
+        )
+        return self
+
+    def drop_heartbeat(
+        self, host: Any, times: int | None = None
+    ) -> "FaultPlan":
+        """Swallow ``host``'s heartbeats (HostSentinel.beat) so the
+        injectable clock can age it into a declared death. Unlimited by
+        default — a dead host stays silent."""
+        self._heartbeat_faults.append(
+            {"host": host, "times": times, "count": 0}
+        )
+        return self
+
+    def corrupt_shard(
+        self, layer: int | None = None, times: int = 1
+    ) -> "FaultPlan":
+        """Corrupt a checkpointed layer's shard payload at load time
+        (``layer=None`` matches any layer) — resume must truncate the
+        restored prefix and refit, never crash or restore garbage."""
+        self._shard_faults.append(
+            {"layer": layer, "times": times, "count": 0}
+        )
+        return self
+
     @staticmethod
     def truncate_file(path: str, keep: int = 20) -> None:
         """Tear a checkpoint / AOT blob the way a killed writer would."""
@@ -217,6 +284,78 @@ class FaultPlan:
                 raise SimulatedCrash(
                     f"injected crash after layer {layer_index}"
                 )
+            for f in self._host_faults:
+                if f["count"] >= f["times"] or f["layer"] != layer_index:
+                    continue
+                f["count"] += 1
+                self.fired.append(("host", f"{f['host']}@layer-{layer_index}"))
+                from .distributed import HostLostError
+
+                raise HostLostError(
+                    f["host"],
+                    reason=f"injected host loss after layer {layer_index}",
+                )
+
+    def on_collective(self, name: str) -> tuple[float, Any]:
+        """CollectiveGuard hook: returns (extra simulated seconds, the
+        straggling host or None); raises ``HostLostError`` for a host
+        scripted to die during this collective."""
+        with self._lock:
+            for f in self._host_faults:
+                if f["count"] >= f["times"] or f["collective"] is None:
+                    continue
+                if f["collective"] != name:
+                    continue
+                f["count"] += 1
+                self.fired.append(("host", f"{f['host']}@{name}"))
+                from .distributed import HostLostError
+
+                raise HostLostError(
+                    f["host"],
+                    reason=f"injected host loss during collective {name}",
+                )
+            extra, host = 0.0, None
+            for f in self._straggle_faults:
+                if f["count"] >= f["times"]:
+                    continue
+                if f["name"] is not None and f["name"] != name:
+                    continue
+                f["count"] += 1
+                if f["count"] == 1:
+                    self.fired.append(("straggle", name))
+                extra += f["delay"]
+                if host is None:
+                    host = f["host"]
+            return extra, host
+
+    def on_heartbeat(self, host: Any) -> bool:
+        """True = swallow this heartbeat (HostSentinel.beat). Fires per
+        beat; only the FIRST firing per fault lands in ``fired``."""
+        with self._lock:
+            for f in self._heartbeat_faults:
+                if f["times"] is not None and f["count"] >= f["times"]:
+                    continue
+                if f["host"] != host:
+                    continue
+                f["count"] += 1
+                if f["count"] == 1:
+                    self.fired.append(("heartbeat", str(host)))
+                return True
+        return False
+
+    def on_shard_load(self, layer_index: int) -> bool:
+        """True = treat this checkpoint layer's shard payload as corrupt
+        (CheckpointManager load path)."""
+        with self._lock:
+            for f in self._shard_faults:
+                if f["count"] >= f["times"]:
+                    continue
+                if f["layer"] is not None and f["layer"] != layer_index:
+                    continue
+                f["count"] += 1
+                self.fired.append(("shard", f"layer-{layer_index}"))
+                return True
+        return False
 
     def on_candidate_fit(self, est: Any) -> None:
         name = type(est).__name__

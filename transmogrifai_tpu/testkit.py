@@ -437,7 +437,9 @@ def fault_plan(seed: int = 42) -> "Any":
     harness (raise on the Nth fit, crash after a layer, NaN a stage output,
     tear a file; serving side: malform incoming rows, fail a scoring
     stage, tear a training profile, shift a feature's observed stream,
-    fail streaming chunk reads). Install it over a block with
+    fail streaming chunk reads; distributed side: kill a simulated host
+    after a layer or mid-collective, straggle a collective, drop
+    heartbeats, corrupt a checkpoint shard). Install it over a block with
     ``install_faults``::
 
         plan = testkit.fault_plan().crash_after_layer(1)
@@ -451,6 +453,12 @@ def fault_plan(seed: int = 42) -> "Any":
         with testkit.install_faults(plan):
             fn = score_function(model)
             fn.batch(rows)
+
+        plan = (testkit.fault_plan()
+                .fail_host(1, after_layer=2)            # degraded-mesh path
+                .straggle_collective("pxtx", delay=120.0))
+        with testkit.install_faults(plan):
+            workflow.train(checkpoint_dir=d)   # fails over, completes
     """
     from .resilience.faults import FaultPlan
 

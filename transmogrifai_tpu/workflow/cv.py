@@ -25,6 +25,7 @@ import numpy as np
 
 from ..dataset import Dataset
 from ..evaluators.base import Evaluator
+from ..resilience import distributed
 from ..selector.model_selector import ModelSelector
 from ..selector.validators import CandidateResult, expand_grid
 from ..types.columns import NumericColumn, VectorColumn
@@ -67,6 +68,12 @@ def workflow_cv_results(
     failed: set[str] = set()
 
     for fold_i, (train_mask, val_mask) in enumerate(folds):
+        # fold-boundary heartbeat pulse: a silent host is declared dead
+        # between folds, and HostLostError (a BaseException) sails past the
+        # candidate-isolation handlers below into the workflow failover loop
+        controller = distributed.active_controller()
+        if controller is not None:
+            controller.on_fold(fold_i)
         tr_idx = np.nonzero(train_mask)[0]
         va_idx = np.nonzero(val_mask)[0]
         fold_train = train_data.take(tr_idx)

@@ -19,7 +19,7 @@ from typing import Iterable
 
 from ..dataset import Dataset
 from ..features.feature import Feature
-from ..resilience import faults
+from ..resilience import distributed, faults
 from ..stages.base import Estimator, Model, PipelineStage, Transformer
 from .dag import compute_dag
 
@@ -76,6 +76,8 @@ def fit_and_transform_dag(
         if checkpoint is not None and (
             newly_fitted or not checkpoint.has_layer(li)
         ):
+            from ..parallel.mesh import execution_mesh
+
             # resume skips re-serializing layers restored intact from disk
             # (large fitted arrays make that pure wasted compression/IO)
             checkpoint.save_layer(
@@ -86,9 +88,16 @@ def fit_and_transform_dag(
                     for pos, s in enumerate(layer)
                     if isinstance(fitted[s.uid], Model)
                 ],
+                mesh_info=distributed.mesh_fingerprint(execution_mesh()),
             )
         if plan is not None:
             plan.on_layer_end(li)
+        # heartbeat pulse at the layer boundary: the checkpoint for this
+        # layer is on disk, so a host declared dead here fails over with
+        # zero lost work
+        controller = distributed.active_controller()
+        if controller is not None:
+            controller.on_layer_end(li)
     return dataset, fitted
 
 

@@ -206,6 +206,7 @@ def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F82
         "blocklisted": model.blocklisted,
         "sensitiveFeatures": model.sensitive_info,
         "servingProfiles": model.serving_profiles,
+        "distResilience": model.dist_summary,
     }
     atomic_write_model_dir(path, manifest, arrays)
 
@@ -298,4 +299,6 @@ def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
         sensitive_info=manifest.get("sensitiveFeatures"),
         # absent on pre-drift-sentinel saves: the sentinel just stays inert
         serving_profiles=manifest.get("servingProfiles"),
+        # absent on pre-failover saves: no dist ledger to report
+        dist_summary=manifest.get("distResilience"),
     )

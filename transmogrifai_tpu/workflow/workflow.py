@@ -176,17 +176,36 @@ class Workflow:
         self,
         checkpoint_dir: str | None = None,
         resume: bool = False,
+        on_mesh_mismatch: str = "reshard",
     ) -> "WorkflowModel":
         """Fit the DAG. With ``checkpoint_dir``, every completed layer (and
         every finished CV candidate sweep) is persisted atomically there;
         ``resume=True`` restores completed layers into the ``prefitted``
-        warm-start dict so only unfinished work re-runs (docs/robustness.md)."""
+        warm-start dict so only unfinished work re-runs (docs/robustness.md).
+
+        Checkpoints record the device topology they were written under;
+        resuming on a different mesh (N→M devices, including M=1)
+        reshards the saved arrays onto the current mesh by default —
+        ``on_mesh_mismatch="raise"`` turns a topology change into a
+        ``CheckpointMeshMismatch`` instead. Training also runs inside an
+        elastic failover loop (resilience/distributed.py): a declared host
+        loss (heartbeat timeout, exhausted collective retries, injected
+        ``fail_host``) degrades the mesh to the surviving hosts' devices
+        and re-enters the fit from the last completed layer checkpoint
+        instead of aborting."""
         if not self.result_features:
             raise ValueError("setResultFeatures must be called before train")
         if self.reader is None:
             raise ValueError("No input data: call set_input_dataset or set_reader")
         if resume and checkpoint_dir is None:
             raise ValueError("resume=True requires checkpoint_dir")
+        if on_mesh_mismatch not in ("reshard", "raise"):
+            # an unrecognized policy must not silently mean "reshard" for
+            # a caller who asked to fail on topology changes
+            raise ValueError(
+                f"unknown on_mesh_mismatch {on_mesh_mismatch!r} "
+                "(choose 'reshard' or 'raise')"
+            )
         stages = self._stages()
         self._apply_overrides(stages)
         selectors = [s for s in stages if isinstance(s, ModelSelector)]
@@ -248,7 +267,9 @@ class Workflow:
         # checkpoint/resume (resilience/): completed layers restore into the
         # prefitted warm-start dict; the selector checkpoints CV candidates
         ckpt = None
-        prefitted = dict(self._prefitted)
+        signature = None
+        dag_layers = None
+        base_prefitted = dict(self._prefitted)
         if checkpoint_dir is not None:
             from ..resilience.checkpoint import (
                 CheckpointManager,
@@ -257,13 +278,11 @@ class Workflow:
             )
 
             ckpt = CheckpointManager(checkpoint_dir)
-            if resume:
-                layers = compute_dag(self.result_features)
-                signature = dag_signature(
-                    layers, dataset_fingerprint(train_data)
-                )
-                prefitted.update(ckpt.load_layers(signature, layers))
-            else:
+            dag_layers = compute_dag(self.result_features)
+            signature = dag_signature(
+                dag_layers, dataset_fingerprint(train_data)
+            )
+            if not resume:
                 # fresh train: stale entries from a previous run in the
                 # same dir must never mix into a later crash + resume
                 ckpt.clear()
@@ -275,37 +294,99 @@ class Workflow:
 
         # every estimator fit below runs under the ambient execution mesh:
         # tree fits shard_map rows with psum'd histograms, solver fits ride
-        # GSPMD row sharding; None (single device) = plain jit
+        # GSPMD row sharding; None (single device) = plain jit. The
+        # FailoverController wraps the whole fit phase: on a declared host
+        # loss the mesh degrades to the surviving hosts' devices and the
+        # fit re-enters from the last completed layer checkpoint.
+        import contextlib
+
         from ..parallel.mesh import use_execution_mesh
+        from ..resilience import distributed
+        from ..resilience.distributed import HostLostError
 
-        mesh = self._resolve_mesh()
-        try:
-            with use_execution_mesh(mesh):
-                if self._workflow_cv and selector is not None:
-                    from .cv import workflow_cv_results
+        controller = distributed.active_controller()
+        own_controller = controller is None
+        if own_controller:
+            controller = distributed.FailoverController()
+        controller.bind(self._resolve_mesh(), checkpoint=ckpt)
 
-                    # NOTE: checkpoint-restored stages deliberately stay OUT
-                    # of the per-fold refits — they were fit on the full
-                    # training split, and prefitting them here would leak
-                    # validation rows into candidate selection; only the
-                    # user's explicit warm-start stages are honored (same
-                    # semantics as an uninterrupted withWorkflowCV train)
-                    selector.precomputed_results = workflow_cv_results(
-                        selector, train_data, prefitted=self._prefitted
-                    )
-                    log.info(
-                        "Workflow-level CV: %d candidate results from per-fold DAG refits",
-                        len(selector.precomputed_results),
-                    )
-
-                fitted_data, fitted = fit_and_transform_dag(
-                    train_data, self.result_features, prefitted=prefitted,
-                    checkpoint=ckpt,
+        def load_checkpointed_layers() -> dict[str, Any]:
+            pf = dict(base_prefitted)
+            if ckpt is not None and (
+                resume or controller.counters["failovers"]
+            ):
+                # the strict policy applies to the user-initiated resume
+                # only: after a failover THIS run changed the mesh on
+                # purpose, so the reload must reshard, not crash
+                policy = (
+                    "reshard" if controller.counters["failovers"]
+                    else on_mesh_mismatch
                 )
+                pf.update(ckpt.load_layers(
+                    signature, dag_layers,
+                    mesh_info=distributed.mesh_fingerprint(controller.mesh),
+                    mesh_policy=policy,
+                ))
+                controller.counters["reshardEvents"] += ckpt.reshard_events
+            return pf
+
+        try:
+            install = (
+                distributed.installed_controller(controller)
+                if own_controller
+                else contextlib.nullcontext()
+            )
+            with install:
+                prefitted = load_checkpointed_layers()
+                cv_results = None
+                while True:
+                    try:
+                        with use_execution_mesh(controller.mesh):
+                            if self._workflow_cv and selector is not None:
+                                if cv_results is None:
+                                    from .cv import workflow_cv_results
+
+                                    # NOTE: checkpoint-restored stages stay
+                                    # OUT of the per-fold refits — they were
+                                    # fit on the full training split, and
+                                    # prefitting them here would leak
+                                    # validation rows into candidate
+                                    # selection; only the user's explicit
+                                    # warm-start stages are honored (same
+                                    # semantics as an uninterrupted
+                                    # withWorkflowCV train)
+                                    cv_results = workflow_cv_results(
+                                        selector, train_data,
+                                        prefitted=self._prefitted,
+                                    )
+                                    log.info(
+                                        "Workflow-level CV: %d candidate "
+                                        "results from per-fold DAG refits",
+                                        len(cv_results),
+                                    )
+                                # re-handed on every attempt: the selector
+                                # consumes them, and a failover AFTER the
+                                # sweep finished must not re-run training's
+                                # most expensive phase
+                                selector.precomputed_results = cv_results
+
+                            fitted_data, fitted = fit_and_transform_dag(
+                                train_data, self.result_features,
+                                prefitted=prefitted, checkpoint=ckpt,
+                            )
+                        break
+                    except HostLostError as e:
+                        # elastic degraded-mesh failover: shrink the mesh to
+                        # the survivors (raises when no failover is left),
+                        # restore every completed layer from the checkpoint,
+                        # and re-enter the fit instead of aborting
+                        controller.failover(e)
+                        prefitted = load_checkpointed_layers()
         finally:
             if selector is not None:
                 selector._checkpoint = None
                 selector._checkpoint_resume = False
+        dist_summary = controller.summary()
 
         selector_info = None
         if selector is not None:
@@ -317,6 +398,11 @@ class Workflow:
                 "evaluator": selector.evaluator.name,
                 "problemKind": selector.problem_kind,
             }
+            sel_stage = fitted.get(selector.uid)
+            if isinstance(sel_stage, SelectedModel):
+                # failover counters ride the selector summary next to the
+                # PR-1 candidateAttempts ledger (same reporting convention)
+                sel_stage.summary["distributedResilience"] = dist_summary
 
         if selector is not None and holdout_data is not None:
             sel_model = fitted[selector.uid]
@@ -362,6 +448,7 @@ class Workflow:
             label_summary=label_summary,
             training_params=dict(self._stage_overrides),
             serving_profiles=serving_profiles,
+            dist_summary=dist_summary,
         )
         if selector is not None:
             # keep the live evaluator object so custom evaluators keep working
@@ -431,6 +518,7 @@ class WorkflowModel:
         label_summary: dict[str, Any] | None = None,
         training_params: dict[str, Any] | None = None,
         serving_profiles: dict[str, Any] | None = None,
+        dist_summary: dict[str, Any] | None = None,
     ):
         self.result_features = result_features
         self.raw_features = raw_features
@@ -447,6 +535,10 @@ class WorkflowModel:
         #: sentinel (fill rate + StreamingHistogram JSON); None on models
         #: saved before this field existed
         self.serving_profiles = serving_profiles
+        #: distributed-resilience ledger from training (hosts lost,
+        #: failovers, collective retries, stragglers, reshard events, mesh
+        #: history); None on models saved before this field existed
+        self.dist_summary = dist_summary
 
     # --------------------------------------------------------- persistence
     def save(self, path: str) -> None:
@@ -593,6 +685,7 @@ class WorkflowModel:
             "sensitiveFeatures": self.sensitive_info,
             "modelSelectorSummary": sel_summary,
             "stageMetadata": stage_meta,
+            "distributedResilience": self.dist_summary,
         }
 
     def summary_pretty(self) -> str:
@@ -729,6 +822,22 @@ class WorkflowModel:
                 lines.extend(ilines)  # all-or-nothing: no dangling headers
             except Exception as e:  # insights are best-effort here
                 log.debug("summary_pretty insights skipped: %s", e)
+        dist = getattr(self, "dist_summary", None) or {}
+        if any(
+            dist.get(k)
+            for k in (
+                "hostsLost", "failovers", "stragglersDetected",
+                "collectivesRetried", "reshardEvents",
+            )
+        ):
+            lines.append(
+                f"Distributed resilience: {dist.get('hostsLost', 0)} "
+                f"host(s) lost, {dist.get('failovers', 0)} failover(s), "
+                f"{dist.get('collectivesRetried', 0)} collective "
+                f"retry(ies), {dist.get('stragglersDetected', 0)} "
+                f"straggler(s), {dist.get('reshardEvents', 0)} reshard "
+                f"event(s)"
+            )
         serve = self._serving_resilience_line()
         if serve:
             lines.append(serve)
