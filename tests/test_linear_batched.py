@@ -129,3 +129,18 @@ def test_fit_arrays_batched_masks_matches_fit_arrays():
                 pb / scale, ps / scale, atol=5e-3,
                 err_msg=f"mask {mi} point {pi}",
             )
+
+
+def test_no_lane_broadcast_temporary_in_lowering():
+    """Memory-shape regression for the exact-constant detection: the
+    masked per-(K, D) min/max must lower WITHOUT the [K, N, D] broadcast
+    temporary the one-shot jnp.where form materialized (O(K*N*D) bytes,
+    scaling with the grid). Distinct primes make the shape string
+    unambiguous in the lowered StableHLO."""
+    k, n, d = 7, 31, 13
+    txt = fit_linear_batched.lower(
+        jnp.zeros((n, d), jnp.float32), jnp.zeros(n, jnp.float32),
+        jnp.ones((k, n), jnp.float32), jnp.zeros(k, jnp.float32),
+        jnp.zeros(k, jnp.float32), num_iters=8, fit_intercept=True,
+    ).as_text()
+    assert f"{k}x{n}x{d}" not in txt

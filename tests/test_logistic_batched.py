@@ -192,3 +192,17 @@ def test_no_intercept_scale_only_multinomial_svc(fitfn_kind):
         m_r = x @ np.asarray(raw.weights)
         agree = ((m_s > 0) == (m_r > 0)).mean()
         assert agree > 0.97
+
+
+def test_no_lane_broadcast_temporary_in_lowering():
+    """Memory-shape regression (mirrors test_linear_batched): the exact
+    constant-column min/max must not lower a [K, N, D] broadcast
+    temporary — lanes scan via lax.map over one [N, D] buffer."""
+    k, n, d = 7, 31, 13
+    txt = fit_logistic_binary_batched.lower(
+        jnp.zeros((n, d), jnp.float32), jnp.zeros(n, jnp.float32),
+        jnp.ones((k, n), jnp.float32), jnp.zeros(k, jnp.float32),
+        jnp.zeros(k, jnp.float32), num_iters=4, fit_intercept=True,
+        standardization=True,
+    ).as_text()
+    assert f"{k}x{n}x{d}" not in txt

@@ -44,3 +44,56 @@ class TestNative:
     @pytest.mark.skipif(not native.available(), reason="no toolchain")
     def test_native_is_active_in_ci(self):
         assert native.available()
+
+
+class TestTreePredictSumValidation:
+    """tree_predict_sum must validate split-feature indices and the leaf
+    table width BEFORE handing pointers to the C kernel — a malformed
+    stack raises the same IndexError the numpy traversal would instead of
+    reading out of bounds."""
+
+    def _valid(self):
+        # 1 tree, depth 2, width 2: root splits feat 0, level-1 feat 1
+        binned = np.array(
+            [[0, 1, 2], [3, 0, 1], [1, 2, 0], [2, 3, 3]], dtype=np.int32
+        )
+        sf = np.array([[[0, -1], [1, 1]]], dtype=np.int32)   # [1, 2, 2]
+        sb = np.array([[[1, 0], [2, 1]]], dtype=np.int32)
+        lv = np.arange(4, dtype=np.float32).reshape(1, 4)    # [1, 2^2]
+        return binned, sf, sb, lv
+
+    def _require_kernel(self):
+        lib = native._load()
+        if lib is None or not hasattr(lib, "tp_tree_predict_sum"):
+            pytest.skip("native tree kernel unavailable")
+
+    def test_valid_stack_passes(self):
+        self._require_kernel()
+        binned, sf, sb, lv = self._valid()
+        out = native.tree_predict_sum(binned, sf, sb, lv)
+        assert out is not None and out.shape == (4,)
+        assert np.isfinite(out).all()
+
+    def test_split_feature_out_of_bounds_raises(self):
+        self._require_kernel()
+        binned, sf, sb, lv = self._valid()
+        sf = sf.copy()
+        sf[0, 0, 0] = 99  # >= num_f=3: the C gather would read OOB
+        with pytest.raises(IndexError, match="split feature index"):
+            native.tree_predict_sum(binned, sf, sb, lv)
+
+    def test_leaf_table_width_mismatch_raises(self):
+        self._require_kernel()
+        binned, sf, sb, lv = self._valid()
+        with pytest.raises(IndexError, match="leaf table width"):
+            native.tree_predict_sum(binned, sf, sb, lv[:, :3])
+
+    def test_matches_numpy_traversal_on_valid_stack(self):
+        self._require_kernel()
+        from transmogrifai_tpu.models import trees as TR
+
+        binned, sf, sb, lv = self._valid()
+        stack = TR.Tree(split_feat=sf, split_bin=sb, leaf_value=lv)
+        expect = TR._traverse_host(binned, stack).sum(axis=0)
+        got = native.tree_predict_sum(binned, sf, sb, lv)
+        np.testing.assert_allclose(got, expect)

@@ -611,7 +611,7 @@ class TestCorruptAotBlob:
         fn = jax.jit(lambda a: a * 2.0)
         args = (np.arange(4, dtype=np.float32),)
         key = aot._key("resilience_test", args, {})
-        path = os.path.join(tmp_path, f"{aot._version_salt()}-{key}.jaxexec")
+        path = aot._blob_path("resilience_test", key)
 
         # garbage bytes: not even a pickle
         with open(path, "wb") as fh:

@@ -811,3 +811,72 @@ class TestMetadataAndSummary:
         with installed(FaultPlan().nan_output(pred.name, rows=(0,))):
             with pytest.raises(ScoreGuardError, match="non-finite"):
                 fn.batch(ds.rows()[:2])
+
+
+# --------------------------------------- all-null response: entry-point parity
+class TestAllNullResponseParity:
+    """A PRESENT but all-null response column must score through
+    ``fn.columns`` exactly like ``fn.batch`` scores the same unlabeled
+    rows: both entry points substitute the score-time null-label fill
+    (``column_from_values(ftype, [0]*b)``), so label-observing machinery
+    (the drift sentinel's fill-rate window, label-consuming stages) sees
+    identical raw columns."""
+
+    def _null_label_data(self, trained, n=64):
+        from transmogrifai_tpu.types.columns import empty_like
+
+        ds, pred, model = trained
+        label_f = next(f for f in model.raw_features if f.is_response)
+        sub = ds.take(np.arange(n))
+        null_ds = sub.with_column(
+            label_f.name, empty_like(label_f.ftype, n)
+        )
+        rows = null_ds.rows()
+        assert all(r[label_f.name] is None for r in rows)
+        return null_ds, rows, label_f, pred, model
+
+    def test_predictions_agree(self, trained):
+        null_ds, rows, _label_f, pred, model = self._null_label_data(trained)
+        fn = score_function(model)
+        out_rows = fn.batch(rows)
+        out_cols = fn.columns(null_ds)[pred.name].to_list()
+        for i, row_out in enumerate(out_rows):
+            assert row_out[pred.name] == out_cols[i]
+
+    def test_label_consuming_stage_sees_the_fill_on_both_paths(self):
+        """The distinguishing assertion: a result feature DERIVED from the
+        response (label - 1.0) must see the score-time 0-fill on BOTH
+        entry points — without the columnar-path substitution, fn.columns
+        hands the stage an all-null label and the derived column nulls
+        out while fn.batch reports -1.0."""
+        import transmogrifai_tpu.dsl  # noqa: F401  (Feature arithmetic)
+
+        uid_util.reset()
+        ds = _binary_ds(n=160, seed=23)
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        selector = BinaryClassificationModelSelector(
+            seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+            num_folds=2,
+        )
+        pred = selector.set_input(resp, vec).get_output()
+        shifted = resp - 1.0
+        model = (
+            Workflow()
+            .set_result_features(pred, shifted)
+            .set_input_dataset(ds)
+            .train()
+        )
+        from transmogrifai_tpu.types.columns import empty_like
+
+        n = 8
+        null_ds = ds.take(np.arange(n)).with_column(
+            "label", empty_like(T.RealNN, n)
+        )
+        rows = null_ds.rows()
+        fn = score_function(model)
+        out_rows = fn.batch(rows)
+        out_cols = fn.columns(null_ds)[shifted.name].to_list()
+        for i in range(n):
+            assert out_rows[i][shifted.name] == -1.0
+            assert out_cols[i] == out_rows[i][shifted.name]

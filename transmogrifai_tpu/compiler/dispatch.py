@@ -1,0 +1,148 @@
+"""Donated-buffer, pipelined dispatch helpers.
+
+Two seams that cut hot-path dispatch cost without touching any math:
+
+* **Donation** — ``donating(name, jit_fn, ...)`` builds a ``jax.jit`` twin
+  of a module-level jitted function with ``donate_argnums`` set, so a
+  carried buffer (the boosting margin between chunk programs, a sweep's
+  fresh mask stack) is aliased into the output instead of copied. Callers
+  must treat donated args as CONSUMED — every wired call site passes a
+  buffer it never reads again. ``TPTPU_DONATE=0`` falls back to the
+  undonated original.
+
+* **Transfer prefetch** — ``prefetch_f32(arr)`` starts the async
+  host→device upload of a float32 view of ``arr`` while host-side work
+  (layer transforms, checkpoint saves, row codecs) is still running;
+  ``device_f32(arr)`` picks the in-flight buffer up at dispatch time (or
+  falls back to a plain ``jnp.asarray``). This is how layer k+1's input
+  transfer overlaps layer k's compute on the tunneled chip. Prefetch is a
+  no-op under an active execution mesh — GSPMD placement stays with the
+  sharding helpers in ``parallel/mesh.py``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import weakref
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_DONATED: dict[str, Any] = {}
+_DONATED_LOCK = threading.Lock()
+
+
+def donating(
+    name: str,
+    jit_fn: Callable,
+    donate_argnums: tuple[int, ...],
+    static_argnames: Sequence[str] = (),
+) -> Callable:
+    """Donation-enabled twin of ``jit_fn`` (cached by ``name``). Returns
+    ``jit_fn`` unchanged when donation is disabled or the wrapped python
+    function is not recoverable."""
+    if os.environ.get("TPTPU_DONATE", "1") == "0":
+        return jit_fn
+    with _DONATED_LOCK:
+        got = _DONATED.get(name)
+    if got is not None:
+        return got
+    base = getattr(jit_fn, "__wrapped__", None)
+    if base is None:
+        got = jit_fn
+    else:
+        import jax
+
+        try:
+            got = jax.jit(
+                base,
+                static_argnames=tuple(static_argnames),
+                donate_argnums=donate_argnums,
+            )
+        except Exception as e:  # donation must never break a fit
+            log.info("donated twin of %s unavailable (%s)", name, e)
+            got = jit_fn
+    with _DONATED_LOCK:
+        _DONATED.setdefault(name, got)
+        return _DONATED[name]
+
+
+# ---------------------------------------------------------------- prefetch
+# id -> (weakref-to-source, device buffer); small FIFO — entries exist only
+# between a prefetch and the dispatch that consumes them
+_PREFETCH: dict[int, tuple] = {}
+_PREFETCH_LOCK = threading.Lock()
+_PREFETCH_CAP = 8
+
+
+def _mesh_active() -> bool:
+    try:
+        from ..parallel.mesh import execution_mesh
+
+        return execution_mesh() is not None
+    except Exception:
+        return False
+
+
+def prefetch_f32(arr) -> None:
+    """Start the async device upload of ``np.asarray(arr, float32)``;
+    ``device_f32`` on the SAME object (by identity) picks it up. Errors are
+    swallowed — prefetch is purely an overlap optimization."""
+    try:
+        if _mesh_active():
+            return
+        src = arr
+        key = id(src)
+        with _PREFETCH_LOCK:
+            if key in _PREFETCH:
+                return
+        import jax
+
+        buf = jax.device_put(np.asarray(arr, dtype=np.float32))
+        try:
+            ref = weakref.ref(src)
+        except TypeError:  # source not weakref-able: skip (no way to
+            return         # detect the id being recycled)
+        with _PREFETCH_LOCK:
+            _PREFETCH[key] = (ref, buf)
+            while len(_PREFETCH) > _PREFETCH_CAP:
+                _PREFETCH.pop(next(iter(_PREFETCH)))
+    except Exception as e:
+        log.debug("prefetch skipped: %s", e)
+
+
+def device_f32(arr):
+    """The prefetched device buffer for ``arr`` if one is in flight (and
+    the source object is still alive — a dead ref means the id may have
+    been recycled), else a plain float32 ``jnp.asarray``. Entries are NOT
+    consumed: several model families dispatch on the same training matrix.
+    Callers must not mutate ``arr`` between prefetch and dispatch."""
+    import jax.numpy as jnp
+
+    key = id(arr)
+    with _PREFETCH_LOCK:
+        hit = _PREFETCH.get(key)
+        # purge dead refs opportunistically so recycled ids cannot alias
+        for k in [k for k, (r, _) in _PREFETCH.items() if r() is None]:
+            _PREFETCH.pop(k, None)
+    if hit is not None:
+        ref, buf = hit
+        if ref() is arr and not _mesh_active():
+            return buf
+    if isinstance(arr, np.ndarray):
+        # dtype-convert on HOST: an eager device-side convert compiles a
+        # per-process program on the axon backend (see gbdt._binned)
+        return jnp.asarray(np.asarray(arr, dtype=np.float32))
+    return jnp.asarray(arr, dtype=jnp.float32)
+
+
+def clear_prefetch() -> None:
+    """Release every prefetched device buffer. The phases that prefetch
+    (DAG fit, columnar scoring) call this when they finish — without it a
+    long-lived process would pin up to ``_PREFETCH_CAP`` training-matrix
+    buffers in device memory for its lifetime."""
+    with _PREFETCH_LOCK:
+        _PREFETCH.clear()

@@ -1,0 +1,127 @@
+"""``compileStats`` — the compile plane's process-wide ledger.
+
+One thread-safe counter object records every program-acquisition event:
+compiles (AOT misses that paid trace+compile), memory/disk cache hits,
+candidate-dedup lane hits (lanes that rode an already-acquired batched
+program), shape-bucket pad lanes, warmup loads and their overlap seconds,
+and the corruption/version-invalidation drops from the persistent bank.
+
+Counters are cumulative per process. Consumers that want a per-phase view
+(the model selector's summary, the bench's cold-run probe) take a
+``snapshot()`` before and report ``delta(before)`` after.
+"""
+from __future__ import annotations
+
+import threading
+
+_COUNTER_KEYS = (
+    "programsCompiled",      # AOT misses: paid a trace + compile (or a
+                             # persistent-compile-cache load) this process
+    "cacheHitsMemory",       # same-process repeats served from _MEM
+    "cacheHitsDisk",         # deserialized a banked executable (no trace,
+                             # no compile)
+    "dedupHits",             # candidate lanes beyond the first that shared
+                             # one batched program (cross-candidate dedup)
+    "laneBucketPads",        # inert lanes added by shape-bucket padding
+    "bucketedSweeps",        # sweeps whose lane count was padded to a bucket
+    "corruptBlobsDropped",   # unreadable/torn blobs deleted + recompiled
+    "versionInvalidations",  # blobs dropped for a source/backend change
+    "savesFailed",           # background executable saves that errored
+    "warmupPrograms",        # executables loaded by the async warmup thread
+)
+
+
+class CompileStats:
+    """Thread-safe counters; ``warmupOverlapSeconds`` rides along as a
+    float (seconds of program acquisition overlapped with host-side work by
+    the background warmup thread)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {k: 0 for k in _COUNTER_KEYS}
+        self._warmup_overlap_s = 0.0
+        #: per-program-name compile counts — lets tests pin "this sweep
+        #: compiled exactly one logistic program" without global noise
+        self._compiled_by_name: dict[str, int] = {}
+
+    # ------------------------------------------------------------ recording
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    def record_compile(self, name: str) -> None:
+        with self._lock:
+            self._counts["programsCompiled"] += 1
+            self._compiled_by_name[name] = (
+                self._compiled_by_name.get(name, 0) + 1
+            )
+
+    def record_sweep(self, lanes: int, padded: int = 0) -> None:
+        """One batched candidate sweep dispatched: ``lanes`` logical
+        candidate lanes shared one program (dedup = lanes - 1), ``padded``
+        inert lanes were added to land on a shape bucket."""
+        with self._lock:
+            if lanes > 1:
+                self._counts["dedupHits"] += lanes - 1
+            if padded > 0:
+                self._counts["laneBucketPads"] += padded
+                self._counts["bucketedSweeps"] += 1
+
+    def record_warmup(self, programs: int, overlap_s: float) -> None:
+        with self._lock:
+            self._counts["warmupPrograms"] += programs
+            self._warmup_overlap_s += overlap_s
+
+    # ------------------------------------------------------------ reporting
+    def snapshot(self) -> dict:
+        """JSON-able view. ``compileCacheHitRate`` is hits / acquisitions
+        (acquisition = any aot_call that needed a program: hit or
+        compile)."""
+        with self._lock:
+            out: dict = dict(self._counts)
+            out["warmupOverlapSeconds"] = round(self._warmup_overlap_s, 3)
+            out["programsCompiledByName"] = dict(self._compiled_by_name)
+        hits = out["cacheHitsMemory"] + out["cacheHitsDisk"]
+        total = hits + out["programsCompiled"]
+        out["compileCacheHitRate"] = round(hits / total, 4) if total else None
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = {k: 0 for k in _COUNTER_KEYS}
+            self._warmup_overlap_s = 0.0
+            self._compiled_by_name = {}
+
+
+_STATS = CompileStats()
+
+
+def stats() -> CompileStats:
+    return _STATS
+
+
+def snapshot() -> dict:
+    return _STATS.snapshot()
+
+
+def delta(before: dict) -> dict:
+    """Per-phase view: current snapshot minus a ``snapshot()`` taken
+    earlier (rates recomputed from the deltas, not differenced)."""
+    now = _STATS.snapshot()
+    out: dict = {}
+    for k in _COUNTER_KEYS:
+        out[k] = now[k] - before.get(k, 0)
+    out["warmupOverlapSeconds"] = round(
+        now["warmupOverlapSeconds"] - before.get("warmupOverlapSeconds", 0.0),
+        3,
+    )
+    by_name_before = before.get("programsCompiledByName", {})
+    out["programsCompiledByName"] = {
+        name: n - by_name_before.get(name, 0)
+        for name, n in now["programsCompiledByName"].items()
+        if n - by_name_before.get(name, 0)
+    }
+    hits = out["cacheHitsMemory"] + out["cacheHitsDisk"]
+    total = hits + out["programsCompiled"]
+    out["compileCacheHitRate"] = round(hits / total, 4) if total else None
+    return out

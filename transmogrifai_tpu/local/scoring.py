@@ -30,6 +30,7 @@ counters surface on ``score_fn.metadata()``.
 from __future__ import annotations
 
 import logging
+import os
 import weakref
 from typing import Any, Callable
 
@@ -53,6 +54,18 @@ from ..workflow.workflow import WorkflowModel
 log = logging.getLogger(__name__)
 
 _BUCKET_CAP = 8192
+
+
+def _all_null(col) -> bool:
+    """True when every row of the column is missing (validity mask all
+    False, or every object value None for mask-less column types)."""
+    mask = getattr(col, "mask", None)
+    if mask is not None:
+        return not np.asarray(mask, dtype=bool).any()
+    try:
+        return all(v is None for v in col.to_list())
+    except Exception:
+        return False
 
 
 def _bucket(n: int) -> int:
@@ -92,9 +105,15 @@ def score_function(
     exposed as ``score_fn.guard`` / ``.sentinel`` / ``.breakers`` /
     ``.drift`` / ``.quarantine`` and their counters via
     ``score_fn.metadata()``."""
+    from ..compiler import warmup as _warmup
+    from ..models.base import PredictorModel
     from ..workflow.dag import compute_dag
 
     from ..stages.base import Estimator
+
+    # overlap loading the banked scoring executables with closure build
+    # (compiler.warmup — one background load per process)
+    _warmup.start_warmup(_warmup.SCORE_PROGRAMS, scope="score")
 
     # ---- build-time: flatten the fitted DAG into an ordered stage plan
     plan = []
@@ -106,6 +125,17 @@ def score_function(
                 # closure-build time, not deep inside the first call
                 raise ValueError(f"Stage {t} was never fitted")
             plan.append(t)
+    # pipelined dispatch: columns that feed a fitted predictor stage get
+    # their device upload prefetched the moment they materialize, so the
+    # transfer overlaps the host stages between producer and predictor
+    # (consumed via compiler.dispatch.device_f32 in the model's predict;
+    # only batches above the host-predict cutoff ever dispatch on device)
+    _predictor_feeds = frozenset(
+        t.input_names[-1] for t in plan if isinstance(t, PredictorModel)
+    )
+    _device_predict_min = int(
+        os.environ.get("TPTPU_HOST_PREDICT_MAX", "16384")
+    )
     raw_features = list(model.raw_features)
     result_names = [f.name for f in model.result_features]
     result_ftypes = {f.name: f.ftype for f in model.result_features}
@@ -223,6 +253,18 @@ def score_function(
                 cols[t.output_name] = _guarded(
                     t, col, n, count=breaker_mode == "active"
                 )
+                if (
+                    t.output_name in _predictor_feeds
+                    and b > _device_predict_min
+                ):
+                    vals = getattr(cols[t.output_name], "values", None)
+                    if (
+                        vals is not None
+                        and getattr(vals, "dtype", None) == np.float32
+                    ):
+                        from ..compiler.dispatch import prefetch_f32
+
+                        prefetch_f32(vals)
             except (ScoreGuardError, SchemaViolationError):
                 raise
             except Exception as e:
@@ -482,6 +524,12 @@ def score_function(
             ))
             for nm in result_names:
                 out[i][nm] = _default_value(nm)
+        if m and b > _device_predict_min:
+            # release any prefetched device buffers this batch created —
+            # they must not outlive the batch and pin device memory
+            from ..compiler.dispatch import clear_prefetch
+
+            clear_prefetch()
         return out
 
     def score_columns(dataset) -> dict[str, Any]:
@@ -516,6 +564,14 @@ def score_function(
                 cols[f.name] = column_from_values(f.ftype, [fill] * b)
                 continue
             c = dataset[f.name]
+            if f.is_response and _all_null(c):
+                # PRESENT but all-null response: substitute the same
+                # score-time null-label fill the row path uses
+                # (_raw_columns) — label-dependent stages must see the
+                # 0-fill on both entry points, or batch and columnar
+                # scores diverge on unlabeled data
+                cols[f.name] = column_from_values(f.ftype, [0] * b)
+                continue
             cols[f.name] = c if pad is None else c.take(pad)
         if drift_sentinel.enabled:
             drift_sentinel.observe_columns(cols, n)
@@ -572,6 +628,10 @@ def score_function(
         for nm in degraded:
             if nm not in out:
                 out[nm] = _default_column(nm, n)
+        if b > _device_predict_min:
+            from ..compiler.dispatch import clear_prefetch
+
+            clear_prefetch()  # see score_batch: bound buffer lifetime
         return out
 
     def score_one(row: dict[str, Any]) -> dict[str, Any]:
@@ -583,8 +643,12 @@ def score_function(
         """Score-path health: guard + sentinel + quarantine + breaker +
         drift counters, one report — plus the training-side distributed
         ledger (hosts lost, failovers, reshards) so serving ops can see
-        the model behind this closure finished on a degraded mesh."""
+        the model behind this closure finished on a degraded mesh, and the
+        process-wide compile-plane ledger (compiler.stats)."""
+        from ..compiler import stats as cstats
+
         return {
+            "compileStats": cstats.snapshot(),
             "scoreGuard": guard.stats(),
             "sentinel": None if sentinel is None else sentinel.stats(),
             "quarantine": qlog.stats(),

@@ -243,7 +243,11 @@ class _BinnedModel(PredictorModel):
                     for t in hs
                 ]
         else:
-            xj = jnp.asarray(x, dtype=jnp.float32)
+            from ..compiler.dispatch import device_f32
+
+            # the serving path prefetches the feature matrix while earlier
+            # plan stages run; pick that transfer up here
+            xj = device_f32(x)
             thr = jnp.asarray(self.thresholds)
             ds = self._dev(trees)
             ds = ds if many else [ds]
@@ -538,10 +542,13 @@ class _TreeEstimator(PredictorEstimator):
         # backend) exactly once, on the sweep's critical path
         from ..utils.aot import aot_call
 
+        from ..compiler.dispatch import device_f32
+
+        # device_f32 picks up the async upload the DAG fit prefetched for
+        # this matrix, when one is in flight (compiler.dispatch)
         binned = aot_call(
             "bin_data", _bin_data_jit,
-            (jnp.asarray(np.asarray(x, dtype=np.float32)),
-             jnp.asarray(thresholds)),
+            (device_f32(x), jnp.asarray(thresholds)),
             {},
         )
         fgroups = _feature_bin_groups(x)
@@ -752,6 +759,14 @@ class _TreeEstimator(PredictorEstimator):
         norm = normalize or (lambda m: m)
         merged = [norm({**self.get_params(), **p}) for p in group_points]
         n_masks, n_pts = masks.shape[0], len(merged)
+        # cross-candidate dedup ledger: every (mask × point) lane of this
+        # static group shares ONE compiled program. Tree lanes do NOT pad
+        # onto shape buckets (compiler.bucketing): split decisions are
+        # discrete, and a reassociated histogram sum under a different
+        # lane count can flip a borderline split.
+        from ..compiler import stats as cstats
+
+        cstats.stats().record_sweep(lanes=n_masks * n_pts)
         row_mask_k = jnp.asarray(np.repeat(masks, n_pts, axis=0))
 
         def knob(name):
@@ -1234,6 +1249,10 @@ class RandomForestClassifier(_TreeEstimator):
         merged = [{**self.get_params(), **p} for p in group_points]
         n_masks, n_pts = masks.shape[0], len(merged)
         c = num_classes
+        from ..compiler import stats as cstats
+
+        # one program serves masks × points × classes lanes (dedup ledger)
+        cstats.stats().record_sweep(lanes=n_masks * n_pts * c)
         ind = np.stack(
             [(y == cls) for cls in range(c)]
         ).astype(np.float32)                         # [C, N]

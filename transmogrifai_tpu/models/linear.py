@@ -78,7 +78,10 @@ class LinearRegression(PredictorEstimator):
         tunnel dispatch per fold x point for microseconds of FLOPs).
         Same-(fit_intercept, max_iter) groups batch (fold-mask, reg,
         elastic-net) triples onto the fit axis of fit_linear_batched;
-        points with unknown params fall back to sequential fits."""
+        points with unknown params fall back to sequential fits. Lane
+        counts pad onto shape buckets (compiler.bucketing) so near-miss
+        sweeps share one banked executable."""
+        from ..compiler import bucketing, dispatch
         from ..utils.aot import aot_call
         from .base import group_grid_by_statics
         from .solvers import fit_linear_batched
@@ -107,18 +110,19 @@ class LinearRegression(PredictorEstimator):
                 dtype=np.float32,
             )
             rm = np.repeat(np.stack(masks), len(idxs), axis=0)  # mask-major
+            k, (rm, regs, ens) = bucketing.bucket_sweep_lanes(rm, regs, ens)
             stacked = aot_call(
                 "linear_batched", fit_linear_batched,
                 (
-                    jnp.asarray(x, dtype=jnp.float32),
+                    dispatch.device_f32(x),
                     jnp.asarray(y, dtype=jnp.float32),
                     jnp.asarray(rm), jnp.asarray(regs), jnp.asarray(ens),
                 ),
                 dict(num_iters=max(max_iter * 4, 200),
                      fit_intercept=fit_intercept),
             )
-            w = np.asarray(stacked.weights)
-            b = np.asarray(stacked.intercept)
+            w = np.asarray(stacked.weights)[:k]
+            b = np.asarray(stacked.intercept)[:k]
             for mi in range(n_masks):
                 for j, i in enumerate(idxs):
                     models[mi][i] = LinearRegressionModel(

@@ -45,6 +45,30 @@ def _effectively_constant(
     return std <= jnp.maximum(rel_tol * scale, 1e-12)
 
 
+def _masked_minmax(x: jax.Array, rm: jax.Array):
+    """Per-(lane, column) masked min/max: ``([K, D] min, [K, D] max)`` for
+    x [N, D] under masks rm [K, N].
+
+    The one-shot broadcast form (``jnp.where(rm[:, :, None] > 0, x[None],
+    ±big)`` reduced over axis 1) materializes O(K·N·D) temporaries — ~100 MB
+    per reduction at Titanic sweep shapes, and the allocation scales with
+    the grid. ``lax.map`` scans the K mask lanes instead, so peak extra
+    memory is one [N, D] buffer regardless of K. min/max are exact under
+    ANY association, so the result is bit-identical to the broadcast form
+    (and invariant across shardings — the property the constant-column
+    gate relies on)."""
+    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+
+    def one(mask_row):  # [N] -> ([D], [D])
+        mb = mask_row[:, None] > 0
+        return (
+            jnp.min(jnp.where(mb, x, big), axis=0),
+            jnp.max(jnp.where(mb, x, -big), axis=0),
+        )
+
+    return jax.lax.map(one, rm)
+
+
 def _standardize(x: jax.Array, row_mask: jax.Array):
     n = jnp.maximum(row_mask.sum(), 1.0)
     mean = (x * row_mask[:, None]).sum(0) / n
@@ -126,10 +150,7 @@ def fit_linear_batched(
     # (scale == std) and the phantom one-pass std would pass through —
     # the column then absorbs a garbage weight that corrupts held-out
     # predictions wherever the column is nonzero outside the mask
-    rmb = rm[:, :, None] > 0
-    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
-    xmax = jnp.max(jnp.where(rmb, x[None], -big), axis=1)   # [K, D]
-    xmin = jnp.min(jnp.where(rmb, x[None], big), axis=1)
+    xmin, xmax = _masked_minmax(x, rm)                      # [K, D] each
     const = (xmax <= xmin) | _effectively_constant(
         std, jnp.sqrt(var + mean_true**2)
     )
@@ -386,11 +407,9 @@ def fit_logistic_binary_batched(
     # can flip a borderline column in opposite directions — one path pins
     # the weight at 0, the other divides by the phantom std and amplifies
     # it to O(10) (observed on Titanic fold masks). Masked min/max are
-    # exact under ANY association, so both paths agree bit-for-bit.
-    rmb = rm[:, :, None] > 0
-    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
-    xmax = jnp.max(jnp.where(rmb, x[None], -big), axis=1)   # [K, D]
-    xmin = jnp.min(jnp.where(rmb, x[None], big), axis=1)
+    # exact under ANY association, so both paths agree bit-for-bit
+    # (_masked_minmax scans lanes instead of broadcasting [K, N, D]).
+    xmin, xmax = _masked_minmax(x, rm)                      # [K, D] each
     const = xmax <= xmin
     # near-constant (but not exactly constant) columns still carry one-pass
     # cancellation noise in std; clamp to the noise floor instead of gating

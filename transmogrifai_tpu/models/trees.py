@@ -1463,15 +1463,27 @@ def fit_boosted_batched(
     margin = jnp.asarray(np.asarray(np.broadcast_to(
         _np_f32(base_score).reshape(-1, 1), (k_fits, n)
     )))
+    from ..compiler.dispatch import donating
     from ..utils.aot import aot_call
 
+    # donated-buffer pipelining: the [K, N] margin is a pure carry between
+    # chunk programs — chunk i+1 never needs chunk i's input margin again,
+    # so the executable aliases it into the output margin instead of
+    # allocating a fresh buffer per chunk (TPTPU_DONATE=0 opts out)
+    boost_chunk_fn = donating(
+        "boost_chunk", _boost_rounds_batched, donate_argnums=(3,),
+        static_argnames=(
+            "num_rounds", "max_depth", "num_bins", "objective",
+            "axis_name", "axis_size", "hist_impl",
+        ),
+    )
     chunks = []
     done = 0
     chunk_size = _boost_round_chunk(num_rounds)
     while done < num_rounds:
         rc = min(chunk_size, num_rounds - done)
         trees_c, margin = aot_call(
-            "boost_chunk", _boost_rounds_batched,
+            "boost_chunk", boost_chunk_fn,
             (binned, y, row_mask, margin, eta_v, lam, gam, mcw, mig,
              feature_groups),
             dict(num_rounds=rc, max_depth=max_depth, num_bins=num_bins,

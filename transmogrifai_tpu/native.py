@@ -364,6 +364,20 @@ def tree_predict_sum(
     lv = np.ascontiguousarray(lv, dtype=np.float32)
     n, num_f = binned.shape
     r, depth, width = sf.shape
+    # validate BEFORE handing pointers to C: the kernel gathers
+    # binned[i, sf[...]] and lv[t, node << (depth - eff)] unchecked, so a
+    # malformed stack (corrupt manifest, truncated arrays) would read out
+    # of bounds instead of raising like the numpy traversal does
+    if sf.size and int(sf.max()) >= num_f:
+        raise IndexError(
+            f"tree_predict_sum: split feature index {int(sf.max())} out of "
+            f"bounds for {num_f} binned feature(s)"
+        )
+    if lv.ndim != 2 or lv.shape[1] != (1 << depth):
+        raise IndexError(
+            f"tree_predict_sum: leaf table width {lv.shape[1:]} does not "
+            f"match depth {depth} (expected {1 << depth})"
+        )
     out = np.empty(n, dtype=np.float32)
     lib.tp_tree_predict_sum(
         binned, n, num_f, sf, sb, lv, r, depth, width, lv.shape[1], out,

@@ -278,6 +278,12 @@ class ModelSelector(PredictorEstimator):
         }
 
     def fit_arrays(self, x, y, row_mask) -> SelectedModel:
+        from ..compiler import stats as cstats
+
+        # compile-plane ledger for THIS selection (programs compiled /
+        # cache + dedup hits / warmup overlap) — the delta lands in the
+        # summary next to the retry and failover ledgers
+        compile_baseline = cstats.snapshot()
         train_idx = np.nonzero(row_mask > 0)[0]
         xt, yt = x[train_idx], y[train_idx]
 
@@ -396,6 +402,7 @@ class ModelSelector(PredictorEstimator):
             "extraTrainEvaluations": extra_train,
             "holdoutEvaluation": None,
             "splitterSummary": splitter_summary,
+            "compileStats": cstats.delta(compile_baseline),
         }
         self.metadata["modelSelectorSummary"] = summary
         return SelectedModel(best_model, summary)

@@ -6,17 +6,32 @@ executes in ~9 ms but costs seconds to trace/compile/load per process; the
 axon backend routes compiles through a remote helper, so even a cached
 compile is ~0.3-0.8 s and a fresh one is tens of seconds).
 
-Round 3 layers, fastest first:
+This module is the persistent layer of the compile plane
+(``transmogrifai_tpu/compiler/``): every model family and the serving path
+route their jitted entry points through ``aot_call``, and every event
+(compile, hit, corruption drop, invalidation) lands in the
+``compiler.stats`` ledger surfaced as ``compileStats``.
+
+Layers, fastest first:
   1. in-memory table (``_MEM``) — same-process repeats are free;
   2. serialized EXECUTABLE cache (``jax.experimental.serialize_executable``)
      — a fresh process skips trace AND compile AND compile-cache load:
      measured ~1.3 s for a 46 MB boost-chunk executable vs ~2.6 s for the
      round-2 StableHLO path and ~20-40 s for a cold compile. ``prewarm()``
-     loads every banked executable for the current (backend, device-count)
-     on a thread pool so the model-selector phase finds them in ``_MEM``;
+     loads banked executables for the current (backend, device-count) on a
+     thread pool — optionally filtered to the program NAMES a DAG will
+     actually need (``compiler.warmup`` drives this) — so the model-selector
+     phase finds them in ``_MEM``;
   3. transparent fallback to a direct ``jit_fn(*args, **statics)`` call on
      ANY failure (new shapes still work; blobs self-invalidate via a
      source-version salt in the key).
+
+Program identity = (source salt incl. jax version, backend, device count,
+ambient mesh fingerprint, arg tree structure + shapes/dtypes/shardings,
+static kwargs). Blob files are ``{salt}-{name}-{key}.jaxexec`` under
+``.jax_cache/execs/{backend}-{ndev}`` (override the root with
+``TPTPU_COMPILE_CACHE``); writes are atomic (unique tmp + ``os.replace``),
+corrupt/truncated blobs are deleted and recompiled. See docs/tpu.md.
 
 Opt out with TPTPU_AOT=0.
 """
@@ -26,6 +41,7 @@ import hashlib
 import logging
 import os
 import pickle
+import re
 import threading
 import time as _time
 from typing import Any, Callable
@@ -40,6 +56,12 @@ _THREADS: list = []
 _SALT: str | None = None
 
 _START = _time.monotonic()
+
+
+def _stats():
+    from ..compiler import stats as _s
+
+    return _s.stats()
 
 
 def _drain_exports() -> None:
@@ -69,6 +91,13 @@ import atexit  # noqa: E402
 atexit.register(_drain_exports)
 
 
+class DonatedArgsConsumed(RuntimeError):
+    """A banked executable donated (deleted) some of the caller's args and
+    then failed — no in-place fallback can run. Propagated past aot_call's
+    transparent-fallback handler so the caller-level retry (the
+    candidate-sweep RetryPolicy) re-enters with fresh buffers."""
+
+
 def _enabled() -> bool:
     return os.environ.get("TPTPU_AOT", "1") != "0"
 
@@ -76,10 +105,16 @@ def _enabled() -> bool:
 def _exec_dir() -> str:
     import jax
 
+    root = os.environ.get("TPTPU_COMPILE_CACHE")
+    if not root:
+        root = os.path.join(
+            os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            ),
+            ".jax_cache",
+        )
     base = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-        ".jax_cache", "execs",
-        f"{jax.default_backend()}-{len(jax.devices())}",
+        root, "execs", f"{jax.default_backend()}-{len(jax.devices())}"
     )
     os.makedirs(base, exist_ok=True)
     return base
@@ -87,10 +122,16 @@ def _exec_dir() -> str:
 
 def _version_salt() -> str:
     """Hash of the source files whose tracing the cache skips — a code
-    change invalidates every blob."""
+    change invalidates every blob. The jax version rides the salt too: a
+    serialized executable is runtime-specific, and loading one saved under
+    a different jax/XLA build is undefined behavior at best."""
     global _SALT
     if _SALT is None:
+        import jax
+
         h = hashlib.sha256()
+        h.update(b"aot-format-2")  # filename layout: salt-name-key.jaxexec
+        h.update(f"jax={jax.__version__}".encode())
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         # every file that DEFINES an aot_call-routed jit_fn must be listed,
         # or editing it serves stale banked executables of the old code
@@ -105,12 +146,37 @@ def _version_salt() -> str:
                 h.update(rel.encode())
         # trace-time env knobs are program identity too: a blob exported
         # under one knob value must not be served to a process expecting
-        # another (TPTPU_HIST additionally rides the explicit statics)
+        # another (TPTPU_HIST additionally rides the explicit statics).
+        # TPTPU_DONATE is in the list because donation is baked into the
+        # serialized executable: a donating blob served to a donate-off
+        # process would still delete the caller's buffers (and vice versa
+        # a donate-off blob would permanently disable the optimization).
         for knob in ("TPTPU_HIST", "TPTPU_HIST_COMB", "TPTPU_GEMM_MCAP",
-                     "TPTPU_BOOST_CHUNK"):
+                     "TPTPU_BOOST_CHUNK", "TPTPU_DONATE"):
             h.update(f"{knob}={os.environ.get(knob, '')}".encode())
         _SALT = h.hexdigest()[:16]
     return _SALT
+
+
+def _mesh_fp() -> str:
+    """Compact ambient-execution-mesh fingerprint: a blob compiled for a
+    4-device data mesh must never shadow the single-device program of the
+    same shapes (and per-leaf shardings alone miss fully-replicated
+    args)."""
+    try:
+        from ..parallel.mesh import execution_mesh
+
+        mesh = execution_mesh()
+    except Exception:
+        return "none"
+    if mesh is None:
+        return "none"
+    try:
+        return ",".join(
+            f"{name}{int(mesh.shape[name])}" for name in mesh.axis_names
+        )
+    except Exception:
+        return "unknown"
 
 
 def _key(name: str, args: tuple, statics: dict) -> str:
@@ -120,7 +186,7 @@ def _key(name: str, args: tuple, statics: dict) -> str:
     # blob exported single-device must not shadow a mesh-sharded variant
     # (and vice versa) on the same backend/shapes
     parts = [name, _version_salt(), jax.default_backend(),
-             f"ndev={len(jax.devices())}"]
+             f"ndev={len(jax.devices())}", f"mesh={_mesh_fp()}"]
     parts.append(str(jax.tree_util.tree_structure(args)))
     for a in jax.tree_util.tree_leaves(args):
         parts.append(f"{getattr(a, 'shape', ())}:{getattr(a, 'dtype', type(a).__name__)}")
@@ -130,6 +196,29 @@ def _key(name: str, args: tuple, statics: dict) -> str:
     for k in sorted(statics):
         parts.append(f"{k}={statics[k]}")
     return hashlib.sha256("|".join(map(str, parts)).encode()).hexdigest()[:24]
+
+
+def _safe_name(name: str) -> str:
+    """Program name as a filename segment (no dashes: the filename parser
+    splits on them)."""
+    return re.sub(r"[^A-Za-z0-9_]", "_", name)
+
+
+def _blob_path(name: str, key: str) -> str:
+    return os.path.join(
+        _exec_dir(), f"{_version_salt()}-{_safe_name(name)}-{key}.jaxexec"
+    )
+
+
+def _parse_blob_name(fn: str) -> tuple[str, str, str] | None:
+    """(salt, name, key) from ``salt-name-key.jaxexec``; None for files in
+    an unknown layout (deleted on sight, like any stale-version blob)."""
+    if not fn.endswith(".jaxexec"):
+        return None
+    parts = fn[: -len(".jaxexec")].split("-")
+    if len(parts) != 3:
+        return None
+    return parts[0], parts[1], parts[2]
 
 
 def _load_exec(path: str):
@@ -168,6 +257,7 @@ def _acquire_banked(path: str, name: str, key: str):
         return _load_exec(path)
     except Exception as e:
         log.info("AOT executable %s unusable (%s); removing", key, e)
+        _stats().bump("corruptBlobsDropped")
         try:
             os.remove(path)
         except OSError:
@@ -175,11 +265,17 @@ def _acquire_banked(path: str, name: str, key: str):
         return None
 
 
-def prewarm(max_workers: int = 8, max_bytes: int = 32_000_000) -> int:
-    """Load every CURRENT-version banked executable for this
-    backend/device-count into ``_MEM`` on a thread pool. Call early (e.g.
-    right after backend init) so acquisition overlaps the data/feature
-    phases; returns the number of programs loaded. Files from other source
+def prewarm(
+    max_workers: int = 8,
+    max_bytes: int = 32_000_000,
+    names: set | frozenset | None = None,
+) -> int:
+    """Load banked executables for this backend/device-count into ``_MEM``
+    on a thread pool. Call early (e.g. right after backend init) so
+    acquisition overlaps the data/feature phases; returns the number of
+    programs loaded. ``names`` restricts the load to those program names
+    (the DAG-aware warmup passes the families it will actually fit) —
+    unlisted blobs stay on disk untouched. Files from other source
     versions can never hit (the key embeds the salt), so they are deleted
     on sight — without this the bank grows by a full program set per source
     edit and prewarm ships gigabytes of dead executables."""
@@ -190,16 +286,22 @@ def prewarm(max_workers: int = 8, max_bytes: int = 32_000_000) -> int:
     except Exception:
         return 0
     salt = _version_salt()
+    safe_names = None if names is None else {_safe_name(n) for n in names}
     paths = []
     for fn in os.listdir(d):
         if not fn.endswith(".jaxexec"):
             continue
         p = os.path.join(d, fn)
-        if not fn.startswith(salt + "-"):
+        parsed = _parse_blob_name(fn)
+        if parsed is None or parsed[0] != salt:
+            _stats().bump("versionInvalidations")
             try:
                 os.remove(p)
             except OSError:
                 pass
+            continue
+        _salt_seg, name_seg, _key_seg = parsed
+        if safe_names is not None and name_seg not in safe_names:
             continue
         try:
             if os.path.getsize(p) > max_bytes:
@@ -219,7 +321,7 @@ def prewarm(max_workers: int = 8, max_bytes: int = 32_000_000) -> int:
     loaded = [0]
 
     def _one(p):
-        key = os.path.basename(p)[len(salt) + 1: -len(".jaxexec")]
+        key = _parse_blob_name(os.path.basename(p))[2]
         with _LOCK:
             if key in _MEM:
                 return
@@ -227,6 +329,7 @@ def prewarm(max_workers: int = 8, max_bytes: int = 32_000_000) -> int:
             call = _load_exec(p)
         except Exception as e:
             log.info("prewarm: dropping unusable executable %s (%s)", p, e)
+            _stats().bump("corruptBlobsDropped")
             try:
                 os.remove(p)
             except OSError:
@@ -245,7 +348,12 @@ def prewarm(max_workers: int = 8, max_bytes: int = 32_000_000) -> int:
 def aot_call(
     name: str, jit_fn: Callable, args: tuple, statics: dict
 ) -> Any:
-    """``jit_fn(*args, **statics)`` through the executable cache."""
+    """``jit_fn(*args, **statics)`` through the executable cache.
+
+    NOTE on donation: when ``jit_fn`` was built with ``donate_argnums``
+    (compiler.dispatch.donating), the banked executable donates too —
+    callers must treat those args as consumed on EVERY path through here.
+    """
     if not _enabled():
         return jit_fn(*args, **statics)
     try:
@@ -256,26 +364,47 @@ def aot_call(
             # NOTE: dispatch is async — timing this call would measure
             # enqueue latency, not execution
             log.debug("AOT hit %s (%s)", name, key)
+            _stats().bump("cacheHitsMemory")
             return call(*args)
-        path = os.path.join(
-            _exec_dir(), f"{_version_salt()}-{key}.jaxexec"
-        )
+        path = _blob_path(name, key)
         call = _acquire_banked(path, name, key)
         if call is not None:
             try:
                 out = call(*args)
                 with _LOCK:
                     _MEM[key] = call
+                _stats().bump("cacheHitsDisk")
                 return out
             except Exception as e:
                 # blob deserialized but the executable is broken (stale
                 # runtime, torn payload): remove it so a future first-use
                 # re-saves instead of permanently disabling the cache
                 log.info("AOT executable %s unusable (%s); removing", key, e)
+                _stats().bump("corruptBlobsDropped")
                 try:
                     os.remove(path)
                 except OSError:
                     pass
+                import jax
+
+                if any(
+                    getattr(a, "is_deleted", lambda: False)()
+                    for a in jax.tree_util.tree_leaves(args)
+                ):
+                    # the broken executable DONATED some args before
+                    # failing — the direct-call fallback below would crash
+                    # on the deleted buffers with a baffling error deep in
+                    # dispatch. Re-raise instead: the candidate-level
+                    # RetryPolicy (selector/validators.py) re-enters the
+                    # sweep with fresh buffers, and the blob is gone.
+                    log.warning(
+                        "AOT executable %s consumed donated args before "
+                        "failing; re-raising for caller-level retry", key,
+                    )
+                    raise DonatedArgsConsumed(
+                        f"banked executable for {name} failed after "
+                        f"donating its inputs: {e}"
+                    ) from e
         # first use of this program version: run directly, then save the
         # compiled executable in the background so FUTURE processes skip
         # trace+compile. _PENDING dedupes concurrent validator threads;
@@ -287,6 +416,7 @@ def aot_call(
             "AOT miss %s (%s): direct call %.2f s", name, key,
             _time.monotonic() - t_direct,
         )
+        _stats().record_compile(name)
         with _LOCK:
             if key not in _MEM:
                 # same-process repeats reuse jit_fn's warm cache
@@ -299,10 +429,11 @@ def aot_call(
             try:
                 from jax.experimental import serialize_executable as SE
 
-                t0 = _time.monotonic()
                 # .lower().compile() hits the jit's persistent compile
                 # cache (same computation), so this is load-cost, not a
-                # recompile
+                # recompile. Lowering only needs avals, so it is safe even
+                # when the direct call above DONATED some of args.
+                t0 = _time.monotonic()
                 compiled = jit_fn.lower(*args, **statics).compile()
                 payload, in_tree, out_tree = SE.serialize(compiled)
                 blob = pickle.dumps((payload, in_tree, out_tree))
@@ -316,6 +447,7 @@ def aot_call(
                 )
             except Exception as e:  # never break the fit for the cache
                 log.info("AOT save of %s failed: %s", name, e)
+                _stats().bump("savesFailed")
                 with _LOCK:
                     _FAILED.add(key)
             finally:
@@ -327,6 +459,10 @@ def aot_call(
             _THREADS.append(th)
         th.start()
         return out
+    except DonatedArgsConsumed:
+        # args are gone — the transparent direct-call fallback below would
+        # crash on deleted buffers; let the caller-level retry recover
+        raise
     except Exception as e:
         log.info("AOT cache bypassed for %s: %s", name, e)
         return jit_fn(*args, **statics)

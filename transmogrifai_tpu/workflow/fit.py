@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from typing import Iterable
 
+import numpy as np
+
 from ..dataset import Dataset
 from ..features.feature import Feature
 from ..resilience import distributed, faults
@@ -40,12 +42,35 @@ def fit_and_transform_dag(
     layers = compute_dag(list(result_features))
     fitted: dict[str, PipelineStage] = {}
     prefitted = prefitted or {}
+    from ..compiler import dispatch as _dispatch
+
     plan = faults.active()
     signature = None
     if checkpoint is not None:
         from ..resilience.checkpoint import dag_signature, dataset_fingerprint
 
         signature = dag_signature(layers, dataset_fingerprint(dataset))
+    dataset_box = [dataset]
+    try:
+        _fit_layers(
+            layers, dataset_box, fitted, prefitted, plan, checkpoint,
+            signature,
+        )
+    finally:
+        # release the prefetched device buffers: the last layer's fits
+        # consumed them, and keeping them would pin training matrices in
+        # device memory for the process lifetime
+        _dispatch.clear_prefetch()
+    return dataset_box[0], fitted
+
+
+def _fit_layers(
+    layers, dataset_box, fitted, prefitted, plan, checkpoint, signature
+) -> None:
+    """The layer loop of fit_and_transform_dag (split out so the caller
+    can bound the prefetch-buffer lifetime with one try/finally).
+    ``dataset_box`` is a 1-element list carrying the evolving dataset."""
+    dataset = dataset_box[0]
     for li, layer in enumerate(layers):
         transformers: list[Transformer] = []
         newly_fitted = False
@@ -73,6 +98,12 @@ def fit_and_transform_dag(
                 corrupted = plan.on_stage_output(t, dataset[t.output_name])
                 if corrupted is not None:
                     dataset = dataset.with_column(t.output_name, corrupted)
+        # pipelined layer execution (compiler.dispatch): layer li's
+        # transforms just materialized the feature matrices layer li+1's
+        # estimators will fit on — start their device uploads NOW so the
+        # transfer overlaps the checkpoint save and remaining host work
+        # instead of serializing in front of the first fit dispatch
+        _prefetch_next_layer_inputs(layers, li, dataset, prefitted)
         if checkpoint is not None and (
             newly_fitted or not checkpoint.has_layer(li)
         ):
@@ -98,7 +129,35 @@ def fit_and_transform_dag(
         controller = distributed.active_controller()
         if controller is not None:
             controller.on_layer_end(li)
-    return dataset, fitted
+    dataset_box[0] = dataset
+
+
+def _prefetch_next_layer_inputs(layers, li, dataset, prefitted) -> None:
+    """Start async device transfers for the 2-D (vector) inputs of the
+    NEXT layer's still-unfitted estimators (model-family fits dispatch on
+    exactly these matrices — logistic/linear solvers and tree binning pick
+    the in-flight buffer up via ``compiler.dispatch.device_f32``). Purely
+    an overlap optimization: failures are swallowed inside the dispatch
+    helpers and every consumer falls back to its own upload."""
+    if li + 1 >= len(layers):
+        return
+    from ..compiler.dispatch import prefetch_f32
+
+    for stage in layers[li + 1]:
+        if not isinstance(stage, Estimator) or stage.uid in prefitted:
+            continue
+        for nm in getattr(stage, "input_names", ()):
+            if nm not in dataset:
+                continue
+            vals = getattr(dataset[nm], "values", None)
+            # f32-only: consumers re-key a dtype-converted COPY, so a
+            # non-f32 prefetch would upload bytes nobody ever picks up
+            if (
+                vals is not None
+                and getattr(vals, "ndim", 0) == 2
+                and getattr(vals, "dtype", None) == np.float32
+            ):
+                prefetch_f32(vals)
 
 
 def apply_transformations_dag(

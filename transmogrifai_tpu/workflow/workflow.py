@@ -208,6 +208,13 @@ class Workflow:
             )
         stages = self._stages()
         self._apply_overrides(stages)
+        # async warmup (compiler.warmup): load the banked executables the
+        # model families in THIS DAG will need on a background thread, so
+        # program acquisition overlaps the reader/feature phases below
+        # instead of serializing in front of the first fit dispatch
+        from ..compiler import warmup as _warmup
+
+        _warmup.start_warmup(_warmup.train_programs(stages), scope="train")
         selectors = [s for s in stages if isinstance(s, ModelSelector)]
         if len(selectors) > 1:
             raise ValueError(
@@ -592,6 +599,10 @@ class WorkflowModel:
         keep_intermediate_features: bool = False,
     ) -> Dataset:
         """Apply the fitted DAG (OpWorkflowModel.score, OpWorkflowModel.scala:259)."""
+        from ..compiler import warmup as _warmup
+
+        # overlap loading the banked scoring executables with raw-data prep
+        _warmup.start_warmup(_warmup.SCORE_PROGRAMS, scope="score")
         raw = self._prepare_raw(dataset, reader)
         transformed = apply_transformations_dag(raw, self.result_features, self.fitted)
         if keep_intermediate_features:
@@ -822,6 +833,20 @@ class WorkflowModel:
                 lines.extend(ilines)  # all-or-nothing: no dangling headers
             except Exception as e:  # insights are best-effort here
                 log.debug("summary_pretty insights skipped: %s", e)
+        comp = (sel or {}).get("compileStats") or {}
+        if comp.get("programsCompiled") or comp.get("cacheHitsMemory") or \
+                comp.get("cacheHitsDisk") or comp.get("dedupHits"):
+            hits = comp.get("cacheHitsMemory", 0) + comp.get("cacheHitsDisk", 0)
+            rate = comp.get("compileCacheHitRate")
+            rate_s = f", {rate:.0%} hit rate" if rate is not None else ""
+            lines.append(
+                f"Compile plane: {comp.get('programsCompiled', 0)} "
+                f"program(s) compiled, {hits} cache hit(s){rate_s}, "
+                f"{comp.get('dedupHits', 0)} dedup lane(s), "
+                f"{comp.get('laneBucketPads', 0)} pad lane(s), "
+                f"{comp.get('warmupPrograms', 0)} warmed "
+                f"({comp.get('warmupOverlapSeconds', 0.0):.2f}s overlapped)"
+            )
         dist = getattr(self, "dist_summary", None) or {}
         if any(
             dist.get(k)
