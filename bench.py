@@ -458,14 +458,26 @@ def bench_transmogrify_text(n_rows: int = 100_000) -> dict:
     ds = Dataset.of(cols)
     resp, preds = from_dataset(ds, response="label")
     vector = transmogrify(preds)
+    from transmogrifai_tpu.featurize import stats as fstats
+
+    featurize_before = fstats.snapshot()
     t0 = time.perf_counter()
     data, _ = fit_and_transform_dag(ds, [vector])
     dt = time.perf_counter() - t0
+    fdelta = fstats.delta(featurize_before)
     return {
         "rows_per_sec": n / dt,
         "transmogrify_s": dt,
         "rows": n,
         "width": int(data[vector.name].values.shape[1]),
+        # per-stage rows/s from the featurizeStats ledger (instrumented
+        # vectorizer transform passes only — fits excluded)
+        "featurize_rows_per_sec": {
+            name: cell.get("rowsPerSec")
+            for name, cell in (fdelta.get("stageRowsPerSec") or {}).items()
+        },
+        "featurize_pool_utilization": fdelta.get("poolUtilization"),
+        "featurize_fallback_kernels": fdelta.get("fallbackKernels"),
     }
 
 
@@ -831,6 +843,19 @@ def main() -> None:
                 "transmogrify_width": thru["width"],
                 "text_transmogrify_rows_per_sec": round(text["rows_per_sec"]),
                 "text_transmogrify_width": text["width"],
+                # featurize engine (PR 5): per-stage rows/s breakdown from
+                # the featurizeStats ledger, plus the PR-4 pre-engine
+                # numbers recorded on this protocol for the before/after
+                # (BENCH_r05.json: text 90334 rows/s, serve batch 70926)
+                "featurize_rows_per_sec": text.get("featurize_rows_per_sec"),
+                "featurize_pool_utilization": text.get(
+                    "featurize_pool_utilization"
+                ),
+                "featurize_fallback_kernels": text.get(
+                    "featurize_fallback_kernels"
+                ),
+                "text_transmogrify_rows_per_sec_pre_engine": 90334,
+                "serve_batch_rows_per_sec_pre_engine": 70926,
                 # single fresh-process run; the tunneled shared chip's
                 # round-trip throughput varies hour-to-hour — measured
                 # quiet-chip best 9.3 s, congested episodes up to ~70 s

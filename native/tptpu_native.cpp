@@ -18,8 +18,20 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <vector>
 
 extern "C" {
+
+// ------------------------------------------------------------- ABI version
+// Monotonic export-set stamp, checked by native.py at load time: a cached
+// libtptpu.so that predates a kernel must degrade to the numpy fallback
+// with one warning (plus a featurizeStats counter), never AttributeError
+// at transform time. Bump when adding/changing exported symbols.
+//   1 — pre-stamp exports (murmur3/tokenize/clean/parse/tree kernels)
+//   2 — featurize plane: tp_intern_tokens / tp_intern_values /
+//       tp_code_bincount
+//   3 — tp_text_valuestats (one-pass SmartText fit statistics)
+int64_t tp_abi_version() { return 3; }
 
 // ---------------------------------------------------------------- murmur3
 // MurmurHash3 x86 32-bit, bit-identical to utils/text.py murmur3_32 (and to
@@ -63,6 +75,21 @@ static uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
   h ^= h >> 16;
   return h;
 }
+
+// ------------------------------------------------------ char class tables
+// One branch-free lookup per byte instead of 3-6 range compares in every
+// tokenizer inner loop (the featurize plane's hottest instruction stream).
+static struct CharTables {
+  uint8_t word[256];   // [A-Za-z0-9]
+  uint8_t lower[256];  // ASCII tolower
+  CharTables() {
+    for (int c = 0; c < 256; c++) {
+      word[c] = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+                (c >= 'a' && c <= 'z');
+      lower[c] = (c >= 'A' && c <= 'Z') ? c + 32 : c;
+    }
+  }
+} kChar;
 
 // Hash n strings (concatenated buffer + offsets[n+1]) into out[n].
 void tp_murmur3_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
@@ -109,20 +136,16 @@ void tp_tokenize_hash_scatter(const uint8_t* buf, const int64_t* offsets,
                               const uint8_t* prefix, int64_t prefix_len,
                               float* out, int64_t out_cols,
                               int64_t col_offset) {
-  std::string token;
-  token.reserve(64);
+  uint8_t token[512];
+  if (prefix_len > 0 && prefix_len <= (int64_t)sizeof(token))
+    std::memcpy(token, prefix, (size_t)prefix_len);
   for (int64_t i = 0; i < n_strings; i++) {
     const uint8_t* s = buf + offsets[i];
     int64_t len = offsets[i + 1] - offsets[i];
     float* row_out = out + rows[i] * out_cols + col_offset;
     int64_t start = -1;
     for (int64_t k = 0; k <= len; k++) {
-      bool word = false;
-      if (k < len) {
-        uint8_t c = s[k];
-        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
-               (c >= 'a' && c <= 'z');
-      }
+      bool word = k < len && kChar.word[s[k]];
       if (word) {
         if (start < 0) start = k;
         continue;
@@ -130,14 +153,25 @@ void tp_tokenize_hash_scatter(const uint8_t* buf, const int64_t* offsets,
       if (start >= 0) {
         int64_t tlen = k - start;
         if (tlen >= min_token_len) {
-          token.assign((const char*)prefix, (size_t)prefix_len);
-          for (int64_t t = start; t < k; t++) {
-            uint8_t c = s[t];
-            if (lowercase && c >= 'A' && c <= 'Z') c += 32;
-            token.push_back((char)c);
+          // stack buffer for the common short token; oversized tokens
+          // take an exact heap path (hash input must stay byte-identical
+          // to the Python tokenizer's)
+          uint32_t h;
+          if (prefix_len + tlen <= (int64_t)sizeof(token)) {
+            if (lowercase) {
+              for (int64_t t = 0; t < tlen; t++)
+                token[prefix_len + t] = kChar.lower[s[start + t]];
+            } else {
+              std::memcpy(token + prefix_len, s + start, (size_t)tlen);
+            }
+            h = murmur3_32(token, prefix_len + tlen, seed);
+          } else {
+            std::string big((const char*)prefix, (size_t)prefix_len);
+            for (int64_t t = start; t < k; t++)
+              big.push_back((char)(lowercase ? kChar.lower[s[t]] : s[t]));
+            h = murmur3_32((const uint8_t*)big.data(), (int64_t)big.size(),
+                           seed);
           }
-          uint32_t h = murmur3_32((const uint8_t*)token.data(),
-                                  (int64_t)token.size(), seed);
           float* cell = row_out + (int64_t)(h % (uint32_t)num_buckets);
           if (binary) {
             *cell = 1.0f;
@@ -169,12 +203,7 @@ int64_t tp_count_tokens(const uint8_t* buf, const int64_t* offsets,
     int64_t len = offsets[i + 1] - offsets[i];
     int64_t start = -1;
     for (int64_t k = 0; k <= len; k++) {
-      bool word = false;
-      if (k < len) {
-        uint8_t c = s[k];
-        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
-               (c >= 'a' && c <= 'z');
-      }
+      bool word = k < len && kChar.word[s[k]];
       if (word) {
         if (start < 0) start = k;
         continue;
@@ -198,8 +227,9 @@ int64_t tp_tokenize_hash_coo(const uint8_t* buf, const int64_t* offsets,
                              const uint8_t* prefix, int64_t prefix_len,
                              int32_t* out_rows, int32_t* out_cols,
                              int64_t cap) {
-  std::string token;
-  token.reserve(64);
+  uint8_t token[512];
+  if (prefix_len > 0 && prefix_len <= (int64_t)sizeof(token))
+    std::memcpy(token, prefix, (size_t)prefix_len);
   // per-row bucket bitset for binary dedup
   std::string seen;
   if (binary) seen.assign((size_t)((num_buckets + 7) / 8), '\0');
@@ -210,12 +240,7 @@ int64_t tp_tokenize_hash_coo(const uint8_t* buf, const int64_t* offsets,
     int64_t len = offsets[i + 1] - offsets[i];
     int64_t start = -1;
     for (int64_t k = 0; k <= len; k++) {
-      bool word = false;
-      if (k < len) {
-        uint8_t c = s[k];
-        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
-               (c >= 'a' && c <= 'z');
-      }
+      bool word = k < len && kChar.word[s[k]];
       if (word) {
         if (start < 0) start = k;
         continue;
@@ -223,14 +248,25 @@ int64_t tp_tokenize_hash_coo(const uint8_t* buf, const int64_t* offsets,
       if (start >= 0) {
         int64_t tlen = k - start;
         if (tlen >= min_token_len && w < cap) {
-          token.assign((const char*)prefix, (size_t)prefix_len);
-          for (int64_t t = start; t < k; t++) {
-            uint8_t c = s[t];
-            if (lowercase && c >= 'A' && c <= 'Z') c += 32;
-            token.push_back((char)c);
+          // fixed stack buffer for the overwhelmingly common short token;
+          // oversized tokens take an exact heap path (hash input must be
+          // byte-identical to the Python tokenizer's)
+          uint32_t h;
+          if (prefix_len + tlen <= (int64_t)sizeof(token)) {
+            if (lowercase) {
+              for (int64_t t = 0; t < tlen; t++)
+                token[prefix_len + t] = kChar.lower[s[start + t]];
+            } else {
+              std::memcpy(token + prefix_len, s + start, (size_t)tlen);
+            }
+            h = murmur3_32(token, prefix_len + tlen, seed);
+          } else {
+            std::string big((const char*)prefix, (size_t)prefix_len);
+            for (int64_t t = start; t < k; t++)
+              big.push_back((char)(lowercase ? kChar.lower[s[t]] : s[t]));
+            h = murmur3_32((const uint8_t*)big.data(), (int64_t)big.size(),
+                           seed);
           }
-          uint32_t h = murmur3_32((const uint8_t*)token.data(),
-                                  (int64_t)token.size(), seed);
           int64_t col = (int64_t)(h % (uint32_t)num_buckets);
           bool emit = true;
           if (binary) {
@@ -283,12 +319,7 @@ void tp_clean_tokenstats(const uint8_t* buf, const int64_t* offsets,
     int64_t len = offsets[i + 1] - offsets[i];
     int64_t start = -1;
     for (int64_t k = 0; k <= len; k++) {
-      bool word = false;
-      if (k < len) {
-        uint8_t c = s[k];
-        word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
-               (c >= 'a' && c <= 'z');
-      }
+      bool word = k < len && kChar.word[s[k]];
       if (word) {
         if (start < 0) start = k;
         continue;
@@ -410,6 +441,230 @@ void tp_tree_predict_sum(const int32_t* binned, int64_t n, int64_t num_f,
         node = node * 2 + go;
       }
       out[i] += lvt[node << (depth - eff)];
+    }
+  }
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------- token interning
+// Internal open-addressing hash set over byte slices (linear probing,
+// power-of-two capacity). Used by the interning kernels below; not exported.
+namespace {
+
+struct SliceTable {
+  // parallel arrays: slot -> (start, len) into an external byte store,
+  // plus the assigned code; code < 0 marks an empty slot.
+  std::vector<int64_t> starts;
+  std::vector<int64_t> lens;
+  std::vector<int32_t> codes;
+  uint64_t mask;
+
+  explicit SliceTable(int64_t expected) {
+    uint64_t cap = 1024;
+    while ((int64_t)cap < expected * 2) cap <<= 1;
+    starts.assign(cap, 0);
+    lens.assign(cap, 0);
+    codes.assign(cap, -1);
+    mask = cap - 1;
+  }
+
+  // find-or-insert the slice store[start:start+len]; returns (code, fresh)
+  int32_t probe(const uint8_t* store, int64_t start, int64_t len,
+                int32_t next_code, bool* fresh) {
+    uint64_t h = murmur3_32(store + start, len, 0x9747b28cu);
+    uint64_t i = h & mask;
+    for (;;) {
+      int32_t c = codes[i];
+      if (c < 0) {
+        starts[i] = start;
+        lens[i] = len;
+        codes[i] = next_code;
+        *fresh = true;
+        return next_code;
+      }
+      if (lens[i] == len &&
+          std::memcmp(store + starts[i], store + start, (size_t)len) == 0) {
+        *fresh = false;
+        return c;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Tokenize n ASCII row-strings and intern the tokens: emits one int32 code
+// per token occurrence (CSR payload), row offsets [n+1], and the unique
+// token table (bytes + offsets, first-occurrence order). Token rule and
+// lowercase/min-length semantics match tp_tokenize_hash_scatter (ASCII
+// [A-Za-z0-9]+ runs — the Python caller routes non-ASCII columns to the
+// exact-Unicode fallback). `cap_tokens` bounds out_codes/uniq_offsets
+// (callers size it with tp_count_tokens); uniq_buf must hold at least the
+// input buffer's byte length (tokens never grow). Returns the unique count.
+int64_t tp_intern_tokens(const uint8_t* buf, const int64_t* offsets,
+                         int64_t n_strings, int lowercase,
+                         int64_t min_token_len, int32_t* out_codes,
+                         int64_t* out_row_offsets, uint8_t* uniq_buf,
+                         int64_t* uniq_offsets, int64_t cap_tokens) {
+  SliceTable table(cap_tokens > 0 ? cap_tokens : 1);
+  int64_t w = 0;        // tokens emitted
+  int64_t uniq_w = 0;   // bytes written to uniq_buf
+  int32_t n_uniq = 0;
+  uniq_offsets[0] = 0;
+  out_row_offsets[0] = 0;
+  for (int64_t i = 0; i < n_strings; i++) {
+    const uint8_t* s = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t start = -1;
+    for (int64_t k = 0; k <= len; k++) {
+      bool word = k < len && kChar.word[s[k]];
+      if (word) {
+        if (start < 0) start = k;
+        continue;
+      }
+      if (start >= 0) {
+        int64_t tlen = k - start;
+        if (tlen >= min_token_len && w < cap_tokens) {
+          // stage the (lowercased) token at the tail of uniq_buf; keep it
+          // only when it is fresh
+          for (int64_t t = 0; t < tlen; t++) {
+            uint8_t c = s[start + t];
+            uniq_buf[uniq_w + t] = lowercase ? kChar.lower[c] : c;
+          }
+          bool fresh = false;
+          int32_t code =
+              table.probe(uniq_buf, uniq_w, tlen, n_uniq, &fresh);
+          if (fresh) {
+            uniq_w += tlen;
+            n_uniq++;
+            uniq_offsets[n_uniq] = uniq_w;
+          }
+          out_codes[w++] = code;
+        }
+        start = -1;
+      }
+    }
+    out_row_offsets[i + 1] = w;
+  }
+  return n_uniq;
+}
+
+// Intern n whole strings (concatenated buffer + offsets[n+1], compared
+// verbatim — callers pre-clean/lowercase if needed): out_codes[i] is the
+// code of value i, first_rows[u] the index of code u's first occurrence
+// (so callers recover unique VALUES without decoding any bytes), counts[u]
+// its total occurrence count. Returns the unique count.
+int64_t tp_intern_values(const uint8_t* buf, const int64_t* offsets,
+                         int64_t n, int32_t* out_codes, int64_t* first_rows,
+                         int64_t* counts) {
+  SliceTable table(n > 0 ? n : 1);
+  int32_t n_uniq = 0;
+  for (int64_t i = 0; i < n; i++) {
+    bool fresh = false;
+    int32_t code = table.probe(buf, offsets[i], offsets[i + 1] - offsets[i],
+                               n_uniq, &fresh);
+    if (fresh) {
+      first_rows[n_uniq] = i;
+      counts[n_uniq] = 0;
+      n_uniq++;
+    }
+    counts[code]++;
+    out_codes[i] = code;
+  }
+  return n_uniq;
+}
+
+// One-pass SmartText fit statistics: per string, clean
+// (TextUtils.cleanString — lowercase, split on non-alnum, capitalize,
+// join) while updating the token-length histogram, then intern the
+// cleaned (or raw, when intern_raw != 0) value in the same walk. The
+// cleaned bytes of DUPLICATE values are rewound, so uniq_buf stays
+// compact (unique values only, first-occurrence order via uniq_offsets).
+// intern_raw mode compares the raw slice minus `sep_trail` trailing
+// separator bytes (callers concatenate with one '\0' between items).
+// Returns the unique count; out_counts[u] is unique u's occurrence count.
+int64_t tp_text_valuestats(const uint8_t* buf, const int64_t* offsets,
+                           int64_t n, int64_t* len_hist, int64_t hist_size,
+                           int intern_raw, int64_t sep_trail,
+                           uint8_t* uniq_buf, int64_t* uniq_offsets,
+                           int64_t* out_counts) {
+  SliceTable table(n > 0 ? n : 1);
+  int64_t uniq_w = 0;
+  int32_t n_uniq = 0;
+  uniq_offsets[0] = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* s = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    // clean + histogram
+    int64_t start = -1;
+    int64_t w = uniq_w;
+    for (int64_t k = 0; k <= len; k++) {
+      bool word = k < len && kChar.word[s[k]];
+      if (word) {
+        if (start < 0) start = k;
+        continue;
+      }
+      if (start >= 0) {
+        int64_t tlen = k - start;
+        int64_t bin = tlen < hist_size ? tlen : hist_size - 1;
+        len_hist[bin]++;
+        if (!intern_raw) {
+          for (int64_t t = start; t < k; t++) {
+            uint8_t c = s[t];
+            if (c >= 'A' && c <= 'Z') c += 32;            // lowercase...
+            if (t == start && c >= 'a' && c <= 'z') c -= 32;  // ...capitalize
+            uniq_buf[w++] = c;
+          }
+        }
+        start = -1;
+      }
+    }
+    bool fresh = false;
+    int32_t code;
+    if (intern_raw) {
+      int64_t rlen = len - (i + 1 < n ? sep_trail : 0);
+      code = table.probe(buf, offsets[i], rlen, n_uniq, &fresh);
+      if (fresh) {
+        // copy the raw value so uniq_buf alone carries the uniques
+        std::memcpy(uniq_buf + uniq_w, s, (size_t)rlen);
+        w = uniq_w + rlen;
+      }
+    } else {
+      code = table.probe(uniq_buf, uniq_w, w - uniq_w, n_uniq, &fresh);
+    }
+    if (fresh) {
+      uniq_w = w;
+      n_uniq++;
+      uniq_offsets[n_uniq] = uniq_w;
+      out_counts[code] = 0;
+    }
+    out_counts[code]++;
+  }
+  return n_uniq;
+}
+
+// Scatter interned token codes into per-row bucket counts:
+// out[r, col_offset + code_to_col[codes[t]]] (+)= 1 for every token t of
+// row r, skipping codes mapped to a negative column. binary sets presence
+// instead of accumulating. The downstream half of tp_intern_tokens — the
+// hashing-TF / count-vectorizer transform over code arrays.
+void tp_code_bincount(const int32_t* codes, const int64_t* row_offsets,
+                      int64_t n_rows, const int32_t* code_to_col, int binary,
+                      float* out, int64_t out_cols, int64_t col_offset) {
+  for (int64_t r = 0; r < n_rows; r++) {
+    float* row_out = out + r * out_cols + col_offset;
+    for (int64_t t = row_offsets[r]; t < row_offsets[r + 1]; t++) {
+      int32_t col = code_to_col[codes[t]];
+      if (col < 0) continue;
+      if (binary) {
+        row_out[col] = 1.0f;
+      } else {
+        row_out[col] += 1.0f;
+      }
     }
   }
 }

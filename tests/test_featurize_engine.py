@@ -1,0 +1,662 @@
+"""Golden parity suite for the columnar featurization engine.
+
+Every rewritten hot-path stage (tokenizer / n-gram / stop words / count
+vectorizer / hashing TF / TF-IDF / time periods / the Word2Vec feed) must
+produce BYTE-IDENTICAL vectors and metadata to the historical row-loop
+implementations, which are re-stated here as golden twins. Corpora cover
+unicode, empty rows, all-null columns and single-row inputs. The serving
+section pins pool-on == pool-off scoring, PR-2 quarantine/sentinel
+behavior under chunked featurization, the wide-vocabulary SparseMatrix
+regression (no dense [N, 2^18] materialization), the bulk SchemaSentinel
+against its per-row twin, and the numpy-fallback path with the native
+library disabled.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.featurize import parallel as fpar
+from transmogrifai_tpu.featurize import stats as fstats
+from transmogrifai_tpu.featurize.interning import (
+    InternedTextList,
+    interned_of,
+    tokenize_text_column,
+)
+from transmogrifai_tpu.ops.embeddings import OpWord2VecModel
+from transmogrifai_tpu.ops.text_stages import (
+    ENGLISH_STOP_WORDS,
+    OpCountVectorizer,
+    OpCountVectorizerModel,
+    OpHashingTF,
+    OpIDF,
+    OpIDFModel,
+    OpNGram,
+    OpStopWordsRemover,
+    OpStringIndexer,
+    TextTokenizer,
+)
+from transmogrifai_tpu.ops.time_period import (
+    TIME_PERIODS,
+    TimePeriodListTransformer,
+    TimePeriodMapTransformer,
+    TimePeriodTransformer,
+    period_value,
+)
+from transmogrifai_tpu.types.columns import (
+    ListColumn,
+    SparseMatrix,
+    column_from_values,
+)
+from transmogrifai_tpu.utils.text import hash_to_index, tokenize
+
+pytestmark = pytest.mark.featurize
+
+
+# ---------------------------------------------------------------- corpora
+TEXT_CORPORA = {
+    "plain": ["the quick brown fox", "lazy dog", "fox fox fox", "the the"],
+    "unicode": ["café au lait", "naïve Σigma ΣIGMA", "hello—world", "日本語 テスト"],
+    "mixed": ["ascii only here", "déjà vu", None, "", "UPPER lower 42"],
+    "empty_rows": ["", None, "", None],
+    "all_null": [None, None, None],
+    "single": ["one lonely row of text"],
+    "punct": ["a-b_c!d", "  spaces   everywhere  ", "1 2 3 4 5"],
+}
+
+
+def _text_col(vals):
+    return column_from_values(T.Text, list(vals))
+
+
+def _token_lists(vals, **kw):
+    return [tokenize(v, **kw) if v else [] for v in vals]
+
+
+def _feat_text(name="txt"):
+    return FeatureBuilder.Text(name).as_predictor()
+
+
+def _feat_list(name="toks"):
+    return FeatureBuilder.TextList(name).as_predictor()
+
+
+# ----------------------------------------------------------- tokenization
+@pytest.mark.parametrize("corpus", sorted(TEXT_CORPORA))
+def test_tokenizer_matches_row_loop(corpus):
+    vals = TEXT_CORPORA[corpus]
+    stage = TextTokenizer().set_input(_feat_text())
+    out = stage.transform_columns(_text_col(vals), num_rows=len(vals))
+    golden = [tokenize(v, True, 1) if v else [] for v in _text_col(vals).to_list()]
+    assert out.to_list() == golden
+    assert isinstance(out, ListColumn)
+
+
+@pytest.mark.parametrize("lower,minlen", [(True, 1), (False, 2), (True, 3)])
+def test_tokenizer_params_match(lower, minlen):
+    vals = TEXT_CORPORA["plain"] + TEXT_CORPORA["unicode"]
+    stage = TextTokenizer(
+        to_lowercase=lower, min_token_length=minlen
+    ).set_input(_feat_text())
+    out = stage.transform_columns(_text_col(vals), num_rows=len(vals))
+    golden = [
+        tokenize(v, lower, minlen) if v else []
+        for v in _text_col(vals).to_list()
+    ]
+    assert out.to_list() == golden
+
+
+def test_interned_take_rows_round_trip():
+    vals = TEXT_CORPORA["mixed"]
+    tc = tokenize_text_column(vals)
+    idx = np.array([3, 0, 0, 2])
+    golden = [_token_lists(vals)[i] for i in idx]
+    # null/"" render as [] through column_from_values too
+    golden = [
+        tokenize(v, True, 1) if v else []
+        for v in np.asarray(_text_col(vals).to_list(), dtype=object)[idx]
+    ]
+    assert tc.take_rows(idx).to_lists() == golden
+
+
+# ----------------------------------------------------------------- n-gram
+@pytest.mark.parametrize("corpus", sorted(TEXT_CORPORA))
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_ngram_matches_row_loop(corpus, n):
+    vals = TEXT_CORPORA[corpus]
+    rows = _token_lists(vals)
+    stage = OpNGram(n=n).set_input(_feat_list())
+    out = stage.transform_columns(
+        ListColumn(T.TextList, rows), num_rows=len(rows)
+    )
+    golden = [
+        [" ".join(row[i:i + n]) for i in range(len(row) - n + 1)]
+        if row else []
+        for row in rows
+    ]
+    assert out.to_list() == golden
+
+
+# ------------------------------------------------------------- stop words
+@pytest.mark.parametrize("case_sensitive", [False, True])
+def test_stopwords_match_row_loop(case_sensitive):
+    rows = _token_lists(
+        ["the quick brown fox", "The THE a thE", "ceci est un test", None]
+    )
+    rows[1] = ["The", "THE", "a", "thE"]  # mixed case survives tokenize-off
+    stage = OpStopWordsRemover(case_sensitive=case_sensitive).set_input(
+        _feat_list()
+    )
+    out = stage.transform_columns(
+        ListColumn(T.TextList, rows), num_rows=len(rows)
+    )
+    if case_sensitive:
+        sw = frozenset(ENGLISH_STOP_WORDS)
+        golden = [[t for t in row if t not in sw] for row in rows]
+    else:
+        low = frozenset(w.lower() for w in ENGLISH_STOP_WORDS)
+        golden = [[t for t in row if t.lower() not in low] for row in rows]
+    assert out.to_list() == golden
+
+
+def test_stopwords_membership_cache_fills_once():
+    stage = OpStopWordsRemover().set_input(_feat_list())
+    rows = [["the", "fox"], ["fox", "a"]]
+    stage.transform_columns(ListColumn(T.TextList, rows), num_rows=2)
+    assert stage._member_cache == {"the": True, "fox": False, "a": True}
+
+
+# ------------------------------------------------------- count vectorizer
+def _golden_term_matrix(rows, vocab, binary):
+    values = np.zeros((len(rows), len(vocab)), dtype=np.float32)
+    index = {t: i for i, t in enumerate(vocab)}
+    for r, row in enumerate(rows):
+        counts: dict = {}
+        for t in row:
+            counts[t] = counts.get(t, 0.0) + 1.0
+        if binary:
+            counts = {t: 1.0 for t in counts}
+        for t, c in counts.items():
+            j = index.get(t)
+            if j is not None:
+                values[r, j] = c
+    return values
+
+
+@pytest.mark.parametrize("corpus", sorted(TEXT_CORPORA))
+@pytest.mark.parametrize("binary", [False, True])
+def test_count_vectorizer_matches_row_loop(corpus, binary):
+    rows = _token_lists(TEXT_CORPORA[corpus]) + [["the", "the", "fox"]]
+    feat = _feat_list()
+    est = OpCountVectorizer(binary=binary).set_input(feat)
+    model = est.fit(Dataset.of({"toks": ListColumn(T.TextList, rows)}))
+    out = model.transform_columns(
+        ListColumn(T.TextList, rows), num_rows=len(rows)
+    )
+    golden = _golden_term_matrix(rows, model.vocab, binary)
+    assert np.asarray(out.values).dtype == np.float32
+    assert np.array_equal(np.asarray(out.values), golden)
+    assert out.metadata.size == len(model.vocab)
+    assert [m.indicator_value for m in out.metadata.columns] == model.vocab
+
+
+def test_count_vectorizer_wide_vocab_stays_sparse():
+    # the Spark-default vocab_size is 2^18: the transform must route
+    # through SparseMatrix instead of materializing N x 262144 float32
+    # (~1 GB per 1k rows)
+    rows = [["tok%d" % i for i in range(20)] for _ in range(64)]
+    model = OpCountVectorizerModel(
+        ["tok%d" % i for i in range(20)] + ["pad%d" % i for i in range((1 << 18) - 20)]
+    )
+    model.set_input(_feat_list())
+    out = model.transform_columns(
+        ListColumn(T.TextList, rows), num_rows=len(rows)
+    )
+    assert isinstance(out.values, SparseMatrix)
+    assert out.values.shape == (64, 1 << 18)
+    assert out.values.nnz == 64 * 20
+    # spot-check values without densifying the full plane
+    assert out.values._dense is None
+
+
+# -------------------------------------------------------------- hashingTF
+@pytest.mark.parametrize("corpus", sorted(TEXT_CORPORA))
+@pytest.mark.parametrize("binary", [False, True])
+def test_hashing_tf_matches_row_loop(corpus, binary):
+    rows = _token_lists(TEXT_CORPORA[corpus])
+    stage = OpHashingTF(num_features=32, binary=binary).set_input(_feat_list())
+    out = stage.transform_columns(
+        ListColumn(T.TextList, rows), num_rows=len(rows)
+    )
+    golden = np.zeros((len(rows), 32), dtype=np.float32)
+    for r, row in enumerate(rows):
+        for t in row:
+            j = hash_to_index(t, 32)
+            if binary:
+                golden[r, j] = 1.0
+            else:
+                golden[r, j] += 1.0
+    assert np.array_equal(np.asarray(out.values), golden)
+
+
+# ----------------------------------------------------------------- TF-IDF
+def test_idf_matches_dense_multiply_and_sparse_round_trip():
+    rows = [["a", "a", "b"], ["b", "c"], [], ["a", "c", "c", "c"]]
+    feat = _feat_list()
+    cv = OpCountVectorizer().set_input(feat)
+    ds = Dataset.of({"toks": ListColumn(T.TextList, rows)})
+    cv_model = cv.fit(ds)
+    counts = cv_model.transform_columns(
+        ListColumn(T.TextList, rows), num_rows=len(rows)
+    )
+    vec_feat = FeatureBuilder.OPVector("v").as_predictor()
+    idf = OpIDF().set_input(vec_feat)
+    model = idf.fit(Dataset.of({"v": counts}))
+    out = model.transform_columns(counts, num_rows=len(rows))
+    golden = (np.asarray(counts.values) * model.idf[None, :]).astype(np.float32)
+    assert np.array_equal(np.asarray(out.values), golden)
+
+    # sparse input: same idf fit, byte-identical densified tf-idf
+    sparse_counts = type(counts)(
+        counts.feature_type,
+        SparseMatrix.from_dense(np.asarray(counts.values)),
+        counts.metadata,
+    )
+    model_sp = idf.fit_model(Dataset.of({"v": sparse_counts}))
+    assert np.array_equal(model_sp.idf, model.idf)
+    model_sp.set_input(vec_feat)
+    out_sp = model_sp.transform_columns(sparse_counts, num_rows=len(rows))
+    assert isinstance(out_sp.values, SparseMatrix)
+    assert np.array_equal(np.asarray(out_sp.values), golden)
+
+
+# ----------------------------------------------------------- time periods
+@pytest.mark.parametrize("period", TIME_PERIODS)
+def test_time_period_scalar_vs_vector_parity(period):
+    rng = np.random.default_rng(7)
+    ms = np.concatenate([
+        rng.integers(-4_000_000_000_000, 4_000_000_000_000, 2000),
+        np.array([0, 1, -1, 86_400_000, -86_400_000, 3_600_000 * 25]),
+    ])
+    feat = FeatureBuilder.Date("d").as_predictor()
+    stage = TimePeriodTransformer(period).set_input(feat)
+    col = column_from_values(T.Date, [int(v) for v in ms])
+    out = stage.transform_columns(col, num_rows=len(ms))
+    golden = np.array([period_value(int(v), period) for v in ms], dtype=np.int64)
+    assert np.array_equal(out.values, golden)
+
+
+@pytest.mark.parametrize("period", TIME_PERIODS)
+def test_time_period_list_and_map_parity(period):
+    rng = np.random.default_rng(8)
+    rows = [
+        [int(v) for v in rng.integers(-2_000_000_000_000, 2_000_000_000_000, k)]
+        for k in (3, 0, 1, 5)
+    ]
+    lf = FeatureBuilder.DateList("dl").as_predictor()
+    stage = TimePeriodListTransformer(period).set_input(lf)
+    out = stage.transform_columns(
+        ListColumn(T.DateList, rows), num_rows=len(rows)
+    )
+    golden = [
+        [period_value(int(v), period) for v in row] if row else []
+        for row in rows
+    ]
+    assert out.to_list() == golden
+
+    maps = [
+        {f"k{i}": v for i, v in enumerate(row)} if row else {}
+        for row in rows
+    ]
+    mf = FeatureBuilder.DateMap("dm").as_predictor()
+    mstage = TimePeriodMapTransformer(period).set_input(mf)
+    mout = mstage.transform_columns(
+        column_from_values(T.DateMap, maps), num_rows=len(maps)
+    )
+    mgolden = [
+        {k: period_value(int(v), period) for k, v in m.items()} if m else {}
+        for m in maps
+    ]
+    assert mout.to_list() == mgolden
+
+
+# ------------------------------------------------------------ w2v feed
+def test_word2vec_transform_matches_row_loop():
+    rng = np.random.default_rng(3)
+    vocab = [f"w{i}" for i in range(40)]
+    vectors = rng.standard_normal((40, 16)).astype(np.float32)
+    model = OpWord2VecModel(vocab, vectors)
+    model.set_input(_feat_list())
+    rows = [
+        [vocab[i] for i in rng.integers(0, 40, k)] + (["oov"] if k % 2 else [])
+        for k in (5, 0, 1, 12, 64)
+    ]
+    out = model.transform_columns(
+        ListColumn(T.TextList, rows), num_rows=len(rows)
+    )
+    golden = np.zeros((len(rows), 16), dtype=np.float32)
+    index = {t: i for i, t in enumerate(vocab)}
+    for r, row in enumerate(rows):
+        ids = [index[t] for t in row if t in index]
+        if ids:
+            golden[r] = vectors[ids].mean(axis=0)
+    assert np.array_equal(np.asarray(out.values), golden)
+
+
+# ------------------------------------------------------- string indexer
+def test_string_indexer_matches_row_loop():
+    vals = ["b", "a", "b", None, "c", "b", "a", "zz"]
+    feat = _feat_text()
+    for handle in ("keep", "skip"):
+        est = OpStringIndexer(handle_invalid=handle).set_input(feat)
+        ds = Dataset.of({"txt": _text_col(vals[:6])})
+        model = est.fit(ds)
+        col = _text_col(vals)
+        out = model.transform_columns(col, num_rows=len(vals))
+        unseen = float(len(model.labels))
+        gv = np.zeros(len(vals), dtype=np.float64)
+        gm = np.ones(len(vals), dtype=bool)
+        for i, v in enumerate(col.to_list()):
+            j = model._index.get(v) if v is not None else None
+            if j is not None:
+                gv[i] = float(j)
+            elif handle == "keep":
+                gv[i] = unseen
+            else:
+                gm[i] = False
+        assert np.array_equal(out.values, gv)
+        assert np.array_equal(out.mask, gm)
+    est = OpStringIndexer(handle_invalid="error").set_input(feat)
+    model = est.fit(Dataset.of({"txt": _text_col(["a", "b"])}))
+    with pytest.raises(ValueError, match="Unseen label"):
+        model.transform_columns(_text_col(["a", "zz"]), num_rows=2)
+
+
+# ------------------------------------------------- interning invariants
+def test_interned_column_is_list_column_for_legacy_consumers():
+    vals = ["a b", None, "c"]
+    tc = tokenize_text_column(vals)
+    col = InternedTextList(T.TextList, tc)
+    assert isinstance(col, ListColumn)
+    assert len(col) == 3
+    assert col.values == [["a", "b"], [], ["c"]]
+    assert interned_of(col) is tc
+    sliced = col.take(np.array([2, 0]))
+    assert sliced.to_list() == [["c"], ["a", "b"]]
+
+
+def test_interned_of_caches_on_plain_list_columns():
+    col = ListColumn(T.TextList, [["x"], ["x", "y"]])
+    tc1 = interned_of(col)
+    assert interned_of(col) is tc1
+    assert tc1.vocab == ["x", "y"]
+
+
+# --------------------------------------------------- numpy-fallback path
+def test_rewritten_stages_identical_without_native_library(monkeypatch):
+    from transmogrifai_tpu import native
+
+    vals = TEXT_CORPORA["plain"] + TEXT_CORPORA["mixed"]
+    stage = TextTokenizer().set_input(_feat_text())
+    col = _text_col(vals)
+    with_native = stage.transform_columns(col, num_rows=len(vals)).to_list()
+    hstage = OpHashingTF(num_features=16).set_input(_feat_list())
+    rows = _token_lists(vals)
+    hn = np.asarray(
+        hstage.transform_columns(
+            ListColumn(T.TextList, rows), num_rows=len(rows)
+        ).values
+    )
+    monkeypatch.setattr(native, "_load", lambda: None)
+    without = stage.transform_columns(col, num_rows=len(vals)).to_list()
+    assert with_native == without
+    hf = np.asarray(
+        hstage.transform_columns(
+            ListColumn(T.TextList, rows), num_rows=len(rows)
+        ).values
+    )
+    assert np.array_equal(hn, hf)
+    assert fstats.snapshot()["internFallbackBuilds"] > 0
+
+
+def test_stale_library_records_and_falls_back(monkeypatch):
+    from transmogrifai_tpu import native
+
+    class _Stale:  # a lib object missing every new kernel
+        pass
+
+    monkeypatch.setattr(native, "_load", lambda: _Stale())
+    monkeypatch.setattr(native, "_STALE_WARNED", set())
+    before = fstats.snapshot()["staleLibraryKernels"]
+    assert native.intern_values(["a", "b", "a"]) is None
+    assert fstats.snapshot()["staleLibraryKernels"] == before + 1
+
+
+# ------------------------------------------------------ bulk sentinel
+def test_check_rows_matches_check_row_exactly():
+    from transmogrifai_tpu.resilience.sentinel import SchemaSentinel
+
+    feats = [
+        FeatureBuilder.Real("r").as_predictor(),
+        FeatureBuilder.Integral("i").as_predictor(),
+        FeatureBuilder.Binary("b").as_predictor(),
+        FeatureBuilder.Text("t").as_predictor(),
+        FeatureBuilder.TextMap("m").as_predictor(),
+    ]
+    rows = [
+        {"r": 1.0, "i": 2, "b": True, "t": "ok", "m": {"k": "v"}},
+        {"r": float("nan"), "i": 2.5, "b": "yes", "t": 7, "m": {}},
+        {"r": "3.5", "i": "4", "b": "garbage", "t": None, "m": []},
+        {"i": float("inf"), "b": 0, "t": "fine", "m": {"a": 1}},
+        {"r": None, "i": None, "b": None, "t": None, "m": None},
+        {"r": np.float64(2.0), "i": np.int32(3), "b": np.bool_(False),
+         "t": "x", "m": {"z": "w"}},
+    ] * 3
+    bulk = SchemaSentinel(feats)
+    single = SchemaSentinel(feats)
+    got = bulk.check_rows(rows)
+    want = [single.check_row(dict(r)) for r in rows]
+    assert [g[0] for g in got] == [w[0] for w in want]
+    assert [g[1] for g in got] == [w[1] for w in want]
+    assert bulk.stats() == single.stats()
+
+
+def test_check_rows_survives_int_beyond_float64_range():
+    # census {float, int} is clean, but the vectorized float64 conversion
+    # overflows on a huge int — the batch must fall back to the exact
+    # per-row path (which accepts huge ints), not crash
+    from transmogrifai_tpu.resilience.sentinel import SchemaSentinel
+
+    feats = [FeatureBuilder.Real("x").as_predictor()]
+    rows = [{"x": 0.5}, {"x": 2 ** 1024}, {"x": float("nan")}]
+    bulk = SchemaSentinel(feats)
+    single = SchemaSentinel(feats)
+    got = bulk.check_rows(rows)
+    want = [single.check_row(dict(r)) for r in rows]
+    assert [g[0] for g in got] == [w[0] for w in want]
+    assert bulk.stats() == single.stats()
+
+
+def test_onehot_set_fit_ignores_none_members():
+    from transmogrifai_tpu.ops.categorical import OneHotVectorizer
+    from transmogrifai_tpu.types.columns import SetColumn
+
+    col = SetColumn(
+        T.MultiPickList,
+        [frozenset({"a", None}), frozenset({"b"}), frozenset({"a"})],
+    )
+    f = FeatureBuilder.MultiPickList("s").as_predictor()
+    est = OneHotVectorizer(min_support=1).set_input(f)
+    model = est.fit(Dataset.of({"s": col}))
+    assert model.vocabs[0] == ["A", "B"]  # no 'None' category
+
+
+def test_pivot_codes_consistent_across_batch_sizes():
+    # non-str values must resolve identically at serving and ingest batch
+    # sizes (raw-keyed memo semantics, no str() coercion divergence)
+    from transmogrifai_tpu.ops.categorical import _pivot_codes
+
+    index = {"5": 0, "a": 1}
+    small = _pivot_codes([5, "a", None] * 10, index, clean_text=False)
+    big = _pivot_codes([5, "a", None] * 2000, index, clean_text=False)
+    assert list(small[:3]) == list(big[:3]) == [-2, 1, -1]
+
+
+def test_check_rows_raise_fires_on_same_row():
+    from transmogrifai_tpu.resilience.sentinel import (
+        SchemaSentinel,
+        SchemaViolationError,
+        SentinelPolicy,
+    )
+
+    feats = [FeatureBuilder.Real("r").as_predictor()]
+    policy = SentinelPolicy(unparseable="raise")
+    rows = [{"r": 1.0}, {"r": "bad"}, {"r": "also bad"}]
+    bulk = SchemaSentinel(feats, policy=policy)
+    single = SchemaSentinel(feats, policy=policy)
+    with pytest.raises(SchemaViolationError) as e_bulk:
+        bulk.check_rows(rows)
+    err_single = None
+    for r in rows:
+        try:
+            single.check_row(r)
+        except SchemaViolationError as e:
+            err_single = e
+            break
+    assert str(e_bulk.value) == str(err_single)
+    assert bulk.rows_seen == single.rows_seen
+
+
+# ---------------------------------------------- serving under the pool
+def _tiny_model():
+    rng = np.random.default_rng(0)
+    n = 300
+    words = np.array("alpha beta gamma delta epsilon zeta".split())
+    txt = np.array(
+        [" ".join(words[rng.integers(0, 6, 6)]) for _ in range(n)],
+        dtype=object,
+    )
+    txt[rng.random(n) < 0.1] = None
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.types.columns import NumericColumn, TextColumn
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    cols = {
+        "label": NumericColumn(
+            T.Integral, rng.integers(0, 2, n).astype(np.int64),
+            np.ones(n, bool),
+        ),
+        "txt": TextColumn(T.Text, txt),
+        "num": NumericColumn(T.Real, rng.normal(size=n), rng.random(n) > 0.2),
+    }
+    ds = Dataset.of(cols)
+    resp, preds = from_dataset(ds, response="label")
+    vector = transmogrify(preds)
+    pred = LogisticRegression().set_input(resp, vector).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    return model, ds
+
+
+@pytest.mark.serving
+def test_score_columns_pool_on_matches_pool_off(monkeypatch):
+    from transmogrifai_tpu.local.scoring import score_function
+
+    model, ds = _tiny_model()
+    monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "4")
+    monkeypatch.setenv("TPTPU_FEATURIZE_CHUNK", "64")  # force chunking at 300 rows
+    on = score_function(model).columns(ds)
+    monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "0")
+    off = score_function(model).columns(ds)
+    assert set(on) == set(off)
+    for name in on:
+        a, b = on[name], off[name]
+        la, lb = a.to_list(), b.to_list()
+        assert la == lb, name
+
+
+@pytest.mark.serving
+def test_quarantine_preserved_under_chunked_featurization(monkeypatch):
+    from transmogrifai_tpu.local.scoring import score_function
+
+    model, ds = _tiny_model()
+    names = [f.name for f in model.raw_features]
+    rows = [
+        {n: v for n, v in zip(names, vals)}
+        for vals in zip(*(ds[n].to_list() for n in names))
+    ]
+    rows[3] = dict(rows[3], num="##unparseable##")
+    rows[7] = dict(rows[7], num="##unparseable##")
+    monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "4")
+    monkeypatch.setenv("TPTPU_FEATURIZE_CHUNK", "32")
+    f_on = score_function(model)
+    out_on = f_on.batch(rows)
+    monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "0")
+    f_off = score_function(model)
+    out_off = f_off.batch(rows)
+    assert out_on == out_off
+    assert f_on.quarantine.stats() == f_off.quarantine.stats()
+    assert f_on.quarantine.stats()["quarantinedRows"] == 2
+    assert f_on.sentinel.stats()["violations"] == {"unparseable": 2}
+
+
+@pytest.mark.serving
+def test_fused_batches_match_first_unfused_batch():
+    from transmogrifai_tpu.local.scoring import score_function
+
+    model, ds = _tiny_model()
+    f = score_function(model)
+    before = fstats.snapshot()["fusedAssemblies"]
+    first = f.columns(ds)    # learns widths (unfused)
+    second = f.columns(ds)   # fused assembly
+    third = f.columns(ds)
+    assert fstats.snapshot()["fusedAssemblies"] > before
+    for name in first:
+        assert first[name].to_list() == second[name].to_list() == \
+            third[name].to_list(), name
+
+
+@pytest.mark.serving
+def test_featurize_stats_surface_in_metadata_and_summary():
+    from transmogrifai_tpu.local.scoring import score_function
+
+    model, ds = _tiny_model()
+    f = score_function(model)
+    f.columns(ds)
+    md = f.metadata()
+    assert "featurizeStats" in md
+    assert md["featurizeStats"]["rowsFeaturized"] > 0
+    assert "stageRowsPerSec" in md["featurizeStats"]
+
+
+# ------------------------------------------------------- chunk helpers
+def test_chunk_ranges_cover_rows_exactly(monkeypatch):
+    monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "4")
+    monkeypatch.setenv("TPTPU_FEATURIZE_CHUNK", "10")
+    ranges = fpar.chunk_ranges(35)
+    assert ranges[0][0] == 0 and ranges[-1][1] == 35
+    flat = []
+    for a, b in ranges:
+        flat.extend(range(a, b))
+    assert flat == list(range(35))
+    monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "0")
+    assert fpar.chunk_ranges(35) == [(0, 35)]
+
+
+def test_slice_rows_matches_take():
+    from transmogrifai_tpu.types.columns import MapColumn, NumericColumn
+
+    cols = [
+        column_from_values(T.Real, [1.0, None, 3.0, 4.0]),
+        _text_col(["a", None, "c", "d"]),
+        MapColumn(T.TextMap, [{"k": 1}, {}, {"j": 2}, {"k": 3}]),
+        InternedTextList(T.TextList, tokenize_text_column(["a b", None, "c", "d e f"])),
+    ]
+    for col in cols:
+        a = fpar.slice_rows(col, 1, 3)
+        b = col.take(np.arange(1, 3))
+        assert a.to_list() == b.to_list(), type(col).__name__

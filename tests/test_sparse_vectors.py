@@ -91,7 +91,12 @@ def test_hash_block_sparse_binary_dedupes():
     assert dense[0].sum() == 1.0  # three 'fox' → one bucket, value 1
 
 
-def test_smarttext_sparse_pipeline_matches_dense():
+def test_smarttext_sparse_pipeline_matches_dense(monkeypatch):
+    # drop the serving-size dense cutoff so the sparse path engages at
+    # a test-sized batch (ingest-scale batches assemble sparse by default)
+    from transmogrifai_tpu.ops import text as text_mod
+
+    monkeypatch.setattr(text_mod, "SPARSE_MIN_ROWS", 0)
     rng = np.random.default_rng(1)
     words = np.array("alpha beta gamma delta epsilon zeta eta theta".split())
     n = 400

@@ -1,0 +1,32 @@
+"""Columnar featurization engine — the raw→vector plane with zero
+per-row Python in the hot path.
+
+Four pillars (mirroring the compile plane of ``transmogrifai_tpu.compiler``):
+
+* **token-code interning** (``interning``): each text column is tokenized
+  ONCE into a flat int32 code array + row offsets (CSR layout) over a
+  per-batch vocabulary; downstream text stages (n-grams, stop words,
+  count/hashing TF, the embeddings feed) operate on the code arrays with
+  numpy/native kernels instead of list-of-list-of-str;
+* **fused block assembly** (``engine``): a planner walks the fitted DAG,
+  groups the vectorizer sequence stages feeding ``VectorsCombiner``, and
+  has them write straight into one preallocated ``[N, width]`` matrix —
+  no per-stage output temporaries, no combiner concat;
+* **chunked parallel featurization** (``parallel``): a thread pool over
+  row chunks (the native kernels release the GIL) feeding both train-time
+  ingest and batch/columnar serving, wired into the PR-4 ``prefetch_f32``
+  seam;
+* **featurizeStats** (``stats``): the process-wide ledger — per-stage
+  rows/s, bytes assembled, pool utilization, interning and
+  fallback-kernel counts — surfaced in the selector summary,
+  ``summary_pretty()``, ``score_fn.metadata()`` and the bench JSON.
+
+See ``docs/featurization.md``.
+"""
+from . import stats  # noqa: F401
+from .interning import (  # noqa: F401
+    InternedTextList,
+    TokenCodes,
+    interned_of,
+    tokenize_text_column,
+)

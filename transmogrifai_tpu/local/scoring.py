@@ -125,6 +125,12 @@ def score_function(
                 # closure-build time, not deep inside the first call
                 raise ValueError(f"Stage {t} was never fitted")
             plan.append(t)
+    # featurize plane: one fusion planner per closure — after the first
+    # batch learns each vectorizer's width, later batches assemble the
+    # whole plane into ONE [N, total_width] buffer (featurize/engine.py)
+    from ..featurize.engine import FusionPlanner
+
+    fusion = FusionPlanner(plan)
     # pipelined dispatch: columns that feed a fitted predictor stage get
     # their device upload prefetched the moment they materialize, so the
     # transfer overlaps the host stages between producer and predictor
@@ -218,6 +224,19 @@ def score_function(
         dead: set[str] = set()
         failures: list[tuple[Any, Exception]] = []
         cause: dict[str, str] = {}
+        with fusion.batch(b):
+            _plan_loop(
+                cols, b, n, row_indices, breaker_mode, skip,
+                dead, failures, cause, fp,
+            )
+        return dead, failures, cause
+
+    def _plan_loop(
+        cols, b, n, row_indices, breaker_mode, skip,
+        dead, failures, cause, fp,
+    ) -> None:
+        """The stage loop of ``_run_plan`` (split out so the fusion batch
+        context brackets exactly one plan execution)."""
         for t in plan:
             if any(nm in dead for nm in t.input_names):
                 dead.add(t.output_name)
@@ -286,7 +305,6 @@ def score_function(
                     br.record_failure()
                 else:
                     br.record_success()
-        return dead, failures, cause
 
     def _raw_columns(
         prepared: list[dict[str, Any] | None], n: int, b: int
@@ -374,17 +392,20 @@ def score_function(
         (Drift observes the BUILT raw columns afterwards — one vectorized
         bulk merge per feature instead of a per-row histogram update.)"""
         fp = faults.active()
-        prepared: list[dict[str, Any] | None] = []
-        invalid: dict[int, list] = {}
-        for i, row in enumerate(rows):
-            if fp is not None:
+        if fp is not None:
+            rows = list(rows)
+            for i, row in enumerate(rows):
                 corrupted = fp.on_score_row(row, i)
                 if corrupted is not None:
-                    row = corrupted
-            if sentinel is not None:
-                clean, reasons = sentinel.check_row(row)
-            else:
-                clean, reasons = row, []
+                    rows[i] = corrupted
+        prepared: list[dict[str, Any] | None] = []
+        invalid: dict[int, list] = {}
+        if sentinel is None:
+            return list(rows), invalid
+        # bulk validation: a type census per column proves clean batches
+        # clean in O(fields) array passes; only suspicious rows re-run the
+        # exact per-row check (identical counters/coercions/raise order)
+        for i, (clean, reasons) in enumerate(sentinel.check_rows(rows)):
             if reasons:
                 invalid[i] = reasons
                 prepared.append(None)
@@ -644,11 +665,14 @@ def score_function(
         drift counters, one report — plus the training-side distributed
         ledger (hosts lost, failovers, reshards) so serving ops can see
         the model behind this closure finished on a degraded mesh, and the
-        process-wide compile-plane ledger (compiler.stats)."""
+        process-wide compile-plane (compiler.stats) and featurize-plane
+        (featurize.stats) ledgers."""
         from ..compiler import stats as cstats
+        from ..featurize import stats as fstats
 
         return {
             "compileStats": cstats.snapshot(),
+            "featurizeStats": fstats.snapshot(),
             "scoreGuard": guard.stats(),
             "sentinel": None if sentinel is None else sentinel.stats(),
             "quarantine": qlog.stats(),

@@ -121,6 +121,52 @@ def _load() -> ctypes.CDLL | None:
                 np.ctypeslib.ndpointer(np.float32, flags="C"),
                 ctypes.c_int64, ctypes.c_int64,
             ]
+        if hasattr(lib, "tp_abi_version"):
+            lib.tp_abi_version.restype = ctypes.c_int64
+        if hasattr(lib, "tp_intern_tokens"):
+            lib.tp_intern_tokens.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64,
+            ]
+            lib.tp_intern_tokens.restype = ctypes.c_int64
+        if hasattr(lib, "tp_intern_values"):
+            lib.tp_intern_values.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+            ]
+            lib.tp_intern_values.restype = ctypes.c_int64
+        if hasattr(lib, "tp_text_valuestats"):
+            lib.tp_text_valuestats.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.uint8, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+            ]
+            lib.tp_text_valuestats.restype = ctypes.c_int64
+        if hasattr(lib, "tp_code_bincount"):
+            lib.tp_code_bincount.argtypes = [
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.int64, flags="C"),
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                ctypes.c_int,
+                np.ctypeslib.ndpointer(np.float32, flags="C"),
+                ctypes.c_int64, ctypes.c_int64,
+            ]
         if hasattr(lib, "tp_tree_predict_sum"):
             lib.tp_tree_predict_sum.argtypes = [
                 np.ctypeslib.ndpointer(np.int32, flags="C"),
@@ -140,8 +186,60 @@ def available() -> bool:
     return _load() is not None
 
 
+#: ABI stamp the bindings below were written against (tp_abi_version in
+#: native/tptpu_native.cpp). A loaded library reporting less predates some
+#: kernel — affected entry points fail SOFT (numpy fallback + one warning +
+#: a featurizeStats counter) instead of AttributeError at transform time.
+ABI_VERSION = 3
+
+_STALE_WARNED: set[str] = set()
+
+
+def abi_version() -> int:
+    """ABI stamp of the loaded library (0 = missing/unstamped)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tp_abi_version"):
+        return 0
+    return int(lib.tp_abi_version())
+
+
+def _require(symbol: str):
+    """The loaded library, or None when it lacks ``symbol`` (stale cached
+    build) — recorded once per symbol in the featurize ledger so operators
+    can see a degraded kernel set instead of silently slow transforms."""
+    lib = _load()
+    if lib is None:
+        return None
+    if hasattr(lib, symbol):
+        return lib
+    if symbol not in _STALE_WARNED:
+        _STALE_WARNED.add(symbol)
+        log.warning(
+            "libtptpu.so predates kernel %s (abi %d < %d): numpy fallback "
+            "active — rebuild with `make -B` in native/",
+            symbol, abi_version(), ABI_VERSION,
+        )
+        from .featurize import stats as _fstats
+
+        _fstats.stats().count_stale_library(symbol)
+    return None
+
+
 def _concat(values: list) -> tuple[bytes, np.ndarray]:
-    """Concatenate strings into one UTF-8 buffer + offsets[n+1]."""
+    """Concatenate strings into one UTF-8 buffer + offsets[n+1].
+
+    ASCII fast path: one join + one bulk isascii + one encode, with byte
+    offsets from character lengths (== byte lengths for ASCII) — the
+    per-item encode loop only runs for non-ASCII/mixed input."""
+    n = len(values)
+    try:
+        joined = "".join(values)
+    except TypeError:
+        joined = None  # None/non-str present — per-item loop below
+    if joined is not None and joined.isascii():
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(np.fromiter(map(len, values), np.int64, n), out=offsets[1:])
+        return joined.encode("ascii"), offsets
     encoded = [v.encode("utf-8") if isinstance(v, str) else b"" for v in values]
     offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
     np.cumsum([len(e) for e in encoded], out=offsets[1:])
@@ -297,7 +395,11 @@ def tokenize_hash_coo(
     if ct is None:
         return None
     buf, offsets = ct
-    cap = int(lib.tp_count_tokens(buf, offsets, len(texts), min_token_length))
+    # worst-case token count instead of a counting prepass: every token
+    # needs at least one word char plus a delimiter, so the fill pass can
+    # never emit more than (bytes + strings) / 2 + 1 pairs — sizing the
+    # output this way saves a full scan of the buffer
+    cap = (len(buf) + len(texts)) // 2 + 1
     out_rows = np.empty(max(cap, 1), dtype=np.int32)
     out_cols = np.empty(max(cap, 1), dtype=np.int32)
     pref = prefix.encode("ascii")
@@ -309,7 +411,9 @@ def tokenize_hash_coo(
             pref, len(pref), out_rows, out_cols, cap,
         )
     )
-    return out_rows[:n], out_cols[:n]
+    # copy out of the worst-case-sized scratch: a view would pin the
+    # whole allocation for the lifetime of the sparse block
+    return out_rows[:n].copy(), out_cols[:n].copy()
 
 
 def clean_tokenstats(texts: list) -> tuple[list, np.ndarray] | None:
@@ -337,6 +441,44 @@ def clean_tokenstats(texts: list) -> tuple[list, np.ndarray] | None:
         for i in range(len(texts))
     ]
     return cleaned, hist
+
+
+def text_stats_pass(
+    texts: list, cap: int, clean_text: bool
+) -> tuple[np.ndarray, list[str], np.ndarray] | None:
+    """The SmartText fit hot loop in ONE native pass
+    (``tp_text_valuestats``): clean + token-length histogram + capped
+    value counts without ever materializing a per-row Python string.
+    Returns ``(length_hist, uniques, counts)`` where ``uniques`` holds
+    only the FIRST ``cap + 1`` distinct (cleaned) values in row order
+    with their FULL counts (the capped-Counter monoid of TextStats), or
+    None when the native path can't take the column (library
+    missing/stale or non-ASCII rows)."""
+    lib = _require("tp_text_valuestats")
+    if lib is None:
+        return None
+    ct = _concat_tokens(texts)
+    if ct is None:  # non-ASCII rows present — caller partitions
+        return None
+    buf, offsets = ct
+    n = len(texts)
+    hist = np.zeros(256, dtype=np.int64)
+    uniq_buf = np.empty(max(len(buf), 1), dtype=np.uint8)
+    uniq_offsets = np.zeros(n + 1, dtype=np.int64)
+    counts = np.empty(n, dtype=np.int64)
+    n_uniq = int(
+        lib.tp_text_valuestats(
+            buf, offsets, n, hist, hist.shape[0],
+            0 if clean_text else 1, 1,
+            uniq_buf, uniq_offsets, counts,
+        )
+    )
+    k = min(n_uniq, cap + 1)
+    raw = uniq_buf[: uniq_offsets[k]].tobytes().decode("ascii")
+    uniques = [
+        raw[uniq_offsets[u]:uniq_offsets[u + 1]] for u in range(k)
+    ]
+    return hist, uniques, counts[:k]
 
 
 def _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset):
@@ -382,6 +524,125 @@ def tree_predict_sum(
     lib.tp_tree_predict_sum(
         binned, n, num_f, sf, sb, lv, r, depth, width, lv.shape[1], out,
     )
+    return out
+
+
+def intern_tokens(
+    texts: list,
+    to_lowercase: bool = True,
+    min_token_length: int = 1,
+) -> tuple[np.ndarray, np.ndarray, list[str]] | None:
+    """Tokenize + intern ASCII row strings in ONE native pass: returns
+    ``(codes int32[T], row_offsets int64[len(texts)+1], vocab)`` where
+    ``vocab`` holds the unique (lowercased) tokens in first-occurrence
+    order — the only per-token Python strings ever built. None when the
+    native path can't take it (library missing/stale or non-ASCII rows) —
+    the caller partitions or falls back to the dict interner."""
+    lib = _require("tp_intern_tokens")
+    if lib is None:
+        return None
+    ct = _concat_tokens(texts)
+    if ct is None:  # non-ASCII rows present — caller partitions
+        return None
+    buf, offsets = ct
+    if not hasattr(lib, "tp_count_tokens"):
+        return None
+    cap = int(lib.tp_count_tokens(buf, offsets, len(texts), min_token_length))
+    codes = np.empty(max(cap, 1), dtype=np.int32)
+    row_offsets = np.zeros(len(texts) + 1, dtype=np.int64)
+    uniq_buf = np.empty(max(len(buf), 1), dtype=np.uint8)
+    uniq_offsets = np.zeros(max(cap, 1) + 1, dtype=np.int64)
+    n_uniq = int(
+        lib.tp_intern_tokens(
+            buf, offsets, len(texts), 1 if to_lowercase else 0,
+            min_token_length, codes, row_offsets, uniq_buf, uniq_offsets,
+            cap,
+        )
+    )
+    raw = uniq_buf[: uniq_offsets[n_uniq]].tobytes().decode("ascii")
+    vocab = [
+        raw[uniq_offsets[u]:uniq_offsets[u + 1]] for u in range(n_uniq)
+    ]
+    return codes[: row_offsets[-1]], row_offsets, vocab
+
+
+def intern_values(
+    values: list,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+    """Intern whole strings (byte-exact, any unicode): returns
+    ``(codes int32[n], first_rows int64[U], counts int64[U])`` — unique
+    value u IS ``values[first_rows[u]]``, so no string is ever rebuilt.
+    None when the native library is missing/stale OR any value is not a
+    str (interning is byte-keyed; a str() coercion would collapse e.g. 7
+    with "7") — callers fall back to the raw-keyed dict interner, which
+    has the exact historical per-value semantics. None entries are the
+    caller's to map out first."""
+    lib = _require("tp_intern_values")
+    if lib is None:
+        return None
+    n = len(values)
+    if n == 0:
+        z64 = np.zeros(0, dtype=np.int64)
+        return np.zeros(0, dtype=np.int32), z64, z64
+    try:
+        joined = "".join(values)
+    except TypeError:
+        return None  # non-str values present — dict fallback keys raw
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    if joined.isascii():
+        np.cumsum(np.fromiter(map(len, values), np.int64, n), out=offsets[1:])
+        buf = joined.encode("ascii")
+    else:
+        encoded = [v.encode("utf-8") for v in values]
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        buf = b"".join(encoded)
+    codes = np.empty(n, dtype=np.int32)
+    first_rows = np.empty(n, dtype=np.int64)
+    counts = np.empty(n, dtype=np.int64)
+    n_uniq = int(lib.tp_intern_values(buf, offsets, n, codes, first_rows, counts))
+    return codes, first_rows[:n_uniq], counts[:n_uniq]
+
+
+def code_bincount(
+    codes: np.ndarray,
+    row_offsets: np.ndarray,
+    code_to_col: np.ndarray,
+    out: np.ndarray,
+    binary: bool = False,
+    col_offset: int = 0,
+) -> np.ndarray:
+    """Scatter interned token codes into per-row bucket counts:
+    ``out[r, col_offset + code_to_col[codes[t]]] (+)= 1`` for row r's
+    tokens, skipping negative columns. ``out`` may be a wider float32
+    matrix (strided block write). Numpy fallback is exact."""
+    codes = np.ascontiguousarray(codes, dtype=np.int32)
+    row_offsets = np.ascontiguousarray(row_offsets, dtype=np.int64)
+    code_to_col = np.ascontiguousarray(code_to_col, dtype=np.int32)
+    n_rows = len(row_offsets) - 1
+    lib = _require("tp_code_bincount")
+    if (
+        lib is not None
+        and out.flags["C_CONTIGUOUS"]
+        and out.dtype == np.float32
+    ):
+        lib.tp_code_bincount(
+            codes, row_offsets, n_rows, code_to_col, 1 if binary else 0,
+            out, out.shape[1], col_offset,
+        )
+        return out
+    from .featurize import stats as _fstats
+
+    _fstats.stats().count_fallback("code_bincount")
+    cols = code_to_col[codes].astype(np.int64)
+    rows = np.repeat(
+        np.arange(n_rows, dtype=np.int64), np.diff(row_offsets)
+    )
+    keep = cols >= 0
+    rows, cols = rows[keep], cols[keep] + col_offset
+    if binary:
+        out[rows, cols] = 1.0
+    else:
+        np.add.at(out, (rows, cols), 1.0)
     return out
 
 

@@ -8,7 +8,7 @@ column-provenance metadata.
 """
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -64,6 +64,39 @@ def _assemble_values(blocks: Sequence[np.ndarray]) -> np.ndarray:
     return values
 
 
+def _vstack_values(parts: Sequence) -> "np.ndarray":
+    """Row-wise concat of chunk outputs (dense ndarray or SparseMatrix;
+    a mixed set degrades to sparse — values are preserved either way)."""
+    from ..types.columns import SparseMatrix
+
+    if len(parts) == 1:
+        return parts[0]
+    if any(isinstance(p, SparseMatrix) for p in parts):
+        rows_parts, cols_parts, vals_parts = [], [], []
+        any_vals = False
+        off = 0
+        width = parts[0].shape[1]
+        for p in parts:
+            if not isinstance(p, SparseMatrix):
+                p = SparseMatrix.from_dense(p)
+            rows_parts.append(p.rows.astype(np.int64) + off)
+            cols_parts.append(p.cols)
+            vals_parts.append(p.vals)
+            any_vals = any_vals or p.vals is not None
+            off += p.shape[0]
+        vals = None
+        if any_vals:
+            vals = np.concatenate([
+                v if v is not None else np.ones(len(r), dtype=np.float32)
+                for v, r in zip(vals_parts, rows_parts)
+            ])
+        return SparseMatrix(
+            np.concatenate(rows_parts).astype(np.int32),
+            np.concatenate(cols_parts), (off, width), vals,
+        )
+    return np.concatenate(parts, axis=0)
+
+
 class _CachedMetaVectorizer:
     """Mixin: column metadata is fit-static (it describes columns, not
     rows), but blocks_for re-derives it every call — ~30-40 ms of dataclass
@@ -73,22 +106,99 @@ class _CachedMetaVectorizer:
     The cache key is the per-block (width, meta-count) layout, not just
     the total width: a blocks_for whose metas shifted between calls while
     total width stayed constant would otherwise silently attach stale
-    metadata to scored vectors."""
+    metadata to scored vectors.
+
+    Execution rides the featurize plane (``transmogrifai_tpu.featurize``):
+    large batches split across the thread pool by row chunk (``blocks_for``
+    is row-pointwise by the vectorizer contract; native kernels release
+    the GIL), and when a fusion sink is active (``featurize.engine``) the
+    assembled values land directly in the stage's slice of the shared
+    ``[N, total_width]`` plane buffer instead of a private matrix."""
 
     _meta_cache: tuple | None = None  # (layout key, VectorMetadata)
 
+    def _blocks_chunked(self, cols, num_rows: int):
+        """blocks_for over row chunks on the featurize pool; single-chunk
+        batches fall through to one direct call."""
+        from ..featurize import parallel as _par
+
+        ranges = _par.chunk_ranges(num_rows)
+        if len(ranges) == 1:
+            return self.blocks_for(cols, num_rows)
+
+        def _task(span):
+            a, b = span
+            sub = [_par.slice_rows(c, a, b) for c in cols]
+            return self.blocks_for(sub, b - a)
+
+        parts = _par.run_tasks([lambda s=s: _task(s) for s in ranges])
+        blocks0, metas = parts[0]
+        blocks = [
+            _vstack_values([p[0][bi] for p in parts])
+            for bi in range(len(blocks0))
+        ]
+        return blocks, metas
+
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
-        blocks, metas = self.blocks_for(cols, num_rows)
+        import time as _time
+
+        from ..featurize import engine as _engine
+        from ..featurize import parallel as _par
+        from ..featurize import stats as _fstats
+
+        t0 = _time.perf_counter()
+        if (
+            _par.pool_enabled()
+            and num_rows >= 2 * _par.min_chunk_rows()
+            and _engine.current_sink(self.uid) is None
+        ):
+            blocks, metas = self._blocks_chunked(cols, num_rows)
+        else:
+            blocks, metas = self.blocks_for(cols, num_rows)
         layout = tuple(
             (b.shape[1], len(ms)) for b, ms in zip(blocks, metas)
         )
         cached = self._meta_cache
         if cached is not None and cached[0] == layout:
+            metadata = cached[1]
+        else:
+            parts = [
+                VectorMetadata(self.output_name, tuple(m)) for m in metas
+            ]
+            metadata = VectorMetadata.flatten(self.output_name, parts)
+            self._meta_cache = (layout, metadata)
+        sink = _engine.current_sink(self.uid)
+        if sink is not None and not any(
+            isinstance(b, _sparse_cls()) for b in blocks
+        ):
+            # fused assembly: blocks land in this stage's slice of the
+            # shared plane buffer; the combiner then returns the buffer
+            # wholesale instead of concatenating per-stage outputs
+            buf, off, width = sink
+            o = off
+            for b in blocks:
+                w = b.shape[1]
+                buf[:, o:o + w] = b
+                o += w
+            values: Any = buf[:, off:off + width]
+        else:
             values = _assemble_values(blocks)
-            return VectorColumn(OPVector, values, cached[1])
-        out = assemble_vector(self.output_name, blocks, metas)
-        self._meta_cache = (layout, out.metadata)
+        assert values.shape[1] == metadata.size, (
+            values.shape, metadata.size,
+        )
+        out = VectorColumn(OPVector, values, metadata)
+        _engine.note_output(self.uid, out)
+        nbytes = getattr(values, "nbytes", 0) or 0
+        _fstats.stats().record_stage(
+            self.operation_name, num_rows, _time.perf_counter() - t0, nbytes
+        )
         return out
+
+
+def _sparse_cls():
+    from ..types.columns import SparseMatrix
+
+    return SparseMatrix
 
 
 class VectorizerModel(_CachedMetaVectorizer, Model):

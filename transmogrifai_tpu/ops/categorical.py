@@ -61,21 +61,11 @@ def pivot_block(
     null_col = other_col + 1
     if not is_set:
         # categorical columns repeat a handful of distinct values over
-        # many rows: memoize raw → column so the per-row work is one dict
-        # hit (clean_string's regex per row was the pivot plane's hot
-        # loop), then scatter all rows in one fancy-indexed assignment
-        code_of: dict = {}
-        codes = np.empty(n, dtype=np.int64)
-        for r, raw in enumerate(values):
-            j = code_of.get(raw, -3)
-            if j == -3:
-                v = _clean(raw, clean_text)
-                if v is None:
-                    j = -1
-                else:
-                    j = index.get(v, -2)  # -2 = OTHER
-                code_of[raw] = j
-            codes[r] = j
+        # many rows: intern the raw values ONCE (native byte-exact pass),
+        # clean/resolve each DISTINCT value, then one vectorized gather +
+        # fancy-indexed scatter maps every row — zero per-row Python when
+        # the native interner is present
+        codes = _pivot_codes(values, index, clean_text)
         hit = codes >= 0
         out[np.nonzero(hit)[0], codes[hit]] = 1.0
         out[codes == -2, other_col] = 1.0
@@ -95,6 +85,46 @@ def pivot_block(
             else:
                 out[r, j] += 1.0
     return out
+
+
+def _pivot_codes(values: list, index: dict, clean_text: bool) -> np.ndarray:
+    """Per-row pivot code (-1 = null, -2 = OTHER, >=0 = vocab column) via
+    whole-value interning: cleaning and vocabulary lookup run once per
+    DISTINCT raw value."""
+    from ..featurize.interning import intern_values
+
+    n = len(values)
+    if n < 4096:
+        # serving-size batches: the memo-dict walk beats the native
+        # interning round trip (fixed call overhead) at small n. (Large
+        # batches with non-str values keep the same raw-keyed semantics:
+        # intern_values refuses non-str input and the dict interner
+        # inside featurize.interning keys raw values.)
+        code_of: dict = {}
+        codes = np.empty(n, dtype=np.int64)
+        for r, raw in enumerate(values):
+            j = code_of.get(raw, -3)
+            if j == -3:
+                v = _clean(raw, clean_text)
+                j = -1 if v is None else index.get(v, -2)
+                code_of[raw] = j
+            codes[r] = j
+        return codes
+    codes = np.full(n, -1, dtype=np.int64)
+    present = np.fromiter((v is not None for v in values), bool, n)
+    if not present.any():
+        return codes
+    if present.all():
+        texts = values if isinstance(values, list) else list(values)
+    else:
+        texts = [v for v in values if v is not None]
+    icodes, uniques, _ = intern_values(texts)
+    uniq_col = np.empty(len(uniques), dtype=np.int64)
+    for u, raw in enumerate(uniques):
+        v = _clean(raw, clean_text)
+        uniq_col[u] = -1 if v is None else index.get(v, -2)
+    codes[present] = uniq_col[icodes]
+    return codes
 
 
 def pivot_metas(
@@ -202,23 +232,32 @@ class OneHotVectorizer(VectorizerEstimator):
         }
 
     def fit_model(self, dataset: Dataset) -> OneHotModel:
+        from itertools import chain
+
+        from ..featurize.interning import intern_values
+
         vocabs = []
         for name in self.input_names:
             col = dataset[name]
-            counts: Counter = Counter()
             if isinstance(col, SetColumn):
-                for members in col.values:
-                    for m in members:
-                        m2 = _clean(m, self.clean_text)
-                        if m2 is not None:
-                            counts[m2] += 1
+                raw = [
+                    m for m in chain.from_iterable(col.values)
+                    if m is not None
+                ]
             elif isinstance(col, TextColumn):
-                for v in col.values:
-                    v2 = _clean(v, self.clean_text)
-                    if v2 is not None:
-                        counts[v2] += 1
+                raw = [v for v in col.values if v is not None]
             else:
                 raise TypeError(f"OneHotVectorizer cannot pivot {type(col).__name__}")
+            counts: Counter = Counter()
+            if raw:
+                # value counts via interning: clean_string runs once per
+                # DISTINCT raw value, not once per row (non-str members
+                # take interning's raw-keyed dict fallback)
+                _, uniques, ucounts = intern_values(raw)
+                for u, c in zip(uniques, ucounts):
+                    u2 = _clean(u, self.clean_text)
+                    if u2 is not None:
+                        counts[u2] += int(c)
             vocabs.append(top_values(counts, self.top_k, self.min_support))
         self.metadata["vocabs"] = vocabs
         return OneHotModel(vocabs, self.track_nulls, self.clean_text)

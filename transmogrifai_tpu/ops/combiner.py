@@ -38,6 +38,7 @@ class VectorsCombiner(Transformer):
         return out
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        from ..featurize import engine as _engine
         from ..types.columns import SparseMatrix
 
         vecs = []
@@ -52,6 +53,14 @@ class VectorsCombiner(Transformer):
                 if c.metadata is not None
                 else VectorMetadata("anon", ())
             )
+        fused = _engine.fused_result(self.uid, cols)
+        if fused is not None:
+            # every member stage wrote its slice of the shared plane
+            # buffer this batch — the concatenation already happened
+            metadata = self._flatten(metas)
+            if metadata.size != fused.shape[1]:
+                metadata = None
+            return VectorColumn(OPVector, fused, metadata)
         if any_sparse:
             # sparse inputs stay sparse end-to-end: the combined vector is
             # COO (dense sub-blocks carry their values via from_dense) —

@@ -170,12 +170,21 @@ class OpWord2Vec(Estimator):
         }
 
     def fit_model(self, dataset) -> "OpWord2VecModel":
+        from ..featurize.interning import interned_of
+
         col = dataset[self.input_names[0]]
         assert isinstance(col, ListColumn)
-        counts: dict[str, int] = {}
-        for row in col.values:
-            for t in row:
-                counts[t] = counts.get(t, 0) + 1
+        # token counts via interning: one bincount over the code array
+        tc = interned_of(col)
+        code_counts = (
+            np.bincount(tc.codes, minlength=len(tc.vocab))
+            if len(tc.vocab) else np.zeros(0, int)
+        )
+        # zero-count vocab entries (tokens an upstream stage filtered out
+        # of every row) never existed in the historical counts dict
+        counts = {
+            t: int(c) for t, c in zip(tc.vocab, code_counts) if c > 0
+        }
         vocab = [
             t for t, c in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
             if c >= self.min_count
@@ -183,8 +192,8 @@ class OpWord2Vec(Estimator):
         index = {t: i for i, t in enumerate(vocab)}
         pairs = []
         w = self.window_size
-        for row in col.values:
-            ids = [index[t] for t in row if t in index]
+        for toks in col.values:
+            ids = [index[t] for t in toks if t in index]
             for i, c in enumerate(ids):
                 for j in range(max(0, i - w), min(len(ids), i + w + 1)):
                     if j != i:
@@ -226,14 +235,30 @@ class OpWord2VecModel(Model):
         return cls(params["vocab"], arrays["vectors"])
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        from ..featurize import kernels as FK
+        from ..featurize.interning import interned_of
+
         col = cols[0]
         assert isinstance(col, ListColumn)
         dim = self.vectors.shape[1] if self.vectors.size else 0
-        values = np.zeros((num_rows, dim), dtype=np.float32)
-        for r, row in enumerate(col.values):
-            ids = [self._index[t] for t in row if t in self._index]
-            if ids:
-                values[r] = self.vectors[ids].mean(axis=0)
+        # interned feed: resolve each DISTINCT token against the learned
+        # vocabulary once, drop unknowns with one vectorized filter, then
+        # a segment mean over the CSR layout replaces the per-row loop
+        tc = interned_of(col)
+        idx = self._index
+        code_to_vec = np.fromiter(
+            (idx.get(t, -1) for t in tc.vocab), np.int64, len(tc.vocab)
+        )
+        if dim and tc.num_tokens:
+            mapped = code_to_vec[tc.codes]
+            keep = mapped >= 0
+            kept_cum = np.zeros(len(keep) + 1, dtype=np.int64)
+            np.cumsum(keep, out=kept_cum[1:])
+            values = FK.segment_mean_f32(
+                self.vectors, mapped[keep], kept_cum[tc.offsets]
+            )
+        else:
+            values = np.zeros((num_rows, dim), dtype=np.float32)
         f = self.input_features[0]
         metas = tuple(
             ColumnMeta(

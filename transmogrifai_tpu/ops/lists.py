@@ -20,7 +20,6 @@ import numpy as np
 from ..dataset import Dataset
 from ..stages.metadata import NULL_STRING, ColumnMeta
 from ..types.columns import Column, ListColumn
-from ..utils.text import hash_to_index
 from .base import VectorizerEstimator, VectorizerModel, VectorizerTransformer
 from .defaults import DEFAULTS
 
@@ -59,22 +58,24 @@ class TextListModel(VectorizerModel):
         }
 
     def blocks_for(self, cols: Sequence[Column], num_rows: int):
+        from ..featurize import kernels as FK
+        from ..featurize.interning import interned_of
+
         blocks, metas = [], []
         for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
-            n = num_rows
             width = self.num_terms + (1 if self.track_nulls else 0)
-            out = np.zeros((n, width), dtype=np.float32)
-            for r, terms in enumerate(col.to_list()):
-                if not terms:
-                    if self.track_nulls:
-                        out[r, self.num_terms] = 1.0
-                    continue
-                for t in terms:
-                    j = hash_to_index(str(t), self.num_terms, self.seed)
-                    if self.binary_freq:
-                        out[r, j] = 1.0
-                    else:
-                        out[r, j] += 1.0
+            # interned: each DISTINCT term hashes once, occurrences ride
+            # the code array through the native bincount scatter
+            tc = interned_of(col)
+            bucket_of = FK.hash_vocab(
+                [t if isinstance(t, str) else str(t) for t in tc.vocab],
+                self.num_terms, seed=self.seed,
+            )
+            out = FK.term_count_block(
+                tc, bucket_of, width, binary=self.binary_freq
+            )
+            if self.track_nulls:
+                out[tc.row_counts() == 0, self.num_terms] = 1.0
             if self.idf is not None:
                 out[:, : self.num_terms] *= np.asarray(self.idf[fi])[None, :]
             blocks.append(out)
@@ -126,16 +127,21 @@ class TextListVectorizer(VectorizerEstimator):
             # Spark IDF semantics: log((m + 1) / (df + 1)); df < minDocFreq -> 0
             idf = []
             m = dataset.num_rows
+            from ..featurize import kernels as FK
+            from ..featurize.interning import interned_of
+
             for name in self.input_names:
                 col = dataset[name]
-                df = np.zeros(self.num_terms, dtype=np.int64)
-                for terms in col.to_list():
-                    if not terms:
-                        continue
-                    seen = {hash_to_index(str(t), self.num_terms, self.seed)
-                            for t in terms}
-                    for j in seen:
-                        df[j] += 1
+                tc = interned_of(col)
+                bucket_of = FK.hash_vocab(
+                    [t if isinstance(t, str) else str(t) for t in tc.vocab],
+                    self.num_terms, seed=self.seed,
+                ).astype(np.int64)
+                # document frequency: one bincount over the distinct
+                # (row, bucket) pairs
+                df = FK.distinct_pair_bincount(
+                    tc.row_index(), bucket_of[tc.codes], self.num_terms
+                ).astype(np.int64)
                 w = np.log((m + 1.0) / (df + 1.0))
                 w[df < self.min_doc_freq] = 0.0
                 idf.append(w.tolist())

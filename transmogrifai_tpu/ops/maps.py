@@ -36,8 +36,18 @@ from .text import HASH, IGNORE, PIVOT, TextStats, decide_method, hash_block
 _MS_PER_DAY = 86_400_000.0
 
 
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=65536)
+def _clean_key_cached(k: str) -> str:
+    # map keys repeat on every row — the clean_string regex runs once per
+    # DISTINCT key per process instead of once per (row, key)
+    return clean_string(k)
+
+
 def _clean_key(k: str, clean_keys: bool) -> str:
-    return clean_string(k) if clean_keys else k
+    return _clean_key_cached(k) if clean_keys else k
 
 
 def learn_keys(col: MapColumn, clean_keys: bool) -> list[str]:
@@ -56,6 +66,44 @@ def map_rows(col: Column, clean_keys: bool) -> list[dict]:
     for m in col.to_list():
         out.append({_clean_key(k, clean_keys): v for k, v in (m or {}).items()})
     return out
+
+
+def map_key_values(
+    col: Column, clean_keys: bool, keys: list[str] | None = None
+) -> dict[str, list]:
+    """Per-key value columns in ONE pass over the rows — replaces the
+    ``map_rows`` + per-key ``[m.get(k) for m in rows]`` pattern (which
+    walked every row once per learned key). Later duplicate cleaned keys
+    win, matching ``map_rows``. With ``keys`` given, unlearned keys are
+    dropped; with ``keys=None`` the key set is DISCOVERED in the same
+    pass (the fit path: ``learn_keys`` + extraction fused — rows before a
+    key's first occurrence correctly read as missing)."""
+    n = len(col)
+    # one extraction pass per column per process phase: the fit walks the
+    # rows, then the transform over the SAME column reuses its pass (the
+    # cache lives on the column instance and dies with it)
+    cached = getattr(col, "_extract_cache", None)
+    if cached is not None and cached[0] == clean_keys:
+        full = cached[1]
+    else:
+        full = {}
+        cache = _clean_key_cached
+        for r, m in enumerate(col.values):
+            if m:
+                for k, v in m.items():
+                    if clean_keys:
+                        k = cache(k)
+                    lst = full.get(k)
+                    if lst is None:
+                        lst = full[k] = [None] * n
+                    lst[r] = v
+        try:
+            col._extract_cache = (clean_keys, full)
+        except Exception:  # pragma: no cover - exotic column type
+            pass
+    if keys is None:
+        return full
+    return {k: full.get(k) or [None] * n for k in keys}
 
 
 class RealMapModel(VectorizerModel):
@@ -83,20 +131,22 @@ class RealMapModel(VectorizerModel):
             keys, fills = self.keys[fi], self.fills[fi]
             per_key = 2 if self.track_nulls else 1
             out = np.zeros((num_rows, len(keys) * per_key), dtype=np.float32)
-            rows = map_rows(col, self.clean_keys)
-            # prefill every slot as missing, then override present entries
-            out[:, 0::per_key] = np.asarray(fills)[None, :]
-            if self.track_nulls:
-                out[:, 1::per_key] = 1.0
-            kidx = {k: j for j, k in enumerate(keys)}
-            for r, m in enumerate(rows):
-                for k, v in m.items():
-                    j = kidx.get(k)
-                    if j is None or v is None:
-                        continue
-                    out[r, j * per_key] = float(v)
-                    if self.track_nulls:
-                        out[r, j * per_key + 1] = 0.0
+            by_key = map_key_values(col, self.clean_keys, keys)
+            for j, (k, fill) in enumerate(zip(keys, fills)):
+                lst = by_key[k]
+                present = np.fromiter(
+                    (v is not None for v in lst), bool, num_rows
+                )
+                try:
+                    vals = np.asarray(lst, dtype=np.float64)  # None -> nan
+                except (TypeError, ValueError):
+                    vals = np.asarray(
+                        [np.nan if v is None else float(v) for v in lst],
+                        dtype=np.float64,
+                    )
+                out[:, j * per_key] = np.where(present, vals, fill)
+                if self.track_nulls:
+                    out[:, j * per_key + 1] = ~present
             metas_f: list[ColumnMeta] = []
             for k in keys:
                 metas_f.append(
@@ -294,11 +344,11 @@ class TextMapPivotModel(VectorizerModel):
     def blocks_for(self, cols: Sequence[Column], num_rows: int):
         blocks, metas = [], []
         for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
-            rows = map_rows(col, self.clean_keys)
+            by_key = map_key_values(col, self.clean_keys, self.keys[fi])
             parts, metas_f = [], []
             for ki, k in enumerate(self.keys[fi]):
                 vocab = self.vocabs[fi][ki]
-                values = [m.get(k) for m in rows]
+                values = by_key[k]
                 is_set = any(
                     isinstance(v, (set, frozenset, list, tuple)) for v in values
                 )
@@ -412,7 +462,7 @@ class SmartTextMapModel(VectorizerModel):
         slot = 0
         nulls = 1 if self.track_nulls else 0
         for fi, (col, feat) in enumerate(zip(cols, self.input_features)):
-            rows = map_rows(col, self.clean_keys)
+            by_key = map_key_values(col, self.clean_keys, self.keys[fi])
             widths = []
             for ki, k in enumerate(self.keys[fi]):
                 method = self.methods[fi][ki]
@@ -423,12 +473,15 @@ class SmartTextMapModel(VectorizerModel):
                 else:
                     widths.append(nulls)
             # wide hash keys assemble SPARSE (see SmartTextModel.blocks_for)
+            from .text import SPARSE_MIN_ROWS
+
             if (
                 any(m == HASH for m in self.methods[fi])
                 and self.num_hashes >= 64
+                and num_rows >= SPARSE_MIN_ROWS
             ):
                 sm = self._feature_sparse(
-                    fi, feat, rows, widths, num_rows, slot
+                    fi, feat, by_key, widths, num_rows, slot
                 )
                 if sm is not None:
                     block, metas_f = sm
@@ -444,7 +497,7 @@ class SmartTextMapModel(VectorizerModel):
             for ki, (k, width) in enumerate(zip(self.keys[fi], widths)):
                 method = self.methods[fi][ki]
                 values = [
-                    None if m.get(k) is None else str(m.get(k)) for m in rows
+                    None if v is None else str(v) for v in by_key[k]
                 ]
                 if method == PIVOT:
                     vocab = self.vocabs[fi][ki]
@@ -490,7 +543,7 @@ class SmartTextMapModel(VectorizerModel):
             metas.append(metas_f)
         return blocks, metas
 
-    def _feature_sparse(self, fi, feat, rows, widths, num_rows, slot0):
+    def _feature_sparse(self, fi, feat, by_key, widths, num_rows, slot0):
         """Sparse assembly of one map feature; None → dense fallback."""
         from ..types.columns import SparseMatrix
         from .text import hash_block_sparse
@@ -504,7 +557,7 @@ class SmartTextMapModel(VectorizerModel):
                 continue
             used_widths.append(width)
             values = [
-                None if m.get(k) is None else str(m.get(k)) for m in rows
+                None if v is None else str(v) for v in by_key[k]
             ]
             if method == PIVOT:
                 vocab = self.vocabs[fi][ki]
@@ -604,16 +657,22 @@ class SmartTextMapVectorizer(VectorizerEstimator):
         from .text import batch_text_stats
 
         all_keys, all_methods, all_vocabs, summaries = [], [], [], []
+        from ..featurize import parallel as _par
+
         for name in self.input_names:
             col = dataset[name]
-            keys = learn_keys(col, self.clean_keys)
-            rows = map_rows(col, self.clean_keys)
+            by_key = map_key_values(col, self.clean_keys)
+            keys = sorted(by_key)
             methods, vocabs = [], []
-            for k in keys:
-                stats = batch_text_stats(
-                    [m.get(k) for m in rows],
-                    self.max_cardinality, self.clean_text,
+            # per-key TextStats fan out across the pool (native passes
+            # release the GIL)
+            key_stats = _par.run_tasks([
+                lambda k=k: batch_text_stats(
+                    by_key[k], self.max_cardinality, self.clean_text,
                 )
+                for k in keys
+            ])
+            for k, stats in zip(keys, key_stats):
                 method = decide_method(
                     stats, self.max_cardinality, self.top_k, self.min_support,
                     self.coverage_pct, self.min_length_std_dev,

@@ -208,17 +208,31 @@ class TextLenTransformer(Transformer):
         super().__init__("textLen", uid=uid)
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        from ..featurize.interning import interned_of
+
         blocks = []
         metas = []
         for f, col in zip(self.input_features, cols):
             assert isinstance(col, (ListColumn, TextColumn))
             if isinstance(col, ListColumn):
-                lens = [
-                    float(sum(len(t) for t in row)) if row else 0.0
-                    for row in col.values
-                ]
+                # char count per DISTINCT token once, then one segment sum
+                # over the interned CSR layout
+                tc = interned_of(col)
+                vlen = np.fromiter(
+                    map(len, tc.vocab), np.float64, len(tc.vocab)
+                )
+                tok_lens = (
+                    vlen[tc.codes] if len(tc.vocab)
+                    else np.zeros(0, dtype=np.float64)
+                )
+                csum = np.zeros(len(tok_lens) + 1, dtype=np.float64)
+                np.cumsum(tok_lens, out=csum[1:])
+                lens = csum[tc.offsets[1:]] - csum[tc.offsets[:-1]]
             else:
-                lens = [float(len(v)) if v else 0.0 for v in col.values]
+                lens = np.fromiter(
+                    (float(len(v)) if v else 0.0 for v in col.values),
+                    np.float64, num_rows,
+                )
             blocks.append(np.asarray(lens, dtype=np.float32)[:, None])
             metas.append(
                 ColumnMeta(

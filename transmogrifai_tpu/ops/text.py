@@ -92,15 +92,23 @@ def batch_text_stats(
     exactly."""
     from itertools import islice
 
-    from ..native import clean_tokenstats
+    from ..native import clean_tokenstats, text_stats_pass
     from ..utils.text import clean_string, tokenize
 
     stats = TextStats.empty(cardinality_cap)
-    texts: list[str] = []
-    for v in values:
-        if v is not None:
-            texts.append(v if isinstance(v, str) else str(v))
+    texts, _ = _partition_nulls(values)
     if not texts:
+        return stats
+    # fused native pass: clean + token-length histogram + capped value
+    # counts without materializing ONE per-row Python string (the decode
+    # of 100k cleaned strings used to dominate the whole SmartText fit)
+    fused = text_stats_pass(texts, cardinality_cap, clean_text)
+    if fused is not None:
+        hist, uniques, counts = fused
+        for length, count in enumerate(hist):
+            if count:
+                stats.length_counts[length] += int(count)
+        stats.value_counts.update(dict(zip(uniques, map(int, counts))))
         return stats
     res = clean_tokenstats(texts)
     if res is not None:
@@ -144,6 +152,13 @@ def batch_text_stats(
 
 
 PIVOT, HASH, IGNORE = "Pivot", "Hash", "Ignore"
+
+#: batches below this row count assemble hash planes DENSE even at wide
+#: bucket counts — serving-size batches pay more for COO round trips (and
+#: the predictor densifies regardless) than for the dense scatter
+import os as _os
+
+SPARSE_MIN_ROWS = int(_os.environ.get("TPTPU_SPARSE_MIN_ROWS", "4096"))
 
 
 def decide_method(
@@ -203,19 +218,15 @@ def hash_block(
     # ASCII check is a single bulk isascii on the joined string). Only
     # when the column holds non-ASCII content does the per-row partition
     # run, keeping those rows on the exact-Unicode Python tokenizer.
-    texts: list[str] = []
-    rows_idx: list[int] = []
-    for r, raw in enumerate(values):
-        if raw is None:
-            if track_nulls:
-                out[r, null_col] = 1.0
-        else:
-            texts.append(raw if isinstance(raw, str) else str(raw))
-            rows_idx.append(r)
+    texts, rows_idx = _partition_nulls(values)
+    if track_nulls and len(rows_idx) < n:
+        null_rows = np.ones(n, dtype=bool)
+        null_rows[rows_idx] = False
+        out[null_rows, null_col] = 1.0
     slow_rows: list[tuple[int, str]] = []
     if texts:
         ok = tokenize_hash_scatter(
-            texts, np.asarray(rows_idx, dtype=np.int64),
+            texts, rows_idx,
             num_features, out, seed=seed, binary=binary_freq,
             to_lowercase=to_lowercase, min_token_length=min_token_length,
             prefix=prefix, col_offset=col_offset,
@@ -259,6 +270,29 @@ def hash_block(
     return out
 
 
+def _partition_nulls(values) -> tuple[list, np.ndarray]:
+    """(non-null texts, their int64 row indices) with the None scan done
+    by numpy's elementwise object compare instead of a Python row loop.
+    Non-str values are coerced like the historical per-row path."""
+    arr = (
+        values
+        if isinstance(values, np.ndarray) and values.dtype == object
+        else np.asarray(values, dtype=object)
+    )
+    present = arr != None  # noqa: E711 — elementwise over objects
+    if present is NotImplemented or not isinstance(present, np.ndarray):
+        present = np.fromiter((v is not None for v in arr), bool, len(arr))
+    if present.all():
+        rows_idx = np.arange(len(arr), dtype=np.int64)
+        texts = arr.tolist()
+    else:
+        rows_idx = np.nonzero(present)[0].astype(np.int64)
+        texts = arr[rows_idx].tolist()
+    if texts and not all(isinstance(t, str) for t in texts):
+        texts = [t if isinstance(t, str) else str(t) for t in texts]
+    return texts, rows_idx
+
+
 def hash_block_sparse(
     values: list,
     num_features: int,
@@ -277,19 +311,11 @@ def hash_block_sparse(
     from ..native import tokenize_hash_coo
     from ..types.columns import SparseMatrix
 
-    texts: list[str] = []
-    rows_idx: list[int] = []
-    none_rows: list[int] = []
-    for r, raw in enumerate(values):
-        if raw is None:
-            none_rows.append(r)
-        else:
-            texts.append(raw if isinstance(raw, str) else str(raw))
-            rows_idx.append(r)
+    texts, rows_idx = _partition_nulls(values)
     prefix = f"{feature_slot}_" if shared else ""
     if texts:
         coo = tokenize_hash_coo(
-            texts, np.asarray(rows_idx, dtype=np.int64), num_features,
+            texts, rows_idx, num_features,
             seed=seed, binary=binary_freq, to_lowercase=to_lowercase,
             min_token_length=min_token_length, prefix=prefix,
         )
@@ -300,8 +326,10 @@ def hash_block_sparse(
         rows = np.zeros(0, dtype=np.int32)
         cols = np.zeros(0, dtype=np.int32)
     width = num_features + (1 if track_nulls else 0)
-    if track_nulls and none_rows:
-        nr = np.asarray(none_rows, dtype=np.int32)
+    if track_nulls and len(rows_idx) < len(values):
+        null_rows = np.ones(len(values), dtype=bool)
+        null_rows[rows_idx] = False
+        nr = np.nonzero(null_rows)[0].astype(np.int32)
         rows = np.concatenate([rows, nr])
         cols = np.concatenate(
             [cols, np.full(len(nr), num_features, dtype=np.int32)]
@@ -390,8 +418,14 @@ class SmartTextModel(VectorizerModel):
         # pass): at 512 buckets the dense block is ~99.8% zeros and its
         # page-faulted writes dominate the whole text plane on
         # memory-bandwidth-poor hosts. Pivot/null sub-blocks are narrow —
-        # they ride along via from_dense.
-        if any(m == HASH for m in self.methods) and self.num_hashes >= 64:
+        # they ride along via from_dense. SMALL batches (the serving path)
+        # stay dense: the predictor densifies anyway, and a dense plane
+        # lets the fusion sink skip the combiner concat entirely.
+        if (
+            any(m == HASH for m in self.methods)
+            and self.num_hashes >= 64
+            and num_rows >= SPARSE_MIN_ROWS
+        ):
             sparse = self._blocks_sparse(cols, num_rows, widths, nulls)
             if sparse is not None:
                 return sparse
@@ -404,7 +438,9 @@ class SmartTextModel(VectorizerModel):
         for slot, (col, method, vocab, feat, width) in enumerate(
             zip(cols, self.methods, self.vocabs, self.input_features, widths)
         ):
-            values = col.to_list()
+            values = (
+                col.values if isinstance(col, TextColumn) else col.to_list()
+            )
             if method == PIVOT:
                 out[:, off:off + width] = pivot_block(
                     values, vocab, self.track_nulls, self.clean_text, False
@@ -455,7 +491,9 @@ class SmartTextModel(VectorizerModel):
             if width == 0:
                 continue
             used_widths.append(width)
-            values = col.to_list()
+            values = (
+                col.values if isinstance(col, TextColumn) else col.to_list()
+            )
             if method == PIVOT:
                 blocks.append(
                     pivot_block(
@@ -547,11 +585,20 @@ class SmartTextVectorizer(VectorizerEstimator):
         return batch_text_stats(col.values, self.max_cardinality, self.clean_text)
 
     def fit_model(self, dataset: Dataset) -> SmartTextModel:
+        from ..featurize import parallel as _par
+
         methods, vocabs, summaries = [], [], []
+        cols = []
         for name in self.input_names:
             col = dataset[name]
             assert isinstance(col, TextColumn), f"{name} is not a text column"
-            stats = self.compute_stats(col)
+            cols.append(col)
+        # per-column TextStats are independent — the native clean/intern
+        # passes release the GIL, so columns fan out across the pool
+        all_stats = _par.run_tasks(
+            [lambda c=c: self.compute_stats(c) for c in cols]
+        )
+        for name, stats in zip(self.input_names, all_stats):
             method = decide_method(
                 stats,
                 self.max_cardinality,

@@ -29,6 +29,7 @@ import base64
 import binascii
 import re
 from functools import lru_cache as _lru_cache
+from typing import Any
 
 import numpy as np
 
@@ -54,6 +55,12 @@ from ..types.columns import (
     NumericColumn,
     TextColumn,
     VectorColumn,
+)
+from ..featurize.interning import (
+    InternedTextList,
+    TokenCodes,
+    interned_of,
+    tokenize_text_column,
 )
 from ..utils.text import tokenize
 
@@ -108,13 +115,16 @@ class TextTokenizer(Transformer):
                 ) if v else []
                 for v in col.values
             ]
-        else:
-            out = [
-                tokenize(v, self.to_lowercase, self.min_token_length)
-                if v else []
-                for v in col.values
-            ]
-        return ListColumn(TextList, out)
+            return ListColumn(TextList, out)
+        # interned hot path: ONE native tokenize+intern pass over the
+        # column; downstream text stages consume the code arrays and the
+        # list-of-lists view only materializes if something asks for it
+        return InternedTextList(
+            TextList,
+            tokenize_text_column(
+                col.values, self.to_lowercase, self.min_token_length
+            ),
+        )
 
 
 class OpNGram(Transformer):
@@ -137,13 +147,34 @@ class OpNGram(Transformer):
         col = cols[0]
         assert isinstance(col, ListColumn)
         n = self.n
-        out = [
-            [" ".join(row[i : i + n]) for i in range(len(row) - n + 1)]
-            if row
-            else []
-            for row in col.values
-        ]
-        return ListColumn(TextList, out)
+        tc = interned_of(col)
+        if n == 1:  # 1-grams are the tokens themselves
+            return InternedTextList(TextList, tc)
+        counts = tc.row_counts()
+        out_counts = np.maximum(counts - (n - 1), 0)
+        offsets = np.zeros(tc.num_rows + 1, dtype=np.int64)
+        np.cumsum(out_counts, out=offsets[1:])
+        total = int(offsets[-1])
+        if total == 0:
+            return InternedTextList(
+                TextList, TokenCodes(np.zeros(0, np.int32), offsets, [])
+            )
+        # window start positions (global token index per emitted n-gram)
+        starts = (
+            np.arange(total, dtype=np.int64)
+            - np.repeat(offsets[:-1], out_counts)
+            + np.repeat(tc.offsets[:-1], out_counts)
+        )
+        windows = tc.codes[starts[:, None] + np.arange(n, dtype=np.int64)]
+        uniq, inverse = np.unique(windows, axis=0, return_inverse=True)
+        vocab_arr = tc.vocab_array()
+        ngram_vocab = [" ".join(vocab_arr[win]) for win in uniq]
+        return InternedTextList(
+            TextList,
+            TokenCodes(
+                inverse.astype(np.int32, copy=False), offsets, ngram_vocab
+            ),
+        )
 
 
 # Spark's StopWordsRemover english default list (org.apache.spark.ml.feature,
@@ -183,6 +214,10 @@ class OpStopWordsRemover(Transformer):
         self.stop_words = frozenset(stop_words)
         self.case_sensitive = case_sensitive
         self._lowered = frozenset(w.lower() for w in self.stop_words)
+        #: token -> is-stop-word, filled lazily: the case-insensitive path
+        #: lowercases each DISTINCT token at most once per process instead
+        #: of every token on every transform call
+        self._member_cache: dict[str, bool] = {}
 
     def get_params(self):
         return {
@@ -190,28 +225,40 @@ class OpStopWordsRemover(Transformer):
             "case_sensitive": self.case_sensitive,
         }
 
+    def _is_stop(self, token: str) -> bool:
+        if self.case_sensitive:
+            return token in self.stop_words
+        got = self._member_cache.get(token)
+        if got is None:
+            if len(self._member_cache) >= 65536:
+                # long-lived serving processes see unbounded distinct
+                # tokens — bound the memo instead of leaking
+                self._member_cache.clear()
+            got = self._member_cache[token] = token.lower() in self._lowered
+        return got
+
     def transform_columns(self, *cols: Column, num_rows: int) -> ListColumn:
         col = cols[0]
         assert isinstance(col, ListColumn)
-        if self.case_sensitive:
-            sw = self.stop_words
-            out = [[t for t in row if t not in sw] for row in col.values]
-        else:
-            sw = self._lowered
-            out = [[t for t in row if t.lower() not in sw] for row in col.values]
-        return ListColumn(TextList, out)
+        tc = interned_of(col)
+        # membership is decided once per DISTINCT token (a boolean mask
+        # over the batch vocabulary), then the drop is one vectorized
+        # filter over the code array
+        drop = np.fromiter(
+            (self._is_stop(t) for t in tc.vocab), bool, len(tc.vocab)
+        )
+        if not drop.any():
+            return InternedTextList(TextList, tc)
+        keep = ~drop[tc.codes]
+        kept_cum = np.zeros(len(keep) + 1, dtype=np.int64)
+        np.cumsum(keep, out=kept_cum[1:])
+        offsets = kept_cum[tc.offsets]
+        return InternedTextList(
+            TextList, TokenCodes(tc.codes[keep], offsets, tc.vocab)
+        )
 
 
-def _term_vector_column(
-    output_name: str, feature, vocab: list[str], rows: list[dict[str, float]]
-) -> VectorColumn:
-    values = np.zeros((len(rows), len(vocab)), dtype=np.float32)
-    index = {t: i for i, t in enumerate(vocab)}
-    for r, counts in enumerate(rows):
-        for t, c in counts.items():
-            j = index.get(t)
-            if j is not None:
-                values[r, j] = c
+def _term_vector_metas(output_name: str, feature, vocab: list[str]):
     metas = tuple(
         ColumnMeta(
             parent_names=(feature.name,),
@@ -222,7 +269,7 @@ def _term_vector_column(
         )
         for i, t in enumerate(vocab)
     )
-    return VectorColumn(OPVector, values, VectorMetadata(output_name, metas))
+    return VectorMetadata(output_name, metas)
 
 
 class OpCountVectorizer(Estimator):
@@ -254,18 +301,28 @@ class OpCountVectorizer(Estimator):
     def fit_model(self, dataset) -> "OpCountVectorizerModel":
         col = dataset[self.input_names[0]]
         assert isinstance(col, ListColumn)
-        df: dict[str, int] = {}
-        tf: dict[str, int] = {}
-        for row in col.values:
-            for t in set(row):
-                df[t] = df.get(t, 0) + 1
-            for t in row:
-                tf[t] = tf.get(t, 0) + 1
-        n = len(col.values)
+        # interned fit: term frequency is one bincount over the code
+        # array; document frequency one bincount over the distinct
+        # (row, code) pairs — no per-row/token dict churn
+        from ..featurize.kernels import distinct_pair_bincount
+
+        tc = interned_of(col)
+        nv = len(tc.vocab)
+        tf = np.bincount(tc.codes, minlength=nv) if nv else np.zeros(0, int)
+        if tc.num_tokens:
+            df = distinct_pair_bincount(tc.row_index(), tc.codes, nv)
+        else:
+            df = np.zeros(nv, dtype=np.int64)
+        n = len(col)
         min_docs = self.min_df if self.min_df >= 1 else self.min_df * n
-        terms = [t for t, d in df.items() if d >= min_docs]
+        # d > 0: the shared interned vocabulary can carry tokens an
+        # upstream stage filtered out of every row (e.g. stop words) —
+        # the historical per-row df dict never saw those, so min_df <= 0
+        # must not admit them
+        terms = [t for t, d in zip(tc.vocab, df) if d >= min_docs and d > 0]
         # highest total frequency first, ties lexicographic (stable vocab)
-        terms.sort(key=lambda t: (-tf[t], t))
+        tf_of = {t: int(c) for t, c in zip(tc.vocab, tf)}
+        terms.sort(key=lambda t: (-tf_of[t], t))
         vocab = terms[: self.vocab_size]
         self.metadata["vocabSize"] = len(vocab)
         return OpCountVectorizerModel(vocab, self.binary)
@@ -278,6 +335,7 @@ class OpCountVectorizerModel(Model):
         super().__init__("countVectorized", uid=uid)
         self.vocab = list(vocab)
         self.binary = binary
+        self._index = {t: i for i, t in enumerate(self.vocab)}
 
     def get_params(self):
         return {"vocab": self.vocab, "binary": self.binary}
@@ -287,18 +345,29 @@ class OpCountVectorizerModel(Model):
         return cls(params["vocab"], params.get("binary", False))
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        from ..featurize import kernels as FK
+
         col = cols[0]
         assert isinstance(col, ListColumn)
-        rows = []
-        for row in col.values:
-            counts: dict[str, float] = {}
-            for t in row:
-                counts[t] = counts.get(t, 0.0) + 1.0
-            if self.binary:
-                counts = {t: 1.0 for t in counts}
-            rows.append(counts)
-        return _term_vector_column(
-            self.output_name, self.input_features[0], self.vocab, rows
+        tc = interned_of(col)
+        code_to_col = FK.map_vocab(tc.vocab, self._index)
+        width = len(self.vocab)
+        if width > FK.dense_vocab_max():
+            # Spark-default vocab_size is 2^18: a dense [N, 2^18] float32
+            # transform allocates ~1 GB per 1k rows — wide vocabularies
+            # stay COO (the SparseMatrix path every assembler supports)
+            values: Any = FK.term_count_sparse(
+                tc, code_to_col, width, binary=self.binary
+            )
+        else:
+            values = FK.term_count_block(
+                tc, code_to_col, width, binary=self.binary
+            )
+        return VectorColumn(
+            OPVector, values,
+            _term_vector_metas(
+                self.output_name, self.input_features[0], self.vocab
+            ),
         )
 
 
@@ -323,21 +392,17 @@ class OpHashingTF(Transformer):
         return {"num_features": self.num_features, "binary": self.binary}
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
-        from ..native import murmur3_scatter
+        from ..featurize import kernels as FK
 
         col = cols[0]
         assert isinstance(col, ListColumn)
-        tokens: list[str] = []
-        rows: list[int] = []
-        for r, row in enumerate(col.values):
-            tokens.extend(row)
-            rows.extend([r] * len(row))
-        values = np.zeros((num_rows, self.num_features), dtype=np.float32)
-        if tokens:
-            murmur3_scatter(
-                tokens, np.asarray(rows, dtype=np.int64), num_rows,
-                self.num_features, binary=self.binary, out=values,
-            )
+        tc = interned_of(col)
+        # each DISTINCT term is murmur3-hashed once; occurrences ride the
+        # code array through the native bincount scatter
+        bucket_of = FK.hash_vocab(tc.vocab, self.num_features)
+        values = FK.term_count_block(
+            tc, bucket_of, self.num_features, binary=self.binary
+        )
         f = self.input_features[0]
         metas = tuple(
             ColumnMeta(
@@ -368,11 +433,24 @@ class OpIDF(Estimator):
         return {"min_doc_freq": self.min_doc_freq}
 
     def fit_model(self, dataset) -> "OpIDFModel":
+        from ..types.columns import SparseMatrix
+
         col = dataset[self.input_names[0]]
         assert isinstance(col, VectorColumn)
-        x = np.asarray(col.values)
-        df = (x > 0).sum(axis=0).astype(np.float64)
-        n = x.shape[0]
+        if isinstance(col.values, SparseMatrix):
+            # document frequency without densifying the wide term plane:
+            # one bincount over the distinct (row, term) pairs
+            from ..featurize.kernels import distinct_pair_bincount
+
+            sm = col.values
+            n, width = sm.shape
+            df = distinct_pair_bincount(
+                sm.rows, sm.cols, width
+            ).astype(np.float64)
+        else:
+            x = np.asarray(col.values)
+            df = (x > 0).sum(axis=0).astype(np.float64)
+            n = x.shape[0]
         idf = np.log((n + 1.0) / (df + 1.0))
         idf = np.where(df >= self.min_doc_freq, idf, 0.0)
         return OpIDFModel(idf)
@@ -393,8 +471,34 @@ class OpIDFModel(Model):
         return cls(arrays["idf"])
 
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
+        from ..types.columns import SparseMatrix
+
         col = cols[0]
         assert isinstance(col, VectorColumn)
+        if isinstance(col.values, SparseMatrix):
+            # keep the wide term plane COO: accumulate duplicate pairs into
+            # counts first so each nonzero is ONE float64 product rounded
+            # to float32 — bit-identical to the dense multiply
+            sm = col.values
+            n, width = sm.shape
+            flat = sm.rows.astype(np.int64) * width + sm.cols.astype(np.int64)
+            if sm.vals is None:
+                uniq, counts = np.unique(flat, return_counts=True)
+                weights = counts.astype(np.float64)
+            else:
+                order = np.argsort(flat, kind="stable")
+                uniq, starts = np.unique(flat[order], return_index=True)
+                weights = np.add.reduceat(
+                    sm.vals[order].astype(np.float64), starts
+                ) if len(uniq) else np.zeros(0)
+            rows_u = (uniq // width).astype(np.int32)
+            cols_u = (uniq % width).astype(np.int32)
+            vals = (weights * self.idf[uniq % width]).astype(np.float32)
+            return VectorColumn(
+                OPVector,
+                SparseMatrix(rows_u, cols_u, (n, width), vals),
+                col.metadata,
+            )
         values = (np.asarray(col.values) * self.idf[None, :]).astype(np.float32)
         return VectorColumn(OPVector, values, col.metadata)
 
@@ -445,21 +549,43 @@ class OpStringIndexerModel(Model):
         return cls(params["labels"], params.get("handle_invalid", "keep"))
 
     def transform_columns(self, *cols: Column, num_rows: int) -> NumericColumn:
+        from ..featurize.interning import intern_values
+
         col = cols[0]
         assert isinstance(col, TextColumn)
         unseen = float(len(self.labels))
-        vals = np.zeros(num_rows, dtype=np.float64)
+        # label columns repeat a handful of distinct values: intern once,
+        # resolve each DISTINCT value against the fitted index, then one
+        # vectorized gather maps every row (non-str values — possible on
+        # hand-built columns — take interning's raw-keyed dict fallback,
+        # preserving the historical per-row lookup semantics)
+        present = np.fromiter(
+            (v is not None for v in col.values), bool, num_rows
+        )
+        texts = [v for v in col.values if v is not None]
+        codes, uniques, _ = intern_values(texts)
+        uniq_idx = np.fromiter(
+            (
+                -1 if (j := self._index.get(u)) is None else j
+                for u in uniques
+            ),
+            np.int64, len(uniques),
+        )
+        mapped = np.full(num_rows, -1, dtype=np.int64)
+        if texts:
+            mapped[present] = uniq_idx[codes]
+        vals = mapped.astype(np.float64)
         mask = np.ones(num_rows, dtype=bool)
-        for i, v in enumerate(col.values):
-            j = self._index.get(v) if v is not None else None
-            if j is not None:
-                vals[i] = float(j)
-            elif self.handle_invalid == "keep":
-                vals[i] = unseen
+        miss = mapped < 0
+        if miss.any():
+            if self.handle_invalid == "keep":
+                vals[miss] = unseen
             elif self.handle_invalid == "skip":
-                mask[i] = False
+                vals[miss] = 0.0
+                mask[miss] = False
             else:
-                raise ValueError(f"Unseen label {v!r}")
+                bad = int(np.nonzero(miss)[0][0])
+                raise ValueError(f"Unseen label {col.values[bad]!r}")
         return NumericColumn(RealNN, vals, mask)
 
 

@@ -63,15 +63,14 @@ class TimePeriodTransformer(Transformer):
         return {"period": self.period}
 
     def transform_columns(self, *cols: Column, num_rows: int) -> NumericColumn:
+        from ..featurize.kernels import calendar_periods
+
         col = cols[0]
         assert isinstance(col, NumericColumn)
-        vals = np.array(
-            [
-                period_value(int(v), self.period) if m else 0
-                for v, m in zip(col.values, col.mask)
-            ],
-            dtype=np.int64,
+        vals = calendar_periods(
+            col.values.astype(np.int64, copy=False), self.period
         )
+        vals[~col.mask] = 0
         return NumericColumn(Integral, vals, col.mask.copy())
 
 
@@ -91,11 +90,23 @@ class TimePeriodListTransformer(Transformer):
         return {"period": self.period}
 
     def transform_columns(self, *cols: Column, num_rows: int) -> ListColumn:
+        from itertools import chain
+
+        from ..featurize.kernels import calendar_periods
+
         col = cols[0]
         assert isinstance(col, ListColumn)
+        rows = col.values
+        counts = np.fromiter(map(len, rows), np.int64, len(rows))
+        offsets = np.zeros(len(rows) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        flat = np.fromiter(
+            chain.from_iterable(rows), np.int64, int(offsets[-1])
+        )
+        periods = calendar_periods(flat, self.period)
         out = [
-            [period_value(int(v), self.period) for v in row] if row else []
-            for row in col.values
+            periods[offsets[r]:offsets[r + 1]].tolist()
+            for r in range(len(rows))
         ]
         return ListColumn(DateList, out)
 
@@ -116,12 +127,26 @@ class TimePeriodMapTransformer(Transformer):
         return {"period": self.period}
 
     def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        from itertools import chain
+
+        from ..featurize.kernels import calendar_periods
+
         col = cols[0]
         assert isinstance(col, MapColumn)
+        maps = col.values
+        counts = np.fromiter(map(len, maps), np.int64, len(maps))
+        offsets = np.zeros(len(maps) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        keys = list(chain.from_iterable(maps))
+        flat = np.fromiter(
+            (v for m in maps for v in m.values()), np.int64, int(offsets[-1])
+        )
+        periods = calendar_periods(flat, self.period).tolist()
         out = [
-            {k: period_value(int(v), self.period) for k, v in m.items()}
-            if m
-            else {}
-            for m in col.values
+            dict(zip(
+                keys[offsets[r]:offsets[r + 1]],
+                periods[offsets[r]:offsets[r + 1]],
+            ))
+            for r in range(len(maps))
         ]
         return MapColumn(IntegralMap, out)

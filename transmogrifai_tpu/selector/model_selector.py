@@ -279,11 +279,14 @@ class ModelSelector(PredictorEstimator):
 
     def fit_arrays(self, x, y, row_mask) -> SelectedModel:
         from ..compiler import stats as cstats
+        from ..featurize import stats as fstats
 
-        # compile-plane ledger for THIS selection (programs compiled /
-        # cache + dedup hits / warmup overlap) — the delta lands in the
-        # summary next to the retry and failover ledgers
+        # compile-plane and featurize-plane ledgers for THIS selection
+        # (programs compiled / cache + dedup hits / warmup overlap; rows
+        # featurized / pool utilization / fallback kernels) — the deltas
+        # land in the summary next to the retry and failover ledgers
         compile_baseline = cstats.snapshot()
+        featurize_baseline = fstats.snapshot()
         train_idx = np.nonzero(row_mask > 0)[0]
         xt, yt = x[train_idx], y[train_idx]
 
@@ -403,6 +406,7 @@ class ModelSelector(PredictorEstimator):
             "holdoutEvaluation": None,
             "splitterSummary": splitter_summary,
             "compileStats": cstats.delta(compile_baseline),
+            "featurizeStats": fstats.delta(featurize_baseline),
         }
         self.metadata["modelSelectorSummary"] = summary
         return SelectedModel(best_model, summary)

@@ -213,8 +213,13 @@ class Workflow:
         # program acquisition overlaps the reader/feature phases below
         # instead of serializing in front of the first fit dispatch
         from ..compiler import warmup as _warmup
+        from ..featurize import stats as _fstats
 
         _warmup.start_warmup(_warmup.train_programs(stages), scope="train")
+        # featurize-plane ledger for THIS train (rows/s per stage, pool
+        # utilization, interning + fallback-kernel counts) — the delta
+        # over the whole ingest lands in the selector summary
+        featurize_baseline = _fstats.snapshot()
         selectors = [s for s in stages if isinstance(s, ModelSelector)]
         if len(selectors) > 1:
             raise ValueError(
@@ -408,8 +413,14 @@ class Workflow:
             sel_stage = fitted.get(selector.uid)
             if isinstance(sel_stage, SelectedModel):
                 # failover counters ride the selector summary next to the
-                # PR-1 candidateAttempts ledger (same reporting convention)
+                # PR-1 candidateAttempts ledger (same reporting convention);
+                # the featurize ledger here covers the WHOLE train ingest
+                # (the delta captured inside fit_arrays only sees the
+                # selector's own array work)
                 sel_stage.summary["distributedResilience"] = dist_summary
+                sel_stage.summary["featurizeStats"] = _fstats.delta(
+                    featurize_baseline
+                )
 
         if selector is not None and holdout_data is not None:
             sel_model = fitted[selector.uid]
@@ -846,6 +857,31 @@ class WorkflowModel:
                 f"{comp.get('laneBucketPads', 0)} pad lane(s), "
                 f"{comp.get('warmupPrograms', 0)} warmed "
                 f"({comp.get('warmupOverlapSeconds', 0.0):.2f}s overlapped)"
+            )
+        feat = (sel or {}).get("featurizeStats") or {}
+        if feat.get("rowsFeaturized") or feat.get("poolTasks"):
+            util = feat.get("poolUtilization")
+            util_s = f", pool {util:.0%} util" if util is not None else ""
+            per_stage = feat.get("stageRowsPerSec") or {}
+            slow = min(
+                (
+                    (c.get("rowsPerSec"), name)
+                    for name, c in per_stage.items()
+                    if c.get("rowsPerSec")
+                ),
+                default=(None, ""),
+            )
+            top_s = (
+                f", bottleneck stage {slow[1]} @ {slow[0]:,} rows/s"
+                if slow[0] else ""
+            )
+            lines.append(
+                f"Featurize plane: {feat.get('rowsFeaturized', 0):,} "
+                f"row(s) through {feat.get('stagesExecuted', 0)} stage "
+                f"pass(es), {feat.get('fusedAssemblies', 0)} fused, "
+                f"{feat.get('poolTasks', 0)} pool task(s){util_s}, "
+                f"{feat.get('fallbackKernels', 0)} fallback kernel(s)"
+                f"{top_s}"
             )
         dist = getattr(self, "dist_summary", None) or {}
         if any(
