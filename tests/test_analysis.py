@@ -1,0 +1,456 @@
+"""Static-analysis plane (analysis/) — pre-flight DAG validation and the
+serving-plan auditor.
+
+The seeded bad-DAG corpus maps every known defect class to its expected
+TPA code; the good-DAG cases pin that legitimate flows (label-aware
+stages, label-derived result features, shrunk variable-arity wirings)
+stay clean. Marker: ``analysis`` (fast, pure graph walking — no fits
+except the two end-to-end audit tests).
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu import Dataset
+from transmogrifai_tpu.analysis import (
+    CODES,
+    Finding,
+    PreflightError,
+    Report,
+    Severity,
+    preflight,
+)
+from transmogrifai_tpu.features import FeatureBuilder, from_dataset
+from transmogrifai_tpu.features.feature import Feature
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.ops.numeric import RealVectorizer
+from transmogrifai_tpu.ops.text_stages import TextTokenizer
+from transmogrifai_tpu.prep import SanityChecker
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.workflow.dag import compute_dag, validate_stages
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+pytestmark = pytest.mark.analysis
+
+LR_MODELS = [(LogisticRegression(), {"reg_param": [0.01]})]
+
+
+# --------------------------------------------------------------- fixtures
+def _dataset(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return Dataset.of({
+        "label": column_from_values(T.RealNN, rng.integers(0, 2, n).tolist()),
+        "age": column_from_values(T.Real, rng.normal(40.0, 9.0, n).tolist()),
+        "city": column_from_values(
+            T.PickList, [["ankara", "bern", "cairo"][i % 3] for i in range(n)]
+        ),
+    })
+
+
+def _flow(ds):
+    """label/predictors + the standard transmogrify->check->select DAG."""
+    label, predictors = from_dataset(ds, response="label")
+    vec = transmogrify(predictors)
+    checked = label.sanity_check(vec, remove_bad_features=True)
+    pred = (
+        BinaryClassificationModelSelector(seed=7, models=LR_MODELS)
+        .set_input(label, checked)
+        .get_output()
+    )
+    return label, predictors, pred
+
+
+def _codes(report):
+    return sorted({f.code for f in report.findings})
+
+
+def _error_codes(report):
+    return sorted({f.code for f in report.errors()})
+
+
+# ------------------------------------------------------------ report core
+def test_finding_requires_registered_code():
+    with pytest.raises(ValueError, match="unregistered"):
+        Finding("TPZ999", "nope")
+
+
+def test_report_ordering_and_queries():
+    r = Report()
+    r.add("TPA001", "a", subject="s1")
+    r.add("TPX004", "b", severity=Severity.INFO)
+    r.add("TPL002", "c", severity=Severity.WARNING)
+    assert len(r) == 3 and not r.ok
+    assert [f.code for f in r.errors()] == ["TPA001"]
+    assert [f.code for f in r.warnings()] == ["TPL002"]
+    assert r.by_code("TPX004")[0].message == "b"
+    js = r.to_json()
+    assert js["errors"] == 1 and js["warnings"] == 1
+    assert "TPA001" in r.summary_line()
+
+
+def test_report_raise_if_errors_is_valueerror():
+    r = Report()
+    r.add("TPA009", "loop", subject="x")
+    with pytest.raises(PreflightError) as ei:
+        r.raise_if_errors()
+    assert isinstance(ei.value, ValueError)
+    assert "TPA009" in str(ei.value)
+    # clean reports pass through
+    assert Report().raise_if_errors().ok
+
+
+def test_all_emittable_codes_are_catalogued():
+    for code in CODES:
+        assert code[:3] in ("TPA", "TPX", "TPL")
+        assert CODES[code]
+
+
+# -------------------------------------------------- good DAGs stay clean
+def test_titanic_style_flow_validates_clean():
+    ds = _dataset()
+    _, _, pred = _flow(ds)
+    report = Workflow().set_result_features(pred).validate()
+    assert report.ok, report.pretty()
+    # the sanctioned label crossings must not trip the leakage check
+    assert not report.by_code("TPA003")
+
+
+def test_label_derived_result_feature_is_not_leakage():
+    # a result feature computed FROM the label is legitimate as long as it
+    # never feeds a predictor's feature input (score_columns parity tests
+    # rely on exactly this shape)
+    ds = _dataset()
+    label, predictors, pred = _flow(ds)
+    derived = (label + 1.0).alias("labelPlusOne")
+    report = Workflow().set_result_features(pred, derived).validate()
+    assert report.ok, report.pretty()
+
+
+def test_preflight_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="mode"):
+        preflight([], mode="banana")
+
+
+# ------------------------------------------------- seeded bad-DAG corpus
+def test_corpus_type_clash_tpa001():
+    age = FeatureBuilder.Real("age").as_predictor()
+    stage = TextTokenizer()  # wants Text
+    stage.input_features = (age,)  # bypass set_input's eager check
+    bad = stage.get_output()
+    report = preflight([bad])
+    assert "TPA001" in _error_codes(report)
+    f = report.by_code("TPA001")[0]
+    assert "age" in f.message and "Text" in f.message
+
+
+def test_corpus_arity_mismatch_tpa002():
+    age = FeatureBuilder.Real("age").as_predictor()
+    other = FeatureBuilder.Real("other").as_predictor()
+    stage = RealVectorizer()
+    stage.set_input(age, other)
+    out = stage.get_output()
+    checker = SanityChecker()  # wants exactly (label, vector)
+    checker.input_features = (out,)  # wrong arity, bypassing set_input
+    bad = checker.get_output()
+    report = preflight([bad])
+    assert "TPA002" in _error_codes(report)
+
+
+def test_corpus_leakage_tpa003():
+    ds = _dataset()
+    label, predictors = from_dataset(ds, response="label")
+    leaky = (label + predictors[0]).alias("leaky")
+    vec = transmogrify(list(predictors) + [leaky])
+    pred = (
+        BinaryClassificationModelSelector(seed=7, models=LR_MODELS)
+        .set_input(label, vec)
+        .get_output()
+    )
+    report = preflight([pred])
+    assert "TPA003" in _error_codes(report)
+    f = report.by_code("TPA003")[0]
+    assert "label" in str(f.detail.get("path"))
+    # and train() refuses it before touching any data
+    with pytest.raises(PreflightError, match="TPA003"):
+        Workflow().set_result_features(pred).set_input_dataset(ds).train()
+
+
+def test_corpus_duplicate_outputs_tpa004():
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    out1 = (a + 1.0).alias("same")
+    out2 = (b + 2.0).alias("same")
+    report = preflight([out1, out2])
+    assert "TPA004" in _error_codes(report)
+
+
+def test_corpus_duplicate_raw_names_tpa005():
+    a1 = FeatureBuilder.Real("dup").as_predictor()
+    a2 = FeatureBuilder.Real("dup").as_predictor()
+    report = preflight([(a1 + 1.0).alias("x"), (a2 + 2.0).alias("y")])
+    assert "TPA005" in _error_codes(report)
+
+
+def test_corpus_orphan_feature_tpa006():
+    orphan = Feature(name="ghost", ftype=T.Real)  # no origin stage
+    out = (orphan + 1.0).alias("derived")
+    report = preflight([out])
+    codes = [f.code for f in report.findings]
+    assert "TPA006" in codes
+    assert report.by_code("TPA006")[0].severity is Severity.WARNING
+
+
+def test_corpus_unwired_stage_tpa007():
+    stage = RealVectorizer()
+    feat = Feature(
+        name="dangling", ftype=T.OPVector, origin_stage=stage, parents=()
+    )
+    report = preflight([feat])
+    assert "TPA007" in _error_codes(report)
+
+
+def test_corpus_estimator_in_serving_plan_tpa008():
+    ds = _dataset()
+    label, predictors, pred = _flow(ds)
+    report = preflight([pred], mode="serve", fitted={})
+    assert "TPA008" in _error_codes(report)
+    # with every estimator fitted (simulated via a transformer stand-in),
+    # train mode accepts the same DAG
+    assert "TPA008" not in _codes(preflight([pred], mode="train"))
+
+
+def test_corpus_cycle_tpa009():
+    a = FeatureBuilder.Real("a").as_predictor()
+    f1 = (a + 1.0).alias("f1")
+    f2 = (f1 + 1.0).alias("f2")
+    # hand-wire the cycle: f1's stage now consumes f2
+    f1.origin_stage.input_features = (f2,)
+    report = preflight([f2])
+    assert "TPA009" in _error_codes(report)
+    # and it did NOT hang or blow the recursion limit getting there
+
+
+def test_corpus_duplicate_uid_tpa011():
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    s1 = RealVectorizer()
+    s2 = RealVectorizer()
+    s2.uid = s1.uid
+    out1 = s1.set_input(a).get_output()
+    out2 = s2.set_input(b).get_output()
+    report = preflight([out1, out2])
+    assert "TPA011" in _error_codes(report)
+
+
+def test_corpus_multiple_selectors_tpa013():
+    ds = _dataset()
+    label, predictors = from_dataset(ds, response="label")
+    vec = transmogrify(predictors)
+    p1 = (
+        BinaryClassificationModelSelector(seed=1, models=LR_MODELS)
+        .set_input(label, vec).get_output()
+    )
+    p2 = (
+        BinaryClassificationModelSelector(seed=2, models=LR_MODELS)
+        .set_input(label, vec).get_output()
+    )
+    report = preflight([p1, p2])
+    assert "TPA013" in _error_codes(report)
+    assert "Only one ModelSelector" in report.by_code("TPA013")[0].message
+
+
+# ------------------------------------------------ validate_stages satellite
+def test_validate_stages_names_offending_stage():
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    s1, s2 = RealVectorizer(), RealVectorizer()
+    s2.uid = s1.uid
+    out1 = s1.set_input(a).get_output()
+    out2 = s2.set_input(b).get_output()
+    layers = [[s1, s2]]
+    with pytest.raises(ValueError) as ei:
+        validate_stages(layers)
+    msg = str(ei.value)
+    assert "TPA011" in msg and s1.uid in msg
+
+
+def test_validate_stages_rejects_duplicate_output_names():
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    out1 = (a + 1.0).alias("same")
+    out2 = (b + 2.0).alias("same")
+    layers = compute_dag([out1, out2])
+    with pytest.raises(ValueError) as ei:
+        validate_stages(layers)
+    assert "TPA004" in str(ei.value) and "same" in str(ei.value)
+
+
+def test_validate_stages_accepts_good_dag():
+    ds = _dataset()
+    _, _, pred = _flow(ds)
+    validate_stages(compute_dag([pred]))  # no raise
+
+
+# ----------------------------------------------------- end-to-end + audit
+@pytest.fixture(scope="module")
+def trained():
+    ds = _dataset(n=160)
+    label, predictors, pred = _flow(ds)
+    model = (
+        Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    )
+    return ds, model
+
+
+def test_train_records_analysis_report(trained):
+    _, model = trained
+    js = model.summary_json()
+    assert js["analysis"] is not None
+    assert js["analysis"]["errors"] == 0
+
+
+def test_summary_pretty_reports_surviving_findings(trained):
+    _, model = trained
+    # a clean train prints no analysis line...
+    assert "Static analysis:" not in model.summary_pretty()
+    # ...but surviving warnings surface with their codes
+    model.analysis = {
+        "findings": [
+            {"code": "TPA006", "severity": "warning", "message": "m",
+             "subject": "ghost"},
+        ],
+        "errors": 0,
+        "warnings": 1,
+    }
+    pretty = model.summary_pretty()
+    assert "Static analysis: 0 error(s), 1 warning(s) (TPA006)" in pretty
+    model.analysis = {"findings": [], "errors": 0, "warnings": 0}
+
+
+def test_analysis_survives_save_load(trained, tmp_path):
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    _, model = trained
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    assert loaded.analysis == model.analysis
+    assert loaded.summary_json()["analysis"]["errors"] == 0
+
+
+def test_preflight_overhead_under_one_percent(trained):
+    # acceptance criterion: the pre-flight walk must cost < 1% of a
+    # flagship train. The flow above trains in seconds; 100 validate()
+    # passes must land well under that even on this 2-vCPU container.
+    import time
+
+    ds, model = trained
+    label, predictors, pred = _flow(_dataset())
+    wf = Workflow().set_result_features(pred)
+    wf.validate()  # warm the lazy imports
+    t0 = time.perf_counter()
+    for _ in range(100):
+        wf.validate()
+    per_pass = (time.perf_counter() - t0) / 100
+    assert per_pass < 0.05, f"preflight too slow: {per_pass:.4f}s/pass"
+
+
+def test_serving_audit_census_in_metadata(trained):
+    from transmogrifai_tpu.local.scoring import score_function
+
+    _, model = trained
+    fn = score_function(model)
+    fn.batch([{"age": 31.0, "city": "bern"}] * 4)
+    md = fn.metadata()
+    analysis = md["analysis"]
+    assert analysis is not None
+    census = analysis["transferCensus"]
+    assert census["batchBucketed"] is True
+    assert census["hostToDeviceTransfers"] == 1
+    assert census["deviceToHostTransfers"] == 1
+    fams = {e["family"] for e in census["stages"]}
+    assert {"vectorizer", "combiner", "predictor"} <= fams
+    # widths are learned after the first batch: every vectorizer proves
+    # its [N, width] and the predictor's upload bytes follow from them
+    vec_widths = [
+        e["width"] for e in census["stages"] if e["family"] == "vectorizer"
+    ]
+    assert all(isinstance(w, int) and w > 0 for w in vec_widths)
+    predictor = [
+        e for e in census["stages"] if e["family"] == "predictor"
+    ][0]
+    assert predictor["upBytesPerRow"] and predictor["upBytesPerRow"] > 0
+    # no TPX004 left once shapes are proven
+    assert not [
+        f for f in analysis["findings"] if f["code"] == "TPX004"
+    ]
+
+
+def test_audit_flags_unbucketed_plan(trained):
+    from transmogrifai_tpu.analysis.plan_audit import audit_serving_plan
+    from transmogrifai_tpu.stages.base import Estimator
+    from transmogrifai_tpu.workflow.dag import compute_dag as cd
+
+    _, model = trained
+    plan = []
+    for layer in cd(list(model.result_features)):
+        for stage in layer:
+            t = model.fitted.get(stage.uid, stage)
+            assert not isinstance(t, Estimator)
+            plan.append(t)
+    report = audit_serving_plan(
+        plan, list(model.raw_features),
+        [f.name for f in model.result_features], bucketed=False,
+    )
+    assert "TPX001" in {f.code for f in report.findings}
+
+
+def test_donation_misuse_detector():
+    from transmogrifai_tpu.analysis.plan_audit import donation_misuse
+
+    bad = (
+        "def f(buf, k):\n"
+        "    g = donating('p', kern, donate_argnums=(0,))\n"
+        "    out = g(buf, k)\n"
+        "    return out + buf\n"
+    )
+    report = donation_misuse(bad, "bad.py")
+    assert [f.code for f in report.findings] == ["TPX003"]
+
+    good = (
+        "def f(buf, k):\n"
+        "    g = donating('p', kern, donate_argnums=(0,))\n"
+        "    out, buf = g(buf, k)\n"
+        "    return out + buf\n"
+    )
+    assert not donation_misuse(good, "good.py").findings
+
+    # the aot_call form used by the gbdt boost chunks: donated arg rides
+    # the args tuple and is re-bound by the same statement
+    aot = (
+        "def f(binned, margin):\n"
+        "    g = donating('boost', kern, donate_argnums=(1,))\n"
+        "    trees, margin = aot_call('boost', g, (binned, margin), {})\n"
+        "    return trees, margin\n"
+    )
+    assert not donation_misuse(aot, "aot.py").findings
+
+    aot_bad = (
+        "def f(binned, margin):\n"
+        "    g = donating('boost', kern, donate_argnums=(1,))\n"
+        "    trees = aot_call('boost', g, (binned, margin), {})\n"
+        "    return trees, margin\n"
+    )
+    assert [f.code for f in donation_misuse(aot_bad, "x.py").findings] == [
+        "TPX003"
+    ]
+
+
+def test_gbdt_module_passes_donation_audit():
+    # the one real donating() call site in the repo must stay clean
+    from transmogrifai_tpu.analysis.plan_audit import donation_misuse_module
+
+    report = donation_misuse_module("transmogrifai_tpu.models.trees")
+    assert not report.findings, report.pretty()

@@ -1,0 +1,283 @@
+"""TPL invariant linter (analysis/lint.py + tools/tplint.py + the CLI
+`lint` mode) — every rule exercised on synthetic sources, the baseline
+gate semantics, and the committed repo baseline staying green."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from transmogrifai_tpu.analysis import lint as L
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(report):
+    return [f.code for f in report.findings]
+
+
+def _lint(src, rel):
+    return L.lint_source(textwrap.dedent(src), rel)
+
+
+# ------------------------------------------------------------------ TPL001
+def test_tpl001_unlocked_shared_write_flagged():
+    src = """
+    import threading
+    _CACHE = {}
+    _LOCK = threading.Lock()
+
+    def bad(key, value):
+        _CACHE[key] = value
+
+    def good(key, value):
+        with _LOCK:
+            _CACHE[key] = value
+
+    def good_mutator(key):
+        with _LOCK:
+            _CACHE.pop(key, None)
+
+    def bad_mutator(key):
+        _CACHE.pop(key, None)
+    """
+    report = _lint(src, "transmogrifai_tpu/featurize/x.py")
+    assert _codes(report) == ["TPL001", "TPL001"]
+    assert "bad" in report.findings[0].message
+
+
+def test_tpl001_scoped_to_threaded_subsystems():
+    src = """
+    _CACHE = {}
+
+    def anywhere(key, value):
+        _CACHE[key] = value
+    """
+    # the same pattern outside featurize//compiler//aot is not flagged
+    report = _lint(src, "transmogrifai_tpu/ops/x.py")
+    assert "TPL001" not in _codes(report)
+
+
+def test_tpl001_locals_not_flagged():
+    src = """
+    def fine(n):
+        cache = {}
+        cache[n] = 1
+        return cache
+    """
+    report = _lint(src, "transmogrifai_tpu/compiler/x.py")
+    assert not report.findings
+
+
+# ------------------------------------------------------------------ TPL002
+def test_tpl002_row_loops_in_ops_hot_paths():
+    src = """
+    class V:
+        def transform_columns(self, *cols, num_rows):
+            out = []
+            for i in range(num_rows):
+                out.append(i)
+            return out
+
+        def blocks_for(self, cols, num_rows):
+            return [v for v in cols[0].to_list()]
+
+        def fit_helper(self, col, num_rows):
+            # not a hot-path method name: allowed
+            return [v for v in col.to_list()]
+    """
+    report = _lint(src, "transmogrifai_tpu/ops/x.py")
+    assert _codes(report) == ["TPL002", "TPL002"]
+
+
+def test_tpl002_columnar_loops_allowed():
+    src = """
+    class V:
+        def transform_columns(self, *cols, num_rows):
+            blocks = []
+            for col in cols:  # per-COLUMN loop: fine
+                blocks.append(col.values)
+            return blocks
+    """
+    report = _lint(src, "transmogrifai_tpu/ops/x.py")
+    assert not report.findings
+
+
+# ------------------------------------------------------------------ TPL003
+def test_tpl003_jit_in_uncached_function():
+    src = """
+    import jax
+    from functools import lru_cache, partial
+
+    jitted = jax.jit(lambda x: x)  # module level: sanctioned
+
+    def bad(fn):
+        return jax.jit(fn)
+
+    @lru_cache(maxsize=None)
+    def cached(fn):
+        return jax.jit(fn)
+
+    @partial(jax.jit, static_argnames=())  # decorator at module level
+    def kernel(x):
+        return x
+    """
+    report = _lint(src, "transmogrifai_tpu/models/x.py")
+    assert _codes(report) == ["TPL003"]
+    assert "bad" in report.findings[0].message
+
+
+def test_tpl003_suppression_comment():
+    src = """
+    import jax
+
+    def special(fn):
+        return jax.jit(fn)  # tplint: disable=TPL003 — manually cached
+    """
+    report = _lint(src, "transmogrifai_tpu/models/x.py")
+    assert not report.findings
+
+
+# ------------------------------------------------------------------ TPL004
+def test_tpl004_wallclock_in_resilience():
+    src = """
+    import time
+
+    def bad():
+        return time.monotonic()
+
+    def also_bad():
+        time.sleep(0.1)
+
+    class C:
+        clock = time.monotonic  # injectable default (a REFERENCE): fine
+    """
+    report = _lint(src, "transmogrifai_tpu/resilience/x.py")
+    assert _codes(report) == ["TPL004", "TPL004"]
+
+
+def test_tpl004_only_in_resilience():
+    src = """
+    import time
+
+    def profiler():
+        return time.perf_counter()
+    """
+    report = _lint(src, "tools/profile_x.py")
+    assert "TPL004" not in _codes(report)
+
+
+# ------------------------------------------------------------------ TPL005
+def test_tpl005_unseeded_rng():
+    src = """
+    import random
+    import numpy as np
+
+    def bad_legacy():
+        return np.random.rand(3)
+
+    def bad_unseeded():
+        return np.random.default_rng()
+
+    def bad_stdlib():
+        return random.random()
+
+    def bad_unseeded_stdlib():
+        return random.Random()
+
+    def good():
+        rng = np.random.default_rng(42)
+        r = random.Random(7)
+        return rng, r
+    """
+    report = _lint(src, "tools/x.py")
+    assert _codes(report) == ["TPL005"] * 4
+
+
+# ---------------------------------------------------------------- baseline
+def test_baseline_roundtrip_and_gate(tmp_path):
+    src = """
+    import numpy as np
+
+    def f():
+        return np.random.rand()
+    """
+    report = _lint(src, "pkg/a.py")
+    assert len(report) == 1
+    # no baseline: everything is new
+    assert len(L.new_findings(report, None)) == 1
+    # write + load: the same finding is covered
+    bl_path = tmp_path / "bl.json"
+    bl_path.write_text(json.dumps(L.baseline_entries(report)))
+    baseline = L.load_baseline(str(bl_path))
+    assert L.new_findings(report, baseline) == []
+    # a SECOND occurrence of the same pattern on a new line is new
+    report2 = _lint(src + "\n\ndef g():\n    return np.random.rand()\n",
+                    "pkg/a.py")
+    fresh = L.new_findings(report2, baseline)
+    assert len(fresh) == 1
+
+
+def test_baseline_is_line_number_independent(tmp_path):
+    src = "import numpy as np\n\ndef f():\n    return np.random.rand()\n"
+    report = L.lint_source(src, "pkg/a.py")
+    bl_path = tmp_path / "bl.json"
+    bl_path.write_text(json.dumps(L.baseline_entries(report)))
+    moved = "import numpy as np\n\n\n\n\ndef f():\n    return np.random.rand()\n"
+    report2 = L.lint_source(moved, "pkg/a.py")
+    assert L.new_findings(report2, L.load_baseline(str(bl_path))) == []
+
+
+# ------------------------------------------------------- repo-level gates
+def test_repo_lint_is_green_against_committed_baseline():
+    report = L.lint_paths(
+        [os.path.join(REPO, "transmogrifai_tpu"), os.path.join(REPO, "tools")],
+        root=REPO,
+    )
+    baseline = L.load_baseline(os.path.join(REPO, "lint_baseline.json"))
+    fresh = L.new_findings(report, baseline)
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+
+
+def test_cli_lint_fails_on_synthetic_violation(tmp_path):
+    # the CI contract: a NEW violation introduced anywhere the lint job
+    # scans must flip the exit code even with the baseline supplied
+    bad = tmp_path / "transmogrifai_tpu" / "resilience"
+    bad.mkdir(parents=True)
+    (bad / "synthetic.py").write_text(
+        "import time\n\ndef f():\n    return time.time()\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu", "lint",
+         "--baseline", os.path.join(REPO, "lint_baseline.json"),
+         str(bad / "synthetic.py")],
+        capture_output=True, text=True, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TPL004" in proc.stdout
+
+
+def test_cli_lint_green_run(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu", "lint",
+         "--baseline", "lint_baseline.json"],
+        capture_output=True, text=True, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout
+
+
+def test_tplint_cli_wrapper(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tplint.py"),
+         "--baseline", "lint_baseline.json"],
+        capture_output=True, text=True, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,136 @@
+"""Property-based tests for the pre-flight DAG validator: random typed
+DAGs accepted by ``Workflow.validate()`` iff a reference oracle accepts.
+
+The generator wires a random chain of typed stages over a random raw
+feature pool, choosing per edge whether to draw a type-compatible or
+type-clashing input (bypassing ``set_input``'s eager check, the way a
+deserialized or hand-wired DAG could). The oracle tracks the ground truth
+independently of the analyser's traversal."""
+import pytest
+
+# hypothesis is an optional test dependency (installed in CI): skip this
+# module instead of failing collection when it is absent — the seeded
+# bad-DAG corpus in test_analysis.py always runs
+try:
+    from hypothesis import given, settings, strategies as st
+except ModuleNotFoundError:  # pragma: no cover
+    pytest.skip("hypothesis not installed", allow_module_level=True)
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.analysis.preflight import preflight
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.text_stages import (
+    OpIndexToString,
+    OpNGram,
+    OpStopWordsRemover,
+    TextTokenizer,
+)
+from transmogrifai_tpu.types import is_subtype
+from transmogrifai_tpu.utils import uid as uid_util
+
+pytestmark = pytest.mark.analysis
+
+SETTINGS = settings(max_examples=40, deadline=None)
+
+#: raw feature palette — names are made unique per draw index
+_RAW_TYPES = [T.Text, T.TextList, T.RealNN, T.Real, T.PickList]
+
+#: stage factories with their declared (unary) input type
+_STAGES = [
+    (lambda: TextTokenizer(), T.Text),
+    (lambda: OpStopWordsRemover(), T.TextList),
+    (lambda: OpNGram(), T.TextList),
+    (lambda: OpIndexToString(labels=["x", "y"]), T.RealNN),
+]
+
+
+def _build_dag(raw_type_idx, stage_plan):
+    """Build a DAG from drawn indices. Returns (result features, expected
+    bad-edge count) — the oracle. ``stage_plan`` is a list of
+    (stage_idx, source_idx, force_clash) triples; sources index into the
+    growing feature pool."""
+    uid_util.reset()
+    pool = []
+    for i, ti in enumerate(raw_type_idx):
+        ftype = _RAW_TYPES[ti % len(_RAW_TYPES)]
+        builder = getattr(FeatureBuilder, ftype.__name__, None)
+        if builder is None:
+            continue
+        pool.append(builder(f"raw{i}").as_predictor())
+    bad_edges = 0
+    outputs = []
+    for si, src_i, force_clash in stage_plan:
+        factory, required = _STAGES[si % len(_STAGES)]
+        compatible = [f for f in pool if is_subtype(f.ftype, required)]
+        clashing = [f for f in pool if not is_subtype(f.ftype, required)]
+        choose_from = clashing if (force_clash and clashing) else (
+            compatible or clashing
+        )
+        if not choose_from:
+            continue
+        src = choose_from[src_i % len(choose_from)]
+        stage = factory()
+        stage.input_features = (src,)  # bypass the eager check on purpose
+        out = stage.get_output()
+        if not is_subtype(src.ftype, required):
+            bad_edges += 1
+        pool.append(out)
+        outputs.append(out)
+    return outputs or pool[:1], bad_edges
+
+
+@SETTINGS
+@given(
+    raw_type_idx=st.lists(st.integers(0, 10), min_size=1, max_size=5),
+    stage_plan=st.lists(
+        st.tuples(
+            st.integers(0, 10), st.integers(0, 10), st.booleans()
+        ),
+        min_size=0, max_size=6,
+    ),
+)
+def test_validate_accepts_iff_oracle_accepts(raw_type_idx, stage_plan):
+    results, bad_edges = _build_dag(raw_type_idx, stage_plan)
+    report = preflight(results)
+    type_errors = report.by_code("TPA001")
+    if bad_edges:
+        assert not report.ok
+        assert len(type_errors) == bad_edges, report.pretty()
+    else:
+        assert report.ok, report.pretty()
+        assert not type_errors
+
+
+@SETTINGS
+@given(
+    n_chain=st.integers(1, 5),
+    cycle_at=st.integers(0, 4),
+)
+def test_any_hand_wired_cycle_is_detected(n_chain, cycle_at):
+    uid_util.reset()
+    base = FeatureBuilder.Real("r").as_predictor()
+    feats = [base]
+    for i in range(n_chain):
+        feats.append((feats[-1] + 1.0).alias(f"f{i}"))
+    # wire some earlier stage to consume the final output -> cycle
+    target = feats[min(cycle_at, n_chain - 1) + 1]
+    target.origin_stage.input_features = (feats[-1],)
+    if target is feats[-1]:
+        # self-loop: the stage consumes its own output
+        pass
+    report = preflight([feats[-1]])
+    assert report.by_code("TPA009"), report.pretty()
+    assert not report.ok
+
+
+@SETTINGS
+@given(name=st.text(
+    alphabet=st.characters(whitelist_categories=("Ll",)), min_size=1,
+    max_size=8,
+))
+def test_duplicate_raw_names_always_flagged(name):
+    uid_util.reset()
+    a = FeatureBuilder.Real(name).as_predictor()
+    b = FeatureBuilder.Real(name).as_predictor()
+    report = preflight([(a + 1.0).alias("x"), (b + 2.0).alias("y")])
+    assert report.by_code("TPA005")

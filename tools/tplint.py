@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""tplint — TP-coded invariant linter CLI (analysis/lint.py).
+
+Thin wrapper over `python -m transmogrifai_tpu lint` for direct use:
+
+    python tools/tplint.py                          # package + tools
+    python tools/tplint.py --baseline lint_baseline.json
+    python tools/tplint.py --write-baseline lint_baseline.json
+    python tools/tplint.py transmogrifai_tpu/ops    # specific paths
+
+Exit code 1 when findings exist that the baseline does not cover.
+Rules (TPL001..TPL005) and the suppression/baseline story are catalogued
+in docs/analysis.md.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from transmogrifai_tpu.cli import run_lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tplint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: transmogrifai_tpu/ and tools/)",
+    )
+    parser.add_argument("--baseline", default=None)
+    parser.add_argument("--write-baseline", default=None)
+    parser.add_argument(
+        "--root", default=".",
+        help="paths in findings/baseline are stored relative to this",
+    )
+    args = parser.parse_args(argv)
+    return run_lint(
+        args.paths, args.baseline, args.write_baseline, root=args.root
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

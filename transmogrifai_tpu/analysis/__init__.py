@@ -1,0 +1,32 @@
+"""Static-analysis plane: TP-coded findings over DAGs, plans and code.
+
+Three analysers share one :class:`Finding`/:class:`Report` core
+(``analysis/findings.py``):
+
+* :mod:`~transmogrifai_tpu.analysis.preflight` — ``TPA0xx`` pre-flight
+  DAG validation (``Workflow.validate()``; runs automatically at the top
+  of ``train()``), restoring the reference's compile-time feature-type
+  guarantees as an eager check.
+* :mod:`~transmogrifai_tpu.analysis.plan_audit` — ``TPX0xx`` serving-plan
+  audit: symbolic ``[N, width]`` shape propagation, the host↔device
+  transfer census, recompile-hazard and donation checks
+  (``score_fn.metadata()["analysis"]``).
+* :mod:`~transmogrifai_tpu.analysis.lint` — ``TPL0xx`` AST lint of the
+  package's own invariants (``python -m transmogrifai_tpu lint``, gated
+  in CI against the committed ``lint_baseline.json``).
+
+See ``docs/analysis.md`` for the full code catalogue.
+"""
+from .findings import CODES, Finding, PreflightError, Report, Severity  # noqa: F401
+from .plan_audit import audit_serving_plan  # noqa: F401
+from .preflight import preflight  # noqa: F401
+
+__all__ = [
+    "CODES",
+    "Finding",
+    "PreflightError",
+    "Report",
+    "Severity",
+    "audit_serving_plan",
+    "preflight",
+]

@@ -1,0 +1,191 @@
+"""Finding/Report core of the static-analysis plane.
+
+The reference's headline guarantee is *compile-time typed* feature
+pipelines: Scala's type system rejects an invalid DAG before Spark ever
+runs (SURVEY §1). A Python rebuild cannot lean on a compiler, so this
+package makes the same class of defect machine-checkable as an eager
+static pass: every rule emits a TP-coded :class:`Finding` through one
+shared :class:`Report`, whether it came from the pre-flight DAG validator
+(``TPA0xx``), the serving-plan auditor (``TPX0xx``) or the package linter
+(``TPL0xx``). One vocabulary, three analysers, one rendering.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Iterable, Iterator
+
+
+class Severity(enum.Enum):
+    ERROR = "error"      # refuses train()/CI — the DAG/plan/code is wrong
+    WARNING = "warning"  # suspicious but runnable; CI fails only on NEW ones
+    INFO = "info"        # census/ledger data riding the report
+
+    def __str__(self) -> str:  # noqa: D105
+        return self.value
+
+
+#: registry of every analyser code — docs/analysis.md catalogues these and
+#: the tests assert emitted findings use registered codes only.
+CODES: dict[str, str] = {
+    # ---- TPA: pre-flight DAG validation (analysis/preflight.py)
+    "TPA001": "stage input feature type incompatible with declared input_types",
+    "TPA002": "stage wired with the wrong number of input features",
+    "TPA003": "response lineage leaks into a predictor's feature input",
+    "TPA004": "duplicate output feature name across distinct stages",
+    "TPA005": "two distinct raw features share one name",
+    "TPA006": "orphan feature: no origin stage and not a declared raw leaf",
+    "TPA007": "stage has no input features wired",
+    "TPA008": "stateful stage used before fit (estimator in a serving plan)",
+    "TPA009": "cycle in the stage graph",
+    "TPA010": "layer inconsistency: stage scheduled before an ancestor",
+    "TPA011": "duplicate stage uid across distinct stage objects",
+    "TPA012": "stage is neither Estimator nor Transformer",
+    "TPA013": "more than one ModelSelector in the workflow",
+    # ---- TPX: serving-plan audit (analysis/plan_audit.py)
+    "TPX001": "device dispatch keyed on raw batch size (recompile hazard)",
+    "TPX002": "host stage sandwiched between device stages (transfer bounce)",
+    "TPX003": "donated buffer read again after a donating() dispatch",
+    "TPX004": "stage width unknown until the first batch (shapes unprovable)",
+    "TPX005": "lane bucketing disabled (TPTPU_LANE_BUCKETS=0)",
+    "TPX006": "fused plane assembly unavailable for this plan",
+    # ---- TPL: package invariant lint (analysis/lint.py)
+    "TPL000": "file does not parse — the linter cannot scan it",
+    "TPL001": "shared module-level state written without holding a lock",
+    "TPL002": "per-row Python loop in an ops/ columnar hot path",
+    "TPL003": "jax.jit built inside an uncached function (retrace hazard)",
+    "TPL004": "wall-clock call in resilience/ (inject the clock instead)",
+    "TPL005": "unseeded random source",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One TP-coded diagnostic.
+
+    ``subject`` names what the finding is about (a stage uid, feature name
+    or ``path:line``); ``detail`` carries structured context for JSON
+    surfaces (never required for rendering)."""
+
+    code: str
+    message: str
+    subject: str = ""
+    severity: Severity = Severity.ERROR
+    detail: dict[str, Any] = dataclasses.field(default_factory=dict, hash=False)
+
+    def __post_init__(self) -> None:
+        if self.code not in CODES:
+            raise ValueError(f"unregistered analyser code {self.code!r}")
+
+    def render(self) -> str:
+        where = f" [{self.subject}]" if self.subject else ""
+        return f"{self.code} {self.severity}: {self.message}{where}"
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "code": self.code,
+            "severity": str(self.severity),
+            "message": self.message,
+            "subject": self.subject,
+        }
+        if self.detail:
+            out["detail"] = self.detail
+        return out
+
+
+class PreflightError(ValueError):
+    """A pre-flight pass found errors. Subclasses ``ValueError``, matching
+    the historical ``validate_stages`` behaviour for wiring/uid errors
+    (the old stage-kind ``TypeError`` is subsumed: every finding class now
+    raises this one type); carries the full :class:`Report` for
+    programmatic access."""
+
+    def __init__(self, report: "Report"):
+        self.report = report
+        errors = report.errors()
+        lines = [f.render() for f in errors]
+        super().__init__(
+            f"static analysis found {len(errors)} error(s):\n  "
+            + "\n  ".join(lines)
+        )
+
+
+class Report:
+    """An ordered collection of findings plus analyser attachments
+    (``data`` — e.g. the plan auditor's transfer census)."""
+
+    def __init__(self, findings: Iterable[Finding] = ()) -> None:
+        self.findings: list[Finding] = list(findings)
+        self.data: dict[str, Any] = {}
+
+    # ------------------------------------------------------------ building
+    def add(
+        self,
+        code: str,
+        message: str,
+        subject: str = "",
+        severity: Severity = Severity.ERROR,
+        **detail: Any,
+    ) -> Finding:
+        f = Finding(code, message, subject, severity, detail)
+        self.findings.append(f)
+        return f
+
+    def extend(self, other: "Report") -> "Report":
+        self.findings.extend(other.findings)
+        self.data.update(other.data)
+        return self
+
+    # ------------------------------------------------------------- queries
+    def __iter__(self) -> Iterator[Finding]:
+        return iter(self.findings)
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity is Severity.ERROR]
+
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity is Severity.WARNING]
+
+    def by_code(self, code: str) -> list[Finding]:
+        return [f for f in self.findings if f.code == code]
+
+    @property
+    def ok(self) -> bool:
+        """True when no ERROR findings (warnings/info don't refuse)."""
+        return not self.errors()
+
+    def raise_if_errors(self) -> "Report":
+        if not self.ok:
+            raise PreflightError(self)
+        return self
+
+    # ----------------------------------------------------------- rendering
+    def pretty(self) -> str:
+        if not self.findings:
+            return "no findings"
+        return "\n".join(f.render() for f in self.findings)
+
+    def summary_line(self) -> str:
+        """One line for ``summary_pretty()``: counts + distinct codes."""
+        codes: dict[str, int] = {}
+        for f in self.findings:
+            codes[f.code] = codes.get(f.code, 0) + 1
+        code_s = ", ".join(
+            f"{c}×{n}" if n > 1 else c for c, n in sorted(codes.items())
+        )
+        return (
+            f"Static analysis: {len(self.errors())} error(s), "
+            f"{len(self.warnings())} warning(s)"
+            + (f" ({code_s})" if code_s else "")
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "findings": [f.to_json() for f in self.findings],
+            "errors": len(self.errors()),
+            "warnings": len(self.warnings()),
+            **self.data,
+        }

@@ -1,0 +1,479 @@
+"""TP-coded invariant linter (``TPL0xx``) — AST rules over this package.
+
+The repo polices its own concurrency and determinism invariants the same
+way the pre-flight pass polices user DAGs. Rules:
+
+* **TPL001** — module-level mutable state written without holding a lock,
+  in the thread-crossed subsystems (``featurize/``, ``compiler/``,
+  ``utils/aot.py``): the chunk-pool workers and the async warmup thread
+  share these modules with the main thread.
+* **TPL002** — per-row Python loops inside ``ops/`` columnar hot paths
+  (``transform_columns`` / ``blocks_for``): the PR-5 columnar engine
+  killed these; new ones silently re-open the 10-100x serving gap.
+* **TPL003** — ``jax.jit`` built inside a function that is not cache
+  decorated: a fresh jit per call retraces/recompiles every invocation
+  and bypasses the AOT executable bank (module-level jits are the
+  sanctioned pattern — ``aot_call`` wraps those).
+* **TPL004** — wall-clock calls (``time.time/monotonic/perf_counter/
+  sleep``) inside ``resilience/``: every component there takes an
+  injectable clock so the fault suite runs without sleeping; a literal
+  clock call dodges the injection seam.
+* **TPL005** — unseeded randomness anywhere (package and ``tools/``):
+  legacy ``np.random.*`` global-state calls, ``np.random.default_rng()``
+  with no seed, and the stdlib ``random`` module's global RNG.
+
+Suppression: ``# tplint: ok`` or ``# tplint: disable=TPL003`` on the
+offending line. Accepted legacy findings live in the committed
+``lint_baseline.json`` — CI (``python -m transmogrifai_tpu lint``) fails
+only on findings NOT in the baseline, so the bar ratchets.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from collections import Counter
+from typing import Any, Iterable
+
+from .findings import Finding, Report, Severity
+
+__all__ = [
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "new_findings",
+    "baseline_entries",
+]
+
+#: subsystems whose module globals are crossed by worker/warmup threads
+_LOCKED_SUBSYSTEMS = ("featurize/", "compiler/", "utils/aot.py")
+
+_MUTATORS = {
+    "append", "add", "update", "pop", "popitem", "setdefault", "clear",
+    "extend", "remove", "discard", "insert",
+}
+
+_WALLCLOCK = {"time", "monotonic", "perf_counter", "perf_counter_ns", "sleep"}
+
+_NP_LEGACY = {
+    "seed", "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "sample", "choice", "shuffle", "permutation", "uniform", "normal",
+    "standard_normal", "beta", "binomial", "poisson", "exponential",
+    "bytes",
+}
+
+_PY_RANDOM = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "uniform", "gauss", "sample", "betavariate", "expovariate",
+    "getrandbits", "triangular", "vonmisesvariate",
+}
+
+_CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+def _suppressed(line: str, code: str) -> bool:
+    if "tplint: ok" in line:
+        return True
+    return f"tplint: disable={code}" in line
+
+
+def _attr_chain(node: ast.expr) -> list[str]:
+    """['np', 'random', 'choice'] for np.random.choice — [] when not a
+    plain name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _is_cached(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        chain = _attr_chain(target)
+        if chain and chain[-1] in _CACHE_DECORATORS:
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# per-rule scanners
+# --------------------------------------------------------------------------
+def _module_mutable_globals(tree: ast.Module) -> set[str]:
+    """Module-level names bound to mutable containers (dict/list/set
+    literals or constructor calls)."""
+    names: set[str] = set()
+    for stmt in tree.body:
+        targets: list[ast.expr] = []
+        value: ast.expr | None = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                                     ast.DictComp, ast.SetComp))
+        if isinstance(value, ast.Call):
+            chain = _attr_chain(value.func)
+            if chain and chain[-1] in {
+                "dict", "list", "set", "defaultdict", "OrderedDict",
+                "deque", "Counter",
+            }:
+                mutable = True
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+def _lock_guarded(expr: ast.expr) -> bool:
+    chain = _attr_chain(expr)
+    if isinstance(expr, ast.Call):
+        chain = _attr_chain(expr.func)
+    return any("lock" in part.lower() for part in chain)
+
+
+class _SharedStateVisitor(ast.NodeVisitor):
+    """TPL001 — subscript writes / mutator calls on module globals
+    outside a ``with <lock>`` block."""
+
+    def __init__(
+        self,
+        globals_: set[str],
+        hits: list[tuple[int, str]],
+        root: ast.AST,
+    ):
+        self.globals = globals_
+        self.hits = hits
+        self.lock_depth = 0
+        self.root = root
+
+    def _visit_function(self, node: ast.AST) -> None:
+        # nested defs get their own pass from _scan_shared_state (and run
+        # outside any enclosing `with lock:` anyway) — descending here
+        # would report each of their hits twice
+        if node is self.root:
+            self.generic_visit(node)
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_With(self, node: ast.With) -> None:
+        guarded = any(_lock_guarded(i.context_expr) for i in node.items)
+        if guarded:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if guarded:
+            self.lock_depth -= 1
+
+    def _check_target(self, target: ast.expr, lineno: int) -> None:
+        if self.lock_depth:
+            return
+        if isinstance(target, ast.Subscript) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id in self.globals:
+            self.hits.append((lineno, target.value.id))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            not self.lock_depth
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self.globals
+        ):
+            self.hits.append((node.lineno, node.func.value.id))
+        self.generic_visit(node)
+
+
+def _scan_shared_state(tree: ast.Module, report_hits: list) -> None:
+    globals_ = _module_mutable_globals(tree)
+    if not globals_:
+        return
+    for fn in [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]:
+        hits: list[tuple[int, str]] = []
+        _SharedStateVisitor(globals_, hits, fn).visit(fn)
+        for lineno, name in hits:
+            report_hits.append((
+                "TPL001", lineno,
+                f"module global '{name}' mutated in {fn.name}() without "
+                "holding a lock (thread-crossed subsystem)",
+            ))
+
+
+def _is_row_iter(it: ast.expr) -> bool:
+    """range(num_rows) / X.to_list() / zip|enumerate over a .to_list()."""
+    if isinstance(it, ast.Call):
+        if isinstance(it.func, ast.Attribute) and it.func.attr == "to_list":
+            return True
+        chain = _attr_chain(it.func)
+        if chain == ["range"] and any(
+            isinstance(a, ast.Name) and a.id == "num_rows" for a in it.args
+        ):
+            return True
+        if chain and chain[-1] in ("zip", "enumerate"):
+            return any(_is_row_iter(a) for a in it.args)
+    return False
+
+
+def _scan_row_loops(tree: ast.Module, report_hits: list) -> None:
+    for fn in [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in ("transform_columns", "blocks_for")
+    ]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For) and _is_row_iter(node.iter):
+                report_hits.append((
+                    "TPL002", node.lineno,
+                    f"per-row Python loop in {fn.name}() — hot-path "
+                    "transforms must stay columnar (vectorize or use the "
+                    "native kernels)",
+                ))
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                       ast.GeneratorExp)
+            ) and any(_is_row_iter(g.iter) for g in node.generators):
+                report_hits.append((
+                    "TPL002", node.lineno,
+                    f"per-row comprehension in {fn.name}() — hot-path "
+                    "transforms must stay columnar (vectorize or use the "
+                    "native kernels)",
+                ))
+
+
+def _function_body_minus_nested(fn: ast.AST):
+    """Nodes of ``fn``'s body excluding nested function BODIES (their
+    decorators still belong to ``fn``'s execution)."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(getattr(fn, "body", ()))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _scan_naked_jit(tree: ast.Module, report_hits: list) -> None:
+    for fn in [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]:
+        if _is_cached(fn):
+            continue
+        for node in _function_body_minus_nested(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain[-2:] == ["jax", "jit"] or chain == ["jit"]:
+                report_hits.append((
+                    "TPL003", node.lineno,
+                    f"jax.jit built inside uncached {fn.name}() — a fresh "
+                    "jit per call retraces every invocation and bypasses "
+                    "the AOT executable bank (hoist to module level or "
+                    "lru_cache the factory)",
+                ))
+
+
+def _scan_wallclock(tree: ast.Module, report_hits: list) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if (
+            len(chain) == 2
+            and chain[1] in _WALLCLOCK
+            and chain[0] in ("time", "_time", "_t")
+        ):
+            report_hits.append((
+                "TPL004", node.lineno,
+                f"wall-clock call {'.'.join(chain)}() in resilience/ — "
+                "route through the component's injectable clock so the "
+                "fault suite stays deterministic",
+            ))
+
+
+def _scan_unseeded_rng(tree: ast.Module, report_hits: list) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if len(chain) == 3 and chain[:2] in (["np", "random"],
+                                             ["numpy", "random"]):
+            if chain[2] == "default_rng":
+                if not node.args and not node.keywords:
+                    report_hits.append((
+                        "TPL005", node.lineno,
+                        "np.random.default_rng() without a seed — results "
+                        "are irreproducible; pass an explicit seed",
+                    ))
+            elif chain[2] in _NP_LEGACY:
+                report_hits.append((
+                    "TPL005", node.lineno,
+                    f"legacy np.random.{chain[2]}() uses hidden global "
+                    "state — use np.random.default_rng(seed)",
+                ))
+        elif chain[:1] == ["random"] and len(chain) == 2:
+            if chain[1] == "Random":
+                if not node.args and not node.keywords:
+                    report_hits.append((
+                        "TPL005", node.lineno,
+                        "random.Random() without a seed — pass an explicit "
+                        "seed",
+                    ))
+            elif chain[1] in _PY_RANDOM:
+                report_hits.append((
+                    "TPL005", node.lineno,
+                    f"stdlib random.{chain[1]}() uses the global RNG — "
+                    "use a seeded random.Random(seed)",
+                ))
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+def lint_source(source: str, rel_path: str) -> Report:
+    """Lint one file's source. ``rel_path`` (posix, repo-relative) selects
+    which rules apply and keys the findings for the baseline."""
+    report = Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        report.add(
+            "TPL000",
+            f"file does not parse: {e}",
+            subject=f"{rel_path}:{e.lineno or 0}",
+            severity=Severity.WARNING,
+            path=rel_path, line=e.lineno or 0, context="",
+        )
+        return report
+    lines = source.splitlines()
+    hits: list[tuple[str, int, str]] = []
+
+    rel = rel_path.replace(os.sep, "/")
+    if any(seg in rel for seg in _LOCKED_SUBSYSTEMS):
+        _scan_shared_state(tree, hits)
+    if "/ops/" in rel or rel.startswith("ops/"):
+        _scan_row_loops(tree, hits)
+    if "/resilience/" in rel or rel.startswith("resilience/"):
+        _scan_wallclock(tree, hits)
+    _scan_naked_jit(tree, hits)
+    _scan_unseeded_rng(tree, hits)
+
+    for code, lineno, message in sorted(hits, key=lambda h: (h[1], h[0])):
+        context = lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
+        if _suppressed(context, code):
+            continue
+        report.add(
+            code, message,
+            subject=f"{rel}:{lineno}",
+            severity=Severity.WARNING,
+            path=rel, line=lineno, context=context,
+        )
+    return report
+
+
+def lint_paths(paths: Iterable[str], root: str = ".") -> Report:
+    """Lint every ``.py`` file under ``paths`` (files or directories).
+    Finding paths are stored relative to ``root`` so the committed
+    baseline is location-independent."""
+    report = Report()
+    files: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", "node_modules")
+            ]
+            files.extend(
+                os.path.join(dirpath, f)
+                for f in filenames
+                if f.endswith(".py")
+            )
+    for path in sorted(files):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        report.extend(lint_source(source, rel))
+    return report
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+def _finding_key(f: Finding) -> tuple[str, str, str]:
+    """Line-number-independent identity: (code, path, source context) —
+    renumbering a file does not invalidate the baseline, editing the
+    offending line does."""
+    d = f.detail
+    return (f.code, d.get("path", ""), d.get("context", ""))
+
+
+def baseline_entries(report: Report) -> dict[str, Any]:
+    """JSON-able baseline from a report (``--write-baseline``)."""
+    return {
+        "version": 1,
+        "findings": [
+            {
+                "code": f.code,
+                "path": f.detail.get("path", ""),
+                "context": f.detail.get("context", ""),
+            }
+            for f in report.findings
+        ],
+    }
+
+
+def load_baseline(path: str) -> Counter:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return Counter(
+        (e["code"], e["path"], e["context"]) for e in data.get("findings", [])
+    )
+
+
+def new_findings(report: Report, baseline: Counter | None) -> list[Finding]:
+    """Findings not covered by the baseline multiset: the CI gate."""
+    if not baseline:
+        return list(report.findings)
+    budget = Counter(baseline)
+    out: list[Finding] = []
+    for f in report.findings:
+        key = _finding_key(f)
+        if budget[key] > 0:
+            budget[key] -= 1
+        else:
+            out.append(f)
+    return out

@@ -1,0 +1,400 @@
+"""Serving-plan auditor — abstract interpretation of a fitted stage plan.
+
+Walks the flat, ordered plan that ``local/scoring.py`` builds (the same
+one the standing scorer of ROADMAP item 1 would pin) and propagates
+symbolic ``[N, width]`` shapes/dtypes through the stage families without
+executing anything:
+
+* **widths** come from each vectorizer's fit-static metadata cache (or
+  the :class:`~transmogrifai_tpu.featurize.engine.FusionPlanner`'s learned
+  widths) — a stage whose width cannot be proven yet is reported (TPX004),
+* **placement** classifies every stage host vs device in the steady-state
+  batch regime, yielding the per-stage host↔device **transfer census**
+  ROADMAP item 5 (single fused on-device scoring graph) needs: what
+  crosses the PCIe/ICI boundary per row today, and therefore what a fused
+  program would eliminate,
+* **recompile hazards**: device dispatch keyed on a raw (unbucketed)
+  batch dimension compiles one program per distinct batch size (TPX001);
+  lane-bucketing opt-out is surfaced (TPX005),
+* **donation misuse**: the modules behind the plan's device stages are
+  AST-scanned for a donated buffer being read again after a
+  ``donating()`` dispatch (TPX003) — the one bug class donation makes
+  possible.
+
+The census lands in ``report.data["transferCensus"]`` and is surfaced on
+``score_fn.metadata()["analysis"]``.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import os
+from typing import Any, Iterable, Sequence
+
+from .findings import Report, Severity
+
+__all__ = ["audit_serving_plan", "donation_misuse"]
+
+#: serving batches at or below this row count predict host-side in numpy
+#: (local/scoring.py reads the same env knob)
+_HOST_PREDICT_MAX = 16384
+
+
+def _width_of(stage, fusion=None) -> int | None:
+    """Fit-static output width of a vectorizer-ish stage, if provable:
+    the vectorizer metadata cache, the combiner's flatten cache, a
+    feature-removal stage's rewritten metadata, or the FusionPlanner's
+    learned widths — all populated without running the stage here."""
+    for attr in ("_meta_cache", "_flatten_cache"):
+        cached = getattr(stage, attr, None)
+        if cached is not None:
+            try:
+                return int(cached[1].size)
+            except Exception:
+                pass
+    new_meta = getattr(stage, "new_metadata", None)
+    if new_meta is not None:
+        try:
+            return int(new_meta.size)
+        except Exception:
+            pass
+    if fusion is not None:
+        w = getattr(fusion, "widths", {}).get(getattr(stage, "uid", None))
+        if w is not None:
+            return int(w)
+    return None
+
+
+def _classify(stage) -> str:
+    from ..models.base import PredictorModel
+    from ..ops.base import _CachedMetaVectorizer
+    from ..ops.combiner import VectorsCombiner
+
+    if isinstance(stage, PredictorModel):
+        return "predictor"
+    if isinstance(stage, VectorsCombiner):
+        return "combiner"
+    if isinstance(stage, _CachedMetaVectorizer):
+        return "vectorizer"
+    return "host"
+
+
+def audit_serving_plan(
+    plan: Sequence,
+    raw_features: Iterable,
+    result_names: Sequence[str],
+    fusion=None,
+    bucketed: bool = True,
+    host_predict_max: int | None = None,
+) -> Report:
+    """Audit an ordered fitted stage ``plan``. ``bucketed`` states whether
+    the caller pads batches onto power-of-two buckets before dispatch
+    (the serving closure does; raw ``WorkflowModel.score`` does not).
+    ``fusion`` is the plan's FusionPlanner, source of learned widths."""
+    report = Report()
+    cutoff = (
+        int(os.environ.get("TPTPU_HOST_PREDICT_MAX", str(_HOST_PREDICT_MAX)))
+        if host_predict_max is None
+        else host_predict_max
+    )
+
+    widths: dict[str, int | None] = {}
+    placement: dict[str, str] = {}  # output name -> "host" | "device"
+    census_stages: list[dict[str, Any]] = []
+    h2d = d2h = 0
+    up_bytes_per_row = down_bytes_per_row = 0.0
+    unknown_widths: list[str] = []
+
+    for f in raw_features:
+        placement[f.name] = "host"  # row codecs build columns host-side
+
+    for t in plan:
+        family = _classify(t)
+        out_name = t.output_name
+        width: int | None = None
+        if family == "predictor":
+            width = 1
+        else:
+            width = _width_of(t, fusion)
+            if width is None and family == "combiner":
+                member_ws = [widths.get(nm) for nm in t.input_names]
+                if all(w is not None for w in member_ws):
+                    width = int(sum(member_ws))  # type: ignore[arg-type]
+            if width is None and family in ("vectorizer", "combiner"):
+                unknown_widths.append(out_name)
+        widths[out_name] = width
+
+        device = family == "predictor"
+        placement[out_name] = "device" if device else "host"
+        entry: dict[str, Any] = {
+            "stage": t.operation_name,
+            "output": out_name,
+            "family": family,
+            "width": width,
+            "placement": placement[out_name],
+        }
+        if device:
+            in_name = t.input_names[-1] if t.input_names else None
+            in_w = widths.get(in_name)
+            up = None if in_w is None else in_w * 4  # f32 feature plane
+            # Prediction columns download as f64 (pred, prob, raw)
+            down = 8 * 3
+            entry.update(
+                {
+                    "input": in_name,
+                    "upBytesPerRow": up,
+                    "downBytesPerRow": down,
+                    "deviceWhenRowsAbove": cutoff,
+                }
+            )
+            h2d += 1
+            d2h += 1
+            up_bytes_per_row += up or 0.0
+            down_bytes_per_row += down
+        census_stages.append(entry)
+
+    # ---- transfer census (report attachment, not a finding)
+    report.data["transferCensus"] = {
+        "resultFeatures": [str(nm) for nm in result_names],
+        "stages": census_stages,
+        "hostToDeviceTransfers": h2d,
+        "deviceToHostTransfers": d2h,
+        "upBytesPerRow": up_bytes_per_row,
+        "downBytesPerRow": down_bytes_per_row,
+        "hostPredictCutoffRows": cutoff,
+        "batchBucketed": bool(bucketed),
+    }
+
+    # ---- TPX002: device -> host -> device bounce in plan order
+    device_stage_names = {
+        e["output"] for e in census_stages if e["placement"] == "device"
+    }
+    for t in plan:
+        if placement.get(t.output_name) != "host":
+            continue
+        feeds_device = any(
+            t.output_name in (u.input_names or ())
+            for u in plan
+            if placement.get(u.output_name) == "device"
+        )
+        from_device = any(
+            nm in device_stage_names for nm in (t.input_names or ())
+        )
+        if feeds_device and from_device:
+            report.add(
+                "TPX002",
+                f"host stage {t.operation_name!r} sits between two device "
+                "dispatches — its inputs download from device and its "
+                "output re-uploads every batch",
+                subject=t.output_name,
+                severity=Severity.WARNING,
+            )
+
+    # ---- TPX001: unbucketed batch-keyed device dispatch
+    if device_stage_names and not bucketed:
+        report.add(
+            "TPX001",
+            "device-dispatching stage(s) "
+            f"{sorted(device_stage_names)} receive the RAW batch dimension "
+            "— every distinct batch size compiles a fresh program; route "
+            "batches through the serving closure's power-of-two buckets",
+            subject=";".join(sorted(device_stage_names)),
+            severity=Severity.WARNING,
+        )
+
+    # ---- TPX004: widths not provable yet (fusion/audit learn on batch 1)
+    for nm in unknown_widths:
+        report.add(
+            "TPX004",
+            f"output '{nm}' has no fit-static width yet — shape "
+            "propagation resumes after the first scored batch",
+            subject=nm,
+            severity=Severity.INFO,
+        )
+
+    # ---- TPX005: lane bucketing opt-out (process-wide env)
+    if os.environ.get("TPTPU_LANE_BUCKETS", "1") == "0":
+        report.add(
+            "TPX005",
+            "TPTPU_LANE_BUCKETS=0: GLM sweep lane counts dispatch "
+            "unpadded — every distinct candidate count compiles its own "
+            "sweep program",
+            subject="env",
+            severity=Severity.INFO,
+        )
+
+    # ---- TPX006: fusion unavailable for this plane
+    if fusion is not None and getattr(fusion, "disabled", False):
+        report.add(
+            "TPX006",
+            "fused plane assembly is unavailable for this plan (no single "
+            "VectorsCombiner over dense sequence vectorizers) — the final "
+            "feature vector concatenates per-stage buffers each batch",
+            subject="plan",
+            severity=Severity.INFO,
+        )
+
+    # ---- TPX003: donated-buffer reuse in the modules behind the plan
+    modules = set()
+    for t in plan:
+        if _classify(t) == "predictor":
+            mod = type(t).__module__
+            if mod.startswith("transmogrifai_tpu"):
+                modules.add(mod)
+    for mod in sorted(modules):
+        report.extend(donation_misuse_module(mod))
+    return report
+
+
+# --------------------------------------------------------------------------
+# donation misuse (AST)
+# --------------------------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def donation_misuse_module(module_name: str) -> Report:
+    """AST-scan one imported module for donated-buffer reuse. Cached for
+    the process lifetime: module source is static, and ``metadata()``
+    (the polled monitoring surface) re-audits on every call."""
+    import importlib
+
+    try:
+        mod = importlib.import_module(module_name)
+        path = mod.__file__
+        with open(path) as f:
+            src = f.read()
+    except Exception:
+        return Report()
+    return donation_misuse(src, path or module_name)
+
+
+def donation_misuse(source: str, path: str = "<string>") -> Report:
+    """TPX003: inside one function, a variable passed at a donated
+    position of a ``donating(...)``-built callable (directly or through
+    ``aot_call``'s args tuple) must not be READ again unless re-bound at
+    or after the dispatch — donated buffers are consumed by XLA and may
+    alias the output."""
+    report = Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return report
+
+    for fn in [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]:
+        _scan_function(fn, path, report)
+    return report
+
+
+def _donate_argnums(call: ast.Call) -> tuple[int, ...] | None:
+    """The literal donate_argnums of a ``donating(...)`` call, if static."""
+    candidates: list[ast.expr] = []
+    if len(call.args) >= 3:
+        candidates.append(call.args[2])
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            candidates.append(kw.value)
+    for node in candidates:
+        try:
+            val = ast.literal_eval(node)
+        except Exception:
+            continue
+        if isinstance(val, int):
+            return (val,)
+        if isinstance(val, (tuple, list)):
+            return tuple(int(v) for v in val)
+    return None
+
+
+def _is_name_call(node: ast.expr, name: str) -> bool:
+    return (isinstance(node, ast.Name) and node.id == name) or (
+        isinstance(node, ast.Attribute) and node.attr == name
+    )
+
+
+def _scan_function(fn: ast.AST, path: str, report: Report) -> None:
+    donated_fns: dict[str, tuple[int, ...]] = {}
+    # events: (lineno, kind, name) — kind in {load, store}
+    events: list[tuple[int, str, str]] = []
+    # dispatches: (lineno, donated names, stored names at that statement)
+    dispatches: list[tuple[int, set[str], set[str]]] = []
+
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if _is_name_call(call.func, "donating"):
+                nums = _donate_argnums(call)
+                if nums is not None:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            donated_fns[tgt.id] = nums
+
+    if not donated_fns:
+        return
+
+    class _V(ast.NodeVisitor):
+        def visit_Name(self, node: ast.Name) -> None:
+            kind = "store" if isinstance(node.ctx, ast.Store) else "load"
+            events.append((node.lineno, kind, node.id))
+            self.generic_visit(node)
+
+        def visit_Call(self, node: ast.Call) -> None:
+            donated: set[str] = set()
+            fname = None
+            if isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname in donated_fns:
+                for i in donated_fns[fname]:
+                    if i < len(node.args) and isinstance(
+                        node.args[i], ast.Name
+                    ):
+                        donated.add(node.args[i].id)
+            elif fname == "aot_call" and len(node.args) >= 3:
+                jf = node.args[1]
+                jf_name = jf.id if isinstance(jf, ast.Name) else None
+                argtup = node.args[2]
+                if jf_name in donated_fns and isinstance(
+                    argtup, (ast.Tuple, ast.List)
+                ):
+                    for i in donated_fns[jf_name]:
+                        if i < len(argtup.elts) and isinstance(
+                            argtup.elts[i], ast.Name
+                        ):
+                            donated.add(argtup.elts[i].id)
+            if donated:
+                dispatches.append((node.lineno, donated, set()))
+            self.generic_visit(node)
+
+    _V().visit(fn)
+
+    # a Store on the dispatch line (the `x, buf = f(buf, ...)` rebind)
+    # re-defines the name from that statement on
+    for lineno, donated, stored in dispatches:
+        for ev_line, kind, name in events:
+            if kind == "store" and name in donated and ev_line >= lineno:
+                stored.add(name)
+
+    for lineno, donated, stored in dispatches:
+        for name in sorted(donated):
+            later_store = [
+                e for e in events
+                if e[1] == "store" and e[2] == name and e[0] >= lineno
+            ]
+            later_loads = [
+                e for e in events
+                if e[1] == "load" and e[2] == name and e[0] > lineno
+            ]
+            for load_line, _, _ in later_loads:
+                # a store at/before the load (and at/after the dispatch)
+                # re-binds the name — the load sees the NEW buffer
+                if any(lineno <= s[0] <= load_line for s in later_store):
+                    continue
+                report.add(
+                    "TPX003",
+                    f"'{name}' is read at line {load_line} after being "
+                    f"donated to a dispatch at line {lineno} — donated "
+                    "buffers are consumed and may alias the output",
+                    subject=f"{path}:{load_line}",
+                    severity=Severity.WARNING,
+                )
+                break  # one finding per donated name per dispatch

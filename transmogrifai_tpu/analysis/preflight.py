@@ -1,0 +1,435 @@
+"""Pre-flight DAG validation — the compile-time feature-type check, eagerly.
+
+Reference guarantee being restored: TransmogrifAI's 45 typed feature
+wrappers make an invalid stage wiring *unrepresentable* — the Scala
+compiler rejects it (SURVEY §1). Here the same rules run as a static pass
+over the lineage-traced feature DAG, BEFORE any data is read:
+
+* per-edge feature-type compatibility against each stage's declared
+  ``input_types`` (TPA001/TPA002),
+* response-lineage leakage — a predictor whose feature input can reach a
+  raw response through anything but a sanctioned label slot (TPA003),
+* duplicate/orphan outputs, duplicate raw names and stage uids
+  (TPA004/TPA005/TPA006/TPA011),
+* stateful-stage-before-fit contract for serving plans (TPA008),
+* cycle and layer-consistency checks over ``compute_dag`` (TPA009/TPA010),
+  subsuming the thin historical ``validate_stages``.
+
+Entry points: :func:`preflight` (used by ``Workflow.validate()`` and run
+automatically at the top of ``Workflow.train()``) and
+:func:`structural_findings` (the layer-shaped subset behind
+``workflow.dag.validate_stages``). The pass is pure graph walking — on the
+flagship titanic flow it costs well under a millisecond, irrelevant next
+to ``train()``.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from ..features.feature import Feature, FeatureGeneratorStage
+from ..stages.base import Estimator, PipelineStage, Transformer
+from .findings import Report, Severity
+
+__all__ = ["preflight", "structural_findings"]
+
+
+# --------------------------------------------------------------------------
+# cycle-safe graph collection
+# --------------------------------------------------------------------------
+def _live_inputs(stage: PipelineStage) -> tuple[Feature, ...]:
+    return tuple(getattr(stage, "input_features", ()) or ())
+
+
+def _collect(
+    result_features: Iterable[Feature],
+) -> tuple[list[PipelineStage], list[Feature], list[list[PipelineStage]]]:
+    """(stages, leaf features, cycles) reachable from the result features.
+
+    Unlike ``Feature.parent_stages`` this walk is cycle-SAFE: a hand-wired
+    loop is reported as a finding instead of blowing the recursion limit
+    deep inside ``train()``. Leaves are features with a generator origin or
+    no origin at all."""
+    stages: list[PipelineStage] = []
+    seen_stages: set[int] = set()
+    leaves: list[Feature] = []
+    seen_leaves: set[int] = set()
+    cycles: list[list[PipelineStage]] = []
+
+    # iterative DFS over stages with colouring for cycle detection
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: dict[int, int] = {}
+
+    def visit_feature(f: Feature, path: list[PipelineStage]) -> None:
+        stage = f.origin_stage
+        if stage is None or isinstance(stage, FeatureGeneratorStage):
+            if id(f) not in seen_leaves:
+                seen_leaves.add(id(f))
+                leaves.append(f)
+            return
+        visit_stage(stage, path)
+
+    def visit_stage(s: PipelineStage, path: list[PipelineStage]) -> None:
+        c = color.get(id(s), 0)
+        if c == BLACK:
+            return
+        if c == GRAY:
+            # cycle: slice the current path from the first occurrence of s
+            try:
+                i = next(j for j, p in enumerate(path) if p is s)
+            except StopIteration:
+                i = 0
+            cycles.append(path[i:] + [s])
+            return
+        color[id(s)] = GRAY
+        path.append(s)
+        for f in _live_inputs(s):
+            visit_feature(f, path)
+        path.pop()
+        color[id(s)] = BLACK
+        if id(s) not in seen_stages:
+            seen_stages.add(id(s))
+            stages.append(s)
+
+    for rf in result_features:
+        visit_feature(rf, [])
+    return stages, leaves, cycles
+
+
+# --------------------------------------------------------------------------
+# individual checks
+# --------------------------------------------------------------------------
+def _check_wiring(stages: Sequence[PipelineStage], report: Report) -> None:
+    """TPA001/TPA002/TPA007/TPA012 — the per-stage edge checks."""
+    from ..types import is_subtype
+
+    for s in stages:
+        if not isinstance(s, (Estimator, Transformer)):
+            report.add(
+                "TPA012",
+                f"stage {s!r} is neither Estimator nor Transformer",
+                subject=getattr(s, "uid", repr(s)),
+            )
+            continue
+        inputs = _live_inputs(s)
+        if not inputs:
+            report.add(
+                "TPA007",
+                f"stage {s!r} has no input features wired",
+                subject=s.uid,
+            )
+            continue
+        declared = s.input_types
+        if declared is None:
+            continue
+        if len(inputs) != len(declared):
+            report.add(
+                "TPA002",
+                f"stage {s!r} expects {len(declared)} input(s) "
+                f"{tuple(t.__name__ for t in declared)}, got {len(inputs)} "
+                f"({', '.join(f.name for f in inputs)})",
+                subject=s.uid,
+                expected=len(declared),
+                got=len(inputs),
+            )
+            continue
+        for i, (f, expected) in enumerate(zip(inputs, declared)):
+            if not is_subtype(f.ftype, expected):
+                report.add(
+                    "TPA001",
+                    f"stage {s!r} input {i} ('{f.name}') has type "
+                    f"{f.ftype.__name__}, expected {expected.__name__}",
+                    subject=s.uid,
+                    feature=f.name,
+                    position=i,
+                    actual=f.ftype.__name__,
+                    expected=expected.__name__,
+                )
+
+
+def _check_uids_and_outputs(
+    stages: Sequence[PipelineStage],
+    leaves: Sequence[Feature],
+    report: Report,
+) -> None:
+    """TPA011 (uid collisions), TPA004 (output-name collisions incl. raw
+    names — with_column would silently overwrite), TPA005 (raw-name
+    collisions), TPA006 (origin-less features)."""
+    by_uid: dict[str, PipelineStage] = {}
+    for s in stages:
+        prior = by_uid.get(s.uid)
+        if prior is not None and prior is not s:
+            report.add(
+                "TPA011",
+                f"duplicate stage uid '{s.uid}' on distinct stages "
+                f"{type(prior).__name__} and {type(s).__name__}",
+                subject=s.uid,
+            )
+        by_uid[s.uid] = s
+
+    raw_by_name: dict[str, Feature] = {}
+    for f in leaves:
+        prior = raw_by_name.get(f.name)
+        if prior is not None and prior.uid != f.uid:
+            report.add(
+                "TPA005",
+                f"two distinct raw features named '{f.name}' in one DAG — "
+                "they would silently read each other's data",
+                subject=f.name,
+            )
+        raw_by_name.setdefault(f.name, f)
+        if f.origin_stage is None:
+            report.add(
+                "TPA006",
+                f"feature '{f.name}' has no origin stage; it will be read "
+                "by name from the input data — declare it via "
+                "FeatureBuilder so its extraction is part of the DAG",
+                subject=f.name,
+                severity=Severity.WARNING,
+            )
+
+    out_by_name: dict[str, PipelineStage] = {}
+    for s in stages:
+        name = _output_name(s)
+        if name is None:
+            continue
+        prior = out_by_name.get(name)
+        if prior is not None and prior is not s:
+            report.add(
+                "TPA004",
+                f"stages {prior!r} and {s!r} both produce output feature "
+                f"'{name}' — the later one silently overwrites the column",
+                subject=name,
+            )
+        out_by_name.setdefault(name, s)
+        if name in raw_by_name:
+            report.add(
+                "TPA004",
+                f"stage {s!r} output '{name}' collides with a raw feature "
+                "of the same name — the transform overwrites the raw column",
+                subject=name,
+            )
+
+
+def _output_name(s: PipelineStage) -> str | None:
+    try:
+        return s.output_name
+    except Exception:
+        return None  # unwired stage; TPA007 already covers it
+
+
+def _label_positions(stage: PipelineStage) -> frozenset[int]:
+    return frozenset(getattr(stage, "label_inputs", ()) or ())
+
+
+def _check_leakage(stages: Sequence[PipelineStage], report: Report) -> None:
+    """TPA003 — response lineage reaching a predictor's FEATURE input.
+
+    The sanctioned crossings are exactly the label slots declared by
+    label-aware stages (``label_inputs`` on PredictorEstimator/
+    PredictorModel, SanityChecker, DecisionTreeNumericBucketizer): walking
+    a predictor's non-label inputs backwards must never reach a raw
+    response except through such a slot. This is the eager equivalent of
+    the reference's response/predictor type discipline — data-dependent
+    leakage (suspiciously-predictive engineered features) stays with the
+    SanityChecker at fit time."""
+    from ..models.base import PredictorEstimator, PredictorModel
+
+    for sink in stages:
+        if not isinstance(sink, (PredictorEstimator, PredictorModel)):
+            continue
+        label_slots = _label_positions(sink)
+        for pos, feat in enumerate(_live_inputs(sink)):
+            if pos in label_slots:
+                continue
+            path = _response_path(feat)
+            if path is not None:
+                report.add(
+                    "TPA003",
+                    f"predictor {sink!r} input {pos} ('{feat.name}') has "
+                    f"the response '{path[-1]}' in its lineage "
+                    f"(path: {' <- '.join(path)}) — the model would train "
+                    "on its own answer",
+                    subject=sink.uid,
+                    feature=feat.name,
+                    path=path,
+                )
+
+
+def _response_path(feature: Feature) -> list[str] | None:
+    """Names from ``feature`` back to a reachable raw response, honouring
+    label slots (not traversed) — None when no response is reachable."""
+    seen: set[int] = set()
+    # stack of (feature, path-so-far); bounded by graph size via ``seen``
+    stack: list[tuple[Feature, tuple[str, ...]]] = [(feature, (feature.name,))]
+    while stack:
+        f, path = stack.pop()
+        if id(f) in seen:
+            continue
+        seen.add(id(f))
+        stage = f.origin_stage
+        if stage is None or isinstance(stage, FeatureGeneratorStage):
+            if f.is_response:
+                return list(path)
+            continue
+        label_slots = _label_positions(stage)
+        for pos, parent in enumerate(_live_inputs(stage)):
+            if pos in label_slots:
+                continue
+            stack.append((parent, path + (parent.name,)))
+    return None
+
+
+def _check_fit_state(
+    stages: Sequence[PipelineStage],
+    fitted: dict[str, PipelineStage] | None,
+    mode: str,
+    report: Report,
+) -> None:
+    """TPA008 — the stateful-stage-before-fit contract: a serving plan may
+    only contain transformers; an estimator whose fitted model is absent
+    from ``fitted`` would have to fit at score time."""
+    if mode != "serve":
+        return
+    fitted = fitted or {}
+    for s in stages:
+        resolved = fitted.get(s.uid, s)
+        if isinstance(resolved, Estimator):
+            report.add(
+                "TPA008",
+                f"stateful stage {s!r} appears in a serving plan without a "
+                "fitted model — estimators must be fitted by train() first",
+                subject=s.uid,
+            )
+
+
+def _check_selectors(stages: Sequence[PipelineStage], report: Report) -> None:
+    from ..selector.model_selector import ModelSelector
+
+    selectors = [s for s in stages if isinstance(s, ModelSelector)]
+    if len(selectors) > 1:
+        report.add(
+            "TPA013",
+            "Only one ModelSelector is allowed per workflow "
+            f"(found {len(selectors)}: "
+            f"{', '.join(s.uid for s in selectors)})",
+            subject=selectors[1].uid,
+        )
+
+
+def _check_layers(
+    result_features: Sequence[Feature],
+    stages: Sequence[PipelineStage],
+    report: Report,
+) -> None:
+    """TPA010 — compute_dag layer consistency: every stage must be
+    scheduled strictly AFTER all its ancestor stages (a violation means a
+    stage would transform before an input column exists)."""
+    from ..workflow.dag import compute_dag
+
+    layers = compute_dag(result_features)
+    layer_of: dict[int, int] = {}
+    for i, layer in enumerate(layers):
+        for s in layer:
+            layer_of[id(s)] = i
+    for s in stages:
+        li = layer_of.get(id(s))
+        if li is None:
+            # stage reachable from lineage but missing from the schedule
+            report.add(
+                "TPA010",
+                f"stage {s!r} is reachable from the result features but "
+                "missing from the computed DAG layers",
+                subject=s.uid,
+            )
+            continue
+        for f in _live_inputs(s):
+            p = f.origin_stage
+            if p is None or isinstance(p, FeatureGeneratorStage):
+                continue
+            pi = layer_of.get(id(p))
+            if pi is not None and pi >= li:
+                report.add(
+                    "TPA010",
+                    f"stage {s!r} (layer {li}) is scheduled no later than "
+                    f"its ancestor {p!r} (layer {pi}) — '{f.name}' would "
+                    "be read before it is produced",
+                    subject=s.uid,
+                )
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+def preflight(
+    result_features: Iterable[Feature],
+    mode: str = "train",
+    fitted: dict[str, PipelineStage] | None = None,
+) -> Report:
+    """Validate the feature DAG rooted at ``result_features``.
+
+    ``mode="train"`` allows unfitted estimators (train will fit them);
+    ``mode="serve"`` additionally enforces the before-fit contract
+    (TPA008) against the ``fitted`` stage dict. Returns a :class:`Report`
+    — call ``.raise_if_errors()`` for the refusing behaviour ``train()``
+    uses."""
+    if mode not in ("train", "serve"):
+        raise ValueError(f"unknown preflight mode {mode!r}")
+    report = Report()
+    rfs = list(result_features)
+    if not rfs:
+        report.add("TPA007", "no result features declared", subject="workflow")
+        return report
+    stages, leaves, cycles = _collect(rfs)
+    for cyc in cycles:
+        report.add(
+            "TPA009",
+            "cycle in the stage graph: "
+            + " -> ".join(type(s).__name__ for s in cyc),
+            subject=cyc[0].uid if cyc else "",
+            stages=[s.uid for s in cyc],
+        )
+    _check_wiring(stages, report)
+    _check_uids_and_outputs(stages, leaves, report)
+    _check_leakage(stages, report)
+    _check_fit_state(stages, fitted, mode, report)
+    _check_selectors(stages, report)
+    if not cycles:
+        # compute_dag recurses through lineage — only safe on acyclic DAGs
+        _check_layers(rfs, stages, report)
+    return report
+
+
+def structural_findings(layers: list[list[PipelineStage]]) -> Report:
+    """The layer-shaped structural subset behind
+    ``workflow.dag.validate_stages``: uid collisions, stage-kind and
+    wiring checks, and duplicate output feature names — every finding
+    names the offending stage and feature."""
+    report = Report()
+    stages = [s for layer in layers for s in layer]
+    _check_wiring(stages, report)
+    by_uid: dict[str, PipelineStage] = {}
+    out_by_name: dict[str, PipelineStage] = {}
+    for s in stages:
+        prior = by_uid.get(s.uid)
+        if prior is not None and prior is not s:
+            report.add(
+                "TPA011",
+                f"duplicate stage uid '{s.uid}' on distinct stages "
+                f"{type(prior).__name__} and {type(s).__name__}",
+                subject=s.uid,
+            )
+        by_uid[s.uid] = s
+        name = _output_name(s)
+        if name is None:
+            continue
+        prior_out = out_by_name.get(name)
+        if prior_out is not None and prior_out is not s:
+            report.add(
+                "TPA004",
+                f"stages {prior_out!r} and {s!r} both produce output "
+                f"feature '{name}' — the later one silently overwrites "
+                "the column",
+                subject=name,
+            )
+        out_by_name.setdefault(name, s)
+    return report

@@ -57,7 +57,7 @@ def donating(
         import jax
 
         try:
-            got = jax.jit(
+            got = jax.jit(  # tplint: disable=TPL003 — cached in _DONATED
                 base,
                 static_argnames=tuple(static_argnames),
                 donate_argnums=donate_argnums,

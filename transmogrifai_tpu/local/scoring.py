@@ -660,17 +660,39 @@ def score_function(
         # guard / breaker / drift path, pinned by the parity tests
         return score_batch([row])[0]
 
+    def audit() -> Any:
+        """Static serving-plan audit (analysis/plan_audit.py): symbolic
+        [N, width] shape propagation over this closure's stage plan, the
+        per-stage host↔device transfer census, recompile-hazard and
+        donation checks. Widths sharpen after the first scored batch
+        (the fusion planner learns them); re-run any time — it executes
+        nothing."""
+        from ..analysis.plan_audit import audit_serving_plan
+
+        return audit_serving_plan(
+            plan, raw_features, result_names,
+            fusion=fusion, bucketed=True,
+            host_predict_max=_device_predict_min,
+        )
+
     def metadata() -> dict[str, Any]:
         """Score-path health: guard + sentinel + quarantine + breaker +
         drift counters, one report — plus the training-side distributed
         ledger (hosts lost, failovers, reshards) so serving ops can see
-        the model behind this closure finished on a degraded mesh, and the
+        the model behind this closure finished on a degraded mesh, the
         process-wide compile-plane (compiler.stats) and featurize-plane
-        (featurize.stats) ledgers."""
+        (featurize.stats) ledgers, and the static plan audit
+        (``analysis`` — findings + the host↔device transfer census)."""
         from ..compiler import stats as cstats
         from ..featurize import stats as fstats
 
+        try:
+            analysis = audit().to_json()
+        except Exception as e:  # the audit must never break monitoring
+            log.debug("plan audit skipped: %s", e)
+            analysis = None
         return {
+            "analysis": analysis,
             "compileStats": cstats.snapshot(),
             "featurizeStats": fstats.snapshot(),
             "scoreGuard": guard.stats(),
@@ -688,6 +710,7 @@ def score_function(
     score_one.breakers = breakers  # type: ignore[attr-defined]
     score_one.drift = drift_sentinel  # type: ignore[attr-defined]
     score_one.quarantine = qlog  # type: ignore[attr-defined]
+    score_one.audit = audit  # type: ignore[attr-defined]
     score_one.metadata = metadata  # type: ignore[attr-defined]
     # the model keeps weak references to its live score functions so
     # summary_pretty() can report serve-side resilience counters next to

@@ -23,6 +23,7 @@ from ..types.columns import Column, NumericColumn, PredictionColumn, VectorColum
 
 class PredictorModel(Model):
     output_type = Prediction
+    label_inputs = (0,)  # (label, features) — label slot is sanctioned
 
     def predict_arrays(
         self, x: np.ndarray
@@ -49,6 +50,7 @@ class PredictorEstimator(Estimator):
 
     input_types = (RealNN, OPVector)
     output_type = Prediction
+    label_inputs = (0,)  # the response is THIS stage's training target
 
     def extract_xy(self, dataset: Dataset) -> tuple[np.ndarray, np.ndarray]:
         label_name, vec_name = self.input_names

@@ -212,6 +212,7 @@ class DecisionTreeNumericBucketizer(Estimator):
 
     input_types = (RealNN, OPNumeric)
     output_type = OPVector
+    label_inputs = (0,)  # supervised binning consumes the label by design
 
     def __init__(
         self,
@@ -265,6 +266,7 @@ class DecisionTreeNumericBucketizer(Estimator):
 
 class DecisionTreeNumericBucketizerModel(Model):
     output_type = OPVector
+    label_inputs = (0,)  # wired (label, numeric) like its estimator
 
     def __init__(
         self,

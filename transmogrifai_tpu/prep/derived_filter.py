@@ -21,6 +21,13 @@ from ..stages.base import Model
 class FeatureRemovalModel(Model):
     output_type = OPVector
 
+    @property
+    def label_inputs(self) -> tuple[int, ...]:
+        # fitted by SanityChecker it inherits (label, vector) wiring — the
+        # label slot is a sanctioned response crossing for the pre-flight
+        # leakage walk; a bare single-vector wiring has no label slot
+        return (0,) if len(self.input_features) == 2 else ()
+
     def __init__(
         self,
         indices_to_keep: Sequence[int],

@@ -70,6 +70,7 @@ class SanityChecker(Estimator):
 
     input_types = (RealNN, OPVector)
     output_type = OPVector
+    label_inputs = (0,)  # label-aware by design: correlation screening
 
     def __init__(
         self,

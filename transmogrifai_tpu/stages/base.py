@@ -31,6 +31,12 @@ class PipelineStage:
     #: (input feature types, output feature type(s)) — overridden by subclasses
     input_types: tuple[type, ...] | None = None
     output_type: type = FeatureType
+    #: input positions that legitimately consume the RESPONSE (the label
+    #: slot of predictors / SanityChecker / supervised bucketizers). The
+    #: pre-flight leakage check (analysis/preflight.py TPA003) treats these
+    #: as the only sanctioned response crossings — response lineage
+    #: reaching any other input of a predictor is flagged.
+    label_inputs: tuple[int, ...] = ()
 
     def __init__(self, operation_name: str, uid: str | None = None):
         self.operation_name = operation_name

@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Iterable
 
 from ..features.feature import Feature, FeatureGeneratorStage
-from ..stages.base import Estimator, PipelineStage, Transformer
+from ..stages.base import PipelineStage
 
 
 def compute_dag(result_features: Iterable[Feature]) -> list[list[PipelineStage]]:
@@ -34,17 +34,17 @@ def compute_dag(result_features: Iterable[Feature]) -> list[list[PipelineStage]]
 
 def validate_stages(layers: list[list[PipelineStage]]) -> None:
     """Workflow-level stage validation (OpWorkflow.scala:280-338): distinct
-    uids; every stage is an Estimator or Transformer; inputs wired."""
-    seen: dict[str, PipelineStage] = {}
-    for layer in layers:
-        for s in layer:
-            if s.uid in seen and seen[s.uid] is not s:
-                raise ValueError(f"Duplicate stage uid {s.uid}")
-            seen[s.uid] = s
-            if not isinstance(s, (Estimator, Transformer)):
-                raise TypeError(f"{s} is neither Estimator nor Transformer")
-            if not s.input_features:
-                raise ValueError(f"{s} has no inputs wired")
+    uids; every stage is an Estimator or Transformer; inputs wired and
+    type-compatible; distinct output feature names.
+
+    Implemented by the static-analysis plane (analysis/preflight.py) so
+    every violation is TP-coded and names the offending stage AND feature
+    — raises :class:`~transmogrifai_tpu.analysis.PreflightError` (a
+    ``ValueError``) listing all findings, instead of the historical
+    anonymous first-failure message."""
+    from ..analysis.preflight import structural_findings
+
+    structural_findings(layers).raise_if_errors()
 
 
 def raw_features_of(result_features: Iterable[Feature]) -> list[Feature]:

@@ -207,6 +207,7 @@ def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F82
         "sensitiveFeatures": model.sensitive_info,
         "servingProfiles": model.serving_profiles,
         "distResilience": model.dist_summary,
+        "analysis": getattr(model, "analysis", None),
     }
     atomic_write_model_dir(path, manifest, arrays)
 
@@ -301,4 +302,6 @@ def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
         serving_profiles=manifest.get("servingProfiles"),
         # absent on pre-failover saves: no dist ledger to report
         dist_summary=manifest.get("distResilience"),
+        # absent on pre-analysis-plane saves: no findings ledger
+        analysis=manifest.get("analysis"),
     )

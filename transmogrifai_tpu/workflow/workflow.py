@@ -145,10 +145,24 @@ class Workflow:
                 )
         self.blocklisted_features = sorted(dead)
 
+    # ----------------------------------------------------------- pre-flight
+    def validate(self) -> "Report":
+        """Pre-flight static analysis of the declared DAG (no data needed):
+        feature-type compatibility per stage edge, response-lineage leakage
+        into predictors, duplicate/orphan outputs, cycles and layer
+        consistency — the eager equivalent of the reference's compile-time
+        typed pipelines (analysis/preflight.py; docs/analysis.md catalogues
+        the TPA codes). Returns the :class:`~transmogrifai_tpu.analysis.Report`;
+        ``train()`` runs the same pass and refuses on errors."""
+        from ..analysis.preflight import preflight
+
+        return preflight(self.result_features, mode="train")
+
     # --------------------------------------------------------------- train
-    def _stages(self) -> list[PipelineStage]:
+    def _stages(self, validate: bool = True) -> list[PipelineStage]:
         layers = compute_dag(self.result_features)
-        validate_stages(layers)
+        if validate:
+            validate_stages(layers)
         return [s for layer in layers for s in layer]
 
     def _apply_overrides(self, stages: Sequence[PipelineStage]) -> None:
@@ -206,7 +220,14 @@ class Workflow:
                 f"unknown on_mesh_mismatch {on_mesh_mismatch!r} "
                 "(choose 'reshard' or 'raise')"
             )
-        stages = self._stages()
+        # pre-flight static analysis: refuse a provably-broken DAG (type
+        # clash, leakage, cycle, ...) BEFORE reading any data — the eager
+        # stand-in for the reference's compile-time typed pipelines. The
+        # report (incl. surviving warnings) rides the model summary.
+        preflight_report = self.validate().raise_if_errors()
+        # preflight already covered the structural checks — skip the
+        # second validate_stages pass inside _stages()
+        stages = self._stages(validate=False)
         self._apply_overrides(stages)
         # async warmup (compiler.warmup): load the banked executables the
         # model families in THIS DAG will need on a background thread, so
@@ -467,6 +488,7 @@ class Workflow:
             training_params=dict(self._stage_overrides),
             serving_profiles=serving_profiles,
             dist_summary=dist_summary,
+            analysis=preflight_report.to_json(),
         )
         if selector is not None:
             # keep the live evaluator object so custom evaluators keep working
@@ -537,6 +559,7 @@ class WorkflowModel:
         training_params: dict[str, Any] | None = None,
         serving_profiles: dict[str, Any] | None = None,
         dist_summary: dict[str, Any] | None = None,
+        analysis: dict[str, Any] | None = None,
     ):
         self.result_features = result_features
         self.raw_features = raw_features
@@ -557,6 +580,10 @@ class WorkflowModel:
         #: failovers, collective retries, stragglers, reshard events, mesh
         #: history); None on models saved before this field existed
         self.dist_summary = dist_summary
+        #: pre-flight static-analysis report from train() (JSON form of
+        #: analysis.Report — findings that survived as warnings/info);
+        #: None on models saved before the analysis plane existed
+        self.analysis = analysis
 
     # --------------------------------------------------------- persistence
     def save(self, path: str) -> None:
@@ -708,6 +735,7 @@ class WorkflowModel:
             "modelSelectorSummary": sel_summary,
             "stageMetadata": stage_meta,
             "distributedResilience": self.dist_summary,
+            "analysis": self.analysis,
         }
 
     def summary_pretty(self) -> str:
@@ -902,6 +930,19 @@ class WorkflowModel:
         serve = self._serving_resilience_line()
         if serve:
             lines.append(serve)
+        analysis = getattr(self, "analysis", None) or {}
+        if analysis.get("findings"):
+            codes: dict[str, int] = {}
+            for f in analysis["findings"]:
+                codes[f["code"]] = codes.get(f["code"], 0) + 1
+            code_s = ", ".join(
+                f"{c}×{n}" if n > 1 else c for c, n in sorted(codes.items())
+            )
+            lines.append(
+                f"Static analysis: {analysis.get('errors', 0)} error(s), "
+                f"{analysis.get('warnings', 0)} warning(s) ({code_s}) — "
+                "see docs/analysis.md"
+            )
         lines.append(
             f"Trained on {s['trainRows']} rows (holdout {s['holdoutRows']}); "
             f"{len(s['rawFeatures'])} raw features"
