@@ -59,6 +59,27 @@ def _reference_titanic_train_s() -> float:
 REFERENCE_TITANIC_TRAIN_S = _reference_titanic_train_s()
 
 
+def _telemetry_phase_breakdown() -> dict:
+    """Span-derived ingest/featurize/compile/fit/eval seconds (telemetry
+    plane); empty when telemetry is disabled."""
+    try:
+        from transmogrifai_tpu.telemetry import phase_breakdown
+
+        return phase_breakdown()
+    except Exception:
+        return {}
+
+
+def _telemetry_serve_latency() -> dict:
+    """Per-stage-family serve p50/p95/p99 ms from the latency histograms."""
+    try:
+        from transmogrifai_tpu.telemetry import serve_latency_summary
+
+        return serve_latency_summary()
+    except Exception:
+        return {}
+
+
 def _cpu_workload_baseline(name: str) -> dict | None:
     """Measured CPU entry for a scale workload (baseline_cpu.py writes
     BASELINE_CPU.json['workloads'][name])."""
@@ -630,6 +651,33 @@ def bench_wide_mlp(
 
 
 def main() -> None:
+    """Argv dispatch wrapped with the ``--trace`` flag: when present (bare
+    or ``--trace=PATH``), the buffered telemetry spans are written as a
+    Chrome trace-event document beside the JSON output when the selected
+    bench mode finishes — open it at ui.perfetto.dev to see the
+    layer/fold/stage nesting behind the wall-clock numbers."""
+    import sys
+
+    trace_path = None
+    for a in list(sys.argv[1:]):
+        if a == "--trace" or a.startswith("--trace="):
+            val = a.split("=", 1)[1] if "=" in a else ""
+            trace_path = val or "bench_trace.json"
+            sys.argv.remove(a)
+    try:
+        _dispatch()
+    finally:
+        if trace_path is not None:
+            from transmogrifai_tpu.telemetry import export_chrome_trace
+
+            doc = export_chrome_trace(trace_path)
+            print(
+                f"wrote {len(doc['traceEvents'])} span(s) to {trace_path}",
+                file=sys.stderr,
+            )
+
+
+def _dispatch() -> None:
     import sys
 
     scale_configs = {
@@ -856,6 +904,13 @@ def main() -> None:
                 ),
                 "text_transmogrify_rows_per_sec_pre_engine": 90334,
                 "serve_batch_rows_per_sec_pre_engine": 70926,
+                # telemetry (PR 7): span-derived seconds per bench phase
+                # across the in-process reps (compile runs on a background
+                # warmup thread, so it can overlap the others), plus the
+                # serve-path latency quantiles from the histogram pipeline
+                # — the r06+ trajectory attributes wins to phases
+                "phase_breakdown": _telemetry_phase_breakdown(),
+                "serve_latency_ms": _telemetry_serve_latency(),
                 # single fresh-process run; the tunneled shared chip's
                 # round-trip throughput varies hour-to-hour — measured
                 # quiet-chip best 9.3 s, congested episodes up to ~70 s

@@ -5,8 +5,9 @@ way the pre-flight pass polices user DAGs. Rules:
 
 * **TPL001** — module-level mutable state written without holding a lock,
   in the thread-crossed subsystems (``featurize/``, ``compiler/``,
-  ``utils/aot.py``): the chunk-pool workers and the async warmup thread
-  share these modules with the main thread.
+  ``utils/aot.py``, ``telemetry/``): the chunk-pool workers, the async
+  warmup thread, and the telemetry span/event buffers share these modules
+  with the main thread.
 * **TPL002** — per-row Python loops inside ``ops/`` columnar hot paths
   (``transform_columns`` / ``blocks_for``): the PR-5 columnar engine
   killed these; new ones silently re-open the 10-100x serving gap.
@@ -46,7 +47,8 @@ __all__ = [
 ]
 
 #: subsystems whose module globals are crossed by worker/warmup threads
-_LOCKED_SUBSYSTEMS = ("featurize/", "compiler/", "utils/aot.py")
+#: (telemetry/ buffers are written from scoring, pool, and warmup threads)
+_LOCKED_SUBSYSTEMS = ("featurize/", "compiler/", "utils/aot.py", "telemetry/")
 
 _MUTATORS = {
     "append", "add", "update", "pop", "popitem", "setdefault", "clear",

@@ -101,7 +101,12 @@ def prefetch_f32(arr) -> None:
                 return
         import jax
 
-        buf = jax.device_put(np.asarray(arr, dtype=np.float32))
+        from ..telemetry import spans as _tspans
+
+        with _tspans.span(
+            "compile/prefetch", bytes=int(getattr(arr, "nbytes", 0))
+        ):
+            buf = jax.device_put(np.asarray(arr, dtype=np.float32))
         try:
             ref = weakref.ref(src)
         except TypeError:  # source not weakref-able: skip (no way to

@@ -9,10 +9,16 @@ and the corruption/version-invalidation drops from the persistent bank.
 Counters are cumulative per process. Consumers that want a per-phase view
 (the model selector's summary, the bench's cold-run probe) take a
 ``snapshot()`` before and report ``delta(before)`` after.
+
+The counter dict, its lock, and the snapshot/delta arithmetic live on the
+shared :class:`telemetry.metrics.LedgerCore` — one re-entrant lock across
+every ledger (consistent cross-ledger snapshots) and one copy of the
+delta helpers instead of three. The ledger registers itself as the
+``compile`` source of ``telemetry.render_prometheus()``.
 """
 from __future__ import annotations
 
-import threading
+from ..telemetry import metrics as _tm
 
 _COUNTER_KEYS = (
     "programsCompiled",      # AOT misses: paid a trace + compile (or a
@@ -31,24 +37,19 @@ _COUNTER_KEYS = (
 )
 
 
-class CompileStats:
+class CompileStats(_tm.LedgerCore):
     """Thread-safe counters; ``warmupOverlapSeconds`` rides along as a
     float (seconds of program acquisition overlapped with host-side work by
     the background warmup thread)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counts: dict[str, int] = {k: 0 for k in _COUNTER_KEYS}
+        super().__init__(_COUNTER_KEYS)
         self._warmup_overlap_s = 0.0
         #: per-program-name compile counts — lets tests pin "this sweep
         #: compiled exactly one logistic program" without global noise
         self._compiled_by_name: dict[str, int] = {}
 
     # ------------------------------------------------------------ recording
-    def bump(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[key] += n
-
     def record_compile(self, name: str) -> None:
         with self._lock:
             self._counts["programsCompiled"] += 1
@@ -81,19 +82,23 @@ class CompileStats:
             out: dict = dict(self._counts)
             out["warmupOverlapSeconds"] = round(self._warmup_overlap_s, 3)
             out["programsCompiledByName"] = dict(self._compiled_by_name)
-        hits = out["cacheHitsMemory"] + out["cacheHitsDisk"]
-        total = hits + out["programsCompiled"]
-        out["compileCacheHitRate"] = round(hits / total, 4) if total else None
+        out["compileCacheHitRate"] = _hit_rate(out)
         return out
 
     def reset(self) -> None:
         with self._lock:
-            self._counts = {k: 0 for k in _COUNTER_KEYS}
+            self._reset_counts()
             self._warmup_overlap_s = 0.0
             self._compiled_by_name = {}
 
 
+def _hit_rate(counts: dict) -> float | None:
+    hits = counts["cacheHitsMemory"] + counts["cacheHitsDisk"]
+    return _tm.ratio(hits, hits + counts["programsCompiled"])
+
+
 _STATS = CompileStats()
+_tm.REGISTRY.register_source("compile", _STATS.snapshot)
 
 
 def stats() -> CompileStats:
@@ -108,20 +113,13 @@ def delta(before: dict) -> dict:
     """Per-phase view: current snapshot minus a ``snapshot()`` taken
     earlier (rates recomputed from the deltas, not differenced)."""
     now = _STATS.snapshot()
-    out: dict = {}
-    for k in _COUNTER_KEYS:
-        out[k] = now[k] - before.get(k, 0)
-    out["warmupOverlapSeconds"] = round(
-        now["warmupOverlapSeconds"] - before.get("warmupOverlapSeconds", 0.0),
-        3,
+    out: dict = _tm.counter_delta(now, before, _COUNTER_KEYS)
+    out["warmupOverlapSeconds"] = _tm.float_delta(
+        now, before, "warmupOverlapSeconds"
     )
-    by_name_before = before.get("programsCompiledByName", {})
-    out["programsCompiledByName"] = {
-        name: n - by_name_before.get(name, 0)
-        for name, n in now["programsCompiledByName"].items()
-        if n - by_name_before.get(name, 0)
-    }
-    hits = out["cacheHitsMemory"] + out["cacheHitsDisk"]
-    total = hits + out["programsCompiled"]
-    out["compileCacheHitRate"] = round(hits / total, 4) if total else None
+    out["programsCompiledByName"] = _tm.named_delta(
+        now["programsCompiledByName"],
+        before.get("programsCompiledByName", {}),
+    )
+    out["compileCacheHitRate"] = _hit_rate(out)
     return out

@@ -109,15 +109,24 @@ def start_warmup(
 
 
 def _run(names) -> None:
+    from ..telemetry import events as _tevents
+    from ..telemetry import spans as _tspans
     from ..utils import aot
 
     t0 = time.monotonic()
     try:
-        n = aot.prewarm(names=names)
+        with _tspans.span(
+            "compile/warmup", programs=-1 if names is None else len(names)
+        ):
+            n = aot.prewarm(names=names)
     except Exception as e:  # warmup must never take a train down
         log.info("warmup failed: %s", e)
         return
-    _stats.stats().record_warmup(n, time.monotonic() - t0)
+    overlap = time.monotonic() - t0
+    _stats.stats().record_warmup(n, overlap)
+    _tevents.emit(
+        "warmup_complete", programs=n, overlapSeconds=round(overlap, 3)
+    )
 
 
 def reset_for_tests() -> None:

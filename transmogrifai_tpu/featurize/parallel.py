@@ -104,10 +104,13 @@ def run_tasks(tasks: Sequence[Callable[[], object]]) -> list:
         finally:
             _ON_POOL.active = False
 
-    t0 = time.perf_counter()
-    futures = [_pool().submit(_timed, i, t) for i, t in enumerate(tasks)]
-    results = [f.result() for f in futures]
-    wall = time.perf_counter() - t0
+    from ..telemetry import spans as _tspans
+
+    with _tspans.span("featurize/pool", tasks=len(tasks)):
+        t0 = time.perf_counter()
+        futures = [_pool().submit(_timed, i, t) for i, t in enumerate(tasks)]
+        results = [f.result() for f in futures]
+        wall = time.perf_counter() - t0
     fstats.stats().record_pool(
         len(tasks), sum(busy), wall, featurize_threads()
     )

@@ -13,10 +13,16 @@ detections from the ABI stamp in ``native.py``.
 Counters are cumulative per process. Consumers that want a per-phase view
 (the model selector's summary, the bench rows) take a ``snapshot()``
 before and report ``delta(before)`` after.
+
+Counter dict, lock, and snapshot/delta arithmetic come from the shared
+:class:`telemetry.metrics.LedgerCore` — the same core under compileStats
+and the resilience ledger, so a ``telemetry.snapshot_lock()`` read is
+consistent across all of them. The ledger registers itself as the
+``featurize`` source of ``telemetry.render_prometheus()``.
 """
 from __future__ import annotations
 
-import threading
+from ..telemetry import metrics as _tm
 
 _COUNTER_KEYS = (
     "rowsFeaturized",        # rows through instrumented vectorizer stages
@@ -34,13 +40,12 @@ _COUNTER_KEYS = (
 )
 
 
-class FeaturizeStats:
+class FeaturizeStats(_tm.LedgerCore):
     """Thread-safe counters; per-stage rows/seconds and pool busy/wall
     seconds ride along as floats."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counts: dict[str, int] = {k: 0 for k in _COUNTER_KEYS}
+        super().__init__(_COUNTER_KEYS)
         #: operation name -> [rows, seconds] — rows/s per stage kind
         self._stage: dict[str, list[float]] = {}
         self._fallback_by_kernel: dict[str, int] = {}
@@ -50,10 +55,6 @@ class FeaturizeStats:
         self._pool_workers = 0
 
     # ------------------------------------------------------------ recording
-    def bump(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[key] += n
-
     def record_stage(
         self, name: str, rows: int, seconds: float, out_bytes: int = 0
     ) -> None:
@@ -118,15 +119,12 @@ class FeaturizeStats:
                 for name, (rows, sec) in sorted(self._stage.items())
             }
         out["stageRowsPerSec"] = stage
-        denom = out["poolWallSeconds"] * max(out["poolWorkers"], 1)
-        out["poolUtilization"] = (
-            round(out["poolBusySeconds"] / denom, 4) if denom > 0 else None
-        )
+        out["poolUtilization"] = _pool_utilization(out)
         return out
 
     def reset(self) -> None:
         with self._lock:
-            self._counts = {k: 0 for k in _COUNTER_KEYS}
+            self._reset_counts()
             self._stage = {}
             self._fallback_by_kernel = {}
             self._stale_kernels = []
@@ -135,7 +133,13 @@ class FeaturizeStats:
             self._pool_workers = 0
 
 
+def _pool_utilization(counts: dict) -> float | None:
+    denom = counts["poolWallSeconds"] * max(counts["poolWorkers"], 1)
+    return _tm.ratio(counts["poolBusySeconds"], denom) if denom > 0 else None
+
+
 _STATS = FeaturizeStats()
+_tm.REGISTRY.register_source("featurize", _STATS.snapshot)
 
 
 def stats() -> FeaturizeStats:
@@ -150,9 +154,9 @@ def delta(before: dict) -> dict:
     """Per-phase view: current snapshot minus an earlier ``snapshot()``
     (utilization recomputed from the deltas, not differenced)."""
     now = _STATS.snapshot()
-    out: dict = {k: now[k] - before.get(k, 0) for k in _COUNTER_KEYS}
+    out: dict = _tm.counter_delta(now, before, _COUNTER_KEYS)
     for k in ("poolBusySeconds", "poolWallSeconds"):
-        out[k] = round(now[k] - before.get(k, 0.0), 3)
+        out[k] = _tm.float_delta(now, before, k)
     out["poolWorkers"] = now["poolWorkers"]
     before_stage = before.get("stageRowsPerSec", {})
     stage = {}
@@ -167,15 +171,9 @@ def delta(before: dict) -> dict:
                 "rowsPerSec": round(rows / sec) if sec > 0 else None,
             }
     out["stageRowsPerSec"] = stage
-    before_fb = before.get("fallbacksByKernel", {})
-    out["fallbacksByKernel"] = {
-        k: n - before_fb.get(k, 0)
-        for k, n in now["fallbacksByKernel"].items()
-        if n - before_fb.get(k, 0)
-    }
-    out["staleKernels"] = now["staleKernels"]
-    denom = out["poolWallSeconds"] * max(out["poolWorkers"], 1)
-    out["poolUtilization"] = (
-        round(out["poolBusySeconds"] / denom, 4) if denom > 0 else None
+    out["fallbacksByKernel"] = _tm.named_delta(
+        now["fallbacksByKernel"], before.get("fallbacksByKernel", {})
     )
+    out["staleKernels"] = now["staleKernels"]
+    out["poolUtilization"] = _pool_utilization(out)
     return out

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import weakref
 from typing import Any, Callable
 
@@ -38,6 +39,8 @@ import numpy as np
 
 from ..resilience import faults
 from ..resilience.guards import ScoreGuard, ScoreGuardError
+from ..telemetry import metrics as _tm
+from ..telemetry import spans as _tspans
 from ..resilience.sentinel import (
     BreakerConfig,
     CircuitBreaker,
@@ -54,6 +57,54 @@ from ..workflow.workflow import WorkflowModel
 log = logging.getLogger(__name__)
 
 _BUCKET_CAP = 8192
+
+#: weakrefs to every live score function in the process — the ``serving``
+#: ledger source of ``telemetry.render_prometheus()`` aggregates their
+#: quarantine / guard / drift / breaker counters. The lock brackets the
+#: prune+append so concurrent score_function() builds cannot drop one.
+_LIVE_SCORE_FNS: list = []
+_LIVE_LOCK = threading.Lock()
+
+
+def _serving_source() -> dict[str, Any]:
+    """Aggregate serve-side health counters across live score functions
+    (reads instance counters only — never runs the drift report, which
+    mutates alert bookkeeping)."""
+    out = {
+        "scoreFunctions": 0,
+        "quarantinedRows": 0,
+        "guardedRows": 0,
+        "driftAlerts": 0,
+        "breakerTrips": 0,
+        "breakerShortCircuits": 0,
+    }
+    with _LIVE_LOCK:
+        refs = list(_LIVE_SCORE_FNS)
+    for ref in refs:
+        fn = ref()
+        if fn is None:
+            continue
+        try:
+            quarantined = fn.quarantine.stats()["quarantinedRows"]
+            guarded = fn.guard.stats()["guardedRows"]
+            drift_alerts = getattr(fn.drift, "alerts_total", 0)
+            trips = circuits = 0
+            for br in fn.breakers.values():
+                circuits += br.short_circuits
+                trips += br.transitions.get("closed->open", 0)
+                trips += br.transitions.get("half_open->open", 0)
+        except Exception:  # a half-built closure must not kill exposition
+            continue
+        out["scoreFunctions"] += 1
+        out["quarantinedRows"] += quarantined
+        out["guardedRows"] += guarded
+        out["driftAlerts"] += drift_alerts
+        out["breakerTrips"] += trips
+        out["breakerShortCircuits"] += circuits
+    return out
+
+
+_tm.REGISTRY.register_source("serving", _serving_source)
 
 
 def _all_null(col) -> bool:
@@ -205,6 +256,7 @@ def score_function(
         row_indices: tuple[int, ...] | None,
         breaker_mode: str = "active",
         skip: frozenset = frozenset(),
+        fam_seconds: dict[str, float] | None = None,
     ) -> tuple[set, list, dict]:
         """Execute the stage plan over already-built raw columns, with
         per-stage fault isolation. Returns ``(dead, failures, cause)``:
@@ -227,16 +279,21 @@ def score_function(
         with fusion.batch(b):
             _plan_loop(
                 cols, b, n, row_indices, breaker_mode, skip,
-                dead, failures, cause, fp,
+                dead, failures, cause, fp, fam_seconds,
             )
         return dead, failures, cause
 
     def _plan_loop(
         cols, b, n, row_indices, breaker_mode, skip,
-        dead, failures, cause, fp,
+        dead, failures, cause, fp, fam_seconds=None,
     ) -> None:
         """The stage loop of ``_run_plan`` (split out so the fusion batch
-        context brackets exactly one plan execution)."""
+        context brackets exactly one plan execution). ``fam_seconds``
+        (primary runs only) accumulates per-stage-family seconds —
+        ``featurize`` for host transform stages, ``dispatch`` for fitted
+        predictors — feeding the serve-latency histograms; per-stage
+        detail spans engage above the TPTPU_TRACE_STAGE_ROWS floor."""
+        detail = fam_seconds is not None and _tspans.stage_detail(n)
         for t in plan:
             if any(nm in dead for nm in t.input_names):
                 dead.add(t.output_name)
@@ -265,10 +322,23 @@ def score_function(
                 if fp is not None:
                     fp.on_stage_transform(t, row_indices)
                 t0 = breaker.clock() if br is not None else 0.0
+                ts = _tspans.clock() if fam_seconds is not None else 0.0
                 col = t.transform_columns(
                     *[cols[nm] for nm in t.input_names], num_rows=b
                 )
                 elapsed = breaker.clock() - t0 if br is not None else 0.0
+                if fam_seconds is not None:
+                    tdur = _tspans.clock() - ts
+                    fam = (
+                        "dispatch" if isinstance(t, PredictorModel)
+                        else "featurize"
+                    )
+                    fam_seconds[fam] = fam_seconds.get(fam, 0.0) + tdur
+                    if detail:
+                        _tspans.record_span(
+                            f"serve/stage/{type(t).__name__}", ts, tdur,
+                            rows=n,
+                        )
                 cols[t.output_name] = _guarded(
                     t, col, n, count=breaker_mode == "active"
                 )
@@ -468,8 +538,16 @@ def score_function(
         n = len(rows)
         if n == 0:
             return []
+        # serve-path telemetry: a handful of clock reads per batch
+        # (sentinel → featurize → dispatch → download family seconds),
+        # recorded in one record_serve_batch call at the end
+        tel = _tspans.enabled()
+        started = _tspans.clock() if tel else 0.0
+        fam: dict[str, float] = {}
         qlog.start_batch()
         prepared, invalid = _prepare_rows(rows)
+        if tel:
+            fam["sentinel"] = _tspans.clock() - started
         # quarantined rows are COMPACTED OUT before the plan runs: a bad
         # row must never reach a stage (an all-missing placeholder could
         # still poison one and feed the breaker), so only survivors score
@@ -482,15 +560,24 @@ def score_function(
         poisoned: dict[int, tuple[str, Exception]] = {}
         if m:
             b = _bucket(m)
+            tc = _tspans.clock() if tel else 0.0
             cols = _raw_columns([prepared[i] for i in survivors], m, b)
             if drift_sentinel.enabled:
                 # observed post codec (typed, coerced values), one
                 # vectorized bulk merge per feature; quarantined rows never
                 # reach the plan, so they are not part of the window
                 drift_sentinel.observe_columns(cols, m)
+            if tel:
+                # the row→column codec counts as featurize time; the plan
+                # loop adds the per-stage featurize/dispatch seconds on top
+                fam["featurize"] = _tspans.clock() - tc
             pre_open = _pre_open_snapshot()
-            dead, failures, cause = _run_plan(cols, b, m, tuple(survivors))
+            dead, failures, cause = _run_plan(
+                cols, b, m, tuple(survivors),
+                fam_seconds=fam if tel else None,
+            )
             degraded = [nm for nm in result_names if nm in dead]
+            td = _tspans.clock() if tel else 0.0
             for name in result_names:
                 if name in degraded:
                     continue
@@ -498,6 +585,8 @@ def score_function(
                 rendered = cols[name].to_list()
                 for j, i in enumerate(survivors):
                     out[i][name] = rendered[j]
+            if tel:
+                fam["download"] = _tspans.clock() - td
             # per-row isolation: a fresh stage failure bisects the
             # survivors so only the poisoning row(s) are quarantined;
             # results dead from an OPEN breaker are NOT recovered (that
@@ -551,6 +640,8 @@ def score_function(
             from ..compiler.dispatch import clear_prefetch
 
             clear_prefetch()
+        if tel:
+            _tspans.record_serve_batch("batch", n, started, fam)
         return out
 
     def score_columns(dataset) -> dict[str, Any]:
@@ -569,6 +660,9 @@ def score_function(
         n = len(dataset)
         if n == 0:
             return {}
+        tel = _tspans.enabled()
+        started = _tspans.clock() if tel else 0.0
+        fam: dict[str, float] = {}
         qlog.start_batch()
         b = _bucket(n)
         cols: dict[str, Any] = {}
@@ -596,8 +690,15 @@ def score_function(
             cols[f.name] = c if pad is None else c.take(pad)
         if drift_sentinel.enabled:
             drift_sentinel.observe_columns(cols, n)
+        if tel:
+            # column intake (padding/take + drift observe) counts as
+            # featurize time — there is no row-dict sentinel on this path
+            fam["featurize"] = _tspans.clock() - started
         pre_open = _pre_open_snapshot()
-        dead, failures, cause = _run_plan(cols, b, n, tuple(range(n)))
+        dead, failures, cause = _run_plan(
+            cols, b, n, tuple(range(n)), fam_seconds=fam if tel else None
+        )
+        td = _tspans.clock() if tel else 0.0
         keep = np.arange(n)
         degraded = [nm for nm in result_names if nm in dead]
         out = {
@@ -605,6 +706,8 @@ def score_function(
             for name in result_names
             if name not in degraded
         }
+        if tel:
+            fam["download"] = _tspans.clock() - td
         fail_names = [nm for nm in degraded if cause.get(nm) == "failure"]
         if failures and fail_names and n > 1:
             segments: dict[str, list] = {nm: [] for nm in fail_names}
@@ -653,6 +756,8 @@ def score_function(
             from ..compiler.dispatch import clear_prefetch
 
             clear_prefetch()  # see score_batch: bound buffer lifetime
+        if tel:
+            _tspans.record_serve_batch("columns", n, started, fam)
         return out
 
     def score_one(row: dict[str, Any]) -> dict[str, Any]:
@@ -685,22 +790,36 @@ def score_function(
         (``analysis`` — findings + the host↔device transfer census)."""
         from ..compiler import stats as cstats
         from ..featurize import stats as fstats
+        from ..telemetry.export import serving_snapshot
 
         try:
             analysis = audit().to_json()
         except Exception as e:  # the audit must never break monitoring
             log.debug("plan audit skipped: %s", e)
             analysis = None
+        # the slow, lock-free parts first (the drift report walks every
+        # feature's histogram and may emit events) — holding the shared
+        # snapshot lock here would stall every scoring thread's recorder
+        drift_report = drift_sentinel.report()
+        breaker_stats = {nm: br.stats() for nm, br in breakers.items()}
+        # then ONE consistent point-in-time read of the process ledgers:
+        # their recorders serialize on the same lock, so a concurrent
+        # scorer can no longer move counts between the compileStats and
+        # featurizeStats reads (torn cross-ledger view)
+        with _tm.snapshot_lock():
+            compile_snap = cstats.snapshot()
+            featurize_snap = fstats.snapshot()
         return {
             "analysis": analysis,
-            "compileStats": cstats.snapshot(),
-            "featurizeStats": fstats.snapshot(),
+            "compileStats": compile_snap,
+            "featurizeStats": featurize_snap,
             "scoreGuard": guard.stats(),
             "sentinel": None if sentinel is None else sentinel.stats(),
             "quarantine": qlog.stats(),
-            "breakers": {nm: br.stats() for nm, br in breakers.items()},
-            "drift": drift_sentinel.report(),
+            "breakers": breaker_stats,
+            "drift": drift_report,
             "distributed": getattr(model, "dist_summary", None),
+            "telemetry": serving_snapshot(),
         }
 
     score_one.batch = score_batch  # type: ignore[attr-defined]
@@ -720,4 +839,8 @@ def score_function(
         monitors = model._serving_monitors = []  # type: ignore[attr-defined]
     monitors[:] = [r for r in monitors if r() is not None]  # prune dead refs
     monitors.append(weakref.ref(score_one))
+    # process-wide serving source (telemetry exposition) tracks it too
+    with _LIVE_LOCK:
+        _LIVE_SCORE_FNS[:] = [r for r in _LIVE_SCORE_FNS if r() is not None]
+        _LIVE_SCORE_FNS.append(weakref.ref(score_one))
     return score_one

@@ -182,6 +182,9 @@ class CheckpointManager:
             "stages": entries,
         }
         atomic_write_model_dir(self.layer_path(index), manifest, arrays)
+        from ..telemetry import events as _tevents
+
+        _tevents.emit("checkpoint_save", layer=index, stages=len(entries))
         log.debug("checkpointed layer %d (%d stages)", index, len(entries))
 
     def load_layers(

@@ -42,10 +42,14 @@ import dataclasses
 import logging
 import os
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
+
+from ..telemetry import events as _tevents
+from ..telemetry import metrics as _tm
 
 log = logging.getLogger(__name__)
 
@@ -237,9 +241,14 @@ class HostSentinel:
 
     def note_straggler(self, name: str, seconds: float) -> None:
         self.counters["stragglersDetected"] += 1
+        deadline = self.deadline_for(name)
+        _tevents.emit(
+            "straggler", collective=name, seconds=round(seconds, 3),
+            deadline=round(deadline, 3),
+        )
         log.warning(
             "straggler: collective %s took %.3fs (deadline %.3fs)",
-            name, seconds, self.deadline_for(name),
+            name, seconds, deadline,
         )
 
     def stats(self) -> dict[str, Any]:
@@ -414,6 +423,8 @@ class FailoverController:
             self.sentinel, self.config.max_collective_retries
         )
         self.mesh_history = [mesh_fingerprint(mesh)]
+        global _LAST_BOUND
+        _LAST_BOUND = weakref.ref(self)
         return self
 
     # ------------------------------------------------------ progress hooks
@@ -478,6 +489,11 @@ class FailoverController:
         # (CheckpointManager.reshard_events), not this mesh change —
         # meshHistory records that.
         self.mesh_history.append(mesh_fingerprint(self.mesh))
+        _tevents.emit(
+            "failover", host=repr(host), reason=err.reason,
+            survivingDevices=max(1, len(survivors)),
+            failovers=self.counters["failovers"],
+        )
         log.warning(
             "failover: host %r lost (%s); continuing on %d device(s)",
             host, err.reason, max(1, len(survivors)),
@@ -527,6 +543,32 @@ class FailoverController:
 
 # ------------------------------------------------------------- installation
 _CONTROLLER: FailoverController | None = None
+
+#: weakref to the controller most recently bound to a mesh — the
+#: ``resilience`` exposition source reads it after train() uninstalls
+_LAST_BOUND: Callable[[], "FailoverController | None"] | None = None
+
+#: the full counter catalogue, so a fresh process exposes every
+#: resilience metric at zero before any controller exists
+_ZERO_LEDGER = {
+    "hostsLost": 0, "failovers": 0, "reshardEvents": 0, "hosts": 0,
+    "heartbeatsDropped": 0, "stragglersDetected": 0, "collectivesRetried": 0,
+}
+
+
+def _resilience_source() -> dict[str, Any]:
+    """The distributed-resilience ledger as a telemetry source: the
+    installed controller's merged counters (or the most recently bound
+    one's — a finished train keeps reporting until the next bind)."""
+    c = _CONTROLLER
+    if c is None and _LAST_BOUND is not None:
+        c = _LAST_BOUND()
+    if c is None:
+        return dict(_ZERO_LEDGER)
+    return {**_ZERO_LEDGER, **c.summary()}
+
+
+_tm.REGISTRY.register_source("resilience", _resilience_source)
 
 
 def install_controller(controller: FailoverController) -> None:

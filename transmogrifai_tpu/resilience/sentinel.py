@@ -35,6 +35,7 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
+from ..telemetry import events as _tevents
 from ..types import Storage
 from ..utils.streaming_histogram import StreamingHistogram, histogram_from_values
 
@@ -471,6 +472,11 @@ class CircuitBreaker:
 
     def _to(self, state: str) -> None:
         self.transitions[f"{self.state}->{state}"] += 1
+        _tevents.emit(
+            "breaker_transition", stage=self.name,
+            transition=f"{self.state}->{state}",
+            consecutiveFailures=self.consecutive_failures,
+        )
         self.state = state
 
     def allow(self) -> bool:
@@ -780,6 +786,14 @@ class DriftSentinel:
                 if name not in self._alerting:
                     self._alerting.add(name)
                     self.alerts_total += 1
+                    _tevents.emit(
+                        "drift_alert", feature=name,
+                        fillRatio=(
+                            None if math.isinf(fill_ratio) else
+                            round(fill_ratio, 4)
+                        ),
+                        jsDivergence=None if js is None else round(js, 4),
+                    )
                     log.warning(
                         "drift sentinel: feature '%s' drifted (fillRatio="
                         "%.3g, js=%s)", name, fill_ratio,

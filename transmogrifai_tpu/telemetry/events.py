@@ -1,0 +1,102 @@
+"""Structured JSONL event log — the ops-facing record of rare, important
+state changes.
+
+Every :func:`emit` produces one JSON-able record with a process-unique,
+strictly monotonic ``seq`` (assigned under the log lock, so the JSONL
+ordering is the ordering even when emitters race across threads), the
+injectable-monotonic timestamp, a wall-clock timestamp for correlation
+with external logs, and the emitter's fields.
+
+Wired event kinds (see docs/observability.md for the catalogue):
+
+* ``failover`` / ``straggler`` — resilience/distributed.py
+* ``breaker_transition`` — resilience/sentinel.py circuit breakers
+* ``drift_alert`` — resilience/sentinel.py drift sentinel
+* ``checkpoint_save`` — resilience/checkpoint.py layer saves
+* ``warmup_complete`` — compiler/warmup.py background bank loads
+
+The log is a bounded in-memory deque (``TPTPU_EVENT_BUFFER``, default
+4096) exportable as JSONL (:func:`to_jsonl` / :func:`write`); set
+``TPTPU_EVENT_LOG=/path/file.jsonl`` to also append each record to disk
+as it is emitted.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from . import spans as _spans
+from .spans import _env_int
+
+__all__ = ["emit", "recent", "count", "to_jsonl", "write", "reset_for_tests"]
+
+_LOCK = threading.Lock()
+_BUFFER: deque = deque(maxlen=_env_int("TPTPU_EVENT_BUFFER", 4096))
+_STATE: dict[str, int] = {"seq": 0}
+
+
+def emit(kind: str, **fields: Any) -> dict[str, Any]:
+    """Append one event; returns the record (with its assigned seq).
+
+    Honors the telemetry disable switch: when ``spans.enabled()`` is
+    False the record is built and returned (seq 0) but neither buffered
+    nor appended to ``TPTPU_EVENT_LOG``."""
+    rec: dict[str, Any] = {
+        "seq": 0,
+        "ts": round(_spans.clock(), 6),
+        "unix": round(time.time(), 3),
+        "kind": kind,
+    }
+    rec.update(fields)
+    if not _spans.enabled():
+        return rec
+    path = os.environ.get("TPTPU_EVENT_LOG")
+    with _LOCK:
+        _STATE["seq"] += 1
+        rec["seq"] = _STATE["seq"]
+        _BUFFER.append(rec)
+        if path:
+            # inside the lock so on-disk ordering matches seq ordering;
+            # events are rare (failovers, breaker trips), so the open
+            # cost is irrelevant
+            try:
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, default=str) + "\n")
+            except OSError:
+                pass  # a full disk must not take scoring down
+    return rec
+
+
+def recent(n: int | None = None) -> list[dict[str, Any]]:
+    with _LOCK:
+        out = list(_BUFFER)
+    return out if n is None else out[-n:]
+
+
+def count() -> int:
+    """Total events emitted this process (monotonic, survives buffer
+    eviction)."""
+    return _STATE["seq"]
+
+
+def to_jsonl() -> str:
+    return "\n".join(json.dumps(r, default=str) for r in recent())
+
+
+def write(path: str) -> int:
+    """Dump the buffered events as JSONL; returns the record count."""
+    recs = recent()
+    with open(path, "w", encoding="utf-8") as f:
+        for r in recs:
+            f.write(json.dumps(r, default=str) + "\n")
+    return len(recs)
+
+
+def reset_for_tests() -> None:
+    with _LOCK:
+        _BUFFER.clear()
+        _STATE["seq"] = 0

@@ -28,6 +28,7 @@ from ..evaluators.base import Evaluator
 from ..resilience import distributed
 from ..selector.model_selector import ModelSelector
 from ..selector.validators import CandidateResult, expand_grid
+from ..telemetry import spans as _tspans
 from ..types.columns import NumericColumn, VectorColumn
 from .fit import apply_transformations_dag, fit_and_transform_dag
 
@@ -74,40 +75,50 @@ def workflow_cv_results(
         controller = distributed.active_controller()
         if controller is not None:
             controller.on_fold(fold_i)
-        tr_idx = np.nonzero(train_mask)[0]
-        va_idx = np.nonzero(val_mask)[0]
-        fold_train = train_data.take(tr_idx)
-        fold_val = train_data.take(va_idx)
+        with _tspans.span("cv/fold", fold=fold_i):
+            tr_idx = np.nonzero(train_mask)[0]
+            va_idx = np.nonzero(val_mask)[0]
+            fold_train = train_data.take(tr_idx)
+            fold_val = train_data.take(va_idx)
 
-        # the leak-free part: every estimator up to the selector's inputs is
-        # re-fit on the fold's training rows only
-        fitted_t, fitted_stages = fit_and_transform_dag(
-            fold_train, targets, prefitted=prefitted
-        )
-        transformed_v = apply_transformations_dag(fold_val, targets, fitted_stages)
+            # the leak-free part: every estimator up to the selector's
+            # inputs is re-fit on the fold's training rows only
+            fitted_t, fitted_stages = fit_and_transform_dag(
+                fold_train, targets, prefitted=prefitted
+            )
+            transformed_v = apply_transformations_dag(
+                fold_val, targets, fitted_stages
+            )
 
-        xt, yt = _arrays(fitted_t, label_feature.name, vector_feature.name)
-        xv, yv = _arrays(transformed_v, label_feature.name, vector_feature.name)
+            xt, yt = _arrays(fitted_t, label_feature.name, vector_feature.name)
+            xv, yv = _arrays(
+                transformed_v, label_feature.name, vector_feature.name
+            )
 
-        for est, grid in selector.models:
-            if est.uid in failed:
-                continue
-            points = expand_grid(grid)
-            try:
-                _sweep_fold(
-                    est, points, xt, yt, xv, yv, evaluator,
-                    per_candidate, fold_i,
-                )
-            except Exception as e:  # candidate-level isolation
-                log.warning(
-                    "Model %s failed workflow CV: %s", type(est).__name__, e
-                )
-                failed.add(est.uid)
-                per_candidate = {
-                    k: v
-                    for k, v in per_candidate.items()
-                    if v.model_uid != est.uid
-                }
+            for est, grid in selector.models:
+                if est.uid in failed:
+                    continue
+                points = expand_grid(grid)
+                try:
+                    with _tspans.span(
+                        "cv/candidate",
+                        model=type(est).__name__, points=len(points),
+                    ):
+                        _sweep_fold(
+                            est, points, xt, yt, xv, yv, evaluator,
+                            per_candidate, fold_i,
+                        )
+                except Exception as e:  # candidate-level isolation
+                    log.warning(
+                        "Model %s failed workflow CV: %s",
+                        type(est).__name__, e,
+                    )
+                    failed.add(est.uid)
+                    per_candidate = {
+                        k: v
+                        for k, v in per_candidate.items()
+                        if v.model_uid != est.uid
+                    }
 
     results = list(per_candidate.values())
     if not results:

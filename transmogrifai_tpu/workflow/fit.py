@@ -23,6 +23,7 @@ from ..dataset import Dataset
 from ..features.feature import Feature
 from ..resilience import distributed, faults
 from ..stages.base import Estimator, Model, PipelineStage, Transformer
+from ..telemetry import spans as _tspans
 from .dag import compute_dag
 
 
@@ -72,64 +73,82 @@ def _fit_layers(
     ``dataset_box`` is a 1-element list carrying the evolving dataset."""
     dataset = dataset_box[0]
     for li, layer in enumerate(layers):
-        transformers: list[Transformer] = []
-        newly_fitted = False
-        for stage in layer:
-            if stage.uid in prefitted:
-                model = prefitted[stage.uid]
-                assert isinstance(model, Transformer)
-                fitted[stage.uid] = model
-                transformers.append(model)
-            elif isinstance(stage, Estimator):
-                if plan is not None:
-                    plan.on_stage_fit(stage)
-                model = stage.fit(dataset)
-                fitted[stage.uid] = model
-                transformers.append(model)
-                newly_fitted = True
-            elif isinstance(stage, Transformer):
-                fitted[stage.uid] = stage
-                transformers.append(stage)
-            else:
-                raise TypeError(f"Cannot fit {stage}")
-        for t in transformers:
-            dataset = t.transform(dataset)
-            if plan is not None:
-                corrupted = plan.on_stage_output(t, dataset[t.output_name])
-                if corrupted is not None:
-                    dataset = dataset.with_column(t.output_name, corrupted)
-        # pipelined layer execution (compiler.dispatch): layer li's
-        # transforms just materialized the feature matrices layer li+1's
-        # estimators will fit on — start their device uploads NOW so the
-        # transfer overlaps the checkpoint save and remaining host work
-        # instead of serializing in front of the first fit dispatch
-        _prefetch_next_layer_inputs(layers, li, dataset, prefitted)
-        if checkpoint is not None and (
-            newly_fitted or not checkpoint.has_layer(li)
-        ):
-            from ..parallel.mesh import execution_mesh
-
-            # resume skips re-serializing layers restored intact from disk
-            # (large fitted arrays make that pure wasted compression/IO)
-            checkpoint.save_layer(
-                li,
-                signature,
-                [
-                    (pos, s.uid, fitted[s.uid])
-                    for pos, s in enumerate(layer)
-                    if isinstance(fitted[s.uid], Model)
-                ],
-                mesh_info=distributed.mesh_fingerprint(execution_mesh()),
+        # telemetry: one span per DAG layer, child spans per estimator fit
+        # and per transform — the layer/stage hierarchy in the Chrome trace
+        with _tspans.span("train/layer", index=li, stages=len(layer)):
+            dataset = _fit_one_layer(
+                li, layer, dataset, fitted, prefitted, plan, checkpoint,
+                signature, layers,
             )
-        if plan is not None:
-            plan.on_layer_end(li)
-        # heartbeat pulse at the layer boundary: the checkpoint for this
-        # layer is on disk, so a host declared dead here fails over with
-        # zero lost work
-        controller = distributed.active_controller()
-        if controller is not None:
-            controller.on_layer_end(li)
     dataset_box[0] = dataset
+
+
+def _fit_one_layer(
+    li, layer, dataset, fitted, prefitted, plan, checkpoint, signature,
+    layers,
+) -> Dataset:
+    """One DAG layer: fit estimators, apply transforms, prefetch the next
+    layer's inputs, checkpoint, heartbeat. Returns the evolved dataset."""
+    transformers: list[Transformer] = []
+    newly_fitted = False
+    for stage in layer:
+        if stage.uid in prefitted:
+            model = prefitted[stage.uid]
+            assert isinstance(model, Transformer)
+            fitted[stage.uid] = model
+            transformers.append(model)
+        elif isinstance(stage, Estimator):
+            if plan is not None:
+                plan.on_stage_fit(stage)
+            with _tspans.span("train/fit", stage=type(stage).__name__):
+                model = stage.fit(dataset)
+            fitted[stage.uid] = model
+            transformers.append(model)
+            newly_fitted = True
+        elif isinstance(stage, Transformer):
+            fitted[stage.uid] = stage
+            transformers.append(stage)
+        else:
+            raise TypeError(f"Cannot fit {stage}")
+    for t in transformers:
+        with _tspans.span("train/transform", stage=type(t).__name__):
+            dataset = t.transform(dataset)
+        if plan is not None:
+            corrupted = plan.on_stage_output(t, dataset[t.output_name])
+            if corrupted is not None:
+                dataset = dataset.with_column(t.output_name, corrupted)
+    # pipelined layer execution (compiler.dispatch): layer li's
+    # transforms just materialized the feature matrices layer li+1's
+    # estimators will fit on — start their device uploads NOW so the
+    # transfer overlaps the checkpoint save and remaining host work
+    # instead of serializing in front of the first fit dispatch
+    _prefetch_next_layer_inputs(layers, li, dataset, prefitted)
+    if checkpoint is not None and (
+        newly_fitted or not checkpoint.has_layer(li)
+    ):
+        from ..parallel.mesh import execution_mesh
+
+        # resume skips re-serializing layers restored intact from disk
+        # (large fitted arrays make that pure wasted compression/IO)
+        checkpoint.save_layer(
+            li,
+            signature,
+            [
+                (pos, s.uid, fitted[s.uid])
+                for pos, s in enumerate(layer)
+                if isinstance(fitted[s.uid], Model)
+            ],
+            mesh_info=distributed.mesh_fingerprint(execution_mesh()),
+        )
+    if plan is not None:
+        plan.on_layer_end(li)
+    # heartbeat pulse at the layer boundary: the checkpoint for this
+    # layer is on disk, so a host declared dead here fails over with
+    # zero lost work
+    controller = distributed.active_controller()
+    if controller is not None:
+        controller.on_layer_end(li)
+    return dataset
 
 
 def _prefetch_next_layer_inputs(layers, li, dataset, prefitted) -> None:

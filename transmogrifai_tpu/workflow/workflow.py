@@ -22,6 +22,7 @@ from ..features.feature import Feature
 from ..readers.core import DataReader, DatasetReader
 from ..selector.model_selector import ModelSelector, SelectedModel
 from ..stages.base import Estimator, PipelineStage
+from ..telemetry import spans as _tspans
 from ..types.columns import NumericColumn, VectorColumn
 from .dag import compute_dag, raw_features_of, validate_stages
 from .fit import apply_transformations_dag, fit_and_transform_dag
@@ -250,7 +251,8 @@ class Workflow:
         selector = selectors[0] if selectors else None
 
         raw_features = raw_features_of(self.result_features)
-        raw = self.reader.generate_dataset(raw_features)
+        with _tspans.span("train/ingest", features=len(raw_features)):
+            raw = self.reader.generate_dataset(raw_features)
         if raw.num_rows == 0:
             raise ValueError("Input dataset cannot be empty")
         log.info("Generated raw data: %d rows, %d features", raw.num_rows, len(raw_features))
@@ -446,18 +448,21 @@ class Workflow:
         if selector is not None and holdout_data is not None:
             sel_model = fitted[selector.uid]
             assert isinstance(sel_model, SelectedModel)
-            transformed = apply_transformations_dag(
-                holdout_data, self.result_features, fitted
-            )
-            label_name, vec_name = selector.input_names
-            label = transformed[label_name]
-            vec = transformed[vec_name]
-            assert isinstance(label, NumericColumn) and isinstance(vec, VectorColumn)
-            holdout_metrics = sel_model.evaluate_holdout(
-                np.asarray(vec.values, dtype=np.float32),
-                label.values.astype(np.float64),
-                selector.evaluator,
-            )
+            with _tspans.span("train/eval", rows=len(holdout_data)):
+                transformed = apply_transformations_dag(
+                    holdout_data, self.result_features, fitted
+                )
+                label_name, vec_name = selector.input_names
+                label = transformed[label_name]
+                vec = transformed[vec_name]
+                assert isinstance(label, NumericColumn) and isinstance(
+                    vec, VectorColumn
+                )
+                holdout_metrics = sel_model.evaluate_holdout(
+                    np.asarray(vec.values, dtype=np.float32),
+                    label.values.astype(np.float64),
+                    selector.evaluator,
+                )
             log.info("Holdout metrics: %s", holdout_metrics)
 
         label_summary = None
@@ -930,6 +935,16 @@ class WorkflowModel:
         serve = self._serving_resilience_line()
         if serve:
             lines.append(serve)
+        # one consolidated telemetry line (span/event counts + serve
+        # latency quantiles) pointing at the full export surfaces
+        try:
+            from ..telemetry import summary_line as _tel_line
+
+            tel = _tel_line()
+            if tel:
+                lines.append(tel)
+        except Exception as e:  # telemetry must never break the summary
+            log.debug("telemetry summary line skipped: %s", e)
         analysis = getattr(self, "analysis", None) or {}
         if analysis.get("findings"):
             codes: dict[str, int] = {}
