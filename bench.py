@@ -650,12 +650,230 @@ def bench_wide_mlp(
     }
 
 
+def _serve_loadtest_model():
+    """Train the small seeded mixed-type flow the serve loadtest scores
+    (Real + Real + PickList so the transmogrify plane has multiple
+    vectorizer members and fusion/priming engage; one LR candidate keeps
+    the CI smoke run fast)."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.types.columns import column_from_values
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    rng = np.random.default_rng(17)
+    n = 512
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    city = [["a", "b", "c", "d"][i % 4] for i in range(n)]
+    label = (x1 + 0.5 * x2 + 0.2 * rng.normal(size=n) > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+        "city": column_from_values(T.PickList, city),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    )
+    pred = selector.set_input(resp, vec).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    rows = [
+        {"x1": float(a), "x2": float(b), "city": c}
+        for a, b, c in zip(x1, x2, city)
+    ]
+    return model, rows
+
+
+def bench_serve_loadtest(
+    rates=None,
+    duration: float = 3.0,
+    seed: int = 6,
+    deadline: float = 0.25,
+    bursts=None,
+    chaos: bool = False,
+    max_queue_rows: int = 256,
+    max_batch_rows: int = 64,
+    service_time: float | None = None,
+) -> dict:
+    """Open-loop standing-service load test (serving/loadtest.py): seeded
+    arrival schedules on a virtual clock, REAL measured batch execution
+    seconds advancing it — so the percentiles carry true service cost
+    without one wall-clock sleep. Runs each rate in ``rates`` (default: a
+    healthy 200/s and an overloaded 800/s so the report shows both sides
+    of the shed cliff) and emits p50/p95/p99 latency, shed rate, goodput,
+    the typed rejection taxonomy, and the reconciliation verdict per
+    rate — the BENCH_r06.json regression shape.
+
+    ``service_time`` (seconds per micro-batch) replaces the measured real
+    execution cost with a DETERMINISTIC virtual one: the report becomes
+    machine-independent, so the overload/shed numbers are directly
+    regression-comparable across hosts (capacity = max_batch_rows /
+    service_time rows per virtual second). Without it the virtual clock
+    advances by each batch's measured real seconds — true service cost on
+    this host, at the price of host-dependence."""
+    from transmogrifai_tpu.local.scoring import score_function
+    from transmogrifai_tpu.resilience import FaultPlan, installed
+    from transmogrifai_tpu.serving import ServiceConfig, run_loadtest
+
+    rates = [float(r) for r in (rates or (200.0, 800.0))]
+    svc_time = None
+    if service_time is not None:
+        fixed = float(service_time)
+        svc_time = lambda n: fixed  # noqa: E731
+    model, rows = _serve_loadtest_model()
+    fn = score_function(model)
+    # warm the power-of-two buckets the batcher will hit, so rate #1 is a
+    # serving benchmark, not a first-compile benchmark
+    fn.batch(rows[:max_batch_rows])
+    fn.batch(rows[:1])
+    per_rate = []
+    for rate in rates:
+        plan = FaultPlan(seed=seed)
+        for spec in bursts or ():
+            parts = [float(x) for x in str(spec).split(":")]
+            if len(parts) != 3:
+                raise SystemExit(
+                    f"--burst wants START:DUR:MULT, got {spec!r}"
+                )
+            plan.burst_arrivals(
+                start=parts[0], duration=parts[1], multiplier=parts[2]
+            )
+        if chaos:
+            plan.slow_stage(delay=0.005, times=200)
+            plan.fail_stage_transform(target="modelSelector", times=10)
+        cfg = ServiceConfig(
+            max_queue_rows=max_queue_rows, max_batch_rows=max_batch_rows
+        )
+        if chaos or bursts:
+            with installed(plan):
+                rep = run_loadtest(
+                    fn, rows, rate=rate, duration=duration, seed=seed,
+                    deadline=deadline, config=cfg, plan=plan,
+                    service_time=svc_time,
+                )
+            rep["chaos_fired"] = [list(x) for x in plan.fired[:8]]
+        else:
+            rep = run_loadtest(
+                fn, rows, rate=rate, duration=duration, seed=seed,
+                deadline=deadline, config=cfg, service_time=svc_time,
+            )
+        per_rate.append(rep)
+    return {
+        "metric": "serve_loadtest_open_loop",
+        # the headline value: goodput at the HIGHEST offered rate — the
+        # number overload regressions move first
+        "value": per_rate[-1]["goodput_rows_per_s"],
+        "unit": "rows/s goodput at max offered rate",
+        "seed": seed,
+        "duration_s": duration,
+        "deadline_s": deadline,
+        "chaos": bool(chaos),
+        "bursts": [str(b) for b in (bursts or ())],
+        "service_time_s": service_time,
+        "config": (
+            f"synthetic Real+Real+PickList LR flow (512 fit rows), "
+            f"queue bound {max_queue_rows} rows, micro-batch "
+            f"{max_batch_rows} rows, virtual clock w/ "
+            + (
+                f"fixed {service_time * 1e3:g} ms batch cost "
+                f"(deterministic)" if service_time is not None
+                else "measured batch cost"
+            )
+        ),
+        "rates": per_rate,
+    }
+
+
+def _build_parser():
+    """Argparse front-end: every historical ``bench.py <mode>`` argv mode
+    is a subcommand of the same name (so invocations never changed), and
+    modes with real knobs — ``serve-loadtest --rate --burst --seed`` —
+    get a sane home instead of positional-argv archaeology."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="bench.py",
+        description=(
+            "transmogrifai_tpu benchmark modes; prints one JSON report "
+            "per run (no mode = the full flagship suite)"
+        ),
+    )
+    sub = p.add_subparsers(dest="mode", metavar="MODE")
+    for name, hlp in (
+        ("scale", "boosted trees, 1M rows x 64 feats"),
+        ("scale256", "boosted trees, >128-bin kernel path"),
+        ("scalewide", "boosted trees, 500-feat wide shape"),
+        ("embeddings", "word2vec + LDA"),
+        ("logsweep", "72-fit logistic sweep"),
+        ("wide", "wide synthetic MLP (bf16 matmuls)"),
+        ("coldprobe", "fresh-process cold flagship probe"),
+        ("flagship", "the full flagship suite (also the no-mode default)"),
+    ):
+        sub.add_parser(name, help=hlp)
+    sl = sub.add_parser(
+        "serve-loadtest",
+        help=(
+            "open-loop standing-service load test: seeded arrival "
+            "schedules on a virtual clock (no sleeps), p50/p95/p99 + "
+            "shed rate + goodput per rate"
+        ),
+    )
+    sl.add_argument(
+        "--rate", type=float, action="append", dest="rates", metavar="RPS",
+        help="arrival rate(s) in requests per virtual second; repeatable "
+             "(default: 200 and 800 — one healthy, one overloaded)",
+    )
+    sl.add_argument(
+        "--duration", type=float, default=3.0,
+        help="virtual seconds of arrivals per rate (default 3.0)",
+    )
+    sl.add_argument("--seed", type=int, default=6, help="schedule seed")
+    sl.add_argument(
+        "--deadline", type=float, default=0.25,
+        help="per-request latency budget in seconds (default 0.25)",
+    )
+    sl.add_argument(
+        "--burst", action="append", dest="bursts", metavar="START:DUR:MULT",
+        help="arrival burst window(s), e.g. 1.0:0.5:8 = 8x rate for "
+             "0.5 s starting at t=1.0; repeatable",
+    )
+    sl.add_argument(
+        "--chaos", action="store_true",
+        help="install a seeded FaultPlan chaos storm on top of any "
+             "bursts: slow_stage simulated latency + stage-failure storms",
+    )
+    sl.add_argument("--max-queue-rows", type=int, default=256)
+    sl.add_argument("--max-batch-rows", type=int, default=64)
+    sl.add_argument(
+        "--service-time", type=float, default=None, metavar="SECS",
+        help="fixed virtual seconds per micro-batch instead of measured "
+             "real cost — makes the report machine-independent (capacity "
+             "= max-batch-rows / service-time rows per virtual second)",
+    )
+    sl.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the JSON report to PATH",
+    )
+    return p
+
+
 def main() -> None:
-    """Argv dispatch wrapped with the ``--trace`` flag: when present (bare
-    or ``--trace=PATH``), the buffered telemetry spans are written as a
-    Chrome trace-event document beside the JSON output when the selected
-    bench mode finishes — open it at ui.perfetto.dev to see the
-    layer/fold/stage nesting behind the wall-clock numbers."""
+    """Parse argv and dispatch, wrapped with the ``--trace`` flag: when
+    present (bare or ``--trace=PATH``), the buffered telemetry spans are
+    written as a Chrome trace-event document beside the JSON output when
+    the selected bench mode finishes — open it at ui.perfetto.dev to see
+    the layer/fold/stage nesting behind the wall-clock numbers.
+
+    ``--trace`` is stripped before argparse runs so the bare form keeps
+    working in any position (``--trace <mode>`` must not eat the mode as
+    its value)."""
     import sys
 
     trace_path = None
@@ -664,8 +882,9 @@ def main() -> None:
             val = a.split("=", 1)[1] if "=" in a else ""
             trace_path = val or "bench_trace.json"
             sys.argv.remove(a)
+    ns = _build_parser().parse_args()
     try:
-        _dispatch()
+        _dispatch(ns)
     finally:
         if trace_path is not None:
             from transmogrifai_tpu.telemetry import export_chrome_trace
@@ -677,27 +896,26 @@ def main() -> None:
             )
 
 
-def _dispatch() -> None:
-    import sys
-
+def _dispatch(ns) -> None:
+    mode = ns.mode
     scale_configs = {
         # metric suffix: (rows, feats, rounds, depth, bins)
         "scale": (1_000_000, 64, 20, 6, 32),
         "scale256": (500_000, 64, 10, 6, 256),   # >128-bin kernel path
         "scalewide": (1_000_000, 500, 10, 6, 32),  # BASELINE.json config-5 shape
     }
-    if len(sys.argv) > 1 and sys.argv[1] in scale_configs:
-        rows, feats, rounds, depth, bins = scale_configs[sys.argv[1]]
+    if mode in scale_configs:
+        rows, feats, rounds, depth, bins = scale_configs[mode]
         scale = bench_boosted_scale(
             n_rows=rows, n_feats=feats, num_rounds=rounds,
             max_depth=depth, num_bins=bins,
         )
-        base = _cpu_workload_baseline(sys.argv[1])
+        base = _cpu_workload_baseline(mode)
         vsb = round(base["value"] / scale["train_s"], 3) if base else 0.0
         print(
             json.dumps(
                 {
-                    "metric": f"boosted_trees_{sys.argv[1]}_train_wallclock",
+                    "metric": f"boosted_trees_{mode}_train_wallclock",
                     "value": round(scale["train_s"], 3),
                     "unit": "s",
                     "vs_baseline": vsb,
@@ -718,7 +936,7 @@ def _dispatch() -> None:
             )
         )
         return
-    if len(sys.argv) > 1 and sys.argv[1] == "embeddings":
+    if mode == "embeddings":
         emb = bench_embeddings()
         w2v_base = _cpu_workload_baseline("word2vec")
         lda_base = _cpu_workload_baseline("lda")
@@ -758,7 +976,7 @@ def _dispatch() -> None:
             )
         )
         return
-    if len(sys.argv) > 1 and sys.argv[1] == "logsweep":
+    if mode == "logsweep":
         ls = bench_logistic_sweep()
         base = _cpu_workload_baseline("logistic_sweep")
         vsb = round(base["value"] / ls["train_s"], 3) if base else 0.0
@@ -779,7 +997,7 @@ def _dispatch() -> None:
             )
         )
         return
-    if len(sys.argv) > 1 and sys.argv[1] == "wide":
+    if mode == "wide":
         wide = bench_wide_mlp()
         print(
             json.dumps(
@@ -797,8 +1015,22 @@ def _dispatch() -> None:
             )
         )
         return
-    if len(sys.argv) > 1 and sys.argv[1] == "coldprobe":
+    if mode == "coldprobe":
         print(json.dumps(bench_titanic_cold()))
+        return
+    if mode == "serve-loadtest":
+        report = bench_serve_loadtest(
+            rates=ns.rates, duration=ns.duration, seed=ns.seed,
+            deadline=ns.deadline, bursts=ns.bursts, chaos=ns.chaos,
+            max_queue_rows=ns.max_queue_rows,
+            max_batch_rows=ns.max_batch_rows,
+            service_time=ns.service_time,
+        )
+        doc = json.dumps(report)
+        print(doc)
+        if ns.out:
+            with open(ns.out, "w") as fh:
+                fh.write(doc + "\n")
         return
     # cold probe FIRST: a fresh process against whatever program bank is
     # on disk — the number one cold training run actually pays (the

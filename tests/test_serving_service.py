@@ -1,0 +1,1161 @@
+"""Standing scoring service suite (transmogrifai_tpu/serving/): bounded
+admission, dynamic micro-batching, deadline budgets, tiered load shedding
+with hysteresis, chaos-proven graceful degradation, and the thread-safety
+hardening of the shared sentinel/breaker/quarantine state.
+
+Everything runs on injectable/virtual clocks — zero real sleeps; the
+open-loop loadtest harness drives overload entirely in virtual time.
+Markers: serving, faults.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.resilience import FaultPlan, installed
+from transmogrifai_tpu.resilience.guards import ScoreGuard
+from transmogrifai_tpu.resilience.sentinel import (
+    BreakerConfig,
+    CircuitBreaker,
+    QuarantineLog,
+    QuarantineRecord,
+    SchemaSentinel,
+)
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.serving import (
+    AdmissionQueue,
+    DeadlineBudget,
+    DeadlineExceeded,
+    LoadShedder,
+    MicroBatcher,
+    RejectedByAdmission,
+    ScoringService,
+    ServiceConfig,
+    ShedConfig,
+    run_loadtest,
+)
+from transmogrifai_tpu.serving import deadline as sdl
+from transmogrifai_tpu.serving import shedding as sshed
+from transmogrifai_tpu.serving.loadtest import LoadSchedule, VirtualClock
+from transmogrifai_tpu.telemetry import events as tevents
+from transmogrifai_tpu.telemetry import metrics as tm
+from transmogrifai_tpu.telemetry import spans as tspans
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+pytestmark = [pytest.mark.serving, pytest.mark.faults]
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def _binary_ds(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = (x1 + 0.5 * x2 + 0.3 * rng.normal(size=n) > 0).astype(float)
+    return Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+    })
+
+
+@pytest.fixture(scope="module")
+def trained():
+    uid_util.reset()
+    ds = _binary_ds(n=120, seed=3)
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    )
+    pred = selector.set_input(resp, vec).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    return ds, model
+
+
+@pytest.fixture(scope="module")
+def trained_fused():
+    """A flow whose transmogrify plane has MULTIPLE vectorizer members
+    feeding one combiner — the shape on which fusion (and fit-static
+    priming) engages."""
+    uid_util.reset()
+    rng = np.random.default_rng(5)
+    n = 96
+    x1 = rng.normal(size=n)
+    city = [["a", "b", "c"][i % 3] for i in range(n)]
+    label = (x1 > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "city": column_from_values(T.PickList, city),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    )
+    pred = selector.set_input(resp, vec).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    return ds, model
+
+
+@pytest.fixture()
+def score_fn(trained):
+    _, model = trained
+    return score_function(model)
+
+
+@pytest.fixture()
+def rows():
+    rng = np.random.default_rng(11)
+    return [
+        {"x1": float(a), "x2": float(b)}
+        for a, b in zip(rng.normal(size=64), rng.normal(size=64))
+    ]
+
+
+def _mkreq(n_rows=1, budget=None, enq=0.0):
+    """A minimal queue item: anything with .rows / .budget / .enqueued_at."""
+    class R:
+        pass
+
+    r = R()
+    r.rows = [{"x1": 0.0}] * n_rows
+    r.budget = budget
+    r.enqueued_at = enq
+    return r
+
+
+# ------------------------------------------------------------ admission queue
+class TestAdmissionQueue:
+    def test_bounded_in_rows_typed_rejection(self):
+        q = AdmissionQueue(max_rows=4)
+        q.offer(_mkreq(3))
+        with pytest.raises(RejectedByAdmission) as ei:
+            q.offer(_mkreq(2))
+        assert ei.value.reason == "queue_full"
+        q.offer(_mkreq(1))  # exactly at the bound fits
+        assert q.depth_rows() == 4 and q.peak_rows == 4
+
+    def test_fifo_pop_many_respects_row_budget(self):
+        q = AdmissionQueue(max_rows=64)
+        items = [_mkreq(3), _mkreq(3), _mkreq(3)]
+        for it in items:
+            q.offer(it)
+        got = q.pop_many(max_rows=6)
+        assert got == items[:2] and q.depth_rows() == 3
+
+    def test_oversized_single_request_still_progresses(self):
+        q = AdmissionQueue(max_rows=64)
+        big = _mkreq(32)
+        q.offer(big)
+        assert q.pop_many(max_rows=8) == [big]
+
+    def test_closed_refuses_with_stopped(self):
+        q = AdmissionQueue(max_rows=8)
+        q.offer(_mkreq(1))
+        q.close()
+        with pytest.raises(RejectedByAdmission) as ei:
+            q.offer(_mkreq(1))
+        assert ei.value.reason == "stopped"
+        # queued items survive close for draining
+        assert len(q.drain()) == 1 and q.depth_rows() == 0
+
+    def test_unknown_reason_rejected(self):
+        with pytest.raises(ValueError):
+            RejectedByAdmission("nope")
+
+
+# ------------------------------------------------------------ deadline budget
+class TestDeadlineBudget:
+    def setup_method(self):
+        tm.REGISTRY.reset_metrics_for_tests()
+
+    def test_remaining_on_injectable_clock(self):
+        clk = FakeClock()
+        b = DeadlineBudget(0.100, clock=clk)
+        clk.now = 0.040
+        assert b.remaining() == pytest.approx(0.060)
+        assert not b.expired()
+        clk.now = 0.120
+        assert b.expired()
+
+    def test_consume_burns_simulated_seconds(self):
+        clk = FakeClock()
+        b = DeadlineBudget(0.100, clock=clk)
+        b.consume(0.075)
+        assert b.remaining() == pytest.approx(0.025)
+        b.consume(0.050)
+        assert b.expired()
+
+    def test_covers_uses_recorded_family_p95(self):
+        clk = FakeClock()
+        # seed a dispatch-family history of ~50 ms
+        h = tm.REGISTRY.histogram(
+            "tptpu_serve_seconds", labels={"stage": "dispatch"}
+        )
+        for _ in range(50):
+            h.observe(0.050)
+        assert sdl.family_p95("dispatch") > 0.030
+        tight = DeadlineBudget(0.010, clock=clk)
+        roomy = DeadlineBudget(1.0, clock=clk)
+        assert not tight.covers()
+        assert roomy.covers()
+
+    def test_checkpoint_raises_typed_counts_and_emits(self):
+        clk = FakeClock()
+        h = tm.REGISTRY.histogram(
+            "tptpu_serve_seconds", labels={"stage": "featurize"}
+        )
+        for _ in range(50):
+            h.observe(0.080)
+        b = DeadlineBudget(0.020, clock=clk)
+        with sdl.active(b):
+            sdl.checkpoint("sentinel")  # no sentinel history: 0 required
+            with pytest.raises(DeadlineExceeded) as ei:
+                sdl.checkpoint("featurize")
+        assert ei.value.family == "featurize"
+        assert ei.value.required > ei.value.remaining
+        kinds = [e["kind"] for e in tevents.recent(5)]
+        assert "deadline_exceeded" in kinds
+        # the OUTCOME counter belongs to the service (one checkpoint trip
+        # can shed several co-batched members) — a bare checkpoint must
+        # not book it
+        assert (
+            tm.REGISTRY.counter("tptpu_serve_deadline_exceeded_total").value
+            == 0
+        )
+
+    def test_no_history_only_spent_budget_rejects(self):
+        clk = FakeClock()
+        b = DeadlineBudget(0.010, clock=clk)
+        with sdl.active(b):
+            sdl.checkpoint("dispatch")  # 0 required, 10 ms left: passes
+            clk.now = 0.020
+            with pytest.raises(DeadlineExceeded):
+                sdl.checkpoint("dispatch")
+
+    def test_active_installs_thread_locally_and_restores(self):
+        b1 = DeadlineBudget(1.0, clock=FakeClock())
+        b2 = DeadlineBudget(2.0, clock=FakeClock())
+        assert sdl.current() is None
+        with sdl.active(b1):
+            assert sdl.current() is b1
+            with sdl.active(b2):
+                assert sdl.current() is b2
+            assert sdl.current() is b1
+        assert sdl.current() is None
+        seen = []
+
+        def other():
+            seen.append(sdl.current())
+
+        with sdl.active(b1):
+            th = threading.Thread(target=other)
+            th.start()
+            th.join()
+        assert seen == [None]  # budgets never leak across threads
+
+
+# ------------------------------------------------------------- micro batcher
+class TestMicroBatcher:
+    def test_assembles_up_to_max_rows(self):
+        q = AdmissionQueue(max_rows=64)
+        clk = FakeClock()
+        mb = MicroBatcher(q, max_rows=4, clock=clk)
+        for _ in range(3):
+            q.offer(_mkreq(2))
+        plan = mb.next_batch()
+        assert len(plan.requests) == 2 and len(plan.rows) == 4
+        assert mb.stats()["batchesAssembled"] == 1
+
+    def test_expired_members_split_out(self):
+        q = AdmissionQueue(max_rows=64)
+        clk = FakeClock()
+        mb = MicroBatcher(q, max_rows=16, clock=clk)
+        dead = DeadlineBudget(0.010, clock=clk)
+        live = DeadlineBudget(10.0, clock=clk)
+        q.offer(_mkreq(1, budget=dead))
+        q.offer(_mkreq(1, budget=live))
+        clk.now = 0.020  # first budget expired while queued
+        plan = mb.next_batch()
+        assert len(plan.expired) == 1 and len(plan.requests) == 1
+        assert plan.budget is live
+
+    def test_tightest_member_budget_wins(self):
+        q = AdmissionQueue(max_rows=64)
+        clk = FakeClock()
+        mb = MicroBatcher(q, max_rows=16, clock=clk)
+        loose = DeadlineBudget(10.0, clock=clk)
+        tight = DeadlineBudget(1.0, clock=clk)
+        q.offer(_mkreq(1, budget=loose))
+        q.offer(_mkreq(1, budget=tight))
+        q.offer(_mkreq(1))  # no budget
+        plan = mb.next_batch()
+        assert plan.budget is tight and len(plan.rows) == 3
+
+
+# -------------------------------------------------------------- load shedder
+class TestLoadShedder:
+    def setup_method(self):
+        tm.REGISTRY.reset_metrics_for_tests()
+        sshed.reset_process_flags_for_tests()
+
+    def teardown_method(self):
+        sshed.reset_process_flags_for_tests()
+
+    def test_tiers_climb_in_order(self):
+        sh = LoadShedder(ShedConfig(), capacity=100)
+        assert sh.update(10, 0, 0.0) == 0
+        assert sh.update(55, 0, 0.0) == 1   # detail_enter 0.50
+        assert sh.update(75, 0, 0.0) == 2   # drift_enter 0.70
+        assert sh.update(95, 0, 0.0) == 3   # reject_enter 0.90
+        assert sh.reject_admissions
+        assert sh.stats()["tierEntries"] == {
+            "shed_detail": 1, "shed_drift": 1, "reject": 1,
+        }
+
+    def test_hysteresis_no_flapping_at_the_boundary(self):
+        sh = LoadShedder(ShedConfig(), capacity=100)
+        sh.update(95, 0, 0.0)
+        assert sh.tier == 3
+        # load falls below ENTER but above EXIT (0.65): tier holds
+        sh.update(80, 0, 0.0)
+        assert sh.tier == 3
+        transitions = sh.transitions
+        # hovering there forever never flaps
+        for _ in range(10):
+            sh.update(80, 0, 0.0)
+        assert sh.transitions == transitions
+        # below reject_exit: drops to 2 (still above drift_exit 0.50)
+        sh.update(60, 0, 0.0)
+        assert sh.tier == 2
+        sh.update(10, 0, 0.0)
+        assert sh.tier == 0
+
+    def test_side_effects_detail_spans_and_drift_flag(self):
+        sh = LoadShedder(ShedConfig(), capacity=100)
+        assert tspans.stage_detail(1000) and not sshed.drift_shed()
+        sh.update(55, 0, 0.0)
+        assert not tspans.stage_detail(1000)  # tier 1 sheds detail spans
+        assert not sshed.drift_shed()
+        sh.update(75, 0, 0.0)
+        assert sshed.drift_shed()             # tier 2 sheds drift observe
+        sh.update(5, 0, 0.0)
+        assert tspans.stage_detail(1000) and not sshed.drift_shed()
+
+    def test_open_breakers_add_load(self):
+        sh = LoadShedder(ShedConfig(breaker_weight=0.5), capacity=100)
+        # queue alone: below detail tier; breakers half open: tier engages
+        assert sh.update(30, 0, 0.0) == 0
+        assert sh.update(30, 0, 0.5) == 1
+
+    def test_transitions_emit_load_shed_events(self):
+        sh = LoadShedder(ShedConfig(), capacity=100)
+        sh.update(95, 0, 0.0)
+        evts = [e for e in tevents.recent(10) if e["kind"] == "load_shed"]
+        assert evts and evts[-1]["tier"] == "reject"
+        assert (
+            tm.REGISTRY.counter("tptpu_serve_shed_transitions_total").value
+            >= 1
+        )
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            ShedConfig(detail_enter=0.3, detail_exit=0.5)
+
+    def test_reset_restores_process_flags(self):
+        sh = LoadShedder(ShedConfig(), capacity=100)
+        sh.update(95, 0, 0.0)
+        sh.reset()
+        assert sh.tier == 0
+        assert tspans.stage_detail(1000) and not sshed.drift_shed()
+
+
+# ---------------------------------------------------------- service lifecycle
+class TestServiceLifecycle:
+    def test_pump_mode_scores_and_reconciles(self, score_fn, rows):
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(workers=0, max_queue_rows=64, max_batch_rows=8),
+            clock=clk,
+        )
+        svc.start()
+        handles = [svc.submit(dict(r)) for r in rows[:20]]
+        while svc.pump():
+            pass
+        svc.stop()
+        s = svc.stats()
+        assert s["admitted"] == 20 and s["completed"] == 20
+        assert s["outstanding"] == 0 and s["queueDepthRows"] == 0
+        out = handles[0].result(timeout=1)
+        assert len(out) == 1 and isinstance(out[0], dict)
+        assert handles[0].outcome == "completed"
+        assert handles[0].latency() is not None
+
+    def test_batch_results_map_back_to_requests(self, score_fn, rows):
+        """Multi-row requests sliced out of the shared micro-batch match
+        scoring the same rows alone."""
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(workers=0, max_queue_rows=64, max_batch_rows=16),
+            clock=clk,
+        )
+        svc.start()
+        h2 = svc.submit([dict(rows[0]), dict(rows[1])])
+        h1 = svc.submit(dict(rows[2]))
+        while svc.pump():
+            pass
+        svc.stop()
+        solo = score_fn.batch([dict(rows[0]), dict(rows[1]), dict(rows[2])])
+        assert h2.result(timeout=1) == solo[:2]
+        assert h1.result(timeout=1) == solo[2:]
+
+    def test_worker_mode_completes_and_quiesces(self, score_fn, rows):
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(workers=2, max_queue_rows=128, max_batch_rows=16),
+        )
+        svc.start()
+        handles = [svc.submit(dict(rows[i % len(rows)])) for i in range(40)]
+        for h in handles:
+            h.result(timeout=60)
+        svc.stop()
+        s = svc.stats()
+        assert s["admitted"] == 40 and s["completed"] == 40
+        assert s["outstanding"] == 0
+        assert not [
+            t for t in threading.enumerate()
+            if t.name.startswith("tptpu-serve-") and t.is_alive()
+        ]
+
+    def test_submit_after_stop_typed_rejection(self, score_fn, rows):
+        svc = ScoringService(score_fn, ServiceConfig(workers=0))
+        svc.start()
+        svc.stop()
+        with pytest.raises(RejectedByAdmission) as ei:
+            svc.submit(dict(rows[0]))
+        assert ei.value.reason == "stopped"
+        assert svc.stats()["rejected"]["stopped"] == 1
+
+    def test_stop_drains_queued_requests(self, score_fn, rows):
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(workers=0, max_queue_rows=64, max_batch_rows=8),
+            clock=clk,
+        )
+        svc.start()
+        handles = [svc.submit(dict(r)) for r in rows[:10]]
+        svc.stop(drain=True)  # never pumped: drain scores the backlog
+        s = svc.stats()
+        assert s["outstanding"] == 0 and s["queueDepthRows"] == 0
+        assert s["completed"] == 10
+        assert all(h.done() for h in handles)
+
+    def test_context_manager(self, score_fn, rows):
+        with ScoringService(score_fn, ServiceConfig(workers=1)) as svc:
+            h = svc.submit(dict(rows[0]))
+            h.result(timeout=30)
+        assert svc.stats()["outstanding"] == 0
+
+    def test_empty_request_rejected(self, score_fn):
+        svc = ScoringService(score_fn, ServiceConfig(workers=0))
+        svc.start()
+        with pytest.raises(ValueError):
+            svc.submit([])
+        svc.stop()
+
+    def test_start_primes_fusion_from_fit_static_widths(self, trained_fused):
+        _, model = trained_fused
+        fn = score_function(model)
+        assert not fn.fusion.disabled and not fn.fusion.ready()
+        svc = ScoringService(fn, ServiceConfig(workers=0))
+        svc.start()
+        # the fitted vectorizers' meta caches are populated at train time,
+        # so the planner is ready before the first batch ever runs
+        assert fn.fusion.ready()
+        h = svc.submit({"x1": 0.1, "city": "a"})
+        svc.pump()
+        svc.stop()
+        # primed-first-batch output matches an unprimed closure's
+        fresh = score_function(model)
+        assert h.result(timeout=1) == fresh.batch([{"x1": 0.1, "city": "a"}])
+
+    def test_prime_is_safe_on_fusion_disabled_plans(self, score_fn):
+        # the two-Real flow has no combiner: prime() must be a quiet no-op
+        assert score_fn.fusion.disabled
+        assert score_fn.fusion.prime() is False
+
+    def test_unhealthy_batch_is_typed_error_not_crash(self, trained, rows):
+        _, model = trained
+        fn = score_function(model, isolation="raise", breaker=False)
+        clk = VirtualClock()
+        svc = ScoringService(
+            fn, ServiceConfig(workers=0, max_batch_rows=8), clock=clk
+        )
+        svc.start()
+        plan = FaultPlan(seed=1).fail_stage_transform(
+            target="modelSelector", times=1
+        )
+        with installed(plan):
+            h = svc.submit(dict(rows[0]))
+            svc.pump()
+        svc.stop()
+        assert h.outcome == "error"
+        with pytest.raises(Exception):
+            h.result(timeout=1)
+        s = svc.stats()
+        assert s["errors"] == 1 and s["outstanding"] == 0
+
+
+# ------------------------------------------------------------ deadline serving
+class TestServiceDeadlines:
+    def setup_method(self):
+        tm.REGISTRY.reset_metrics_for_tests()
+
+    def test_queued_expiry_is_shed_not_executed(self, score_fn, rows):
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(
+                workers=0, max_queue_rows=64, max_batch_rows=8,
+                default_deadline=0.050,
+            ),
+            clock=clk,
+        )
+        svc.start()
+        h = svc.submit(dict(rows[0]))
+        clk.advance(0.100)  # budget expires while queued
+        h2 = svc.submit(dict(rows[1]))
+        while svc.pump():
+            pass
+        svc.stop()
+        assert h.outcome == "deadline_exceeded"
+        with pytest.raises(DeadlineExceeded):
+            h.result(timeout=1)
+        assert h2.outcome == "completed"
+        s = svc.stats()
+        assert s["shed"]["deadline_exceeded"] == 1
+        assert s["admitted"] == s["completed"] + sum(s["shed"].values())
+
+    def test_admission_rejects_budget_below_pipeline_p95(self, score_fn, rows):
+        h = tm.REGISTRY.histogram(
+            "tptpu_serve_seconds", labels={"stage": "dispatch"}
+        )
+        for _ in range(50):
+            h.observe(0.200)
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn, ServiceConfig(workers=0, default_deadline=0.010),
+            clock=clk,
+        )
+        svc.start()
+        with pytest.raises(DeadlineExceeded):
+            svc.submit(dict(rows[0]))
+        svc.stop()
+        assert svc.stats()["rejected"]["deadline"] == 1
+
+    def test_slow_stage_chaos_burns_budget_mid_execution(self, score_fn, rows):
+        """slow_stage simulated seconds consume the active budget, so the
+        dispatch-family checkpoint rejects the batch DURING execution —
+        without one real sleep."""
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(
+                workers=0, max_batch_rows=8, default_deadline=0.100,
+            ),
+            clock=clk,
+        )
+        svc.start()
+        plan = FaultPlan(seed=2).slow_stage(delay=0.500)
+        with installed(plan):
+            hd = svc.submit(dict(rows[0]))
+            svc.pump()
+        svc.stop()
+        assert hd.outcome == "deadline_exceeded"
+        assert svc.stats()["shed"]["deadline_exceeded"] == 1
+        assert ("slow", plan.fired[0][1]) in plan.fired
+
+    def test_mid_execution_deadline_sheds_only_the_spent_member(
+        self, score_fn, rows
+    ):
+        """Co-batched requests carry their OWN deadline outcomes: when the
+        tightest member's budget trips a checkpoint mid-execution, members
+        that never asked for a deadline still complete (re-executed
+        without the tripped member)."""
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn, ServiceConfig(workers=0, max_batch_rows=8), clock=clk
+        )
+        svc.start()
+        plan = FaultPlan(seed=7).slow_stage(delay=0.500)
+        with installed(plan):
+            tight = svc.submit(dict(rows[0]), deadline=0.100)
+            loose = svc.submit(dict(rows[1]))  # no deadline at all
+            svc.pump()
+            while svc.pump():
+                pass
+        svc.stop()
+        assert tight.outcome == "deadline_exceeded"
+        assert loose.outcome == "completed"
+        assert loose.result(timeout=1) is not None
+        s = svc.stats()
+        assert s["shed"]["deadline_exceeded"] == 1 and s["completed"] == 1
+
+
+# ------------------------------------------------- backpressure and shedding
+class TestServiceBackpressure:
+    #: thresholds pushed above any reachable load so the queue bound, not
+    #: the shed tiers, is the limit under test
+    NO_SHED = ShedConfig(
+        detail_enter=3.0, detail_exit=2.0, drift_enter=5.0, drift_exit=4.0,
+        reject_enter=9.0, reject_exit=8.0,
+    )
+
+    def test_queue_full_typed_rejection(self, score_fn, rows):
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(
+                workers=0, max_queue_rows=4, max_batch_rows=4,
+                shed=self.NO_SHED,
+            ),
+            clock=clk,
+        )
+        svc.start()
+        for i in range(4):
+            svc.submit(dict(rows[i]))
+        with pytest.raises(RejectedByAdmission) as ei:
+            svc.submit(dict(rows[4]))
+        assert ei.value.reason == "queue_full"
+        while svc.pump():
+            pass
+        svc.stop()
+        s = svc.stats()
+        assert s["rejected"]["queue_full"] == 1 and s["completed"] == 4
+
+    def test_reject_tier_refuses_then_readmits(self, score_fn, rows):
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(
+                workers=0, max_queue_rows=10, max_batch_rows=4,
+                shed=ShedConfig(
+                    detail_enter=0.30, detail_exit=0.20,
+                    drift_enter=0.50, drift_exit=0.35,
+                    reject_enter=0.85, reject_exit=0.50,
+                ),
+            ),
+            clock=clk,
+        )
+        svc.start()
+        for i in range(9):  # up to load 0.8 at the last admission check
+            svc.submit(dict(rows[i]))
+        with pytest.raises(RejectedByAdmission) as ei:
+            svc.submit(dict(rows[9]))
+        assert ei.value.reason == "shedding"
+        assert svc.shedder.tier == 3
+        # drain below reject_exit: admissions resume (hysteresis honored)
+        while svc.pump():
+            pass
+        assert svc.shedder.tier == 0
+        h = svc.submit(dict(rows[9]))
+        while svc.pump():
+            pass
+        svc.stop()
+        assert h.outcome == "completed"
+        s = svc.stats()
+        assert s["rejected"]["shedding"] == 1
+        assert s["shedding"]["tierEntries"]["reject"] >= 1
+
+    def test_drift_observation_shed_at_tier_two(self, trained, rows):
+        _, model = trained
+        fn = score_function(model)
+        if not fn.drift.enabled:
+            pytest.skip("model carries no serving profiles")
+        before = fn.drift.rows_observed
+        sh = LoadShedder(ShedConfig(), capacity=100)
+        sh.update(75, 0, 0.0)  # tier 2: drift shed process-wide
+        try:
+            fn.batch([dict(rows[0])])
+            assert fn.drift.rows_observed == before  # observation skipped
+        finally:
+            sh.reset()
+        fn.batch([dict(rows[0])])
+        assert fn.drift.rows_observed == before + 1  # restored
+
+
+# ----------------------------------------------------------- open-loop chaos
+class TestChaosLoadtest:
+    def test_reports_are_seed_deterministic(self, score_fn, rows):
+        kw = dict(
+            rate=100.0, duration=1.0, seed=9,
+            service_time=lambda n: 0.004,
+            config=ServiceConfig(max_queue_rows=64, max_batch_rows=16),
+        )
+        a = run_loadtest(score_fn, rows, **kw)
+        b = run_loadtest(score_fn, rows, **kw)
+        assert a == b
+        assert a["reconciled"] and a["completed"] > 0
+
+    def test_burst_windows_multiply_arrivals(self):
+        plan = FaultPlan(seed=0).burst_arrivals(
+            start=0.5, duration=0.5, multiplier=4.0
+        )
+        flat = LoadSchedule(rate=100.0, duration=1.0, seed=0).arrivals()
+        burst = LoadSchedule(rate=100.0, duration=1.0, seed=0).arrivals(plan)
+        assert len(flat) == pytest.approx(100, abs=2)
+        assert len(burst) == pytest.approx(250, abs=5)
+        assert ("burst", "t=0.5") in plan.fired
+
+    def test_overload_sheds_but_goodput_stays_positive(self, score_fn, rows):
+        """Open-loop overload: the service costs more virtual time per
+        batch than the arrival gaps provide, so queue pressure builds;
+        healthy requests keep completing while the excess sheds with typed
+        outcomes, and every counter reconciles."""
+        rep = run_loadtest(
+            score_fn, rows, rate=400.0, duration=1.5, seed=4,
+            deadline=0.100, service_time=lambda n: 0.030,
+            config=ServiceConfig(max_queue_rows=32, max_batch_rows=8),
+        )
+        assert rep["completed"] > 0 and rep["goodput_rows_per_s"] > 0
+        assert rep["shed_total"] + rep["rejected_total"] > 0
+        assert rep["shed_rate"] > 0
+        assert rep["reconciled"]
+        # typed taxonomy: everything shed/rejected has a named bucket
+        assert sum(rep["shed"].values()) == rep["shed_total"]
+        assert sum(rep["rejected"].values()) == rep["rejected_total"]
+
+    def test_full_chaos_soak(self, score_fn, rows):
+        """The acceptance-criteria soak: slow_stage + burst_arrivals +
+        stage-failure storms against the standing service. Healthy goodput
+        stays positive, p99 stays bounded by the deadline ceiling, every
+        shed is typed, counters reconcile, and the service quiesces."""
+        threads_before = {
+            t.name for t in threading.enumerate() if t.is_alive()
+        }
+        plan = (
+            FaultPlan(seed=13)
+            .slow_stage(delay=0.020, times=40)
+            .burst_arrivals(start=0.3, duration=0.4, multiplier=6.0)
+            .fail_stage_transform(target="modelSelector", times=5)
+        )
+        with installed(plan):
+            rep = run_loadtest(
+                score_fn, rows, rate=150.0, duration=1.5, seed=13,
+                deadline=0.250, service_time=lambda n: 0.010,
+                config=ServiceConfig(max_queue_rows=48, max_batch_rows=8),
+                plan=plan,
+            )
+        # graceful degradation, not collapse
+        assert rep["completed"] > 0 and rep["goodput_rows_per_s"] > 0
+        assert rep["reconciled"]
+        # bounded p99: a completed request can never exceed its deadline
+        # budget by more than one batch's service cost
+        # a completed request's latency is capped at its deadline budget
+        # plus one batch's worst cost (0.010 base + 4 slow-stage hits of
+        # 0.020 simulated each) — beyond that the checkpoints shed it
+        assert rep["latency_ms"]["p99"] is not None
+        assert rep["latency_ms"]["p99"] <= 250.0 + 10.0 + 4 * 20.0 + 1.0
+        # the storms actually fired
+        fired_kinds = {k for k, _ in plan.fired}
+        assert {"slow", "burst", "transform"} <= fired_kinds
+        # chaos produced typed degradation somewhere (shed, rejection,
+        # quarantine, or a contained error) — never an untyped loss
+        degraded = (
+            rep["shed_total"] + rep["rejected_total"]
+            + rep["quarantined"] + rep["errors"]
+        )
+        assert degraded > 0
+        # quiesced: no service threads leaked, queue drained
+        leaked = {
+            t.name for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("tptpu-serve-")
+        } - threads_before
+        assert not leaked
+        assert rep["max_queue_depth_rows"] <= 48
+
+    def test_soak_is_deterministic_with_the_same_plan_seed(
+        self, score_fn, rows
+    ):
+        def once():
+            plan = (
+                FaultPlan(seed=21)
+                .slow_stage(delay=0.015, times=20)
+                .burst_arrivals(start=0.2, duration=0.3, multiplier=5.0)
+                .fail_stage_transform(target="modelSelector", times=3)
+            )
+            with installed(plan):
+                return run_loadtest(
+                    score_fn, rows, rate=120.0, duration=1.0, seed=21,
+                    deadline=0.200, service_time=lambda n: 0.008,
+                    config=ServiceConfig(
+                        max_queue_rows=32, max_batch_rows=8
+                    ),
+                    plan=plan,
+                )
+
+        assert once() == once()
+
+    def test_loadtest_uses_no_real_sleeps(self, score_fn, rows):
+        import time as _time
+
+        t0 = _time.perf_counter()
+        rep = run_loadtest(
+            score_fn, rows, rate=200.0, duration=5.0, seed=3,
+            service_time=lambda n: 0.004,
+            config=ServiceConfig(max_queue_rows=64, max_batch_rows=32),
+        )
+        wall = _time.perf_counter() - t0
+        assert rep["virtual_end_s"] >= 5.0
+        # 5 virtual seconds of traffic must cost nowhere near 5 real ones
+        # (scoring ~1000 rows on CPU dominates; sleeping would add 5 s+)
+        assert wall < 4.0
+
+
+# ------------------------------------------------- thread-safety hammer suite
+class TestThreadSafetyHammers:
+    N_THREADS = 8
+
+    def _hammer(self, fn, per_thread=200):
+        errs = []
+        barrier = threading.Barrier(self.N_THREADS)
+
+        def run():
+            barrier.wait()
+            try:
+                for _ in range(per_thread):
+                    fn()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=run) for _ in range(self.N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+
+    def test_schema_sentinel_counters_exact_under_hammer(self):
+        ds = _binary_ds(8)
+        resp, preds = from_dataset(ds, response="label")
+        s = SchemaSentinel([resp, *preds])
+        self._hammer(lambda: s.check_row({"x1": "zzz", "x2": 1.0}))
+        stats = s.stats()
+        total = self.N_THREADS * 200
+        assert stats["rowsSeen"] == total
+        assert stats["violations"]["unparseable"] == total
+        assert stats["byFeature"]["x1"] == total
+
+    def test_quarantine_log_totals_exact_and_batches_thread_local(self):
+        qlog = QuarantineLog(keep=50)
+        counter = {"i": 0}
+        lock = threading.Lock()
+
+        def add():
+            with lock:
+                counter["i"] += 1
+                i = counter["i"]
+            qlog.start_batch()
+            qlog.add(QuarantineRecord(i, "x1", "stage", "boom"))
+            qlog.add(QuarantineRecord(i, "x2", "stage", "boom"))  # same row
+            assert qlog.batch_rows() == {i}  # this thread's batch only
+            assert len(qlog.last) == 2
+
+        self._hammer(add, per_thread=100)
+        stats = qlog.stats()
+        total = self.N_THREADS * 100
+        assert stats["quarantinedRows"] == total
+        assert stats["records"] == 2 * total
+        assert stats["byKind"]["stage"] == 2 * total
+        assert len(qlog.records) == 50  # ring bound holds
+
+    def test_score_guard_counts_exact_under_hammer(self, trained):
+        _, model = trained
+        guard = ScoreGuard()
+
+        class Stage:
+            output_name = "out"
+            uid = "Stage_000000000001"
+
+        stage = Stage()
+        from transmogrifai_tpu.types.columns import NumericColumn
+
+        # a PRESENT NaN (the codec masks NaNs out, so build it directly)
+        col = NumericColumn(
+            T.Real, np.array([np.nan, 1.0]), np.array([True, True])
+        )
+        self._hammer(
+            lambda: guard.apply(stage, col, is_result=True, num_rows=2),
+            per_thread=100,
+        )
+        assert guard.stats()["guardedRows"] == self.N_THREADS * 100
+
+    def test_breaker_transitions_consistent_under_hammer(self):
+        clk = FakeClock()
+        br = CircuitBreaker(
+            "s", BreakerConfig(failure_threshold=3, clock=clk)
+        )
+
+        def step():
+            if br.allow():
+                br.record_failure()
+
+        self._hammer(step, per_thread=100)
+        st = br.stats()
+        assert st["state"] == "open"
+        # every thread observed a consistent machine: exactly one
+        # closed->open transition, no lost counts
+        assert st["transitions"] == {"closed->open": 1}
+        assert (
+            st["shortCircuits"]
+            == self.N_THREADS * 100 - st["consecutiveFailures"]
+        )
+
+    def test_half_open_admits_exactly_one_concurrent_probe(self):
+        clk = FakeClock()
+        br = CircuitBreaker(
+            "s", BreakerConfig(failure_threshold=1, recovery_time=1.0,
+                               clock=clk)
+        )
+        br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        clk.now = 2.0  # recovery window elapsed: next allow() half-opens
+        results = []
+        barrier = threading.Barrier(self.N_THREADS)
+
+        def probe():
+            barrier.wait()
+            results.append(br.allow())
+
+        threads = [
+            threading.Thread(target=probe) for _ in range(self.N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results.count(True) == 1  # exactly one probe passes
+        assert br.state == "half_open"
+        # the losing racers were counted as short circuits
+        assert br.short_circuits >= self.N_THREADS - 1
+        # probe succeeds: breaker closes and normal traffic resumes
+        br.record_success()
+        assert br.state == "closed"
+        assert all(br.allow() for _ in range(4))
+
+    def test_release_probe_unwedges_an_abandoned_half_open_probe(self):
+        """An exception that unwinds between allow() and the outcome
+        record (deadline rejection, guard escalation) must release the
+        probe slot — otherwise the breaker wedges half-open forever."""
+        clk = FakeClock()
+        br = CircuitBreaker(
+            "s", BreakerConfig(failure_threshold=1, recovery_time=1.0,
+                               clock=clk)
+        )
+        br.allow()
+        br.record_failure()
+        clk.now = 2.0
+        assert br.allow()          # probe claimed
+        assert not br.allow()      # slot taken
+        br.release_probe()         # the claimant unwound exceptionally
+        assert br.allow()          # next caller can probe again
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_failed_probe_reopens_and_next_window_reprobes(self):
+        clk = FakeClock()
+        br = CircuitBreaker(
+            "s", BreakerConfig(failure_threshold=1, recovery_time=1.0,
+                               clock=clk)
+        )
+        br.allow()
+        br.record_failure()
+        clk.now = 1.5
+        assert br.allow()          # the probe
+        assert not br.allow()      # concurrent caller: short circuit
+        br.record_failure()        # probe failed: re-open
+        assert br.state == "open"
+        assert not br.allow()
+        clk.now = 3.0              # a fresh window: probe again
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_concurrent_scoring_through_one_closure(self, trained, rows):
+        """The re-entrant seam: N threads score through ONE closure while
+        another thread reads metadata(); counters stay exact and no read
+        tears."""
+        _, model = trained
+        fn = score_function(model)
+        self._hammer(lambda: fn.batch([dict(rows[0]), {"x1": "zzz"}]),
+                     per_thread=25)
+        stats = fn.quarantine.stats()
+        total = self.N_THREADS * 25
+        assert stats["quarantinedRows"] == total
+        assert fn.sentinel.stats()["rowsSeen"] == 2 * total
+        md = fn.metadata()
+        assert md["quarantine"]["quarantinedRows"] == total
+
+    def test_metadata_consistent_while_scoring_concurrently(
+        self, trained, rows
+    ):
+        _, model = trained
+        fn = score_function(model)
+        stop = threading.Event()
+        errs = []
+
+        def reader():
+            while not stop.is_set():
+                md = fn.metadata()
+                drift = md["drift"]
+                if drift["enabled"]:
+                    for f in drift["features"].values():
+                        rows_ = f.get("rows")
+                        if rows_ is not None and rows_ < 0:
+                            errs.append("negative rows")
+
+        th = threading.Thread(target=reader)
+        th.start()
+        try:
+            self._hammer(lambda: fn.batch([dict(rows[0])]), per_thread=30)
+        finally:
+            stop.set()
+            th.join()
+        assert not errs
+
+
+# ------------------------------------------------------------- observability
+class TestServiceObservability:
+    def test_service_source_in_prometheus_export(self, score_fn, rows):
+        from transmogrifai_tpu.telemetry.export import render_prometheus
+
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn, ServiceConfig(workers=0, max_batch_rows=8), clock=clk
+        )
+        svc.start()
+        for r in rows[:4]:
+            svc.submit(dict(r))
+        while svc.pump():
+            pass
+        text = render_prometheus()
+        assert "tptpu_service_admitted" in text
+        assert "tptpu_serve_queue_depth" in text
+        svc.stop()
+
+    def test_render_prometheus_never_deadlocks_against_submit(
+        self, score_fn, rows
+    ):
+        # regression: the 'service' exposition source takes the service
+        # lock (stats()) while submit() holds it around the queue-depth
+        # gauge set (registry lock) — render_prometheus() must run its
+        # sources OUTSIDE the registry lock or the two directions are an
+        # ABBA deadlock. Daemon threads + join timeout = the alarm.
+        from transmogrifai_tpu.telemetry.export import render_prometheus
+
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(workers=0, max_queue_rows=100_000),
+            clock=clk,
+        )
+        svc.start()
+        barrier = threading.Barrier(2)
+        errors: list[BaseException] = []
+
+        def _submit():
+            barrier.wait()
+            for _ in range(300):
+                try:
+                    svc.submit(dict(rows[0]))
+                except BaseException as e:  # pragma: no cover
+                    errors.append(e)
+
+        def _render():
+            barrier.wait()
+            for _ in range(300):
+                render_prometheus()
+
+        threads = [
+            threading.Thread(target=_submit, daemon=True),
+            threading.Thread(target=_render, daemon=True),
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30.0)
+        hung = [th.name for th in threads if th.is_alive()]
+        assert not hung, f"deadlock: {hung} never finished"
+        assert not errors
+        svc.stop()
+
+    def test_serve_queue_span_recorded_per_batch(self, score_fn, rows):
+        tspans.reset_for_tests()
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn, ServiceConfig(workers=0, max_batch_rows=8), clock=clk
+        )
+        svc.start()
+        for r in rows[:4]:
+            svc.submit(dict(r))
+        clk.advance(0.005)
+        while svc.pump():
+            pass
+        svc.stop()
+        names = [e["name"] for e in tspans.snapshot_events()]
+        assert "serve/queue" in names
+
+    def test_shed_and_reject_counters_reconcile_with_events(
+        self, score_fn, rows
+    ):
+        clk = VirtualClock()
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(
+                workers=0, max_queue_rows=4, max_batch_rows=4,
+                default_deadline=0.050,
+                shed=TestServiceBackpressure.NO_SHED,
+            ),
+            clock=clk,
+        )
+        svc.start()
+        svc.submit(dict(rows[0]))
+        clk.advance(0.100)  # expire it in queue
+        for i in range(1, 4):
+            svc.submit(dict(rows[i]))
+        with pytest.raises(RejectedByAdmission):
+            svc.submit(dict(rows[4]))  # queue_full
+        while svc.pump():
+            pass
+        svc.stop()
+        s = svc.stats()
+        assert s["shed"]["deadline_exceeded"] == 1
+        assert s["rejected"]["queue_full"] == 1
+        assert s["admitted"] == (
+            s["completed"] + s["quarantined"] + s["errors"]
+            + sum(s["shed"].values())
+        )

@@ -5,9 +5,11 @@ way the pre-flight pass polices user DAGs. Rules:
 
 * **TPL001** — module-level mutable state written without holding a lock,
   in the thread-crossed subsystems (``featurize/``, ``compiler/``,
-  ``utils/aot.py``, ``telemetry/``): the chunk-pool workers, the async
-  warmup thread, and the telemetry span/event buffers share these modules
-  with the main thread.
+  ``utils/aot.py``, ``telemetry/``, ``serving/``, ``resilience/``): the
+  chunk-pool workers, the async warmup thread, the telemetry span/event
+  buffers, and the standing-service worker threads (which share the
+  sentinel/breaker/quarantine state and the serving process flags) cross
+  these modules with the main thread.
 * **TPL002** — per-row Python loops inside ``ops/`` columnar hot paths
   (``transform_columns`` / ``blocks_for``): the PR-5 columnar engine
   killed these; new ones silently re-open the 10-100x serving gap.
@@ -47,8 +49,13 @@ __all__ = [
 ]
 
 #: subsystems whose module globals are crossed by worker/warmup threads
-#: (telemetry/ buffers are written from scoring, pool, and warmup threads)
-_LOCKED_SUBSYSTEMS = ("featurize/", "compiler/", "utils/aot.py", "telemetry/")
+#: (telemetry/ buffers are written from scoring, pool, and warmup threads;
+#: serving/ + resilience/ joined when the standing service put sentinel,
+#: breaker, and shed state in front of concurrent service workers)
+_LOCKED_SUBSYSTEMS = (
+    "featurize/", "compiler/", "utils/aot.py", "telemetry/", "serving/",
+    "resilience/",
+)
 
 _MUTATORS = {
     "append", "add", "update", "pop", "popitem", "setdefault", "clear",

@@ -129,6 +129,25 @@ def _run(names) -> None:
     )
 
 
+def join_warmup(timeout: float | None = None) -> bool:
+    """Block until every started warmup thread finishes loading (the
+    standing service's ``start(wait_warmup=True)`` — a service that wants
+    its first batch warm, not overlapped). Returns False when a thread is
+    still alive after ``timeout`` seconds."""
+    with _LOCK:
+        threads = list(_STARTED.values())
+    deadline = None if timeout is None else time.monotonic() + timeout
+    ok = True
+    for th in threads:
+        left = (
+            None if deadline is None
+            else max(0.0, deadline - time.monotonic())
+        )
+        th.join(timeout=left)
+        ok = ok and not th.is_alive()
+    return ok
+
+
 def reset_for_tests() -> None:
     """Forget started scopes so a test can exercise warmup repeatedly."""
     with _LOCK:

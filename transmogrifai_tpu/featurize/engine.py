@@ -59,16 +59,50 @@ class FusionPlanner:
         combiner = combiners[0]
         by_output = {t.output_name: t for t in plan}
         members = []
+        stages = []
         for name in combiner.input_names:
             t = by_output.get(name)
             if t is None or not isinstance(t, _CachedMetaVectorizer):
                 return  # passthrough vector / non-sequence producer
             members.append(t.uid)
+            stages.append(t)
         if not members:
             return
         self.combiner_uid = combiner.uid
         self.member_uids = members
+        self._member_stages = stages
         self.disabled = False
+
+    def prime(self) -> bool:
+        """Learn member widths from fit-static metadata (each vectorizer's
+        populated ``_meta_cache``) without waiting for a first unfused
+        batch — the standing service calls this at start so batch #1
+        already assembles into the single fused buffer. A member whose
+        fit-time metadata is absent stays unlearned (that member's width
+        arrives via :meth:`note_output` as before). Returns ``ready()``.
+
+        Safe to over-prime: if a member later emits sparse at runtime it
+        bypasses the sink, ``fused_result`` sees an incomplete write set,
+        and the combiner falls back to plain assembly."""
+        if self.disabled:
+            return False
+        for t in getattr(self, "_member_stages", ()):
+            if t.uid in self.widths:
+                continue
+            cached = getattr(t, "_meta_cache", None)
+            if cached is not None:
+                try:
+                    self.widths[t.uid] = int(cached[1].size)
+                    continue
+                except Exception:
+                    pass
+            meta = getattr(t, "new_metadata", None)
+            if meta is not None:
+                try:
+                    self.widths[t.uid] = int(meta.size)
+                except Exception:
+                    pass
+        return self.ready()
 
     # ------------------------------------------------------------- learning
     def note_output(self, uid: str, column) -> None:

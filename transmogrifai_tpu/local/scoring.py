@@ -39,6 +39,8 @@ import numpy as np
 
 from ..resilience import faults
 from ..resilience.guards import ScoreGuard, ScoreGuardError
+from ..serving import deadline as _sdl
+from ..serving import shedding as _sshed
 from ..telemetry import metrics as _tm
 from ..telemetry import spans as _tspans
 from ..resilience.sentinel import (
@@ -302,13 +304,28 @@ def score_function(
                     "short_circuit" if "short_circuit" in up else "failure"
                 )
                 continue
+            # deadline gate at the dispatch family boundary: a request
+            # whose remaining budget can't cover the predictor's p95 is
+            # rejected HERE, before the expensive dispatch — the raise is
+            # outside the stage try, so it propagates as a typed
+            # DeadlineExceeded instead of counting as a stage failure.
+            # It must also run BEFORE br.allow(): allow() in half-open
+            # claims the single probe slot, and a raise between the claim
+            # and record_success/record_failure would leak it, wedging
+            # the breaker half-open forever
+            if isinstance(t, PredictorModel):
+                _sdl.checkpoint("dispatch")
             br = None
             if breaker is not None:
                 if breaker_mode == "active":
                     br = breakers.get(t.output_name)
                     if br is None:
-                        br = breakers[t.output_name] = CircuitBreaker(
-                            t.output_name, breaker
+                        # setdefault: two service workers racing the first
+                        # execution of a stage must share ONE breaker, not
+                        # silently drop one of two
+                        br = breakers.setdefault(
+                            t.output_name,
+                            CircuitBreaker(t.output_name, breaker),
                         )
                     if not br.allow():
                         dead.add(t.output_name)
@@ -326,9 +343,17 @@ def score_function(
                 col = t.transform_columns(
                     *[cols[nm] for nm in t.input_names], num_rows=b
                 )
-                elapsed = breaker.clock() - t0 if br is not None else 0.0
+                # slow-stage chaos: simulated extra seconds ride the
+                # breaker-deadline elapsed time, the stage-family latency,
+                # and the active request budget — no real sleep anywhere
+                extra = fp.on_stage_duration(t) if fp is not None else 0.0
+                if extra:
+                    _sdl.consume(extra)
+                elapsed = (
+                    breaker.clock() - t0 + extra if br is not None else 0.0
+                )
                 if fam_seconds is not None:
-                    tdur = _tspans.clock() - ts
+                    tdur = _tspans.clock() - ts + extra
                     fam = (
                         "dispatch" if isinstance(t, PredictorModel)
                         else "featurize"
@@ -355,6 +380,12 @@ def score_function(
 
                         prefetch_f32(vals)
             except (ScoreGuardError, SchemaViolationError):
+                # explicit escalations propagate — but a half-open probe
+                # claimed by allow() above must be released on the way
+                # out, or the breaker wedges half-open with no probe to
+                # ever report back
+                if br is not None:
+                    br.release_probe()
                 raise
             except Exception as e:
                 if br is not None:
@@ -371,8 +402,7 @@ def score_function(
                 continue
             if br is not None:
                 if breaker.deadline is not None and elapsed > breaker.deadline:
-                    br.deadline_overruns += 1
-                    br.record_failure()
+                    br.record_failure(overrun=True)
                 else:
                     br.record_success()
 
@@ -396,10 +426,17 @@ def score_function(
 
     # ---- default predictions: the all-missing row scored once, plainly
     # (no fault hooks, guards, or breakers — defaults must stay
-    # deterministic even under an installed FaultPlan)
+    # deterministic even under an installed FaultPlan). The memo lock
+    # keeps concurrent service workers from computing (and potentially
+    # half-publishing) the neutral row twice.
     _neutral: dict[str, Any] = {}
+    _neutral_lock = threading.Lock()
 
     def _neutral_columns() -> dict[str, Any]:
+        with _neutral_lock:
+            return _neutral_columns_locked()
+
+    def _neutral_columns_locked() -> dict[str, Any]:
         if "cols" not in _neutral:
             cols = {
                 f.name: column_from_values(
@@ -434,12 +471,13 @@ def score_function(
         return _neutral["cols"]
 
     def _default_value(name: str) -> Any:
-        vals = _neutral.get("values")
-        if vals is None:
-            vals = _neutral["values"] = {
-                nm: None if col is None else col.to_list()[0]
-                for nm, col in _neutral_columns().items()
-            }
+        with _neutral_lock:
+            vals = _neutral.get("values")
+            if vals is None:
+                vals = _neutral["values"] = {
+                    nm: None if col is None else col.to_list()[0]
+                    for nm, col in _neutral_columns_locked().items()
+                }
         v = vals[name]
         # rows must not alias one shared mutable default (Prediction maps)
         if isinstance(v, dict):
@@ -545,9 +583,14 @@ def score_function(
         started = _tspans.clock() if tel else 0.0
         fam: dict[str, float] = {}
         qlog.start_batch()
+        # deadline gates (serving/deadline.py): each stage-family boundary
+        # rejects a request whose remaining budget can't cover that
+        # family's p95 — near-free no-ops without an active budget
+        _sdl.checkpoint("sentinel")
         prepared, invalid = _prepare_rows(rows)
         if tel:
             fam["sentinel"] = _tspans.clock() - started
+        _sdl.checkpoint("featurize")
         # quarantined rows are COMPACTED OUT before the plan runs: a bad
         # row must never reach a stage (an all-missing placeholder could
         # still poison one and feed the breaker), so only survivors score
@@ -562,10 +605,12 @@ def score_function(
             b = _bucket(m)
             tc = _tspans.clock() if tel else 0.0
             cols = _raw_columns([prepared[i] for i in survivors], m, b)
-            if drift_sentinel.enabled:
+            if drift_sentinel.enabled and not _sshed.drift_shed():
                 # observed post codec (typed, coerced values), one
                 # vectorized bulk merge per feature; quarantined rows never
-                # reach the plan, so they are not part of the window
+                # reach the plan, so they are not part of the window.
+                # Skipped at shed tier >= 2 — drift observation is
+                # monitoring, and monitoring yields before scoring does
                 drift_sentinel.observe_columns(cols, m)
             if tel:
                 # the row→column codec counts as featurize time; the plan
@@ -688,7 +733,7 @@ def score_function(
                 cols[f.name] = column_from_values(f.ftype, [0] * b)
                 continue
             cols[f.name] = c if pad is None else c.take(pad)
-        if drift_sentinel.enabled:
+        if drift_sentinel.enabled and not _sshed.drift_shed():
             drift_sentinel.observe_columns(cols, n)
         if tel:
             # column intake (padding/take + drift observe) counts as
@@ -824,6 +869,7 @@ def score_function(
 
     score_one.batch = score_batch  # type: ignore[attr-defined]
     score_one.columns = score_columns  # type: ignore[attr-defined]
+    score_one.fusion = fusion  # type: ignore[attr-defined]
     score_one.guard = guard  # type: ignore[attr-defined]
     score_one.sentinel = sentinel  # type: ignore[attr-defined]
     score_one.breakers = breakers  # type: ignore[attr-defined]

@@ -62,7 +62,13 @@ class FaultPlan:
         self._crash_layers: list[dict[str, Any]] = []
         self._nan_faults: list[dict[str, Any]] = []
         self._transform_faults: list[dict[str, Any]] = []
+        self._slow_faults: list[dict[str, Any]] = []
+        self._burst_windows: list[dict[str, Any]] = []
         self._row_faults: list[dict[str, Any]] = []
+        #: cumulative simulated seconds injected by ``slow_stage`` — the
+        #: serve-loadtest harness reads deltas of this to advance its
+        #: virtual clock (no real sleeps anywhere)
+        self.simulated_seconds = 0.0
         self._profile_faults: list[dict[str, Any]] = []
         self._drift_faults: list[dict[str, Any]] = []
         self._chunk_faults: list[dict[str, Any]] = []
@@ -134,6 +140,42 @@ class FaultPlan:
         self._transform_faults.append(
             {"target": target, "rows": None if rows is None else set(rows),
              "times": times, "count": 0, "transient": transient}
+        )
+        return self
+
+    def slow_stage(
+        self,
+        target: str | None = None,
+        delay: float = 0.1,
+        times: int | None = None,
+    ) -> "FaultPlan":
+        """Inflate a matching scoring stage's observed duration by
+        ``delay`` SIMULATED seconds (no real sleep): the scoring loop adds
+        the extra to the breaker-deadline elapsed time, to the per-family
+        latency seconds, and consumes it from any active per-request
+        deadline budget (serving/deadline.py), so slow-stage chaos drives
+        deadline rejections and breaker overruns deterministically.
+        Unlimited by default — a degraded stage stays slow."""
+        self._slow_faults.append(
+            {"target": target, "delay": float(delay), "times": times,
+             "count": 0}
+        )
+        return self
+
+    def burst_arrivals(
+        self, start: float, duration: float, multiplier: float = 10.0
+    ) -> "FaultPlan":
+        """Declare an arrival-rate burst window for the open-loop
+        serve-loadtest harness: between ``start`` and ``start + duration``
+        (harness virtual seconds) the nominal arrival rate multiplies by
+        ``multiplier``. Queried via :meth:`arrival_multiplier` while
+        generating the seeded schedule — the burst is part of the plan, so
+        the same plan replays the same overload every run."""
+        if duration <= 0 or multiplier <= 0:
+            raise ValueError("burst_arrivals needs duration > 0, multiplier > 0")
+        self._burst_windows.append(
+            {"start": float(start), "end": float(start) + float(duration),
+             "multiplier": float(multiplier), "fired": False}
         )
         return self
 
@@ -392,6 +434,40 @@ class FaultPlan:
                     f"injected transform failure on "
                     f"{type(stage).__name__}({stage.uid})"
                 )
+
+    def on_stage_duration(self, stage: Any) -> float:
+        """Extra SIMULATED seconds a matching stage execution took
+        (``slow_stage``). Fires per execution; only the FIRST firing per
+        fault lands in ``fired`` (a standing service executes thousands of
+        batches)."""
+        with self._lock:
+            extra = 0.0
+            for f in self._slow_faults:
+                if f["times"] is not None and f["count"] >= f["times"]:
+                    continue
+                if f["target"] is not None and not _matches(stage, f["target"]):
+                    continue
+                f["count"] += 1
+                if f["count"] == 1:
+                    self.fired.append(("slow", stage.output_name))
+                extra += f["delay"]
+            if extra:
+                self.simulated_seconds += extra
+            return extra
+
+    def arrival_multiplier(self, t: float) -> float:
+        """Product of every burst window covering harness-virtual time
+        ``t`` (1.0 outside all windows). The first query inside a window
+        lands in ``fired``."""
+        with self._lock:
+            mult = 1.0
+            for f in self._burst_windows:
+                if f["start"] <= t < f["end"]:
+                    if not f["fired"]:
+                        f["fired"] = True
+                        self.fired.append(("burst", f"t={f['start']:g}"))
+                    mult *= f["multiplier"]
+            return mult
 
     def on_score_row(self, row: dict, index: int) -> dict | None:
         """Return a corrupted copy of an incoming row, or None to keep it."""

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from collections import Counter
 from typing import Any
 
@@ -54,7 +55,9 @@ class ScoreGuard:
         self.fallback = fallback
         self.per_stage = dict(per_stage or {})
         self.scope = scope
-        #: stage output name -> number of degraded rows
+        self._lock = threading.Lock()
+        #: stage output name -> number of degraded rows (mutated under the
+        #: instance lock — concurrent service workers share one guard)
         self.counts: Counter[str] = Counter()
 
     def mode_for(self, stage: Any, is_result: bool = True) -> str:
@@ -69,11 +72,12 @@ class ScoreGuard:
         return self.fallback
 
     def stats(self) -> dict[str, Any]:
-        return {
-            "fallback": self.fallback,
-            "guardedRows": int(sum(self.counts.values())),
-            "byStage": dict(self.counts),
-        }
+        with self._lock:
+            return {
+                "fallback": self.fallback,
+                "guardedRows": int(sum(self.counts.values())),
+                "byStage": dict(self.counts),
+            }
 
     def apply(
         self,
@@ -108,7 +112,8 @@ class ScoreGuard:
                 f"'{stage.output_name}'"
             )
         if count:
-            self.counts[stage.output_name] += n_bad
+            with self._lock:
+                self.counts[stage.output_name] += n_bad
             log.warning(
                 "score guard: %d non-finite row(s) in '%s' replaced with "
                 "defaults", n_bad, stage.output_name,

@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
+import threading
 import time
 from collections import Counter, deque
 from typing import Any, Callable, Iterable
@@ -206,7 +207,9 @@ class SchemaSentinel:
     ``(feature, kind, reason)`` triples — non-empty means the row must be
     quarantined. Response features are never validated (serving rows
     legitimately lack labels). Every non-``allow`` violation is counted in
-    ``counts`` (by kind) and ``by_feature``."""
+    ``counts`` (by kind) and ``by_feature``; counter mutations hold the
+    instance lock (the registry-lock treatment), so concurrent service
+    workers sharing one sentinel never lose increments."""
 
     def __init__(
         self,
@@ -219,6 +222,7 @@ class SchemaSentinel:
         self._fields = [
             (f.name, f.ftype) for f in raw_features if not f.is_response
         ]
+        self._lock = threading.Lock()
         self.counts: Counter[str] = Counter()
         self.by_feature: Counter[str] = Counter()
         self.rows_seen = 0
@@ -229,7 +233,8 @@ class SchemaSentinel:
     def check_row(
         self, row: dict[str, Any]
     ) -> tuple[dict[str, Any], list[tuple[str, str, str]]]:
-        self.rows_seen += 1
+        with self._lock:
+            self.rows_seen += 1
         out = row
         quarantine: list[tuple[str, str, str]] = []
         for name, ftype in self._fields:
@@ -259,8 +264,9 @@ class SchemaSentinel:
                 # violation counted — fill-rate monitoring is the drift
                 # sentinel's job, and real violations must not drown in it
                 continue
-            self.counts[kind] += 1
-            self.by_feature[name] += 1
+            with self._lock:
+                self.counts[kind] += 1
+                self.by_feature[name] += 1
             reason = f"{kind}: {_describe(v)} for {ftype.__name__}"
             if action == "raise":
                 raise SchemaViolationError(f"feature '{name}' — {reason}")
@@ -349,20 +355,29 @@ class SchemaSentinel:
                     bool, n,
                 )
         out = []
+        clean_run = 0  # clean rows count in bulk — one lock per run
         for i, row in enumerate(rows):
             if flagged[i]:
+                if clean_run:
+                    with self._lock:
+                        self.rows_seen += clean_run
+                    clean_run = 0
                 out.append(self.check_row(row))
             else:
-                self.rows_seen += 1
+                clean_run += 1
                 out.append((row, []))
+        if clean_run:
+            with self._lock:
+                self.rows_seen += clean_run
         return out
 
     def stats(self) -> dict[str, Any]:
-        return {
-            "rowsSeen": self.rows_seen,
-            "violations": dict(self.counts),
-            "byFeature": dict(self.by_feature),
-        }
+        with self._lock:
+            return {
+                "rowsSeen": self.rows_seen,
+                "violations": dict(self.counts),
+                "byFeature": dict(self.by_feature),
+            }
 
 
 #: per-storage type sets that can never produce a violation worse than
@@ -405,37 +420,60 @@ class QuarantineLog:
 
     Records are per (row, feature) — a row violating two features yields
     two records — but ``quarantinedRows`` counts distinct ROWS, so the
-    counter matches "k bad rows" exactly."""
+    counter matches "k bad rows" exactly.
+
+    Thread-safe: cumulative counters mutate under the instance lock, and
+    the per-batch view (``last`` / ``batch_rows``) is THREAD-LOCAL — each
+    service worker scores its own batch, so "this batch's records" must
+    mean "this thread's batch", not whichever batch last called
+    ``start_batch`` anywhere in the process."""
 
     def __init__(self, keep: int = 1000):
         self.keep = keep
+        self._lock = threading.Lock()
         self.records: deque[QuarantineRecord] = deque(maxlen=keep)
-        self.last: list[QuarantineRecord] = []
         self.total_rows = 0
         self.total_records = 0
         self.by_kind: Counter[str] = Counter()
-        self._batch_rows: set[int] = set()
+        self._tls = threading.local()
+
+    @property
+    def last(self) -> list[QuarantineRecord]:
+        """This thread's current-batch records (empty before any batch)."""
+        return getattr(self._tls, "last", [])
+
+    def batch_rows(self) -> set[int]:
+        """Distinct row indices quarantined in this thread's batch."""
+        return set(getattr(self._tls, "rows", ()))
 
     def start_batch(self) -> None:
-        self.last = []
-        self._batch_rows = set()
+        self._tls.last = []
+        self._tls.rows = set()
 
     def add(self, rec: QuarantineRecord) -> None:
-        self.records.append(rec)
-        self.last.append(rec)
-        self.total_records += 1
-        self.by_kind[rec.kind] += 1
-        if rec.index not in self._batch_rows:
-            self._batch_rows.add(rec.index)
-            self.total_rows += 1
+        batch_last = getattr(self._tls, "last", None)
+        if batch_last is None:  # add() without start_batch(): direct use
+            batch_last = self._tls.last = []
+            self._tls.rows = set()
+        batch_last.append(rec)
+        new_row = rec.index not in self._tls.rows
+        if new_row:
+            self._tls.rows.add(rec.index)
+        with self._lock:
+            self.records.append(rec)
+            self.total_records += 1
+            self.by_kind[rec.kind] += 1
+            if new_row:
+                self.total_rows += 1
 
     def stats(self) -> dict[str, Any]:
-        return {
-            "quarantinedRows": self.total_rows,
-            "records": self.total_records,
-            "lastBatch": len(self.last),
-            "byKind": dict(self.by_kind),
-        }
+        with self._lock:
+            return {
+                "quarantinedRows": self.total_rows,
+                "records": self.total_records,
+                "lastBatch": len(self.last),
+                "byKind": dict(self.by_kind),
+            }
 
 
 # ------------------------------------------------------------ circuit breaker
@@ -454,23 +492,30 @@ class BreakerConfig:
 class CircuitBreaker:
     """Closed / open / half-open breaker for one scoring stage.
 
-    ``allow()`` gates execution: closed and half-open pass (half-open is
-    the recovery probe), open short-circuits until ``recovery_time`` has
-    elapsed. ``record_success``/``record_failure`` drive the transitions;
-    K *consecutive* failures open the breaker, a successful probe closes
-    it, a failed probe re-opens it."""
+    ``allow()`` gates execution: closed passes, open short-circuits until
+    ``recovery_time`` has elapsed, half-open admits EXACTLY ONE probe at a
+    time — concurrent callers racing the recovery window short-circuit
+    until the in-flight probe reports back (two service workers sharing a
+    breaker must not both hammer a still-broken stage).
+    ``record_success``/``record_failure`` drive the transitions; K
+    *consecutive* failures open the breaker, a successful probe closes
+    it, a failed probe re-opens it. All state moves under the instance
+    lock, so transition counters stay exact under concurrent scoring."""
 
     def __init__(self, name: str, config: BreakerConfig):
         self.name = name
         self.config = config
+        self._lock = threading.Lock()
         self.state = "closed"
         self.consecutive_failures = 0
         self.opened_at: float | None = None
         self.short_circuits = 0
         self.deadline_overruns = 0
+        self.probe_in_flight = False
         self.transitions: Counter[str] = Counter()
 
     def _to(self, state: str) -> None:
+        """Caller holds the lock."""
         self.transitions[f"{self.state}->{state}"] += 1
         _tevents.emit(
             "breaker_transition", stage=self.name,
@@ -480,19 +525,35 @@ class CircuitBreaker:
         self.state = state
 
     def allow(self) -> bool:
-        if self.state == "closed":
-            return True
-        if self.state == "open":
-            now = self.config.clock()
-            if (
-                self.opened_at is not None
-                and now - self.opened_at >= self.config.recovery_time
-            ):
-                self._to("half_open")
+        with self._lock:
+            if self.state == "closed":
                 return True
-            self.short_circuits += 1
-            return False
-        return True  # half_open: let the probe through
+            if self.state == "open":
+                now = self.config.clock()
+                if (
+                    self.opened_at is not None
+                    and now - self.opened_at >= self.config.recovery_time
+                ):
+                    self._to("half_open")
+                    self.probe_in_flight = True
+                    return True
+                self.short_circuits += 1
+                return False
+            # half_open: one probe at a time; racers short-circuit
+            if self.probe_in_flight:
+                self.short_circuits += 1
+                return False
+            self.probe_in_flight = True
+            return True
+
+    def release_probe(self) -> None:
+        """Abandon an in-flight half-open probe WITHOUT recording an
+        outcome (the caller is unwinding past the stage on an exception
+        that is not the stage's failure — e.g. a deadline rejection or a
+        guard escalation). The breaker stays half-open and the next
+        caller may claim the probe slot."""
+        with self._lock:
+            self.probe_in_flight = False
 
     def would_short_circuit(self) -> bool:
         """Pure peek at ``allow()`` — no transition, no counter. Used by
@@ -504,35 +565,47 @@ class CircuitBreaker:
         )
 
     def record_success(self) -> None:
-        if self.state == "half_open":
-            self._to("closed")
-            log.info("breaker %s recovered (half-open probe ok)", self.name)
-        self.consecutive_failures = 0
+        with self._lock:
+            self.probe_in_flight = False
+            if self.state == "half_open":
+                self._to("closed")
+                log.info(
+                    "breaker %s recovered (half-open probe ok)", self.name
+                )
+            self.consecutive_failures = 0
 
-    def record_failure(self) -> None:
-        self.consecutive_failures += 1
-        if self.state == "half_open":
-            self._to("open")
-            self.opened_at = self.config.clock()
-        elif (
-            self.state == "closed"
-            and self.consecutive_failures >= self.config.failure_threshold
-        ):
-            self._to("open")
-            self.opened_at = self.config.clock()
-            log.warning(
-                "breaker %s opened after %d consecutive failures",
-                self.name, self.consecutive_failures,
-            )
+    def record_failure(self, overrun: bool = False) -> None:
+        """``overrun=True`` counts a per-stage deadline overrun (treated
+        as a failure) — folded in here so the overrun counter mutates
+        under the same lock as the rest of the breaker state."""
+        with self._lock:
+            self.probe_in_flight = False
+            if overrun:
+                self.deadline_overruns += 1
+            self.consecutive_failures += 1
+            if self.state == "half_open":
+                self._to("open")
+                self.opened_at = self.config.clock()
+            elif (
+                self.state == "closed"
+                and self.consecutive_failures >= self.config.failure_threshold
+            ):
+                self._to("open")
+                self.opened_at = self.config.clock()
+                log.warning(
+                    "breaker %s opened after %d consecutive failures",
+                    self.name, self.consecutive_failures,
+                )
 
     def stats(self) -> dict[str, Any]:
-        return {
-            "state": self.state,
-            "consecutiveFailures": self.consecutive_failures,
-            "shortCircuits": self.short_circuits,
-            "deadlineOverruns": self.deadline_overruns,
-            "transitions": dict(self.transitions),
-        }
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutiveFailures": self.consecutive_failures,
+                "shortCircuits": self.short_circuits,
+                "deadlineOverruns": self.deadline_overruns,
+                "transitions": dict(self.transitions),
+            }
 
 
 # ------------------------------------------------------------- drift sentinel
@@ -667,7 +740,13 @@ class DriftSentinel:
     with a status of ``ok`` / ``warn`` / ``alert`` against the configured
     thresholds. Torn or corrupt profiles disable monitoring for that
     feature only (listed in ``torn``) — a damaged artifact must degrade
-    observability, not scoring."""
+    observability, not scoring.
+
+    Each feature's sliding window has its own lock: ``observe_columns``
+    (the scoring hot path) and ``report()`` (monitoring) both take it per
+    feature, so a concurrent ``observe`` can no longer tear the window
+    stats mid-read (rows/nulls/histogram snapshot inconsistency under
+    ``score_fn.metadata()`` — the PR-7 note)."""
 
     def __init__(
         self,
@@ -703,6 +782,10 @@ class DriftSentinel:
                 )
                 self.torn.append(name)
         self._windows = {name: _Window(self.config) for name in self.profiles}
+        self._window_locks = {
+            name: threading.Lock() for name in self.profiles
+        }
+        self._report_lock = threading.Lock()  # alert bookkeeping + totals
 
     @property
     def enabled(self) -> bool:
@@ -719,12 +802,14 @@ class DriftSentinel:
         if not self.profiles:
             return
         plan = faults.active()
-        self.rows_observed += num_rows
+        with self._report_lock:
+            self.rows_observed += num_rows
         for name in self.profiles:
             w = self._windows[name]
             col = cols.get(name)
             if col is None:
-                w.observe_bulk(np.empty(0), num_rows, num_rows)
+                with self._window_locks[name]:
+                    w.observe_bulk(np.empty(0), num_rows, num_rows)
                 continue
             if isinstance(col, NumericColumn):
                 vals = np.asarray(
@@ -734,21 +819,30 @@ class DriftSentinel:
                     vals = np.asarray([
                         plan.on_drift_observe(name, float(v)) for v in vals
                     ])
-                w.observe_bulk(vals, num_rows, num_rows - len(vals))
+                with self._window_locks[name]:
+                    w.observe_bulk(vals, num_rows, num_rows - len(vals))
             else:
                 nulls = int(_null_mask(col)[:num_rows].sum())
-                w.observe_bulk(np.empty(0), num_rows, nulls)
+                with self._window_locks[name]:
+                    w.observe_bulk(np.empty(0), num_rows, nulls)
 
     def report(self) -> dict[str, Any]:
         features: dict[str, Any] = {}
         alerts: list[str] = []
         for name, prof in self.profiles.items():
             w = self._windows[name]
-            rows = w.rows
+            # snapshot (rows, nulls, merged histogram) under the feature's
+            # window lock — a concurrent observe_columns can no longer tear
+            # rows vs nulls vs histogram mid-read (the PR-7 metadata()
+            # note); the slow JS computation runs on the snapshot, outside
+            with self._window_locks[name]:
+                rows = w.rows
+                nulls = w.nulls
+                hist = w.histogram() if prof.histogram is not None else None
             if rows < self.config.min_rows:
                 features[name] = {"status": "insufficient", "rows": rows}
                 continue
-            serve_fill = 1.0 - w.nulls / rows
+            serve_fill = 1.0 - nulls / rows
             train_fill = prof.fill_rate
             lo, hi = sorted((serve_fill, train_fill))
             fill_ratio = (
@@ -757,7 +851,7 @@ class DriftSentinel:
             js = None
             if prof.histogram is not None:
                 js = histogram_js_divergence(
-                    prof.histogram, w.histogram(), self.config.compare_bins
+                    prof.histogram, hist, self.config.compare_bins
                 )
             status = "ok"
             if (
@@ -783,9 +877,12 @@ class DriftSentinel:
             }
             if status == "alert":
                 alerts.append(name)
-                if name not in self._alerting:
-                    self._alerting.add(name)
-                    self.alerts_total += 1
+                with self._report_lock:
+                    fresh_alert = name not in self._alerting
+                    if fresh_alert:
+                        self._alerting.add(name)
+                        self.alerts_total += 1
+                if fresh_alert:
                     _tevents.emit(
                         "drift_alert", feature=name,
                         fillRatio=(
@@ -800,15 +897,17 @@ class DriftSentinel:
                         "n/a" if js is None else f"{js:.3f}",
                     )
             else:
-                self._alerting.discard(name)
-        return {
-            "enabled": self.enabled,
-            "rowsObserved": self.rows_observed,
-            "tornProfiles": list(self.torn),
-            "alerts": alerts,
-            "driftAlertsTotal": self.alerts_total,
-            "features": features,
-        }
+                with self._report_lock:
+                    self._alerting.discard(name)
+        with self._report_lock:
+            return {
+                "enabled": self.enabled,
+                "rowsObserved": self.rows_observed,
+                "tornProfiles": list(self.torn),
+                "alerts": alerts,
+                "driftAlertsTotal": self.alerts_total,
+                "features": features,
+            }
 
 
 # ------------------------------------------------------- train-time profiling

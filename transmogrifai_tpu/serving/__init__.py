@@ -1,0 +1,42 @@
+"""Standing scoring service — the long-lived online path over one
+``score_function`` closure (ROADMAP item 1).
+
+The library pieces PRs 1–7 built (warm compile bank, fused featurize
+plane, schema sentinel, circuit breakers, drift windows, telemetry)
+assemble here into a service that stays up under overload and faults:
+
+* :mod:`.queue` — bounded admission (typed :class:`RejectedByAdmission`),
+* :mod:`.batcher` — dynamic micro-batch assembly onto the fusion buffer,
+* :mod:`.deadline` — per-request budgets propagated through the
+  sentinel → featurize → dispatch stage families
+  (:class:`DeadlineExceeded` rejects early, never late),
+* :mod:`.shedding` — backpressure + tiered load shedding with hysteresis,
+* :mod:`.service` — the service loop (:class:`ScoringService`),
+* :mod:`.loadtest` — the seeded open-loop arrival harness on a virtual
+  clock (``bench.py serve-loadtest``).
+
+See docs/serving.md ("Overload & graceful degradation").
+"""
+from .batcher import BatchPlan, MicroBatcher
+from .deadline import DeadlineBudget, DeadlineExceeded
+from .loadtest import LoadSchedule, VirtualClock, run_loadtest
+from .queue import AdmissionQueue, RejectedByAdmission
+from .service import PendingScore, ScoringService, ServiceConfig
+from .shedding import LoadShedder, ShedConfig
+
+__all__ = [
+    "AdmissionQueue",
+    "BatchPlan",
+    "DeadlineBudget",
+    "DeadlineExceeded",
+    "LoadSchedule",
+    "LoadShedder",
+    "MicroBatcher",
+    "PendingScore",
+    "RejectedByAdmission",
+    "ScoringService",
+    "ServiceConfig",
+    "ShedConfig",
+    "VirtualClock",
+    "run_loadtest",
+]

@@ -1,0 +1,220 @@
+"""Open-loop serve load testing on a virtual clock — seeded, sleepless.
+
+Closed-loop benchmarks (issue the next request when the last returns)
+cannot see overload: arrival pressure collapses to service capacity and
+latency looks flat right up to the cliff. This harness is OPEN-LOOP: a
+seeded arrival schedule at a fixed rate (optionally multiplied through
+``FaultPlan.burst_arrivals`` windows) submits requests regardless of how
+the service is doing, so queue growth, deadline sheds, and admission
+rejections appear exactly as they would under real traffic.
+
+Nothing sleeps. Time is a :class:`VirtualClock` the service runs on:
+
+* arrivals advance the clock to their scheduled instant;
+* each pumped batch advances it by the batch's measured REAL execution
+  seconds (bench mode) or an injected ``service_time`` (tests) plus any
+  ``slow_stage`` SIMULATED seconds the fault plan charged — so chaos
+  latency shows up in the percentiles without ever sleeping;
+* per-request latency = completion − arrival, both virtual.
+
+The emitted report carries p50/p95/p99 latency, shed rate, goodput
+(healthy completions per virtual second), the typed outcome taxonomy,
+and the reconciliation verdict — the shape ``bench.py serve-loadtest``
+lands in ``BENCH_r06.json`` so overload behavior joins the regression
+trail.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from ..resilience import faults as _faults
+from ..telemetry import spans as _tspans
+from . import deadline as _deadline
+from .queue import RejectedByAdmission
+from .service import ScoringService, ServiceConfig
+
+__all__ = ["VirtualClock", "LoadSchedule", "run_loadtest"]
+
+
+class VirtualClock:
+    """Monotonic virtual time; advanced explicitly by the harness."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError("virtual time cannot go backwards")
+        self.now += dt
+        return self.now
+
+
+@dataclasses.dataclass
+class LoadSchedule:
+    """Seeded open-loop arrival schedule."""
+
+    rate: float                 # nominal arrivals per virtual second
+    duration: float             # virtual seconds of arrivals
+    seed: int = 0
+
+    def arrivals(self, plan: "_faults.FaultPlan | None" = None) -> list[float]:
+        """Arrival instants: inter-arrival 1/(rate*mult) where ``mult`` is
+        the fault plan's burst multiplier at the current instant — the
+        same plan replays the same burst every run."""
+        if self.rate <= 0 or self.duration <= 0:
+            raise ValueError("need rate > 0 and duration > 0")
+        out: list[float] = []
+        t = 0.0
+        while True:
+            mult = plan.arrival_multiplier(t) if plan is not None else 1.0
+            t += 1.0 / (self.rate * mult)
+            if t >= self.duration:
+                return out
+            out.append(t)
+
+
+def run_loadtest(
+    score_fn: Callable,
+    rows: list[dict],
+    rate: float,
+    duration: float,
+    seed: int = 0,
+    deadline: float | None = None,
+    config: ServiceConfig | None = None,
+    service_time: Callable[[int], float] | None = None,
+    plan: "_faults.FaultPlan | None" = None,
+) -> dict[str, Any]:
+    """One open-loop run; returns the metrics report (see module
+    docstring). ``rows`` is the pool the seeded rng draws request payloads
+    from; ``service_time`` (a callable invoked once per executed batch)
+    replaces measured real execution time with deterministic virtual
+    seconds — tests and regression benches use a constant; ``plan`` is an
+    ALREADY INSTALLED FaultPlan whose bursts/slow stages drive the
+    chaos."""
+    rng = np.random.default_rng(seed)
+    clock = VirtualClock()
+    # deterministic mode (injected service_time) must virtualize the
+    # TELEMETRY clock too: the serve-family histograms feed the deadline
+    # checkpoints' p95s, and left on the real clock they record host
+    # execution speed — a loaded machine would shed requests a fast one
+    # completes, making the "machine-independent" report host-dependent.
+    # On the virtual clock the family seconds are exactly the slow_stage
+    # simulated charges. Bench mode (service_time=None) keeps real time.
+    prev_spans_clock = _tspans.get_clock()
+    if service_time is not None:
+        _tspans.set_clock(clock)
+    cfg = config or ServiceConfig()
+    cfg = dataclasses.replace(cfg, workers=0)
+    if deadline is not None:
+        cfg = dataclasses.replace(cfg, default_deadline=deadline)
+    service = ScoringService(score_fn, cfg, clock=clock)
+
+    def _advance(real: float, sim: float, rows_executed: int) -> None:
+        base = (
+            service_time(rows_executed) if service_time is not None else real
+        )
+        clock.advance(base + sim)
+
+    service.on_batch_cost = _advance
+    service.start()
+    schedule = LoadSchedule(rate=rate, duration=duration, seed=seed)
+    arrivals = schedule.arrivals(plan)
+    idx = rng.integers(0, len(rows), size=max(1, len(arrivals)))
+    handles = []
+    max_depth = 0
+    # discrete-event engine: ONE worker whose busy/free timeline is
+    # ``free_at``. A batch starts the moment the worker is free and work
+    # is queued; arrivals scheduled DURING a batch enqueue behind it
+    # (that is what makes the loop open: queue depth, deadline burn, and
+    # shed tiers grow exactly as they would under real sustained traffic,
+    # instead of the worker magically draining between every arrival).
+    free_at = 0.0
+
+    def _serve_until(horizon: float | None) -> float:
+        """Run batches whose start instant lands before ``horizon``
+        (None = run until the queue drains); returns the updated
+        ``free_at``."""
+        busy = free_at
+        while service.queue.depth_requests() > 0:
+            start = max(busy, clock.now)
+            if horizon is not None and start >= horizon:
+                break
+            clock.advance(start - clock.now)
+            if not service.pump():  # everything left expired/settled
+                break
+            busy = clock.now  # pump advanced by the batch's cost
+        return busy
+
+    try:
+        for i, t in enumerate(arrivals):
+            free_at = _serve_until(t)
+            clock.advance(max(0.0, t - clock.now))
+            try:
+                handles.append(service.submit(dict(rows[int(idx[i])])))
+            except (RejectedByAdmission, _deadline.DeadlineExceeded):
+                pass  # counted in the service's typed rejection taxonomy
+            max_depth = max(max_depth, service.queue.depth_rows())
+        # arrivals over: drain whatever is still queued
+        _serve_until(None)
+        while service.pump():
+            pass
+        service.stop(drain=True)
+    finally:
+        _tspans.set_clock(prev_spans_clock)
+    end = clock.now
+
+    stats = service.stats()
+    latencies = sorted(
+        h.latency() for h in handles
+        if h.outcome in ("completed", "quarantined") and h.latency() is not None
+    )
+
+    def _pct(q: float) -> float | None:
+        if not latencies:
+            return None
+        return round(
+            float(np.percentile(latencies, q, method="nearest")) * 1e3, 3
+        )
+
+    shed_total = sum(stats["shed"].values())
+    rejected_total = sum(stats["rejected"].values())
+    settled = (
+        stats["completed"] + stats["quarantined"] + stats["errors"] + shed_total
+    )
+    offered = len(arrivals)
+    return {
+        "rate": rate,
+        "duration_s": duration,
+        "seed": seed,
+        "offered": offered,
+        "admitted": stats["admitted"],
+        "completed": stats["completed"],
+        "quarantined": stats["quarantined"],
+        "errors": stats["errors"],
+        "shed": dict(stats["shed"]),
+        "rejected": dict(stats["rejected"]),
+        "shed_total": shed_total,
+        "rejected_total": rejected_total,
+        "shed_rate": (
+            round((shed_total + rejected_total) / offered, 4) if offered else 0.0
+        ),
+        "latency_ms": {"p50": _pct(50), "p95": _pct(95), "p99": _pct(99)},
+        "goodput_rows_per_s": (
+            round(stats["completed"] / end, 2) if end > 0 else 0.0
+        ),
+        "max_queue_depth_rows": max(max_depth, stats["queuePeakRows"]),
+        "batches": stats["batches"],
+        "shed_tier_entries": stats["shedding"]["tierEntries"],
+        "virtual_end_s": round(end, 4),
+        # the hard invariant the chaos suite pins: every admitted request
+        # settled with exactly one typed outcome, nothing leaked
+        "reconciled": (
+            stats["admitted"] == settled and stats["outstanding"] == 0
+        ),
+    }

@@ -1,0 +1,531 @@
+"""The standing scoring service — admission, micro-batching, deadlines,
+shedding, and graceful degradation over one ``score_function`` closure.
+
+``ScoringService`` assembles the library pieces PRs 1–7 built into the
+long-lived path ROADMAP item 1 names: requests enter through a bounded
+:class:`~.queue.AdmissionQueue`, assemble into micro-batches on the
+:class:`~.batcher.MicroBatcher` (riding the closure's ``FusionPlanner``
+buffer and banked executables — :meth:`start` pre-warms the program bank
+and primes fusion), execute under the tightest member's
+:class:`~.deadline.DeadlineBudget` (stage-family checkpoints inside
+``local/scoring.py`` reject late requests early), and degrade through
+the :class:`~.shedding.LoadShedder` tiers when queue depth, in-flight
+rows, or open breakers say the service is past capacity.
+
+Every outcome is TYPED and COUNTED — the reconciliation invariant
+
+    admitted == completed + quarantined + shed + errors + outstanding
+
+holds at every instant (pinned by the chaos soak tests), and
+``stop(drain=True)`` quiesces cleanly: admissions close, the queue
+drains, workers join, no threads leak.
+
+Synchronous mode (``workers=0`` + :meth:`pump`) runs the whole loop on
+the caller's thread with an injectable clock — the loadtest harness and
+the chaos suite drive overload scenarios without a single real sleep.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import weakref
+from typing import Any, Callable
+
+from ..resilience import faults as _faults
+from ..telemetry import metrics as _tm
+from . import deadline as _deadline
+from .batcher import BatchPlan, MicroBatcher
+from .queue import AdmissionQueue, RejectedByAdmission
+from .shedding import LoadShedder, ShedConfig
+
+log = logging.getLogger(__name__)
+
+__all__ = ["PendingScore", "ScoreRequest", "ScoringService", "ServiceConfig"]
+
+#: outcome labels a finished request can carry
+OUTCOMES = ("completed", "quarantined", "deadline_exceeded", "stopped", "error")
+
+#: weakrefs to live services — the ``service`` exposition source
+_LIVE_SERVICES: list = []
+_LIVE_LOCK = threading.Lock()
+
+
+@dataclasses.dataclass
+class ServiceConfig:
+    """Tuning knobs (each has a matching env var documented in
+    docs/serving.md)."""
+
+    max_queue_rows: int = 2048      # admission queue bound
+    max_batch_rows: int = 256       # micro-batch assembly cap
+    max_wait: float = 0.005         # worker-mode assembly wait (real s)
+    workers: int = 1                # 0 = synchronous pump mode
+    default_deadline: float | None = None   # per-request budget seconds
+    shed: ShedConfig = dataclasses.field(default_factory=ShedConfig)
+
+
+class PendingScore:
+    """Future-like handle for one submitted request."""
+
+    __slots__ = (
+        "_event", "results", "error", "outcome",
+        "submitted_at", "completed_at",
+    )
+
+    def __init__(self, submitted_at: float):
+        self._event = threading.Event()
+        self.results: list[dict] | None = None
+        self.error: BaseException | None = None
+        self.outcome: str | None = None
+        self.submitted_at = submitted_at
+        self.completed_at: float | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> list[dict]:
+        """The per-row results; raises the typed rejection on a shed
+        request (quarantined requests RETURN — their rows carry default
+        predictions, which is the graceful-degradation contract)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not finished")
+        if self.error is not None:
+            raise self.error
+        return self.results  # type: ignore[return-value]
+
+    def latency(self) -> float | None:
+        if self.completed_at is None:
+            return None
+        return self.completed_at - self.submitted_at
+
+
+class ScoreRequest:
+    __slots__ = ("rows", "budget", "handle", "enqueued_at")
+
+    def __init__(
+        self,
+        rows: list[dict],
+        budget: _deadline.DeadlineBudget | None,
+        handle: PendingScore,
+        enqueued_at: float,
+    ):
+        self.rows = rows
+        self.budget = budget
+        self.handle = handle
+        self.enqueued_at = enqueued_at
+
+
+class ScoringService:
+    """Long-lived async scoring over one score-function closure."""
+
+    def __init__(
+        self,
+        score_fn: Callable,
+        config: ServiceConfig | None = None,
+        clock: Callable[[], float] | None = None,
+    ):
+        self.score_fn = score_fn
+        self.config = config or ServiceConfig()
+        self.clock = clock if clock is not None else time.monotonic
+        self.queue = AdmissionQueue(self.config.max_queue_rows)
+        self.batcher = MicroBatcher(
+            self.queue, self.config.max_batch_rows, clock=self.clock
+        )
+        self.shedder = LoadShedder(
+            self.config.shed, capacity=self.config.max_queue_rows
+        )
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._started = False
+        self._in_flight_rows = 0
+        self._in_flight_requests = 0
+        # harness hook: called with (real_seconds, simulated_seconds,
+        # executed_rows) after each batch execution, BEFORE completions
+        # are stamped — the loadtest harness advances its virtual clock
+        # here so latencies include service time without any real sleeps
+        self.on_batch_cost: Callable[[float, float, int], None] | None = None
+        # typed outcome counters (mutations under self._lock)
+        self.admitted = 0
+        self.completed = 0
+        self.quarantined = 0
+        self.errors = 0
+        self.batches = 0
+        self.shed: dict[str, int] = {"deadline_exceeded": 0, "stopped": 0}
+        self.rejected: dict[str, int] = {
+            "queue_full": 0, "shedding": 0, "stopped": 0, "deadline": 0,
+        }
+        with _LIVE_LOCK:
+            _LIVE_SERVICES[:] = [
+                r for r in _LIVE_SERVICES if r() is not None
+            ]
+            _LIVE_SERVICES.append(weakref.ref(self))
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, wait_warmup: bool = False, timeout: float = 60.0) -> "ScoringService":
+        """Idempotent: pre-warms the banked scoring executables
+        (``compiler/warmup.py``), primes the closure's fusion planner from
+        fit-static widths, and launches the worker threads."""
+        from ..compiler import warmup as _warmup
+
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        _warmup.start_warmup(_warmup.SCORE_PROGRAMS, scope="score")
+        if wait_warmup:
+            _warmup.join_warmup(timeout=timeout)
+        fusion = getattr(self.score_fn, "fusion", None)
+        if fusion is not None:
+            try:
+                fusion.prime()
+            except Exception:  # priming is an optimization, never fatal
+                log.debug("fusion prime failed", exc_info=True)
+        for i in range(self.config.workers):
+            th = threading.Thread(
+                target=self._worker, daemon=True, name=f"tptpu-serve-{i}"
+            )
+            self._threads.append(th)
+            th.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Quiesce: close admissions, drain (or shed) the queue, join
+        workers. After stop() the queue is empty, every admitted request
+        has a typed outcome, and no service thread is alive."""
+        self.queue.close()
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=timeout)
+            if th.is_alive():  # pragma: no cover - the deadlock alarm
+                raise RuntimeError(f"service worker {th.name} leaked")
+        self._threads.clear()
+        if drain:
+            while self.pump():
+                pass
+        for req in self.queue.drain():
+            self._finish(
+                req, "stopped", error=RejectedByAdmission("stopped")
+            )
+        self.shedder.reset()
+        _tm.REGISTRY.gauge("tptpu_serve_queue_depth").set(0)
+        _tm.REGISTRY.gauge("tptpu_serve_in_flight_rows").set(0)
+
+    def __enter__(self) -> "ScoringService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ admission
+    def submit(
+        self,
+        rows: dict | list[dict],
+        deadline: float | None = None,
+    ) -> PendingScore:
+        """Admit one request (one row dict, or a small list scored as a
+        unit). Raises :class:`RejectedByAdmission` (queue full / shedding
+        tier / stopped) or :class:`~.deadline.DeadlineExceeded` (the
+        budget cannot cover the pipeline p95 even before queuing) —
+        admission control rejects early, it never blocks."""
+        if isinstance(rows, dict):
+            rows = [rows]
+        if not rows:
+            raise ValueError("empty request")
+        now = self.clock()
+        if self._stop.is_set() or self.queue.closed:
+            self._count_rejected("stopped")
+            raise RejectedByAdmission("stopped")
+        # backpressure: the tier reflects THIS request's world, not the
+        # last batch's (bursts between pumps must start rejecting)
+        self._update_shedder()
+        if self.shedder.reject_admissions:
+            self._count_rejected("shedding")
+            raise RejectedByAdmission(
+                "shedding", f"load {self.shedder.load:.3f}"
+            )
+        budget = None
+        secs = deadline if deadline is not None else self.config.default_deadline
+        if secs is not None:
+            budget = _deadline.DeadlineBudget(secs, clock=self.clock, started=now)
+            if not budget.covers():
+                self._count_rejected("deadline")
+                _tm.REGISTRY.counter(
+                    "tptpu_serve_deadline_exceeded_total"
+                ).inc()
+                raise _deadline.DeadlineExceeded(
+                    "admission", budget.remaining(), _deadline.pipeline_p95()
+                )
+        handle = PendingScore(submitted_at=now)
+        req = ScoreRequest(list(rows), budget, handle, enqueued_at=now)
+        try:
+            # offer + admitted count under ONE critical section: a worker
+            # can pop and settle the request the instant offer() publishes
+            # it, and the reconciliation invariant (admitted >= settled at
+            # every instant) must never observe the settle before the
+            # admission. Safe nesting: nothing acquires self._lock while
+            # holding the queue lock.
+            with self._lock:
+                self.queue.offer(req)
+                self.admitted += 1
+        except RejectedByAdmission as e:
+            self._count_rejected(e.reason)
+            raise
+        _tm.REGISTRY.counter("tptpu_serve_admitted_total").inc()
+        return handle
+
+    def _count_rejected(self, reason: str) -> None:
+        with self._lock:
+            self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        _tm.REGISTRY.counter("tptpu_serve_rejected_total").inc()
+
+    # ------------------------------------------------------------ execution
+    def pump(self) -> int:
+        """Synchronously assemble and execute ONE micro-batch on the
+        caller's thread; returns the number of requests it settled (0 when
+        the queue was empty). The workerless twin of the service loop —
+        the loadtest harness's whole engine."""
+        plan = self.batcher.next_batch(wait=0.0)
+        if plan is None or plan.empty:
+            self._update_shedder()
+            return 0
+        return self._execute(plan)
+
+    def _worker(self) -> None:
+        cfg = self.config
+        while True:
+            plan = self.batcher.next_batch(wait=max(cfg.max_wait, 1e-3))
+            if plan is not None and not plan.empty:
+                try:
+                    self._execute(plan)
+                except Exception:  # pragma: no cover - belt and braces
+                    log.exception("service batch execution failed")
+            elif self._stop.is_set() and self.queue.depth_requests() == 0:
+                return
+
+    def _execute(self, plan: BatchPlan) -> int:
+        for req in plan.expired:
+            self._finish(
+                req, "deadline_exceeded",
+                error=_deadline.DeadlineExceeded(
+                    "queue", -1.0 if req.budget is None
+                    else req.budget.remaining(),
+                    _deadline.pipeline_p95(),
+                ),
+            )
+            _tm.REGISTRY.counter("tptpu_serve_deadline_exceeded_total").inc()
+        if not plan.requests:
+            self._update_shedder()
+            return len(plan.expired)
+        n_rows = len(plan.rows)
+        with self._lock:
+            self._in_flight_rows += n_rows
+            self._in_flight_requests += len(plan.requests)
+            self.batches += 1
+        _tm.REGISTRY.gauge("tptpu_serve_in_flight_rows").set(
+            self._in_flight_rows
+        )
+        self._update_shedder()
+        # deadline outcomes are PER REQUEST, not per batch: the batch runs
+        # under its tightest member's budget, and when that budget trips a
+        # stage-family checkpoint mid-execution, only the members whose own
+        # budget can no longer cover the pipeline are shed — the rest
+        # (including members that never asked for a deadline) re-execute
+        # without the tripped member. Each retry sheds at least the
+        # tripping member, so the loop is bounded by the member count.
+        pending = list(plan.requests)
+        while pending:
+            rows = [r for req in pending for r in req.rows]
+            budget = None
+            for req in pending:
+                b = req.budget
+                if b is not None and (
+                    budget is None or b.remaining() < budget.remaining()
+                ):
+                    budget = b
+            fault_plan = _faults.active()
+            sim0 = (
+                fault_plan.simulated_seconds if fault_plan is not None
+                else 0.0
+            )
+            t0 = time.perf_counter()
+            out: list[dict] | None = None
+            error: BaseException | None = None
+            try:
+                with _deadline.active(budget):
+                    out = self.score_fn.batch(rows)
+            except _deadline.DeadlineExceeded as e:
+                error = e
+            except Exception as e:  # contained: one batch, typed outcome
+                error = e
+                log.warning(
+                    "service batch of %d rows failed (%s: %s)",
+                    len(rows), type(e).__name__, e,
+                )
+            real = time.perf_counter() - t0
+            sim = (
+                fault_plan.simulated_seconds - sim0
+                if fault_plan is not None else 0.0
+            )
+            if self.on_batch_cost is not None:
+                self.on_batch_cost(real, sim, len(rows))
+            if error is None:
+                quarantined_rows = self._quarantined_rows()
+                off = 0
+                for req in pending:
+                    k = len(req.rows)
+                    req_out = out[off:off + k]
+                    hit = any(
+                        i in quarantined_rows for i in range(off, off + k)
+                    )
+                    off += k
+                    self._finish(
+                        req, "quarantined" if hit else "completed",
+                        results=req_out,
+                    )
+                break
+            if not isinstance(error, _deadline.DeadlineExceeded):
+                for req in pending:
+                    self._finish(req, "error", error=error)
+                break
+            # shed exactly the members whose own budget is now spent (the
+            # tripping tightest budget is always among them); guarantee
+            # progress even if covers() flickers back true
+            required = _deadline.pipeline_p95()
+            spent = [
+                req for req in pending
+                if req.budget is not None
+                and not req.budget.covers(required=required)
+            ]
+            if not spent:
+                spent = [
+                    req for req in pending if req.budget is budget
+                ] or pending[:1]
+            for req in spent:
+                self._finish(req, "deadline_exceeded", error=error)
+                _tm.REGISTRY.counter(
+                    "tptpu_serve_deadline_exceeded_total"
+                ).inc()
+            pending = [req for req in pending if req.handle.outcome is None]
+        with self._lock:
+            self._in_flight_rows -= n_rows
+            self._in_flight_requests -= len(plan.requests)
+        _tm.REGISTRY.gauge("tptpu_serve_in_flight_rows").set(
+            self._in_flight_rows
+        )
+        self._update_shedder()
+        return len(plan.requests) + len(plan.expired)
+
+    def _quarantined_rows(self) -> set[int]:
+        """Flat row indices the closure quarantined in the batch it just
+        scored (thread-local per-batch view of the QuarantineLog)."""
+        qlog = getattr(self.score_fn, "quarantine", None)
+        if qlog is None:
+            return set()
+        try:
+            return qlog.batch_rows()
+        except Exception:
+            return set()
+
+    def _finish(
+        self,
+        req: ScoreRequest,
+        outcome: str,
+        results: list[dict] | None = None,
+        error: BaseException | None = None,
+    ) -> None:
+        h = req.handle
+        h.results = results
+        h.error = error
+        h.outcome = outcome
+        h.completed_at = self.clock()
+        with self._lock:
+            if outcome == "completed":
+                self.completed += 1
+            elif outcome == "quarantined":
+                self.quarantined += 1
+            elif outcome == "error":
+                self.errors += 1
+            else:
+                self.shed[outcome] = self.shed.get(outcome, 0) + 1
+        if outcome == "completed":
+            _tm.REGISTRY.counter("tptpu_serve_completed_total").inc()
+        elif outcome in ("deadline_exceeded", "stopped"):
+            _tm.REGISTRY.counter("tptpu_serve_shed_total").inc()
+        h._event.set()
+
+    # -------------------------------------------------------------- signals
+    def _breaker_open_fraction(self) -> float:
+        breakers = getattr(self.score_fn, "breakers", None)
+        if not breakers:
+            return 0.0
+        states = [br.state for br in list(breakers.values())]
+        return states.count("open") / len(states) if states else 0.0
+
+    def _update_shedder(self) -> None:
+        self.shedder.update(
+            self.queue.depth_rows(),
+            self._in_flight_rows,
+            self._breaker_open_fraction(),
+        )
+
+    # ---------------------------------------------------------------- state
+    def stats(self) -> dict[str, Any]:
+        """Typed counters + the reconciliation fields. ``outstanding`` is
+        admitted-but-unfinished (queued or in flight); at quiesce it is 0
+        and ``admitted == completed + quarantined + shed + errors``."""
+        with self._lock:
+            settled = (
+                self.completed + self.quarantined + self.errors
+                + sum(self.shed.values())
+            )
+            return {
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "quarantined": self.quarantined,
+                "errors": self.errors,
+                "batches": self.batches,
+                "shed": dict(self.shed),
+                "rejected": dict(self.rejected),
+                "outstanding": self.admitted - settled,
+                "queueDepthRows": self.queue.depth_rows(),
+                "queuePeakRows": self.queue.peak_rows,
+                "inFlightRows": self._in_flight_rows,
+                "shedding": self.shedder.stats(),
+                "batcher": self.batcher.stats(),
+            }
+
+
+def _service_source() -> dict[str, Any]:
+    """Aggregate standing-service counters across live services — the
+    ``service`` ledger source of ``telemetry.render_prometheus()``."""
+    out = {
+        "services": 0, "admitted": 0, "completed": 0, "quarantined": 0,
+        "shedTotal": 0, "rejectedTotal": 0, "errors": 0,
+        "queueDepthRows": 0, "inFlightRows": 0, "shedTier": 0,
+    }
+    with _LIVE_LOCK:
+        refs = list(_LIVE_SERVICES)
+    for ref in refs:
+        svc = ref()
+        if svc is None:
+            continue
+        try:
+            s = svc.stats()
+        except Exception:  # a half-built service must not kill exposition
+            continue
+        out["services"] += 1
+        out["admitted"] += s["admitted"]
+        out["completed"] += s["completed"]
+        out["quarantined"] += s["quarantined"]
+        out["shedTotal"] += sum(s["shed"].values())
+        out["rejectedTotal"] += sum(s["rejected"].values())
+        out["errors"] += s["errors"]
+        out["queueDepthRows"] += s["queueDepthRows"]
+        out["inFlightRows"] += s["inFlightRows"]
+        out["shedTier"] = max(out["shedTier"], s["shedding"]["tier"])
+    return out
+
+
+_tm.REGISTRY.register_source("service", _service_source)

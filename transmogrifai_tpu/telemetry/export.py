@@ -125,7 +125,13 @@ def render_prometheus(
         snap_counters = dict(registry._counters)
         snap_gauges = dict(registry._gauges)
         histograms = list(registry._histograms.values())
-        sources = registry.source_snapshots()
+    # sources MUST run after the lock releases: the lock is re-entrant, so
+    # calling source_snapshots() inside the block silently runs the source
+    # callables with the registry lock held — an ABBA deadlock against any
+    # thread holding its subsystem lock while touching a gauge/counter
+    # (e.g. ScoringService.submit -> queue gauge vs. the service source ->
+    # ScoringService.stats).
+    sources = registry.source_snapshots()
     for name in sorted(snap_counters):
         c = snap_counters[name]
         lines.append(f"# TYPE {name} counter")

@@ -259,22 +259,33 @@ class MetricsRegistry:
             self._sources[name] = fn
 
     def source_snapshots(self) -> dict[str, dict]:
+        """Snapshot every registered source. The source callables run
+        OUTSIDE the registry lock: sources reach into their subsystem's
+        own locks (the standing service's, the load shedder's), and those
+        subsystems take the registry lock on their hot paths (gauges,
+        counters) — invoking sources under the registry lock is an ABBA
+        deadlock with any concurrent submit/update. Each ledger source
+        still snapshots consistently under its own lock; only
+        cross-source simultaneity is (harmlessly) approximate."""
         with self.lock:
             items = list(self._sources.items())
-            out: dict[str, dict] = {}
-            for name, fn in items:
-                try:
-                    out[name] = fn()
-                except Exception:  # a dead source must not kill exposition
-                    out[name] = {}
-            return out
+        out: dict[str, dict] = {}
+        for name, fn in items:
+            try:
+                out[name] = fn()
+            except Exception:  # a dead source must not kill exposition
+                out[name] = {}
+        return out
 
     # ------------------------------------------------------------ snapshot
     def snapshot_all(self) -> dict[str, Any]:
-        """Consistent JSON-able view of everything the registry knows:
-        taken under the shared lock, so ledger sources cannot tear."""
+        """JSON-able view of everything the registry knows. Metrics are
+        read under the shared lock (one consistent point in time); source
+        snapshots run after it, outside the lock (see
+        :meth:`source_snapshots` — each source is internally consistent
+        under its own lock)."""
         with self.lock:
-            return {
+            out: dict[str, Any] = {
                 "counters": {
                     n: c.value for n, c in sorted(self._counters.items())
                 },
@@ -285,8 +296,9 @@ class MetricsRegistry:
                     {"name": h.name, "labels": dict(h.labels), **h.snapshot()}
                     for _, h in sorted(self._histograms.items())
                 ],
-                "sources": self.source_snapshots(),
             }
+        out["sources"] = self.source_snapshots()
+        return out
 
     def reset_metrics_for_tests(self) -> None:
         """Drop counters/gauges/histograms (sources stay registered)."""

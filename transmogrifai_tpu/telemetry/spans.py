@@ -42,9 +42,11 @@ __all__ = [
     "record_serve_batch",
     "clock",
     "set_clock",
+    "get_clock",
     "enabled",
     "set_enabled",
     "stage_detail",
+    "set_detail_suppressed",
     "snapshot_events",
     "recent_serve_traces",
     "configure_buffers",
@@ -69,6 +71,9 @@ _CLOCK: Callable[[], float] = time.monotonic
 #: below holds ``_LOCK`` (TPL001)
 _STATE: dict[str, Any] = {
     "enabled": os.environ.get("TPTPU_TELEMETRY", "1") != "0",
+    # raised by the serving load shedder (tier >= 1): per-stage detail
+    # spans are the cheapest thing to drop under overload
+    "detail_suppressed": False,
 }
 _EVENTS: deque = deque(maxlen=_env_int("TPTPU_TRACE_BUFFER", 65536))
 _SERVE_RING: deque = deque(maxlen=_env_int("TPTPU_SERVE_TRACE_RING", 64))
@@ -90,6 +95,11 @@ def set_clock(fn: Callable[[], float] | None = None) -> None:
     _CLOCK = fn if fn is not None else time.monotonic
 
 
+def get_clock() -> Callable[[], float]:
+    """The currently installed clock callable (for save/restore swaps)."""
+    return _CLOCK
+
+
 def enabled() -> bool:
     return _STATE["enabled"]
 
@@ -101,8 +111,22 @@ def set_enabled(on: bool) -> None:
 
 def stage_detail(rows: int) -> bool:
     """True when scoring should emit per-stage detail spans for a batch of
-    ``rows`` (large enough that span cost is noise)."""
-    return _STATE["enabled"] and rows >= _DETAIL_MIN_ROWS
+    ``rows`` (large enough that span cost is noise, and the load shedder
+    has not suppressed detail)."""
+    return (
+        _STATE["enabled"]
+        and not _STATE["detail_suppressed"]
+        and rows >= _DETAIL_MIN_ROWS
+    )
+
+
+def set_detail_suppressed(on: bool) -> None:
+    """Shed/restore per-stage detail spans (serving shed tier 1 — the
+    first, cheapest degradation under overload). A stale read in a scoring
+    thread mid-transition costs one extra/missing detail span, never
+    correctness, so the read side stays lock-free."""
+    with _LOCK:
+        _STATE["detail_suppressed"] = bool(on)
 
 
 def _tid() -> int:
