@@ -59,6 +59,119 @@ def _reference_titanic_train_s() -> float:
 REFERENCE_TITANIC_TRAIN_S = _reference_titanic_train_s()
 
 
+# --------------------------------------------------------------------------
+# unified bench report shape
+# --------------------------------------------------------------------------
+#: committed BENCH_r*.json files historically came in two ad-hoc shapes —
+#: the harness capture ({n, cmd, rc, tail, parsed}, r01-r05) and the
+#: metric-style dict (r06). New reports all go through write_bench_report:
+#: one envelope stamping schema_version/seed/median_of plus a flat
+#: ``metrics`` map, so regression tooling parses every future report the
+#: same way. validate_bench_report accepts the permissive union of all
+#: three, so the committed history stays parseable forever.
+BENCH_SCHEMA_VERSION = 1
+
+
+def make_bench_report(
+    *,
+    metric: str,
+    value,
+    unit: str,
+    seed: int | None = None,
+    median_of: int | None = None,
+    metrics: dict | None = None,
+    **extras,
+) -> dict:
+    """The unified report envelope: headline metric/value/unit (the shape
+    every historical consumer already greps), provenance stamps, and a
+    flat numeric ``metrics`` map for regression tooling."""
+    report = {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "seed": seed,
+        "median_of": median_of,
+        "metrics": dict(metrics or {}),
+    }
+    report.update(extras)
+    return report
+
+
+def dump_bench_report(
+    report: dict, path: str | None, echo: bool = False
+) -> dict:
+    """The ONE writing convention for bench reports: a single JSON
+    document + trailing newline (optionally echoed to stdout first) —
+    shared by every subcommand that takes ``--out``."""
+    doc = json.dumps(report)
+    if echo:
+        print(doc)
+    if path:
+        with open(path, "w") as fh:
+            fh.write(doc + "\n")
+    return report
+
+
+def write_bench_report(path: str | None, **kw) -> dict:
+    """Build a unified report and (when ``path`` is given) write it."""
+    return dump_bench_report(make_bench_report(**kw), path)
+
+
+def validate_bench_report(doc) -> list[str]:
+    """Problems with a bench report under the permissive legacy/new
+    union (empty list = valid). Accepted shapes:
+
+    * **unified** (``schema_version`` >= 1): metric/value/unit + a dict
+      ``metrics`` map and the seed/median_of provenance stamps;
+    * **legacy metric-style** (r06): metric/value/unit, anything else
+      free-form;
+    * **legacy harness capture** (r01-r05): ``cmd``/``rc``/``tail``.
+    """
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"not a JSON object: {type(doc).__name__}"]
+    if "schema_version" in doc:
+        if not isinstance(doc["schema_version"], int) or doc["schema_version"] < 1:
+            problems.append(f"bad schema_version {doc['schema_version']!r}")
+        for key, types in (
+            ("metric", str), ("unit", str), ("metrics", dict),
+        ):
+            if not isinstance(doc.get(key), types):
+                problems.append(f"unified report missing/invalid {key!r}")
+        if "value" not in doc:
+            problems.append("unified report missing 'value'")
+        for key in ("seed", "median_of"):
+            v = doc.get(key)
+            if v is not None and not isinstance(v, int):
+                problems.append(f"{key!r} must be int or null, got {v!r}")
+        metrics = doc.get("metrics")
+        if isinstance(metrics, dict):
+            for name, v in metrics.items():
+                if v is not None and not isinstance(
+                    v, (int, float, str, bool)
+                ):
+                    problems.append(
+                        f"metrics[{name!r}] is not a scalar: {v!r}"
+                    )
+    elif "metric" in doc:
+        for key, types in (("metric", str), ("unit", str)):
+            if not isinstance(doc.get(key), types):
+                problems.append(f"metric-style report invalid {key!r}")
+        if "value" not in doc:
+            problems.append("metric-style report missing 'value'")
+    elif "cmd" in doc or "tail" in doc:
+        if not isinstance(doc.get("rc"), int):
+            problems.append("harness capture missing integer 'rc'")
+        if not isinstance(doc.get("tail"), str):
+            problems.append("harness capture missing 'tail'")
+    else:
+        problems.append(
+            "unrecognized bench shape (none of schema_version/metric/cmd)"
+        )
+    return problems
+
+
 def _telemetry_phase_breakdown() -> dict:
     """Span-derived ingest/featurize/compile/fit/eval seconds (telemetry
     plane); empty when telemetry is disabled."""
@@ -791,6 +904,91 @@ def bench_serve_loadtest(
     }
 
 
+def bench_explain(
+    rows: int = 256,
+    k: int = 3,
+    median_of: int = 5,
+) -> dict:
+    """Serving-speed batched LOCO attributions (ROADMAP item 4): score
+    one batch plain, then score the SAME batch with ``explain=k``, and
+    report attribution throughput as a fraction of plain scoring
+    throughput (target: >= 10%, i.e. explaining costs at most ~10x — the
+    reference's per-row LOCO is ~groups×rows dispatches, 100x+).
+
+    Both measurements are medians of ``median_of`` in-process reps after
+    a warmup call (the usual bench protocol); the report carries the
+    attribution-ledger delta (lane dispatch/dedup/pad counts, per-group
+    top-k hits), the compileStats sweep counters the explain program
+    family rode, and whether the ``attribution`` ledger made it into the
+    Prometheus exposition."""
+    from transmogrifai_tpu.compiler import stats as cstats
+    from transmogrifai_tpu.insights import ledger as attr_ledger
+    from transmogrifai_tpu.local.scoring import score_function
+    from transmogrifai_tpu.telemetry import render_prometheus
+
+    model, sample = _serve_loadtest_model()
+    fn = score_function(model)
+    reps = -(-rows // len(sample))
+    batch = [dict(r) for r in (sample * reps)[:rows]]
+
+    def _median(call) -> float:
+        call()  # warm the bucket/program for this shape
+        ts = []
+        for _ in range(median_of):
+            t = time.perf_counter()
+            call()
+            ts.append(time.perf_counter() - t)
+        return sorted(ts)[len(ts) // 2]
+
+    plain_s = _median(lambda: fn.batch(batch))
+    attr_before = attr_ledger.snapshot()
+    compile_before = cstats.snapshot()
+    explain_s = _median(lambda: fn.batch(batch, explain=k))
+    attr_delta = attr_ledger.delta(attr_before)
+    compile_delta = cstats.delta(compile_before)
+    plain_rps = rows / plain_s
+    explain_rps = rows / explain_s
+    ratio = explain_rps / plain_rps
+
+    sample_out = fn.batch(batch[:2], explain=k)
+    md = fn.metadata()["attributions"]
+    prom = render_prometheus()
+    return make_bench_report(
+        metric="explain_vs_plain_serving_throughput",
+        value=round(ratio, 4),
+        unit="fraction of plain scoring rows/s (target >= 0.10)",
+        seed=17,  # _serve_loadtest_model's fixed flow seed
+        median_of=median_of,
+        metrics={
+            "plain_rows_per_sec": round(plain_rps),
+            "explain_rows_per_sec": round(explain_rps),
+            "explain_vs_plain_throughput": round(ratio, 4),
+            "target_min_ratio": 0.10,
+            "rows": rows,
+            "top_k": k,
+            "groups": len(md["groups"] or ()),
+            "rows_explained": attr_delta["rowsExplained"],
+            "lane_dispatches": attr_delta["laneDispatches"],
+            "lanes_deduped": attr_delta["lanesDeduped"],
+            "lanes_padded": attr_delta["lanesPadded"],
+            "explain_batches": attr_delta["explainBatches"],
+            "compile_dedup_hits": compile_delta["dedupHits"],
+            "compile_lane_bucket_pads": compile_delta["laneBucketPads"],
+            "prometheus_has_attribution_ledger": (
+                "tptpu_attribution_rows_explained" in prom
+            ),
+        },
+        config=(
+            f"synthetic Real+Real+PickList LR flow (512 fit rows), "
+            f"{rows}-row batch, top-{k} LOCO attributions, batched "
+            f"[lanes x N, width] sweep through the banked predict program"
+        ),
+        sample_attributions=sample_out[0]["attributions"],
+        attribution_ledger=attr_delta,
+        attribution_drift_enabled=md["drift"]["enabled"],
+    )
+
+
 def _build_parser():
     """Argparse front-end: every historical ``bench.py <mode>`` argv mode
     is a subcommand of the same name (so invocations never changed), and
@@ -860,6 +1058,31 @@ def _build_parser():
     sl.add_argument(
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH",
+    )
+    ex = sub.add_parser(
+        "explain",
+        help=(
+            "serving-speed batched LOCO attributions: explain throughput "
+            "as a fraction of plain scoring throughput (target >= 10%%), "
+            "with the attribution-ledger and compile-sweep deltas"
+        ),
+    )
+    ex.add_argument(
+        "--rows", type=int, default=256,
+        help="batch size to score/explain (default 256)",
+    )
+    ex.add_argument(
+        "--k", type=int, default=3,
+        help="top-k attributions per row (default 3)",
+    )
+    ex.add_argument(
+        "--median-of", type=int, default=5,
+        help="timed reps per measurement, median reported (default 5)",
+    )
+    ex.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the JSON report to PATH (the BENCH_r07.json "
+             "regression shape)",
     )
     return p
 
@@ -1018,19 +1241,23 @@ def _dispatch(ns) -> None:
     if mode == "coldprobe":
         print(json.dumps(bench_titanic_cold()))
         return
-    if mode == "serve-loadtest":
-        report = bench_serve_loadtest(
-            rates=ns.rates, duration=ns.duration, seed=ns.seed,
-            deadline=ns.deadline, bursts=ns.bursts, chaos=ns.chaos,
-            max_queue_rows=ns.max_queue_rows,
-            max_batch_rows=ns.max_batch_rows,
-            service_time=ns.service_time,
+    if mode == "explain":
+        dump_bench_report(
+            bench_explain(rows=ns.rows, k=ns.k, median_of=ns.median_of),
+            ns.out, echo=True,
         )
-        doc = json.dumps(report)
-        print(doc)
-        if ns.out:
-            with open(ns.out, "w") as fh:
-                fh.write(doc + "\n")
+        return
+    if mode == "serve-loadtest":
+        dump_bench_report(
+            bench_serve_loadtest(
+                rates=ns.rates, duration=ns.duration, seed=ns.seed,
+                deadline=ns.deadline, bursts=ns.bursts, chaos=ns.chaos,
+                max_queue_rows=ns.max_queue_rows,
+                max_batch_rows=ns.max_batch_rows,
+                service_time=ns.service_time,
+            ),
+            ns.out, echo=True,
+        )
         return
     # cold probe FIRST: a fresh process against whatever program bank is
     # on disk — the number one cold training run actually pays (the

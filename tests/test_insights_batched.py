@@ -1,0 +1,773 @@
+"""Explainability-plane suite (transmogrifai_tpu/insights/): batched-LOCO
+golden parity against the per-group-loop oracle, the attribution ledger,
+attribution drift, explain-aware serving (shed tier / deadline skip /
+quarantine interplay), the TPX007 metadata-fallback surface, and the
+train-time baseline profile round-trip.
+
+Marker: insights. Everything is synthetic and fast (no titanic fixture,
+no sleeps).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder, from_dataset
+from transmogrifai_tpu.insights import (
+    AttributionDriftMonitor,
+    RecordInsightsLOCO,
+    column_groups,
+    compute_attribution_profile,
+    explain_batch,
+    top_k_maps,
+)
+from transmogrifai_tpu.insights import ledger as attr_ledger
+from transmogrifai_tpu.insights.loco import reference_loop
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.models.linear import LinearRegression
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.serving import (
+    LoadShedder,
+    ScoringService,
+    ServiceConfig,
+    ShedConfig,
+)
+from transmogrifai_tpu.serving import deadline as sdl
+from transmogrifai_tpu.serving import shedding as sshed
+from transmogrifai_tpu.serving.loadtest import VirtualClock
+from transmogrifai_tpu.stages.metadata import ColumnMeta, VectorMetadata
+from transmogrifai_tpu.telemetry import events as tevents
+from transmogrifai_tpu.telemetry import metrics as tm
+from transmogrifai_tpu.types.columns import (
+    VectorColumn,
+    column_from_values,
+)
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+
+pytestmark = pytest.mark.insights
+
+
+# ------------------------------------------------------------------ fixtures
+def _fit_lr(x, y):
+    lbl = FeatureBuilder.RealNN("label").as_response()
+    vecf = FeatureBuilder.OPVector("vec").as_predictor()
+    est = LogisticRegression().set_input(lbl, vecf)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, y.tolist()),
+        "vec": VectorColumn(T.OPVector, x),
+    })
+    return est.fit(ds), vecf
+
+
+@pytest.fixture(scope="module")
+def lr_case():
+    rng = np.random.default_rng(11)
+    n, d = 64, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    x[:, 4] = 0.0          # an all-zero column: the dedup lane
+    x[5] = 0.0             # an all-null (all-zero) row
+    x[-1] = 0.0
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    model, vecf = _fit_lr(x, y)
+    return model, x, vecf
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """Mixed-type flow (Real + Real + PickList) — transmogrify metadata
+    carries real group provenance, the plan has a fitted selector."""
+    uid_util.reset()
+    rng = np.random.default_rng(17)
+    n = 128
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    city = [["a", "b", "c", "d"][i % 4] for i in range(n)]
+    label = (x1 + 0.5 * x2 + 0.2 * rng.normal(size=n) > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+        "city": column_from_values(T.PickList, city),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    )
+    pred = selector.set_input(resp, vec).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    rows = [
+        {"x1": float(a), "x2": float(b), "city": c}
+        for a, b, c in zip(x1, x2, city)
+    ]
+    return ds, model, rows
+
+
+# --------------------------------------------------------------- unit: groups
+def _text_hash_meta(n_hash=4):
+    """Vector metadata with unicode parents and hashed-text descriptors —
+    the RecordInsightsLOCO text-aggregation shape."""
+    cols = [
+        ColumnMeta(
+            parent_names=("désc_ünïcode",), parent_type="Text",
+            grouping="désc_ünïcode", descriptor_value=f"hash_{i}", index=i,
+        )
+        for i in range(n_hash)
+    ]
+    cols.append(ColumnMeta(
+        parent_names=("age",), parent_type="Real", index=n_hash,
+    ))
+    cols.append(ColumnMeta(
+        parent_names=("when",), parent_type="Date",
+        descriptor_value="DayOfWeek", index=n_hash + 1,
+    ))
+    return VectorMetadata("vec", tuple(cols))
+
+
+def test_column_groups_aggregate_unicode_text_hashes():
+    meta = _text_hash_meta()
+    groups = column_groups(meta, meta.size)
+    names = [n for n, _ in groups]
+    assert "désc_ünïcode(text)" in names
+    text_idxs = dict(groups)["désc_ünïcode(text)"]
+    assert text_idxs == [0, 1, 2, 3]  # all hash columns, one group
+    assert "when" in names  # date components aggregate by parent
+
+
+def test_column_groups_meta_fallback_counts_on_ledger():
+    before = attr_ledger.snapshot()["metaFallbacks"]
+    groups = column_groups(None, 3)
+    assert [n for n, _ in groups] == ["col_0", "col_1", "col_2"]
+    assert attr_ledger.snapshot()["metaFallbacks"] == before + 1
+    # size mismatch degrades identically (and counts)
+    groups = column_groups(_text_hash_meta(), 99)
+    assert all(n.startswith("col_") for n, _ in groups)
+    assert attr_ledger.snapshot()["metaFallbacks"] == before + 2
+
+
+# ------------------------------------------------------- golden parity: LOCO
+class TestBatchedParity:
+    def test_diffs_match_reference_loop(self, lr_case):
+        model, x, _ = lr_case
+        groups = column_groups(None, x.shape[1], count_fallback=False)
+        batched, info = explain_batch(model, x, groups)
+        golden = reference_loop(model, x, groups)
+        np.testing.assert_allclose(batched, golden, rtol=1e-6, atol=1e-9)
+        # the all-zero column deduped: exactly 0.0, no dispatch lane
+        assert info["deduped"] >= 1
+        assert np.all(batched[:, 4] == 0.0)
+
+    def test_single_row_batch(self, lr_case):
+        model, x, _ = lr_case
+        groups = column_groups(None, x.shape[1], count_fallback=False)
+        one, _ = explain_batch(model, x[:1], groups)
+        golden = reference_loop(model, x[:1], groups)
+        np.testing.assert_allclose(one, golden, rtol=1e-6, atol=1e-9)
+
+    def test_all_zero_rows_explain_to_zero(self, lr_case):
+        model, x, _ = lr_case
+        groups = column_groups(None, x.shape[1], count_fallback=False)
+        diffs, _ = explain_batch(model, x, groups)
+        # rows 5 and -1 are all-zero: zeroing any group changes nothing
+        assert np.all(diffs[5] == 0.0) and np.all(diffs[-1] == 0.0)
+
+    def test_lane_chunking_matches_monolithic(self, lr_case, monkeypatch):
+        model, x, _ = lr_case
+        groups = column_groups(None, x.shape[1], count_fallback=False)
+        whole, _ = explain_batch(model, x, groups)
+        # budget of ONE lane's elements: every lane dispatches alone
+        monkeypatch.setenv(
+            "TPTPU_EXPLAIN_LANE_BUDGET", str(x.shape[0] * x.shape[1])
+        )
+        chunked, info = explain_batch(model, x, groups)
+        np.testing.assert_allclose(chunked, whole, rtol=1e-6, atol=1e-9)
+        assert info["dispatches"] > 1
+
+    def test_floor_lane_bucket_respects_budget(self):
+        from transmogrifai_tpu.compiler.bucketing import lane_bucket
+        from transmogrifai_tpu.insights.loco import _floor_lane_bucket
+
+        for k in (1, 2, 3, 5, 17, 33, 63, 64, 65, 95, 96, 200):
+            b = _floor_lane_bucket(k)
+            assert 1 <= b <= k
+            # the chunk size IS a bucket: padding never rounds it up
+            assert lane_bucket(b) == b
+            # and any padded partial tail stays within the chunk size
+            for tail in range(1, b + 1):
+                assert lane_bucket(tail) <= b
+
+    def test_regression_model_tracks_prediction(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(40, 4)).astype(np.float32)
+        y = (2.0 * x[:, 0] - x[:, 2]).astype(np.float32)
+        lbl = FeatureBuilder.RealNN("label").as_response()
+        vecf = FeatureBuilder.OPVector("vec").as_predictor()
+        est = LinearRegression().set_input(lbl, vecf)
+        ds = Dataset.of({
+            "label": column_from_values(T.RealNN, y.tolist()),
+            "vec": VectorColumn(T.OPVector, x),
+        })
+        model = est.fit(ds)
+        groups = column_groups(None, 4, count_fallback=False)
+        batched, _ = explain_batch(model, x, groups)
+        golden = reference_loop(model, x, groups)
+        np.testing.assert_allclose(batched, golden, rtol=1e-5, atol=1e-7)
+        # the dominant coefficient dominates the attributions
+        assert np.mean(np.abs(batched[:, 0]) > np.abs(batched[:, 1])) > 0.9
+
+    def test_transformer_output_matches_pre_batched_semantics(self, lr_case):
+        """RecordInsightsLOCO end-to-end: identical top-k maps to the
+        per-group-loop implementation composed of the same selection."""
+        model, x, vecf = lr_case
+        ds = Dataset.of({"vec": VectorColumn(T.OPVector, x)})
+        loco = RecordInsightsLOCO(model, top_k=3).set_input(vecf)
+        out = loco.transform(ds)[loco.output_name].to_list()
+        groups = column_groups(None, x.shape[1], count_fallback=False)
+        golden_diffs = reference_loop(model, x, groups)
+        golden_maps, _ = top_k_maps(
+            golden_diffs, [n for n, _ in groups], 3
+        )
+        assert len(out) == len(golden_maps)
+        for got, want in zip(out, golden_maps):
+            assert set(got) == set(want)
+            for k in got:
+                assert got[k] == pytest.approx(want[k], rel=1e-6, abs=1e-9)
+
+    def test_top_k_larger_than_group_count_caps(self, lr_case):
+        model, x, vecf = lr_case
+        ds = Dataset.of({"vec": VectorColumn(T.OPVector, x)})
+        loco = RecordInsightsLOCO(model, top_k=50).set_input(vecf)
+        maps = loco.transform(ds)[loco.output_name].to_list()
+        assert all(len(m) == x.shape[1] for m in maps)  # capped at G
+
+    def test_unicode_text_hash_groups_in_transform(self, lr_case):
+        model, x36, vecf = lr_case
+        meta = _text_hash_meta()
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(16, meta.size)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        model, vecf = _fit_lr(x, y)
+        ds = Dataset.of({
+            "vec": VectorColumn(T.OPVector, x, meta),
+        })
+        loco = RecordInsightsLOCO(model, top_k=meta.size).set_input(vecf)
+        maps = loco.transform(ds)[loco.output_name].to_list()
+        keys = {k for m in maps for k in m}
+        assert "désc_ünïcode(text)" in keys
+        assert not any("hash_" in k for k in keys)
+
+
+# ----------------------------------------------------------- serving explain
+class TestExplainServing:
+    def test_batch_rows_carry_topk_attributions(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        out = fn.batch([dict(r) for r in rows[:8]], explain=3)
+        for r in out:
+            a = r["attributions"]
+            assert isinstance(a, dict) and len(a) == 3
+            assert all(isinstance(v, float) for v in a.values())
+        # the true driver x1 makes every row's top-k
+        assert all(
+            any(k.startswith("x1") for k in r["attributions"]) for r in out
+        )
+
+    def test_single_row_and_columns_entry_points(self, trained):
+        ds, model, rows = trained
+        fn = score_function(model)
+        one = fn(dict(rows[0]), explain=2)
+        assert len(one["attributions"]) == 2
+        cols_out = fn.columns(ds.take(np.arange(6)), explain=2)
+        assert len(cols_out["attributions"]) == 6
+        assert cols_out["attributions"][0] == one["attributions"]
+
+    def test_explain_off_leaves_rows_untouched(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        out = fn.batch([dict(rows[0])])
+        assert "attributions" not in out[0]
+        assert fn.batch([dict(rows[0])], explain=0)[0].keys() == out[0].keys()
+
+    def test_quarantined_rows_get_none_survivors_explained(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        bad = {"x1": "not_a_number_at_all", "x2": 1.0, "city": "a"}
+        out = fn.batch([bad, dict(rows[1]), dict(rows[2])], explain=2)
+        assert out[0]["attributions"] is None
+        assert len(out[1]["attributions"]) == 2
+        assert len(out[2]["attributions"]) == 2
+
+    def test_explain_requires_a_predictor(self):
+        uid_util.reset()
+        rng = np.random.default_rng(0)
+        n = 32
+        ds = Dataset.of({
+            "label": column_from_values(
+                T.RealNN, rng.integers(0, 2, n).astype(float).tolist()
+            ),
+            "x1": column_from_values(T.Real, rng.normal(size=n)),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        model = (
+            Workflow().set_result_features(vec).set_input_dataset(ds).train()
+        )
+        fn = score_function(model)
+        with pytest.raises(ValueError, match="explain"):
+            fn.batch([{"x1": 1.0}], explain=2)
+
+    def test_regression_workflow_serving_explain(self):
+        """explain=k must work for regression predictors too — the base
+        score there is the prediction itself (PredictionColumn has no
+        probability), the exact branch a classifier-only suite misses."""
+        from transmogrifai_tpu.selector import RegressionModelSelector
+
+        uid_util.reset()
+        rng = np.random.default_rng(9)
+        n = 96
+        x1 = rng.normal(size=n)
+        x2 = rng.normal(size=n)
+        target = 3.0 * x1 - 0.5 * x2 + 0.1 * rng.normal(size=n)
+        ds = Dataset.of({
+            "target": column_from_values(T.RealNN, target.tolist()),
+            "x1": column_from_values(T.Real, x1),
+            "x2": column_from_values(T.Real, x2),
+        })
+        resp, preds = from_dataset(ds, response="target")
+        vec = transmogrify(list(preds))
+        sel = RegressionModelSelector(
+            seed=5, models=[(LinearRegression(), {"reg_param": [0.01]})],
+        )
+        pred = sel.set_input(resp, vec).get_output()
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        )
+        fn = score_function(model)
+        before_errors = attr_ledger.snapshot()["explainErrors"]
+        out = fn.batch(
+            [{"x1": float(a), "x2": float(b)} for a, b in zip(x1[:8], x2[:8])],
+            explain=2,
+        )
+        # real attributions, not a silently-contained AttributeError
+        assert all(len(r["attributions"]) == 2 for r in out)
+        assert attr_ledger.snapshot()["explainErrors"] == before_errors
+        # the dominant coefficient leads most rows' top-k (|x2| can
+        # legitimately out-contribute 3·|x1| on a distribution tail)
+        tops = [
+            max(r["attributions"], key=lambda kv: abs(r["attributions"][kv]))
+            for r in out
+        ]
+        assert sum(1 for t in tops if t.startswith("x1")) >= 5
+
+    def test_sweep_failure_keeps_scores(self, trained, monkeypatch):
+        """Explain is pure observability: a sweep blowing up mid-flight
+        (allocation failure, unexpected predict error) must degrade to
+        attributions=None and a counter — never discard the batch's
+        already-rendered scores."""
+        from transmogrifai_tpu.insights import loco as loco_mod
+
+        _, model, rows = trained
+        fn = score_function(model)
+        before = attr_ledger.snapshot()["explainErrors"]
+
+        def _boom(*a, **kw):
+            raise MemoryError("lane plane allocation failed")
+
+        monkeypatch.setattr(loco_mod, "explain_batch", _boom)
+        out = fn.batch([dict(rows[0])], explain=2)
+        assert out[0]["attributions"] is None
+        assert [k for k in out[0] if k != "attributions"]  # scores kept
+        assert attr_ledger.snapshot()["explainErrors"] == before + 1
+        assert (
+            tm.REGISTRY.counter("tptpu_serve_explain_errors_total").value
+            >= 1
+        )
+
+    def test_negative_explain_rejected(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        with pytest.raises(ValueError):
+            fn.batch([dict(rows[0])], explain=-1)
+
+    def test_ledger_and_metadata_surface(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        before = attr_ledger.snapshot()
+        fn.batch([dict(r) for r in rows[:16]], explain=2)
+        md = fn.metadata()["attributions"]
+        assert md["available"] is True
+        assert md["groups"] and any(g.startswith("x1") for g in md["groups"])
+        led = md["ledger"]
+        assert led["rowsExplained"] >= before["rowsExplained"] + 16
+        assert led["laneDispatches"] > 0
+        groups = led["groups"]
+        # the ledger is process-wide and group names can collide across
+        # fixtures (every flow here has an x1) — assert on the DELTA of
+        # this batch's 16 rows over all x1 groups
+        before_hits = sum(
+            c["topKHits"]
+            for g, c in (before.get("groups") or {}).items()
+            if g.startswith("x1")
+        )
+        now_hits = sum(
+            c["topKHits"] for g, c in groups.items() if g.startswith("x1")
+        )
+        # x1 (the strongest coefficient) makes top-2 for most rows; x2 /
+        # a city pivot can legitimately beat it on distribution tails
+        assert now_hits >= before_hits + 8
+        x1g = next(g for g in groups if g.startswith("x1_"))
+        assert groups[x1g]["meanAbsContribution"] > 0
+        assert groups[x1g]["positiveFraction"] is not None
+
+    def test_prometheus_exposes_attribution_source(self, trained):
+        from transmogrifai_tpu.telemetry import render_prometheus
+
+        _, model, rows = trained
+        fn = score_function(model)
+        fn.batch([dict(rows[0])], explain=1)
+        prom = render_prometheus()
+        assert "tptpu_attribution_rows_explained" in prom
+        assert "tptpu_attribution_lane_dispatches" in prom
+
+    def test_summary_pretty_record_insights_line(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        fn.batch([dict(rows[0])], explain=1)
+        assert "Record insights:" in model.summary_pretty()
+
+    def test_determinism_pool_on_vs_off(self, trained, monkeypatch):
+        """TPTPU_FEATURIZE_THREADS=4 vs pool-off must produce identical
+        attributions (the sweep rides the assembled plane, which is
+        pinned pool-invariant by the featurize suite — this pins the
+        explain layer on top)."""
+        _, model, rows = trained
+        batch = [dict(r) for r in rows[:32]]
+        monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "4")
+        monkeypatch.setenv("TPTPU_FEATURIZE_CHUNK", "8")
+        on = score_function(model).batch(batch, explain=3)
+        monkeypatch.setenv("TPTPU_FEATURIZE_THREADS", "0")
+        off = score_function(model).batch(batch, explain=3)
+        assert [r["attributions"] for r in on] == [
+            r["attributions"] for r in off
+        ]
+
+
+# ------------------------------------------------------- shed tier + deadline
+class TestExplainDegradation:
+    def setup_method(self):
+        sshed.reset_process_flags_for_tests()
+
+    def teardown_method(self):
+        sshed.reset_process_flags_for_tests()
+
+    def test_explain_is_the_first_shed_casualty(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        before = attr_ledger.snapshot()["explainShedRows"]
+        sh = LoadShedder(ShedConfig(), capacity=100)
+        sh.update(40, 0, 0.0)  # tier 1: explain shed, detail spans intact
+        try:
+            assert sshed.explain_shed()
+            out = fn.batch([dict(r) for r in rows[:4]], explain=2)
+            assert all(r["attributions"] is None for r in out)
+            assert attr_ledger.snapshot()["explainShedRows"] == before + 4
+        finally:
+            sh.reset()
+        out = fn.batch([dict(rows[0])], explain=2)  # restored
+        assert out[0]["attributions"] is not None
+
+    def test_deadline_budget_skips_explain_keeps_scores(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        # teach the explain family a fat p95, then run under a budget
+        # that covers scoring but not explaining
+        tm.REGISTRY.histogram(
+            "tptpu_serve_seconds", labels={"stage": "explain"}
+        ).observe(30.0)
+        before = attr_ledger.snapshot()["explainDeadlineSkips"]
+        budget = sdl.DeadlineBudget(5.0)
+        with sdl.active(budget):
+            out = fn.batch([dict(rows[0])], explain=2)
+        assert out[0]["attributions"] is None  # skipped, not failed
+        score_keys = [k for k in out[0] if k != "attributions"]
+        assert score_keys  # the scores themselves survived
+        assert (
+            attr_ledger.snapshot()["explainDeadlineSkips"] == before + 1
+        )
+        evts = [
+            e for e in tevents.recent(20)
+            if e["kind"] == "explain_deadline_skip"
+        ]
+        assert evts and evts[-1]["requiredMs"] >= 1000.0
+
+    def test_service_carries_explain_through_microbatcher(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        clk = VirtualClock()
+        svc = ScoringService(
+            fn,
+            ServiceConfig(workers=0, max_queue_rows=64, max_batch_rows=16),
+            clock=clk,
+        )
+        svc.start()
+        h_explained = svc.submit(dict(rows[0]), explain=3)
+        h_small = svc.submit(dict(rows[1]), explain=1)
+        h_plain = svc.submit(dict(rows[2]))
+        while svc.pump():
+            pass
+        svc.stop()
+        assert len(h_explained.result(timeout=1)[0]["attributions"]) == 3
+        # co-batched member with a smaller k keeps ITS OWN |largest| 1
+        small = h_small.result(timeout=1)[0]["attributions"]
+        assert len(small) == 1
+        full = fn.batch([dict(rows[1])], explain=3)[0]["attributions"]
+        top_name = max(full, key=lambda kv: abs(full[kv]))
+        assert list(small) == [top_name]
+        # a member that never asked sees no attributions key
+        assert "attributions" not in h_plain.result(timeout=1)[0]
+
+    def test_service_admission_budgets_for_explain_family(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        tm.REGISTRY.histogram(
+            "tptpu_serve_seconds", labels={"stage": "explain"}
+        ).observe(40.0)
+        clk = VirtualClock()
+        svc = ScoringService(
+            fn, ServiceConfig(workers=0, max_queue_rows=64), clock=clk
+        )
+        svc.start()
+        # plain request: the 10s budget covers the scoring pipeline
+        svc.submit(dict(rows[0]), deadline=10.0)
+        # explain request: the same budget cannot also cover explain p95
+        with pytest.raises(sdl.DeadlineExceeded):
+            svc.submit(dict(rows[1]), deadline=10.0, explain=2)
+        while svc.pump():
+            pass
+        svc.stop()
+        assert svc.stats()["rejected"]["deadline"] == 1
+
+
+# ----------------------------------------------------------- attribution drift
+class TestAttributionDrift:
+    def _profile_from(self, diffs, names):
+        from transmogrifai_tpu.utils.streaming_histogram import (
+            histogram_from_values,
+        )
+
+        return {
+            "rows": len(diffs),
+            "groups": {
+                name: {
+                    "count": len(diffs),
+                    "meanAbs": float(np.abs(diffs[:, g]).mean()),
+                    "histogram": histogram_from_values(
+                        diffs[:, g], max_bins=32
+                    ).to_json(),
+                }
+                for g, name in enumerate(names)
+            },
+        }
+
+    def test_no_alert_on_matching_distribution(self):
+        rng = np.random.default_rng(0)
+        base = rng.normal(0.0, 0.1, size=(400, 2))
+        mon = AttributionDriftMonitor(
+            self._profile_from(base, ["a", "b"])
+        )
+        assert mon.enabled
+        mon.observe(["a", "b"], rng.normal(0.0, 0.1, size=(200, 2)))
+        rep = mon.report()
+        assert rep["alerts"] == []
+        assert rep["groups"]["a"]["status"] == "ok"
+
+    def test_shifted_contributions_alert_once(self):
+        rng = np.random.default_rng(1)
+        base = rng.normal(0.0, 0.05, size=(400, 2))
+        mon = AttributionDriftMonitor(
+            self._profile_from(base, ["a", "b"])
+        )
+        before_events = len([
+            e for e in tevents.recent() if e["kind"] == "attribution_drift"
+        ])
+        before_ledger = attr_ledger.snapshot()["attributionDriftAlerts"]
+        # group 'a' collapses to a totally different distribution: the
+        # model's reasons changed even though inputs could look identical
+        shifted = np.column_stack([
+            rng.normal(5.0, 0.05, size=200),
+            rng.normal(0.0, 0.05, size=200),
+        ])
+        mon.observe(["a", "b"], shifted)
+        rep = mon.report()
+        assert rep["alerts"] == ["a"]
+        assert rep["groups"]["a"]["jsDivergence"] > 0.5
+        assert rep["groups"]["b"]["status"] == "ok"
+        assert rep["attributionDriftAlertsTotal"] == 1
+        # re-reporting the same alert does NOT double-count (hysteresis)
+        assert mon.report()["attributionDriftAlertsTotal"] == 1
+        events = [
+            e for e in tevents.recent() if e["kind"] == "attribution_drift"
+        ]
+        assert len(events) == before_events + 1
+        assert events[-1]["group"] == "a"
+        assert (
+            attr_ledger.snapshot()["attributionDriftAlerts"]
+            == before_ledger + 1
+        )
+
+    def test_torn_baseline_degrades_that_group_only(self):
+        rng = np.random.default_rng(2)
+        base = rng.normal(size=(200, 2))
+        profile = self._profile_from(base, ["a", "b"])
+        profile["groups"]["b"]["histogram"] = {"torn": True}
+        mon = AttributionDriftMonitor(profile)
+        assert mon.torn == ["b"]
+        mon.observe(["a", "b"], rng.normal(size=(100, 2)))
+        rep = mon.report()
+        assert "a" in rep["groups"] and "b" not in rep["groups"]
+
+    def test_train_captures_profile_and_serving_monitors_it(self, trained):
+        _, model, rows = trained
+        ap = model.attribution_profiles
+        assert ap and ap["rows"] > 0
+        assert any(g.startswith("x1") for g in ap["groups"])
+        for prof in ap["groups"].values():
+            assert prof["histogram"]["points"]
+        fn = score_function(model)
+        fn.batch([dict(r) for r in rows[:8]], explain=2)
+        drift = fn.metadata()["attributions"]["drift"]
+        assert drift["enabled"] and drift["rowsObserved"] >= 8
+
+    def test_profile_roundtrips_through_save_load(self, trained, tmp_path):
+        _, model, _ = trained
+        model.save(str(tmp_path / "m"))
+        loaded = WorkflowModel.load(str(tmp_path / "m"))
+        assert loaded.attribution_profiles == model.attribution_profiles
+
+    def test_profile_disabled_by_env(self, trained, monkeypatch):
+        monkeypatch.setenv("TPTPU_ATTRIBUTION_PROFILE_ROWS", "0")
+        uid_util.reset()
+        rng = np.random.default_rng(0)
+        n = 48
+        ds = Dataset.of({
+            "label": column_from_values(
+                T.RealNN,
+                (rng.normal(size=n) > 0).astype(float).tolist(),
+            ),
+            "x1": column_from_values(T.Real, rng.normal(size=n)),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        sel = BinaryClassificationModelSelector(
+            seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+            num_folds=2,
+        )
+        pred = sel.set_input(resp, vec).get_output()
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+        )
+        assert model.attribution_profiles is None
+
+
+# ----------------------------------------------------------------- TPX007
+class TestMetadataFallbackAudit:
+    def test_healthy_flow_has_no_tpx007(self, trained):
+        _, model, rows = trained
+        fn = score_function(model)
+        fn.batch([dict(rows[0])])
+        findings = fn.metadata()["analysis"]["findings"]
+        assert not [f for f in findings if f["code"] == "TPX007"]
+
+    def test_missing_provenance_flags_tpx007(self):
+        from types import SimpleNamespace
+
+        from transmogrifai_tpu.analysis.plan_audit import audit_serving_plan
+        from transmogrifai_tpu.models.base import PredictorModel
+
+        class _StubPredictor(PredictorModel):
+            # class attrs override the PipelineStage properties
+            input_names = ("vec",)
+            output_name = "pred"
+            operation_name = "stubPredictor"
+
+            def __init__(self):  # no stage wiring needed for the audit
+                pass
+
+        producer = SimpleNamespace(
+            output_name="vec",
+            operation_name="stubVectorizer",
+            input_names=(),
+            # width recoverable (size=5) but provenance columns absent —
+            # exactly the state in which LOCO degrades to col_<j>
+            _meta_cache=(None, SimpleNamespace(size=5, columns=None)),
+        )
+        report = audit_serving_plan(
+            [producer, _StubPredictor()], [], ["pred"]
+        )
+        codes = [f.code for f in report.findings]
+        assert "TPX007" in codes
+        tpx = next(f for f in report.findings if f.code == "TPX007")
+        assert tpx.severity.value == "warning"
+        assert "col_<j>" in tpx.message
+
+
+# ------------------------------------------------------------- bench reports
+class TestBenchReportUnion:
+    def _bench(self):
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+        spec = importlib.util.spec_from_file_location("bench_mod", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_every_committed_bench_report_validates(self):
+        import glob
+
+        bench = self._bench()
+        root = os.path.join(os.path.dirname(__file__), "..")
+        reports = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+        assert reports, "no committed BENCH_*.json found"
+        for path in reports:
+            with open(path) as fh:
+                doc = json.load(fh)
+            problems = bench.validate_bench_report(doc)
+            assert not problems, f"{os.path.basename(path)}: {problems}"
+
+    def test_r07_is_unified_and_over_target(self):
+        bench = self._bench()
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "BENCH_r07.json"
+        )
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["schema_version"] >= 1
+        assert doc["median_of"] == 5 and doc["seed"] is not None
+        m = doc["metrics"]
+        assert m["explain_vs_plain_throughput"] >= m["target_min_ratio"]
+        assert m["rows_explained"] > 0
+        assert m["prometheus_has_attribution_ledger"] is True
+        assert not bench.validate_bench_report(doc)
+
+    def test_writer_roundtrip_and_rejections(self, tmp_path):
+        bench = self._bench()
+        p = str(tmp_path / "r.json")
+        bench.write_bench_report(
+            p, metric="m", value=1.5, unit="s", seed=3, median_of=5,
+            metrics={"a": 1},
+        )
+        with open(p) as fh:
+            doc = json.load(fh)
+        assert not bench.validate_bench_report(doc)
+        assert bench.validate_bench_report([1, 2])  # not an object
+        assert bench.validate_bench_report({"nonsense": 1})
+        bad = dict(doc, metrics="not-a-dict")
+        assert bench.validate_bench_report(bad)

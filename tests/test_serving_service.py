@@ -323,48 +323,56 @@ class TestLoadShedder:
     def test_tiers_climb_in_order(self):
         sh = LoadShedder(ShedConfig(), capacity=100)
         assert sh.update(10, 0, 0.0) == 0
-        assert sh.update(55, 0, 0.0) == 1   # detail_enter 0.50
-        assert sh.update(75, 0, 0.0) == 2   # drift_enter 0.70
-        assert sh.update(95, 0, 0.0) == 3   # reject_enter 0.90
+        assert sh.update(40, 0, 0.0) == 1   # explain_enter 0.35
+        assert sh.update(55, 0, 0.0) == 2   # detail_enter 0.50
+        assert sh.update(75, 0, 0.0) == 3   # drift_enter 0.70
+        assert sh.update(95, 0, 0.0) == 4   # reject_enter 0.90
         assert sh.reject_admissions
         assert sh.stats()["tierEntries"] == {
-            "shed_detail": 1, "shed_drift": 1, "reject": 1,
+            "shed_explain": 1, "shed_detail": 1, "shed_drift": 1,
+            "reject": 1,
         }
 
     def test_hysteresis_no_flapping_at_the_boundary(self):
         sh = LoadShedder(ShedConfig(), capacity=100)
         sh.update(95, 0, 0.0)
-        assert sh.tier == 3
+        assert sh.tier == 4
         # load falls below ENTER but above EXIT (0.65): tier holds
         sh.update(80, 0, 0.0)
-        assert sh.tier == 3
+        assert sh.tier == 4
         transitions = sh.transitions
         # hovering there forever never flaps
         for _ in range(10):
             sh.update(80, 0, 0.0)
         assert sh.transitions == transitions
-        # below reject_exit: drops to 2 (still above drift_exit 0.50)
+        # below reject_exit: drops to 3 (still above drift_exit 0.50)
         sh.update(60, 0, 0.0)
-        assert sh.tier == 2
+        assert sh.tier == 3
         sh.update(10, 0, 0.0)
         assert sh.tier == 0
 
     def test_side_effects_detail_spans_and_drift_flag(self):
         sh = LoadShedder(ShedConfig(), capacity=100)
         assert tspans.stage_detail(1000) and not sshed.drift_shed()
+        assert not sshed.explain_shed()
+        sh.update(40, 0, 0.0)
+        assert sshed.explain_shed()           # tier 1 sheds explain FIRST
+        assert tspans.stage_detail(1000)      # detail spans still on
         sh.update(55, 0, 0.0)
-        assert not tspans.stage_detail(1000)  # tier 1 sheds detail spans
+        assert not tspans.stage_detail(1000)  # tier 2 sheds detail spans
         assert not sshed.drift_shed()
         sh.update(75, 0, 0.0)
-        assert sshed.drift_shed()             # tier 2 sheds drift observe
+        assert sshed.drift_shed()             # tier 3 sheds drift observe
         sh.update(5, 0, 0.0)
         assert tspans.stage_detail(1000) and not sshed.drift_shed()
+        assert not sshed.explain_shed()
 
     def test_open_breakers_add_load(self):
         sh = LoadShedder(ShedConfig(breaker_weight=0.5), capacity=100)
-        # queue alone: below detail tier; breakers half open: tier engages
+        # queue alone: below every tier; breakers half open: the load
+        # signal crosses the explain AND detail enter points
         assert sh.update(30, 0, 0.0) == 0
-        assert sh.update(30, 0, 0.5) == 1
+        assert sh.update(30, 0, 0.5) == 2
 
     def test_transitions_emit_load_shed_events(self):
         sh = LoadShedder(ShedConfig(), capacity=100)
@@ -628,6 +636,7 @@ class TestServiceBackpressure:
     #: thresholds pushed above any reachable load so the queue bound, not
     #: the shed tiers, is the limit under test
     NO_SHED = ShedConfig(
+        explain_enter=2.0, explain_exit=1.0,
         detail_enter=3.0, detail_exit=2.0, drift_enter=5.0, drift_exit=4.0,
         reject_enter=9.0, reject_exit=8.0,
     )
@@ -661,6 +670,7 @@ class TestServiceBackpressure:
             ServiceConfig(
                 workers=0, max_queue_rows=10, max_batch_rows=4,
                 shed=ShedConfig(
+                    explain_enter=0.25, explain_exit=0.15,
                     detail_enter=0.30, detail_exit=0.20,
                     drift_enter=0.50, drift_exit=0.35,
                     reject_enter=0.85, reject_exit=0.50,
@@ -674,7 +684,7 @@ class TestServiceBackpressure:
         with pytest.raises(RejectedByAdmission) as ei:
             svc.submit(dict(rows[9]))
         assert ei.value.reason == "shedding"
-        assert svc.shedder.tier == 3
+        assert svc.shedder.tier == 4
         # drain below reject_exit: admissions resume (hysteresis honored)
         while svc.pump():
             pass
@@ -688,14 +698,14 @@ class TestServiceBackpressure:
         assert s["rejected"]["shedding"] == 1
         assert s["shedding"]["tierEntries"]["reject"] >= 1
 
-    def test_drift_observation_shed_at_tier_two(self, trained, rows):
+    def test_drift_observation_shed_at_tier_three(self, trained, rows):
         _, model = trained
         fn = score_function(model)
         if not fn.drift.enabled:
             pytest.skip("model carries no serving profiles")
         before = fn.drift.rows_observed
         sh = LoadShedder(ShedConfig(), capacity=100)
-        sh.update(75, 0, 0.0)  # tier 2: drift shed process-wide
+        sh.update(75, 0, 0.0)  # tier 3: drift shed process-wide
         try:
             fn.batch([dict(rows[0])])
             assert fn.drift.rows_observed == before  # observation skipped

@@ -486,7 +486,9 @@ def test_chrome_trace_export_opens_in_perfetto_format(flagship, tmp_path):
 
 def test_phase_breakdown_attributes_train_time(flagship):
     pb = texport.phase_breakdown()
-    assert set(pb) == {"ingest", "featurize", "compile", "fit", "eval"}
+    assert set(pb) == {
+        "ingest", "featurize", "compile", "fit", "eval", "explain",
+    }
     # a real train spent real time fitting and transforming
     assert pb["fit"] > 0.0
     assert pb["featurize"] > 0.0

@@ -49,6 +49,9 @@ CODES: dict[str, str] = {
     "TPX004": "stage width unknown until the first batch (shapes unprovable)",
     "TPX005": "lane bucketing disabled (TPTPU_LANE_BUCKETS=0)",
     "TPX006": "fused plane assembly unavailable for this plan",
+    "TPX007": "predictor feature vector carries no usable provenance "
+              "metadata — LOCO attributions degrade to anonymous "
+              "per-column groups",
     # ---- TPL: package invariant lint (analysis/lint.py)
     "TPL000": "file does not parse — the linter cannot scan it",
     "TPL001": "shared module-level state written without holding a lock",

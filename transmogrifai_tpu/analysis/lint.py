@@ -51,10 +51,12 @@ __all__ = [
 #: subsystems whose module globals are crossed by worker/warmup threads
 #: (telemetry/ buffers are written from scoring, pool, and warmup threads;
 #: serving/ + resilience/ joined when the standing service put sentinel,
-#: breaker, and shed state in front of concurrent service workers)
+#: breaker, and shed state in front of concurrent service workers;
+#: insights/ joined when the attribution ledger/drift monitor went in
+#: front of concurrent explain sweeps)
 _LOCKED_SUBSYSTEMS = (
     "featurize/", "compiler/", "utils/aot.py", "telemetry/", "serving/",
-    "resilience/",
+    "resilience/", "insights/",
 )
 
 _MUTATORS = {

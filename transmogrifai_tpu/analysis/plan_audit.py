@@ -65,6 +65,23 @@ def _width_of(stage, fusion=None) -> int | None:
     return None
 
 
+def _meta_of(stage):
+    """Fit-static :class:`VectorMetadata` of a vectorizer-ish stage, if
+    recoverable (the provenance LOCO groups by)."""
+    for attr in ("_meta_cache", "_flatten_cache"):
+        cached = getattr(stage, attr, None)
+        if cached is not None:
+            try:
+                if cached[1].columns is not None:
+                    return cached[1]
+            except Exception:
+                pass
+    new_meta = getattr(stage, "new_metadata", None)
+    if new_meta is not None and getattr(new_meta, "columns", None) is not None:
+        return new_meta
+    return None
+
+
 def _classify(stage) -> str:
     from ..models.base import PredictorModel
     from ..ops.base import _CachedMetaVectorizer
@@ -164,6 +181,42 @@ def audit_serving_plan(
         "hostPredictCutoffRows": cutoff,
         "batchBucketed": bool(bucketed),
     }
+
+    # ---- TPX007: predictor feature plane without usable provenance —
+    # LOCO explanations would silently degrade to anonymous per-column
+    # groups (col_<j> instead of feature names). Only provable
+    # degradations are reported: an unknown width before the first batch
+    # is TPX004's business, not a metadata defect.
+    by_output = {t.output_name: t for t in plan}
+    for t in plan:
+        if _classify(t) != "predictor" or not t.input_names:
+            continue
+        in_name = t.input_names[-1]
+        producer = by_output.get(in_name)
+        if producer is None:
+            continue
+        meta = _meta_of(producer)
+        in_w = widths.get(in_name)
+        degraded = (meta is None and in_w is not None) or (
+            meta is not None and in_w is not None and meta.size != in_w
+        )
+        if degraded:
+            report.add(
+                "TPX007",
+                f"feature vector '{in_name}' feeding predictor "
+                f"{t.operation_name!r} has "
+                + (
+                    "no recoverable provenance metadata"
+                    if meta is None
+                    else f"metadata for {meta.size} column(s) but width "
+                         f"{in_w}"
+                )
+                + " — explain=k / RecordInsightsLOCO will name anonymous "
+                "col_<j> groups instead of features (counted as "
+                "metaFallbacks on the attribution ledger)",
+                subject=in_name,
+                severity=Severity.WARNING,
+            )
 
     # ---- TPX002: device -> host -> device bounce in plan order
     device_stage_names = {

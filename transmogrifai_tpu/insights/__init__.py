@@ -1,4 +1,21 @@
-"""Model interpretability (reference: ModelInsights, RecordInsightsLOCO)."""
+"""Model interpretability (reference: ModelInsights, RecordInsightsLOCO).
+
+Beyond the reference's offline surfaces this package carries the
+serving-speed explainability plane (ROADMAP item 4): the batched LOCO
+program family (:mod:`.loco`), the process-wide attribution ledger
+(:mod:`.ledger`, the ``attribution`` Prometheus source), and attribution
+drift — model-behavior drift detection over contribution distributions
+(:mod:`.drift`). See docs/observability.md."""
 from .model_insights import model_insights  # noqa: F401
-from .loco import RecordInsightsLOCO  # noqa: F401
+from .loco import (  # noqa: F401
+    RecordInsightsLOCO,
+    column_groups,
+    explain_batch,
+    top_k_maps,
+)
 from .correlation import RecordInsightsCorr, RecordInsightsCorrModel  # noqa: F401
+from .drift import (  # noqa: F401
+    AttributionDriftMonitor,
+    compute_attribution_profile,
+)
+from . import ledger as attribution_ledger  # noqa: F401

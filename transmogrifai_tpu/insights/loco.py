@@ -1,18 +1,42 @@
-"""RecordInsightsLOCO — per-row leave-one-covariate-out explanations.
+"""RecordInsightsLOCO — batched leave-one-covariate-out explanations.
 
 Reference: core/.../stages/impl/insights/RecordInsightsLOCO.scala:45-347.
-For each derived vector column (text-hash and date columns aggregated per
-parent feature, strategy LeaveOutVector), zero it out, re-score, and report
-the top-K score differences as a map column.
+For each derived vector column group (text-hash and date columns
+aggregated per parent feature, strategy LeaveOutVector), zero it out,
+re-score, and report the top-K score differences.
 
-TPU improvement over the reference (SURVEY.md §7 step 7): the reference
-loops per row re-scoring one modified vector at a time; here the whole
-(rows × groups) sweep is BATCHED — one model call per column group over all
-rows at once.
+TPU improvement over the reference (SURVEY.md §7 step 7, ROADMAP item 4):
+the reference loops per row re-scoring one modified vector at a time; the
+previous revision of this module batched the rows but still made one
+model call per column group. Here the whole sweep is ONE program family:
+
+* every perturbation lane shares the fused ``[N, width]`` feature plane —
+  lane ``g`` is the plane with group ``g``'s column slice zeroed — and the
+  sweep dispatches as one ``[lanes × N, width]`` model call (the same
+  batched predict program the scoring path already banks, so the sweep
+  rides the persistent executable bank instead of compiling per group);
+* lane counts are padded onto the shared shape buckets
+  (``compiler/bucketing.lane_bucket``) so near-miss group counts reuse one
+  program, and the pad/dedup bookkeeping lands in compileStats
+  (``record_sweep``) exactly like the GLM candidate sweeps;
+* groups whose slice is already all-zero across the batch are DEDUPED out
+  before dispatch — zeroing them changes nothing, so their contribution
+  is exactly 0.0 without a model call;
+* when ``lanes × N × width`` exceeds the memory budget
+  (``TPTPU_EXPLAIN_LANE_BUDGET`` float32 elements, default 2^23 ≈ 32 MB)
+  the sweep runs as a loop of bucketed lane chunks through the same
+  program family instead of one monolithic dispatch.
+
+Every sweep records on the attribution ledger (``insights/ledger.py``):
+rows/s, lane dispatch/dedup/pad counts, per-group contribution
+statistics, and the vector-metadata fallbacks that silently anonymized
+column groups before the ledger existed (surfaced as TPX007 by the
+serving-plan auditor).
 """
 from __future__ import annotations
 
-from typing import Sequence
+import logging
+import os
 
 import numpy as np
 
@@ -21,15 +45,50 @@ from ..stages.base import Model
 from ..stages.metadata import VectorMetadata
 from ..types import OPVector, TextMap
 from ..types.columns import Column, MapColumn, VectorColumn
+from . import ledger as _ledger
+
+log = logging.getLogger(__name__)
 
 ABS = "abs"
 POSITIVE_NEGATIVE = "positive_negative"
 
+#: max float32 elements a single perturbation dispatch may materialize
+#: (lanes × rows × width); larger sweeps loop over bucketed lane chunks
+_DEFAULT_LANE_BUDGET = 1 << 23
 
-def _column_groups(meta: VectorMetadata | None, dim: int) -> list[tuple[str, list[int]]]:
+
+def _lane_budget() -> int:
+    try:
+        return max(
+            1, int(os.environ.get(
+                "TPTPU_EXPLAIN_LANE_BUDGET", str(_DEFAULT_LANE_BUDGET)
+            ))
+        )
+    except ValueError:
+        return _DEFAULT_LANE_BUDGET
+
+
+def _column_groups(
+    meta: VectorMetadata | None, dim: int, count_fallback: bool = True
+) -> list[tuple[str, list[int]]]:
     """Group hashed-text/date columns by parent feature; pivot/numeric
-    columns stay individual (RecordInsightsLOCO text aggregation)."""
+    columns stay individual (RecordInsightsLOCO text aggregation).
+
+    When ``meta`` is absent or inconsistent with the vector width the
+    grouping degrades to anonymous per-column groups — that degradation
+    used to be silent; it now counts ``metaFallbacks`` on the attribution
+    ledger (and the serving-plan auditor reports it as TPX007)."""
     if meta is None or meta.size != dim:
+        if count_fallback:
+            _ledger.stats().count_meta_fallback()
+            log.warning(
+                "LOCO column groups degraded to anonymous per-column "
+                "groups: vector metadata %s (width %d) — attributions "
+                "will name col_<j> instead of features (TPX007)",
+                "absent" if meta is None
+                else f"size {meta.size} != {dim}",
+                dim,
+            )
         return [(f"col_{j}", [j]) for j in range(dim)]
     groups: dict[str, list[int]] = {}
     order: list[str] = []
@@ -45,6 +104,181 @@ def _column_groups(meta: VectorMetadata | None, dim: int) -> list[tuple[str, lis
             order.append(key)
         groups[key].append(j)
     return [(k, groups[k]) for k in order]
+
+
+#: public alias (the serving closure and the train-time profiler group
+#: the same way the transformer does)
+column_groups = _column_groups
+
+
+def _floor_lane_bucket(k: int) -> int:
+    """Largest lane-bucket boundary <= ``k``, so ``lane_bucket`` of any
+    chunk of this size — or a smaller padded tail — never exceeds it.
+    Derived from ``compiler.bucketing.lane_bucket`` itself (one source
+    of truth for the boundary ladder; a few dozen probes at most)."""
+    from ..compiler.bucketing import lane_bucket
+
+    b = max(1, k)
+    while b > 1 and lane_bucket(b) > b:
+        b -= 1
+    return b
+
+
+def _base_scores(
+    model: PredictorModel,
+    x: np.ndarray,
+    base_prob: np.ndarray | None = None,
+    base_pred: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Per-row base score tracked against the BASE prediction's class
+    (RecordInsightsLOCO tracks the original class's probability, so
+    perturbed scores of different classes are never compared). Callers
+    that already hold the batch's PredictionColumn pass its arrays in and
+    skip the extra base dispatch."""
+    if base_prob is not None:
+        base_class = np.argmax(base_prob, axis=1)
+        rows = np.arange(len(base_prob))
+        return base_prob[rows, base_class].astype(np.float64), base_class
+    if base_pred is not None:
+        return np.asarray(base_pred, dtype=np.float64), None
+    pred, prob, _ = model.predict_arrays(x)
+    if prob is None:
+        return np.asarray(pred, dtype=np.float64), None
+    base_class = prob.argmax(axis=1)
+    rows = np.arange(len(prob))
+    return prob[rows, base_class].astype(np.float64), base_class
+
+
+def explain_batch(
+    model: PredictorModel,
+    x: np.ndarray,
+    groups: list[tuple[str, list[int]]],
+    base_prob: np.ndarray | None = None,
+    base_pred: np.ndarray | None = None,
+) -> tuple[np.ndarray, dict[str, int]]:
+    """LOCO contribution matrix ``[N, G]`` for one feature plane.
+
+    ``diffs[i, g]`` = base score of row ``i`` minus its score with group
+    ``g``'s columns zeroed (positive = the group pushed the score UP).
+    One batched program family: dedup → lane bucketing → ``[lanes×N, D]``
+    dispatch(es) under the memory budget. ``base_prob``/``base_pred``
+    reuse an already-computed base prediction (the serving path passes
+    the batch's PredictionColumn arrays).
+
+    Returns ``(diffs, sweep_info)`` where ``sweep_info`` carries the lane
+    bookkeeping (``lanes`` dispatched incl. pads, ``deduped``, ``padded``,
+    ``dispatches``) for the caller's ledger record — the caller owns the
+    clock read, so it records rows/seconds in ONE ``record_explain``."""
+    from ..compiler import stats as cstats
+    from ..compiler.bucketing import lane_bucket
+
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    n, dim = x.shape
+    g_count = len(groups)
+    diffs = np.zeros((n, g_count), dtype=np.float64)
+    info = {"lanes": 0, "deduped": 0, "padded": 0, "dispatches": 0}
+    if n == 0 or g_count == 0:
+        return diffs, info
+    base, base_class = _base_scores(model, x, base_prob, base_pred)
+
+    # dedup: a group whose slice is all-zero across the batch cannot move
+    # any score — its contribution is exactly 0.0, no lane dispatched
+    live: list[int] = []
+    for g, (_, idxs) in enumerate(groups):
+        if np.any(x[:, idxs]):
+            live.append(g)
+    info["deduped"] = g_count - len(live)
+    if not live:
+        return diffs, info
+
+    # lane chunks under the memory budget, each padded onto the shared
+    # shape buckets so the dispatch shapes form a small program family.
+    # The chunk size is FLOORED to a bucket boundary: a chunk sized
+    # budget//(n*dim) would be rounded UP by lane_bucket and the padded
+    # dispatch could materialize ~2x the budget — flooring guarantees
+    # every chunk (including a padded final partial) stays <= per_chunk
+    per_chunk = _floor_lane_bucket(
+        max(1, _lane_budget() // max(1, n * dim))
+    )
+    rows = np.arange(n)
+    for start in range(0, len(live), per_chunk):
+        chunk = live[start:start + per_chunk]
+        k = len(chunk)
+        kb = lane_bucket(k)
+        pad = kb - k
+        plane = np.broadcast_to(x, (kb, n, dim)).copy()
+        for lane, g in enumerate(chunk):
+            plane[lane, :, groups[g][1]] = 0.0
+        # pad lanes replay lane 0 (already zeroed) — inert, sliced off
+        pred_p, prob_p, _ = model.predict_arrays(
+            plane.reshape(kb * n, dim)
+        )
+        if prob_p is not None and base_class is not None:
+            scores = prob_p.reshape(kb, n, -1)[:, rows, base_class]
+        else:
+            scores = np.asarray(pred_p, dtype=np.float64).reshape(kb, n)
+        for lane, g in enumerate(chunk):
+            diffs[:, g] = base - scores[lane]
+        cstats.stats().record_sweep(lanes=k, padded=pad)
+        info["lanes"] += kb
+        info["padded"] += pad
+        info["dispatches"] += 1
+    return diffs, info
+
+
+def top_k_maps(
+    diffs: np.ndarray,
+    names: list[str],
+    top_k: int,
+    strategy: str = ABS,
+) -> tuple[list[dict[str, float]], np.ndarray]:
+    """Per-row top-k maps (ranked insertion order) + per-group hit counts.
+
+    Selection semantics match the reference exactly: ``abs`` takes the k
+    largest |contribution|s; ``positive_negative`` takes the k most
+    positive AND k most negative (RecordInsightsLOCO.scala:91)."""
+    n, g_count = diffs.shape
+    k = min(top_k, g_count)
+    hits = np.zeros(g_count, dtype=np.int64)
+    values: list[dict[str, float]] = []
+    for i in range(n):
+        row = diffs[i]
+        if strategy == ABS:
+            picked = list(np.argsort(-np.abs(row))[:k])
+        else:
+            # topK most positive AND topK most negative
+            # (RecordInsightsLOCO.scala:91 PositiveNegative strategy)
+            order = np.argsort(-row)
+            pos = [j for j in order[:k] if row[j] > 0]
+            neg = [j for j in order[::-1][:k] if row[j] < 0]
+            picked = pos + [j for j in neg if j not in pos]
+        hits[picked] += 1
+        values.append({names[j]: float(row[j]) for j in picked})
+    return values, hits
+
+
+def reference_loop(
+    model: PredictorModel,
+    x: np.ndarray,
+    groups: list[tuple[str, list[int]]],
+) -> np.ndarray:
+    """The pre-batched implementation — one model call PER COLUMN GROUP —
+    kept as the golden oracle for the parity suite (never on a hot
+    path)."""
+    x = np.asarray(x, dtype=np.float32)
+    n = x.shape[0]
+    base, base_class = _base_scores(model, x)
+    diffs = np.zeros((n, len(groups)), dtype=np.float64)
+    rows = np.arange(n)
+    for gi, (_, idxs) in enumerate(groups):
+        x2 = x.copy()
+        x2[:, idxs] = 0.0
+        pred, prob, _ = model.predict_arrays(x2)
+        if prob is not None and base_class is not None:
+            diffs[:, gi] = base - prob[rows, base_class]
+        else:
+            diffs[:, gi] = base - np.asarray(pred, dtype=np.float64)
+    return diffs
 
 
 class RecordInsightsLOCO(Model):
@@ -69,6 +303,12 @@ class RecordInsightsLOCO(Model):
         self.model = model
         self.top_k = top_k
         self.strategy = strategy
+        #: (metadata object, dim, groups) — metadata is fit-static, so a
+        #: metadata-less vector logs/counts its degradation ONCE per
+        #: stage, not once per scored batch. The cache HOLDS the metadata
+        #: object (identity compared with ``is``): an id()-keyed cache
+        #: could serve stale groups after the id is recycled by GC
+        self._groups_cache: tuple | None = None
 
     def get_params(self):
         return {
@@ -94,44 +334,33 @@ class RecordInsightsLOCO(Model):
         )
         return cls(model=model, **params)
 
-    def _score(self, x: np.ndarray, base_class: np.ndarray | None = None):
-        """Per-row score tracked against the BASE prediction's class
-        (RecordInsightsLOCO tracks the original class's probability, so
-        perturbed scores of different classes are never compared)."""
-        pred, prob, raw = self.model.predict_arrays(x)
-        if prob is None:
-            return pred, None
-        if base_class is None:
-            base_class = prob.argmax(axis=1)
-        rows = np.arange(len(prob))
-        return prob[rows, base_class], base_class
-
     def transform_columns(self, *cols: Column, num_rows: int) -> MapColumn:
+        from ..telemetry import spans as _tspans
+
         vec = cols[-1]
         assert isinstance(vec, VectorColumn)
         x = np.asarray(vec.values, dtype=np.float32)
-        base, base_class = self._score(x)
-        groups = _column_groups(vec.metadata, x.shape[1])
-
-        diffs = np.zeros((num_rows, len(groups)), dtype=np.float64)
-        for gi, (_, idxs) in enumerate(groups):
-            x2 = x.copy()
-            x2[:, idxs] = 0.0
-            diffs[:, gi] = base - self._score(x2, base_class)[0]
-
+        cached = self._groups_cache
+        if (
+            cached is None
+            or cached[0] is not vec.metadata
+            or cached[1] != x.shape[1]
+        ):
+            cached = self._groups_cache = (
+                vec.metadata, x.shape[1],
+                _column_groups(vec.metadata, x.shape[1]),
+            )
+        groups = cached[2]
+        t0 = _tspans.clock()
+        diffs, info = explain_batch(self.model, x, groups)
         names = [name for name, _ in groups]
-        values: list[dict] = []
-        k = min(self.top_k, len(groups))
-        for i in range(num_rows):
-            row = diffs[i]
-            if self.strategy == ABS:
-                picked = list(np.argsort(-np.abs(row))[:k])
-            else:
-                # topK most positive AND topK most negative
-                # (RecordInsightsLOCO.scala:91 PositiveNegative strategy)
-                order = np.argsort(-row)
-                pos = [j for j in order[:k] if row[j] > 0]
-                neg = [j for j in order[::-1][:k] if row[j] < 0]
-                picked = pos + [j for j in neg if j not in pos]
-            values.append({names[j]: float(row[j]) for j in picked})
+        values, hits = top_k_maps(
+            diffs[:num_rows], names, self.top_k, self.strategy
+        )
+        led = _ledger.stats()
+        led.record_explain(
+            num_rows, _tspans.clock() - t0, lanes=info["lanes"],
+            deduped=info["deduped"], padded=info["padded"],
+        )
+        led.record_groups(names, diffs[:num_rows], hits)
         return MapColumn(TextMap, values)

@@ -37,10 +37,14 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..insights import ledger as _attr_ledger
+from ..insights import loco as _loco
+from ..insights.drift import AttributionDriftMonitor
 from ..resilience import faults
 from ..resilience.guards import ScoreGuard, ScoreGuardError
 from ..serving import deadline as _sdl
 from ..serving import shedding as _sshed
+from ..telemetry import events as _tevents
 from ..telemetry import metrics as _tm
 from ..telemetry import spans as _tspans
 from ..resilience.sentinel import (
@@ -231,6 +235,137 @@ def score_function(
     raise_on_stage_error = isolation == "raise"
     if isolation not in ("degrade", "raise"):
         raise ValueError(f"unknown isolation mode {isolation!r}")
+
+    # ---- explainability plane (insights/): batched LOCO attributions for
+    # ``explain=k`` calls ride the LAST fitted predictor's feature plane;
+    # column groups resolve once from the fit-static vector metadata on
+    # the first sweep. The attribution drift monitor compares serve-time
+    # contribution distributions against the train-time baseline profile
+    # persisted in the model manifest (attributionProfiles).
+    _explain_model = next(
+        (t for t in reversed(plan) if isinstance(t, PredictorModel)), None
+    )
+    _explain_vec = (
+        _explain_model.input_names[-1] if _explain_model is not None else None
+    )
+    _explain_state: dict[str, Any] = {}
+    attribution_drift = AttributionDriftMonitor(
+        getattr(model, "attribution_profiles", None)
+    )
+
+    def _run_explain(
+        cols: dict[str, Any],
+        m: int,
+        k: int,
+        dead: set,
+        fam: dict[str, float] | None,
+    ) -> list[dict[str, float]] | None:
+        """Batched LOCO over the already-assembled feature plane: per-row
+        top-k attribution maps for the ``m`` live rows, or ``None`` when
+        explain degraded (shed under load, skipped on a spent deadline
+        budget, or the predictor/plane is dead this batch). Explain work
+        is pure observability — it NEVER fails scoring; any degradation
+        is typed and counted."""
+        led = _attr_ledger.stats()
+        if _explain_model is None:
+            raise ValueError(
+                "explain=k requires a fitted predictor stage in the "
+                "scoring plan"
+            )
+        if (
+            _explain_model.output_name in dead
+            or _explain_vec in dead
+            or _explain_vec not in cols
+        ):
+            return None  # no healthy plane/prediction to explain against
+        # shed tier 1 (serving/shedding.py): explain work is the FIRST
+        # casualty of overload — cheaper to drop than detail spans, drift
+        # windows, or admissions
+        if _sshed.explain_shed():
+            led.count_shed(m)
+            _tm.REGISTRY.counter("tptpu_serve_explain_shed_total").inc(m)
+            return None
+        # deadline accounting: the explain family has its own p95 in the
+        # serve-latency histograms; a request whose remaining budget
+        # cannot cover it keeps its SCORES and drops the explanations —
+        # a soft skip, unlike the hard stage-family checkpoints
+        bgt = _sdl.current()
+        if bgt is not None:
+            required = _sdl.family_p95("explain")
+            remaining = bgt.remaining()
+            if remaining <= 0.0 or remaining < required:
+                led.count_deadline_skip()
+                _tm.REGISTRY.counter(
+                    "tptpu_serve_explain_deadline_skips_total"
+                ).inc()
+                _tevents.emit(
+                    "explain_deadline_skip",
+                    remainingMs=round(remaining * 1e3, 3),
+                    requiredMs=round(required * 1e3, 3),
+                )
+                return None
+        # explain is pure observability: from here on ANY failure (an
+        # allocation error on the lane plane, an unexpected predict
+        # error) degrades to attributions=None and a counter — it must
+        # never discard the batch's already-rendered scores
+        try:
+            ts = _tspans.clock()
+            vec = cols[_explain_vec]
+            x = np.asarray(vec.values, dtype=np.float32)
+            # one-shot atomic publish of (groups, names): concurrent
+            # service workers racing the first sweep must never observe
+            # the pair half-built
+            resolved = _explain_state.get("resolved")
+            if resolved is None:
+                groups = _loco.column_groups(
+                    getattr(vec, "metadata", None), x.shape[1]
+                )
+                resolved = _explain_state["resolved"] = (
+                    groups, [name for name, _ in groups]
+                )
+            groups, names = resolved
+            pcol = cols[_explain_model.output_name]
+            prob = getattr(pcol, "probability", None)
+            base_prob = None if prob is None else np.asarray(prob)
+            # regression predictions track the prediction itself
+            # (PredictionColumn carries `prediction`, [N] float64)
+            base_pred = (
+                np.asarray(pcol.prediction) if base_prob is None else None
+            )
+            diffs, info = _loco.explain_batch(
+                _explain_model, x, groups,
+                base_prob=base_prob, base_pred=base_pred,
+            )
+            diffs = diffs[:m]
+            maps, hits = _loco.top_k_maps(diffs, names, k)
+            dur = _tspans.clock() - ts
+            led.record_explain(
+                m, dur, lanes=info["lanes"], deduped=info["deduped"],
+                padded=info["padded"],
+            )
+            led.record_groups(names, diffs, hits)
+            _tm.REGISTRY.counter("tptpu_serve_explain_rows_total").inc(m)
+            # attribution drift observes the sweep unless the drift shed
+            # tier engaged (monitoring yields before scoring does)
+            if attribution_drift.enabled and not _sshed.drift_shed():
+                attribution_drift.observe(names, diffs)
+            if fam is not None:
+                # the explain family rides record_serve_batch like the
+                # other stage families — its histogram feeds the deadline
+                # p95 above
+                fam["explain"] = fam.get("explain", 0.0) + dur
+                _tspans.record_span(
+                    "serve/explain", ts, dur, rows=m, lanes=len(names)
+                )
+            return maps
+        except Exception as e:
+            led.count_error()
+            _tm.REGISTRY.counter("tptpu_serve_explain_errors_total").inc()
+            log.warning(
+                "explain sweep failed (%s: %s) — scores kept, "
+                "attributions degraded to None", type(e).__name__, e,
+            )
+            return None
 
     def _guarded(t, col, num_rows, count=True):
         """Per-stage output: fault-injection hook, then the NaN/Inf guard
@@ -572,8 +707,13 @@ def score_function(
         _bisect_rows(indices[:mid], build_cols, on_ok, on_poisoned, skip, budget)
         _bisect_rows(indices[mid:], build_cols, on_ok, on_poisoned, skip, budget)
 
-    def score_batch(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    def score_batch(
+        rows: list[dict[str, Any]], explain: int = 0
+    ) -> list[dict[str, Any]]:
         n = len(rows)
+        explain = int(explain or 0)
+        if explain < 0:
+            raise ValueError(f"explain must be >= 0, got {explain}")
         if n == 0:
             return []
         # serve-path telemetry: a handful of clock reads per batch
@@ -601,6 +741,7 @@ def score_function(
         fail_names: list[str] = []
         failures: list = []
         poisoned: dict[int, tuple[str, Exception]] = {}
+        attr_maps: list[dict[str, float]] | None = None
         if m:
             b = _bucket(m)
             tc = _tspans.clock() if tel else 0.0
@@ -609,7 +750,7 @@ def score_function(
                 # observed post codec (typed, coerced values), one
                 # vectorized bulk merge per feature; quarantined rows never
                 # reach the plan, so they are not part of the window.
-                # Skipped at shed tier >= 2 — drift observation is
+                # Skipped at shed tier >= 3 — drift observation is
                 # monitoring, and monitoring yields before scoring does
                 drift_sentinel.observe_columns(cols, m)
             if tel:
@@ -632,6 +773,13 @@ def score_function(
                     out[i][name] = rendered[j]
             if tel:
                 fam["download"] = _tspans.clock() - td
+            if explain:
+                # attributions ride the batch AFTER scores render: the
+                # sweep reuses the assembled feature plane and the batch's
+                # own PredictionColumn as the base (no extra base dispatch)
+                attr_maps = _run_explain(
+                    cols, m, explain, dead, fam if tel else None
+                )
             # per-row isolation: a fresh stage failure bisects the
             # survivors so only the poisoning row(s) are quarantined;
             # results dead from an OPEN breaker are NOT recovered (that
@@ -679,6 +827,17 @@ def score_function(
             ))
             for nm in result_names:
                 out[i][nm] = _default_value(nm)
+        if explain:
+            # every row answers the explain request: a top-k map for rows
+            # that were explained, None for quarantined/poisoned rows and
+            # for batches whose explain work was shed or skipped
+            for j, i in enumerate(survivors):
+                out[i]["attributions"] = (
+                    None if attr_maps is None or i in poisoned
+                    else attr_maps[j]
+                )
+            for i in invalid:
+                out[i]["attributions"] = None
         if m and b > _device_predict_min:
             # release any prefetched device buffers this batch created —
             # they must not outlive the batch and pin device memory
@@ -689,7 +848,7 @@ def score_function(
             _tspans.record_serve_batch("batch", n, started, fam)
         return out
 
-    def score_columns(dataset) -> dict[str, Any]:
+    def score_columns(dataset, explain: int = 0) -> dict[str, Any]:
         """Columnar scoring: Dataset in, ``{result_name: Column}`` out.
 
         The counterpart of sklearn's ``pipeline.predict(dataframe)`` — the
@@ -701,8 +860,13 @@ def score_function(
         same power-of-two buckets by replicating row 0; outputs are sliced
         back with ``take``. A stage failure isolates per row: poisoning
         rows get default values in the AFFECTED result columns only (the
-        row-dict path quarantines the whole row)."""
+        row-dict path quarantines the whole row). ``explain=k`` adds an
+        ``"attributions"`` entry: one top-k map per row (or None when the
+        sweep was shed/skipped)."""
         n = len(dataset)
+        explain = int(explain or 0)
+        if explain < 0:
+            raise ValueError(f"explain must be >= 0, got {explain}")
         if n == 0:
             return {}
         tel = _tspans.enabled()
@@ -753,6 +917,11 @@ def score_function(
         }
         if tel:
             fam["download"] = _tspans.clock() - td
+        attr_maps: list[dict[str, float]] | None = None
+        if explain:
+            attr_maps = _run_explain(
+                cols, n, explain, dead, fam if tel else None
+            )
         fail_names = [nm for nm in degraded if cause.get(nm) == "failure"]
         if failures and fail_names and n > 1:
             segments: dict[str, list] = {nm: [] for nm in fail_names}
@@ -797,6 +966,8 @@ def score_function(
         for nm in degraded:
             if nm not in out:
                 out[nm] = _default_column(nm, n)
+        if explain:
+            out["attributions"] = attr_maps
         if b > _device_predict_min:
             from ..compiler.dispatch import clear_prefetch
 
@@ -805,10 +976,10 @@ def score_function(
             _tspans.record_serve_batch("columns", n, started, fam)
         return out
 
-    def score_one(row: dict[str, Any]) -> dict[str, Any]:
+    def score_one(row: dict[str, Any], explain: int = 0) -> dict[str, Any]:
         # single-row scoring IS batch scoring: one shared quarantine /
-        # guard / breaker / drift path, pinned by the parity tests
-        return score_batch([row])[0]
+        # guard / breaker / drift / explain path, pinned by parity tests
+        return score_batch([row], explain=explain)[0]
 
     def audit() -> Any:
         """Static serving-plan audit (analysis/plan_audit.py): symbolic
@@ -842,10 +1013,11 @@ def score_function(
         except Exception as e:  # the audit must never break monitoring
             log.debug("plan audit skipped: %s", e)
             analysis = None
-        # the slow, lock-free parts first (the drift report walks every
-        # feature's histogram and may emit events) — holding the shared
-        # snapshot lock here would stall every scoring thread's recorder
+        # the slow, lock-free parts first (the drift reports walk every
+        # feature's/group's histogram and may emit events) — holding the
+        # shared snapshot lock here would stall every scoring thread
         drift_report = drift_sentinel.report()
+        attribution_drift_report = attribution_drift.report()
         breaker_stats = {nm: br.stats() for nm, br in breakers.items()}
         # then ONE consistent point-in-time read of the process ledgers:
         # their recorders serialize on the same lock, so a concurrent
@@ -854,6 +1026,8 @@ def score_function(
         with _tm.snapshot_lock():
             compile_snap = cstats.snapshot()
             featurize_snap = fstats.snapshot()
+            attribution_snap = _attr_ledger.snapshot()
+        resolved = _explain_state.get("resolved")
         return {
             "analysis": analysis,
             "compileStats": compile_snap,
@@ -863,6 +1037,12 @@ def score_function(
             "quarantine": qlog.stats(),
             "breakers": breaker_stats,
             "drift": drift_report,
+            "attributions": {
+                "available": _explain_model is not None,
+                "groups": None if resolved is None else resolved[1],
+                "ledger": attribution_snap,
+                "drift": attribution_drift_report,
+            },
             "distributed": getattr(model, "dist_summary", None),
             "telemetry": serving_snapshot(),
         }
@@ -875,6 +1055,7 @@ def score_function(
     score_one.breakers = breakers  # type: ignore[attr-defined]
     score_one.drift = drift_sentinel  # type: ignore[attr-defined]
     score_one.quarantine = qlog  # type: ignore[attr-defined]
+    score_one.attribution_drift = attribution_drift  # type: ignore[attr-defined]
     score_one.audit = audit  # type: ignore[attr-defined]
     score_one.metadata = metadata  # type: ignore[attr-defined]
     # the model keeps weak references to its live score functions so

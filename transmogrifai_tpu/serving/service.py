@@ -101,7 +101,7 @@ class PendingScore:
 
 
 class ScoreRequest:
-    __slots__ = ("rows", "budget", "handle", "enqueued_at")
+    __slots__ = ("rows", "budget", "handle", "enqueued_at", "explain")
 
     def __init__(
         self,
@@ -109,11 +109,13 @@ class ScoreRequest:
         budget: _deadline.DeadlineBudget | None,
         handle: PendingScore,
         enqueued_at: float,
+        explain: int = 0,
     ):
         self.rows = rows
         self.budget = budget
         self.handle = handle
         self.enqueued_at = enqueued_at
+        self.explain = explain
 
 
 class ScoringService:
@@ -223,16 +225,25 @@ class ScoringService:
         self,
         rows: dict | list[dict],
         deadline: float | None = None,
+        explain: int = 0,
     ) -> PendingScore:
         """Admit one request (one row dict, or a small list scored as a
-        unit). Raises :class:`RejectedByAdmission` (queue full / shedding
-        tier / stopped) or :class:`~.deadline.DeadlineExceeded` (the
-        budget cannot cover the pipeline p95 even before queuing) —
-        admission control rejects early, it never blocks."""
+        unit). ``explain=k`` asks for top-k LOCO attributions beside each
+        row's scores (carried through micro-batch assembly; under load the
+        shedder drops explain work first, so the rows may come back with
+        ``attributions: None``). Raises :class:`RejectedByAdmission`
+        (queue full / shedding tier / stopped) or
+        :class:`~.deadline.DeadlineExceeded` (the budget cannot cover the
+        pipeline p95 — including the explain family's p95 for explain
+        requests — even before queuing) — admission control rejects
+        early, it never blocks."""
         if isinstance(rows, dict):
             rows = [rows]
         if not rows:
             raise ValueError("empty request")
+        explain = int(explain or 0)
+        if explain < 0:
+            raise ValueError(f"explain must be >= 0, got {explain}")
         now = self.clock()
         if self._stop.is_set() or self.queue.closed:
             self._count_rejected("stopped")
@@ -249,16 +260,23 @@ class ScoringService:
         secs = deadline if deadline is not None else self.config.default_deadline
         if secs is not None:
             budget = _deadline.DeadlineBudget(secs, clock=self.clock, started=now)
-            if not budget.covers():
+            # explain requests must budget for the explain family too —
+            # its p95 rides the same serve-latency histograms
+            required = _deadline.pipeline_p95()
+            if explain:
+                required += _deadline.family_p95("explain")
+            if not budget.covers(required=required):
                 self._count_rejected("deadline")
                 _tm.REGISTRY.counter(
                     "tptpu_serve_deadline_exceeded_total"
                 ).inc()
                 raise _deadline.DeadlineExceeded(
-                    "admission", budget.remaining(), _deadline.pipeline_p95()
+                    "admission", budget.remaining(), required
                 )
         handle = PendingScore(submitted_at=now)
-        req = ScoreRequest(list(rows), budget, handle, enqueued_at=now)
+        req = ScoreRequest(
+            list(rows), budget, handle, enqueued_at=now, explain=explain
+        )
         try:
             # offer + admitted count under ONE critical section: a worker
             # can pop and settle the request the instant offer() publishes
@@ -344,6 +362,10 @@ class ScoringService:
                     budget is None or b.remaining() < budget.remaining()
                 ):
                     budget = b
+            # the batch explains at the LARGEST member k (co-batched
+            # members share one sweep); each member's slice is trimmed
+            # back to its own k below
+            explain_k = max((req.explain for req in pending), default=0)
             fault_plan = _faults.active()
             sim0 = (
                 fault_plan.simulated_seconds if fault_plan is not None
@@ -354,7 +376,11 @@ class ScoringService:
             error: BaseException | None = None
             try:
                 with _deadline.active(budget):
-                    out = self.score_fn.batch(rows)
+                    out = (
+                        self.score_fn.batch(rows, explain=explain_k)
+                        if explain_k
+                        else self.score_fn.batch(rows)
+                    )
             except _deadline.DeadlineExceeded as e:
                 error = e
             except Exception as e:  # contained: one batch, typed outcome
@@ -380,6 +406,8 @@ class ScoringService:
                         i in quarantined_rows for i in range(off, off + k)
                     )
                     off += k
+                    if explain_k:
+                        _fit_attributions(req_out, req.explain)
                     self._finish(
                         req, "quarantined" if hit else "completed",
                         results=req_out,
@@ -495,6 +523,23 @@ class ScoringService:
                 "shedding": self.shedder.stats(),
                 "batcher": self.batcher.stats(),
             }
+
+
+def _fit_attributions(rows_out: list[dict], k: int) -> None:
+    """Reconcile a member's slice of a shared explain sweep with its OWN
+    request: members that never asked lose the key, members that asked
+    for fewer than the batch's k keep their |contribution|-largest k
+    (row dicts are per-row and slices are disjoint, so mutation is
+    safe)."""
+    for r in rows_out:
+        if k <= 0:
+            r.pop("attributions", None)
+            continue
+        a = r.get("attributions")
+        if a and len(a) > k:
+            r["attributions"] = dict(
+                sorted(a.items(), key=lambda kv: -abs(kv[1]))[:k]
+            )
 
 
 def _service_source() -> dict[str, Any]:

@@ -4,15 +4,22 @@ Under sustained overload a scorer must degrade in ORDER — cheapest
 observability first, admissions last — and re-admit smoothly instead of
 flapping. The :class:`LoadShedder` computes a load signal from queue
 depth, in-flight rows, and the fraction of open circuit breakers, and
-maps it onto three cumulative tiers:
+maps it onto four cumulative tiers:
 
-====  ===============  ============================================
-tier  name             sheds
-====  ===============  ============================================
-1     ``shed_detail``  per-stage detail spans (telemetry only)
-2     ``shed_drift``   drift-window observation (monitoring only)
-3     ``reject``       new admissions (typed ``RejectedByAdmission``)
-====  ===============  ============================================
+====  ================  ============================================
+tier  name              sheds
+====  ================  ============================================
+1     ``shed_explain``  LOCO attribution sweeps (``explain=k`` work)
+2     ``shed_detail``   per-stage detail spans (telemetry only)
+3     ``shed_drift``    drift-window observation (monitoring only)
+4     ``reject``        new admissions (typed ``RejectedByAdmission``)
+====  ================  ============================================
+
+Explain sweeps are the first casualty: they multiply the predict cost by
+the lane count, and a late explanation is worth strictly less than an
+on-time score — so attribution work yields before any other
+observability does (rows shed this way are counted per row on
+``tptpu_serve_explain_shed_total`` and the attribution ledger).
 
 Each tier has an ENTER threshold and a strictly lower EXIT threshold
 (hysteresis): a tier engages when load rises to its enter point and only
@@ -21,11 +28,12 @@ at a boundary does not oscillate between shedding and re-admitting on
 every batch. Every transition increments the tier-transition counter and
 emits a ``load_shed`` event.
 
-Tier 1 suppresses detail spans through
-``telemetry.spans.set_detail_suppressed`` (the scoring loop already
-consults ``stage_detail``); tier 2 raises the process-wide drift-shed
-flag that ``local/scoring.py`` checks before observing columns. Both are
-restored the moment the shedder drops back below the exit threshold.
+Tier 1 raises the process-wide explain-shed flag ``local/scoring.py``
+checks before an attribution sweep; tier 2 suppresses detail spans
+through ``telemetry.spans.set_detail_suppressed`` (the scoring loop
+already consults ``stage_detail``); tier 3 raises the drift-shed flag
+checked before drift-window observation. All are restored the moment the
+shedder drops back below the exit threshold.
 """
 from __future__ import annotations
 
@@ -36,23 +44,31 @@ from ..telemetry import events as _tevents
 from ..telemetry import metrics as _tm
 from ..telemetry import spans as _tspans
 
-__all__ = ["LoadShedder", "ShedConfig", "TIER_NAMES", "drift_shed"]
+__all__ = [
+    "LoadShedder", "ShedConfig", "TIER_NAMES", "drift_shed", "explain_shed",
+]
 
-TIER_NAMES = ("normal", "shed_detail", "shed_drift", "reject")
+TIER_NAMES = ("normal", "shed_explain", "shed_detail", "shed_drift", "reject")
 
 # process-wide shed flags are REFCOUNTS of shedder contributions, not
 # booleans (TPL001: mutations hold the lock): two standing services in
 # one process each contribute while at/above the tier, so an idle
 # service's transition (or reset) can never clear the suppression an
-# overloaded one still needs. Reads go through the lock-free accessor —
+# overloaded one still needs. Reads go through the lock-free accessors —
 # a stale read during a transition costs one extra/missing drift
-# observation, never correctness.
+# observation or explain sweep, never correctness.
 _LOCK = threading.Lock()
-_STATE = {"detail": 0, "drift": 0}
+_STATE = {"explain": 0, "detail": 0, "drift": 0}
+
+
+def explain_shed() -> bool:
+    """True while ANY shedder is at tier >= 1 (scoring skips the
+    attribution sweep for the batch — explain is the first casualty)."""
+    return _STATE["explain"] > 0
 
 
 def drift_shed() -> bool:
-    """True while ANY shedder is at tier >= 2 (scoring skips the drift
+    """True while ANY shedder is at tier >= 3 (scoring skips the drift
     window observe for the batch)."""
     return _STATE["drift"] > 0
 
@@ -64,6 +80,7 @@ def reset_process_flags_for_tests() -> None:
     leaks its contribution into ``_STATE``; production code must use
     :meth:`LoadShedder.reset` so co-resident services keep theirs."""
     with _LOCK:
+        _STATE["explain"] = 0
         _STATE["detail"] = 0
         _STATE["drift"] = 0
     _tspans.set_detail_suppressed(False)
@@ -89,6 +106,8 @@ class ShedConfig:
     in-flight rows) / capacity + breaker_weight * fraction of breakers
     open). Enter > exit per tier = the hysteresis band."""
 
+    explain_enter: float = 0.35
+    explain_exit: float = 0.20
     detail_enter: float = 0.50
     detail_exit: float = 0.35
     drift_enter: float = 0.70
@@ -99,6 +118,7 @@ class ShedConfig:
 
     def __post_init__(self) -> None:
         pairs = (
+            ("explain", self.explain_enter, self.explain_exit),
             ("detail", self.detail_enter, self.detail_exit),
             ("drift", self.drift_enter, self.drift_exit),
             ("reject", self.reject_enter, self.reject_exit),
@@ -110,10 +130,16 @@ class ShedConfig:
                 )
 
     def enter_for(self, tier: int) -> float:
-        return (self.detail_enter, self.drift_enter, self.reject_enter)[tier - 1]
+        return (
+            self.explain_enter, self.detail_enter, self.drift_enter,
+            self.reject_enter,
+        )[tier - 1]
 
     def exit_for(self, tier: int) -> float:
-        return (self.detail_exit, self.drift_exit, self.reject_exit)[tier - 1]
+        return (
+            self.explain_exit, self.detail_exit, self.drift_exit,
+            self.reject_exit,
+        )[tier - 1]
 
 
 class LoadShedder:
@@ -143,7 +169,7 @@ class LoadShedder:
             self.load = load
             tier = self.tier
             # climb through every tier whose ENTER threshold load reached
-            while tier < 3 and load >= self.config.enter_for(tier + 1):
+            while tier < 4 and load >= self.config.enter_for(tier + 1):
                 tier += 1
             # descend only below the EXIT threshold (hysteresis)
             while tier > 0 and load < self.config.exit_for(tier):
@@ -160,8 +186,9 @@ class LoadShedder:
             # 0→2 racing a 2→0 would leave the process flags wrong.
             # Safe: the shift/metrics/event locks taken below never wrap
             # an acquisition of this shedder's lock
-            _shift("detail", int(tier >= 1) - int(prev >= 1))
-            _shift("drift", int(tier >= 2) - int(prev >= 2))
+            _shift("explain", int(tier >= 1) - int(prev >= 1))
+            _shift("detail", int(tier >= 2) - int(prev >= 2))
+            _shift("drift", int(tier >= 3) - int(prev >= 3))
             _tm.REGISTRY.counter("tptpu_serve_shed_transitions_total").inc()
             _tm.REGISTRY.gauge("tptpu_serve_shed_tier").set(tier)
             _tevents.emit(
@@ -173,7 +200,7 @@ class LoadShedder:
     # ------------------------------------------------------------- state
     @property
     def reject_admissions(self) -> bool:
-        return self.tier >= 3
+        return self.tier >= 4
 
     def reset(self) -> None:
         """Back to normal (service shutdown) — withdraws THIS shedder's
@@ -182,8 +209,9 @@ class LoadShedder:
         with self._lock:
             prev, self.tier = self.tier, 0
             self.load = 0.0
-            _shift("detail", -int(prev >= 1))
-            _shift("drift", -int(prev >= 2))
+            _shift("explain", -int(prev >= 1))
+            _shift("detail", -int(prev >= 2))
+            _shift("drift", -int(prev >= 3))
         _tm.REGISTRY.gauge("tptpu_serve_shed_tier").set(0)
 
     def stats(self) -> dict:

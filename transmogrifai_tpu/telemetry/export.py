@@ -46,6 +46,7 @@ def _ensure_default_sources() -> None:
     module importing them at package-import time."""
     from ..compiler import stats as _cstats  # noqa: F401
     from ..featurize import stats as _fstats  # noqa: F401
+    from ..insights import ledger as _attr  # noqa: F401
     from ..local import scoring as _scoring  # noqa: F401
     from ..resilience import distributed as _dist  # noqa: F401
 
@@ -197,6 +198,10 @@ _PHASE_PREFIXES = (
     ("compile/", "compile"),
     ("train/fit", "fit"),
     ("train/eval", "eval"),
+    # the explainability plane: train-time baseline sweeps + serve-time
+    # explain=k sweeps both attribute to one "explain" phase
+    ("train/attribution", "explain"),
+    ("serve/explain", "explain"),
 )
 
 

@@ -206,6 +206,7 @@ def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F82
         "blocklisted": model.blocklisted,
         "sensitiveFeatures": model.sensitive_info,
         "servingProfiles": model.serving_profiles,
+        "attributionProfiles": getattr(model, "attribution_profiles", None),
         "distResilience": model.dist_summary,
         "analysis": getattr(model, "analysis", None),
     }
@@ -300,6 +301,8 @@ def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
         sensitive_info=manifest.get("sensitiveFeatures"),
         # absent on pre-drift-sentinel saves: the sentinel just stays inert
         serving_profiles=manifest.get("servingProfiles"),
+        # absent on pre-explainability saves: attribution drift stays inert
+        attribution_profiles=manifest.get("attributionProfiles"),
         # absent on pre-failover saves: no dist ledger to report
         dist_summary=manifest.get("distResilience"),
         # absent on pre-analysis-plane saves: no findings ledger

@@ -479,6 +479,17 @@ class Workflow:
 
         serving_profiles = compute_serving_profiles(train_data, raw_features)
 
+        # attribution baseline (insights/drift.py): one batched LOCO sweep
+        # over a bounded training sample, sketching each feature group's
+        # contribution distribution — the serve-time attribution drift
+        # monitor compares explain=k sweeps against this. Persisted next
+        # to servingProfiles; TPTPU_ATTRIBUTION_PROFILE_ROWS=0 disables.
+        attribution_profiles = None
+        if selector_info is not None:
+            attribution_profiles = _attribution_baseline(
+                fitted, selector_info, fitted_data
+            )
+
         model = WorkflowModel(
             result_features=self.result_features,
             raw_features=tuple(raw_features),
@@ -492,6 +503,7 @@ class Workflow:
             label_summary=label_summary,
             training_params=dict(self._stage_overrides),
             serving_profiles=serving_profiles,
+            attribution_profiles=attribution_profiles,
             dist_summary=dist_summary,
             analysis=preflight_report.to_json(),
         )
@@ -500,6 +512,45 @@ class Workflow:
             # on the in-memory model (the name in selector_info covers load)
             model._live_evaluator = selector.evaluator
         return model
+
+
+def _attribution_baseline(
+    fitted: dict[str, Any],
+    selector_info: dict[str, Any],
+    fitted_data: Dataset,
+) -> dict[str, Any] | None:
+    """Train-time baseline attribution profile (insights/drift.py) — a
+    best-effort capture that must never fail a train; one bounded batched
+    LOCO sweep, counted under the ``train/attribution`` span so
+    ``phase_breakdown()`` attributes its seconds to ``explain``."""
+    import os
+
+    try:
+        max_rows = int(os.environ.get("TPTPU_ATTRIBUTION_PROFILE_ROWS", "256"))
+    except ValueError:
+        max_rows = 256
+    if max_rows <= 0:
+        return None
+    sel_model = fitted.get(selector_info["estimatorUid"])
+    vec_name = selector_info["vectorName"]
+    if sel_model is None or vec_name not in fitted_data:
+        return None
+    vec = fitted_data[vec_name]
+    if not isinstance(vec, VectorColumn):
+        return None
+    try:
+        from ..insights.drift import compute_attribution_profile
+
+        with _tspans.span("train/attribution", rows=min(max_rows, len(vec))):
+            return compute_attribution_profile(
+                sel_model,
+                np.asarray(vec.values, dtype=np.float32),
+                vec.metadata,
+                max_rows=max_rows,
+            )
+    except Exception as e:  # observability must never break training
+        log.warning("attribution baseline capture skipped: %s", e)
+        return None
 
 
 def _label_summary(
@@ -563,6 +614,7 @@ class WorkflowModel:
         label_summary: dict[str, Any] | None = None,
         training_params: dict[str, Any] | None = None,
         serving_profiles: dict[str, Any] | None = None,
+        attribution_profiles: dict[str, Any] | None = None,
         dist_summary: dict[str, Any] | None = None,
         analysis: dict[str, Any] | None = None,
     ):
@@ -581,6 +633,10 @@ class WorkflowModel:
         #: sentinel (fill rate + StreamingHistogram JSON); None on models
         #: saved before this field existed
         self.serving_profiles = serving_profiles
+        #: per-feature-group baseline LOCO contribution histograms for the
+        #: serve-time attribution drift monitor (insights/drift.py); None
+        #: on models saved before the explainability plane existed
+        self.attribution_profiles = attribution_profiles
         #: distributed-resilience ledger from training (hosts lost,
         #: failovers, collective retries, stragglers, reshard events, mesh
         #: history); None on models saved before this field existed
@@ -916,6 +972,32 @@ class WorkflowModel:
                 f"{feat.get('fallbackKernels', 0)} fallback kernel(s)"
                 f"{top_s}"
             )
+        # explainability plane: the attribution ledger's one-line view
+        # (train-time baseline sweeps + any serve-time explain=k work)
+        try:
+            from ..insights import ledger as _attr_ledger
+
+            att = _attr_ledger.snapshot()
+            if att.get("rowsExplained") or att.get("profilesCaptured"):
+                rate = att.get("explainRowsPerSec")
+                rate_s = f" @ {rate:,} rows/s" if rate else ""
+                profiled = len(
+                    (getattr(self, "attribution_profiles", None) or {})
+                    .get("groups", {})
+                )
+                lines.append(
+                    f"Record insights: {att.get('rowsExplained', 0):,} "
+                    f"row(s) explained{rate_s}, "
+                    f"{att.get('laneDispatches', 0)} lane(s) dispatched "
+                    f"({att.get('lanesDeduped', 0)} deduped, "
+                    f"{att.get('lanesPadded', 0)} padded), "
+                    f"{profiled} group(s) profiled, "
+                    f"{att.get('attributionDriftAlerts', 0)} attribution "
+                    f"drift alert(s), {att.get('explainShedRows', 0)} "
+                    f"row(s) shed"
+                )
+        except Exception as e:  # observability must never break summaries
+            log.debug("record-insights summary line skipped: %s", e)
         dist = getattr(self, "dist_summary", None) or {}
         if any(
             dist.get(k)
