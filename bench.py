@@ -172,6 +172,43 @@ def validate_bench_report(doc) -> list[str]:
     return problems
 
 
+def validate_reports(root: str | None = None) -> int:
+    """The ``validate-reports`` subcommand: run ``validate_bench_report``
+    over every committed ``BENCH_*.json`` / ``MULTICHIP_*.json`` (and the
+    run ledger's ``RUN_*.json``, which additionally validates against the
+    runlog schema) in the repo root. Returns the number of invalid
+    files — CI exits nonzero on any, so a future bench landing cannot
+    silently drift the permissive schema union."""
+    from transmogrifai_tpu.telemetry import runlog as _runlog
+
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    names = sorted(
+        n for n in os.listdir(root)
+        if n.endswith(".json")
+        and n.startswith(("BENCH_", "MULTICHIP_", "RUN_"))
+    )
+    bad = 0
+    for name in names:
+        path = os.path.join(root, name)
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"FAIL {name}: unreadable ({e})")
+            bad += 1
+            continue
+        problems = validate_bench_report(doc)
+        if name.startswith("RUN_"):
+            problems += _runlog.validate_run_report(doc)
+        if problems:
+            print(f"FAIL {name}: " + "; ".join(problems))
+            bad += 1
+        else:
+            print(f"ok   {name}")
+    print(f"{len(names)} report(s) checked, {bad} invalid")
+    return bad
+
+
 def _telemetry_phase_breakdown() -> dict:
     """Span-derived ingest/featurize/compile/fit/eval seconds (telemetry
     plane); empty when telemetry is disabled."""
@@ -238,6 +275,17 @@ def bench_titanic() -> dict:
     # back-to-back runs is the honest point estimate. Nothing is excluded:
     # rep 0 pays any per-process program acquisition the prewarm thread
     # has not finished hiding.
+    # the flagship train is flight-recorded (telemetry/runlog.py): ONE
+    # RUN_*.json per bench invocation — the LAST rep, which is warm
+    # steady state, so cross-invocation auto-diffs compare like with
+    # like (rep 0 pays per-process program acquisition by design; diffing
+    # a cold rep against a previous invocation's warm one would fire
+    # spurious TPR001/TPR002 and bury real regressions). The artifact
+    # lands beside the BENCH_r0x trail; $TPTPU_RUN_DIR overrides, empty
+    # disables.
+    run_dir = os.environ.get("TPTPU_RUN_DIR")
+    if run_dir is None:
+        run_dir = os.path.dirname(os.path.abspath(__file__))
     samples = []
     model = None
     for _rep in range(5):
@@ -252,7 +300,10 @@ def bench_titanic() -> dict:
         selector = BinaryClassificationModelSelector(seed=42)
         pred = selector.set_input(resp, checked).get_output()
         model = (
-            Workflow().set_result_features(pred).set_input_dataset(ds).train()
+            Workflow().set_result_features(pred).set_input_dataset(ds)
+            # "" = explicitly disabled for the cold/warming reps (None
+            # would fall back to $TPTPU_RUN_DIR and record all five)
+            .train(run_dir=run_dir if _rep == 4 else "")
         )
         samples.append(time.perf_counter() - t0)
     train_s = sorted(samples)[len(samples) // 2]
@@ -1059,6 +1110,18 @@ def _build_parser():
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH",
     )
+    vr = sub.add_parser(
+        "validate-reports",
+        help=(
+            "validate every committed BENCH_*/MULTICHIP_*/RUN_*.json "
+            "against the permissive report-schema union; exit nonzero "
+            "on drift"
+        ),
+    )
+    vr.add_argument(
+        "--root", default=None,
+        help="directory to scan (default: the repo root beside bench.py)",
+    )
     ex = sub.add_parser(
         "explain",
         help=(
@@ -1241,6 +1304,9 @@ def _dispatch(ns) -> None:
     if mode == "coldprobe":
         print(json.dumps(bench_titanic_cold()))
         return
+    if mode == "validate-reports":
+        bad = validate_reports(ns.root)
+        raise SystemExit(1 if bad else 0)
     if mode == "explain":
         dump_bench_report(
             bench_explain(rows=ns.rows, k=ns.k, median_of=ns.median_of),

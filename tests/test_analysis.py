@@ -103,7 +103,8 @@ def test_report_raise_if_errors_is_valueerror():
 
 def test_all_emittable_codes_are_catalogued():
     for code in CODES:
-        assert code[:3] in ("TPA", "TPX", "TPL")
+        # TPR: the cross-run regression sentinel (telemetry/runlog.py)
+        assert code[:3] in ("TPA", "TPX", "TPL", "TPR")
         assert CODES[code]
 
 

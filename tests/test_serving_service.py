@@ -479,6 +479,28 @@ class TestServiceLifecycle:
         assert s["completed"] == 10
         assert all(h.done() for h in handles)
 
+    def test_stop_resets_gauges_in_exposition(self, score_fn, rows):
+        """Quiesce → exposition: the queue-depth / in-flight gauges must
+        read ZERO in the Prometheus text after stop(), not freeze at
+        their last pre-quiesce value (a stopped service reporting queued
+        rows would look like a live backlog to a scraper)."""
+        from transmogrifai_tpu.telemetry import render_prometheus
+
+        svc = ScoringService(
+            score_fn,
+            ServiceConfig(workers=0, max_queue_rows=64, max_batch_rows=8),
+        )
+        svc.start()
+        for r in rows[:6]:
+            svc.submit(dict(r))
+        # queued, never pumped: the queue gauge holds a nonzero value now
+        assert tm.REGISTRY.gauge("tptpu_serve_queue_depth").value > 0
+        svc.stop(drain=True)
+        text = render_prometheus()
+        assert "tptpu_serve_queue_depth 0" in text
+        assert "tptpu_serve_in_flight_rows 0" in text
+        assert svc.stats()["outstanding"] == 0
+
     def test_context_manager(self, score_fn, rows):
         with ScoringService(score_fn, ServiceConfig(workers=1)) as svc:
             h = svc.submit(dict(rows[0]))

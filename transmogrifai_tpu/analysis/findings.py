@@ -52,6 +52,12 @@ CODES: dict[str, str] = {
     "TPX007": "predictor feature vector carries no usable provenance "
               "metadata — LOCO attributions degrade to anonymous "
               "per-column groups",
+    # ---- TPR: cross-run regression sentinel (telemetry/runlog.py)
+    "TPR001": "training phase slowed beyond tolerance between runs",
+    "TPR002": "compiled-program count blew up between runs",
+    "TPR003": "host<->device transfer volume grew beyond tolerance "
+              "between runs",
+    "TPR004": "quality metric dropped beyond tolerance between runs",
     # ---- TPL: package invariant lint (analysis/lint.py)
     "TPL000": "file does not parse — the linter cannot scan it",
     "TPL001": "shared module-level state written without holding a lock",

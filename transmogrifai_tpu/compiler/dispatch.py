@@ -101,12 +101,21 @@ def prefetch_f32(arr) -> None:
                 return
         import jax
 
+        from ..telemetry import runlog as _runlog
         from ..telemetry import spans as _tspans
 
-        with _tspans.span(
-            "compile/prefetch", bytes=int(getattr(arr, "nbytes", 0))
-        ):
+        nbytes = int(getattr(arr, "nbytes", 0))
+        with _tspans.span("compile/prefetch", bytes=nbytes):
+            t0 = _tspans.clock()
             buf = jax.device_put(np.asarray(arr, dtype=np.float32))
+            # runtime transfer census (telemetry/runlog.py): every upload
+            # through this seam is one host->device crossing the run
+            # ledger counts — the live counterpart of the static TPX
+            # census in analysis/plan_audit.py
+            _runlog.record_upload(
+                buf.nbytes if hasattr(buf, "nbytes") else nbytes,
+                _tspans.clock() - t0,
+            )
         try:
             ref = weakref.ref(src)
         except TypeError:  # source not weakref-able: skip (no way to
@@ -136,12 +145,32 @@ def device_f32(arr):
     if hit is not None:
         ref, buf = hit
         if ref() is arr and not _mesh_active():
+            # the upload was already counted at prefetch time — a pickup
+            # is not a second transfer
             return buf
+    import jax
+
+    if isinstance(arr, jax.Array):
+        # already-device: re-wraps without crossing the boundary — no
+        # census entry, no clock reads on this fast path
+        return jnp.asarray(arr, dtype=jnp.float32)
+    from ..telemetry import runlog as _runlog
+    from ..telemetry import spans as _tspans
+
+    t0 = _tspans.clock()
     if isinstance(arr, np.ndarray):
         # dtype-convert on HOST: an eager device-side convert compiles a
         # per-process program on the axon backend (see gbdt._binned)
-        return jnp.asarray(np.asarray(arr, dtype=np.float32))
-    return jnp.asarray(arr, dtype=jnp.float32)
+        out = jnp.asarray(np.asarray(arr, dtype=np.float32))
+    else:
+        out = jnp.asarray(arr, dtype=jnp.float32)
+    # fresh upload (no prefetch in flight): one host->device crossing
+    # on the run ledger's runtime transfer census
+    _runlog.record_upload(
+        int(getattr(out, "nbytes", getattr(arr, "nbytes", 0))),
+        _tspans.clock() - t0,
+    )
+    return out
 
 
 def clear_prefetch() -> None:

@@ -46,6 +46,7 @@ from ..serving import deadline as _sdl
 from ..serving import shedding as _sshed
 from ..telemetry import events as _tevents
 from ..telemetry import metrics as _tm
+from ..telemetry import runlog as _runlog
 from ..telemetry import spans as _tspans
 from ..resilience.sentinel import (
     BreakerConfig,
@@ -196,9 +197,36 @@ def score_function(
     _predictor_feeds = frozenset(
         t.input_names[-1] for t in plan if isinstance(t, PredictorModel)
     )
+    #: predictor-produced outputs — the columns whose render is a
+    #: device->host crossing on the runtime transfer census when the
+    #: batch dispatched on device (same per-row accounting convention as
+    #: the static TPX census: 24 download bytes per prediction row)
+    _predictor_outputs = frozenset(
+        t.output_name for t in plan if isinstance(t, PredictorModel)
+    )
     _device_predict_min = int(
         os.environ.get("TPTPU_HOST_PREDICT_MAX", "16384")
     )
+
+    def _census_downloads(
+        b: int, n: int, degraded: list[str], seconds: float
+    ) -> None:
+        """Runtime d2h census at the download point (telemetry/runlog.py):
+        one crossing per rendered predictor output for a device-dispatched
+        batch, 24 bytes/row (f64 pred+prob+raw — the static census's
+        ``downBytesPerRow``), so ``runs --diff`` and the reconciliation
+        tests can square runtime against ``audit()``'s prediction."""
+        if b <= _device_predict_min:
+            return  # host-predict regime: nothing crossed the boundary
+        cols = [
+            nm for nm in result_names
+            if nm in _predictor_outputs and nm not in degraded
+        ]
+        if not cols:
+            return
+        per = seconds / len(cols)
+        for _ in cols:
+            _runlog.record_download(24 * n, per)
     raw_features = list(model.raw_features)
     result_names = [f.name for f in model.result_features]
     result_ftypes = {f.name: f.ftype for f in model.result_features}
@@ -773,6 +801,7 @@ def score_function(
                     out[i][name] = rendered[j]
             if tel:
                 fam["download"] = _tspans.clock() - td
+            _census_downloads(b, m, degraded, fam.get("download", 0.0))
             if explain:
                 # attributions ride the batch AFTER scores render: the
                 # sweep reuses the assembled feature plane and the batch's
@@ -917,6 +946,7 @@ def score_function(
         }
         if tel:
             fam["download"] = _tspans.clock() - td
+        _census_downloads(b, n, degraded, fam.get("download", 0.0))
         attr_maps: list[dict[str, float]] | None = None
         if explain:
             attr_maps = _run_explain(

@@ -26,6 +26,8 @@ from ..evaluators.base import Evaluator
 from ..models.base import PredictorEstimator, PredictorModel
 from ..resilience import faults
 from ..resilience.retry import RetryPolicy
+from ..telemetry import runlog as _runlog
+from ..telemetry import spans as _tspans
 
 log = logging.getLogger(__name__)
 
@@ -200,6 +202,13 @@ class Validator:
             Returns (CandidateResults, attempts, from_checkpoint)."""
             if isinstance(points, Exception):
                 raise points
+            # run-ledger pulse (telemetry/runlog.py): one timing per
+            # candidate family sweep — the fold axis is batched into the
+            # program, so the family IS the timing unit here (workflow CV
+            # pulses per fold instead). RunRecorder is thread-safe: these
+            # fire from the candidate pool's worker threads.
+            recorder = _runlog.active_recorder()
+            cand_t0 = _tspans.clock() if recorder is not None else 0.0
             key = None
             if checkpoint is not None:
                 key = _candidate_key(
@@ -229,6 +238,11 @@ class Validator:
                     extra_masks=extra_masks,
                 )
             )
+            if recorder is not None:
+                recorder.on_candidate(
+                    type(est).__name__, len(points),
+                    _tspans.clock() - cand_t0, rows=len(y),
+                )
             if key is not None:
                 checkpoint.save_candidate(
                     key,

@@ -195,24 +195,30 @@ class ScoringService:
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Quiesce: close admissions, drain (or shed) the queue, join
         workers. After stop() the queue is empty, every admitted request
-        has a typed outcome, and no service thread is alive."""
-        self.queue.close()
-        self._stop.set()
-        for th in self._threads:
-            th.join(timeout=timeout)
-            if th.is_alive():  # pragma: no cover - the deadlock alarm
-                raise RuntimeError(f"service worker {th.name} leaked")
-        self._threads.clear()
-        if drain:
-            while self.pump():
-                pass
-        for req in self.queue.drain():
-            self._finish(
-                req, "stopped", error=RejectedByAdmission("stopped")
-            )
-        self.shedder.reset()
-        _tm.REGISTRY.gauge("tptpu_serve_queue_depth").set(0)
-        _tm.REGISTRY.gauge("tptpu_serve_in_flight_rows").set(0)
+        has a typed outcome, and no service thread is alive. The
+        queue-depth / in-flight gauges reset to zero on EVERY exit path
+        (including the worker-leak alarm) — a stopped service must not
+        freeze its last pre-quiesce value into the Prometheus exposition
+        as if rows were still in flight."""
+        try:
+            self.queue.close()
+            self._stop.set()
+            for th in self._threads:
+                th.join(timeout=timeout)
+                if th.is_alive():  # pragma: no cover - the deadlock alarm
+                    raise RuntimeError(f"service worker {th.name} leaked")
+            self._threads.clear()
+            if drain:
+                while self.pump():
+                    pass
+            for req in self.queue.drain():
+                self._finish(
+                    req, "stopped", error=RejectedByAdmission("stopped")
+                )
+            self.shedder.reset()
+        finally:
+            _tm.REGISTRY.gauge("tptpu_serve_queue_depth").set(0)
+            _tm.REGISTRY.gauge("tptpu_serve_in_flight_rows").set(0)
 
     def __enter__(self) -> "ScoringService":
         return self.start()

@@ -19,13 +19,20 @@ per-stage summaries — SURVEY §1 L3); this plane is the live counterpart.
 * :mod:`telemetry.export` — ``render_prometheus()``, chrome trace export,
   the span-derived bench phase breakdown, and the ``summary_pretty()``
   line.
+* :mod:`telemetry.runlog` — the training-run flight recorder: one
+  schema-versioned ``RunReport`` per ``Workflow.train()`` (per-phase /
+  layer / fold timings, runtime host↔device transfer census, device-
+  memory high-water, live progress/ETA) plus the cross-run
+  ``diff_runs`` / ``RegressionSentinel`` regression verdicts.
 
-CLI: ``python -m transmogrifai_tpu metrics`` / ``... trace``.
-Docs: docs/observability.md (span taxonomy + metric catalogue).
+CLI: ``python -m transmogrifai_tpu metrics`` / ``... trace`` /
+``... runs``. Docs: docs/observability.md (span taxonomy + metric
+catalogue + the run ledger).
 """
 from __future__ import annotations
 
 from . import events  # noqa: F401
+from . import runlog  # noqa: F401
 from .export import (  # noqa: F401
     export_chrome_trace,
     metrics_snapshot,

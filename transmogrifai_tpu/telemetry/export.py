@@ -49,6 +49,7 @@ def _ensure_default_sources() -> None:
     from ..insights import ledger as _attr  # noqa: F401
     from ..local import scoring as _scoring  # noqa: F401
     from ..resilience import distributed as _dist  # noqa: F401
+    from . import runlog as _runlog  # noqa: F401
 
 
 _SNAKE_RE = re.compile(r"(?<=[a-z0-9])([A-Z])")

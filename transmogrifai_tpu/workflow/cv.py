@@ -28,6 +28,7 @@ from ..evaluators.base import Evaluator
 from ..resilience import distributed
 from ..selector.model_selector import ModelSelector
 from ..selector.validators import CandidateResult, expand_grid
+from ..telemetry import runlog as _runlog
 from ..telemetry import spans as _tspans
 from ..types.columns import NumericColumn, VectorColumn
 from .fit import apply_transformations_dag, fit_and_transform_dag
@@ -75,6 +76,11 @@ def workflow_cv_results(
         controller = distributed.active_controller()
         if controller is not None:
             controller.on_fold(fold_i)
+        # run-ledger pulse: fold boundaries land in the flight recorder's
+        # per-fold timings and progress/ETA stream (telemetry/runlog.py)
+        recorder = _runlog.active_recorder()
+        if recorder is not None:
+            recorder.on_fold_start(fold_i, total=len(folds))
         with _tspans.span("cv/fold", fold=fold_i):
             tr_idx = np.nonzero(train_mask)[0]
             va_idx = np.nonzero(val_mask)[0]
@@ -99,6 +105,7 @@ def workflow_cv_results(
                 if est.uid in failed:
                     continue
                 points = expand_grid(grid)
+                cand_t0 = _tspans.clock()
                 try:
                     with _tspans.span(
                         "cv/candidate",
@@ -108,17 +115,35 @@ def workflow_cv_results(
                             est, points, xt, yt, xv, yv, evaluator,
                             per_candidate, fold_i,
                         )
+                    if recorder is not None:
+                        recorder.on_candidate(
+                            type(est).__name__, len(points),
+                            _tspans.clock() - cand_t0,
+                            rows=len(yt), fold=fold_i,
+                        )
                 except Exception as e:  # candidate-level isolation
                     log.warning(
                         "Model %s failed workflow CV: %s",
                         type(est).__name__, e,
                     )
+                    if recorder is not None:
+                        recorder.on_candidate(
+                            type(est).__name__, len(points),
+                            _tspans.clock() - cand_t0,
+                            rows=len(yt), fold=fold_i, error=str(e),
+                        )
                     failed.add(est.uid)
                     per_candidate = {
                         k: v
                         for k, v in per_candidate.items()
                         if v.model_uid != est.uid
                     }
+
+        if recorder is not None:
+            recorder.on_fold_end(
+                fold_i, total=len(folds),
+                rows=int(train_mask.sum() + val_mask.sum()),
+            )
 
     results = list(per_candidate.values())
     if not results:

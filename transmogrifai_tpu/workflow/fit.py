@@ -23,6 +23,7 @@ from ..dataset import Dataset
 from ..features.feature import Feature
 from ..resilience import distributed, faults
 from ..stages.base import Estimator, Model, PipelineStage, Transformer
+from ..telemetry import runlog as _runlog
 from ..telemetry import spans as _tspans
 from .dag import compute_dag
 
@@ -72,13 +73,24 @@ def _fit_layers(
     can bound the prefetch-buffer lifetime with one try/finally).
     ``dataset_box`` is a 1-element list carrying the evolving dataset."""
     dataset = dataset_box[0]
+    # run-ledger pulses (telemetry/runlog.py): layer boundaries feed the
+    # flight recorder's per-layer timings, device-memory polls, and the
+    # seconds-per-layer EWMA behind the live train(progress=...) ETA
+    recorder = _runlog.active_recorder()
     for li, layer in enumerate(layers):
+        if recorder is not None:
+            recorder.on_layer_start(li, total=len(layers))
         # telemetry: one span per DAG layer, child spans per estimator fit
         # and per transform — the layer/stage hierarchy in the Chrome trace
         with _tspans.span("train/layer", index=li, stages=len(layer)):
             dataset = _fit_one_layer(
                 li, layer, dataset, fitted, prefitted, plan, checkpoint,
                 signature, layers,
+            )
+        if recorder is not None:
+            recorder.on_layer_end(
+                li, total=len(layers), stages=len(layer),
+                rows=dataset.num_rows,
             )
     dataset_box[0] = dataset
 
@@ -117,6 +129,16 @@ def _fit_one_layer(
             corrupted = plan.on_stage_output(t, dataset[t.output_name])
             if corrupted is not None:
                 dataset = dataset.with_column(t.output_name, corrupted)
+            # slow-stage chaos rides the TRAIN timings too: simulated
+            # extra seconds land on the flight recorder's in-flight
+            # phase/layer durations (the serving path's breaker-elapsed
+            # convention — no real sleep), so a seeded slow_stage plan
+            # drives the cross-run regression sentinel deterministically
+            extra = plan.on_stage_duration(t)
+            if extra:
+                recorder = _runlog.active_recorder()
+                if recorder is not None:
+                    recorder.add_simulated(extra)
     # pipelined layer execution (compiler.dispatch): layer li's
     # transforms just materialized the feature matrices layer li+1's
     # estimators will fit on — start their device uploads NOW so the

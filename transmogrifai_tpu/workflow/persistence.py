@@ -209,6 +209,7 @@ def save_workflow_model(model: "WorkflowModel", path: str) -> None:  # noqa: F82
         "attributionProfiles": getattr(model, "attribution_profiles", None),
         "distResilience": model.dist_summary,
         "analysis": getattr(model, "analysis", None),
+        "runReport": getattr(model, "run_report", None),
     }
     atomic_write_model_dir(path, manifest, arrays)
 
@@ -307,4 +308,6 @@ def load_workflow_model(path: str) -> "WorkflowModel":  # noqa: F821
         dist_summary=manifest.get("distResilience"),
         # absent on pre-analysis-plane saves: no findings ledger
         analysis=manifest.get("analysis"),
+        # absent on pre-run-ledger saves: no flight-recorder report
+        run_report=manifest.get("runReport"),
     )

@@ -13,6 +13,7 @@ WorkflowModel that can score/evaluate/summarize/save.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Sequence
 
 import numpy as np
@@ -22,12 +23,42 @@ from ..features.feature import Feature
 from ..readers.core import DataReader, DatasetReader
 from ..selector.model_selector import ModelSelector, SelectedModel
 from ..stages.base import Estimator, PipelineStage
+from ..telemetry import runlog as _runlog
 from ..telemetry import spans as _tspans
 from ..types.columns import NumericColumn, VectorColumn
 from .dag import compute_dag, raw_features_of, validate_stages
 from .fit import apply_transformations_dag, fit_and_transform_dag
 
 log = logging.getLogger(__name__)
+
+#: one-shot latch for the summary-degradation warning (further failures
+#: still count on the run ledger and the event log, just without the
+#: per-call log noise)
+_SUMMARY_DEGRADED_WARNED = [False]
+
+
+def _report_summary_degraded(section: str, e: Exception) -> None:
+    """A ``summary_pretty`` section failed to render: count it on the run
+    ledger (``summaryDegraded``), land a ``summary_degraded`` event in the
+    structured log, and warn ONCE per process — a broken summary section
+    must be observable, not a silent debug-level swallow."""
+    detail = f"{type(e).__name__}: {e}"
+    try:
+        from ..telemetry import events as _tevents
+
+        _runlog.stats().bump("summaryDegraded")
+        _tevents.emit("summary_degraded", section=section, error=detail)
+    except Exception:  # the degradation report must not break the summary
+        pass
+    if not _SUMMARY_DEGRADED_WARNED[0]:
+        _SUMMARY_DEGRADED_WARNED[0] = True
+        log.warning(
+            "summary_pretty %s section degraded (%s) — counted as "
+            "summaryDegraded on the run ledger; further degradations "
+            "log at debug level", section, detail,
+        )
+    else:
+        log.debug("summary_pretty %s section skipped: %s", section, detail)
 
 
 class Workflow:
@@ -192,6 +223,8 @@ class Workflow:
         checkpoint_dir: str | None = None,
         resume: bool = False,
         on_mesh_mismatch: str = "reshard",
+        progress: Any = None,
+        run_dir: str | None = None,
     ) -> "WorkflowModel":
         """Fit the DAG. With ``checkpoint_dir``, every completed layer (and
         every finished CV candidate sweep) is persisted atomically there;
@@ -207,7 +240,21 @@ class Workflow:
         loss (heartbeat timeout, exhausted collective retries, injected
         ``fail_host``) degrades the mesh to the surviving hosts' devices
         and re-enters the fit from the last completed layer checkpoint
-        instead of aborting."""
+        instead of aborting.
+
+        Every train is flight-recorded (telemetry/runlog.py): per-phase
+        and per-layer/fold/candidate timings, compile/featurize ledger
+        deltas, the runtime host<->device transfer census, and device-
+        memory high-water gauges land in a schema-versioned RunReport on
+        the returned model (``model.run_report``, ``summary_json()["run"]``,
+        the manifest). ``progress`` is an optional callback receiving
+        phase/layer/fold pulse dicts with a live seconds-per-layer EWMA
+        ETA. ``run_dir`` (default None = fall back to ``$TPTPU_RUN_DIR``;
+        pass ``""`` to disable persistence even when the env var is set)
+        persists the report as a ``RUN_*.json`` artifact and auto-diffs
+        it against the directory's latest run, warning on TPR-coded
+        regressions (``python -m transmogrifai_tpu runs --diff`` compares
+        any two)."""
         if not self.result_features:
             raise ValueError("setResultFeatures must be called before train")
         if self.reader is None:
@@ -221,6 +268,11 @@ class Workflow:
                 f"unknown on_mesh_mismatch {on_mesh_mismatch!r} "
                 "(choose 'reshard' or 'raise')"
             )
+        # flight recorder (telemetry/runlog.py): one RunReport per train —
+        # phases/layers/folds, ledger deltas, runtime transfer census,
+        # device-memory high-water, live progress/ETA. Purely
+        # observability: every recorder path is exception-contained.
+        recorder = _runlog.RunRecorder(progress=progress).start()
         # pre-flight static analysis: refuse a provably-broken DAG (type
         # clash, leakage, cycle, ...) BEFORE reading any data — the eager
         # stand-in for the reference's compile-time typed pipelines. The
@@ -251,8 +303,10 @@ class Workflow:
         selector = selectors[0] if selectors else None
 
         raw_features = raw_features_of(self.result_features)
-        with _tspans.span("train/ingest", features=len(raw_features)):
-            raw = self.reader.generate_dataset(raw_features)
+        with recorder.phase("ingest"):
+            with _tspans.span("train/ingest", features=len(raw_features)):
+                raw = self.reader.generate_dataset(raw_features)
+        recorder.set_phase_rows("ingest", raw.num_rows)
         if raw.num_rows == 0:
             raise ValueError("Input dataset cannot be empty")
         log.info("Generated raw data: %d rows, %d features", raw.num_rows, len(raw_features))
@@ -365,6 +419,15 @@ class Workflow:
                 controller.counters["reshardEvents"] += ckpt.reshard_events
             return pf
 
+        # the fit phase runs with the recorder INSTALLED so the layer /
+        # fold / candidate pulses in fit.py, cv.py and validators.py land
+        # on this run; an ExitStack keeps the existing failover-loop
+        # structure intact (a re-entered fit phase accumulates seconds)
+        _rec_stack = contextlib.ExitStack()
+        _rec_stack.enter_context(_runlog.recording(recorder))
+        _rec_stack.enter_context(
+            recorder.phase("fit", rows=train_data.num_rows)
+        )
         try:
             install = (
                 distributed.installed_controller(controller)
@@ -418,6 +481,7 @@ class Workflow:
                         controller.failover(e)
                         prefitted = load_checkpointed_layers()
         finally:
+            _rec_stack.close()
             if selector is not None:
                 selector._checkpoint = None
                 selector._checkpoint_resume = False
@@ -445,24 +509,26 @@ class Workflow:
                     featurize_baseline
                 )
 
+        holdout_metrics = None
         if selector is not None and holdout_data is not None:
             sel_model = fitted[selector.uid]
             assert isinstance(sel_model, SelectedModel)
-            with _tspans.span("train/eval", rows=len(holdout_data)):
-                transformed = apply_transformations_dag(
-                    holdout_data, self.result_features, fitted
-                )
-                label_name, vec_name = selector.input_names
-                label = transformed[label_name]
-                vec = transformed[vec_name]
-                assert isinstance(label, NumericColumn) and isinstance(
-                    vec, VectorColumn
-                )
-                holdout_metrics = sel_model.evaluate_holdout(
-                    np.asarray(vec.values, dtype=np.float32),
-                    label.values.astype(np.float64),
-                    selector.evaluator,
-                )
+            with recorder.phase("eval", rows=len(holdout_data)):
+                with _tspans.span("train/eval", rows=len(holdout_data)):
+                    transformed = apply_transformations_dag(
+                        holdout_data, self.result_features, fitted
+                    )
+                    label_name, vec_name = selector.input_names
+                    label = transformed[label_name]
+                    vec = transformed[vec_name]
+                    assert isinstance(label, NumericColumn) and isinstance(
+                        vec, VectorColumn
+                    )
+                    holdout_metrics = sel_model.evaluate_holdout(
+                        np.asarray(vec.values, dtype=np.float32),
+                        label.values.astype(np.float64),
+                        selector.evaluator,
+                    )
             log.info("Holdout metrics: %s", holdout_metrics)
 
         label_summary = None
@@ -486,9 +552,18 @@ class Workflow:
         # to servingProfiles; TPTPU_ATTRIBUTION_PROFILE_ROWS=0 disables.
         attribution_profiles = None
         if selector_info is not None:
-            attribution_profiles = _attribution_baseline(
-                fitted, selector_info, fitted_data
-            )
+            with recorder.phase("attribution"):
+                attribution_profiles = _attribution_baseline(
+                    fitted, selector_info, fitted_data
+                )
+
+        # freeze the flight recorder into the run report, persist it as a
+        # RUN_*.json artifact when a run dir is configured, and auto-diff
+        # against the directory's previous run (the regression sentinel)
+        run_report = _finalize_run_report(
+            recorder, holdout_metrics, train_data.num_rows,
+            run_dir if run_dir is not None else os.environ.get("TPTPU_RUN_DIR"),
+        )
 
         model = WorkflowModel(
             result_features=self.result_features,
@@ -506,12 +581,50 @@ class Workflow:
             attribution_profiles=attribution_profiles,
             dist_summary=dist_summary,
             analysis=preflight_report.to_json(),
+            run_report=run_report,
         )
         if selector is not None:
             # keep the live evaluator object so custom evaluators keep working
             # on the in-memory model (the name in selector_info covers load)
             model._live_evaluator = selector.evaluator
         return model
+
+
+def _finalize_run_report(
+    recorder: "_runlog.RunRecorder",
+    holdout_metrics: dict[str, Any] | None,
+    train_rows: int,
+    run_dir: str | None,
+) -> dict[str, Any] | None:
+    """Freeze the flight recorder into its RunReport; with a run dir,
+    diff against the directory's latest run FIRST (the regression verdict
+    rides inside the new artifact), then persist ``RUN_*.json``. Contained:
+    a capture failure degrades to ``run_report=None``, never a failed
+    train."""
+    try:
+        recorder.record_quality(holdout_metrics)
+        report = recorder.finalize(train_rows=train_rows)
+        if run_dir:
+            baseline = _runlog.latest_run_report(run_dir)
+            if baseline is not None:
+                diff = _runlog.diff_runs(baseline, report)
+                report["run"]["regression"] = {
+                    "baselineRunId": (baseline.get("run") or {}).get("runId"),
+                    "baselineFile": (baseline.get("run") or {}).get("file"),
+                    "findings": [f.to_json() for f in diff.findings],
+                }
+                if diff.findings:
+                    log.warning(
+                        "train run regressed vs %s:\n%s",
+                        (baseline.get("run") or {}).get("file", "<baseline>"),
+                        diff.pretty(),
+                    )
+            path = _runlog.save_run_report(report, run_dir)
+            log.info("run report written: %s", path)
+        return report
+    except Exception as e:  # observability must never fail a train
+        log.warning("run report capture failed: %s", e)
+        return None
 
 
 def _attribution_baseline(
@@ -617,6 +730,7 @@ class WorkflowModel:
         attribution_profiles: dict[str, Any] | None = None,
         dist_summary: dict[str, Any] | None = None,
         analysis: dict[str, Any] | None = None,
+        run_report: dict[str, Any] | None = None,
     ):
         self.result_features = result_features
         self.raw_features = raw_features
@@ -645,6 +759,11 @@ class WorkflowModel:
         #: analysis.Report — findings that survived as warnings/info);
         #: None on models saved before the analysis plane existed
         self.analysis = analysis
+        #: training-run flight-recorder report (telemetry/runlog.py):
+        #: per-phase/layer/fold timings, ledger deltas, runtime transfer
+        #: census, device-memory high-water; None on models saved before
+        #: the run ledger existed (or when capture degraded)
+        self.run_report = run_report
 
     # --------------------------------------------------------- persistence
     def save(self, path: str) -> None:
@@ -797,6 +916,7 @@ class WorkflowModel:
             "stageMetadata": stage_meta,
             "distributedResilience": self.dist_summary,
             "analysis": self.analysis,
+            "run": getattr(self, "run_report", None),
         }
 
     def summary_pretty(self) -> str:
@@ -931,8 +1051,11 @@ class WorkflowModel:
                     ))
                     ilines.append("")
                 lines.extend(ilines)  # all-or-nothing: no dangling headers
-            except Exception as e:  # insights are best-effort here
-                log.debug("summary_pretty insights skipped: %s", e)
+            except Exception as e:  # insights stay best-effort, but a
+                # broken section must be observable, not invisible:
+                # counted on the run ledger + a summary_degraded event +
+                # a one-shot warning (was a silent debug-level swallow)
+                _report_summary_degraded("insights", e)
         comp = (sel or {}).get("compileStats") or {}
         if comp.get("programsCompiled") or comp.get("cacheHitsMemory") or \
                 comp.get("cacheHitsDisk") or comp.get("dedupHits"):
@@ -1017,6 +1140,9 @@ class WorkflowModel:
         serve = self._serving_resilience_line()
         if serve:
             lines.append(serve)
+        run_line = self._run_report_lines()
+        if run_line:
+            lines.extend(run_line)
         # one consolidated telemetry line (span/event counts + serve
         # latency quantiles) pointing at the full export surfaces
         try:
@@ -1045,6 +1171,53 @@ class WorkflowModel:
             f"{len(s['rawFeatures'])} raw features"
         )
         return "\n".join(lines)
+
+    def _run_report_lines(self) -> list[str]:
+        """The flight recorder's summary lines: one "Run report:" line
+        (wall, phases, layers, transfer census, device high-water, the
+        artifact file when persisted) plus a regression line when the
+        auto-diff against the run dir's previous run found TPR findings."""
+        report = getattr(self, "run_report", None) or {}
+        run = report.get("run") or {}
+        if not run:
+            return []
+        lines: list[str] = []
+        phases = run.get("phases") or {}
+        phase_s = ", ".join(
+            f"{name} {cell.get('seconds', 0.0):.2f}s"
+            for name, cell in phases.items()
+        )
+        census = run.get("transferCensus") or {}
+        h2d = census.get("hostToDevice") or {}
+        d2h = census.get("deviceToHost") or {}
+        mem = run.get("deviceMemory") or {}
+        line = (
+            f"Run report: {run.get('wallSeconds', 0.0):.2f}s wall"
+            + (f" ({phase_s})" if phase_s else "")
+            + f", {len(run.get('layers') or [])} layer(s), "
+            f"h2d {h2d.get('count', 0)}x/{h2d.get('bytes', 0):,} B, "
+            f"d2h {d2h.get('count', 0)}x/{d2h.get('bytes', 0):,} B, "
+            f"device high-water {mem.get('highWaterBytes', 0):,} B "
+            f"({mem.get('backend', '?')})"
+        )
+        if run.get("file"):
+            line += f" — {run['file']}"
+        lines.append(line)
+        regression = run.get("regression") or {}
+        findings = regression.get("findings") or []
+        if findings:
+            codes: dict[str, int] = {}
+            for f in findings:
+                codes[f["code"]] = codes.get(f["code"], 0) + 1
+            code_s = ", ".join(
+                f"{c}×{n}" if n > 1 else c for c, n in sorted(codes.items())
+            )
+            lines.append(
+                f"Run regression: {len(findings)} finding(s) vs "
+                f"{regression.get('baselineFile', 'previous run')} "
+                f"({code_s}) — see docs/observability.md"
+            )
+        return lines
 
     def _serving_resilience_line(self) -> str | None:
         """Aggregate serve-side counters from every live score function
