@@ -209,6 +209,23 @@ def validate_reports(root: str | None = None) -> int:
     return bad
 
 
+
+def median_timed(call, reps: int = 5, warmups: int = 1) -> float:
+    """The ONE timing convention for bench measurements: ``warmups``
+    untimed calls (program/bucket warm for this shape), then the median
+    of ``reps`` timed calls — a single draw right after other work lands
+    in whatever host/tunnel state that work left behind (measured 2x
+    swings with identical code)."""
+    for _ in range(warmups):
+        call()
+    ts = []
+    for _ in range(reps):
+        t = time.perf_counter()
+        call()
+        ts.append(time.perf_counter() - t)
+    return sorted(ts)[len(ts) // 2]
+
+
 def _telemetry_phase_breakdown() -> dict:
     """Span-derived ingest/featurize/compile/fit/eval seconds (telemetry
     plane); empty when telemetry is disabled."""
@@ -329,23 +346,11 @@ def bench_titanic() -> dict:
         f(r)
         lat.append(time.perf_counter() - t2)
     lat.sort()
-    def _median_batch_s(call) -> float:
-        """Median of 5 timed calls after one warmup — a single draw right
-        after the train reps lands in whatever host/tunnel state they left
-        behind (measured 2x swings with identical code)."""
-        call()
-        ts = []
-        for _ in range(5):
-            t = time.perf_counter()
-            call()
-            ts.append(time.perf_counter() - t)
-        return sorted(ts)[len(ts) // 2]
-
-    batch_s = _median_batch_s(lambda: f.batch(rows))
+    batch_s = median_timed(lambda: f.batch(rows))
     # columnar batch (fn.columns): dataset in, columns out — the direct
     # analog of sklearn pipeline.predict(dataframe), which also takes
     # columnar input and returns arrays (no per-value row-dict codec)
-    cols_s = _median_batch_s(lambda: f.columns(ds))
+    cols_s = median_timed(lambda: f.columns(ds))
     chk = checked.origin_stage.metadata.get("sanityCheckerSummary", {})
     return {
         "train_s": train_s,
@@ -982,19 +987,12 @@ def bench_explain(
     reps = -(-rows // len(sample))
     batch = [dict(r) for r in (sample * reps)[:rows]]
 
-    def _median(call) -> float:
-        call()  # warm the bucket/program for this shape
-        ts = []
-        for _ in range(median_of):
-            t = time.perf_counter()
-            call()
-            ts.append(time.perf_counter() - t)
-        return sorted(ts)[len(ts) // 2]
-
-    plain_s = _median(lambda: fn.batch(batch))
+    plain_s = median_timed(lambda: fn.batch(batch), reps=median_of)
     attr_before = attr_ledger.snapshot()
     compile_before = cstats.snapshot()
-    explain_s = _median(lambda: fn.batch(batch, explain=k))
+    explain_s = median_timed(
+        lambda: fn.batch(batch, explain=k), reps=median_of
+    )
     attr_delta = attr_ledger.delta(attr_before)
     compile_delta = cstats.delta(compile_before)
     plain_rps = rows / plain_s
@@ -1038,6 +1036,153 @@ def bench_explain(
         attribution_ledger=attr_delta,
         attribution_drift_enabled=md["drift"]["enabled"],
     )
+
+
+def bench_serve_fused(
+    rows: int = 2048,
+    k: int = 3,
+    median_of: int = 5,
+) -> dict:
+    """Fused-vs-staged serving A/B (ROADMAP item 1): score the SAME
+    batch through the fused end-to-end scoring graph (compiler/fused.py —
+    one donated XLA dispatch per batch) and through the staged loop
+    (``TPTPU_FUSED=0``), same closure, same seed, same rows.
+
+    The headline is the fused/staged throughput ratio — the
+    machine-independent witness of the boundary cost the fused graph
+    removes (on a tunneled TPU the staged path pays a host featurize +
+    upload + download per batch; on CPU the two backends share silicon,
+    so the CPU ratio is the floor, not the hardware story). The report
+    also carries the max fused-vs-staged probability delta (parity), the
+    reconciled runtime-vs-static transfer census ("uploads only at
+    ingest, downloads only at render"), the audit's TPX codes, the fused
+    compile-ledger delta, and ``serve_batch_vs_sklearn`` against the
+    BASELINE_CPU sklearn serving anchor."""
+    from transmogrifai_tpu.compiler import stats as cstats
+    from transmogrifai_tpu.local.scoring import score_function
+    from transmogrifai_tpu.telemetry import runlog as rl
+
+    prev_cutoff = os.environ.get("TPTPU_HOST_PREDICT_MAX")
+    prev_fused = os.environ.get("TPTPU_FUSED")
+    # bench batches must be in the device regime — that is the steady
+    # state the fused graph exists for
+    os.environ["TPTPU_HOST_PREDICT_MAX"] = "0"
+    try:
+        model, sample = _serve_loadtest_model()
+        fn = score_function(model)
+        reps = -(-rows // len(sample))
+        batch = [dict(r) for r in (sample * reps)[:rows]]
+
+        fused_available = fn.prime_fused()
+        # warm BOTH paths (and the explain program) before any timing:
+        # the first fused dispatch kicks off a background executable save
+        # whose serialization must not contend with a timed rep
+        for _ in range(2):
+            fn.batch(batch)
+            fn.batch(batch, explain=k)
+        os.environ["TPTPU_FUSED"] = "0"
+        try:
+            for _ in range(2):
+                fn.batch(batch)
+        finally:
+            os.environ.pop("TPTPU_FUSED", None)
+        fused_s = median_timed(
+            lambda: fn.batch(batch), reps=median_of, warmups=0
+        )
+        explain_s = median_timed(
+            lambda: fn.batch(batch, explain=k), reps=median_of, warmups=0
+        )
+        # census: one steady-state batch, squared against the static audit
+        census_before = rl.snapshot()
+        compile_before = cstats.snapshot()
+        fused_out = fn.batch(batch)
+        census = rl.delta(census_before)
+        compile_delta = cstats.delta(compile_before)
+        audit = fn.audit().to_json()
+        static = audit["transferCensus"]
+        rec = rl.reconcile_transfer_census(
+            census, static, rows=rows, batches=1, check_uploads=True
+        )
+        os.environ["TPTPU_FUSED"] = "0"
+        try:
+            staged_s = median_timed(
+                lambda: fn.batch(batch), reps=median_of, warmups=0
+            )
+            staged_out = fn.batch(batch)
+        finally:
+            os.environ.pop("TPTPU_FUSED", None)
+        key = next(iter(fused_out[0]))
+        score_key = (
+            "probability_1"
+            if "probability_1" in fused_out[0][key] else "prediction"
+        )
+        parity = max(
+            abs(a[key][score_key] - b[key][score_key])
+            for a, b in zip(fused_out, staged_out)
+        )
+        fused_rps = rows / fused_s
+        staged_rps = rows / staged_s
+        explain_rps = rows / explain_s
+        skl = _cpu_workload_baseline("serving")
+        vs_skl = (
+            round(fused_rps / skl["batch_rows_per_sec"], 4) if skl else None
+        )
+        md = fn.metadata()["fused"]
+        return make_bench_report(
+            metric="serve_fused_vs_staged_throughput",
+            value=round(fused_rps / staged_rps, 4),
+            unit="x staged-loop rows/s (same closure, TPTPU_FUSED A/B)",
+            seed=17,  # _serve_loadtest_model's fixed flow seed
+            median_of=median_of,
+            metrics={
+                "fused_rows_per_sec": round(fused_rps),
+                "staged_rows_per_sec": round(staged_rps),
+                "fused_vs_staged": round(fused_rps / staged_rps, 4),
+                "explain_rows_per_sec": round(explain_rps),
+                "serve_batch_vs_sklearn": vs_skl,
+                "sklearn_baseline_rows_per_sec": (
+                    skl["batch_rows_per_sec"] if skl else None
+                ),
+                "rows": rows,
+                "top_k": k,
+                "fused_available": bool(fused_available),
+                "fused_dispatches": md["dispatches"],
+                "fused_fallbacks": md["fallbacks"],
+                "compile_fused_dispatches": compile_delta[
+                    "fusedDispatches"
+                ],
+                "max_score_delta_vs_staged": float(parity),
+                "census_reconciled": bool(rec["consistent"]),
+                "census_h2d_per_batch": census["h2dTransfers"],
+                "census_d2h_per_batch": census["d2hTransfers"],
+                "census_up_bytes_per_row": static["upBytesPerRow"],
+                "census_down_bytes_per_row": static["downBytesPerRow"],
+                "audit_tpx002_clean": not any(
+                    f["code"] == "TPX002" for f in audit["findings"]
+                ),
+                "audit_tpx008_clean": not any(
+                    f["code"] == "TPX008" for f in audit["findings"]
+                ),
+            },
+            config=(
+                f"synthetic Real+Real+PickList LR flow (512 fit rows), "
+                f"{rows}-row batch, fused graph = one donated XLA "
+                f"dispatch (ingest codecs up, predictor core down) vs "
+                f"the staged loop on the same closure; sklearn anchor = "
+                f"BASELINE_CPU 'serving' (titanic RF pipeline, "
+                f"different flow — directional only)"
+            ),
+            fused_program=audit.get("fusedProgram"),
+        )
+    finally:
+        if prev_cutoff is None:
+            os.environ.pop("TPTPU_HOST_PREDICT_MAX", None)
+        else:
+            os.environ["TPTPU_HOST_PREDICT_MAX"] = prev_cutoff
+        if prev_fused is None:
+            os.environ.pop("TPTPU_FUSED", None)
+        else:
+            os.environ["TPTPU_FUSED"] = prev_fused
 
 
 def _build_parser():
@@ -1145,6 +1290,32 @@ def _build_parser():
     ex.add_argument(
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH (the BENCH_r07.json "
+             "regression shape)",
+    )
+    sf = sub.add_parser(
+        "serve-fused",
+        help=(
+            "fused-vs-staged serving A/B: the end-to-end fused scoring "
+            "graph (one donated XLA dispatch per batch) against the "
+            "staged loop on the same closure — throughput ratio, score "
+            "parity, reconciled transfer census"
+        ),
+    )
+    sf.add_argument(
+        "--rows", type=int, default=2048,
+        help="batch size to score per rep (default 2048)",
+    )
+    sf.add_argument(
+        "--k", type=int, default=3,
+        help="top-k for the explain-enabled fused measurement (default 3)",
+    )
+    sf.add_argument(
+        "--median-of", type=int, default=5,
+        help="timed reps per measurement, median reported (default 5)",
+    )
+    sf.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the JSON report to PATH (the BENCH_r08.json "
              "regression shape)",
     )
     return p
@@ -1310,6 +1481,14 @@ def _dispatch(ns) -> None:
     if mode == "explain":
         dump_bench_report(
             bench_explain(rows=ns.rows, k=ns.k, median_of=ns.median_of),
+            ns.out, echo=True,
+        )
+        return
+    if mode == "serve-fused":
+        dump_bench_report(
+            bench_serve_fused(
+                rows=ns.rows, k=ns.k, median_of=ns.median_of
+            ),
             ns.out, echo=True,
         )
         return

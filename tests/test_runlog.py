@@ -585,7 +585,13 @@ def test_recorder_overhead_under_two_percent(flagship):
     attributed = (
         n_layers * per_layer + n_phases * per_phase + n_polls * per_poll
     )
-    assert attributed < 0.02 * flagship["wall"], (
+    # absolute floor, the RunTolerances.phase_min_seconds pattern: when
+    # every program the flagship needs is already warm from earlier
+    # suites the train collapses to tens of milliseconds, and 2% of a
+    # 40 ms train is below the recorder's fixed per-pulse cost — a bound
+    # about warm-cache luck, not recorder overhead. The relative bound
+    # still governs any train above 1.25 s (every cold/real one).
+    assert attributed < max(0.02 * flagship["wall"], 0.025), (
         f"recorder overhead {attributed:.4f}s on a "
         f"{flagship['wall']:.2f}s train ({n_layers} layers, "
         f"{n_phases} phases, {n_polls} polls)"

@@ -52,6 +52,8 @@ CODES: dict[str, str] = {
     "TPX007": "predictor feature vector carries no usable provenance "
               "metadata — LOCO attributions degrade to anonymous "
               "per-column groups",
+    "TPX008": "fused scoring graph unavailable or degraded — steady-state "
+              "batches fall back to the staged loop",
     # ---- TPR: cross-run regression sentinel (telemetry/runlog.py)
     "TPR001": "training phase slowed beyond tolerance between runs",
     "TPR002": "compiled-program count blew up between runs",

@@ -103,11 +103,22 @@ def audit_serving_plan(
     fusion=None,
     bucketed: bool = True,
     host_predict_max: int | None = None,
+    fused=None,
+    fused_reason: str | None = None,
+    fused_counters: dict | None = None,
 ) -> Report:
     """Audit an ordered fitted stage ``plan``. ``bucketed`` states whether
     the caller pads batches onto power-of-two buckets before dispatch
     (the serving closure does; raw ``WorkflowModel.score`` does not).
-    ``fusion`` is the plan's FusionPlanner, source of learned widths."""
+    ``fusion`` is the plan's FusionPlanner, source of learned widths.
+
+    ``fused`` is the closure's compiled
+    :class:`~transmogrifai_tpu.compiler.fused.FusedServingProgram` (or
+    None): when present, its covered stages audit as device-placed, the
+    census states the fused two-crossing contract (ONE ingest upload, ONE
+    render download per batch), and the fused module joins the TPX003
+    donation scan. ``fused_reason`` (why no program) and
+    ``fused_counters`` (runtime dispatch/fallback counts) feed TPX008."""
     report = Report()
     cutoff = (
         int(os.environ.get("TPTPU_HOST_PREDICT_MAX", str(_HOST_PREDICT_MAX)))
@@ -115,6 +126,8 @@ def audit_serving_plan(
         else host_predict_max
     )
 
+    fused_covered = frozenset() if fused is None else fused.covered
+    fused_widths = {} if fused is None else fused.static_widths
     widths: dict[str, int | None] = {}
     placement: dict[str, str] = {}  # output name -> "host" | "device"
     census_stages: list[dict[str, Any]] = []
@@ -128,11 +141,16 @@ def audit_serving_plan(
     for t in plan:
         family = _classify(t)
         out_name = t.output_name
+        in_fused = out_name in fused_covered
         width: int | None = None
         if family == "predictor":
             width = 1
         else:
             width = _width_of(t, fusion)
+            if width is None and in_fused:
+                # the fused build proved widths statically from the
+                # member specs — no first batch needed
+                width = fused_widths.get(out_name)
             if width is None and family == "combiner":
                 member_ws = [widths.get(nm) for nm in t.input_names]
                 if all(w is not None for w in member_ws):
@@ -141,7 +159,7 @@ def audit_serving_plan(
                 unknown_widths.append(out_name)
         widths[out_name] = width
 
-        device = family == "predictor"
+        device = family == "predictor" or in_fused
         placement[out_name] = "device" if device else "host"
         entry: dict[str, Any] = {
             "stage": t.operation_name,
@@ -150,7 +168,9 @@ def audit_serving_plan(
             "width": width,
             "placement": placement[out_name],
         }
-        if device:
+        if in_fused:
+            entry["fused"] = True
+        if family == "predictor" and not in_fused:
             in_name = t.input_names[-1] if t.input_names else None
             in_w = widths.get(in_name)
             up = None if in_w is None else in_w * 4  # f32 feature plane
@@ -170,6 +190,14 @@ def audit_serving_plan(
             down_bytes_per_row += down
         census_stages.append(entry)
 
+    if fused is not None:
+        # the fused program's whole-segment contract: ingest codecs cross
+        # once, the predictor core crosses back once — per batch
+        h2d += 1
+        d2h += 1
+        up_bytes_per_row += fused.up_bytes_per_row
+        down_bytes_per_row += fused.down_bytes_per_row
+
     # ---- transfer census (report attachment, not a finding)
     report.data["transferCensus"] = {
         "resultFeatures": [str(nm) for nm in result_names],
@@ -180,7 +208,10 @@ def audit_serving_plan(
         "downBytesPerRow": down_bytes_per_row,
         "hostPredictCutoffRows": cutoff,
         "batchBucketed": bool(bucketed),
+        "fusedProgram": fused is not None,
     }
+    if fused is not None:
+        report.data["fusedProgram"] = fused.describe()
 
     # ---- TPX007: predictor feature plane without usable provenance —
     # LOCO explanations would silently degrade to anonymous per-column
@@ -287,6 +318,28 @@ def audit_serving_plan(
             severity=Severity.INFO,
         )
 
+    # ---- TPX008: fused path unavailable / runtime degradations
+    if fused is None and fused_reason is not None:
+        report.add(
+            "TPX008",
+            "fused scoring graph unavailable — steady-state batches run "
+            f"the staged loop ({fused_reason})",
+            subject="plan",
+            severity=Severity.INFO,
+        )
+    fallbacks = int((fused_counters or {}).get("fallbacks", 0))
+    if fallbacks > 0:
+        last = (fused_counters or {}).get("lastFallback")
+        report.add(
+            "TPX008",
+            f"{fallbacks} batch(es) degraded from the fused graph to the "
+            "staged loop at dispatch time"
+            + (f" (last: {last})" if last else ""),
+            subject="plan",
+            severity=Severity.WARNING,
+            fallbacks=fallbacks,
+        )
+
     # ---- TPX003: donated-buffer reuse in the modules behind the plan
     modules = set()
     for t in plan:
@@ -294,6 +347,10 @@ def audit_serving_plan(
             mod = type(t).__module__
             if mod.startswith("transmogrifai_tpu"):
                 modules.add(mod)
+    if fused is not None:
+        # the fused dispatch donates its ingest buffers — its module is
+        # exactly the bug surface TPX003 exists for
+        modules.add("transmogrifai_tpu.compiler.fused")
     for mod in sorted(modules):
         report.extend(donation_misuse_module(mod))
     return report

@@ -17,7 +17,12 @@ package is the one place that cost is managed:
   sweeps reuse one executable;
 * :mod:`.dispatch` — donated-buffer dispatch (backend-aware ``jit`` twins
   with ``donate_argnums``) and the transfer-prefetch seam that overlaps
-  device uploads for layer k+1 with layer k's host work.
+  device uploads for layer k+1 with layer k's host work;
+* :mod:`.fused` — the fused end-to-end scoring graph: the fitted serving
+  plan (member vectorizers + plane assembly + feature removal + model
+  predict) compiled into ONE donated, bucketed XLA dispatch per
+  steady-state batch, with a counted staged-loop fallback (TPX008). See
+  docs/tpu.md "The fused scoring graph".
 
 The persistent on-disk program cache itself lives in ``utils/aot.py``
 (``aot_call`` / ``prewarm``); every model family and the serving path route

@@ -34,6 +34,12 @@ _COUNTER_KEYS = (
     "versionInvalidations",  # blobs dropped for a source/backend change
     "savesFailed",           # background executable saves that errored
     "warmupPrograms",        # executables loaded by the async warmup thread
+    "fusedDispatches",       # steady-state batches scored as ONE fused
+                             # donated XLA dispatch (compiler/fused.py)
+    "fusedExplainLanes",     # LOCO perturbation lanes that rode a fused
+                             # dispatch (in-graph, no separate sweep)
+    "fusedFallbacks",        # batches that degraded from the fused graph
+                             # to the staged loop (TPX008 in the audit)
 )
 
 
@@ -67,6 +73,18 @@ class CompileStats(_tm.LedgerCore):
             if padded > 0:
                 self._counts["laneBucketPads"] += padded
                 self._counts["bucketedSweeps"] += 1
+
+    def record_fused(self, lanes: int = 0) -> None:
+        """One fused serving dispatch (``lanes`` > 0 when LOCO explain
+        lanes rode the same program)."""
+        with self._lock:
+            self._counts["fusedDispatches"] += 1
+            if lanes > 0:
+                self._counts["fusedExplainLanes"] += lanes
+
+    def record_fused_fallback(self) -> None:
+        with self._lock:
+            self._counts["fusedFallbacks"] += 1
 
     def record_warmup(self, programs: int, overlap_s: float) -> None:
         with self._lock:

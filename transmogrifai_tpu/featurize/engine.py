@@ -120,6 +120,14 @@ class FusionPlanner:
             u in self.widths for u in self.member_uids
         )
 
+    def plane_width(self) -> int | None:
+        """Total [N, width] plane width once every member width is known
+        (the fused scoring graph cross-checks its statically-derived
+        widths against this)."""
+        if not self.ready():
+            return None
+        return sum(self.widths[u] for u in self.member_uids)
+
     # ------------------------------------------------------------- batches
     def batch(self, num_rows: int) -> "_BatchContext":
         return _BatchContext(self, num_rows)
@@ -134,7 +142,7 @@ class _BatchContext:
     def __enter__(self):
         p = self.planner
         if p.ready():
-            total = sum(p.widths[u] for u in p.member_uids)
+            total = p.plane_width()
             layout = {}
             off = 0
             for u in p.member_uids:

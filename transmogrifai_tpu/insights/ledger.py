@@ -51,6 +51,9 @@ _COUNTER_KEYS = (
     "attributionDriftAlerts",  # fresh attribution-drift alerts (model-
                              # behavior drift, not input drift)
     "profilesCaptured",      # train-time baseline attribution profiles
+    "explainBudgetSkips",    # fused-graph explain sweeps skipped because
+                             # lanes x rows x width exceeded the lane
+                             # budget for a single dispatch (scores kept)
 )
 
 
@@ -116,6 +119,9 @@ class AttributionStats(_tm.LedgerCore):
 
     def count_deadline_skip(self) -> None:
         self.bump("explainDeadlineSkips")
+
+    def count_budget_skip(self) -> None:
+        self.bump("explainBudgetSkips")
 
     def count_error(self) -> None:
         self.bump("explainErrors")

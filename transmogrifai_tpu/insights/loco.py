@@ -124,6 +124,49 @@ def _floor_lane_bucket(k: int) -> int:
     return b
 
 
+def base_from_arrays(
+    prob: np.ndarray | None, pred: np.ndarray | None
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """(base score, base class) from already-rendered prediction arrays —
+    the probability of each row's OWN predicted class for classifiers,
+    the prediction itself for regressors. Shared by the staged sweep and
+    the fused graph's in-dispatch lanes."""
+    if prob is not None:
+        prob = np.asarray(prob)
+        base_class = np.argmax(prob, axis=1)
+        rows = np.arange(len(prob))
+        return prob[rows, base_class].astype(np.float64), base_class
+    return np.asarray(pred, dtype=np.float64), None
+
+
+def scores_from_outputs(
+    pred_p: np.ndarray | None,
+    prob_p: np.ndarray | None,
+    base_class: np.ndarray | None,
+    lanes: int,
+    n: int,
+) -> np.ndarray:
+    """[lanes, N] perturbed scores tracked against each row's BASE class
+    (so perturbed scores of different classes are never compared) — the
+    one place the lane-output → score convention lives."""
+    if prob_p is not None and base_class is not None:
+        return prob_p.reshape(lanes, n, -1)[:, np.arange(n), base_class]
+    return np.asarray(pred_p, dtype=np.float64).reshape(lanes, n)
+
+
+def group_masks(
+    groups: list[tuple[str, list[int]]], width: int, lanes: int | None = None
+) -> np.ndarray:
+    """[lanes, width] f32 column masks for the in-graph sweep: lane g is
+    1.0 on group g's column slice. Rows beyond ``len(groups)`` (bucket
+    padding) stay all-zero — an unperturbed plane whose diff is exactly
+    0, sliced off by the caller."""
+    out = np.zeros((lanes or len(groups), width), dtype=np.float32)
+    for g, (_, idxs) in enumerate(groups):
+        out[g, idxs] = 1.0
+    return out
+
+
 def _base_scores(
     model: PredictorModel,
     x: np.ndarray,
@@ -135,18 +178,10 @@ def _base_scores(
     perturbed scores of different classes are never compared). Callers
     that already hold the batch's PredictionColumn pass its arrays in and
     skip the extra base dispatch."""
-    if base_prob is not None:
-        base_class = np.argmax(base_prob, axis=1)
-        rows = np.arange(len(base_prob))
-        return base_prob[rows, base_class].astype(np.float64), base_class
-    if base_pred is not None:
-        return np.asarray(base_pred, dtype=np.float64), None
+    if base_prob is not None or base_pred is not None:
+        return base_from_arrays(base_prob, base_pred)
     pred, prob, _ = model.predict_arrays(x)
-    if prob is None:
-        return np.asarray(pred, dtype=np.float64), None
-    base_class = prob.argmax(axis=1)
-    rows = np.arange(len(prob))
-    return prob[rows, base_class].astype(np.float64), base_class
+    return base_from_arrays(prob, pred)
 
 
 def explain_batch(
@@ -200,7 +235,6 @@ def explain_batch(
     per_chunk = _floor_lane_bucket(
         max(1, _lane_budget() // max(1, n * dim))
     )
-    rows = np.arange(n)
     for start in range(0, len(live), per_chunk):
         chunk = live[start:start + per_chunk]
         k = len(chunk)
@@ -213,10 +247,7 @@ def explain_batch(
         pred_p, prob_p, _ = model.predict_arrays(
             plane.reshape(kb * n, dim)
         )
-        if prob_p is not None and base_class is not None:
-            scores = prob_p.reshape(kb, n, -1)[:, rows, base_class]
-        else:
-            scores = np.asarray(pred_p, dtype=np.float64).reshape(kb, n)
+        scores = scores_from_outputs(pred_p, prob_p, base_class, kb, n)
         for lane, g in enumerate(chunk):
             diffs[:, g] = base - scores[lane]
         cstats.stats().record_sweep(lanes=k, padded=pad)

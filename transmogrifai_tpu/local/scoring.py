@@ -58,7 +58,13 @@ from ..resilience.sentinel import (
     SchemaSentinel,
     SchemaViolationError,
 )
-from ..types.columns import column_from_values, concat_columns, empty_like
+from ..types import Prediction
+from ..types.columns import (
+    PredictionColumn,
+    column_from_values,
+    concat_columns,
+    empty_like,
+)
 from ..workflow.workflow import WorkflowModel
 
 log = logging.getLogger(__name__)
@@ -281,6 +287,144 @@ def score_function(
         getattr(model, "attribution_profiles", None)
     )
 
+    # ---- fused scoring graph (compiler/fused.py): the steady-state batch
+    # path above the host-predict cutoff compiles the member vectorizers,
+    # the combiner plane, the SanityChecker gathers, and the model predict
+    # into ONE donated XLA dispatch — host ingest codecs up, predictor
+    # core down, nothing else crosses the boundary. Unfuseable plans and
+    # dispatch-time errors degrade to the staged loop below, counted
+    # (fusedFallbacks / TPX008) and evented.
+    #: ``reason`` holds the BUILD obstruction only (Unfuseable message /
+    #: build error) — the dynamic TPTPU_FUSED=0 opt-out is derived in
+    #: ``_fused_reason`` so flipping the env never erases it. The lock
+    #: brackets build-once and the counter read-modify-writes: service
+    #: workers share ONE closure, and a worker observing ``built`` before
+    #: ``program`` publishes (or a torn ``+=``) would silently run staged
+    #: / undercount the TPX008 fallbacks.
+    fused_holder: dict[str, Any] = {
+        "program": None, "built": False, "reason": None,
+    }
+    fused_counters: dict[str, Any] = {
+        "dispatches": 0, "fallbacks": 0, "lastFallback": None,
+        "consecutiveErrors": 0,
+    }
+    _fused_lock = threading.Lock()
+    #: consecutive dispatch errors that disable the fused program for this
+    #: closure — a deterministically-broken program must not re-pay a
+    #: failed trace (and a warning) on EVERY steady-state batch
+    _FUSED_MAX_CONSECUTIVE_ERRORS = 3
+
+    def _fused_reason() -> str | None:
+        if os.environ.get("TPTPU_FUSED", "1") == "0":
+            return "TPTPU_FUSED=0"
+        return fused_holder["reason"]
+
+    def _fused_program():
+        """The compiled fused serving program, or None (opt-out /
+        unfuseable plan shape — see ``_fused_reason``). The build is
+        static — it traces/compiles nothing until the first dispatch."""
+        if os.environ.get("TPTPU_FUSED", "1") == "0":
+            return None
+        with _fused_lock:
+            if not fused_holder["built"]:
+                from ..compiler import fused as _fused
+
+                try:
+                    fused_holder["program"] = _fused.build_fused_plan(
+                        plan, raw_features, result_names, fusion=fusion
+                    )
+                    log.info(
+                        "fused scoring graph ready (%s): %d member(s), "
+                        "plane width %d -> %d",
+                        fused_holder["program"].fingerprint,
+                        len(fused_holder["program"].members),
+                        fused_holder["program"].plane_width,
+                        fused_holder["program"].width,
+                    )
+                except _fused.Unfuseable as e:
+                    fused_holder["reason"] = str(e)
+                    log.info("fused scoring graph unavailable: %s", e)
+                except Exception as e:  # defensive — never break builds
+                    fused_holder["reason"] = f"{type(e).__name__}: {e}"
+                    log.warning(
+                        "fused scoring graph build failed", exc_info=True
+                    )
+                fused_holder["built"] = True
+            return fused_holder["program"]
+
+    def _count_fused_dispatch() -> None:
+        with _fused_lock:
+            fused_counters["dispatches"] += 1
+            fused_counters["consecutiveErrors"] = 0
+
+    def _count_fused_fallback(reason: str, exc: Exception | None = None):
+        from ..compiler import stats as cstats
+
+        disabled = False
+        with _fused_lock:
+            fused_counters["fallbacks"] += 1
+            fused_counters["lastFallback"] = reason
+            if reason == "dispatch_error":
+                fused_counters["consecutiveErrors"] += 1
+                if (
+                    fused_counters["consecutiveErrors"]
+                    >= _FUSED_MAX_CONSECUTIVE_ERRORS
+                    and fused_holder["program"] is not None
+                ):
+                    # a program failing every batch is broken, not
+                    # unlucky: stop retrying (each retry re-pays a failed
+                    # trace), keep the staged loop, and say so in the
+                    # audit (TPX008 reason)
+                    fused_holder["program"] = None
+                    fused_holder["reason"] = (
+                        f"disabled after "
+                        f"{fused_counters['consecutiveErrors']} "
+                        f"consecutive dispatch errors (last: "
+                        f"{type(exc).__name__ if exc else reason})"
+                    )
+                    disabled = True
+        cstats.stats().record_fused_fallback()
+        _tevents.emit("fused_fallback", reason=reason)
+        log.warning(
+            "fused dispatch degraded to the staged loop (%s%s)%s",
+            reason,
+            "" if exc is None else f": {type(exc).__name__}: {exc}",
+            " — fused program disabled for this closure" if disabled
+            else "",
+        )
+
+    def _explain_gate(m: int, led) -> bool:
+        """The shed/deadline gates shared by the staged sweep and the
+        fused in-graph lanes; False = attributions degrade for this batch
+        (typed and counted — scores are never affected)."""
+        # shed tier 1 (serving/shedding.py): explain work is the FIRST
+        # casualty of overload — cheaper to drop than detail spans, drift
+        # windows, or admissions
+        if _sshed.explain_shed():
+            led.count_shed(m)
+            _tm.REGISTRY.counter("tptpu_serve_explain_shed_total").inc(m)
+            return False
+        # deadline accounting: the explain family has its own p95 in the
+        # serve-latency histograms; a request whose remaining budget
+        # cannot cover it keeps its SCORES and drops the explanations —
+        # a soft skip, unlike the hard stage-family checkpoints
+        bgt = _sdl.current()
+        if bgt is not None:
+            required = _sdl.family_p95("explain")
+            remaining = bgt.remaining()
+            if remaining <= 0.0 or remaining < required:
+                led.count_deadline_skip()
+                _tm.REGISTRY.counter(
+                    "tptpu_serve_explain_deadline_skips_total"
+                ).inc()
+                _tevents.emit(
+                    "explain_deadline_skip",
+                    remainingMs=round(remaining * 1e3, 3),
+                    requiredMs=round(required * 1e3, 3),
+                )
+                return False
+        return True
+
     def _run_explain(
         cols: dict[str, Any],
         m: int,
@@ -306,32 +450,8 @@ def score_function(
             or _explain_vec not in cols
         ):
             return None  # no healthy plane/prediction to explain against
-        # shed tier 1 (serving/shedding.py): explain work is the FIRST
-        # casualty of overload — cheaper to drop than detail spans, drift
-        # windows, or admissions
-        if _sshed.explain_shed():
-            led.count_shed(m)
-            _tm.REGISTRY.counter("tptpu_serve_explain_shed_total").inc(m)
+        if not _explain_gate(m, led):
             return None
-        # deadline accounting: the explain family has its own p95 in the
-        # serve-latency histograms; a request whose remaining budget
-        # cannot cover it keeps its SCORES and drops the explanations —
-        # a soft skip, unlike the hard stage-family checkpoints
-        bgt = _sdl.current()
-        if bgt is not None:
-            required = _sdl.family_p95("explain")
-            remaining = bgt.remaining()
-            if remaining <= 0.0 or remaining < required:
-                led.count_deadline_skip()
-                _tm.REGISTRY.counter(
-                    "tptpu_serve_explain_deadline_skips_total"
-                ).inc()
-                _tevents.emit(
-                    "explain_deadline_skip",
-                    remainingMs=round(remaining * 1e3, 3),
-                    requiredMs=round(required * 1e3, 3),
-                )
-                return None
         # explain is pure observability: from here on ANY failure (an
         # allocation error on the lane plane, an unexpected predict
         # error) degrades to attributions=None and a counter — it must
@@ -414,6 +534,158 @@ def score_function(
             count=count,
         )
 
+    def _fused_explain_request(prog, b: int, n: int) -> dict | None:
+        """Resolve column groups and build the in-graph lane masks for a
+        fused ``explain=k`` batch, honoring the shared shed/deadline
+        gates plus the lane budget (the fused sweep is ONE dispatch — a
+        sweep that cannot fit degrades attributions, never scores)."""
+        led = _attr_ledger.stats()
+        if not _explain_gate(n, led):
+            return None
+        resolved = _explain_state.get("resolved")
+        if resolved is None:
+            groups = _loco.column_groups(
+                prog.predictor_input_meta, prog.width
+            )
+            resolved = _explain_state["resolved"] = (
+                groups, [nm for nm, _ in groups]
+            )
+        groups, names = resolved
+        from ..compiler.bucketing import lane_bucket
+
+        kb = lane_bucket(len(groups))
+        if (kb + 1) * b * max(1, prog.width) > _loco._lane_budget():
+            led.count_budget_skip()
+            _tevents.emit(
+                "explain_budget_skip",
+                lanes=kb, rows=b, width=prog.width,
+            )
+            return None
+        return {
+            "masks": _loco.group_masks(groups, prog.width, lanes=kb),
+            "groups": groups, "names": names,
+            "kb": kb, "pad": kb - len(groups), "seconds": 0.0,
+        }
+
+    def _dispatch_fused(
+        prog, cols, b: int, n: int, explain_k: int,
+        fam_seconds, runinfo,
+    ) -> bool:
+        """The whole fused segment as ONE donated dispatch: ingest codecs
+        up, predictor core (plus in-graph explain lanes) down, host
+        epilogue shared with the staged path. Returns True when the batch
+        committed; any raise degrades to the staged loop (counted by the
+        caller)."""
+        lane_state = None
+        lane_masks = None
+        if explain_k:
+            lane_state = _fused_explain_request(prog, b, n)
+            if lane_state is not None:
+                lane_masks = lane_state["masks"]
+        ts = _tspans.clock()
+        core, lane_core, info = prog.run(cols, b, n, lane_masks)
+        pred, prob, raw = prog.epilogue(core)
+        pcol = PredictionColumn(
+            Prediction,
+            np.asarray(pred, dtype=np.float64),
+            None if prob is None else np.asarray(prob, dtype=np.float64),
+            None if raw is None else np.asarray(raw, dtype=np.float64),
+        )
+        cols[prog.predictor.output_name] = _guarded(
+            prog.predictor, pcol, n
+        )
+        _count_fused_dispatch()
+        dur = _tspans.clock() - ts
+        if fam_seconds is not None:
+            fam_seconds["dispatch"] = (
+                fam_seconds.get("dispatch", 0.0) + dur
+            )
+            if _tspans.stage_detail(n):
+                _tspans.record_span(
+                    "serve/fused", ts, dur, rows=n, lanes=info["lanes"]
+                )
+        if runinfo is not None:
+            runinfo["fused"] = True
+            if lane_state is not None and lane_core is not None:
+                # lane scores tracked against each row's base class —
+                # pure observability: a failure here degrades the
+                # attributions, never the already-rendered scores
+                try:
+                    t2 = _tspans.clock()
+                    lane_pred, lane_prob, _ = prog.epilogue(lane_core)
+                    base, base_class = _loco.base_from_arrays(prob, pred)
+                    scores = _loco.scores_from_outputs(
+                        lane_pred, lane_prob, base_class,
+                        lane_state["kb"], b,
+                    )
+                    diffs = (base[None, :] - scores).T
+                    runinfo["fused_diffs"] = np.ascontiguousarray(
+                        diffs[:, : len(lane_state["groups"])]
+                    )
+                    # only the MARGINAL host cost (lane epilogue) — the
+                    # dispatch itself is already charged to the dispatch
+                    # family above; double-charging it here would inflate
+                    # the explain family p95 the deadline gate budgets
+                    lane_state["seconds"] = _tspans.clock() - t2
+                    runinfo["fused_lane_state"] = lane_state
+                except Exception as e:
+                    _attr_ledger.stats().count_error()
+                    _tm.REGISTRY.counter(
+                        "tptpu_serve_explain_errors_total"
+                    ).inc()
+                    log.warning(
+                        "fused explain lanes failed (%s: %s) — scores "
+                        "kept, attributions degraded to None",
+                        type(e).__name__, e,
+                    )
+        return True
+
+    def _finish_fused_explain(
+        runinfo: dict, m: int, k: int, fam: dict[str, float] | None
+    ) -> list[dict[str, float]] | None:
+        """Ledger/drift/top-k bookkeeping for an explain sweep that rode
+        the fused dispatch — mirrors ``_run_explain``'s tail exactly so
+        the two paths share counters and semantics."""
+        led = _attr_ledger.stats()
+        state = runinfo.get("fused_lane_state")
+        diffs = runinfo.get("fused_diffs")
+        if state is None or diffs is None:
+            return None
+        try:
+            from ..compiler import stats as cstats
+
+            ts = _tspans.clock()
+            names = state["names"]
+            diffs = diffs[:m]
+            maps, hits = _loco.top_k_maps(diffs, names, k)
+            cstats.stats().record_sweep(
+                lanes=len(state["groups"]), padded=state["pad"]
+            )
+            led.record_explain(
+                m, state["seconds"] + (_tspans.clock() - ts),
+                lanes=state["kb"], deduped=0, padded=state["pad"],
+            )
+            led.record_groups(names, diffs, hits)
+            _tm.REGISTRY.counter("tptpu_serve_explain_rows_total").inc(m)
+            if attribution_drift.enabled and not _sshed.drift_shed():
+                attribution_drift.observe(names, diffs)
+            if fam is not None:
+                fam["explain"] = fam.get("explain", 0.0) + state["seconds"]
+                _tspans.record_span(
+                    "serve/explain", ts, state["seconds"], rows=m,
+                    lanes=len(names),
+                )
+            return maps
+        except Exception as e:
+            led.count_error()
+            _tm.REGISTRY.counter("tptpu_serve_explain_errors_total").inc()
+            log.warning(
+                "fused explain post-processing failed (%s: %s) — scores "
+                "kept, attributions degraded to None",
+                type(e).__name__, e,
+            )
+            return None
+
     def _run_plan(
         cols: dict[str, Any],
         b: int,
@@ -422,6 +694,8 @@ def score_function(
         breaker_mode: str = "active",
         skip: frozenset = frozenset(),
         fam_seconds: dict[str, float] | None = None,
+        explain_k: int = 0,
+        runinfo: dict | None = None,
     ) -> tuple[set, list, dict]:
         """Execute the stage plan over already-built raw columns, with
         per-stage fault isolation. Returns ``(dead, failures, cause)``:
@@ -436,30 +710,89 @@ def score_function(
         run, so a pre-existing short circuit is honored while the stage
         whose fresh failure is being isolated can still be probed.
         ``ScoreGuardError``/``SchemaViolationError`` are explicit
-        escalations and propagate."""
+        escalations and propagate.
+
+        Primary active runs above the host-predict cutoff first try the
+        FUSED program (one donated dispatch for members + combiner +
+        gathers + predict); a missing/ineligible program or a dispatch
+        error degrades to the staged loop below, counted and audited
+        (TPX008). Re-runs (``breaker_mode="observe"``), fault-plan
+        batches, and host-predict-size batches always run staged."""
         fp = faults.active()
         dead: set[str] = set()
         failures: list[tuple[Any, Exception]] = []
         cause: dict[str, str] = {}
+        prog = None
+        if (
+            breaker_mode == "active" and not skip and fp is None
+            and b > _device_predict_min
+        ):
+            prog = _fused_program()
+            if prog is not None and any(
+                br.state != "closed"
+                for nm, br in breakers.items() if nm in prog.covered
+            ):
+                # a not-closed covered breaker routes the batch staged:
+                # an open one must never be bypassed, and a recovery-due
+                # one needs the staged loop to run its half-open probe —
+                # the fused path never calls allow()/record_success, so
+                # dispatching over it would wedge the breaker open
+                prog = None
         with fusion.batch(b):
-            _plan_loop(
-                cols, b, n, row_indices, breaker_mode, skip,
-                dead, failures, cause, fp, fam_seconds,
-            )
+            if prog is not None:
+                _plan_loop(
+                    cols, b, n, row_indices, breaker_mode, skip,
+                    dead, failures, cause, fp, fam_seconds,
+                    stages=prog.prefix,
+                )
+                done = False
+                if not dead and not failures:
+                    # same deadline gate as the staged predictor boundary
+                    # — OUTSIDE the fallback try, so a typed
+                    # DeadlineExceeded propagates instead of counting as
+                    # a fused failure
+                    _sdl.checkpoint("dispatch")
+                    try:
+                        done = _dispatch_fused(
+                            prog, cols, b, n, explain_k, fam_seconds,
+                            runinfo,
+                        )
+                    except (ScoreGuardError, SchemaViolationError):
+                        raise  # explicit escalations stay escalations
+                    except Exception as e:
+                        _count_fused_fallback("dispatch_error", e)
+                else:
+                    _count_fused_fallback("prefix_degraded")
+                if done:
+                    return dead, failures, cause
+                # counted fail-soft seam: the batch degrades to today's
+                # staged loop over the fused segment's stages
+                _plan_loop(
+                    cols, b, n, row_indices, breaker_mode, skip,
+                    dead, failures, cause, fp, fam_seconds,
+                    stages=prog.fused_stages,
+                )
+            else:
+                _plan_loop(
+                    cols, b, n, row_indices, breaker_mode, skip,
+                    dead, failures, cause, fp, fam_seconds,
+                )
         return dead, failures, cause
 
     def _plan_loop(
         cols, b, n, row_indices, breaker_mode, skip,
-        dead, failures, cause, fp, fam_seconds=None,
+        dead, failures, cause, fp, fam_seconds=None, stages=None,
     ) -> None:
         """The stage loop of ``_run_plan`` (split out so the fusion batch
         context brackets exactly one plan execution). ``fam_seconds``
         (primary runs only) accumulates per-stage-family seconds —
         ``featurize`` for host transform stages, ``dispatch`` for fitted
         predictors — feeding the serve-latency histograms; per-stage
-        detail spans engage above the TPTPU_TRACE_STAGE_ROWS floor."""
+        detail spans engage above the TPTPU_TRACE_STAGE_ROWS floor.
+        ``stages`` restricts the walk to a sub-plan (the fused path's
+        host prefix, or its staged continuation after a fallback)."""
         detail = fam_seconds is not None and _tspans.stage_detail(n)
-        for t in plan:
+        for t in (plan if stages is None else stages):
             if any(nm in dead for nm in t.input_names):
                 dead.add(t.output_name)
                 up = {cause.get(nm) for nm in t.input_names if nm in dead}
@@ -770,6 +1103,7 @@ def score_function(
         failures: list = []
         poisoned: dict[int, tuple[str, Exception]] = {}
         attr_maps: list[dict[str, float]] | None = None
+        runinfo: dict[str, Any] = {}
         if m:
             b = _bucket(m)
             tc = _tspans.clock() if tel else 0.0
@@ -789,6 +1123,7 @@ def score_function(
             dead, failures, cause = _run_plan(
                 cols, b, m, tuple(survivors),
                 fam_seconds=fam if tel else None,
+                explain_k=explain, runinfo=runinfo,
             )
             degraded = [nm for nm in result_names if nm in dead]
             td = _tspans.clock() if tel else 0.0
@@ -801,13 +1136,25 @@ def score_function(
                     out[i][name] = rendered[j]
             if tel:
                 fam["download"] = _tspans.clock() - td
-            _census_downloads(b, m, degraded, fam.get("download", 0.0))
+            if not runinfo.get("fused"):
+                # fused batches counted their real download inside the
+                # dispatch — the staged render convention must not
+                # double-count it
+                _census_downloads(b, m, degraded, fam.get("download", 0.0))
             if explain:
                 # attributions ride the batch AFTER scores render: the
                 # sweep reuses the assembled feature plane and the batch's
-                # own PredictionColumn as the base (no extra base dispatch)
-                attr_maps = _run_explain(
-                    cols, m, explain, dead, fam if tel else None
+                # own PredictionColumn as the base (no extra base
+                # dispatch); fused batches already carried their lanes in
+                # the single dispatch and only finish bookkeeping here
+                attr_maps = (
+                    _finish_fused_explain(
+                        runinfo, m, explain, fam if tel else None
+                    )
+                    if runinfo.get("fused")
+                    else _run_explain(
+                        cols, m, explain, dead, fam if tel else None
+                    )
                 )
             # per-row isolation: a fresh stage failure bisects the
             # survivors so only the poisoning row(s) are quarantined;
@@ -933,8 +1280,10 @@ def score_function(
             # featurize time — there is no row-dict sentinel on this path
             fam["featurize"] = _tspans.clock() - started
         pre_open = _pre_open_snapshot()
+        runinfo: dict[str, Any] = {}
         dead, failures, cause = _run_plan(
-            cols, b, n, tuple(range(n)), fam_seconds=fam if tel else None
+            cols, b, n, tuple(range(n)), fam_seconds=fam if tel else None,
+            explain_k=explain, runinfo=runinfo,
         )
         td = _tspans.clock() if tel else 0.0
         keep = np.arange(n)
@@ -946,11 +1295,18 @@ def score_function(
         }
         if tel:
             fam["download"] = _tspans.clock() - td
-        _census_downloads(b, n, degraded, fam.get("download", 0.0))
+        if not runinfo.get("fused"):
+            _census_downloads(b, n, degraded, fam.get("download", 0.0))
         attr_maps: list[dict[str, float]] | None = None
         if explain:
-            attr_maps = _run_explain(
-                cols, n, explain, dead, fam if tel else None
+            attr_maps = (
+                _finish_fused_explain(
+                    runinfo, n, explain, fam if tel else None
+                )
+                if runinfo.get("fused")
+                else _run_explain(
+                    cols, n, explain, dead, fam if tel else None
+                )
             )
         fail_names = [nm for nm in degraded if cause.get(nm) == "failure"]
         if failures and fail_names and n > 1:
@@ -1017,13 +1373,22 @@ def score_function(
         per-stage host↔device transfer census, recompile-hazard and
         donation checks. Widths sharpen after the first scored batch
         (the fusion planner learns them); re-run any time — it executes
-        nothing."""
+        nothing. When the fused graph is available the census reports its
+        two-crossing contract (ingest up, render down) and the fused
+        module joins the TPX003 donation scan; a missing/degraded fused
+        path surfaces as TPX008."""
         from ..analysis.plan_audit import audit_serving_plan
 
+        prog = _fused_program()
+        with _fused_lock:
+            counters = dict(fused_counters)
         return audit_serving_plan(
             plan, raw_features, result_names,
             fusion=fusion, bucketed=True,
             host_predict_max=_device_predict_min,
+            fused=prog,
+            fused_reason=_fused_reason(),
+            fused_counters=counters,
         )
 
     def metadata() -> dict[str, Any]:
@@ -1058,8 +1423,19 @@ def score_function(
             featurize_snap = fstats.snapshot()
             attribution_snap = _attr_ledger.snapshot()
         resolved = _explain_state.get("resolved")
+        with _fused_lock:
+            prog = fused_holder["program"]
+            fused_snap = dict(fused_counters)
         return {
             "analysis": analysis,
+            "fused": {
+                "active": prog is not None,
+                "reason": _fused_reason(),
+                "fingerprint": None if prog is None else prog.fingerprint,
+                "dispatches": fused_snap["dispatches"],
+                "fallbacks": fused_snap["fallbacks"],
+                "lastFallback": fused_snap["lastFallback"],
+            },
             "compileStats": compile_snap,
             "featurizeStats": featurize_snap,
             "scoreGuard": guard.stats(),
@@ -1077,9 +1453,17 @@ def score_function(
             "telemetry": serving_snapshot(),
         }
 
+    def prime_fused() -> bool:
+        """Build the fused serving program now instead of on the first
+        eligible batch (the standing service calls this at start, after
+        priming the fusion planner). Returns availability."""
+        return _fused_program() is not None
+
     score_one.batch = score_batch  # type: ignore[attr-defined]
     score_one.columns = score_columns  # type: ignore[attr-defined]
     score_one.fusion = fusion  # type: ignore[attr-defined]
+    score_one.prime_fused = prime_fused  # type: ignore[attr-defined]
+    score_one.fused_state = fused_holder  # type: ignore[attr-defined]
     score_one.guard = guard  # type: ignore[attr-defined]
     score_one.sentinel = sentinel  # type: ignore[attr-defined]
     score_one.breakers = breakers  # type: ignore[attr-defined]

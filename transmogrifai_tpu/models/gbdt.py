@@ -261,6 +261,64 @@ class _BinnedModel(PredictorModel):
                         for t in ds]
         return np.stack(outs, axis=1).astype(np.float64)
 
+    # ---- shared predict entry: family-specific stacks + HOST epilogue ----
+    def _tree_stacks(self):
+        """(trees-or-per-class-list, boosted) — the arrays
+        ``_predict_stacks`` dispatches over."""
+        raise NotImplementedError
+
+    def predictions_from_core(self, core: np.ndarray):
+        """(pred, prob, raw) from the [N, k] margin/mean-leaf core — the
+        numpy tail shared by the staged path and the fused graph's
+        downloaded core, so the two are bit-identical."""
+        raise NotImplementedError
+
+    def predict_arrays(self, x):
+        trees, boosted = self._tree_stacks()
+        return self.predictions_from_core(
+            self._predict_stacks(x, trees, boosted=boosted)
+        )
+
+    def fused_predict_spec(self):
+        """Device core for the fused scoring graph: the same
+        ``predict_*_raw`` programs the staged device path banks, traced
+        over the in-graph plane — tree predictions stay bit-identical."""
+        from ..compiler.fused import PredictorPlan
+
+        trees, boosted = self._tree_stacks()
+        ds = self._dev(trees)
+        ds = ds if isinstance(trees, list) else [ds]
+        params: dict = {
+            "thr": np.asarray(self.thresholds, dtype=np.float32),
+            "trees": tuple(ds),
+        }
+        if boosted:
+            params["eta"] = np.float32(self.eta)
+            params["base"] = np.float32(self.base_score)
+
+        def core(plane, p):
+            if boosted:
+                outs = [
+                    TR.predict_boosted_raw(
+                        plane, p["thr"], t, p["eta"], p["base"]
+                    )
+                    for t in p["trees"]
+                ]
+            else:
+                outs = [
+                    TR.predict_forest_raw(plane, p["thr"], t)
+                    for t in p["trees"]
+                ]
+            return jnp.stack(outs, axis=1)
+
+        return PredictorPlan(
+            stage=self, in_dim=int(self.thresholds.shape[0]), params=params,
+            core=core, epilogue=self.predictions_from_core,
+            descriptor=(
+                f"{'boost' if boosted else 'forest'}:{len(ds)}"
+            ),
+        )
+
     def detach_from_sweep(self):
         """Cut every reference to the stacked sweep arrays: materialize this
         model's own lane (a small independent device array) and drop the
@@ -328,9 +386,13 @@ class BoostedBinaryModel(_BinnedModel):
             params["eta"], params["base_score"],
         )
 
-    def predict_arrays(self, x):
-        margin = self._predict_stacks(x, self.trees, boosted=True)[:, 0]
-        return self.predictions_from_sweep(margin)
+    def _tree_stacks(self):
+        return self.trees, True
+
+    def predictions_from_core(self, core):
+        return self.predictions_from_sweep(
+            np.asarray(core, dtype=np.float64)[:, 0]
+        )
 
     # ---- batched sweep-eval protocol (validators._sweep_family) ----------
     sweep_mode = "boost"
@@ -372,8 +434,11 @@ class BoostedMultiModel(_BinnedModel):
             params["eta"], params["base_score"],
         )
 
-    def predict_arrays(self, x):
-        margins = self._predict_stacks(x, self.trees_per_class, boosted=True)
+    def _tree_stacks(self):
+        return self.trees_per_class, True
+
+    def predictions_from_core(self, core):
+        margins = np.asarray(core, dtype=np.float64)
         p = _sigmoid(margins)
         prob = p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
         return prob.argmax(axis=1).astype(np.float64), prob, margins
@@ -405,9 +470,11 @@ class BoostedRegressionModel(_BinnedModel):
             params["eta"], params["base_score"],
         )
 
-    def predict_arrays(self, x):
-        pred = self._predict_stacks(x, self.trees, boosted=True)[:, 0]
-        return pred, None, None
+    def _tree_stacks(self):
+        return self.trees, True
+
+    def predictions_from_core(self, core):
+        return np.asarray(core, dtype=np.float64)[:, 0], None, None
 
     sweep_mode = "boost"
 
@@ -438,9 +505,11 @@ class ForestClassifierModel(_BinnedModel):
     def from_params(cls, params, arrays):
         return cls(arrays["thresholds"], _class_trees_from_arrays(arrays))
 
-    def predict_arrays(self, x):
-        probs = self._predict_stacks(x, self.forests_per_class, boosted=False)
-        return self._probs_to_predictions(probs)
+    def _tree_stacks(self):
+        return self.forests_per_class, False
+
+    def predictions_from_core(self, core):
+        return self._probs_to_predictions(np.asarray(core, dtype=np.float64))
 
     @staticmethod
     def _probs_to_predictions(probs):
@@ -491,9 +560,11 @@ class ForestRegressionModel(_BinnedModel):
             "leaf_value": t.leaf_value,
         }
 
-    def predict_arrays(self, x):
-        pred = self._predict_stacks(x, self.trees, boosted=False)[:, 0]
-        return pred, None, None
+    def _tree_stacks(self):
+        return self.trees, False
+
+    def predictions_from_core(self, core):
+        return np.asarray(core, dtype=np.float64)[:, 0], None, None
 
     sweep_mode = "forest"
 

@@ -49,9 +49,31 @@ class GeneralizedLinearRegressionModel(PredictorModel):
                    params["family"], params["link"])
 
     def predict_arrays(self, x: np.ndarray):
-        eta = x @ self.weights + self.intercept
+        return self.predictions_from_core(x @ self.weights + self.intercept)
+
+    def predictions_from_core(self, core: np.ndarray):
+        """Host epilogue shared by staged predict and the fused graph:
+        the link inverse over the downloaded linear predictor eta."""
+        eta = np.asarray(core, dtype=np.float64)
         mu = _linkinv_np(eta, self.link)
         return mu.astype(np.float64), None, None
+
+    def fused_predict_spec(self):
+        from ..compiler.fused import PredictorPlan
+
+        params = {
+            "w": np.asarray(self.weights, dtype=np.float32),
+            "b": np.float32(self.intercept),
+        }
+
+        def core(plane, p):
+            return plane @ p["w"] + p["b"]
+
+        return PredictorPlan(
+            stage=self, in_dim=int(self.weights.shape[0]), params=params,
+            core=core, epilogue=self.predictions_from_core,
+            descriptor=f"glm:{self.family}:{self.link}",
+        )
 
 
 class GeneralizedLinearRegression(PredictorEstimator):

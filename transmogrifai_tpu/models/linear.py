@@ -25,8 +25,27 @@ class LinearRegressionModel(PredictorModel):
         return cls(arrays["weights"], float(arrays["intercept"]))
 
     def predict_arrays(self, x: np.ndarray):
-        pred = x @ self.weights + self.intercept
-        return pred, None, None
+        return self.predictions_from_core(x @ self.weights + self.intercept)
+
+    def predictions_from_core(self, core: np.ndarray):
+        return np.asarray(core, dtype=np.float64), None, None
+
+    def fused_predict_spec(self):
+        from ..compiler.fused import PredictorPlan
+
+        params = {
+            "w": np.asarray(self.weights, dtype=np.float32),
+            "b": np.float32(self.intercept),
+        }
+
+        def core(plane, p):
+            return plane @ p["w"] + p["b"]
+
+        return PredictorPlan(
+            stage=self, in_dim=int(self.weights.shape[0]), params=params,
+            core=core, epilogue=self.predictions_from_core,
+            descriptor="linreg",
+        )
 
 
 class LinearRegression(PredictorEstimator):

@@ -44,19 +44,44 @@ class LogisticRegressionModel(PredictorModel):
         return cls(arrays["weights"], arrays["intercept"], params["num_classes"])
 
     def predict_arrays(self, x: np.ndarray):
+        return self.predictions_from_core(x @ self.weights + self.intercept)
+
+    def predictions_from_core(self, core: np.ndarray):
+        """(pred, prob, raw) from the linear core (binary margin [N] or
+        multinomial logits [N, C]) — the HOST epilogue shared by the
+        staged predict and the fused graph's downloaded core."""
+        core = np.asarray(core, dtype=np.float64)
         if self.num_classes == 2:
-            margin = x @ self.weights + self.intercept
+            margin = core
             p1 = 1.0 / (1.0 + np.exp(-margin))
             prob = np.stack([1.0 - p1, p1], axis=1)
             raw = np.stack([-margin, margin], axis=1)
         else:
-            logits = x @ self.weights + self.intercept
-            logits -= logits.max(axis=1, keepdims=True)
+            logits = core - core.max(axis=1, keepdims=True)
             e = np.exp(logits)
             prob = e / e.sum(axis=1, keepdims=True)
             raw = logits
         pred = prob.argmax(axis=1).astype(np.float64)
         return pred, prob, raw
+
+    def fused_predict_spec(self):
+        """Device core for the fused scoring graph: ``plane @ w + b`` in
+        f32 (predictions within 1e-6 of the staged f64 host matmul)."""
+        from ..compiler.fused import PredictorPlan
+
+        params = {
+            "w": np.asarray(self.weights, dtype=np.float32),
+            "b": np.asarray(self.intercept, dtype=np.float32),
+        }
+
+        def core(plane, p):
+            return plane @ p["w"] + p["b"]
+
+        return PredictorPlan(
+            stage=self, in_dim=int(self.weights.shape[0]), params=params,
+            core=core, epilogue=self.predictions_from_core,
+            descriptor=f"logreg:{self.num_classes}",
+        )
 
 
 class LogisticRegression(PredictorEstimator):

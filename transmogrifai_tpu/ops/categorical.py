@@ -204,6 +204,24 @@ class OneHotModel(VectorizerModel):
             metas.append(pivot_metas(feat.name, feat.ftype, vocab, self.track_nulls))
         return blocks, metas
 
+    def fused_member_spec(self):
+        """Device twin for the fused scoring graph: host interning resolves
+        each distinct raw value to a vocab code, the one-hot scatter runs
+        in-graph. Set-valued pivots (member COUNTS, not indicators) keep
+        the staged path."""
+        from ..compiler.fused import Unfuseable, onehot_member
+        from ..types import OPSet
+
+        for feat in self.input_features:
+            if issubclass(feat.ftype, OPSet):
+                raise Unfuseable(
+                    f"set-valued pivot '{feat.name}' emits member counts — "
+                    "not expressible as a code scatter"
+                )
+        return onehot_member(
+            self, self.vocabs, self.track_nulls, self.clean_text
+        )
+
 
 class OneHotVectorizer(VectorizerEstimator):
     """Sequence estimator pivoting categorical text features

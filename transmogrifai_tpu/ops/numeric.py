@@ -71,6 +71,16 @@ class NumericVectorizerModel(VectorizerModel):
     def get_params(self):
         return {"fills": list(map(float, self.fills)), "track_nulls": self.track_nulls}
 
+    def fused_member_spec(self):
+        """Device twin for the fused scoring graph (compiler/fused.py):
+        ingest = f32 values + validity mask, impute + null-track traced
+        in-graph."""
+        from ..compiler.fused import numeric_member
+
+        return numeric_member(
+            self, np.asarray(self.fills, dtype=np.float32), self.track_nulls
+        )
+
 
 class RealVectorizer(VectorizerEstimator):
     """Mean-imputing vectorizer for Real/Currency/Percent
@@ -169,6 +179,15 @@ class BinaryVectorizer(VectorizerTransformer):
             metas.append(_value_and_null_meta(feat.name, feat.ftype, self.track_nulls))
         return blocks, metas
 
+    def fused_member_spec(self):
+        from ..compiler.fused import numeric_member
+
+        fills = np.full(
+            len(self.input_features), float(self.fill_value),
+            dtype=np.float32,
+        )
+        return numeric_member(self, fills, self.track_nulls)
+
 
 class RealNNVectorizer(VectorizerTransformer):
     """RealNN passthrough (no nulls possible) — Transmogrifier.scala:271."""
@@ -183,3 +202,8 @@ class RealNNVectorizer(VectorizerTransformer):
             blocks.append(col.values.astype(np.float64)[:, None])
             metas.append([ColumnMeta((feat.name,), feat.ftype.__name__)])
         return blocks, metas
+
+    def fused_member_spec(self):
+        from ..compiler.fused import passthrough_member
+
+        return passthrough_member(self, len(self.input_features))

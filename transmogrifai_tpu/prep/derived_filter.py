@@ -64,6 +64,14 @@ class FeatureRemovalModel(Model):
             ),
         )
 
+    def fused_gather_indices(self) -> np.ndarray | None:
+        """The keep-index gather for the fused scoring graph
+        (compiler/fused.py): ``plane[:, idx]`` traced in-graph, or None
+        when this model is a passthrough."""
+        if not self.remove_bad_features:
+            return None
+        return np.asarray(self.indices_to_keep, dtype=np.int32)
+
     def transform_columns(self, *cols: Column, num_rows: int) -> VectorColumn:
         # inputs are (label, vector); the vector is always the last input
         vec = cols[-1]

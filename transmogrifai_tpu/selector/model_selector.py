@@ -203,6 +203,19 @@ class SelectedModel(PredictorModel):
     def predict_arrays(self, x: np.ndarray):
         return self.best_model.predict_arrays(x)
 
+    def fused_predict_spec(self):
+        """Delegate the fused-graph device core to the winning family (the
+        spec's epilogue is the winner's too, so parity carries over)."""
+        spec_fn = getattr(self.best_model, "fused_predict_spec", None)
+        if spec_fn is None:
+            from ..compiler.fused import Unfuseable
+
+            raise Unfuseable(
+                f"selected model family {type(self.best_model).__name__} "
+                "has no fused device predict"
+            )
+        return spec_fn()
+
     def get_arrays(self):
         return {f"best__{k}": v for k, v in self.best_model.get_arrays().items()}
 

@@ -167,8 +167,10 @@ class ScoringService:
     # ------------------------------------------------------------ lifecycle
     def start(self, wait_warmup: bool = False, timeout: float = 60.0) -> "ScoringService":
         """Idempotent: pre-warms the banked scoring executables
-        (``compiler/warmup.py``), primes the closure's fusion planner from
-        fit-static widths, and launches the worker threads."""
+        (``compiler/warmup.py`` — including the fused_serve programs),
+        primes the closure's fusion planner from fit-static widths, builds
+        the fused scoring graph so batch #1 pays no plan compilation, and
+        launches the worker threads."""
         from ..compiler import warmup as _warmup
 
         with self._lock:
@@ -184,6 +186,12 @@ class ScoringService:
                 fusion.prime()
             except Exception:  # priming is an optimization, never fatal
                 log.debug("fusion prime failed", exc_info=True)
+        prime_fused = getattr(self.score_fn, "prime_fused", None)
+        if prime_fused is not None:
+            try:
+                prime_fused()
+            except Exception:  # never fatal — the staged loop remains
+                log.debug("fused prime failed", exc_info=True)
         for i in range(self.config.workers):
             th = threading.Thread(
                 target=self._worker, daemon=True, name=f"tptpu-serve-{i}"

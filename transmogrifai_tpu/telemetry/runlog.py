@@ -811,13 +811,21 @@ def reconcile_transfer_census(
     static_census: dict[str, Any],
     rows: int | None = None,
     batches: int | None = None,
+    check_uploads: bool = False,
 ) -> dict[str, Any]:
     """Square the RUNTIME census (a :func:`delta` of the run ledger, or a
     report's ``transferCensus``) against the STATIC per-row prediction
     from ``analysis/plan_audit.py``. For a device-dispatching batch the
     static census predicts one h2d + one d2h per predictor stage per
     batch and ``downBytesPerRow`` download bytes per row; ``consistent``
-    is True when the observed counts/bytes line up with that prediction."""
+    is True when the observed counts/bytes line up with that prediction.
+
+    ``check_uploads=True`` additionally pins the upload COUNT to the
+    static prediction (``hostToDeviceTransfers × batches``) — the fused
+    scoring graph's "uploads only at ingest" acceptance check. Steady
+    state only: the fused program's one-time model-constant upload and
+    the staged path's opportunistic prefetches make the first batch after
+    bring-up legitimately chattier."""
     if "hostToDevice" in runtime:  # a report census
         rt_d2h = runtime["deviceToHost"]["count"]
         rt_d2h_bytes = runtime["deviceToHost"]["bytes"]
@@ -844,8 +852,12 @@ def reconcile_transfer_census(
         out["expectedD2hTransfers"] = st_d2h * batches
         checks.append(rt_d2h == st_d2h * batches)
     if rows is not None and st_down_per_row:
-        out["expectedD2hBytes"] = st_down_per_row * rows
-        checks.append(rt_d2h_bytes == st_down_per_row * rows)
+        out["expectedD2hBytes"] = round(st_down_per_row * rows)
+        checks.append(rt_d2h_bytes == round(st_down_per_row * rows))
+    if check_uploads and batches is not None:
+        st_h2d = static_census.get("hostToDeviceTransfers", 0)
+        out["expectedH2dTransfers"] = st_h2d * batches
+        checks.append(rt_h2d == st_h2d * batches)
     out["consistent"] = bool(checks) and all(checks)
     return out
 
