@@ -103,8 +103,9 @@ def test_report_raise_if_errors_is_valueerror():
 
 def test_all_emittable_codes_are_catalogued():
     for code in CODES:
-        # TPR: the cross-run regression sentinel (telemetry/runlog.py)
-        assert code[:3] in ("TPA", "TPX", "TPL", "TPR")
+        # TPR: the cross-run regression sentinel (telemetry/runlog.py);
+        # TPC: the concurrency analysis plane (analysis/concurrency.py)
+        assert code[:3] in ("TPA", "TPX", "TPL", "TPR", "TPC")
         assert CODES[code]
 
 
@@ -310,6 +311,15 @@ def test_train_records_analysis_report(trained):
     js = model.summary_json()
     assert js["analysis"] is not None
     assert js["analysis"]["errors"] == 0
+
+
+def test_summary_json_carries_concurrency_summary(trained):
+    # the TPC static-concurrency summary rides beside the TPA/TPX
+    # reports in summary_json()["analysis"] (lru-cached per process)
+    _, model = trained
+    conc = model.summary_json()["analysis"]["concurrency"]
+    assert set(conc) == {"findings", "codes", "locks", "edges"}
+    assert conc["locks"] > 0
 
 
 def test_summary_pretty_reports_surviving_findings(trained):

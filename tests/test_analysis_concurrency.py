@@ -1,0 +1,834 @@
+"""Static concurrency analyzer (analysis/concurrency.py, TPC0xx) —
+seeded positive/negative corpus for every rule, including AST
+reconstructions of the two bugs review actually caught (the PR-8
+``render_prometheus`` ABBA deadlock and the PR-9 non-atomic cache
+publish), the lock registry / annotation vocabulary, the committed repo
+baseline staying green, and the <10s whole-repo runtime bound."""
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from transmogrifai_tpu.analysis import concurrency as C
+from transmogrifai_tpu.analysis import lint as L
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(report):
+    return [f.code for f in report.findings]
+
+
+def _one(src, rel="transmogrifai_tpu/serving/x.py"):
+    return C.analyze_sources([(rel, textwrap.dedent(src))])
+
+
+# =================================================================== TPC001
+#: AST reconstruction of the PR-8 ABBA: render() holds the registry lock
+#: while reaching into the service (whose submit() holds the service lock
+#: while setting a registry gauge) — the two resolvable paths close the
+#: cycle, and the exposition-source `fn()` under the lock is the TPC004
+#: shape that made the original statically invisible.
+PR8_ABBA = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._sources = {}
+        self.service = Service(self)
+
+    def set_gauge(self, name, v):
+        with self.lock:
+            self._sources[name] = v
+
+    def render_prometheus(self):
+        out = {}
+        with self.lock:
+            for name, fn in self._sources.items():
+                out[name] = fn()
+            out["svc"] = self.service.stats()
+        return out
+
+class Service:
+    def __init__(self, registry):
+        self._lock = threading.Lock()
+        self.registry = Registry()
+
+    def submit(self, rows):
+        with self._lock:
+            self.registry.set_gauge("queue_depth", len(rows))
+
+    def stats(self):
+        with self._lock:
+            return {}
+"""
+
+#: the fixed shape: sources snapshotted under the lock, CALLED outside it
+#: (what telemetry/metrics.py actually does post-PR-8)
+PR8_FIXED = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._sources = {}
+        self.service = Service(self)
+
+    def set_gauge(self, name, v):
+        with self.lock:
+            self._sources[name] = v
+
+    def render_prometheus(self):
+        with self.lock:
+            items = list(self._sources.items())
+        out = {}
+        for name, fn in items:
+            out[name] = fn()
+        out["svc"] = self.service.stats()
+        return out
+
+class Service:
+    def __init__(self, registry):
+        self._lock = threading.Lock()
+        self.registry = Registry()
+
+    def submit(self, rows):
+        with self._lock:
+            self.registry.set_gauge("queue_depth", len(rows))
+
+    def stats(self):
+        with self._lock:
+            return {}
+"""
+
+
+def test_tpc001_pr8_abba_reconstruction_flagged():
+    report = _one(PR8_ABBA, "transmogrifai_tpu/telemetry/x.py")
+    assert "TPC001" in _codes(report), report.pretty()
+    # the cycle names both locks
+    f = report.by_code("TPC001")[0]
+    assert "Registry.lock" in f.message and "Service._lock" in f.message
+    # the exposition-source call under the lock is the TPC004 shape
+    assert "TPC004" in _codes(report)
+
+
+def test_tpc001_pr8_fixed_shape_is_clean():
+    report = _one(PR8_FIXED, "transmogrifai_tpu/telemetry/x.py")
+    assert "TPC001" not in _codes(report), report.pretty()
+    assert "TPC004" not in _codes(report), report.pretty()
+
+
+def test_tpc001_direct_with_nesting_cycle():
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+
+    def ab():
+        with _A:
+            with _B:
+                pass
+
+    def ba():
+        with _B:
+            with _A:
+                pass
+    """
+    report = _one(src)
+    assert _codes(report).count("TPC001") == 1
+
+
+def test_tpc001_consistent_order_is_clean():
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+
+    def ab():
+        with _A:
+            with _B:
+                pass
+
+    def ab2():
+        with _A:
+            with _B:
+                pass
+    """
+    report = _one(src)
+    assert "TPC001" not in _codes(report)
+
+
+def test_tpc001_one_level_call_inlining():
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+
+    def inner_b():
+        with _B:
+            pass
+
+    def outer_ab():
+        with _A:
+            inner_b()
+
+    def ba():
+        with _B:
+            with _A:
+                pass
+    """
+    report = _one(src)
+    assert "TPC001" in _codes(report)
+
+
+def test_tpc001_transitive_call_inlining():
+    # A -> (f -> g -> B) plus B -> A: only transitive acquisition
+    # propagation can see the first edge
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+
+    def g():
+        with _B:
+            pass
+
+    def f():
+        g()
+
+    def outer():
+        with _A:
+            f()
+
+    def ba():
+        with _B:
+            with _A:
+                pass
+    """
+    report = _one(src)
+    assert "TPC001" in _codes(report)
+
+
+def test_tpc001_acq_star_is_exact_through_call_cycles():
+    # review fix: a recursive call cycle f->g->h->f must not truncate
+    # the memoized acquisition closure — h's closure includes g's _B no
+    # matter which member of the cycle is computed first, so the real
+    # ABBA against other() is still detected
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+    _L1 = threading.Lock()
+    _L2 = threading.Lock()
+
+    def f(n):
+        with _A:
+            pass
+        g(n)
+
+    def g(n):
+        with _B:
+            pass
+        h(n)
+
+    def h(n):
+        if n:
+            f(n - 1)
+
+    def w1():
+        with _L1:
+            f(3)
+
+    def w2():
+        with _L2:
+            h(3)
+
+    def other():
+        with _B:
+            with _L2:
+                pass
+    """
+    report = _one(src)
+    assert "TPC001" in _codes(report), report.pretty()
+    edges = {
+        (e["from"], e["to"]) for e in report.data["lockGraph"]["edges"]
+    }
+    assert ("serving/x.py:_L2", "serving/x.py:_B") in edges
+
+
+def test_tpc001_self_deadlock_on_plain_lock():
+    src = """
+    import threading
+    _A = threading.Lock()
+
+    def helper():
+        with _A:
+            pass
+
+    def outer():
+        with _A:
+            helper()
+    """
+    report = _one(src)
+    assert "TPC001" in _codes(report)
+
+
+def test_tpc001_rlock_reentry_not_a_cycle():
+    src = """
+    import threading
+    _A = threading.RLock()
+
+    def helper():
+        with _A:
+            pass
+
+    def outer():
+        with _A:
+            helper()
+    """
+    report = _one(src)
+    assert "TPC001" not in _codes(report)
+
+
+def test_lock_family_reentry_not_a_cycle():
+    src = """
+    import threading
+
+    class M:
+        def __init__(self):
+            self._window_locks = {k: threading.Lock() for k in "ab"}
+
+        def merge(self, a, b):
+            with self._window_locks[a]:
+                with self._window_locks[b]:
+                    pass
+    """
+    report = _one(src)
+    assert "TPC001" not in _codes(report)
+
+
+def test_condition_aliases_the_wrapped_lock():
+    # with self._lock and with self._not_empty are ONE lock: a nesting
+    # of the two is re-entry (deadlock, but self-deadlock of one node),
+    # not a two-node cycle between distinct locks
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._not_empty = threading.Condition(self._lock)
+
+        def offer(self):
+            with self._not_empty:
+                pass
+
+        def drain(self):
+            with self._lock:
+                pass
+    """
+    report = _one(src)
+    graph = report.data["lockGraph"]
+    assert "transmogrifai_tpu/serving/x.py" or True
+    keys = [k for k in graph["locks"] if "Q." in k]
+    assert keys == ["serving/x.py:Q._lock"], graph["locks"]
+
+
+def test_multi_item_with_annotation_does_not_alias_every_item():
+    # review fix: a '# tpc: lock(...)' on a multi-item with must not
+    # collapse both locks onto one node (losing _A and fabricating a
+    # self-edge false TPC001)
+    src = """
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+
+    def both():
+        with _A, _B:  # tpc: lock(other/mod.py:EXT)
+            pass
+    """
+    report = _one(src)
+    assert "TPC001" not in _codes(report)
+    edges = {
+        (e["from"], e["to"]) for e in report.data["lockGraph"]["edges"]
+    }
+    assert ("serving/x.py:_A", "serving/x.py:_B") in edges
+
+
+def test_lock_family_make_lock_literal_wins():
+    # review fix: the member make_lock("...") literal IS the canonical
+    # family key — the derived attribute name must not shadow it, or the
+    # runtime TracedLock name and the static node diverge
+    src = """
+    from ..analysis import schedule as _schedule
+
+    class M:
+        def __init__(self, names):
+            self._window_locks = {
+                n: _schedule.make_lock("CUSTOM_FAMILY") for n in names
+            }
+
+        def touch(self, n):
+            with self._window_locks[n]:
+                pass
+    """
+    report = _one(src)
+    assert "CUSTOM_FAMILY" in report.data["lockGraph"]["locks"]
+    assert report.data["lockGraph"]["locks"]["CUSTOM_FAMILY"]["kind"] == \
+        "family"
+
+
+def test_make_lock_literal_is_the_canonical_key():
+    src = """
+    from ..analysis import schedule as _schedule
+
+    class S:
+        def __init__(self):
+            self._lock = _schedule.make_lock("serving/x.py:S._lock")
+
+        def go(self):
+            with self._lock:
+                pass
+    """
+    report = _one(src)
+    assert "serving/x.py:S._lock" in report.data["lockGraph"]["locks"]
+
+
+# =================================================================== TPC002
+def test_tpc002_bare_write_beside_locked_writes():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def locked_inc(self):
+            with self._lock:
+                self.count += 1
+
+        def bare_inc(self):
+            self.count += 1
+    """
+    report = _one(src)
+    assert _codes(report) == ["TPC002"]
+    assert "S.count" in report.findings[0].message
+
+
+def test_tpc002_guarded_annotation_documents_caller_holds():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def locked_inc(self):
+            with self._lock:
+                self.count += 1
+
+        def _reset(self):  # tpc: guarded(serving/x.py:S._lock)
+            self.count = 0
+    """
+    report = _one(src)
+    assert "TPC002" not in _codes(report)
+
+
+def test_tpc002_init_writes_exempt_and_all_locked_clean():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self.state = "closed"
+
+        def inc(self):
+            with self._lock:
+                self.count += 1
+                self.state = "open"
+    """
+    report = _one(src)
+    assert not _codes(report)
+
+
+def test_tpc002_never_locked_field_is_not_flagged():
+    # no discipline established -> nothing to contradict (TPL001's beat)
+    src = """
+    class S:
+        def set(self, v):
+            self.value = v
+
+        def clear(self):
+            self.value = None
+    """
+    report = _one(src)
+    assert "TPC002" not in _codes(report)
+
+
+# =================================================================== TPC003
+def test_tpc003_mixed_lock_guard():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.count = 0
+
+        def inc_a(self):
+            with self._a:
+                self.count += 1
+
+        def inc_b(self):
+            with self._b:
+                self.count += 1
+    """
+    report = _one(src)
+    assert _codes(report) == ["TPC003"]
+
+
+def test_tpc003_common_lock_across_nested_holds_is_clean():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.count = 0
+
+        def inc_ab(self):
+            with self._a:
+                with self._b:
+                    self.count += 1
+
+        def inc_b(self):
+            with self._b:
+                self.count += 1
+    """
+    report = _one(src)
+    assert "TPC003" not in _codes(report)
+
+
+# =================================================================== TPC004
+def test_tpc004_parameter_callback_under_lock():
+    src = """
+    import threading
+    _LOCK = threading.Lock()
+
+    def notify(on_done):
+        with _LOCK:
+            on_done()
+    """
+    report = _one(src)
+    assert _codes(report) == ["TPC004"]
+
+
+def test_tpc004_callback_attribute_under_lock():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def run(self):
+            with self._lock:
+                self.on_batch_cost(1.0)
+    """
+    report = _one(src)
+    assert _codes(report) == ["TPC004"]
+
+
+def test_tpc004_module_function_call_under_lock_is_fine():
+    src = """
+    import threading
+    _LOCK = threading.Lock()
+
+    def helper():
+        return 1
+
+    def run(rows):
+        with _LOCK:
+            helper()
+            len(rows)
+            sorted(rows)
+    """
+    report = _one(src)
+    assert "TPC004" not in _codes(report)
+
+
+def test_tpc004_foreign_call_outside_lock_is_fine():
+    src = """
+    import threading
+    _LOCK = threading.Lock()
+
+    def run(sources):
+        with _LOCK:
+            items = list(sources.items())
+        for name, fn in items:
+            fn()
+    """
+    report = _one(src)
+    assert "TPC004" not in _codes(report)
+
+
+def test_tpc004_alias_of_module_callable_is_fine():
+    # exc = A if flag else B, raised under the lock: A/B are module
+    # classes, not user callbacks (the resilience/faults.py shape)
+    src = """
+    import threading
+    _LOCK = threading.Lock()
+
+    class TransientError(Exception):
+        pass
+
+    class FatalError(Exception):
+        pass
+
+    def fire(transient):
+        with _LOCK:
+            exc = TransientError if transient else FatalError
+            raise exc("injected")
+    """
+    report = _one(src)
+    assert "TPC004" not in _codes(report)
+
+
+def test_tpc004_suppression_comment():
+    src = """
+    import threading
+    _LOCK = threading.Lock()
+
+    def prune(refs):
+        with _LOCK:
+            return [r for r in refs if r() is not None]  # tpc: disable=TPC004
+    """
+    report = _one(src)
+    assert not _codes(report)
+
+
+def test_tpc004_nested_closure_helpers_are_safe_names():
+    src = """
+    import threading
+
+    def factory():
+        _lk = threading.Lock()
+
+        def helper():
+            return 1
+
+        def run():
+            with _lk:
+                helper()
+
+        return run
+    """
+    report = _one(src)
+    assert "TPC004" not in _codes(report)
+
+
+# =================================================================== TPC005
+#: AST reconstruction of the PR-9 bug: the (groups, names) cache was
+#: assigned to the shared attribute FIRST and filled in afterwards —
+#: a concurrent service worker racing the first sweep read it half-built.
+PR9_PUBLISH = """
+class LOCO:
+    def groups(self, meta, dim):
+        if self._cache is None:
+            self._cache = {}
+            for g in range(dim):
+                self._cache[g] = ("col_%d" % g, [g])
+        return self._cache
+"""
+
+#: the fixed shape: build a local, publish with one assignment
+PR9_FIXED = """
+class LOCO:
+    def groups(self, meta, dim):
+        if self._cache is None:
+            built = {}
+            for g in range(dim):
+                built[g] = ("col_%d" % g, [g])
+            self._cache = built
+        return self._cache
+"""
+
+
+def test_tpc005_pr9_publish_reconstruction_flagged():
+    report = _one(PR9_PUBLISH, "transmogrifai_tpu/insights/x.py")
+    assert _codes(report) == ["TPC005"]
+    assert "_cache" in report.findings[0].message
+
+
+def test_tpc005_pr9_fixed_shape_is_clean():
+    report = _one(PR9_FIXED, "transmogrifai_tpu/insights/x.py")
+    assert not _codes(report)
+
+
+def test_tpc005_guarded_publish_is_clean():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def rebuild(self, items):
+            with self._lock:
+                self._cache = {}
+                for k in items:
+                    self._cache[k] = k
+    """
+    report = _one(src)
+    assert "TPC005" not in _codes(report)
+
+
+def test_tpc005_mutator_method_counts_as_fill():
+    src = """
+    class S:
+        def rebuild(self, items):
+            self._cache = []
+            self._cache.append(1)
+    """
+    report = _one(src)
+    assert _codes(report) == ["TPC005"]
+
+
+def test_tpc005_init_exempt():
+    src = """
+    class S:
+        def __init__(self, items):
+            self._cache = {}
+            for k in items:
+                self._cache[k] = k
+    """
+    report = _one(src)
+    assert not _codes(report)
+
+
+# ===================================================== baseline + rendering
+def test_baseline_roundtrip_and_line_move_invariance(tmp_path):
+    report = _one(PR9_PUBLISH, "transmogrifai_tpu/insights/x.py")
+    assert len(report) == 1
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps(L.baseline_entries(report)))
+    baseline = L.load_baseline(str(bl))
+    assert L.new_findings(report, baseline) == []
+    # pad lines above: same finding, new line number, still covered
+    moved = "\n\n\n" + textwrap.dedent(PR9_PUBLISH)
+    report2 = C.analyze_sources([("transmogrifai_tpu/insights/x.py", moved)])
+    assert L.new_findings(report2, baseline) == []
+    # a DIFFERENT finding is new
+    report3 = _one(PR9_PUBLISH.replace("_cache", "_other"),
+                   "transmogrifai_tpu/insights/x.py")
+    assert len(L.new_findings(report3, baseline)) == 1
+
+
+def test_unparseable_file_reports_tpc000():
+    report = _one("def broken(:\n", "transmogrifai_tpu/serving/x.py")
+    assert _codes(report) == ["TPC000"]
+
+
+def test_findings_carry_path_line_context():
+    report = _one(PR9_PUBLISH, "transmogrifai_tpu/insights/x.py")
+    d = report.findings[0].detail
+    assert d["path"] == "transmogrifai_tpu/insights/x.py"
+    assert d["line"] > 0
+    assert "self._cache = {}" in d["context"]
+
+
+# ===================================================== repo-level gates
+@pytest.fixture(scope="module")
+def repo_report():
+    return C.analyze_paths(
+        [os.path.join(REPO, "transmogrifai_tpu")], root=REPO
+    )
+
+
+def test_repo_is_clean_against_committed_baseline(repo_report):
+    baseline = L.load_baseline(
+        os.path.join(REPO, "concurrency_baseline.json")
+    )
+    fresh = L.new_findings(repo_report, baseline)
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+
+
+def test_repo_has_no_potential_deadlocks(repo_report):
+    assert repo_report.by_code("TPC001") == []
+
+
+def test_repo_lock_graph_covers_the_instrumented_seams(repo_report):
+    graph = repo_report.data["lockGraph"]
+    locks = graph["locks"]
+    for key in (
+        "telemetry/metrics.py:MetricsRegistry.lock",
+        "serving/service.py:ScoringService._lock",
+        "serving/queue.py:AdmissionQueue._lock",
+        "serving/shedding.py:LoadShedder._lock",
+        "resilience/sentinel.py:SchemaSentinel._lock",
+        "resilience/sentinel.py:QuarantineLog._lock",
+        "resilience/sentinel.py:CircuitBreaker._lock",
+        "resilience/sentinel.py:DriftSentinel._window_locks[]",
+        "resilience/sentinel.py:DriftSentinel._report_lock",
+        "insights/drift.py:AttributionDriftMonitor._window_locks[]",
+        "insights/drift.py:AttributionDriftMonitor._report_lock",
+    ):
+        assert key in locks, f"{key} missing from the lock registry"
+
+
+def test_audit_service_lock_vs_registry_gauge_ordering(repo_report):
+    """The satellite audit: the service lock DOES order before the
+    registry lock (submit holds it while queue.offer sets the depth
+    gauge) — the safe direction. The PR-8 inversion (registry before
+    service, render_prometheus reaching into stats()) must stay gone."""
+    edges = {
+        (e["from"], e["to"])
+        for e in repo_report.data["lockGraph"]["edges"]
+    }
+    svc = "serving/service.py:ScoringService._lock"
+    reg = "telemetry/metrics.py:MetricsRegistry.lock"
+    assert (svc, reg) in edges
+    assert (reg, svc) not in edges, "render_prometheus ABBA is back"
+
+
+def test_audit_drift_monitor_window_vs_report_lock_ordering(repo_report):
+    """The satellite audit: the attribution drift monitor (and the input
+    DriftSentinel it mirrors) never NESTS a window lock with the report
+    lock in either order — there is no edge to invert."""
+    edges = {
+        (e["from"], e["to"])
+        for e in repo_report.data["lockGraph"]["edges"]
+    }
+    for w, r in (
+        ("insights/drift.py:AttributionDriftMonitor._window_locks[]",
+         "insights/drift.py:AttributionDriftMonitor._report_lock"),
+        ("resilience/sentinel.py:DriftSentinel._window_locks[]",
+         "resilience/sentinel.py:DriftSentinel._report_lock"),
+    ):
+        assert (w, r) not in edges and (r, w) not in edges
+
+
+def test_analyzer_full_repo_under_ten_seconds():
+    t0 = time.perf_counter()
+    C.analyze_paths([os.path.join(REPO, "transmogrifai_tpu")], root=REPO)
+    took = time.perf_counter() - t0
+    assert took < 10.0, f"analyzer took {took:.1f}s on the full repo"
+
+
+def test_package_summary_shape():
+    C.package_summary.cache_clear()
+    s = C.package_summary()
+    assert set(s) == {"findings", "codes", "locks", "edges"}
+    assert s["locks"] > 10 and s["edges"] > 0
+    assert s["findings"] == sum(s["codes"].values())

@@ -62,6 +62,20 @@ def test_tpl001_scoped_to_threaded_subsystems():
     assert "TPL001" not in _codes(report)
 
 
+def test_tpl001_local_is_thread_crossed():
+    # local/ joined the list when scoring closures started carrying
+    # service-shared breaker/guard/quarantine state and the fused holder
+    assert "local/" in L._LOCKED_SUBSYSTEMS
+    src = """
+    _CACHE = {}
+
+    def bad(key, value):
+        _CACHE[key] = value
+    """
+    report = _lint(src, "transmogrifai_tpu/local/x.py")
+    assert _codes(report) == ["TPL001"]
+
+
 def test_tpl001_locals_not_flagged():
     src = """
     def fine(n):
@@ -281,3 +295,116 @@ def test_tplint_cli_wrapper(tmp_path):
         capture_output=True, text=True, env=env, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -------------------------------------------- baseline-file failure modes
+def _run_tplint(*args, cwd=REPO):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tplint.py"), *args],
+        capture_output=True, text=True, env=env, cwd=cwd,
+    )
+
+
+def test_missing_baseline_exits_3_with_clear_message(tmp_path):
+    # a vanished baseline must NOT silently turn every accepted finding
+    # into a "new" one (exit 1) — it is its own, louder failure
+    proc = _run_tplint("--baseline", str(tmp_path / "nope.json"))
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "not found" in proc.stderr
+    assert "refusing to treat every finding as new" in proc.stderr
+
+
+def test_unparseable_baseline_exits_3_with_clear_message(tmp_path):
+    bad = tmp_path / "garbage.json"
+    bad.write_text("{not json at all")
+    proc = _run_tplint("--baseline", str(bad))
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "unparseable" in proc.stderr
+
+
+def test_missing_concurrency_baseline_exits_3(tmp_path):
+    proc = _run_tplint(
+        "--concurrency",
+        "--concurrency-baseline", str(tmp_path / "nope.json"),
+    )
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "not found" in proc.stderr
+
+
+# --------------------------------------------------- --concurrency gating
+def test_concurrency_baseline_flag_implies_the_pass():
+    # review fix: --concurrency-baseline without --concurrency must not
+    # silently skip the TPC analysis behind a green exit
+    proc = _run_tplint(
+        "--baseline", "lint_baseline.json",
+        "--concurrency-baseline", "concurrency_baseline.json",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "concurrency finding(s)" in proc.stdout
+
+
+def test_write_lint_baseline_still_gates_requested_concurrency(tmp_path):
+    # review fix: writing ONE baseline must not skip the gate for the
+    # OTHER analysis that was explicitly requested
+    bad = tmp_path / "transmogrifai_tpu" / "serving"
+    bad.mkdir(parents=True)
+    (bad / "synthetic.py").write_text(
+        "import threading\n"
+        "_A = threading.Lock()\n_B = threading.Lock()\n\n\n"
+        "def ab():\n    with _A:\n        with _B:\n            pass\n\n\n"
+        "def ba():\n    with _B:\n        with _A:\n            pass\n"
+    )
+    proc = _run_tplint(
+        "--write-baseline", str(tmp_path / "lint_bl.json"),
+        "--concurrency",
+        "--concurrency-baseline",
+        os.path.join(REPO, "concurrency_baseline.json"),
+        str(bad / "synthetic.py"),
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TPC001" in proc.stdout
+
+
+def test_write_concurrency_baseline_alone_exits_zero(tmp_path):
+    # review fix: regenerating ONE baseline must not read as a failure
+    # of the other, ungated pass (the --write-baseline mirror exits 0)
+    out = tmp_path / "conc_bl.json"
+    proc = _run_tplint("--write-concurrency-baseline", str(out))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert out.exists()
+    baseline = L.load_baseline(str(out))
+    assert isinstance(baseline, dict) or baseline is not None
+
+
+def test_cli_concurrency_green_against_committed_baseline():
+    proc = _run_tplint(
+        "--baseline", "lint_baseline.json",
+        "--concurrency",
+        "--concurrency-baseline", "concurrency_baseline.json",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "concurrency finding(s)" in proc.stdout
+    assert "order edges" in proc.stdout
+
+
+def test_cli_concurrency_fails_on_synthetic_violation(tmp_path):
+    bad = tmp_path / "transmogrifai_tpu" / "serving"
+    bad.mkdir(parents=True)
+    (bad / "synthetic.py").write_text(
+        "import threading\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n\n\n"
+        "def ab():\n    with _A:\n        with _B:\n            pass\n\n\n"
+        "def ba():\n    with _B:\n        with _A:\n            pass\n"
+    )
+    proc = _run_tplint(
+        "--concurrency",
+        "--concurrency-baseline",
+        os.path.join(REPO, "concurrency_baseline.json"),
+        str(bad / "synthetic.py"),
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TPC001" in proc.stdout

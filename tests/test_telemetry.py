@@ -489,9 +489,17 @@ def test_phase_breakdown_attributes_train_time(flagship):
     assert set(pb) == {
         "ingest", "featurize", "compile", "fit", "eval", "explain",
     }
-    # a real train spent real time fitting and transforming
+    # a real train spent real time fitting and transforming. The
+    # featurize check reads the UNROUNDED span events: when an earlier
+    # suite in the same process warmed every stage cache, the whole
+    # transform loop can legitimately take <0.5 ms, and the rounded
+    # phase_breakdown() cell floors to 0.0 — the spans must still exist
     assert pb["fit"] > 0.0
-    assert pb["featurize"] > 0.0
+    featurize_s = sum(
+        rec["dur"] for rec in flagship["events"]
+        if rec["name"].startswith("train/transform")
+    )
+    assert featurize_s > 0.0
 
 
 def test_serve_latency_histograms_have_stage_families(flagship):

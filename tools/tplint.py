@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""tplint — TP-coded invariant linter CLI (analysis/lint.py).
+"""tplint — TP-coded invariant linter CLI (analysis/lint.py +
+analysis/concurrency.py).
 
 Thin wrapper over `python -m transmogrifai_tpu lint` for direct use:
 
@@ -7,10 +8,14 @@ Thin wrapper over `python -m transmogrifai_tpu lint` for direct use:
     python tools/tplint.py --baseline lint_baseline.json
     python tools/tplint.py --write-baseline lint_baseline.json
     python tools/tplint.py transmogrifai_tpu/ops    # specific paths
+    python tools/tplint.py --concurrency \
+        --concurrency-baseline concurrency_baseline.json
 
-Exit code 1 when findings exist that the baseline does not cover.
-Rules (TPL001..TPL005) and the suppression/baseline story are catalogued
-in docs/analysis.md.
+Exit codes: 0 clean; 1 when findings exist that the baseline does not
+cover; 3 when a supplied baseline file is missing or unparseable (a
+vanished baseline must not silently turn every accepted finding "new").
+Rules (TPL001..TPL005, TPC001..TPC006) and the suppression/baseline
+story are catalogued in docs/analysis.md.
 """
 import argparse
 import os
@@ -33,12 +38,21 @@ def main(argv=None) -> int:
     parser.add_argument("--baseline", default=None)
     parser.add_argument("--write-baseline", default=None)
     parser.add_argument(
+        "--concurrency", action="store_true",
+        help="also run the TPC0xx static concurrency analysis",
+    )
+    parser.add_argument("--concurrency-baseline", default=None)
+    parser.add_argument("--write-concurrency-baseline", default=None)
+    parser.add_argument(
         "--root", default=".",
         help="paths in findings/baseline are stored relative to this",
     )
     args = parser.parse_args(argv)
     return run_lint(
-        args.paths, args.baseline, args.write_baseline, root=args.root
+        args.paths, args.baseline, args.write_baseline, root=args.root,
+        concurrency=args.concurrency,
+        concurrency_baseline=args.concurrency_baseline,
+        write_concurrency_baseline=args.write_concurrency_baseline,
     )
 
 

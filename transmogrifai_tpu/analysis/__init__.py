@@ -1,6 +1,6 @@
 """Static-analysis plane: TP-coded findings over DAGs, plans and code.
 
-Three analysers share one :class:`Finding`/:class:`Report` core
+Five analysers share one :class:`Finding`/:class:`Report` core
 (``analysis/findings.py``):
 
 * :mod:`~transmogrifai_tpu.analysis.preflight` — ``TPA0xx`` pre-flight
@@ -14,6 +14,22 @@ Three analysers share one :class:`Finding`/:class:`Report` core
 * :mod:`~transmogrifai_tpu.analysis.lint` — ``TPL0xx`` AST lint of the
   package's own invariants (``python -m transmogrifai_tpu lint``, gated
   in CI against the committed ``lint_baseline.json``).
+* :mod:`~transmogrifai_tpu.analysis.concurrency` — ``TPC0xx`` cross-module
+  static concurrency analysis: the inferred lock registry, the whole-repo
+  lock-order graph with cycle (potential-deadlock) detection,
+  guarded-field discipline, foreign-callable-under-lock, and non-atomic
+  publish checks (``python -m transmogrifai_tpu lint --concurrency``,
+  gated against ``concurrency_baseline.json``).
+* :mod:`~transmogrifai_tpu.analysis.schedule` — the dynamic side of the
+  concurrency plane: injectable instrumented locks
+  (``TPTPU_LOCK_TRACE=1``, off by default) recording the ACTUAL
+  acquisition order into a dynamic lock-order graph, and
+  ``reconcile_lock_orders`` asserting the dynamic graph is a subgraph of
+  the static one — the same static-vs-runtime reconciliation idiom as
+  the transfer census.
+
+``schedule`` is deliberately stdlib-only (and ``findings``-only) so the
+thread-crossed subsystems can import the lock seam at module-init time.
 
 See ``docs/analysis.md`` for the full code catalogue.
 """

@@ -1,0 +1,1401 @@
+"""Static concurrency analyzer — the ``TPC0xx`` finding family.
+
+The codebase is deeply multi-threaded (featurize pools, standing-service
+workers, warmup threads, telemetry exposition, drift-window locks) and
+the review trail proves the bug class recurs: PR 8 shipped — and review
+caught — a live ABBA deadlock (``render_prometheus`` invoking exposition
+sources inside the registry lock while ``submit()`` held the service
+lock), PR 9 a half-built shared cache published non-atomically. This
+module makes those shapes machine-checkable, the same way the TPA pass
+polices user DAGs and the transfer census polices device crossings:
+
+* a **lock registry** inferred from ``threading.Lock/RLock/Condition``
+  assignments, :func:`analysis.schedule.make_lock` seams (whose string
+  literal IS the canonical key, so the static and dynamic graphs share a
+  vocabulary), and lightweight ``# tpc: lock(name)`` annotations for
+  aliased locks the AST cannot connect (e.g. every ledger sharing the
+  registry lock);
+* a **whole-repo lock-order graph** from ``with``-statement nesting per
+  function. Calls are inlined through the resolved call graph: the
+  analyzer resolves same-module calls, ``self.method()``, attributes
+  typed by their constructor assignment
+  (``self.queue = AdmissionQueue(...)``), module-level singletons, and a
+  tiny return-type oracle for the metrics factories
+  (``REGISTRY.gauge(...).set`` acquires the registry lock) — and
+  propagates each function's acquisition set transitively, so an edge
+  exists whenever lock B can be acquired anywhere downstream of holding
+  lock A. The static graph deliberately OVERAPPROXIMATES the dynamic
+  one (``analysis/schedule.py``), which is what makes the
+  dynamic-subgraph reconciliation meaningful. Cycles are **TPC001**
+  potential deadlocks;
+* **guarded-field discipline**: an instance field ever written under
+  lock L must be written under a common lock at every site — bare
+  writes are **TPC002**, disagreeing guards **TPC003**
+  (``# tpc: guarded(key)`` documents caller-holds-the-lock helpers);
+* **foreign-callable-under-lock** (**TPC004** — the exact PR-8 bug
+  shape): invoking a data-derived callable (an exposition source pulled
+  out of a dict, a user callback parameter) while holding any lock;
+* **non-atomic publish** (**TPC005** — the exact PR-9 bug shape):
+  assigning a fresh mutable container to a shared attribute and then
+  filling it in across subsequent statements, instead of
+  build-locally-then-single-assign.
+
+Scope is the TPL001 thread-crossed subsystem list
+(:data:`THREAD_CROSSED_SUBSYSTEMS`, shared with the linter).
+Suppression mirrors tplint: ``# tpc: ok`` or ``# tpc: disable=TPC004``
+on the offending line. Accepted findings live in the committed
+``concurrency_baseline.json`` (same line-move-invariant key as
+``lint_baseline.json``: code, path, source line text), so CI fails only
+on NEW findings.
+
+Annotation vocabulary (all line comments):
+
+* ``# tpc: lock(key)`` — on a lock (or lock-alias) assignment or a
+  ``with`` line: canonical key override, used to tie aliased locks
+  (``self._lock = reg.lock``) to one graph node;
+* ``# tpc: guarded(key)`` — on a write or ``def`` line: this code runs
+  with ``key`` held by contract (caller-holds-the-lock helpers);
+* ``# tpc: type(Class)`` — on an attribute assignment: the attribute's
+  class when the constructor form cannot show it;
+* ``# tpc: ok`` / ``# tpc: disable=TPCnnn`` — suppress on this line.
+
+Static keys are PACKAGE-relative (``serving/service.py:ScoringService.
+_lock``); finding paths stay repo-relative like every other analyser so
+one baseline format serves both linters.
+"""
+from __future__ import annotations
+
+import ast
+import builtins as _builtins
+import functools
+import os
+import re
+from typing import Any, Iterable
+
+from .findings import Report, Severity
+
+__all__ = [
+    "THREAD_CROSSED_SUBSYSTEMS",
+    "analyze_paths",
+    "analyze_sources",
+    "default_concurrency_paths",
+    "package_summary",
+]
+
+# shared with the linter so both passes police one subsystem list
+from .lint import _LOCKED_SUBSYSTEMS as THREAD_CROSSED_SUBSYSTEMS  # noqa: E402
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_RLOCK_KINDS = {"RLock"}
+_MUTATORS = {
+    "append", "add", "update", "pop", "popitem", "setdefault", "clear",
+    "extend", "remove", "discard", "insert",
+}
+_FRESH_MUTABLE_CTORS = {
+    "dict", "list", "set", "defaultdict", "OrderedDict", "deque", "Counter",
+}
+#: attribute names that mean "someone else's code" when called under a lock
+_CALLBACK_ATTRS = {"callback", "cb", "fn", "hook"}
+#: metrics-registry factory methods whose RETURN value carries the shared
+#: registry lock — the one place attribute types flow through a factory
+_FACTORY_RETURNS = {"counter": "Counter", "gauge": "Gauge",
+                    "histogram": "Histogram"}
+#: fields whose writes are lock/thread bookkeeping, not shared state
+_EXEMPT_FIELD_SUFFIXES = ("_lock", "_locks", "_event", "_tls", "_cond")
+_CTOR_NAMES = ("__init__", "__new__", "__post_init__")
+
+_ANN_LOCK = re.compile(r"#\s*tpc:\s*lock\(\s*([^)]+?)\s*\)")
+_ANN_GUARDED = re.compile(r"#\s*tpc:\s*guarded\(\s*([^)]+?)\s*\)")
+_ANN_TYPE = re.compile(r"#\s*tpc:\s*type\(\s*([^)]+?)\s*\)")
+
+_BUILTINS = set(dir(_builtins))
+_UNSET = object()
+
+
+def _suppressed(line: str, code: str) -> bool:
+    if "tpc: ok" in line:
+        return True
+    return f"tpc: disable={code}" in line
+
+
+def _attr_chain(node: ast.expr) -> list[str]:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _pkg_rel(rel: str) -> str:
+    """Lock keys are package-relative: strip everything up to and
+    including the package directory so keys read the same whether the
+    analyzer runs over a repo checkout or an installed package."""
+    rel = rel.replace(os.sep, "/")
+    marker = "transmogrifai_tpu/"
+    i = rel.rfind(marker)
+    return rel[i + len(marker):] if i >= 0 else rel
+
+
+class _LockDef:
+    __slots__ = ("key", "kind", "repo_rel", "line")
+
+    def __init__(self, key: str, kind: str, repo_rel: str, line: int):
+        self.key = key
+        self.kind = kind  # "lock" | "rlock" | "condition" | "family"
+        self.repo_rel = repo_rel
+        self.line = line
+
+
+class _CallSite:
+    __slots__ = ("node", "held", "line", "target")
+
+    def __init__(self, node: ast.Call, held: tuple[str, ...], line: int):
+        self.node = node
+        self.held = held
+        self.line = line
+        self.target: Any = _UNSET  # memoized resolution
+
+
+class _Write:
+    __slots__ = ("field", "line", "held", "value", "subscript")
+
+    def __init__(self, field, line, held, value, subscript):
+        self.field = field
+        self.line = line
+        self.held = held
+        self.value = value
+        self.subscript = subscript
+
+
+class _FuncInfo:
+    __slots__ = (
+        "pkg_rel", "qual", "cls", "node", "acquires", "order_edges",
+        "calls", "writes", "safe_names", "publishes", "acq_star",
+        "lock_return",
+    )
+
+    def __init__(self, pkg_rel: str, qual: str, cls: str | None, node):
+        self.pkg_rel = pkg_rel
+        self.qual = qual
+        self.cls = cls
+        self.node = node
+        self.lock_return: str | None = None
+        self.reset()
+
+    def reset(self) -> None:
+        self.acquires: set[str] = set()
+        #: (held_key, acquired_key, lineno)
+        self.order_edges: list[tuple[str, str, int]] = []
+        self.calls: list[_CallSite] = []
+        self.writes: list[_Write] = []
+        self.safe_names: set[str] = set()
+        #: TPC005 candidates: field -> {"line", "held", "mutations"}
+        self.publishes: dict[str, dict[str, Any]] = {}
+        self.acq_star: set[str] | None = None
+
+
+class _Module:
+    __slots__ = (
+        "repo_rel", "pkg_rel", "tree", "lines", "funcs", "classes",
+        "mod_aliases", "from_names", "global_locks", "global_instances",
+        "scope_locks",
+    )
+
+    def __init__(self, repo_rel: str, tree: ast.Module, lines: list[str]):
+        self.repo_rel = repo_rel
+        self.pkg_rel = _pkg_rel(repo_rel)
+        self.tree = tree
+        self.lines = lines
+        self.funcs: set[str] = set()
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.mod_aliases: dict[str, list[str]] = {}   # alias -> module parts
+        self.from_names: dict[str, tuple[list[str], str]] = {}
+        self.global_locks: dict[str, _LockDef] = {}
+        self.global_instances: dict[str, str] = {}    # NAME -> class name
+        self.scope_locks: dict[str, dict[str, _LockDef]] = {}  # qual -> env
+
+
+class _Analyzer:
+    """Whole-repo analysis: pass 0 collects module surfaces, walk A
+    registers every lock/type definition (its analysis output is thrown
+    away), the in-between passes resolve aliases and lock-returning
+    functions, walk B re-analyzes every function with complete
+    knowledge, and the rule passes run over walk B's records."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, _Module] = {}          # pkg_rel -> module
+        self.class_index: dict[str, list[tuple[str, ast.ClassDef]]] = {}
+        self.attr_locks: dict[tuple[str, str, str], _LockDef] = {}
+        #: (pkg_rel, cls, attr) -> class-name string to resolve later
+        self.attr_type_names: dict[tuple[str, str, str], str] = {}
+        self.attr_types: dict[tuple[str, str, str], tuple[str, str]] = {}
+        self.functions: dict[tuple[str, str], _FuncInfo] = {}
+        #: per-module nested-def index: pkg_rel -> name -> [qual]
+        self.nested_defs: dict[str, dict[str, list[str]]] = {}
+        self.report = Report()
+        #: (from_key, to_key) -> list of (repo_rel, line)
+        self.edges: dict[tuple[str, str], list[tuple[str, int]]] = {}
+        self._pending_cond_aliases: list = []
+
+    # ---------------------------------------------------------------- helpers
+    def _line(self, mod: _Module, lineno: int) -> str:
+        if 0 < lineno <= len(mod.lines):
+            return mod.lines[lineno - 1]
+        return ""
+
+    def _ann(self, mod: _Module, lineno: int, rx: re.Pattern) -> str | None:
+        m = rx.search(self._line(mod, lineno))
+        return m.group(1).strip() if m else None
+
+    def _add_finding(
+        self, code: str, message: str, mod: _Module, lineno: int,
+        subject: str = "",
+    ) -> None:
+        context = self._line(mod, lineno).strip()
+        if _suppressed(context, code):
+            return
+        self.report.add(
+            code, message,
+            subject=subject or f"{mod.repo_rel}:{lineno}",
+            severity=Severity.WARNING,
+            path=mod.repo_rel, line=lineno, context=context,
+        )
+
+    # ------------------------------------------------------------- pass 0
+    def add_source(self, repo_rel: str, source: str) -> None:
+        repo_rel = repo_rel.replace(os.sep, "/")
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            mod = _Module(repo_rel, ast.Module(body=[], type_ignores=[]),
+                          source.splitlines())
+            self.modules[mod.pkg_rel] = mod
+            self.report.add(
+                "TPC000", f"file does not parse: {e}",
+                subject=f"{repo_rel}:{e.lineno or 0}",
+                severity=Severity.WARNING,
+                path=repo_rel, line=e.lineno or 0, context="",
+            )
+            return
+        mod = _Module(repo_rel, tree, source.splitlines())
+        self.modules[mod.pkg_rel] = mod
+        # imports are collected from the WHOLE tree: function-level
+        # imports are pervasive (lazy imports breaking cycles) and their
+        # names are just as resolvable/safe as module-level ones
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._collect_import(mod, node)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.funcs.add(stmt.name)
+            elif isinstance(stmt, ast.ClassDef):
+                mod.classes[stmt.name] = stmt
+                self.class_index.setdefault(stmt.name, []).append(
+                    (mod.pkg_rel, stmt)
+                )
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._collect_global_assign(mod, stmt)
+
+    def _collect_import(self, mod: _Module, stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                mod.mod_aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name.split(".")
+                )
+            return
+        base = [p for p in (stmt.module or "").split(".") if p]
+        for a in stmt.names:
+            name = a.asname or a.name
+            # `from ..telemetry import metrics as _tm` — a MODULE alias;
+            # `from .queue import AdmissionQueue` — a class/function name.
+            # Record both readings; resolution checks the scanned set.
+            mod.mod_aliases.setdefault(name, base + [a.name])
+            mod.from_names[name] = (base, a.name)
+
+    def _lock_call(self, value: ast.expr) -> tuple[str | None, str] | None:
+        """(explicit_key_or_None, kind) when ``value`` constructs a lock."""
+        if not isinstance(value, ast.Call):
+            return None
+        chain = _attr_chain(value.func)
+        if not chain:
+            return None
+        last = chain[-1]
+        if last == "make_lock":
+            key = None
+            if value.args and isinstance(value.args[0], ast.Constant) and \
+                    isinstance(value.args[0].value, str):
+                key = value.args[0].value
+            kind = "lock"
+            if len(value.args) > 1:
+                fchain = _attr_chain(value.args[1])
+                if fchain and fchain[-1] in _RLOCK_KINDS:
+                    kind = "rlock"
+            return key, kind
+        if last in _LOCK_FACTORIES:
+            if last == "Condition":
+                return None, "condition"
+            return None, "rlock" if last in _RLOCK_KINDS else "lock"
+        return None
+
+    def _collect_global_assign(self, mod: _Module, stmt) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        value = stmt.value
+        if value is None:
+            return
+        lock = self._lock_call(value)
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if lock is not None:
+                explicit, kind = lock
+                key = explicit or self._ann(mod, stmt.lineno, _ANN_LOCK) or \
+                    f"{mod.pkg_rel}:{t.id}"
+                mod.global_locks[t.id] = _LockDef(
+                    key, kind, mod.repo_rel, stmt.lineno
+                )
+                if kind == "condition" and isinstance(value, ast.Call) \
+                        and value.args:
+                    self._pending_cond_aliases.append(
+                        (mod, None, t.id, value.args[0])
+                    )
+            elif isinstance(value, ast.Call) and \
+                    isinstance(value.func, ast.Name) and \
+                    value.func.id in mod.classes:
+                mod.global_instances[t.id] = value.func.id
+            elif self._ann(mod, stmt.lineno, _ANN_LOCK):
+                # annotated alias of a lock defined elsewhere; aliases are
+                # usually the shared re-entrant registry lock, so rlock
+                mod.global_locks[t.id] = _LockDef(
+                    self._ann(mod, stmt.lineno, _ANN_LOCK), "rlock",
+                    mod.repo_rel, stmt.lineno,
+                )
+
+    # ----------------------------------------------------------- walks A / B
+    def scan_all(self) -> None:
+        for mod in self.modules.values():
+            for stmt in mod.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._scan_function(mod, stmt, None, stmt.name, {})
+                elif isinstance(stmt, ast.ClassDef):
+                    for sub in stmt.body:
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            self._scan_function(
+                                mod, sub, stmt.name,
+                                f"{stmt.name}.{sub.name}", {},
+                            )
+
+    def apply_cond_aliases(self) -> None:
+        """``threading.Condition(existing_lock)`` shares the wrapped
+        lock: alias the Condition's node to the wrapped lock's key."""
+        pending, self._pending_cond_aliases = self._pending_cond_aliases, []
+        for mod, cls, name, arg in pending:
+            target = self._resolve_lock_expr(mod, cls, arg, {})
+            if target is None:
+                continue
+            ld = (
+                mod.global_locks.get(name) if cls is None
+                else self.attr_locks.get((mod.pkg_rel, cls, name))
+            )
+            if ld is not None:
+                ld.key = target
+
+    def compute_lock_returns(self) -> None:
+        """A trivial lock-returning function (``return REGISTRY.lock``)
+        lets ``with snapshot_lock():`` resolve without annotations."""
+        for (pkg_rel, qual), info in self.functions.items():
+            mod = self.modules[pkg_rel]
+            scope = mod.scope_locks.get(qual, {})
+            for stmt in info.node.body:
+                if isinstance(stmt, ast.Return) and stmt.value is not None:
+                    key = self._resolve_lock_expr(
+                        mod, info.cls, stmt.value, scope
+                    )
+                    if key is not None:
+                        info.lock_return = key
+
+    def resolve_types(self) -> None:
+        for akey, tname in self.attr_type_names.items():
+            resolved = self._resolve_class_name(akey[0], tname)
+            if resolved is not None:
+                self.attr_types[akey] = resolved
+
+    def index_nested(self) -> None:
+        for (pkg_rel, qual), info in self.functions.items():
+            if "." in qual and info.cls is None:
+                self.nested_defs.setdefault(pkg_rel, {}).setdefault(
+                    qual.rsplit(".", 1)[-1], []
+                ).append(qual)
+
+    def rescan(self) -> None:
+        for info in self.functions.values():
+            info.reset()
+        self.scan_all()
+
+    def _collect_safe_names(self, mod: _Module, fn) -> set[str]:
+        """Names that resolve to code the author wrote (defs, lambdas,
+        aliases of module-level callables like
+        ``exc = TransientError if flag else FatalError``) — NOT
+        data-derived callables — anywhere inside ``fn``."""
+        module_safe = (
+            mod.funcs | set(mod.classes) | set(mod.from_names)
+            | set(mod.mod_aliases) | _BUILTINS
+        )
+
+        def _all_names_safe(expr: ast.expr, safe: set[str]) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in module_safe or expr.id in safe
+            if isinstance(expr, ast.IfExp):
+                return _all_names_safe(expr.body, safe) and _all_names_safe(
+                    expr.orelse, safe
+                )
+            if isinstance(expr, ast.BoolOp):
+                return all(_all_names_safe(v, safe) for v in expr.values)
+            return False
+
+        safe: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                safe.add(node.name)
+            elif isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Lambda) or _all_names_safe(
+                    node.value, safe
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            safe.add(t.id)
+        return safe
+
+    def _scan_function(
+        self,
+        mod: _Module,
+        fn,
+        cls: str | None,
+        qual: str,
+        enclosing_locks: dict[str, _LockDef],
+    ) -> None:
+        fid = (mod.pkg_rel, qual)
+        info = self.functions.get(fid)
+        if info is None:
+            info = self.functions[fid] = _FuncInfo(mod.pkg_rel, qual, cls, fn)
+        info.safe_names = self._collect_safe_names(mod, fn)
+        scope_locks = dict(enclosing_locks)
+        mod.scope_locks[qual] = scope_locks
+        guard_ann = self._ann(mod, fn.lineno, _ANN_GUARDED)
+        base_held: tuple[str, ...] = (guard_ann,) if guard_ann else ()
+        self._walk_stmts(mod, info, cls, qual, fn.body, base_held, scope_locks)
+
+    # -------------------------------------------------- lock-expr resolution
+    def _resolve_lock_expr(
+        self,
+        mod: _Module,
+        cls: str | None,
+        expr: ast.expr,
+        scope_locks: dict[str, _LockDef],
+    ) -> str | None:
+        """Canonical lock key for an expression, or None when it is not
+        (recognizably) a lock."""
+        if isinstance(expr, ast.Call):
+            lock = self._lock_call(expr)
+            if lock is not None and lock[0] is not None:
+                return lock[0]  # inline make_lock("key")
+            target = self._resolve_call_target(mod, cls, expr)
+            if target is not None:
+                callee = self.functions.get(target)
+                if callee is not None and callee.lock_return:
+                    return callee.lock_return
+            return None
+        if isinstance(expr, ast.Subscript):
+            return self._resolve_lock_expr(mod, cls, expr.value, scope_locks)
+        if isinstance(expr, ast.Name):
+            ld = scope_locks.get(expr.id) or mod.global_locks.get(expr.id)
+            return ld.key if ld is not None else None
+        if isinstance(expr, ast.Attribute):
+            chain = _attr_chain(expr)
+            if not chain:
+                return None
+            if chain[0] == "self" and cls is not None and len(chain) == 2:
+                ld = self._attr_lock(mod.pkg_rel, cls, chain[1])
+                return ld.key if ld is not None else None
+            # NAME.attr where NAME is a module-level singleton instance
+            if len(chain) == 2 and chain[0] in mod.global_instances:
+                ld = self._attr_lock(
+                    mod.pkg_rel, mod.global_instances[chain[0]], chain[1]
+                )
+                return ld.key if ld is not None else None
+            # alias._LOCK on an imported (scanned) module
+            if len(chain) == 2 and chain[0] in mod.mod_aliases:
+                other = self._module_for(mod, mod.mod_aliases[chain[0]])
+                if other is not None:
+                    ld = other.global_locks.get(chain[1])
+                    if ld is not None:
+                        return ld.key
+            # lock-ish but unresolvable: still a node, keyed by spelling,
+            # so ordering through it is tracked rather than dropped
+            if any("lock" in part.lower() for part in chain):
+                return f"{mod.pkg_rel}:?{'.'.join(chain)}"
+        return None
+
+    def _lock_kind(self, key: str) -> str:
+        for mod in self.modules.values():
+            for ld in mod.global_locks.values():
+                if ld.key == key:
+                    return ld.kind
+            for env in mod.scope_locks.values():
+                for ld in env.values():
+                    if ld.key == key:
+                        return ld.kind
+        for ld in self.attr_locks.values():
+            if ld.key == key:
+                return ld.kind
+        return "lock"
+
+    def _attr_lock(
+        self, pkg_rel: str, cls: str, attr: str, _depth: int = 0
+    ) -> _LockDef | None:
+        got = self.attr_locks.get((pkg_rel, cls, attr))
+        if got is not None or _depth > 5:
+            return got
+        entry = self._class_entry(pkg_rel, cls)
+        if entry is None:
+            return None
+        crel, cdef = entry
+        got = self.attr_locks.get((crel, cls, attr))
+        if got is not None:
+            return got
+        for base in cdef.bases:
+            chain = _attr_chain(base)
+            if not chain:
+                continue
+            resolved = self._resolve_class_name(crel, chain[-1])
+            if resolved is None:
+                continue
+            got = self._attr_lock(resolved[0], resolved[1], attr, _depth + 1)
+            if got is not None:
+                return got
+        return None
+
+    def _class_entry(
+        self, pkg_rel: str, cls: str
+    ) -> tuple[str, ast.ClassDef] | None:
+        mod = self.modules.get(pkg_rel)
+        if mod is not None and cls in mod.classes:
+            return pkg_rel, mod.classes[cls]
+        entries = self.class_index.get(cls) or []
+        if len(entries) == 1:
+            return entries[0]
+        return None
+
+    def _resolve_class_name(
+        self, pkg_rel: str, name: str
+    ) -> tuple[str, str] | None:
+        mod = self.modules.get(pkg_rel)
+        if mod is not None and name in mod.classes:
+            return pkg_rel, name
+        entries = self.class_index.get(name) or []
+        if len(entries) == 1:
+            return entries[0][0], name
+        return None
+
+    def _module_for(
+        self, mod: _Module, parts: list[str]
+    ) -> _Module | None:
+        """Scanned module for an import spec (best-effort suffix match)."""
+        if not parts:
+            return None
+        suffix = "/".join(parts) + ".py"
+        candidates = [
+            m for rel, m in self.modules.items()
+            if rel == suffix or rel.endswith("/" + suffix)
+        ]
+        if len(candidates) == 1:
+            return candidates[0]
+        cur_dir = os.path.dirname(mod.pkg_rel)
+        sibling = f"{cur_dir}/{parts[-1]}.py" if cur_dir else f"{parts[-1]}.py"
+        return self.modules.get(sibling)
+
+    # ------------------------------------------------------ statement walker
+    def _walk_stmts(self, mod, info, cls, qual, stmts, held, scope_locks):
+        for stmt in stmts:
+            self._walk_stmt(mod, info, cls, qual, stmt, held, scope_locks)
+
+    def _walk_stmt(self, mod, info, cls, qual, stmt, held, scope_locks):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._scan_function(
+                mod, stmt, cls, f"{qual}.{stmt.name}", scope_locks
+            )
+            return
+        if isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._scan_function(
+                        mod, sub, stmt.name,
+                        f"{qual}.{stmt.name}.{sub.name}", scope_locks,
+                    )
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: list[str] = []
+            # a '# tpc: lock(key)' on the with line aliases THE lock —
+            # only meaningful for a single-item with; on a multi-item
+            # with it would alias every item to one key, dropping locks
+            # and fabricating self-edges
+            ann = (
+                self._ann(mod, stmt.lineno, _ANN_LOCK)
+                if len(stmt.items) == 1 else None
+            )
+            for item in stmt.items:
+                self._scan_exprs(mod, info, [item.context_expr], held)
+                key = ann or self._resolve_lock_expr(
+                    mod, cls, item.context_expr, scope_locks
+                )
+                if key is None:
+                    continue
+                # self-edges are recorded too: re-acquiring a PLAIN lock
+                # is a self-deadlock (check_cycles filters rlock/family)
+                for h in held + tuple(acquired):
+                    info.order_edges.append((h, key, stmt.lineno))
+                info.acquires.add(key)
+                acquired.append(key)
+            self._walk_stmts(
+                mod, info, cls, qual, stmt.body, held + tuple(acquired),
+                scope_locks,
+            )
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._handle_assign(mod, info, cls, qual, stmt, held, scope_locks)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_exprs(mod, info, [stmt.iter], held)
+            self._walk_stmts(mod, info, cls, qual, stmt.body, held, scope_locks)
+            self._walk_stmts(
+                mod, info, cls, qual, stmt.orelse, held, scope_locks
+            )
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_exprs(mod, info, [stmt.test], held)
+            self._walk_stmts(mod, info, cls, qual, stmt.body, held, scope_locks)
+            self._walk_stmts(
+                mod, info, cls, qual, stmt.orelse, held, scope_locks
+            )
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_exprs(mod, info, [stmt.test], held)
+            self._walk_stmts(mod, info, cls, qual, stmt.body, held, scope_locks)
+            self._walk_stmts(
+                mod, info, cls, qual, stmt.orelse, held, scope_locks
+            )
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_stmts(mod, info, cls, qual, stmt.body, held, scope_locks)
+            for h in stmt.handlers:
+                self._walk_stmts(
+                    mod, info, cls, qual, h.body, held, scope_locks
+                )
+            self._walk_stmts(
+                mod, info, cls, qual, stmt.orelse, held, scope_locks
+            )
+            self._walk_stmts(
+                mod, info, cls, qual, stmt.finalbody, held, scope_locks
+            )
+            return
+        # leaf / uncommon statements: scan expressions, recurse any nested
+        # statement lists (match cases etc.) generically
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_exprs(mod, info, [child], held)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(mod, info, cls, qual, child, held, scope_locks)
+            else:
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.stmt):
+                        self._walk_stmt(
+                            mod, info, cls, qual, sub, held, scope_locks
+                        )
+                    elif isinstance(sub, ast.expr):
+                        self._scan_exprs(mod, info, [sub], held)
+
+    def _handle_assign(self, mod, info, cls, qual, stmt, held, scope_locks):
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        value = getattr(stmt, "value", None)
+        is_plain_assign = isinstance(stmt, ast.Assign)
+        guard_ann = self._ann(mod, stmt.lineno, _ANN_GUARDED)
+        eff_held = held + ((guard_ann,) if guard_ann else ())
+        lock = (
+            self._lock_call(value)
+            if value is not None and is_plain_assign else None
+        )
+        family = (
+            self._lock_family(value)
+            if value is not None and is_plain_assign else None
+        )
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if lock is not None:
+                    explicit, kind = lock
+                    key = explicit or self._ann(
+                        mod, stmt.lineno, _ANN_LOCK
+                    ) or f"{mod.pkg_rel}:{qual}.{t.id}"
+                    scope_locks.setdefault(t.id, _LockDef(
+                        key, kind, mod.repo_rel, stmt.lineno
+                    ))
+                elif family:
+                    key = family[0] or self._ann(
+                        mod, stmt.lineno, _ANN_LOCK
+                    ) or f"{mod.pkg_rel}:{qual}.{t.id}[]"
+                    scope_locks.setdefault(t.id, _LockDef(
+                        key, "family", mod.repo_rel, stmt.lineno
+                    ))
+            elif isinstance(t, ast.Attribute) and isinstance(
+                t.value, ast.Name
+            ) and t.value.id == "self" and cls is not None:
+                attr = t.attr
+                akey = (mod.pkg_rel, cls, attr)
+                if lock is not None:
+                    explicit, kind = lock
+                    key = explicit or self._ann(
+                        mod, stmt.lineno, _ANN_LOCK
+                    ) or f"{mod.pkg_rel}:{cls}.{attr}"
+                    if akey not in self.attr_locks:
+                        self.attr_locks[akey] = _LockDef(
+                            key, kind, mod.repo_rel, stmt.lineno
+                        )
+                    if kind == "condition" and isinstance(
+                        value, ast.Call
+                    ) and value.args:
+                        self._pending_cond_aliases.append(
+                            (mod, cls, attr, value.args[0])
+                        )
+                elif family:
+                    key = family[0] or self._ann(
+                        mod, stmt.lineno, _ANN_LOCK
+                    ) or f"{mod.pkg_rel}:{cls}.{attr}[]"
+                    if akey not in self.attr_locks:
+                        self.attr_locks[akey] = _LockDef(
+                            key, "family", mod.repo_rel, stmt.lineno
+                        )
+                elif is_plain_assign and self._ann(
+                    mod, stmt.lineno, _ANN_LOCK
+                ):
+                    if akey not in self.attr_locks:
+                        self.attr_locks[akey] = _LockDef(
+                            self._ann(mod, stmt.lineno, _ANN_LOCK), "rlock",
+                            mod.repo_rel, stmt.lineno,
+                        )
+                else:
+                    tname = self._ann(mod, stmt.lineno, _ANN_TYPE)
+                    if tname is None and isinstance(value, ast.Call):
+                        chain = _attr_chain(value.func)
+                        if chain and chain[-1] in _FACTORY_RETURNS:
+                            tname = _FACTORY_RETURNS[chain[-1]]
+                        elif chain and chain[-1][:1].isupper():
+                            tname = chain[-1]
+                    if tname and akey not in self.attr_type_names:
+                        self.attr_type_names[akey] = tname
+                self._record_write(
+                    info, qual, attr, stmt.lineno, eff_held, value,
+                    subscript=False,
+                )
+            elif isinstance(t, ast.Subscript):
+                base = t.value
+                if isinstance(base, ast.Attribute) and isinstance(
+                    base.value, ast.Name
+                ) and base.value.id == "self":
+                    self._record_write(
+                        info, qual, base.attr, stmt.lineno, eff_held,
+                        value, subscript=True,
+                    )
+                self._scan_exprs(mod, info, [t.slice, t.value], held)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    if isinstance(el, ast.Attribute) and isinstance(
+                        el.value, ast.Name
+                    ) and el.value.id == "self" and cls is not None:
+                        self._record_write(
+                            info, qual, el.attr, stmt.lineno, eff_held,
+                            None, subscript=False,
+                        )
+        if value is not None:
+            self._scan_exprs(mod, info, [value], held)
+
+    def _lock_family(self, value: ast.expr) -> tuple[str | None, ...] | None:
+        """``(explicit_key_or_None,)`` when ``value`` builds a dict whose
+        values are locks; the member ``make_lock("…")`` literal — the
+        canonical-key contract — wins over the derived attribute name."""
+        if isinstance(value, ast.DictComp):
+            lock = self._lock_call(value.value)
+            return (lock[0],) if lock is not None else None
+        if isinstance(value, ast.Dict):
+            members = [self._lock_call(v) for v in value.values]
+            if members and all(m is not None for m in members):
+                keys = {m[0] for m in members}
+                return (keys.pop(),) if len(keys) == 1 else (None,)
+        return None
+
+    def _fresh_mutable(self, value: ast.expr | None) -> bool:
+        if value is None:
+            return False
+        if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            chain = _attr_chain(value.func)
+            return bool(chain) and chain[-1] in _FRESH_MUTABLE_CTORS
+        return False
+
+    def _record_write(
+        self, info, qual, field, lineno, held, value, subscript,
+    ) -> None:
+        if qual.rsplit(".", 1)[-1] in _CTOR_NAMES:
+            return
+        if field.endswith(_EXEMPT_FIELD_SUFFIXES):
+            return
+        if value is not None and (
+            self._lock_call(value) or self._lock_family(value)
+        ):
+            return
+        info.writes.append(
+            _Write(field, lineno, frozenset(held), value, subscript)
+        )
+        # ---- TPC005 bookkeeping (statement order is walk order)
+        pub = info.publishes.get(field)
+        if not subscript and self._fresh_mutable(value):
+            info.publishes[field] = {
+                "line": lineno, "held": frozenset(held), "mutations": [],
+            }
+        elif subscript and pub is not None:
+            pub["mutations"].append((lineno, frozenset(held)))
+
+    # --------------------------------------------------------- expr scanning
+    def _scan_exprs(self, mod, info, exprs, held) -> None:
+        stack = [e for e in exprs if e is not None]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue  # lambda bodies run later, under unknown locks
+            if isinstance(node, ast.Call):
+                info.calls.append(_CallSite(node, held, node.lineno))
+                # TPC005: mutator-method calls on a published field
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS and \
+                        isinstance(f.value, ast.Attribute) and isinstance(
+                            f.value.value, ast.Name
+                        ) and f.value.value.id == "self":
+                    pub = info.publishes.get(f.value.attr)
+                    if pub is not None:
+                        pub["mutations"].append(
+                            (node.lineno, frozenset(held))
+                        )
+            stack.extend(
+                c for c in ast.iter_child_nodes(node)
+                if isinstance(c, ast.AST)
+            )
+
+    # ------------------------------------------------------- call resolution
+    def _resolve_call_target(
+        self, mod: _Module, cls: str | None, call: ast.Call,
+        memo: _CallSite | None = None,
+    ) -> tuple[str, str] | None:
+        """(pkg_rel, qual) of the callee, when statically resolvable."""
+        if memo is not None and memo.target is not _UNSET:
+            return memo.target
+        target = self._resolve_call_target_uncached(mod, cls, call)
+        if memo is not None:
+            memo.target = target
+        return target
+
+    def _resolve_call_target_uncached(
+        self, mod: _Module, cls: str | None, call: ast.Call,
+    ) -> tuple[str, str] | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.funcs:
+                return (mod.pkg_rel, name)
+            if name in mod.classes:
+                return self._method_target(mod.pkg_rel, name, "__init__")
+            nested = self.nested_defs.get(mod.pkg_rel, {}).get(name)
+            if nested and len(nested) == 1:
+                return (mod.pkg_rel, nested[0])
+            if name in mod.from_names:
+                base, orig = mod.from_names[name]
+                other = self._module_for(mod, base)
+                if other is not None and orig in other.funcs:
+                    return (other.pkg_rel, orig)
+                resolved = self._resolve_class_name(mod.pkg_rel, name)
+                if resolved is not None:
+                    return self._method_target(
+                        resolved[0], resolved[1], "__init__"
+                    )
+            return None
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            base = func.value
+            # REGISTRY.counter("x").inc() — the factory oracle
+            if isinstance(base, ast.Call):
+                bchain = _attr_chain(base.func)
+                if bchain and bchain[-1] in _FACTORY_RETURNS:
+                    resolved = self._resolve_class_name(
+                        mod.pkg_rel, _FACTORY_RETURNS[bchain[-1]]
+                    )
+                    if resolved is not None:
+                        return self._method_target(
+                            resolved[0], resolved[1], meth
+                        )
+                return None
+            chain = _attr_chain(base)
+            if not chain:
+                return None
+            if chain[0] == "self" and cls is not None:
+                if len(chain) == 1:
+                    return self._method_target(mod.pkg_rel, cls, meth)
+                if len(chain) == 2:
+                    atype = self.attr_types.get((mod.pkg_rel, cls, chain[1]))
+                    if atype is not None:
+                        return self._method_target(atype[0], atype[1], meth)
+                return None
+            if len(chain) == 1:
+                name = chain[0]
+                if name in mod.global_instances:
+                    return self._method_target(
+                        mod.pkg_rel, mod.global_instances[name], meth
+                    )
+                if name in mod.mod_aliases:
+                    other = self._module_for(mod, mod.mod_aliases[name])
+                    if other is not None:
+                        if meth in other.funcs:
+                            return (other.pkg_rel, meth)
+                        if meth in other.classes:
+                            return self._method_target(
+                                other.pkg_rel, meth, "__init__"
+                            )
+                return None
+            if len(chain) == 2 and chain[0] in mod.mod_aliases:
+                other = self._module_for(mod, mod.mod_aliases[chain[0]])
+                if other is not None and chain[1] in other.global_instances:
+                    return self._method_target(
+                        other.pkg_rel, other.global_instances[chain[1]], meth
+                    )
+        return None
+
+    def _method_target(
+        self, pkg_rel: str, cls: str, meth: str, _depth: int = 0
+    ) -> tuple[str, str] | None:
+        if _depth > 5:
+            return None
+        entry = self._class_entry(pkg_rel, cls)
+        if entry is None:
+            return None
+        crel, cdef = entry
+        if (crel, f"{cls}.{meth}") in self.functions:
+            return (crel, f"{cls}.{meth}")
+        for b in cdef.bases:
+            chain = _attr_chain(b)
+            if not chain:
+                continue
+            resolved = self._resolve_class_name(crel, chain[-1])
+            if resolved is not None:
+                got = self._method_target(
+                    resolved[0], resolved[1], meth, _depth + 1
+                )
+                if got is not None:
+                    return got
+        return None
+
+    # ------------------------------------------- ACQ* + edges + rule passes
+    def compute_acq_star(self) -> None:
+        """Exact transitive-acquisition fixpoint over the resolved call
+        graph: Tarjan emits SCCs children-first, so every member of an
+        SCC gets the union of the component's direct acquisitions plus
+        every already-computed callee closure — recursion (direct or
+        mutual) loses nothing."""
+        callees: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        for fid, info in self.functions.items():
+            mod = self.modules[fid[0]]
+            out: set[tuple[str, str]] = set()
+            for site in info.calls:
+                target = self._resolve_call_target(
+                    mod, info.cls, site.node, memo=site
+                )
+                if target is not None and target in self.functions:
+                    out.add(target)
+            callees[fid] = out
+        for scc in _sccs(callees):
+            closure: set[str] = set()
+            for fid in scc:
+                closure |= self.functions[fid].acquires
+            for fid in scc:
+                for callee in callees[fid]:
+                    if callee not in scc:
+                        # children-first SCC order: already computed
+                        closure |= self.functions[callee].acq_star or set()
+            for fid in scc:
+                self.functions[fid].acq_star = closure
+
+    def _acq_star(self, fid: tuple[str, str]) -> set[str]:
+        info = self.functions.get(fid)
+        if info is None or info.acq_star is None:
+            return set()
+        return info.acq_star
+
+    def build_edges(self) -> None:
+        for fid, info in sorted(self.functions.items()):
+            mod = self.modules[fid[0]]
+            for h, key, lineno in info.order_edges:
+                self._add_edge(h, key, mod.repo_rel, lineno)
+            for site in info.calls:
+                if not site.held:
+                    continue
+                target = self._resolve_call_target(
+                    mod, info.cls, site.node, memo=site
+                )
+                if target is None or target == fid:
+                    continue
+                for key in sorted(self._acq_star(target)):
+                    for h in site.held:
+                        self._add_edge(h, key, mod.repo_rel, site.line)
+
+    def _add_edge(self, a: str, b: str, repo_rel: str, lineno: int) -> None:
+        self.edges.setdefault((a, b), []).append((repo_rel, lineno))
+
+    def check_cycles(self) -> None:
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for scc in _sccs(graph):
+            nodes = sorted(scc)
+            if len(scc) < 2:
+                node = nodes[0]
+                if node not in graph.get(node, ()):
+                    continue  # no self loop
+                if self._lock_kind(node) in ("rlock", "family"):
+                    continue  # re-entrant / per-key siblings
+                cyc_edges = [(node, node)]
+            else:
+                cyc_edges = sorted(
+                    (a, b) for (a, b) in self.edges
+                    if a in scc and b in scc and a != b
+                )
+            sites = [
+                (e, *sorted(self.edges[e])[0]) for e in cyc_edges
+                if e in self.edges
+            ]
+            if not sites:
+                continue
+            first = sites[0]
+            mod = self._module_by_repo(first[1])
+            detail = "; ".join(
+                f"{a} -> {b} at {r}:{ln}" for (a, b), r, ln in sites
+            )
+            self._add_finding(
+                "TPC001",
+                "potential deadlock: lock-order cycle "
+                f"{' -> '.join(nodes + [nodes[0]])} ({detail}) — two "
+                "threads taking these locks in opposite orders will "
+                "deadlock; impose one global order or move the inner "
+                "acquisition outside the lock",
+                mod, first[2],
+            )
+
+    def _module_by_repo(self, repo_rel: str) -> _Module:
+        for m in self.modules.values():
+            if m.repo_rel == repo_rel:
+                return m
+        return next(iter(self.modules.values()))
+
+    def check_field_discipline(self) -> None:
+        by_field: dict[tuple[str, str, str], list[tuple[_FuncInfo, _Write]]]
+        by_field = {}
+        for fid, info in self.functions.items():
+            if info.cls is None:
+                continue
+            for w in info.writes:
+                by_field.setdefault(
+                    (fid[0], info.cls, w.field), []
+                ).append((info, w))
+        for (pkg_rel, cls, field), writes in sorted(by_field.items()):
+            mod = self.modules[pkg_rel]
+            locked = [(i, w) for i, w in writes if w.held]
+            bare = [(i, w) for i, w in writes if not w.held]
+            if not locked:
+                continue  # no discipline established: TPL001 territory
+            if bare:
+                guards = sorted({k for _, w in locked for k in w.held})
+                for info, w in sorted(bare, key=lambda p: p[1].line):
+                    self._add_finding(
+                        "TPC002",
+                        f"{cls}.{field} is written under "
+                        f"{'/'.join(guards)} elsewhere but bare here — a "
+                        "concurrent reader/writer can observe a torn or "
+                        "lost update; guard every write site (or mark a "
+                        "caller-holds-the-lock helper with "
+                        "'# tpc: guarded(<lock>)')",
+                        mod, w.line, subject=f"{mod.repo_rel}:{w.line}",
+                    )
+                continue
+            common: set[str] | None = None
+            for _, w in locked:
+                common = set(w.held) if common is None else (common & w.held)
+            if common is not None and not common:
+                guards = sorted({k for _, w in locked for k in w.held})
+                _, w = min(locked, key=lambda p: p[1].line)
+                self._add_finding(
+                    "TPC003",
+                    f"{cls}.{field} is written under DIFFERENT locks "
+                    f"({', '.join(guards)}) at different sites — no "
+                    "single lock serializes the field, so neither guard "
+                    "guards; pick one lock for the field",
+                    mod, w.line, subject=f"{mod.repo_rel}:{w.line}",
+                )
+
+    def check_foreign_calls(self) -> None:
+        for fid, info in sorted(self.functions.items()):
+            mod = self.modules[fid[0]]
+            safe = (
+                info.safe_names | mod.funcs | set(mod.classes)
+                | set(mod.mod_aliases) | set(mod.from_names)
+                | set(mod.global_locks) | set(mod.global_instances)
+                | _BUILTINS
+            )
+            # enclosing-scope nested defs (closure helper siblings)
+            parts = info.qual.split(".")
+            for i in range(1, len(parts)):
+                anc = self.functions.get((fid[0], ".".join(parts[:i])))
+                if anc is not None:
+                    safe |= anc.safe_names
+            for site in info.calls:
+                if not site.held:
+                    continue
+                func = site.node.func
+                flagged = None
+                if isinstance(func, ast.Name) and func.id not in safe:
+                    flagged = f"{func.id}()"
+                elif isinstance(func, ast.Attribute) and (
+                    func.attr in _CALLBACK_ATTRS
+                    or func.attr.startswith("on_")
+                ):
+                    chain = _attr_chain(func)
+                    flagged = ".".join(chain or ["<expr>", func.attr]) + "()"
+                if flagged is None:
+                    continue
+                self._add_finding(
+                    "TPC004",
+                    f"foreign callable {flagged} invoked while holding "
+                    f"{'/'.join(sorted(site.held))} — user callbacks and "
+                    "exposition sources can take arbitrary locks of their "
+                    "own (the PR-8 render_prometheus ABBA); snapshot "
+                    "under the lock, call outside it",
+                    mod, site.line,
+                )
+
+    def check_publishes(self) -> None:
+        for fid, info in sorted(self.functions.items()):
+            if info.qual.rsplit(".", 1)[-1] in _CTOR_NAMES:
+                continue
+            mod = self.modules[fid[0]]
+            for field, pub in sorted(info.publishes.items()):
+                if not pub["mutations"]:
+                    continue
+                guards: frozenset = pub["held"]
+                for _, mheld in pub["mutations"]:
+                    guards = guards & mheld
+                if guards:
+                    continue  # publish + fill all under one common lock
+                self._add_finding(
+                    "TPC005",
+                    f"non-atomic publish of self.{field}: a fresh "
+                    "container is assigned to the shared attribute and "
+                    "then filled in across later statements — a "
+                    "concurrent reader sees it half-built (the PR-9 "
+                    "cache bug); build a local, then publish with one "
+                    "assignment",
+                    mod, pub["line"],
+                )
+
+    # ---------------------------------------------------------------- output
+    def finish(self) -> Report:
+        locks: dict[str, dict[str, Any]] = {}
+        for mod in self.modules.values():
+            for ld in mod.global_locks.values():
+                locks.setdefault(ld.key, {
+                    "kind": ld.kind, "path": ld.repo_rel, "line": ld.line,
+                })
+            for env in mod.scope_locks.values():
+                for ld in env.values():
+                    locks.setdefault(ld.key, {
+                        "kind": ld.kind, "path": ld.repo_rel,
+                        "line": ld.line,
+                    })
+        for ld in self.attr_locks.values():
+            locks.setdefault(ld.key, {
+                "kind": ld.kind, "path": ld.repo_rel, "line": ld.line,
+            })
+        nodes = sorted(set(locks) | {n for e in self.edges for n in e})
+        self.report.findings.sort(
+            key=lambda f: (
+                f.detail.get("path", ""), f.detail.get("line", 0), f.code,
+            )
+        )
+        self.report.data["lockGraph"] = {
+            "locks": {k: locks[k] for k in sorted(locks)},
+            "nodes": nodes,
+            "edges": [
+                {
+                    "from": a, "to": b,
+                    "sites": [
+                        f"{r}:{ln}" for r, ln in sorted(set(sites))[:4]
+                    ],
+                }
+                for (a, b), sites in sorted(self.edges.items())
+            ],
+        }
+        return self.report
+
+
+def _sccs(graph: dict) -> list[set]:
+    """Iterative Tarjan strongly-connected components, emitted
+    children-first (reverse topological order). Nodes are any sortable
+    hashables — lock keys for the order graph, function ids for the
+    call graph."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    out: list[set] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+    return out
+
+
+# ------------------------------------------------------------------ drivers
+def analyze_sources(files: Iterable[tuple[str, str]]) -> Report:
+    """Run the whole-repo analysis over ``(repo_rel_path, source)`` pairs
+    (cross-module resolution needs every file at once, unlike the
+    per-file linter)."""
+    an = _Analyzer()
+    for rel, source in files:
+        an.add_source(rel, source)
+    an.scan_all()            # walk A: register every lock/type definition
+    an.apply_cond_aliases()
+    an.index_nested()
+    an.compute_lock_returns()
+    an.resolve_types()
+    an.rescan()              # walk B: authoritative, fully-resolved
+    an.apply_cond_aliases()  # walk B may re-discover; idempotent
+    an.compute_acq_star()
+    an.build_edges()
+    an.check_cycles()
+    an.check_field_discipline()
+    an.check_foreign_calls()
+    an.check_publishes()
+    return an.finish()
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.replace(os.sep, "/")
+    return any(seg in rel for seg in THREAD_CROSSED_SUBSYSTEMS)
+
+
+def analyze_paths(
+    paths: Iterable[str], root: str = ".", restrict: bool = True,
+) -> Report:
+    """Analyze every ``.py`` under ``paths``; with ``restrict`` (the
+    default) only files on the thread-crossed subsystem list are read —
+    single-threaded code has no lock order to get wrong."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", "node_modules")
+            ]
+            files.extend(
+                os.path.join(dirpath, f)
+                for f in filenames if f.endswith(".py")
+            )
+    pairs: list[tuple[str, str]] = []
+    for path in sorted(files):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if restrict and not _in_scope(rel):
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                pairs.append((rel, fh.read()))
+        except OSError:
+            continue
+    return analyze_sources(pairs)
+
+
+def default_concurrency_paths() -> tuple[list[str], str]:
+    """(paths, root) mirroring ``cli.default_lint_paths``: a repo
+    checkout analyzes ``transmogrifai_tpu/``, an installed package
+    analyzes itself with repo-style relative paths."""
+    if os.path.isdir("transmogrifai_tpu"):
+        return ["transmogrifai_tpu"], "."
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [pkg], os.path.dirname(pkg)
+
+
+@functools.lru_cache(maxsize=1)
+def package_summary() -> dict[str, Any]:
+    """Compact cached summary for ``summary_json()["analysis"]`` — the
+    TPC family riding beside the TPA/TPX reports. Cached per process:
+    the package's source does not change under a running train."""
+    paths, root = default_concurrency_paths()
+    report = analyze_paths(paths, root=root)
+    codes: dict[str, int] = {}
+    for f in report.findings:
+        codes[f.code] = codes.get(f.code, 0) + 1
+    graph = report.data.get("lockGraph", {})
+    return {
+        "findings": len(report.findings),
+        "codes": codes,
+        "locks": len(graph.get("locks", {})),
+        "edges": len(graph.get("edges", [])),
+    }

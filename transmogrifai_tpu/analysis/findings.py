@@ -67,6 +67,17 @@ CODES: dict[str, str] = {
     "TPL003": "jax.jit built inside an uncached function (retrace hazard)",
     "TPL004": "wall-clock call in resilience/ (inject the clock instead)",
     "TPL005": "unseeded random source",
+    # ---- TPC: concurrency analysis (analysis/concurrency.py + schedule.py)
+    "TPC000": "file does not parse — the concurrency analyzer cannot scan it",
+    "TPC001": "potential deadlock: cycle in the static lock-order graph",
+    "TPC002": "field written under a lock at some sites but bare at others",
+    "TPC003": "field guarded by different locks at different write sites",
+    "TPC004": "foreign callable (user callback / exposition source) invoked "
+              "while holding a lock",
+    "TPC005": "non-atomic publish: shared attribute built up across "
+              "multiple statements instead of build-then-single-assign",
+    "TPC006": "dynamic lock-order edge observed at runtime is invisible to "
+              "the static lock-order graph",
 }
 
 

@@ -53,10 +53,14 @@ __all__ = [
 #: serving/ + resilience/ joined when the standing service put sentinel,
 #: breaker, and shed state in front of concurrent service workers;
 #: insights/ joined when the attribution ledger/drift monitor went in
-#: front of concurrent explain sweeps)
+#: front of concurrent explain sweeps; local/ joined when scoring closures
+#: started carrying service-shared breaker/guard/quarantine state and the
+#: fused-program holder in front of concurrent service workers).
+#: The concurrency analyzer (analysis/concurrency.py, TPC0xx) scopes its
+#: whole-repo lock-order pass to this same list.
 _LOCKED_SUBSYSTEMS = (
     "featurize/", "compiler/", "utils/aot.py", "telemetry/", "serving/",
-    "resilience/", "insights/",
+    "resilience/", "insights/", "local/",
 )
 
 _MUTATORS = {

@@ -1,0 +1,292 @@
+"""Dynamic schedule reconciler — instrumented locks + the runtime
+lock-order graph, squared against the static analyzer.
+
+The static side (:mod:`~transmogrifai_tpu.analysis.concurrency`) derives
+a lock-order graph from the AST; this module derives the SAME graph from
+what the process actually did. The seam is :func:`make_lock`: the
+thread-crossed subsystems create their locks through it, naming each lock
+with the static analyzer's canonical key
+(``"serving/service.py:ScoringService._lock"``). With tracing OFF (the
+``TPTPU_LOCK_TRACE=0`` default) ``make_lock`` returns the raw
+``threading`` primitive — zero wrappers, zero cost, nothing to misbehave
+in production. With tracing ON it returns a :class:`TracedLock` that
+records, per acquisition, an edge from every lock the acquiring thread
+already holds to the new one.
+
+:func:`reconcile_lock_orders` then asserts the dynamic graph is a
+SUBGRAPH of the static one — the same static-vs-runtime reconciliation
+idiom as the transfer census (``plan_audit`` TPX census vs the PR-10
+runtime census). A dynamic edge the static analyzer cannot see (TPC006)
+means a lock acquisition flowed through a path the AST pass cannot
+resolve — exactly the blind spot where the next ABBA deadlock hides.
+
+Cross-process capture: the hammer/chaos suites run in a subprocess with
+``TPTPU_LOCK_TRACE=1`` and ``TPTPU_LOCK_TRACE_OUT=<path>``; an atexit
+hook dumps the dynamic graph as JSON for the parent to reconcile.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Any, Callable, Iterable
+
+from .findings import Report, Severity
+
+__all__ = [
+    "TracedLock",
+    "dump_dynamic",
+    "dynamic_graph",
+    "load_dynamic",
+    "make_lock",
+    "reconcile_lock_orders",
+    "reset_dynamic",
+    "trace_enabled",
+]
+
+TRACE_ENV = "TPTPU_LOCK_TRACE"
+TRACE_OUT_ENV = "TPTPU_LOCK_TRACE_OUT"
+
+#: edge -> acquisition count; writes hold _GRAPH_LOCK (TPL001)
+_GRAPH: dict[tuple[str, str], int] = {}
+_GRAPH_LOCK = threading.Lock()
+_TLS = threading.local()
+_DUMP_REGISTERED = False
+#: bumped by reset_dynamic so every thread's seen-edge cache invalidates
+#: lazily on its next acquisition (a live worker thread must re-record
+#: edges into the NEW graph, not keep suppressing them)
+_GENERATION = 0
+
+
+def trace_enabled() -> bool:
+    """True when ``TPTPU_LOCK_TRACE`` asks for instrumented locks.
+    Consulted at LOCK CREATION time: module-level locks decide at import,
+    so the env var must be set before the process starts (the hammer
+    suites run in a subprocess for exactly this reason)."""
+    return os.environ.get(TRACE_ENV, "0").strip().lower() not in (
+        "", "0", "false", "off",
+    )
+
+
+class TracedLock:
+    """A lock wrapper recording the acquisition ORDER, not timings.
+
+    Supports the full lock protocol (``with``, ``acquire``/``release``,
+    ``locked``) so it can stand in for ``threading.Lock``/``RLock``
+    anywhere the seam modules use one. Re-entrant acquisitions of the
+    same name (RLocks, per-key lock FAMILIES sharing one name) do not
+    record self-edges — a family is one node in both graphs.
+
+    Per-thread bookkeeping is a name stack in a ``threading.local``; the
+    global edge map is touched only for edges this thread has not seen
+    before, so the steady-state cost of an acquisition is one list append
+    and one set lookup.
+
+    Known limitation: releasing a traced lock from a DIFFERENT thread
+    than acquired it (legal for plain locks) cannot pop the acquiring
+    thread's stack, so that thread would record phantom held edges
+    afterwards. Every instrumented seam lock is ``with``-statement
+    scoped (the queue Condition releases/reacquires on its own thread),
+    so this cannot happen in-tree — and if a future lock does it, the
+    phantom edge surfaces LOUDLY as a TPC006 reconciliation failure
+    rather than hiding an ordering."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock: Any, name: str):
+        self._lock = lock
+        self.name = name
+
+    # ------------------------------------------------------------ recording
+    def _held_stack(self) -> list[str]:
+        stack = getattr(_TLS, "held", None)
+        if stack is None:
+            stack = _TLS.held = []
+        return stack
+
+    def _record_acquire(self) -> None:
+        stack = self._held_stack()
+        name = self.name
+        if stack:
+            seen = getattr(_TLS, "seen", None)
+            if seen is None or getattr(_TLS, "gen", -1) != _GENERATION:
+                seen = _TLS.seen = set()
+                _TLS.gen = _GENERATION
+            for held in stack:
+                if held == name:  # RLock re-entry / family sibling
+                    continue
+                edge = (held, name)
+                if edge in seen:
+                    continue
+                seen.add(edge)
+                with _GRAPH_LOCK:
+                    _GRAPH[edge] = _GRAPH.get(edge, 0) + 1
+        stack.append(name)
+
+    def _record_release(self) -> None:
+        stack = self._held_stack()
+        # release() from a different thread than acquire() is legal for
+        # plain locks; tolerate an unbalanced stack instead of corrupting
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.name:
+                del stack[i]
+                break
+
+    # ---------------------------------------------------------- lock protocol
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._record_acquire()
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        self._record_release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        self._lock.acquire()
+        self._record_acquire()
+        return True
+
+    def __exit__(self, *exc: Any) -> None:
+        self._lock.release()
+        self._record_release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TracedLock({self.name!r})"
+
+
+def make_lock(name: str, factory: Callable[[], Any] = threading.Lock):
+    """The instrumented-lock seam. Tracing off (default): returns
+    ``factory()`` unchanged — the raw primitive, zero overhead. Tracing
+    on: wraps it in a :class:`TracedLock` carrying ``name``, which MUST
+    be the static analyzer's canonical key for this lock so the two
+    graphs share a vocabulary."""
+    lock = factory()
+    if not trace_enabled():
+        return lock
+    global _DUMP_REGISTERED
+    if not _DUMP_REGISTERED:
+        with _GRAPH_LOCK:
+            if not _DUMP_REGISTERED:
+                out = os.environ.get(TRACE_OUT_ENV)
+                if out:
+                    atexit.register(dump_dynamic, out)
+                _DUMP_REGISTERED = True
+    return TracedLock(lock, name)
+
+
+# ------------------------------------------------------------------ the graph
+def dynamic_graph() -> dict[str, Any]:
+    """JSON-able snapshot of the dynamic lock-order graph."""
+    with _GRAPH_LOCK:
+        items = sorted(_GRAPH.items())
+    nodes = sorted({n for (a, b), _ in items for n in (a, b)})
+    return {
+        "traced": trace_enabled(),
+        "nodes": nodes,
+        "edges": [
+            {"from": a, "to": b, "count": c} for (a, b), c in items
+        ],
+    }
+
+
+def reset_dynamic() -> None:
+    """Drop every recorded edge (test isolation). The generation bump
+    invalidates EVERY thread's seen-edge cache lazily (checked on its
+    next acquisition), so a live worker thread re-records its edges into
+    the new graph instead of silently suppressing them."""
+    global _GENERATION
+    with _GRAPH_LOCK:
+        _GRAPH.clear()
+        _GENERATION += 1
+
+
+def dump_dynamic(path: str) -> None:
+    """Write the dynamic graph as JSON (the atexit hook of a traced
+    subprocess run)."""
+    doc = dynamic_graph()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_dynamic(path: str) -> dict[str, Any]:
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# --------------------------------------------------------------- reconciler
+def _edge_pairs(graph: dict[str, Any] | Iterable) -> list[tuple[str, str]]:
+    """Normalize either graph shape (the static analyzer's
+    ``lockGraph["edges"]`` or :func:`dynamic_graph`'s) to (from, to)
+    pairs."""
+    edges = graph.get("edges", graph) if isinstance(graph, dict) else graph
+    out: list[tuple[str, str]] = []
+    for e in edges:
+        if isinstance(e, dict):
+            out.append((e["from"], e["to"]))
+        else:
+            a, b = e[0], e[1]
+            out.append((str(a), str(b)))
+    return out
+
+
+def reconcile_lock_orders(
+    static: dict[str, Any],
+    dynamic: dict[str, Any],
+) -> Report:
+    """Assert dynamic ⊆ static: every lock-order edge the process
+    actually exercised must be visible to the static analyzer.
+
+    ``static`` is the ``lockGraph`` attachment of a
+    :func:`~transmogrifai_tpu.analysis.concurrency.analyze_paths` report
+    (or any ``{"edges": [...]}``); ``dynamic`` is
+    :func:`dynamic_graph`'s shape. Dynamic edges between locks the static
+    graph has never HEARD of (neither endpoint is a static node) are
+    reported too — an untracked lock is exactly as invisible as an
+    untracked edge. Returns a Report with one TPC006 WARNING per
+    statically-invisible edge and a ``reconciliation`` data attachment;
+    ``report.ok`` stays True (warnings don't refuse) — CI gates on
+    ``len(report)`` instead."""
+    static_edges = set(_edge_pairs(static))
+    static_nodes = set(static.get("nodes") or [])
+    for a, b in static_edges:
+        static_nodes.add(a)
+        static_nodes.add(b)
+    dynamic_edges = _edge_pairs(dynamic)
+    report = Report()
+    invisible: list[tuple[str, str]] = []
+    for a, b in sorted(set(dynamic_edges)):
+        if a == b:
+            continue  # family/re-entrant self-edges are not an ordering
+        if (a, b) in static_edges:
+            continue
+        invisible.append((a, b))
+        report.add(
+            "TPC006",
+            f"runtime acquired {b!r} while holding {a!r}, but the static "
+            "lock-order graph has no such edge — the acquisition flows "
+            "through a call path the AST pass cannot resolve (add a "
+            "'# tpc: lock(...)' annotation or an explicit type hint so "
+            "the deadlock detector can see it)",
+            subject=f"{a} -> {b}",
+            severity=Severity.WARNING,
+            path=a.split(":", 1)[0],
+            line=0,
+            context=f"{a} -> {b}",
+        )
+    report.data["reconciliation"] = {
+        "staticEdges": len(static_edges),
+        "staticNodes": len(static_nodes),
+        "dynamicEdges": len(set(dynamic_edges)),
+        "invisibleEdges": [list(e) for e in invisible],
+        "subgraph": not invisible,
+    }
+    return report

@@ -140,7 +140,8 @@ def device_f32(arr):
     with _PREFETCH_LOCK:
         hit = _PREFETCH.get(key)
         # purge dead refs opportunistically so recycled ids cannot alias
-        for k in [k for k, (r, _) in _PREFETCH.items() if r() is None]:
+        # (r is a weakref deref — runs no user code, takes no locks)
+        for k in [k for k, (r, _) in _PREFETCH.items() if r() is None]:  # tpc: disable=TPC004
             _PREFETCH.pop(k, None)
     if hit is not None:
         ref, buf = hit

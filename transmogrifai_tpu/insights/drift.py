@@ -30,11 +30,11 @@ a damaged artifact must degrade observability, never scoring.
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Any
 
 import numpy as np
 
+from ..analysis import schedule as _schedule
 from ..resilience.sentinel import (
     DriftConfig,
     _Window,
@@ -127,10 +127,16 @@ class AttributionDriftMonitor:
         self._windows = {
             name: _Window(self.config) for name in self.baselines
         }
+        # per-group lock FAMILY: one node in the lock-order graphs
         self._window_locks = {
-            name: threading.Lock() for name in self.baselines
+            name: _schedule.make_lock(
+                "insights/drift.py:AttributionDriftMonitor._window_locks[]"
+            )
+            for name in self.baselines
         }
-        self._report_lock = threading.Lock()
+        self._report_lock = _schedule.make_lock(
+            "insights/drift.py:AttributionDriftMonitor._report_lock"
+        )
 
     @property
     def enabled(self) -> bool:

@@ -1482,6 +1482,7 @@ def score_function(
     monitors.append(weakref.ref(score_one))
     # process-wide serving source (telemetry exposition) tracks it too
     with _LIVE_LOCK:
-        _LIVE_SCORE_FNS[:] = [r for r in _LIVE_SCORE_FNS if r() is not None]
+        # r is a weakref deref — runs no user code, takes no locks
+        _LIVE_SCORE_FNS[:] = [r for r in _LIVE_SCORE_FNS if r() is not None]  # tpc: disable=TPC004
         _LIVE_SCORE_FNS.append(weakref.ref(score_one))
     return score_one

@@ -36,6 +36,7 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
+from ..analysis import schedule as _schedule
 from ..telemetry import events as _tevents
 from ..types import Storage
 from ..utils.streaming_histogram import StreamingHistogram, histogram_from_values
@@ -222,7 +223,9 @@ class SchemaSentinel:
         self._fields = [
             (f.name, f.ftype) for f in raw_features if not f.is_response
         ]
-        self._lock = threading.Lock()
+        self._lock = _schedule.make_lock(
+            "resilience/sentinel.py:SchemaSentinel._lock"
+        )
         self.counts: Counter[str] = Counter()
         self.by_feature: Counter[str] = Counter()
         self.rows_seen = 0
@@ -430,7 +433,9 @@ class QuarantineLog:
 
     def __init__(self, keep: int = 1000):
         self.keep = keep
-        self._lock = threading.Lock()
+        self._lock = _schedule.make_lock(
+            "resilience/sentinel.py:QuarantineLog._lock"
+        )
         self.records: deque[QuarantineRecord] = deque(maxlen=keep)
         self.total_rows = 0
         self.total_records = 0
@@ -505,7 +510,9 @@ class CircuitBreaker:
     def __init__(self, name: str, config: BreakerConfig):
         self.name = name
         self.config = config
-        self._lock = threading.Lock()
+        self._lock = _schedule.make_lock(
+            "resilience/sentinel.py:CircuitBreaker._lock"
+        )
         self.state = "closed"
         self.consecutive_failures = 0
         self.opened_at: float | None = None
@@ -782,10 +789,16 @@ class DriftSentinel:
                 )
                 self.torn.append(name)
         self._windows = {name: _Window(self.config) for name in self.profiles}
+        # per-feature lock FAMILY: one node in the lock-order graphs
         self._window_locks = {
-            name: threading.Lock() for name in self.profiles
+            name: _schedule.make_lock(
+                "resilience/sentinel.py:DriftSentinel._window_locks[]"
+            )
+            for name in self.profiles
         }
-        self._report_lock = threading.Lock()  # alert bookkeeping + totals
+        self._report_lock = _schedule.make_lock(
+            "resilience/sentinel.py:DriftSentinel._report_lock"
+        )  # alert bookkeeping + totals
 
     @property
     def enabled(self) -> bool:

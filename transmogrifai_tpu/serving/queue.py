@@ -19,6 +19,7 @@ import threading
 from collections import deque
 from typing import Any
 
+from ..analysis import schedule as _schedule
 from ..telemetry import metrics as _tm
 
 __all__ = ["AdmissionQueue", "RejectedByAdmission"]
@@ -51,7 +52,10 @@ class AdmissionQueue:
         if max_rows < 1:
             raise ValueError("max_rows must be >= 1")
         self.max_rows = max_rows
-        self._lock = threading.Lock()
+        self._lock = _schedule.make_lock(
+            "serving/queue.py:AdmissionQueue._lock"
+        )
+        # the Condition WRAPS the queue lock: one lock, one graph node
         self._not_empty = threading.Condition(self._lock)
         self._items: deque[Any] = deque()
         self._rows = 0

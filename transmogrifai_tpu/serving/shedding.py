@@ -38,8 +38,8 @@ shedder drops back below the exit threshold.
 from __future__ import annotations
 
 import dataclasses
-import threading
 
+from ..analysis import schedule as _schedule
 from ..telemetry import events as _tevents
 from ..telemetry import metrics as _tm
 from ..telemetry import spans as _tspans
@@ -57,7 +57,7 @@ TIER_NAMES = ("normal", "shed_explain", "shed_detail", "shed_drift", "reject")
 # overloaded one still needs. Reads go through the lock-free accessors —
 # a stale read during a transition costs one extra/missing drift
 # observation or explain sweep, never correctness.
-_LOCK = threading.Lock()
+_LOCK = _schedule.make_lock("serving/shedding.py:_LOCK")
 _STATE = {"explain": 0, "detail": 0, "drift": 0}
 
 
@@ -148,7 +148,9 @@ class LoadShedder:
     def __init__(self, config: ShedConfig | None = None, capacity: int = 2048):
         self.config = config or ShedConfig()
         self.capacity = max(1, capacity)
-        self._lock = threading.Lock()
+        self._lock = _schedule.make_lock(
+            "serving/shedding.py:LoadShedder._lock"
+        )
         self.tier = 0
         self.load = 0.0
         self.transitions = 0

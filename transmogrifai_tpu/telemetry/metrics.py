@@ -33,6 +33,8 @@ import bisect
 import threading
 from typing import Any, Callable, Iterable, Sequence
 
+from ..analysis import schedule as _schedule
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -76,7 +78,13 @@ class Counter:
     def __init__(self, name: str, help: str = "", lock=None):
         self.name = name
         self.help = help
-        self._lock = lock or threading.Lock()
+        # every in-package counter is registry-built and shares the
+        # registry lock (what the tpc alias declares); a STANDALONE
+        # construction gets its own traced node, so if one ever starts
+        # ordering against real locks the schedule reconciler sees it
+        if lock is None:
+            lock = _schedule.make_lock("telemetry/metrics.py:Counter._lock")
+        self._lock = lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -96,7 +104,10 @@ class Gauge:
     def __init__(self, name: str, help: str = "", lock=None):
         self.name = name
         self.help = help
-        self._lock = lock or threading.Lock()
+        # registry-built gauges share the registry lock; see Counter
+        if lock is None:
+            lock = _schedule.make_lock("telemetry/metrics.py:Gauge._lock")
+        self._lock = lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -135,7 +146,10 @@ class Histogram:
         self._counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
         self._sum = 0.0
         self._count = 0
-        self._lock = lock or threading.Lock()
+        # registry-built histograms share the registry lock; see Counter
+        if lock is None:
+            lock = _schedule.make_lock("telemetry/metrics.py:Histogram._lock")
+        self._lock = lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
 
     def observe(self, v: float) -> None:
         i = bisect.bisect_left(self.bounds, v)
@@ -210,7 +224,12 @@ class MetricsRegistry:
     ``with registry.lock:`` brackets a consistent multi-ledger snapshot."""
 
     def __init__(self) -> None:
-        self.lock = threading.RLock()
+        # the instrumented-lock seam (analysis/schedule.py): the literal
+        # name is the static analyzer's canonical key for this lock, so
+        # the dynamic lock-order graph reconciles against the static one
+        self.lock = _schedule.make_lock(
+            "telemetry/metrics.py:MetricsRegistry.lock", threading.RLock
+        )
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[tuple, Histogram] = {}
@@ -331,7 +350,7 @@ class LedgerCore:
         self, counter_keys: Iterable[str], registry: MetricsRegistry | None = None
     ) -> None:
         reg = registry if registry is not None else REGISTRY
-        self._lock = reg.lock
+        self._lock = reg.lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
         self._keys = tuple(counter_keys)
         self._counts: dict[str, int] = {k: 0 for k in self._keys}
 
@@ -339,7 +358,7 @@ class LedgerCore:
         with self._lock:
             self._counts[key] += n
 
-    def _reset_counts(self) -> None:
+    def _reset_counts(self) -> None:  # tpc: guarded(telemetry/metrics.py:MetricsRegistry.lock)
         """Caller holds ``self._lock``."""
         self._counts = {k: 0 for k in self._keys}
 

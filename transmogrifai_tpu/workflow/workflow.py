@@ -904,6 +904,18 @@ class WorkflowModel:
             for uid, s in self.fitted.items()
             if s.metadata
         }
+        analysis = self.analysis
+        if analysis is not None:
+            # the TPC static-concurrency summary rides beside the TPA/TPX
+            # reports (lru-cached per process; contained — a broken
+            # analyzer must never break a training summary)
+            analysis = dict(analysis)
+            try:
+                from ..analysis.concurrency import package_summary
+
+                analysis["concurrency"] = package_summary()
+            except Exception:  # pragma: no cover - defensive
+                pass
         return {
             "trainRows": self.train_rows,
             "holdoutRows": self.holdout_rows,
@@ -915,7 +927,7 @@ class WorkflowModel:
             "modelSelectorSummary": sel_summary,
             "stageMetadata": stage_meta,
             "distributedResilience": self.dist_summary,
-            "analysis": self.analysis,
+            "analysis": analysis,
             "run": getattr(self, "run_report", None),
         }
 
