@@ -351,6 +351,45 @@ def bench_titanic() -> dict:
     # analog of sklearn pipeline.predict(dataframe), which also takes
     # columnar input and returns arrays (no per-value row-dict codec)
     cols_s = median_timed(lambda: f.columns(ds))
+    # program-audit verdict (analysis/program.py): the fitted serving
+    # plan's compiled programs must audit TPJ-clean modulo the accepted
+    # fused-ingest TPJ003 baseline, with the jaxpr-derived per-batch
+    # transfer counts agreeing with the static census. The verdict rides
+    # the flagship RUN_ artifact this invocation just recorded.
+    program_audit = None
+    try:
+        audit = f.audit(programs=True).to_json()
+        tpj = sorted({
+            x["code"] for x in audit["findings"]
+            if x["code"].startswith("TPJ")
+        })
+        counts = audit.get("programTransferCounts") or {}
+        census = audit.get("transferCensus") or {}
+        program_audit = {
+            "tpjCodes": tpj,
+            "clean": set(tpj) <= {"TPJ003"},  # accepted: fused ingest
+            "programsTraced": sorted(audit.get("programs") or {}),
+            "programTransferCounts": counts,
+            "censusAgrees": (
+                counts.get("hostToDevicePerBatch")
+                == census.get("hostToDeviceTransfers")
+                and counts.get("deviceToHostPerBatch")
+                == census.get("deviceToHostTransfers")
+            ),
+        }
+        if run_dir:
+            from transmogrifai_tpu.telemetry import runlog as _rl
+
+            paths = _rl.list_run_reports(run_dir)
+            if paths:
+                doc = _rl.load_run_report(paths[-1])
+                doc["run"]["programAudit"] = program_audit
+                tmp = paths[-1] + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(doc, fh, indent=2, sort_keys=True)
+                os.replace(tmp, paths[-1])
+    except Exception as e:  # the verdict must never break the bench
+        print(f"program-audit verdict skipped: {e}")
     chk = checked.origin_stage.metadata.get("sanityCheckerSummary", {})
     return {
         "train_s": train_s,
@@ -368,6 +407,9 @@ def bench_titanic() -> dict:
         "holdout_aupr": sel["holdoutEvaluation"]["AuPR"],
         "holdout_auroc": sel["holdoutEvaluation"]["AuROC"],
         "n_candidates": len(sel["validationResults"]),
+        "program_audit_clean": (
+            None if program_audit is None else program_audit["clean"]
+        ),
     }
 
 

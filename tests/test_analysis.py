@@ -104,8 +104,9 @@ def test_report_raise_if_errors_is_valueerror():
 def test_all_emittable_codes_are_catalogued():
     for code in CODES:
         # TPR: the cross-run regression sentinel (telemetry/runlog.py);
-        # TPC: the concurrency analysis plane (analysis/concurrency.py)
-        assert code[:3] in ("TPA", "TPX", "TPL", "TPR", "TPC")
+        # TPC: the concurrency analysis plane (analysis/concurrency.py);
+        # TPJ: the compiled-program contract auditor (analysis/program.py)
+        assert code[:3] in ("TPA", "TPX", "TPL", "TPR", "TPC", "TPJ")
         assert CODES[code]
 
 

@@ -1,0 +1,799 @@
+"""Compiled-program contract auditor (analysis/program.py, TPJ0xx):
+seeded positive/negative corpus for every TPJ code — including a
+reconstruction of the PR-11 constant-vs-traced-arg contract as the
+TPJ001 positive — the bucket-boundary TPJ005 fingerprint invariants
+across ``compiler.bucketing.lane_bucket`` boundaries (padded-vs-unpadded
+lane-0-replay twins included), warmup-map reconciliation (TPJ010),
+three-way transfer-census agreement on a fitted flagship flow (TPJ006),
+the unified comment-directive parser, the bank-admission audit gate
+(``TPTPU_PROGRAM_AUDIT=1``) with its overhead guard, the CLI
+``--programs`` gate, and the whole-registry <30 s bound.
+Marker: ``analysis``.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.analysis import findings as F
+from transmogrifai_tpu.analysis import program as P
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(report):
+    return [f.code for f in report.findings]
+
+
+# ---------------------------------------------------------------- directives
+class TestDirectives:
+    def test_unified_and_legacy_spellings_parse(self):
+        assert F.parse_directives("# tp: ok") == [("tp", "ok", "")]
+        assert F.parse_directives("# tplint: disable=TPL003") == [
+            ("tplint", "disable", "TPL003")
+        ]
+        assert F.parse_directives("x = 1  # tpc: lock(metrics.py:REG.lock)") \
+            == [("tpc", "lock", "metrics.py:REG.lock")]
+        assert F.parse_directives("# tpj: disable=TPJ001,TPJ004") == [
+            ("tpj", "disable", "TPJ001"), ("tpj", "disable", "TPJ004"),
+        ]
+
+    def test_suppression_honours_family_and_unified_prefixes(self):
+        assert F.suppressed("# tp: ok", "TPL001")
+        assert F.suppressed("# tp: disable=TPJ007", "TPJ007")
+        assert F.suppressed("# tpj: ok", "TPJ007")
+        assert F.suppressed("# tplint: disable=TPL003", "TPL003")
+        # a different family's prefix must NOT leak across
+        assert not F.suppressed("# tpc: ok", "TPL001")
+        assert not F.suppressed("# tpj: ok", "TPC001")
+        assert not F.suppressed("# tp: disable=TPJ007", "TPJ008")
+
+    def test_annotations_shared_parser(self):
+        assert F.annotations("# tpc: guarded(k)", "guarded", "tpc") == ["k"]
+        assert F.annotations("# tp: lock(a.py:L)", "lock", "tpc") == \
+            ["a.py:L"]
+        assert F.annotations("# tpc: lock(x)", "guarded", "tpc") == []
+
+    def test_trailing_rationale_does_not_corrupt_disable_code(self):
+        # the old substring parsers honored this shape; the shared
+        # grammar must too (review regression)
+        line = "x = f()  # tplint: disable=TPL003 SEE DOCS"
+        assert F.suppressed(line, "TPL003")
+        assert F.suppressed("y()  # tp: disable=TPC004 — weakref prune",
+                            "TPC004")
+        assert not F.suppressed(line, "TPL004")
+
+    def test_legacy_spelling_warns_once(self, caplog):
+        F._warned_legacy.discard("tplint")
+        import logging
+
+        with caplog.at_level(logging.WARNING,
+                             logger="transmogrifai_tpu.analysis.findings"):
+            F.parse_directives("# tplint: ok")
+            F.parse_directives("# tplint: ok")
+        hits = [r for r in caplog.records if "deprecated" in r.message]
+        assert len(hits) == 1
+
+
+# ----------------------------------------------------------------- IR corpus
+def _trace_report(fn, *args, statics=None, name="probe", **spec_kw):
+    spec = P.ProgramSpec(
+        name=name, fn=fn,
+        build=lambda b: (args, statics or {}),
+        buckets=(1,), **spec_kw,
+    )
+    return P.audit_spec(spec)
+
+
+class TestIRChecks:
+    def test_tpj001_constant_folded_model_array_flagged(self):
+        """The PR-11 contract reconstruction: a model array closed over
+        by the program bakes into the jaxpr as a giant constant — one
+        executable per model, exactly what structural-fingerprint keying
+        exists to prevent."""
+        import jax
+
+        baked = np.ones((256, 256), dtype=np.float32)  # 256 KiB
+
+        def scores(x):
+            return x @ baked
+
+        rep = _trace_report(
+            scores, jax.ShapeDtypeStruct((4, 256), "float32"),
+            name="baked",
+        )
+        assert "TPJ001" in _codes(rep)
+        f = rep.by_code("TPJ001")[0]
+        assert f.detail["nbytes"] == baked.nbytes
+        assert f.severity is F.Severity.ERROR
+
+    def test_tpj001_traced_arg_negative(self):
+        import jax
+
+        def scores(x, w):
+            return x @ w
+
+        rep = _trace_report(
+            scores,
+            jax.ShapeDtypeStruct((4, 256), "float32"),
+            jax.ShapeDtypeStruct((256, 256), "float32"),
+            name="traced",
+        )
+        assert "TPJ001" not in _codes(rep)
+
+    def test_tpj001_small_constant_tolerated(self):
+        import jax
+
+        table = np.arange(8, dtype=np.float32)
+
+        def f(x):
+            return x + table
+
+        rep = _trace_report(
+            f, jax.ShapeDtypeStruct((8,), "float32"), name="small"
+        )
+        assert "TPJ001" not in _codes(rep)
+
+    def test_tpj002_x64_leak_flagged(self):
+        import jax
+
+        def f(x):
+            return x.astype("float64").sum()
+
+        with jax.experimental.enable_x64():
+            rep = _trace_report(
+                f, jax.ShapeDtypeStruct((4,), "float32"), name="x64"
+            )
+        assert "TPJ002" in _codes(rep)
+        assert rep.by_code("TPJ002")[0].severity is F.Severity.ERROR
+
+    def test_tpj002_weak_output_warned_strong_negative(self):
+        import jax
+        import jax.numpy as jnp
+
+        # an all-literal computation escapes as a weak-typed OUTPUT: its
+        # dtype is decided by the caller's promotion rules, not pinned
+        rep = _trace_report(
+            lambda x: jnp.sin(2.0),
+            jax.ShapeDtypeStruct((4,), "float32"), name="weakout",
+        )
+        weak = rep.by_code("TPJ002")
+        assert weak and weak[0].severity is F.Severity.WARNING
+
+        rep = _trace_report(
+            lambda x: x * 2.0,
+            jax.ShapeDtypeStruct((4,), "float32"), name="strong",
+        )
+        assert "TPJ002" not in _codes(rep)
+
+    def test_tpj004_host_callback_flagged(self):
+        import jax
+
+        def f(x):
+            jax.debug.print("x = {}", x)
+            return x * 2
+
+        rep = _trace_report(
+            f, jax.ShapeDtypeStruct((4,), "float32"), name="cb"
+        )
+        assert "TPJ004" in _codes(rep)
+
+    def test_tpj004_clean_program_negative(self):
+        import jax
+
+        rep = _trace_report(
+            lambda x: x * 2, jax.ShapeDtypeStruct((4,), "float32"),
+            name="clean",
+        )
+        assert _codes(rep) == []
+
+    def test_tpj003_unaliased_donation_flagged(self):
+        """Donating an arg that can never alias the output (dtype
+        mismatch) is a dead declaration."""
+        import jax
+
+        def f(x, y):
+            return y * 2.0
+
+        spec = P.ProgramSpec(
+            name="deaddonate", fn=jax.jit(f), base_fn=f,
+            build=lambda b: (
+                (
+                    jax.ShapeDtypeStruct((8,), "int32"),
+                    jax.ShapeDtypeStruct((8,), "float32"),
+                ),
+                {},
+            ),
+            buckets=(1,), donate_argnums=(0,),
+        )
+        rep = P.audit_spec(spec)
+        assert "TPJ003" in _codes(rep)
+
+    def test_tpj003_aliased_donation_negative(self):
+        import jax
+
+        def f(x):
+            return x * 2.0
+
+        spec = P.ProgramSpec(
+            name="livedonate", fn=jax.jit(f), base_fn=f,
+            build=lambda b: (
+                (jax.ShapeDtypeStruct((8,), "float32"),), {}
+            ),
+            buckets=(1,), donate_argnums=(0,),
+        )
+        rep = P.audit_spec(spec)
+        assert "TPJ003" not in _codes(rep)
+
+    def test_tpj005_structure_fork_flagged(self):
+        """A program whose structure depends on the bucket (a python
+        branch on lane count) forks the compiled family."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            if x.shape[0] > 4:  # structure forks on the bucketed axis
+                return jnp.sort(x)
+            return x * 2
+
+        spec = P.ProgramSpec(
+            name="fork", fn=f,
+            build=lambda k: (
+                (jax.ShapeDtypeStruct((k,), "float32"),), {}
+            ),
+            buckets=(4, 8), bucket_axis="lanes",
+        )
+        rep = P.audit_spec(spec)
+        assert "TPJ005" in _codes(rep)
+        detail = rep.by_code("TPJ005")[0].detail
+        assert set(detail["fingerprints"]) == {"4", "8"}
+
+    def test_tpj000_untraceable_program_degrades(self):
+        def boom(x):
+            raise RuntimeError("no trace for you")
+
+        spec = P.ProgramSpec(
+            name="boom", fn=boom,
+            build=lambda k: ((np.zeros(3, np.float32),), {}),
+            buckets=(1,),
+        )
+        rep = P.audit_spec(spec)
+        assert _codes(rep) == ["TPJ000"]
+
+
+# ----------------------------------------------------- bucket-boundary TPJ005
+class TestBucketBoundaries:
+    """The GLM sweep programs must keep ONE jaxpr structure across every
+    ``lane_bucket`` family boundary — pow2 (<=64) and 32-multiples — so a
+    future bucket-schedule change that forks program structure fails CI
+    here."""
+
+    def _fingerprints(self, name, buckets):
+        spec = [s for s in P.collect_specs([name]) if s.name == name][0]
+        out = {}
+        for b in buckets:
+            args, statics = spec.build(b)
+            closed = P._trace_closed(spec.fn, args, statics)
+            out[b] = P.jaxpr_fingerprint(closed)
+        return out
+
+    def test_glm_sweep_structure_stable_across_lane_buckets(self):
+        from transmogrifai_tpu.compiler.bucketing import lane_bucket
+
+        buckets = sorted({lane_bucket(k) for k in (3, 5, 17, 33, 65, 90)})
+        assert any(b <= 64 for b in buckets) and any(b > 64 for b in buckets)
+        for name in ("logistic_binary_batched", "linear_batched"):
+            fps = self._fingerprints(name, buckets)
+            assert len(set(fps.values())) == 1, (name, fps)
+
+    def test_padded_vs_unpadded_lane0_replay_twins(self):
+        """k=5 padded onto the 8-bucket must be the SAME program as a
+        native k=8 sweep (the pad replays lane 0 — structure identical,
+        shapes identical after padding)."""
+        from transmogrifai_tpu.compiler.bucketing import (
+            lane_bucket, pad_lane_arrays,
+        )
+
+        k = 5
+        bucket = lane_bucket(k)
+        assert bucket == 8
+        rm = np.ones((k, 16), np.float32)
+        reg = np.zeros(k, np.float32)
+        en = np.zeros(k, np.float32)
+        padded = pad_lane_arrays(bucket, rm, reg, en)
+        assert all(a.shape[0] == bucket for a in padded)
+
+        from transmogrifai_tpu.models.solvers import (
+            fit_logistic_binary_batched,
+        )
+
+        x = np.zeros((16, 3), np.float32)
+        y = np.zeros(16, np.float32)
+        statics = dict(num_iters=2, fit_intercept=True, standardization=True)
+        fp_padded = P.jaxpr_fingerprint(P._trace_closed(
+            fit_logistic_binary_batched, (x, y, *padded), statics
+        ))
+        native = (np.ones((8, 16), np.float32), np.zeros(8, np.float32),
+                  np.zeros(8, np.float32))
+        fp_native = P.jaxpr_fingerprint(P._trace_closed(
+            fit_logistic_binary_batched, (x, y, *native), statics
+        ))
+        assert fp_padded == fp_native
+
+    def test_serving_programs_stable_across_batch_buckets(self):
+        for name in ("bin_data", "predict_boosted", "predict_forest",
+                     "fused_serve", "fused_serve_explain"):
+            fps = self._fingerprints(name, (8, 16, 32))
+            assert len(set(fps.values())) == 1, (name, fps)
+
+
+# -------------------------------------------------------------- registry
+class TestRegistry:
+    def test_every_warmup_mapped_program_registers_a_spec(self):
+        from transmogrifai_tpu.compiler import warmup as W
+
+        mapped = set(W.SCORE_PROGRAMS)
+        for fam in W._FAMILY_PROGRAMS.values():
+            mapped.update(fam)
+        registered = {s.name for s in P.collect_specs()}
+        assert mapped <= registered, mapped - registered
+
+    def test_registry_audit_is_tpj_clean_modulo_baseline(self):
+        """Every program in SCORE_PROGRAMS + the fused builders audits
+        clean except the two ACCEPTED fused-ingest TPJ003s carried by the
+        committed baseline."""
+        from transmogrifai_tpu.analysis import lint as L
+        from transmogrifai_tpu.compiler import warmup as W
+
+        rep = P.audit_programs(include_ast=False)
+        traced = rep.data["programs"]
+        assert set(W.SCORE_PROGRAMS) <= set(traced)
+        baseline = L.load_baseline(os.path.join(REPO,
+                                                "program_baseline.json"))
+        fresh = L.new_findings(rep, baseline)
+        assert fresh == [], [f.render() for f in fresh]
+
+    def test_tpj010_unregistered_map_entry_flagged(self):
+        rep = P.warmup_map_findings(
+            specs=P.collect_specs(),
+            score_programs=frozenset({"predict_boosted", "ghost_program"}),
+            family_programs={},
+        )
+        assert "TPJ010" in _codes(rep)
+        assert "ghost_program" in rep.by_code("TPJ010")[0].message
+
+    def test_tpj010_unmapped_scoring_spec_flagged(self):
+        spec = P.ProgramSpec(
+            name="orphan_scorer", fn=lambda x: x,
+            build=lambda b: ((), {}), scoring=True,
+        )
+        rep = P.warmup_map_findings(
+            specs=[spec], score_programs=frozenset(), family_programs={},
+        )
+        assert "TPJ010" in _codes(rep)
+        assert "orphan_scorer" in rep.by_code("TPJ010")[0].message
+
+    def test_tpj010_negative_consistent_maps(self):
+        rep = P.warmup_map_findings(specs=P.collect_specs())
+        assert "TPJ010" not in _codes(rep)
+
+    def test_broken_registration_surfaces_as_tpj000(self, monkeypatch):
+        """A module whose program_trace_specs() raises must show up as a
+        TPJ000 finding, not silently shrink the audited set."""
+        monkeypatch.setattr(
+            P, "SPEC_MODULES",
+            P.SPEC_MODULES + ("transmogrifai_tpu.no_such_module",),
+        )
+        rep = P.audit_programs(include_ast=False)
+        mods = [
+            f for f in rep.by_code("TPJ000")
+            if "no_such_module" in f.subject
+        ]
+        assert mods and "MISSING" in mods[0].message
+
+    def test_whole_registry_pass_under_pinned_bound(self):
+        t0 = time.monotonic()
+        rep = P.audit_programs(root=REPO)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 30.0, f"--programs pass took {elapsed:.1f}s"
+        assert len(rep.data["programs"]) >= 14
+
+
+# ------------------------------------------------------------- AST (TPJ007-9)
+def _hazards(src, rel="transmogrifai_tpu/models/x.py"):
+    return P.tracing_hazard_source(textwrap.dedent(src), rel)
+
+
+class TestTracingHazards:
+    def test_tpj007_if_while_on_traced_flagged(self):
+        rep = _hazards("""
+            import jax
+
+            @jax.jit
+            def f(x, y):
+                if x > 0:
+                    return y
+                while y < 3:
+                    y = y + 1
+                return y
+        """)
+        assert _codes(rep) == ["TPJ007", "TPJ007"]
+
+    def test_tpj007_static_shape_isnone_negatives(self):
+        rep = _hazards("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("mode",))
+            def f(x, grp, *, mode):
+                if mode == "a":
+                    return x
+                if x.ndim == 2:
+                    return x
+                if grp is None:
+                    return x
+                if isinstance(grp, tuple):
+                    return x
+                return x
+        """)
+        assert _codes(rep) == []
+
+    def test_tpj008_sync_coercions_flagged(self):
+        rep = _hazards("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                a = x.item()
+                b = float(x)
+                c = np.asarray(x)
+                return a + b + c.sum()
+        """)
+        assert _codes(rep) == ["TPJ008", "TPJ008", "TPJ008"]
+
+    def test_tpj008_negatives_on_statics_and_hosts(self):
+        rep = _hazards("""
+            import jax
+            import numpy as np
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("k",))
+            def f(x, *, k):
+                return x * float(k)
+
+            def host_path(rows):
+                return np.asarray(rows)
+        """)
+        assert _codes(rep) == []
+
+    def test_tpj009_closure_capture_flagged_both_scopes(self):
+        rep = _hazards("""
+            import jax
+            import numpy as np
+
+            TABLE = np.asarray([1.0, 2.0])
+
+            @jax.jit
+            def module_capture(z):
+                return z + TABLE
+
+            def factory():
+                w = np.zeros((4, 4))
+                @jax.jit
+                def inner(z):
+                    return z @ w
+                return inner
+        """)
+        assert _codes(rep) == ["TPJ009", "TPJ009"]
+
+    def test_tpj009_negative_passed_as_arg(self):
+        rep = _hazards("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(z, w):
+                return z @ w
+
+            def caller():
+                w = np.zeros((4, 4))
+                return f(np.ones(4), w)
+        """)
+        assert _codes(rep) == []
+
+    def test_wrap_by_name_jit_detected(self):
+        rep = _hazards("""
+            import jax
+
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+
+            g = jax.jit(f)
+        """)
+        assert _codes(rep) == ["TPJ007"]
+
+    def test_suppression_unified_and_tpj_dialects(self):
+        rep = _hazards("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:  # tpj: ok — two-shape family is intentional
+                    return x
+                return -x
+
+            @jax.jit
+            def g(x):
+                if x > 0:  # tp: disable=TPJ007
+                    return x
+                return -x
+        """)
+        assert _codes(rep) == []
+
+    def test_repo_surface_is_hazard_clean(self):
+        rep = P.tracing_hazards_paths(root=REPO)
+        assert _codes(rep) == [], [f.render() for f in rep.findings]
+
+
+# ------------------------------------------------------- census third leg
+class TestThreeWayCensus:
+    def test_program_counts_fused_vs_staged(self):
+        counts = P.program_transfer_counts(fused=object())
+        assert counts["hostToDevicePerBatch"] == 1
+        assert counts["deviceToHostPerBatch"] == 1
+        empty = P.program_transfer_counts(plan=[])
+        assert empty["hostToDevicePerBatch"] == 0
+
+    def test_tpj006_disagreement_flagged_and_agreement_clean(self):
+        static = {"hostToDeviceTransfers": 1, "deviceToHostTransfers": 1}
+        ok = P.reconcile_program_census(
+            static, {"hostToDevicePerBatch": 1, "deviceToHostPerBatch": 1}
+        )
+        assert _codes(ok) == []
+        bad = P.reconcile_program_census(
+            static, {"hostToDevicePerBatch": 2, "deviceToHostPerBatch": 1}
+        )
+        assert _codes(bad) == ["TPJ006"]
+        assert bad.by_code("TPJ006")[0].detail["programH2d"] == 2
+
+    def test_reconcile_transfer_census_grows_program_leg(self):
+        from transmogrifai_tpu.telemetry import runlog as rl
+
+        runtime = {"h2dTransfers": 3, "h2dBytes": 300,
+                   "d2hTransfers": 3, "d2hBytes": 288}
+        static = {"hostToDeviceTransfers": 1, "deviceToHostTransfers": 1,
+                  "downBytesPerRow": 1.0}
+        rec = rl.reconcile_transfer_census(
+            runtime, static, rows=288, batches=3, check_uploads=True,
+            program_counts={"hostToDevicePerBatch": 1,
+                            "deviceToHostPerBatch": 1},
+        )
+        assert rec["consistent"] and rec["programConsistent"]
+        bad = rl.reconcile_transfer_census(
+            runtime, static, rows=288, batches=3,
+            program_counts={"hostToDevicePerBatch": 2,
+                            "deviceToHostPerBatch": 2},
+        )
+        assert not bad["programConsistent"]
+        assert not bad["consistent"]
+
+
+# -------------------------------------------------- fitted flagship flow
+@pytest.fixture(scope="module")
+def flagship():
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.local.scoring import score_function
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.types.columns import column_from_values
+    from transmogrifai_tpu.utils import uid as uid_util
+    from transmogrifai_tpu.workflow.workflow import Workflow
+    import transmogrifai_tpu.types as T
+
+    rng = np.random.default_rng(17)
+    n = 128
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    city = [["bern", "kyiv", "oslo", "lomé"][i % 4] for i in range(n)]
+    label = (x1 + 0.5 * x2 > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "age": column_from_values(T.Real, x1),
+        "income": column_from_values(T.Real, x2),
+        "city": column_from_values(T.PickList, city),
+    })
+    uid_util.reset()
+    resp, preds = from_dataset(ds, response="label")
+    vec = resp.sanity_check(
+        transmogrify(list(preds)), remove_bad_features=True
+    )
+    pred = BinaryClassificationModelSelector(
+        seed=7, num_folds=2,
+        models=[(LogisticRegression(), {"reg_param": [0.01]})],
+    ).set_input(resp, vec).get_output()
+    model = (
+        Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    )
+    rows = [
+        {"age": float(a), "income": float(b), "city": c}
+        for a, b, c in zip(x1, x2, city)
+    ]
+    return {"model": model, "rows": rows, "score_function": score_function}
+
+
+class TestFittedFlow:
+    def test_audit_programs_true_on_fitted_closure(self, flagship,
+                                                   monkeypatch):
+        monkeypatch.setenv("TPTPU_HOST_PREDICT_MAX", "4")
+        fn = flagship["score_function"](flagship["model"])
+        fn.batch(flagship["rows"][:32])
+        rep = fn.audit(programs=True)
+        js = rep.to_json()
+        codes = {f["code"] for f in js["findings"]}
+        # the fitted fused program audits clean modulo the ACCEPTED
+        # fused-ingest TPJ003 (see program_baseline.json)
+        assert codes <= {"TPJ003"}, codes
+        assert "fused_serve" in js["programs"]
+        assert js["programTransferCounts"]["hostToDevicePerBatch"] == 1
+
+    def test_three_way_census_exact_agreement(self, flagship, monkeypatch):
+        from transmogrifai_tpu.telemetry import runlog as rl
+
+        monkeypatch.setenv("TPTPU_HOST_PREDICT_MAX", "4")
+        fn = flagship["score_function"](flagship["model"])
+        rows = flagship["rows"][:32]
+        fn.batch(rows)  # bring-up
+        before = rl.snapshot()
+        for _ in range(3):
+            fn.batch(rows)
+        runtime = rl.delta(before)
+        js = fn.audit(programs=True).to_json()
+        rec = rl.reconcile_transfer_census(
+            runtime, js["transferCensus"], rows=96, batches=3,
+            check_uploads=True,
+            program_counts=js["programTransferCounts"],
+        )
+        assert rec["programConsistent"], rec
+        assert rec["consistent"], rec
+
+    def test_fitted_fused_program_tpj001_guard(self, flagship, monkeypatch):
+        """The fitted program's model arrays arrive as traced args — no
+        giant constant ever folds into the fused jaxpr."""
+        monkeypatch.setenv("TPTPU_HOST_PREDICT_MAX", "4")
+        fn = flagship["score_function"](flagship["model"])
+        fn.batch(flagship["rows"][:32])
+        rep = fn.audit(programs=True)
+        assert rep.by_code("TPJ001") == []
+
+
+# ------------------------------------------------------ bank admission
+class TestBankAdmission:
+    def test_audit_gate_rejects_contract_violator(self, tmp_path,
+                                                  monkeypatch):
+        import jax
+
+        from transmogrifai_tpu.compiler import stats as cstats
+        from transmogrifai_tpu.utils import aot
+
+        monkeypatch.setenv("TPTPU_COMPILE_CACHE", str(tmp_path))
+        monkeypatch.setenv("TPTPU_PROGRAM_AUDIT", "1")
+        baked = np.ones((256, 256), dtype=np.float32)
+        jfn = jax.jit(lambda x: (x @ baked).sum())
+        before = cstats.snapshot()
+        out = aot.aot_call(
+            "tpj_violator", jfn, (np.ones((4, 256), np.float32),), {}
+        )
+        assert np.isfinite(float(out))
+        aot._drain_exports()
+        delta = cstats.delta(before)
+        assert delta["programAuditRejected"] == 1
+        blobs = [
+            f for base, _, fs in os.walk(tmp_path) for f in fs
+            if f.endswith(".jaxexec") and "tpj_violator" in f
+        ]
+        assert blobs == []
+
+    def test_audit_gate_admits_clean_program(self, tmp_path, monkeypatch):
+        import jax
+
+        from transmogrifai_tpu.compiler import stats as cstats
+        from transmogrifai_tpu.utils import aot
+
+        monkeypatch.setenv("TPTPU_COMPILE_CACHE", str(tmp_path))
+        monkeypatch.setenv("TPTPU_PROGRAM_AUDIT", "1")
+        jfn = jax.jit(lambda x, w: (x @ w).sum())
+        before = cstats.snapshot()
+        aot.aot_call(
+            "tpj_clean", jfn,
+            (np.ones((4, 8), np.float32), np.ones((8, 8), np.float32)), {},
+        )
+        aot._drain_exports()
+        delta = cstats.delta(before)
+        assert delta["programsAudited"] >= 1
+        assert delta["programAuditRejected"] == 0
+        blobs = [
+            f for base, _, fs in os.walk(tmp_path) for f in fs
+            if f.endswith(".jaxexec") and "tpj_clean" in f
+        ]
+        assert len(blobs) == 1
+
+    def test_audit_gate_admits_warning_only_program(self, tmp_path,
+                                                    monkeypatch):
+        """WARNING findings (e.g. a weak-typed auxiliary output) are
+        reported, not refused — only ERROR-class contract violations
+        block a blob."""
+        import jax
+
+        from transmogrifai_tpu.compiler import stats as cstats
+        from transmogrifai_tpu.utils import aot
+
+        monkeypatch.setenv("TPTPU_COMPILE_CACHE", str(tmp_path))
+        monkeypatch.setenv("TPTPU_PROGRAM_AUDIT", "1")
+        jfn = jax.jit(lambda x: (x.sum(), 1.0 + 2.0))  # weak 2nd output
+        before = cstats.snapshot()
+        aot.aot_call(
+            "tpj_weak_out", jfn, (np.ones((4,), np.float32),), {}
+        )
+        aot._drain_exports()
+        delta = cstats.delta(before)
+        assert delta["programAuditRejected"] == 0
+        blobs = [
+            f for _, _, fs in os.walk(tmp_path)
+            for f in fs if "tpj_weak_out" in f
+        ]
+        assert len(blobs) == 1
+
+    def test_gate_off_overhead_is_noise(self):
+        """<2% overhead guard, absolute-cost pattern: with the env unset
+        the admission gate is one dict read — not measurable against a
+        1 ms budget for a thousand checks."""
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            os.environ.get("TPTPU_PROGRAM_AUDIT", "0") == "1"
+        assert time.perf_counter() - t0 < 0.01
+
+
+# --------------------------------------------------------------- CLI gate
+def _run_cli(args, cwd=REPO, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu", "lint", *args],
+        capture_output=True, text=True, cwd=cwd, env=env,
+    )
+
+
+@pytest.mark.slow
+class TestCLI:
+    def test_programs_gate_green_against_committed_baseline(self):
+        proc = _run_cli(
+            ["--programs", "--program-baseline", "program_baseline.json"]
+        )
+        assert proc.returncode in (0, 1), proc.stdout + proc.stderr
+        assert "program finding(s)" in proc.stdout
+        assert "programs traced" in proc.stdout
+
+    def test_missing_program_baseline_exits_3(self):
+        proc = _run_cli(
+            ["--programs", "--program-baseline", "no_such_baseline.json"]
+        )
+        assert proc.returncode == 3, proc.stdout + proc.stderr
+        assert "baseline file not found" in proc.stderr
+
+    def test_all_runs_every_gate_with_summary_table(self):
+        proc = _run_cli(["--all"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        for fam in ("TPL", "TPC", "TPJ"):
+            assert fam in proc.stdout
+        assert "gate" in proc.stdout and "baselined" in proc.stdout

@@ -10,12 +10,15 @@ Thin wrapper over `python -m transmogrifai_tpu lint` for direct use:
     python tools/tplint.py transmogrifai_tpu/ops    # specific paths
     python tools/tplint.py --concurrency \
         --concurrency-baseline concurrency_baseline.json
+    python tools/tplint.py --programs \
+        --program-baseline program_baseline.json
+    python tools/tplint.py --all      # every gate, committed baselines
 
 Exit codes: 0 clean; 1 when findings exist that the baseline does not
 cover; 3 when a supplied baseline file is missing or unparseable (a
 vanished baseline must not silently turn every accepted finding "new").
-Rules (TPL001..TPL005, TPC001..TPC006) and the suppression/baseline
-story are catalogued in docs/analysis.md.
+Rules (TPL001..TPL005, TPC001..TPC006, TPJ001..TPJ010) and the
+suppression/baseline story are catalogued in docs/analysis.md.
 """
 import argparse
 import os
@@ -44,6 +47,16 @@ def main(argv=None) -> int:
     parser.add_argument("--concurrency-baseline", default=None)
     parser.add_argument("--write-concurrency-baseline", default=None)
     parser.add_argument(
+        "--programs", action="store_true",
+        help="also run the TPJ0xx compiled-program contract audit",
+    )
+    parser.add_argument("--program-baseline", default=None)
+    parser.add_argument("--write-program-baseline", default=None)
+    parser.add_argument(
+        "--all", action="store_true", dest="all_gates",
+        help="run every gate (TPL + TPC + TPJ) in one pass",
+    )
+    parser.add_argument(
         "--root", default=".",
         help="paths in findings/baseline are stored relative to this",
     )
@@ -53,6 +66,10 @@ def main(argv=None) -> int:
         concurrency=args.concurrency,
         concurrency_baseline=args.concurrency_baseline,
         write_concurrency_baseline=args.write_concurrency_baseline,
+        programs=args.programs,
+        program_baseline=args.program_baseline,
+        write_program_baseline=args.write_program_baseline,
+        all_gates=args.all_gates,
     )
 
 

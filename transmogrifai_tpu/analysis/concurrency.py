@@ -59,6 +59,11 @@ Annotation vocabulary (all line comments):
   class when the constructor form cannot show it;
 * ``# tpc: ok`` / ``# tpc: disable=TPCnnn`` — suppress on this line.
 
+All of these parse through the SHARED directive parser in
+``analysis/findings.py``: the unified ``# tp:`` prefix is the canonical
+spelling for every verb above, and the ``# tpc:`` dialect keeps working
+as a (deprecated, one release) legacy alias.
+
 Static keys are PACKAGE-relative (``serving/service.py:ScoringService.
 _lock``); finding paths stay repo-relative like every other analyser so
 one baseline format serves both linters.
@@ -69,7 +74,6 @@ import ast
 import builtins as _builtins
 import functools
 import os
-import re
 from typing import Any, Iterable
 
 from .findings import Report, Severity
@@ -104,29 +108,23 @@ _FACTORY_RETURNS = {"counter": "Counter", "gauge": "Gauge",
 _EXEMPT_FIELD_SUFFIXES = ("_lock", "_locks", "_event", "_tls", "_cond")
 _CTOR_NAMES = ("__init__", "__new__", "__post_init__")
 
-_ANN_LOCK = re.compile(r"#\s*tpc:\s*lock\(\s*([^)]+?)\s*\)")
-_ANN_GUARDED = re.compile(r"#\s*tpc:\s*guarded\(\s*([^)]+?)\s*\)")
-_ANN_TYPE = re.compile(r"#\s*tpc:\s*type\(\s*([^)]+?)\s*\)")
+# annotation verbs, parsed by the shared directive parser in findings.py
+# (the unified '# tp:' prefix and the legacy '# tpc:' dialect both work)
+_ANN_LOCK = "lock"
+_ANN_GUARDED = "guarded"
+_ANN_TYPE = "type"
 
 _BUILTINS = set(dir(_builtins))
 _UNSET = object()
 
 
 def _suppressed(line: str, code: str) -> bool:
-    if "tpc: ok" in line:
-        return True
-    return f"tpc: disable={code}" in line
+    from .findings import suppressed
+
+    return suppressed(line, code)
 
 
-def _attr_chain(node: ast.expr) -> list[str]:
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return list(reversed(parts))
-    return []
+from .findings import attr_chain as _attr_chain  # shared AST helper
 
 
 def _pkg_rel(rel: str) -> str:
@@ -246,9 +244,11 @@ class _Analyzer:
             return mod.lines[lineno - 1]
         return ""
 
-    def _ann(self, mod: _Module, lineno: int, rx: re.Pattern) -> str | None:
-        m = rx.search(self._line(mod, lineno))
-        return m.group(1).strip() if m else None
+    def _ann(self, mod: _Module, lineno: int, verb: str) -> str | None:
+        from .findings import annotations
+
+        got = annotations(self._line(mod, lineno), verb, family="tpc")
+        return got[0] if got else None
 
     def _add_finding(
         self, code: str, message: str, mod: _Module, lineno: int,

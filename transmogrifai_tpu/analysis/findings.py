@@ -67,6 +67,27 @@ CODES: dict[str, str] = {
     "TPL003": "jax.jit built inside an uncached function (retrace hazard)",
     "TPL004": "wall-clock call in resilience/ (inject the clock instead)",
     "TPL005": "unseeded random source",
+    # ---- TPJ: compiled-program contract audit (analysis/program.py)
+    "TPJ000": "program could not be traced — the auditor cannot inspect it",
+    "TPJ001": "giant constant folded into the compiled program instead of "
+              "arriving as a traced argument",
+    "TPJ002": "f64/x64 value or weak-type promotion inside a device program",
+    "TPJ003": "declared donated argument is never aliased into the "
+              "compiled output (donation is a no-op)",
+    "TPJ004": "host callback / pure_callback / debug print inside a "
+              "device program",
+    "TPJ005": "jaxpr structure drifts across lane/shape buckets "
+              "(recompile-hazard fork)",
+    "TPJ006": "program-level transfer counts disagree with the "
+              "static-plan / runtime transfer census",
+    "TPJ007": "Python control flow branches on a traced value inside a "
+              "jitted body",
+    "TPJ008": "host-sync coercion (.item()/float()/np.asarray) inside a "
+              "jitted body",
+    "TPJ009": "jitted function closes over an ndarray value (baked as a "
+              "program constant)",
+    "TPJ010": "warmup family map and the traceable-program registry "
+              "disagree (silent cold start or dead map entry)",
     # ---- TPC: concurrency analysis (analysis/concurrency.py + schedule.py)
     "TPC000": "file does not parse — the concurrency analyzer cannot scan it",
     "TPC001": "potential deadlock: cycle in the static lock-order graph",
@@ -211,3 +232,115 @@ class Report:
             "warnings": len(self.warnings()),
             **self.data,
         }
+
+
+# --------------------------------------------------------------------------
+# shared comment-directive parser (one grammar for every analyser)
+# --------------------------------------------------------------------------
+# Historically each analyser grew its own dialect (``# tplint: disable=``,
+# ``# tpc: lock(key)``) with copy-paste-divergent parsing. The canonical
+# spelling is now the unified ``# tp: <verb>`` prefix, understood by every
+# analyser; the per-analyser prefixes keep working — ``tpj:`` as a plain
+# alias, ``tplint:``/``tpc:`` as DEPRECATED legacy spellings (one release,
+# warned once per dialect per process).
+#
+# Grammar (one or more directives per comment, whitespace-tolerant):
+#   # tp: ok                      suppress every finding on this line
+#   # tp: disable=TPL003          suppress one code (comma-list accepted)
+#   # tp: lock(key)               concurrency lock-alias annotation
+#   # tp: guarded(key)            caller-holds-the-lock annotation
+#   # tp: type(Cls)               attribute-type hint for call resolution
+import logging as _logging
+import re as _re
+
+_DIRECTIVE_PREFIXES = ("tp", "tplint", "tpc", "tpj")
+_LEGACY_PREFIXES = ("tplint", "tpc")
+_DIR_RE = _re.compile(
+    # disable codes are exact TPx-code tokens (comma-separated) so a
+    # trailing uppercase rationale ("# tp: disable=TPL003 SEE DOCS")
+    # can never corrupt the code being suppressed
+    r"#\s*(tp|tplint|tpc|tpj):\s*"
+    r"(ok|disable=[A-Z]{3}\d+(?:\s*,\s*[A-Z]{3}\d+)*"
+    r"|(?:lock|guarded|type)\(\s*[^)]+?\s*\))"
+)
+_log = _logging.getLogger(__name__)
+_warned_legacy: set = set()
+
+
+def _warn_legacy(prefix: str) -> None:
+    if prefix in _LEGACY_PREFIXES and prefix not in _warned_legacy:
+        _warned_legacy.add(prefix)
+        _log.warning(
+            "'# %s:' directives are deprecated — use the unified '# tp:' "
+            "prefix (the old spelling keeps working for one release)",
+            prefix,
+        )
+
+
+def parse_directives(line: str) -> list[tuple[str, str, str]]:
+    """Every directive on ``line`` as ``(prefix, verb, arg)`` tuples:
+    ``("tp", "disable", "TPL003")``, ``("tpc", "lock", "key")``,
+    ``("tp", "ok", "")``. Legacy prefixes warn once per process."""
+    out: list[tuple[str, str, str]] = []
+    for m in _DIR_RE.finditer(line):
+        prefix, body = m.group(1), m.group(2)
+        _warn_legacy(prefix)
+        if body == "ok":
+            out.append((prefix, "ok", ""))
+        elif body.startswith("disable="):
+            for code in body[len("disable="):].split(","):
+                code = code.strip()
+                if code:
+                    out.append((prefix, "disable", code))
+        else:
+            verb, _, arg = body.partition("(")
+            out.append((prefix, verb.strip(), arg.rstrip(")").strip()))
+    return out
+
+
+#: analyser code family -> the legacy per-analyser prefix it honours
+_FAMILY_PREFIX = {"TPL": "tplint", "TPC": "tpc", "TPJ": "tpj"}
+
+
+def suppressed(line: str, code: str) -> bool:
+    """True when ``line`` carries a directive suppressing ``code`` — in
+    the unified ``tp`` dialect or the code family's own prefix. An ``ok``
+    under a DIFFERENT analyser's prefix does not leak across families
+    (``# tpc: ok`` must not silence a TPL finding on the same line)."""
+    family = _FAMILY_PREFIX.get(code[:3])
+    for prefix, verb, arg in parse_directives(line):
+        if prefix not in ("tp", family):
+            continue
+        if verb == "ok":
+            return True
+        if verb == "disable" and arg == code:
+            return True
+    return False
+
+
+def annotations(line: str, verb: str, family: str | None = None) -> list[str]:
+    """Arguments of every ``verb(...)`` annotation on ``line`` (``lock``,
+    ``guarded``, ``type``) in the unified dialect or ``family``'s prefix."""
+    out = []
+    for prefix, v, arg in parse_directives(line):
+        if v != verb:
+            continue
+        if prefix == "tp" or family is None or prefix == family:
+            out.append(arg)
+    return out
+
+
+def attr_chain(node) -> list[str]:
+    """``['np', 'random', 'choice']`` for ``np.random.choice`` — ``[]``
+    when the expression is not a plain name/attribute chain. The one AST
+    helper every analyser shares (lint, concurrency, program)."""
+    import ast as _ast
+
+    parts: list[str] = []
+    while isinstance(node, _ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, _ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []

@@ -87,22 +87,12 @@ _CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
 
 
 def _suppressed(line: str, code: str) -> bool:
-    if "tplint: ok" in line:
-        return True
-    return f"tplint: disable={code}" in line
+    from .findings import suppressed
+
+    return suppressed(line, code)
 
 
-def _attr_chain(node: ast.expr) -> list[str]:
-    """['np', 'random', 'choice'] for np.random.choice — [] when not a
-    plain name/attribute chain."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return list(reversed(parts))
-    return []
+from .findings import attr_chain as _attr_chain  # shared AST helper
 
 
 def _is_cached(fn: ast.AST) -> bool:

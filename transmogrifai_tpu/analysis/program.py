@@ -1,0 +1,1003 @@
+"""Compiled-program contract auditor (``TPJ0xx``) — the sixth analyser.
+
+Every other analysis family stops at the AST or the plan: TPA walks the
+DAG, TPX abstract-interprets the serving plan, TPL/TPC lint source. None
+of them ever looks at what XLA actually received. This module does: it
+traces every REGISTERED program (the ``SCORE_PROGRAMS``/family maps of
+``compiler/warmup.py``, the fused serving builders of ``compiler/fused.py``
+and the GLM/tree sweep entry points in ``models/``) to its jaxpr over
+representative bucketed abstract shapes, then lints the IR:
+
+* **TPJ001** — a giant (> ``TPTPU_PROGRAM_CONST_MAX``, default 64 KiB)
+  constant folded into the jaxpr instead of arriving as a traced
+  argument. This is the exact hazard the fused graph's
+  structural-fingerprint keying exists to prevent (a model array baked as
+  a constant forks one executable per model and bloats every blob);
+* **TPJ002** — a 64-bit (x64) value anywhere in the program, or weak-type
+  promotion reaching a program OUTPUT: on TPU an f64 op silently falls to
+  f32-with-different-rounding or refuses to lower;
+* **TPJ003** — declared ``donate_argnums`` whose buffers are never
+  aliased into the compiled output: donation is silently a no-op and the
+  pipelined-dispatch memory story is fiction;
+* **TPJ004** — host callbacks (``pure_callback`` / ``io_callback`` /
+  debug prints) inside a device program: every dispatch round-trips the
+  host, defeating the one-dispatch contract;
+* **TPJ005** — per-bucket jaxpr-structure fingerprints that must be
+  identical across lane/batch buckets modulo shapes: a fork means the
+  bucketing plane compiles one program per bucket FAMILY instead of one
+  program per bucket (recompile-hazard drift);
+* **TPJ006** — the jaxpr-level transfer count (each dispatched program =
+  ONE argument upload + ONE result download per batch) reconciled as the
+  third leg against the static plan census (PR 6) and the runtime census
+  (PR 10) via ``telemetry.runlog.reconcile_transfer_census(
+  program_counts=...)``;
+* **TPJ007-009** — AST tracing-hazard lints over ``models/``,
+  ``compiler/`` and ``insights/loco.py``: Python ``if``/``while`` on a
+  traced value, ``.item()``/``float()``/``np.asarray`` host-sync inside a
+  jitted body, and closure capture of ndarray values by jitted functions;
+* **TPJ010** — the warmup family map cross-checked against the
+  traceable-program registry: a mapped name no module registers is a
+  silent cold start, a registered scoring program absent from every
+  family never warms.
+
+Entry points: ``python -m transmogrifai_tpu lint --programs`` (gated on
+the committed ``program_baseline.json`` — same (code, path, line-text)
+keying and exit-3-on-missing contract as the TPL/TPC gates),
+``score_fn.audit(programs=True)`` (audits the FITTED fused program and
+the serving programs its plan dispatches), and the compile bank
+(``utils/aot.py`` audits at bank-admission time under
+``TPTPU_PROGRAM_AUDIT=1`` so a contract-violating program never gets a
+persisted blob).
+
+Programs register by exposing ``program_trace_specs()`` in their defining
+module (``models/gbdt.py``, ``models/trees.py``, ``models/solvers.py``,
+``ops/embeddings.py``, ``compiler/fused.py``) — the spec owns the
+representative shapes, so they live next to the code they describe.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Any, Callable, Iterable, Sequence
+
+from .findings import Report, Severity, suppressed
+
+__all__ = [
+    "ProgramSpec",
+    "collect_specs",
+    "audit_programs",
+    "audit_spec",
+    "audit_jit_call",
+    "audit_fused_program",
+    "program_transfer_counts",
+    "reconcile_program_census",
+    "warmup_map_findings",
+    "tracing_hazards_paths",
+    "tracing_hazard_source",
+    "jaxpr_fingerprint",
+    "DEFAULT_AST_PATHS",
+    "SPEC_MODULES",
+]
+
+#: modules that register traceable programs (each exposes
+#: ``program_trace_specs()``)
+SPEC_MODULES = (
+    "transmogrifai_tpu.models.gbdt",
+    "transmogrifai_tpu.models.trees",
+    "transmogrifai_tpu.models.solvers",
+    "transmogrifai_tpu.ops.embeddings",
+    "transmogrifai_tpu.compiler.fused",
+)
+
+#: source trees the tracing-hazard AST lint (TPJ007-009) covers
+DEFAULT_AST_PATHS = (
+    "transmogrifai_tpu/models",
+    "transmogrifai_tpu/compiler",
+    "transmogrifai_tpu/insights/loco.py",
+)
+
+#: constants above this many bytes must arrive as traced args (TPJ001)
+_CONST_MAX_DEFAULT = 1 << 16
+
+
+def _const_max() -> int:
+    return int(
+        os.environ.get("TPTPU_PROGRAM_CONST_MAX", str(_CONST_MAX_DEFAULT))
+    )
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One registered program and how to trace it representatively.
+
+    ``build(bucket)`` returns ``(args, statics)`` for one bucket of the
+    bucketed axis (lane count for sweep programs, padded batch rows for
+    serving programs). ``fn`` is the dispatched callable (jit-wrapped or
+    plain — plain ones are jitted here with ``static_argnames`` =
+    statics' keys, matching the ``aot_call`` convention). ``base_fn`` is
+    the UNjitted python function, required when ``donate_argnums`` is
+    non-empty (the donation twin is rebuilt for the lowering check)."""
+
+    name: str
+    fn: Any
+    build: Callable[[int], tuple[tuple, dict]]
+    buckets: tuple[int, ...] = (8,)
+    bucket_axis: str = "batch"  # "batch" | "lanes" (reporting only)
+    donate_argnums: tuple[int, ...] = ()
+    base_fn: Any = None
+    static_argnames: tuple[str, ...] = ()
+    scoring: bool = False
+    module: str = ""
+
+
+def _as_spec(obj: Any, module: str) -> ProgramSpec:
+    if isinstance(obj, ProgramSpec):
+        if not obj.module:
+            obj.module = module
+        return obj
+    spec = ProgramSpec(**obj)
+    if not spec.module:
+        spec.module = module
+    return spec
+
+
+def collect_specs(
+    names: Iterable[str] | None = None,
+    errors: list | None = None,
+) -> list[ProgramSpec]:
+    """Every registered :class:`ProgramSpec` (optionally filtered by
+    program name). A module whose import or ``program_trace_specs()``
+    raises is recorded on ``errors`` as ``(module, exception)`` —
+    :func:`audit_programs` surfaces each as a TPJ000 finding so a broken
+    registration can never silently shrink the audited set."""
+    import importlib
+
+    specs: list[ProgramSpec] = []
+    for mod_name in SPEC_MODULES:
+        try:
+            mod = importlib.import_module(mod_name)
+            fn = getattr(mod, "program_trace_specs", None)
+            if fn is None:
+                continue
+            for obj in fn():
+                specs.append(_as_spec(obj, mod_name))
+        except Exception as e:
+            if errors is not None:
+                errors.append((mod_name, e))
+            continue
+    if names is not None:
+        wanted = set(names)
+        specs = [s for s in specs if s.name in wanted]
+    return specs
+
+
+# --------------------------------------------------------------------------
+# jaxpr plumbing
+# --------------------------------------------------------------------------
+def _trace_closed(spec_fn, args: tuple, statics: dict):
+    """ClosedJaxpr of ``fn(*args, **statics)``; jit-wraps plain callables
+    with the statics' keys as static_argnames (the aot_call contract)."""
+    import jax
+
+    fn = spec_fn
+    if not hasattr(fn, "trace"):
+        # trace-only jit: never dispatched or banked
+        fn = jax.jit(fn, static_argnames=tuple(statics))  # tp: disable=TPL003
+    return fn.trace(*args, **statics).jaxpr
+
+
+def _sub_jaxprs(params: dict):
+    """Nested (closed or raw) jaxprs inside an eqn's params — scan/while
+    bodies, cond branches, pjit call_jaxprs. Duck-typed so it holds
+    across jax's core/extend module moves."""
+    for v in params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                yield item  # a ClosedJaxpr
+            elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                yield item  # a raw Jaxpr
+
+
+def _walk(closed, seen=None):
+    """Yield (jaxpr, consts) for the closed jaxpr and every nested one."""
+    if seen is None:
+        seen = set()
+    jaxpr = getattr(closed, "jaxpr", closed)
+    consts = list(getattr(closed, "consts", ()) or ())
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    yield jaxpr, consts
+    for eqn in jaxpr.eqns:
+        for sub in _sub_jaxprs(eqn.params):
+            yield from _walk(sub, seen)
+
+
+def _norm_param(v: Any) -> Any:
+    """Shape-free view of an eqn param for the structural fingerprint:
+    ints and int-tuples (shapes, axes, lengths that scale with the
+    bucket) collapse to a placeholder; dtypes/strings/bools/callables
+    keep their identity; nested jaxprs fingerprint recursively."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int):
+        return "#"
+    if isinstance(v, (str, bytes, float, type(None))):
+        return v
+    if isinstance(v, (list, tuple)):
+        return tuple(_norm_param(x) for x in v)
+    if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+        return ("jaxpr", jaxpr_fingerprint(v))
+    if callable(v):
+        return getattr(v, "__name__", type(v).__name__)
+    if hasattr(v, "dtype") and hasattr(v, "shape"):
+        return ("array", str(v.dtype))
+    return type(v).__name__
+
+
+def jaxpr_fingerprint(closed) -> str:
+    """Structure fingerprint of a (closed) jaxpr, stable modulo shapes:
+    the ordered primitive sequence with shape-free params, recursed
+    through scan/cond/pjit bodies. Two lane buckets of one program family
+    MUST fingerprint identically (TPJ005)."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    parts: list[str] = []
+    for eqn in jaxpr.eqns:
+        norm = tuple(
+            (k, _norm_param(v)) for k, v in sorted(eqn.params.items())
+        )
+        parts.append(f"{eqn.primitive.name}{norm}")
+    return hashlib.sha1("\n".join(parts).encode()).hexdigest()[:16]
+
+
+_CALLBACK_PRIMS = (
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback", "callback",
+)
+
+_X64_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+
+def _aval_of(var):
+    return getattr(var, "aval", None)
+
+
+def _check_jaxpr(
+    report: Report,
+    closed,
+    name: str,
+    bucket: int,
+    const_max: int | None = None,
+) -> None:
+    """TPJ001 (giant consts), TPJ002 (x64/weak), TPJ004 (callbacks) over
+    one traced program."""
+    import numpy as np
+
+    limit = _const_max() if const_max is None else const_max
+    flagged_consts: set[int] = set()
+    seen_cb: set[str] = set()
+    x64_hit = False
+    for jaxpr, consts in _walk(closed):
+        for c in consts:
+            nbytes = int(getattr(c, "nbytes", 0) or 0)
+            if nbytes > limit and id(c) not in flagged_consts:
+                flagged_consts.add(id(c))
+                shape = tuple(getattr(c, "shape", ()) or ())
+                report.add(
+                    "TPJ001",
+                    f"program '{name}' folds a {nbytes}-byte constant "
+                    f"(shape {shape}) into the compiled graph — pass it "
+                    "as a traced argument so same-shaped models share one "
+                    "executable",
+                    subject=f"program:{name}",
+                    severity=Severity.ERROR,
+                    path=f"program:{name}", line=0,
+                    context=f"{name} const{shape}", nbytes=nbytes,
+                )
+        for eqn in jaxpr.eqns:
+            pname = eqn.primitive.name
+            if any(cb in pname for cb in _CALLBACK_PRIMS) and \
+                    pname not in seen_cb:
+                seen_cb.add(pname)
+                report.add(
+                    "TPJ004",
+                    f"program '{name}' embeds host callback primitive "
+                    f"'{pname}' — every dispatch round-trips the host",
+                    subject=f"program:{name}",
+                    severity=Severity.ERROR,
+                    path=f"program:{name}", line=0,
+                    context=f"{name} callback:{pname}",
+                )
+            if not x64_hit:
+                for var in list(eqn.invars) + list(eqn.outvars):
+                    aval = _aval_of(var)
+                    if aval is None:
+                        continue
+                    if str(getattr(aval, "dtype", "")) in _X64_DTYPES:
+                        x64_hit = True
+                        report.add(
+                            "TPJ002",
+                            f"program '{name}' carries a "
+                            f"{aval.dtype} value through op '{pname}' — "
+                            "64-bit math must not reach a TPU kernel",
+                            subject=f"program:{name}",
+                            severity=Severity.ERROR,
+                            path=f"program:{name}", line=0,
+                            context=f"{name} x64:{aval.dtype}",
+                        )
+                        break
+    # weak-type promotion escaping through an OUTPUT (weak intermediates
+    # from python literals are normal; a weak output means the program's
+    # result dtype is decided by the CALLER's promotion rules)
+    top = getattr(closed, "jaxpr", closed)
+    for i, var in enumerate(top.outvars):
+        aval = _aval_of(var)
+        if aval is not None and getattr(aval, "weak_type", False):
+            report.add(
+                "TPJ002",
+                f"program '{name}' output {i} is weak-typed — its dtype "
+                "floats with caller promotion instead of being pinned by "
+                "the program",
+                subject=f"program:{name}",
+                severity=Severity.WARNING,
+                path=f"program:{name}", line=0,
+                context=f"{name} weak-out:{i}",
+            )
+            break
+
+
+def _check_donation(report: Report, spec: ProgramSpec, args, statics) -> None:
+    """TPJ003: lower the donating twin and require at least one argument
+    buffer aliased into the output (``tf.aliasing_output`` /
+    ``jax.buffer_donor`` in the StableHLO)."""
+    import jax
+
+    if not spec.donate_argnums:
+        return
+    base = spec.base_fn
+    if base is None:
+        return
+    static_names = spec.static_argnames or tuple(statics)
+    try:
+        import warnings
+
+        twin = jax.jit(  # tp: disable=TPL003 — lower-only, never dispatched
+            base, static_argnames=static_names,
+            donate_argnums=spec.donate_argnums,
+        )
+        with warnings.catch_warnings():
+            # "Some donated buffers were not usable" is exactly the
+            # signal this check converts into a TPJ003 finding
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers.*"
+            )
+            text = twin.lower(*args, **statics).as_text()
+    except Exception as e:
+        report.add(
+            "TPJ000",
+            f"donation twin of '{spec.name}' failed to lower: {e}",
+            subject=f"program:{spec.name}",
+            severity=Severity.WARNING,
+            path=f"program:{spec.name}", line=0,
+            context=f"{spec.name} donation-lower",
+        )
+        return
+    if "tf.aliasing_output" not in text and "jax.buffer_donor" not in text:
+        report.add(
+            "TPJ003",
+            f"program '{spec.name}' declares donate_argnums="
+            f"{spec.donate_argnums} but NO argument buffer is aliased "
+            "into the compiled output — donation is a no-op and the "
+            "chunk-to-chunk buffer reuse never happens",
+            subject=f"program:{spec.name}",
+            severity=Severity.WARNING,
+            path=f"program:{spec.name}", line=0,
+            context=f"{spec.name} donation",
+        )
+
+
+def audit_jit_call(
+    name: str,
+    jit_fn: Any,
+    args: tuple,
+    statics: dict,
+    const_max: int | None = None,
+) -> Report:
+    """Audit ONE concrete dispatch (the bank-admission seam in
+    ``utils/aot.py``): trace over the call's own avals, run the IR checks.
+    Never raises — an untraceable program is a TPJ000 warning."""
+    report = Report()
+    try:
+        import jax
+
+        avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+            args,
+        )
+        closed = _trace_closed(jit_fn, avals, statics)
+    except Exception as e:
+        report.add(
+            "TPJ000",
+            f"program '{name}' could not be traced for audit: {e}",
+            subject=f"program:{name}",
+            severity=Severity.WARNING,
+            path=f"program:{name}", line=0, context=f"{name} trace",
+        )
+        return report
+    _check_jaxpr(report, closed, name, bucket=-1, const_max=const_max)
+    return report
+
+
+def audit_spec(spec: ProgramSpec, buckets: Sequence[int] | None = None) -> Report:
+    """Trace one registered program over its buckets and run every IR
+    check, including the cross-bucket TPJ005 fingerprint comparison."""
+    report = Report()
+    buckets = tuple(buckets) if buckets is not None else spec.buckets
+    fingerprints: dict[int, str] = {}
+    first_inputs = None
+    for b in buckets:
+        try:
+            args, statics = spec.build(b)
+            closed = _trace_closed(spec.fn, args, statics)
+        except Exception as e:
+            report.add(
+                "TPJ000",
+                f"program '{spec.name}' failed to trace at bucket {b}: "
+                f"{e}",
+                subject=f"program:{spec.name}",
+                severity=Severity.WARNING,
+                path=f"program:{spec.name}", line=0,
+                context=f"{spec.name} trace",
+            )
+            continue
+        if first_inputs is None:
+            first_inputs = (args, statics)
+        _check_jaxpr(report, closed, spec.name, bucket=b)
+        fingerprints[b] = jaxpr_fingerprint(closed)
+    if len(set(fingerprints.values())) > 1:
+        by_fp: dict[str, list[int]] = {}
+        for b, fp in fingerprints.items():
+            by_fp.setdefault(fp, []).append(b)
+        report.add(
+            "TPJ005",
+            f"program '{spec.name}' jaxpr structure FORKS across "
+            f"{spec.bucket_axis} buckets {sorted(fingerprints)} — "
+            f"distinct structures {sorted(by_fp.values())} compile "
+            "distinct program families instead of one program per bucket",
+            subject=f"program:{spec.name}",
+            severity=Severity.WARNING,
+            path=f"program:{spec.name}", line=0,
+            context=f"{spec.name} bucket-fork",
+            fingerprints={str(b): fp for b, fp in fingerprints.items()},
+        )
+    if first_inputs is not None:
+        _check_donation(report, spec, *first_inputs)
+    report.data.setdefault("programs", {})[spec.name] = {
+        "buckets": list(fingerprints),
+        "fingerprints": fingerprints and sorted(set(fingerprints.values())),
+        "bucketAxis": spec.bucket_axis,
+        "donateArgnums": list(spec.donate_argnums),
+    }
+    return report
+
+
+# --------------------------------------------------------------------------
+# warmup-map reconciliation (TPJ010)
+# --------------------------------------------------------------------------
+def warmup_map_findings(
+    specs: Sequence[ProgramSpec] | None = None,
+    score_programs: frozenset | None = None,
+    family_programs: dict | None = None,
+) -> Report:
+    """Cross-check the warmup family maps against the traceable-program
+    registry. A mapped name no module registers warms nothing (silent
+    cold start on every fresh process); a registered SCORING program
+    absent from every family never prewarms."""
+    from ..compiler import warmup as _w
+
+    report = Report()
+    if specs is None:
+        specs = collect_specs()
+    score = _w.SCORE_PROGRAMS if score_programs is None else score_programs
+    families = (
+        _w._FAMILY_PROGRAMS if family_programs is None else family_programs
+    )
+    mapped: set[str] = set(score)
+    for fam in families.values():
+        mapped.update(fam)
+    registered = {s.name for s in specs}
+    for name in sorted(mapped - registered):
+        report.add(
+            "TPJ010",
+            f"warmup map lists program '{name}' but no module registers "
+            "a traceable spec for it — the name warms nothing and the "
+            "auditor cannot inspect it (silent cold start)",
+            subject=f"program:{name}",
+            severity=Severity.WARNING,
+            path=f"program:{name}", line=0, context=f"{name} unmapped",
+        )
+    scoring_registered = {s.name for s in specs if s.scoring}
+    for name in sorted(scoring_registered - mapped):
+        report.add(
+            "TPJ010",
+            f"scoring program '{name}' is registered with the bank but "
+            "absent from SCORE_PROGRAMS and every family map — serving "
+            "never warms it",
+            subject=f"program:{name}",
+            severity=Severity.WARNING,
+            path=f"program:{name}", line=0, context=f"{name} unwarmed",
+        )
+    return report
+
+
+# --------------------------------------------------------------------------
+# transfer-census third leg (TPJ006)
+# --------------------------------------------------------------------------
+def program_transfer_counts(plan=None, fused=None) -> dict[str, Any]:
+    """Per-batch boundary crossings derived from the COMPILED programs a
+    serving plan dispatches: every dispatched program is exactly one
+    argument upload and one result download (the aot_call contract — its
+    args device_put as one pytree, its outputs render once). The fused
+    graph is one program; the staged path dispatches one predict program
+    per predictor stage."""
+    programs: list[str] = []
+    if fused is not None:
+        programs.append("fused_serve")
+    elif plan is not None:
+        from ..models.base import PredictorModel
+
+        for t in plan:
+            if isinstance(t, PredictorModel):
+                programs.append(f"predict:{t.operation_name}")
+    return {
+        "programs": programs,
+        "hostToDevicePerBatch": len(programs),
+        "deviceToHostPerBatch": len(programs),
+        "source": "jaxpr",
+    }
+
+
+def reconcile_program_census(
+    static_census: dict[str, Any], program_counts: dict[str, Any]
+) -> Report:
+    """TPJ006 when the program-derived per-batch crossing counts disagree
+    with the static plan census — the third reconciliation leg (the
+    runtime leg rides ``telemetry.runlog.reconcile_transfer_census``'s
+    ``program_counts=`` argument)."""
+    report = Report()
+    st_h2d = int(static_census.get("hostToDeviceTransfers", 0))
+    st_d2h = int(static_census.get("deviceToHostTransfers", 0))
+    pg_h2d = int(program_counts.get("hostToDevicePerBatch", 0))
+    pg_d2h = int(program_counts.get("deviceToHostPerBatch", 0))
+    report.data["programTransferCounts"] = dict(program_counts)
+    if (st_h2d, st_d2h) != (pg_h2d, pg_d2h):
+        report.add(
+            "TPJ006",
+            "program-level transfer counts disagree with the static plan "
+            f"census: programs say {pg_h2d} h2d / {pg_d2h} d2h per batch, "
+            f"the plan census says {st_h2d} / {st_d2h} — one of the three "
+            "census legs is lying",
+            subject="census",
+            severity=Severity.WARNING,
+            path="program:census", line=0, context="census three-way",
+            programH2d=pg_h2d, programD2h=pg_d2h,
+            staticH2d=st_h2d, staticD2h=st_d2h,
+        )
+    return report
+
+
+# --------------------------------------------------------------------------
+# fitted fused-program audit (score_fn.audit(programs=True))
+# --------------------------------------------------------------------------
+def audit_fused_program(fused, rows: Sequence[int] = (8, 16)) -> Report:
+    """Audit the FITTED fused serving program: trace ``_fused_eval`` over
+    the program's own member specs + real fit-static params at two batch
+    buckets. Model arrays arrive through ``params`` — anything that shows
+    up as a giant jaxpr constant instead violates the PR-11
+    traced-args-not-constants contract (TPJ001) by construction."""
+    from ..compiler import fused as _fused
+
+    spec = ProgramSpec(
+        name="fused_serve",
+        fn=_fused._fused_eval,
+        base_fn=_fused._fused_eval,
+        build=lambda n: (
+            (
+                tuple(m.dummy(n) for m in fused.members),
+                fused._params_host,
+            ),
+            {"spec": fused._spec},
+        ),
+        buckets=tuple(rows),
+        bucket_axis="batch",
+        donate_argnums=(0,),
+        static_argnames=("spec",),
+        scoring=True,
+        module="compiler.fused",
+    )
+    return audit_spec(spec)
+
+
+# --------------------------------------------------------------------------
+# AST tracing-hazard lint (TPJ007-009)
+# --------------------------------------------------------------------------
+import ast  # noqa: E402
+
+_NP_CTORS = {
+    "array", "asarray", "zeros", "ones", "empty", "full", "arange",
+    "linspace", "eye", "load",
+}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+
+from .findings import attr_chain as _attr_chain  # noqa: E402 — shared helper
+
+
+def _jit_call_statics(call: ast.Call) -> tuple[bool, set[str]]:
+    """(is_jax_jit, static names) for a Call node — handles ``jax.jit``,
+    ``jit`` and ``partial(jax.jit, ...)``."""
+    chain = _attr_chain(call.func)
+    statics: set[str] = set()
+    is_jit = chain[-2:] == ["jax", "jit"] or chain == ["jit"]
+    if not is_jit and chain and chain[-1] == "partial" and call.args:
+        inner = _attr_chain(call.args[0])
+        if inner[-2:] == ["jax", "jit"] or inner == ["jit"]:
+            is_jit = True
+    if not is_jit:
+        return False, statics
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            try:
+                val = ast.literal_eval(kw.value)
+            except Exception:
+                continue
+            if isinstance(val, str):
+                statics.add(val)
+            else:
+                statics.update(str(v) for v in val)
+    return True, statics
+
+
+class _JitIndex:
+    """Which function defs in a module are jitted, and their static
+    param names. Detects decorator jits (``@jax.jit``,
+    ``@partial(jax.jit, ...)``), wrap-by-name (``Y = jax.jit(X)``,
+    ``Y = partial(jax.jit, ...)(X)``) and pass-by-name
+    (``jax.jit(fn_name, ...)`` anywhere)."""
+
+    def __init__(self, tree: ast.Module):
+        self.jitted: dict[int, set[str]] = {}  # id(funcdef) -> statics
+        defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        is_jit, statics = _jit_call_statics(dec)
+                        if is_jit:
+                            self.jitted[id(node)] = statics
+                    else:
+                        chain = _attr_chain(dec)
+                        if chain[-2:] == ["jax", "jit"] or chain == ["jit"]:
+                            self.jitted[id(node)] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_jit, statics = _jit_call_statics(node)
+            # partial(jax.jit, ...)(fn) — the jit partial called on fn
+            wrapped: ast.expr | None = None
+            if is_jit:
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] == "partial":
+                    continue  # the partial itself; the outer call wraps
+                if node.args:
+                    wrapped = node.args[0]
+            elif isinstance(node.func, ast.Call):
+                inner_jit, statics = _jit_call_statics(node.func)
+                if inner_jit and node.args:
+                    wrapped = node.args[0]
+            if wrapped is not None and isinstance(wrapped, ast.Name):
+                for d in defs.get(wrapped.id, ()):
+                    self.jitted.setdefault(id(d), set()).update(statics)
+
+    def statics_of(self, fn: ast.AST) -> set[str] | None:
+        return self.jitted.get(id(fn))
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _traced_names_in(expr: ast.expr, traced: set[str]) -> list[str]:
+    """Traced param names whose VALUE the expression actually consumes —
+    shape/dtype metadata reads, ``is None`` tests and ``isinstance``
+    checks don't count (they are static at trace time)."""
+    hits: list[str] = []
+    skip: set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            for inner in ast.walk(node.value):
+                skip.add(id(inner))
+        elif isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            for inner in ast.walk(node):
+                skip.add(id(inner))
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] in ("isinstance", "len", "getattr",
+                                       "hasattr", "callable"):
+                for inner in ast.walk(node):
+                    skip.add(id(inner))
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in traced
+            and id(node) not in skip
+        ):
+            hits.append(node.id)
+    return hits
+
+
+def _ndarray_bindings(scope_body: Iterable[ast.stmt]) -> set[str]:
+    """Names bound (at this scope's statement level) to an ndarray-building
+    call — the closure-capture bait of TPJ009."""
+    out: set[str] = set()
+    for stmt in scope_body:
+        targets: list[ast.expr] = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if not isinstance(value, ast.Call):
+            continue
+        chain = _attr_chain(value.func)
+        if len(chain) >= 2 and chain[0] in ("np", "numpy") and \
+                chain[-1] in _NP_CTORS:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _local_bindings(fn: ast.AST) -> set[str]:
+    """Every name the function binds (params, assignments, loops, withs,
+    imports, comprehension targets, nested defs)."""
+    bound: set[str] = set(_param_names(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node is not fn:
+            bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+    return bound
+
+
+def tracing_hazard_source(source: str, rel_path: str) -> Report:
+    """TPJ007-009 over one file. Approximation contract: "traced value"
+    means a direct parameter of a jitted function that is not in its
+    static_argnames — first-order dataflow only, suppressible with
+    ``# tpj: ok`` / ``# tp: disable=TPJ00x``."""
+    report = Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        report.add(
+            "TPJ000",
+            f"file does not parse: {e}",
+            subject=f"{rel_path}:{e.lineno or 0}",
+            severity=Severity.WARNING,
+            path=rel_path, line=e.lineno or 0, context="",
+        )
+        return report
+    lines = source.splitlines()
+    index = _JitIndex(tree)
+    hits: list[tuple[str, int, str]] = []
+
+    # map each function def to its enclosing function (for TPJ009)
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in ast.walk(node):
+                if child is not node and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and id(child) not in parents:
+                    parents[id(child)] = node
+
+    module_ndarrays = _ndarray_bindings(tree.body)
+
+    for fn in [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]:
+        statics = index.statics_of(fn)
+        if statics is None:
+            continue
+        traced = set(_param_names(fn)) - statics
+        nested_ids = {
+            id(n) for child in ast.iter_child_nodes(fn)
+            for n in ast.walk(child)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+        for node in ast.walk(fn):
+            if id(node) in nested_ids and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            # ---- TPJ007: python control flow on a traced value
+            if isinstance(node, (ast.If, ast.While)):
+                names = _traced_names_in(node.test, traced)
+                if names:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    hits.append((
+                        "TPJ007", node.lineno,
+                        f"python `{kind}` on traced value(s) "
+                        f"{sorted(set(names))} inside jitted {fn.name}() — "
+                        "trace-time branching forks one program per value "
+                        "(use lax.cond/select or make it static)",
+                    ))
+            # ---- TPJ008: host-sync coercions
+            elif isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and _traced_names_in(node.func.value, traced)
+                ):
+                    hits.append((
+                        "TPJ008", node.lineno,
+                        f".item() on a traced value inside jitted "
+                        f"{fn.name}() — forces a device sync per call",
+                    ))
+                elif (
+                    len(chain) == 1 and chain[0] in _SYNC_CASTS
+                    and node.args
+                    and _traced_names_in(node.args[0], traced)
+                ):
+                    hits.append((
+                        "TPJ008", node.lineno,
+                        f"{chain[0]}() coerces a traced value inside "
+                        f"jitted {fn.name}() — host sync / trace error",
+                    ))
+                elif (
+                    len(chain) == 2 and chain[0] in ("np", "numpy")
+                    and chain[1] in ("asarray", "array")
+                    and node.args
+                    and _traced_names_in(node.args[0], traced)
+                ):
+                    hits.append((
+                        "TPJ008", node.lineno,
+                        f"np.{chain[1]}() materializes a traced value "
+                        f"inside jitted {fn.name}() — forces a device "
+                        "download mid-program",
+                    ))
+
+        # ---- TPJ009: closure capture of ndarray values
+        enclosing = parents.get(id(fn))
+        bait = set(module_ndarrays)
+        if enclosing is not None:
+            bait |= _ndarray_bindings(
+                s for s in ast.walk(enclosing) if isinstance(s, ast.stmt)
+            )
+        if bait:
+            bound = _local_bindings(fn)
+            captured = sorted({
+                n.id for n in ast.walk(fn)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in bait and n.id not in bound
+            })
+            if captured:
+                hits.append((
+                    "TPJ009", fn.lineno,
+                    f"jitted {fn.name}() closes over ndarray value(s) "
+                    f"{captured} — they bake into the program as "
+                    "constants (one executable per array, bloated "
+                    "blobs); pass them as traced arguments",
+                ))
+
+    rel = rel_path.replace(os.sep, "/")
+    for code, lineno, message in sorted(hits, key=lambda h: (h[1], h[0])):
+        context = lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
+        if suppressed(context, code):
+            continue
+        report.add(
+            code, message,
+            subject=f"{rel}:{lineno}",
+            severity=Severity.WARNING,
+            path=rel, line=lineno, context=context,
+        )
+    return report
+
+
+def tracing_hazards_paths(
+    paths: Iterable[str] | None = None, root: str = "."
+) -> Report:
+    """TPJ007-009 over every ``.py`` file under ``paths`` (defaults to
+    the tracing-hazard surface: models/, compiler/, insights/loco.py)."""
+    report = Report()
+    if paths is None:
+        paths = [os.path.join(root, p) for p in DEFAULT_AST_PATHS]
+    files: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", "node_modules")
+            ]
+            files.extend(
+                os.path.join(dirpath, f)
+                for f in filenames if f.endswith(".py")
+            )
+    for path in sorted(files):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        report.extend(tracing_hazard_source(source, rel))
+    return report
+
+
+# --------------------------------------------------------------------------
+# whole-registry driver (the CLI `lint --programs` pass)
+# --------------------------------------------------------------------------
+def audit_programs(
+    names: Iterable[str] | None = None,
+    include_ir: bool = True,
+    include_ast: bool = True,
+    ast_paths: Iterable[str] | None = None,
+    root: str = ".",
+    buckets: Sequence[int] | None = None,
+) -> Report:
+    """The full TPJ pass: trace + IR-lint every registered program
+    (TPJ001-005), cross-check the warmup maps (TPJ010), and run the
+    tracing-hazard AST lint (TPJ007-009). Programs that fail to import or
+    trace degrade to TPJ000 findings, never exceptions."""
+    report = Report()
+    if include_ir:
+        spec_errors: list = []
+        specs = collect_specs(names, errors=spec_errors)
+        for mod_name, err in spec_errors:
+            report.add(
+                "TPJ000",
+                f"program registration in '{mod_name}' failed — its "
+                f"programs are MISSING from this audit: {err}",
+                subject=f"module:{mod_name}",
+                severity=Severity.WARNING,
+                path=f"module:{mod_name}", line=0,
+                context=f"{mod_name} collect",
+            )
+        programs: dict[str, Any] = {}
+        for spec in specs:
+            sub = audit_spec(spec, buckets=buckets)
+            programs.update(sub.data.pop("programs", {}))
+            report.extend(sub)
+        report.data["programs"] = programs
+        if names is None:
+            report.extend(warmup_map_findings(specs))
+    if include_ast:
+        report.extend(tracing_hazards_paths(ast_paths, root=root))
+    return report

@@ -57,7 +57,7 @@ def donating(
         import jax
 
         try:
-            got = jax.jit(  # tplint: disable=TPL003 — cached in _DONATED
+            got = jax.jit(  # tp: disable=TPL003 — cached in _DONATED
                 base,
                 static_argnames=tuple(static_argnames),
                 donate_argnums=donate_argnums,
@@ -141,7 +141,7 @@ def device_f32(arr):
         hit = _PREFETCH.get(key)
         # purge dead refs opportunistically so recycled ids cannot alias
         # (r is a weakref deref — runs no user code, takes no locks)
-        for k in [k for k, (r, _) in _PREFETCH.items() if r() is None]:  # tpc: disable=TPC004
+        for k in [k for k, (r, _) in _PREFETCH.items() if r() is None]:  # tp: disable=TPC004
             _PREFETCH.pop(k, None)
     if hit is not None:
         ref, buf = hit

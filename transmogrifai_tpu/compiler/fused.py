@@ -170,7 +170,7 @@ def _plain_jit(name: str, fn) -> Any:
     with _JIT_LOCK:
         got = _JIT.get(name)
         if got is None:
-            got = _JIT[name] = jax.jit(  # tplint: disable=TPL003 — cached
+            got = _JIT[name] = jax.jit(  # tp: disable=TPL003 — cached
                 fn, static_argnames=("spec",)
             )
     return got
@@ -665,3 +665,72 @@ def onehot_member(stage, vocabs, track_nulls, clean_text) -> MemberPlan:
             + (":nulls" if track_nulls else "")
         ),
     )
+
+
+# --------------------------------------------------------------------------
+# compiled-program contract audit (analysis/program.py, TPJ0xx)
+# --------------------------------------------------------------------------
+def _trace_members():
+    """Synthetic member plans for auditing the fused BUILDERS without a
+    fitted plan: one numeric member (3 features, null-tracked) + one
+    pivot member (vocab of 3) — the two kernel families every fitted
+    fused program composes. The fitted program itself is audited by
+    ``analysis.program.audit_fused_program`` with its real params."""
+    import types as _types
+
+    num_stage = _types.SimpleNamespace(
+        output_name="trace_num", input_names=("a", "b", "c"),
+        operation_name="TraceNumeric", uid="trace_num",
+    )
+    oh_stage = _types.SimpleNamespace(
+        output_name="trace_oh", input_names=("p",),
+        operation_name="TraceOneHot", uid="trace_oh",
+    )
+    m1 = numeric_member(num_stage, np.zeros(3, np.float32), True)
+    m2 = onehot_member(oh_stage, [("x", "y", "z")], True, False)
+    return m1, m2
+
+
+def _trace_build(n: int, explain: bool = False):
+    m1, m2 = _trace_members()
+    width = int(m1.width + m2.width)
+    spec = _Spec(
+        kernels=(m1.kernel, m2.kernel),
+        core=lambda plane, p: plane @ p["w"] + p["b"],
+        fingerprint="trace",
+    )
+    params = {
+        "members": (m1.params, m2.params),
+        "gathers": (),
+        "predictor": {
+            "w": np.zeros((width,), np.float32), "b": np.float32(0.0),
+        },
+    }
+    ingest = (m1.dummy(n), m2.dummy(n))
+    if explain:
+        masks = np.zeros((4, width), np.float32)
+        return (ingest, params, masks), {"spec": spec}
+    return (ingest, params), {"spec": spec}
+
+
+def program_trace_specs():
+    """The fused serving builders over representative synthetic members,
+    bucketed on the BATCH axis (the scoring closure's pow2 row buckets)."""
+    return [
+        dict(
+            name="fused_serve",
+            fn=_fused_eval, base_fn=_fused_eval,
+            build=lambda n: _trace_build(n),
+            buckets=(8, 16),
+            donate_argnums=(0,), static_argnames=("spec",),
+            scoring=True,
+        ),
+        dict(
+            name="fused_serve_explain",
+            fn=_fused_eval_explain, base_fn=_fused_eval_explain,
+            build=lambda n: _trace_build(n, explain=True),
+            buckets=(8, 16),
+            donate_argnums=(0,), static_argnames=("spec",),
+            scoring=True,
+        ),
+    ]

@@ -40,6 +40,10 @@ _COUNTER_KEYS = (
                              # dispatch (in-graph, no separate sweep)
     "fusedFallbacks",        # batches that degraded from the fused graph
                              # to the staged loop (TPX008 in the audit)
+    "programsAudited",       # bank admissions run through the TPJ
+                             # compiled-program audit (TPTPU_PROGRAM_AUDIT=1)
+    "programAuditRejected",  # admissions refused a persisted blob because
+                             # the audit found a contract violation
 )
 
 

@@ -1367,7 +1367,7 @@ def score_function(
         # guard / breaker / drift / explain path, pinned by parity tests
         return score_batch([row], explain=explain)[0]
 
-    def audit() -> Any:
+    def audit(programs: bool = False) -> Any:
         """Static serving-plan audit (analysis/plan_audit.py): symbolic
         [N, width] shape propagation over this closure's stage plan, the
         per-stage host↔device transfer census, recompile-hazard and
@@ -1376,13 +1376,23 @@ def score_function(
         nothing. When the fused graph is available the census reports its
         two-crossing contract (ingest up, render down) and the fused
         module joins the TPX003 donation scan; a missing/degraded fused
-        path surfaces as TPX008."""
+        path surfaces as TPX008.
+
+        ``programs=True`` adds the compiled-program contract audit
+        (analysis/program.py, TPJ0xx): the FITTED fused program traces
+        over its real fit-static params (a model array folded as a jaxpr
+        constant instead of a traced argument is TPJ001 — the PR-11
+        structural-fingerprint contract, checked by construction), the
+        banked serving programs the plan's families dispatch audit over
+        their registered bucket shapes, and the jaxpr-derived per-batch
+        transfer counts reconcile as the THIRD census leg against the
+        static plan census (disagreement is TPJ006)."""
         from ..analysis.plan_audit import audit_serving_plan
 
         prog = _fused_program()
         with _fused_lock:
             counters = dict(fused_counters)
-        return audit_serving_plan(
+        report = audit_serving_plan(
             plan, raw_features, result_names,
             fusion=fusion, bucketed=True,
             host_predict_max=_device_predict_min,
@@ -1390,6 +1400,29 @@ def score_function(
             fused_reason=_fused_reason(),
             fused_counters=counters,
         )
+        if programs:
+            from ..analysis import program as _aprog
+            from ..compiler import warmup as _warm
+
+            names = set(_warm.SCORE_PROGRAMS) - {
+                "fused_serve", "fused_serve_explain",
+            }
+            traced: dict = {}
+            sub = _aprog.audit_programs(names=names, include_ast=False)
+            traced.update(sub.data.pop("programs", {}))
+            report.extend(sub)
+            if prog is not None:
+                sub = _aprog.audit_fused_program(prog)
+                traced.update(sub.data.pop("programs", {}))
+                report.extend(sub)
+            report.data["programs"] = traced
+            counts = _aprog.program_transfer_counts(plan=plan, fused=prog)
+            report.extend(
+                _aprog.reconcile_program_census(
+                    report.data["transferCensus"], counts
+                )
+            )
+        return report
 
     def metadata() -> dict[str, Any]:
         """Score-path health: guard + sentinel + quarantine + breaker +
@@ -1483,6 +1516,6 @@ def score_function(
     # process-wide serving source (telemetry exposition) tracks it too
     with _LIVE_LOCK:
         # r is a weakref deref — runs no user code, takes no locks
-        _LIVE_SCORE_FNS[:] = [r for r in _LIVE_SCORE_FNS if r() is not None]  # tpc: disable=TPC004
+        _LIVE_SCORE_FNS[:] = [r for r in _LIVE_SCORE_FNS if r() is not None]  # tp: disable=TPC004
         _LIVE_SCORE_FNS.append(weakref.ref(score_one))
     return score_one

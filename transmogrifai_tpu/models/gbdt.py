@@ -1547,3 +1547,95 @@ class DecisionTreeRegressor(RandomForestRegressor):
             bootstrap=False, feature_groups=fgroups,
         )
         return ForestRegressionModel(thresholds, trees)
+
+
+# --------------------------------------------------------------------------
+# compiled-program contract audit (analysis/program.py, TPJ0xx)
+# --------------------------------------------------------------------------
+def _trace_tree_stack(*lead: int):
+    """Abstract Tree stack with the given leading axes (depth 2)."""
+    import jax
+
+    return TR.Tree(
+        split_feat=jax.ShapeDtypeStruct((*lead, 2, 4), "int32"),
+        split_bin=jax.ShapeDtypeStruct((*lead, 2, 4), "int32"),
+        leaf_value=jax.ShapeDtypeStruct((*lead, 4), "float32"),
+    )
+
+
+def program_trace_specs():
+    """Representative trace shapes for the banked serving/sweep tree
+    programs. Serving programs bucket the BATCH axis (the scoring
+    closure's pow2 row buckets); sweep programs bucket the LANE axis."""
+    import jax
+
+    f32, i32 = "float32", "int32"
+
+    def _x(n: int):
+        return jax.ShapeDtypeStruct((n, 3), f32)
+
+    _thr = jax.ShapeDtypeStruct((3, 3), f32)
+    _scalar = jax.ShapeDtypeStruct((), f32)
+
+    def _predict_boosted(n: int):
+        return (
+            (_x(n), _thr, _trace_tree_stack(2), _scalar, _scalar), {}
+        )
+
+    def _predict_forest(n: int):
+        return ((_x(n), _thr, _trace_tree_stack(2)), {})
+
+    def _sweep(k: int):
+        return (
+            (
+                _x(8), _thr, _trace_tree_stack(k, 2),
+                jax.ShapeDtypeStruct((k,), f32),
+                jax.ShapeDtypeStruct((k,), f32),
+            ),
+            {},
+        )
+
+    return [
+        dict(
+            name="bin_data",
+            fn=_bin_data_jit,
+            build=lambda n: ((_x(n), _thr), {}),
+            buckets=(8, 16), scoring=True,
+        ),
+        dict(
+            name="stack_lane",
+            fn=_stack_lane,
+            build=lambda k: (
+                (
+                    _trace_tree_stack(k, 2),
+                    jax.ShapeDtypeStruct((), i32),
+                ),
+                {},
+            ),
+            buckets=(4, 8), bucket_axis="lanes", scoring=True,
+        ),
+        dict(
+            name="predict_boosted",
+            fn=TR.predict_boosted_raw,
+            build=_predict_boosted,
+            buckets=(8, 16), scoring=True,
+        ),
+        dict(
+            name="predict_forest",
+            fn=TR.predict_forest_raw,
+            build=_predict_forest,
+            buckets=(8, 16), scoring=True,
+        ),
+        dict(
+            name="sweep_boost_outputs",
+            fn=TR.sweep_boosted_outputs,
+            build=_sweep,
+            buckets=(4, 8), bucket_axis="lanes",
+        ),
+        dict(
+            name="sweep_forest_outputs",
+            fn=TR.sweep_forest_outputs,
+            build=_sweep,
+            buckets=(4, 8), bucket_axis="lanes",
+        ),
+    ]

@@ -783,3 +783,49 @@ def fit_linear(
     w = w_std / std
     b = ym - (w_std * mean / std).sum()
     return GLMParams(weights=w, intercept=jnp.where(fit_intercept, b, 0.0))
+
+
+# --------------------------------------------------------------------------
+# compiled-program contract audit (analysis/program.py, TPJ0xx)
+# --------------------------------------------------------------------------
+def program_trace_specs():
+    """Representative trace shapes for the banked GLM sweep programs.
+
+    The bucketed axis is the LANE count K (``compiler.bucketing``): the
+    default buckets cross the pow2(<=64) / 32-multiple boundary so the
+    TPJ005 fingerprint check proves every bucket compiles the same
+    program family. Small N/D and tiny iteration counts keep the whole
+    trace in milliseconds — jaxpr structure does not depend on them."""
+    import jax
+
+    def _glm_args(k: int):
+        f32 = "float32"
+        return (
+            jax.ShapeDtypeStruct((16, 3), f32),   # x
+            jax.ShapeDtypeStruct((16,), f32),     # y
+            jax.ShapeDtypeStruct((k, 16), f32),   # row_masks
+            jax.ShapeDtypeStruct((k,), f32),      # reg_params
+            jax.ShapeDtypeStruct((k,), f32),      # elastic_nets
+        )
+
+    return [
+        dict(
+            name="linear_batched",
+            fn=fit_linear_batched,
+            build=lambda k: (
+                _glm_args(k), dict(num_iters=2, fit_intercept=True)
+            ),
+            buckets=(8, 64, 96),
+            bucket_axis="lanes",
+        ),
+        dict(
+            name="logistic_binary_batched",
+            fn=fit_logistic_binary_batched,
+            build=lambda k: (
+                _glm_args(k),
+                dict(num_iters=2, fit_intercept=True, standardization=True),
+            ),
+            buckets=(8, 64, 96),
+            bucket_axis="lanes",
+        ),
+    ]

@@ -1748,3 +1748,79 @@ def _fit_boosted_batched_sharded(
         done += rc
     trees = jax.tree.map(lambda *xs: np.concatenate(xs, axis=1), *chunks)
     return trees, np.asarray(margin)[:, :n]
+
+
+# --------------------------------------------------------------------------
+# compiled-program contract audit (analysis/program.py, TPJ0xx)
+# --------------------------------------------------------------------------
+def program_trace_specs():
+    """Representative trace shapes for the banked fit-time tree programs
+    (the boosting-round chunk and the bagged-forest scan). The bucketed
+    axis is the fit-lane count K; rounds/trees/depth stay tiny — jaxpr
+    structure is independent of them (they only change scan lengths)."""
+    import jax
+
+    f32, i32 = "float32", "int32"
+
+    def _common(k: int):
+        return (
+            jax.ShapeDtypeStruct((16, 3), i32),   # binned
+            jax.ShapeDtypeStruct((16,), f32),     # y / target
+            jax.ShapeDtypeStruct((k, 16), f32),   # row_mask
+        )
+
+    def _boost(k: int):
+        binned, y, rm = _common(k)
+        s = jax.ShapeDtypeStruct((), f32)
+        return (
+            (
+                binned, y, rm,
+                jax.ShapeDtypeStruct((k, 16), f32),  # margin (donated)
+                jax.ShapeDtypeStruct((k,), f32),     # eta_v
+                s, s, s, s,                          # lam, gam, mcw, mig
+                None,                                # feature_groups
+            ),
+            dict(
+                num_rounds=2, max_depth=2, num_bins=4,
+                objective="binary:logistic", hist_impl=_resolved_impl(),
+            ),
+        )
+
+    def _forest(k: int):
+        binned, target, rm = _common(k)
+        s = jax.ShapeDtypeStruct((), f32)
+        return (
+            (
+                binned, target, rm,
+                jax.ShapeDtypeStruct((1,), "uint32"),  # seed_arr
+                jax.ShapeDtypeStruct((k,), f32),       # sub
+                jax.ShapeDtypeStruct((k,), f32),       # col
+                s, s,                                  # mi, mg
+                None, None, None, None,
+            ),
+            dict(
+                num_trees=2, max_depth=2, num_bins=4, bootstrap=True,
+                lowp=False, hist_impl=_resolved_impl(),
+            ),
+        )
+
+    return [
+        dict(
+            name="boost_chunk",
+            fn=_boost_rounds_batched,
+            base_fn=_boost_chunk_body,
+            build=_boost,
+            buckets=(4, 8), bucket_axis="lanes",
+            donate_argnums=(3,),
+            static_argnames=(
+                "num_rounds", "max_depth", "num_bins", "objective",
+                "axis_name", "axis_size", "hist_impl",
+            ),
+        ),
+        dict(
+            name="forest_scan",
+            fn=_forest_trees_scan,
+            build=_forest,
+            buckets=(4, 8), bucket_axis="lanes",
+        ),
+    ]

@@ -432,3 +432,49 @@ class OpLDAModel(Model):
             for i in range(values.shape[1])
         )
         return VectorColumn(OPVector, values, VectorMetadata(self.output_name, metas))
+
+
+# --------------------------------------------------------------------------
+# compiled-program contract audit (analysis/program.py, TPJ0xx)
+# --------------------------------------------------------------------------
+def program_trace_specs():
+    """Representative trace shapes for the banked embedding programs.
+    The bucketed axis is the pre-sampled step count (a data-sized scan
+    length, not a lane bucket) — structure must still hold across it."""
+    import jax
+
+    f32, i32 = "float32", "int32"
+
+    def _sgns(steps: int):
+        return (
+            (
+                jax.ShapeDtypeStruct((steps, 2), i32),     # centers
+                jax.ShapeDtypeStruct((steps, 2), i32),     # contexts
+                jax.ShapeDtypeStruct((steps, 2, 2), i32),  # negatives
+                jax.ShapeDtypeStruct((steps,), f32),       # lr schedule
+                jax.ShapeDtypeStruct((), i32),             # seed
+            ),
+            dict(vocab_size=8, dim=4),
+        )
+
+    def _lda(n: int):
+        s = jax.ShapeDtypeStruct((), f32)
+        return (
+            (
+                jax.ShapeDtypeStruct((n, 6), f32),  # doc-term counts
+                s, s,                               # alpha, eta
+                jax.ShapeDtypeStruct((), i32),      # seed
+            ),
+            dict(k=2, iters=2, e_iters=2),
+        )
+
+    return [
+        dict(
+            name="sgns_scan2", fn=_make_sgns_scan(), build=_sgns,
+            buckets=(4, 8),
+        ),
+        dict(
+            name="lda_scan", fn=_make_lda_scan(), build=_lda,
+            buckets=(4, 8),
+        ),
+    ]

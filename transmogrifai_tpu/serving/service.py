@@ -166,7 +166,7 @@ class ScoringService:
         with _LIVE_LOCK:
             # r is a weakref deref — runs no user code, takes no locks
             _LIVE_SERVICES[:] = [
-                r for r in _LIVE_SERVICES if r() is not None  # tpc: disable=TPC004
+                r for r in _LIVE_SERVICES if r() is not None  # tp: disable=TPC004
             ]
             _LIVE_SERVICES.append(weakref.ref(self))
 

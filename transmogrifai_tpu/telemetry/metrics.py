@@ -84,7 +84,7 @@ class Counter:
         # ordering against real locks the schedule reconciler sees it
         if lock is None:
             lock = _schedule.make_lock("telemetry/metrics.py:Counter._lock")
-        self._lock = lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
+        self._lock = lock  # tp: lock(telemetry/metrics.py:MetricsRegistry.lock)
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -107,7 +107,7 @@ class Gauge:
         # registry-built gauges share the registry lock; see Counter
         if lock is None:
             lock = _schedule.make_lock("telemetry/metrics.py:Gauge._lock")
-        self._lock = lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
+        self._lock = lock  # tp: lock(telemetry/metrics.py:MetricsRegistry.lock)
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -149,7 +149,7 @@ class Histogram:
         # registry-built histograms share the registry lock; see Counter
         if lock is None:
             lock = _schedule.make_lock("telemetry/metrics.py:Histogram._lock")
-        self._lock = lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
+        self._lock = lock  # tp: lock(telemetry/metrics.py:MetricsRegistry.lock)
 
     def observe(self, v: float) -> None:
         i = bisect.bisect_left(self.bounds, v)
@@ -350,7 +350,7 @@ class LedgerCore:
         self, counter_keys: Iterable[str], registry: MetricsRegistry | None = None
     ) -> None:
         reg = registry if registry is not None else REGISTRY
-        self._lock = reg.lock  # tpc: lock(telemetry/metrics.py:MetricsRegistry.lock)
+        self._lock = reg.lock  # tp: lock(telemetry/metrics.py:MetricsRegistry.lock)
         self._keys = tuple(counter_keys)
         self._counts: dict[str, int] = {k: 0 for k in self._keys}
 
@@ -358,7 +358,7 @@ class LedgerCore:
         with self._lock:
             self._counts[key] += n
 
-    def _reset_counts(self) -> None:  # tpc: guarded(telemetry/metrics.py:MetricsRegistry.lock)
+    def _reset_counts(self) -> None:  # tp: guarded(telemetry/metrics.py:MetricsRegistry.lock)
         """Caller holds ``self._lock``."""
         self._counts = {k: 0 for k in self._keys}
 

@@ -812,6 +812,7 @@ def reconcile_transfer_census(
     rows: int | None = None,
     batches: int | None = None,
     check_uploads: bool = False,
+    program_counts: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Square the RUNTIME census (a :func:`delta` of the run ledger, or a
     report's ``transferCensus``) against the STATIC per-row prediction
@@ -825,7 +826,15 @@ def reconcile_transfer_census(
     scoring graph's "uploads only at ingest" acceptance check. Steady
     state only: the fused program's one-time model-constant upload and
     the staged path's opportunistic prefetches make the first batch after
-    bring-up legitimately chattier."""
+    bring-up legitimately chattier.
+
+    ``program_counts`` (from ``analysis.program.program_transfer_counts``)
+    is the THIRD census leg: per-batch crossings derived from the compiled
+    programs themselves (one argument upload + one result download per
+    dispatched program). When given, the three legs must agree —
+    program == static per batch, and runtime == program × batches;
+    disagreement surfaces as TPJ006 through
+    ``analysis.program.reconcile_program_census``."""
     if "hostToDevice" in runtime:  # a report census
         rt_d2h = runtime["deviceToHost"]["count"]
         rt_d2h_bytes = runtime["deviceToHost"]["bytes"]
@@ -858,6 +867,21 @@ def reconcile_transfer_census(
         st_h2d = static_census.get("hostToDeviceTransfers", 0)
         out["expectedH2dTransfers"] = st_h2d * batches
         checks.append(rt_h2d == st_h2d * batches)
+    if program_counts is not None:
+        pg_h2d = int(program_counts.get("hostToDevicePerBatch", 0))
+        pg_d2h = int(program_counts.get("deviceToHostPerBatch", 0))
+        out["programH2dPerBatch"] = pg_h2d
+        out["programD2hPerBatch"] = pg_d2h
+        prog_checks = [
+            pg_h2d == out["staticH2dPerBatch"],
+            pg_d2h == out["staticD2hPerBatch"],
+        ]
+        if batches is not None:
+            prog_checks.append(rt_d2h == pg_d2h * batches)
+            if check_uploads:
+                prog_checks.append(rt_h2d == pg_h2d * batches)
+        out["programConsistent"] = all(prog_checks)
+        checks.extend(prog_checks)
     out["consistent"] = bool(checks) and all(checks)
     return out
 

@@ -427,6 +427,33 @@ def aot_call(
 
         def _save():
             try:
+                if os.environ.get("TPTPU_PROGRAM_AUDIT", "0") == "1":
+                    # bank-admission contract audit (analysis/program.py):
+                    # a program that bakes giant constants, leaks x64,
+                    # or embeds host callbacks must never persist a blob
+                    # — the violating executable would be served to every
+                    # future process. Runs on this background thread, so
+                    # the audit costs the foreground dispatch nothing;
+                    # with the env unset the gate is one dict read.
+                    from ..analysis.program import audit_jit_call
+
+                    _stats().bump("programsAudited")
+                    audit_rep = audit_jit_call(name, jit_fn, args, statics)
+                    # ERROR findings only (baked constants, x64 leaks,
+                    # host callbacks): warnings are reported, not
+                    # refused — a weak-typed auxiliary output must not
+                    # negative-cache the program out of the bank
+                    bad = audit_rep.errors()
+                    if bad:
+                        _stats().bump("programAuditRejected")
+                        log.warning(
+                            "program audit refused bank admission of %s: "
+                            "%s", name,
+                            "; ".join(f.render() for f in bad),
+                        )
+                        with _LOCK:
+                            _FAILED.add(key)
+                        return
                 from jax.experimental import serialize_executable as SE
 
                 # .lower().compile() hits the jit's persistent compile
