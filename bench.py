@@ -169,6 +169,20 @@ def validate_bench_report(doc) -> list[str]:
         problems.append(
             "unrecognized bench shape (none of schema_version/metric/cmd)"
         )
+    # additive envelope: the SPMD collectiveAudit stamp (PR 15) is
+    # validated WHEN PRESENT — artifacts predating it stay valid forever
+    audit = doc.get("collectiveAudit") if isinstance(doc, dict) else None
+    if audit is not None:
+        if not isinstance(audit, dict):
+            problems.append("collectiveAudit is not an object")
+        else:
+            if not isinstance(audit.get("tpsCodes"), list):
+                problems.append("collectiveAudit missing 'tpsCodes' list")
+            for key in ("clean", "tapesAgree"):
+                if not isinstance(audit.get(key), bool):
+                    problems.append(
+                        f"collectiveAudit missing boolean {key!r}"
+                    )
     return problems
 
 
@@ -410,6 +424,180 @@ def bench_titanic() -> dict:
         "program_audit_clean": (
             None if program_audit is None else program_audit["clean"]
         ),
+    }
+
+
+# --------------------------------------------------------------------------
+# multichip mode: the MULTICHIP artifact + the SPMD collectiveAudit stamp
+# --------------------------------------------------------------------------
+def _multichip_child(sim_hosts: int) -> None:
+    """The traced collective exercise (run in a SUBPROCESS so the
+    TPTPU_COLLECTIVE_TRACE env latch and the atexit tape dump both
+    apply): drive every seam collective across the forced CPU mesh, then
+    a seeded mid-sweep host failure — survivors fail over and keep
+    issuing. The dumped per-host tapes are the parent's reconciliation
+    input."""
+    import numpy as np
+
+    import jax
+    from transmogrifai_tpu.parallel import (
+        global_column_stats,
+        host_row_slice,
+        make_mesh,
+        make_multihost_mesh,
+        pcolumn_stats,
+        pcontingency,
+        phistogram,
+        psegment_reduce,
+        pxtx,
+        ring_gram,
+    )
+    from transmogrifai_tpu.parallel.reductions import pcentered_gram
+    from transmogrifai_tpu.resilience import faults
+    from transmogrifai_tpu.resilience.distributed import (
+        FailoverController,
+        HeartbeatConfig,
+        HostLostError,
+        installed_controller,
+    )
+
+    n = len(jax.devices())
+    mesh = make_mesh(n_data=n, n_model=1)
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(96, 6)).astype(np.float32)
+
+    # the full seam sweep — one entry per collective family on the tape
+    pcolumn_stats(x, mesh)
+    pcentered_gram(x, mesh)
+    pxtx(x, mesh)
+    phistogram(
+        rng.integers(0, 8, size=(96, 3)).astype(np.int32), 8, mesh
+    )
+    pcontingency(
+        np.eye(3, dtype=np.float32)[rng.integers(0, 3, 96)],
+        np.eye(2, dtype=np.float32)[rng.integers(0, 2, 96)],
+        mesh,
+    )
+    ring_gram(x, mesh)
+    psegment_reduce(
+        np.ones(96, np.float32), rng.integers(0, 4, 96).astype(np.int32),
+        4, mesh,
+    )
+    mh = make_multihost_mesh()
+    sl = host_row_slice(96, mh)
+    global_column_stats(x[sl], mh, 96)
+
+    # seeded mid-sweep failover: host 2 dies DURING pxtx; the controller
+    # degrades the mesh and the survivors re-issue — the lost host's
+    # tape must freeze as a prefix of the survivors' (TPS008 otherwise)
+    ctrl = FailoverController(
+        n_hosts=sim_hosts, config=HeartbeatConfig(clock=lambda: 0.0)
+    ).bind(mesh)
+    plan = faults.FaultPlan().fail_host(2, collective="pxtx")
+    with faults.installed(plan), installed_controller(ctrl):
+        pcolumn_stats(x, mesh)
+        degraded = mesh
+        try:
+            pxtx(x, mesh)
+        except HostLostError as e:
+            degraded = ctrl.failover(e) or mesh
+        pxtx(x, degraded)
+        pcolumn_stats(x, degraded)
+    print(
+        f"multichip collective sweep OK: {n} devices, "
+        f"{sim_hosts} simulated hosts, failover at pxtx, "
+        f"hostsLost={ctrl.counters['hostsLost']}"
+    )
+
+
+def bench_multichip(
+    devices: int = 8, sim_hosts: int = 4, full: bool = False
+) -> dict:
+    """The ``multichip`` mode: run the traced collective exercise (and,
+    with ``--full``, the whole ``dryrun_multichip`` parity train when
+    the reference data exists) in a subprocess over ``devices`` forced
+    CPU devices, then stamp the SPMD ``collectiveAudit`` verdict —
+    static TPS codes, per-host tape agreement, census explanation —
+    into the harness-capture-shaped MULTICHIP artifact, mirroring the
+    PR-13 ``programAudit`` stamp on the RUN_ artifact."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from transmogrifai_tpu.analysis import spmd as SP
+    from transmogrifai_tpu.parallel import guarded as G
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    tape_path = os.path.join(
+        tempfile.mkdtemp(prefix="tptpu-multichip-"), "collective_tapes.json"
+    )
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={devices}"
+        ).strip(),
+        "TPTPU_SIM_HOSTS": str(sim_hosts),
+        G.TRACE_ENV: "1",
+        G.TRACE_OUT_ENV: tape_path,
+    })
+    cmd = [sys.executable, os.path.abspath(__file__), "multichip-child",
+           "--sim-hosts", str(sim_hosts)]
+    p = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=1800, env=env,
+        cwd=here,
+    )
+    rc = p.returncode
+    tail = (p.stdout + p.stderr)[-2000:]
+
+    if full:
+        q = subprocess.run(
+            [sys.executable, os.path.join(here, "__graft_entry__.py"),
+             str(devices)],
+            capture_output=True, text=True, timeout=3600, env=env, cwd=here,
+        )
+        rc = rc or q.returncode  # a failed parity train fails the mode
+        tail += ("\n" + (q.stdout + q.stderr)[-2000:])
+
+    # ---- the collectiveAudit verdict
+    spmd_paths = [os.path.join(here, sp) for sp in SP.DEFAULT_SPMD_PATHS]
+    static = SP.audit_spmd(spmd_paths, root=here)
+    tps_codes = sorted({f.code for f in static.findings})
+    # the audit report already carries the seam census — no second scan
+    seam_census: dict = {}
+    for rel, names in (static.data.get("spmdSeams") or {}).items():
+        for name, linenos in names.items():
+            seam_census.setdefault(name, []).extend(
+                f"{rel}:{ln}" for ln in linenos
+            )
+    tapes_agree = explained = False
+    reconciliation = None
+    try:
+        tapes = G.load_tapes(tape_path)
+        recon = SP.reconcile_collective_orders(tapes, seam_census)
+        reconciliation = recon.data["reconciliation"]
+        tapes_agree = bool(reconciliation["tapesAgree"])
+        explained = bool(reconciliation["explained"])
+        tps_codes = sorted(
+            set(tps_codes) | {f.code for f in recon.findings}
+        )
+    except (OSError, ValueError, KeyError) as e:
+        tail += f"\ntape load/reconcile failed: {e}"
+    return {
+        "n_devices": devices,
+        "rc": rc,
+        "ok": rc == 0 and tapes_agree and explained and not tps_codes,
+        "skipped": False,
+        "tail": tail,
+        "collectiveAudit": {
+            "tpsCodes": tps_codes,
+            "clean": not tps_codes,
+            "tapesAgree": tapes_agree,
+            "tapesExplained": explained,
+            "simHosts": sim_hosts,
+            "reconciliation": reconciliation,
+        },
     }
 
 
@@ -1297,6 +1485,38 @@ def _build_parser():
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH",
     )
+    mc = sub.add_parser(
+        "multichip",
+        help=(
+            "traced collective sweep over a forced CPU mesh (+ seeded "
+            "mid-sweep failover): writes the MULTICHIP artifact with "
+            "the SPMD collectiveAudit verdict (tpsCodes / clean / "
+            "tapesAgree) stamped in"
+        ),
+    )
+    mc.add_argument(
+        "--devices", type=int, default=8,
+        help="forced CPU device count for the child mesh (default 8)",
+    )
+    mc.add_argument(
+        "--sim-hosts", type=int, default=4,
+        help="simulated host count for the tape/failover (default 4)",
+    )
+    mc.add_argument(
+        "--full", action="store_true",
+        help="also run the full dryrun_multichip parity train "
+             "(needs the reference test data)",
+    )
+    mc.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the JSON artifact to PATH (MULTICHIP_rXX.json)",
+    )
+    mcc = sub.add_parser(
+        "multichip-child",
+        help="(internal) the traced collective exercise bench.py "
+             "multichip runs in a subprocess",
+    )
+    mcc.add_argument("--sim-hosts", type=int, default=4)
     vr = sub.add_parser(
         "validate-reports",
         help=(
@@ -1516,6 +1736,15 @@ def _dispatch(ns) -> None:
         return
     if mode == "coldprobe":
         print(json.dumps(bench_titanic_cold()))
+        return
+    if mode == "multichip":
+        doc = bench_multichip(
+            devices=ns.devices, sim_hosts=ns.sim_hosts, full=ns.full
+        )
+        dump_bench_report(doc, ns.out, echo=True)
+        raise SystemExit(0 if doc["ok"] else 1)
+    if mode == "multichip-child":
+        _multichip_child(ns.sim_hosts)
         return
     if mode == "validate-reports":
         bad = validate_reports(ns.root)

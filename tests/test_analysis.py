@@ -105,8 +105,9 @@ def test_all_emittable_codes_are_catalogued():
     for code in CODES:
         # TPR: the cross-run regression sentinel (telemetry/runlog.py);
         # TPC: the concurrency analysis plane (analysis/concurrency.py);
-        # TPJ: the compiled-program contract auditor (analysis/program.py)
-        assert code[:3] in ("TPA", "TPX", "TPL", "TPR", "TPC", "TPJ")
+        # TPJ: the compiled-program contract auditor (analysis/program.py);
+        # TPS: the SPMD contract auditor (analysis/spmd.py)
+        assert code[:3] in ("TPA", "TPX", "TPL", "TPR", "TPC", "TPJ", "TPS")
         assert CODES[code]
 
 

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """tplint — TP-coded invariant linter CLI (analysis/lint.py +
-analysis/concurrency.py).
+analysis/concurrency.py + analysis/program.py + analysis/spmd.py).
 
 Thin wrapper over `python -m transmogrifai_tpu lint` for direct use:
 
@@ -12,12 +12,15 @@ Thin wrapper over `python -m transmogrifai_tpu lint` for direct use:
         --concurrency-baseline concurrency_baseline.json
     python tools/tplint.py --programs \
         --program-baseline program_baseline.json
+    python tools/tplint.py --spmd \
+        --spmd-baseline spmd_baseline.json
     python tools/tplint.py --all      # every gate, committed baselines
 
 Exit codes: 0 clean; 1 when findings exist that the baseline does not
 cover; 3 when a supplied baseline file is missing or unparseable (a
 vanished baseline must not silently turn every accepted finding "new").
-Rules (TPL001..TPL005, TPC001..TPC006, TPJ001..TPJ010) and the
+Rules (TPL001..TPL005, TPC001..TPC006, TPJ001..TPJ010,
+TPS001..TPS008) and the
 suppression/baseline story are catalogued in docs/analysis.md.
 """
 import argparse
@@ -53,8 +56,14 @@ def main(argv=None) -> int:
     parser.add_argument("--program-baseline", default=None)
     parser.add_argument("--write-program-baseline", default=None)
     parser.add_argument(
+        "--spmd", action="store_true",
+        help="also run the TPS0xx SPMD contract audit",
+    )
+    parser.add_argument("--spmd-baseline", default=None)
+    parser.add_argument("--write-spmd-baseline", default=None)
+    parser.add_argument(
         "--all", action="store_true", dest="all_gates",
-        help="run every gate (TPL + TPC + TPJ) in one pass",
+        help="run every gate (TPL + TPC + TPJ + TPS) in one pass",
     )
     parser.add_argument(
         "--root", default=".",
@@ -69,6 +78,9 @@ def main(argv=None) -> int:
         programs=args.programs,
         program_baseline=args.program_baseline,
         write_program_baseline=args.write_program_baseline,
+        spmd=args.spmd,
+        spmd_baseline=args.spmd_baseline,
+        write_spmd_baseline=args.write_spmd_baseline,
         all_gates=args.all_gates,
     )
 

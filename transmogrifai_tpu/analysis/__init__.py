@@ -1,6 +1,6 @@
 """Static-analysis plane: TP-coded findings over DAGs, plans and code.
 
-Five analysers share one :class:`Finding`/:class:`Report` core
+Seven analysers share one :class:`Finding`/:class:`Report` core
 (``analysis/findings.py``):
 
 * :mod:`~transmogrifai_tpu.analysis.preflight` — ``TPA0xx`` pre-flight
@@ -27,9 +27,22 @@ Five analysers share one :class:`Finding`/:class:`Report` core
   ``reconcile_lock_orders`` asserting the dynamic graph is a subgraph of
   the static one — the same static-vs-runtime reconciliation idiom as
   the transfer census.
+* :mod:`~transmogrifai_tpu.analysis.program` — ``TPJ0xx`` compiled-
+  program contract audit: jaxpr-level IR lints over every registered
+  XLA program plus the tracing-hazard AST lint and the three-way
+  transfer-census reconciliation (``python -m transmogrifai_tpu lint
+  --programs``, gated against ``program_baseline.json``).
+* :mod:`~transmogrifai_tpu.analysis.spmd` — ``TPS0xx`` SPMD contract
+  audit of the parallel plane: static collective-order divergence and
+  PartitionSpec/axis-binding analysis, a jaxpr/HLO collective census of
+  every registered shard_map kernel, and the per-host collective-tape
+  reconciler riding the ``parallel/guarded.py`` seam
+  (``TPTPU_COLLECTIVE_TRACE=1``; ``python -m transmogrifai_tpu lint
+  --spmd``, gated against ``spmd_baseline.json``).
 
 ``schedule`` is deliberately stdlib-only (and ``findings``-only) so the
-thread-crossed subsystems can import the lock seam at module-init time.
+thread-crossed subsystems can import the lock seam at module-init time;
+``parallel/guarded.py`` plays the same role for the collective tape.
 
 See ``docs/analysis.md`` for the full code catalogue.
 """

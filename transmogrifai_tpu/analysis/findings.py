@@ -88,6 +88,25 @@ CODES: dict[str, str] = {
               "program constant)",
     "TPJ010": "warmup family map and the traceable-program registry "
               "disagree (silent cold start or dead map entry)",
+    # ---- TPS: SPMD contract audit (analysis/spmd.py + parallel/guarded.py)
+    "TPS000": "file/program could not be analyzed — the SPMD auditor "
+              "cannot inspect it",
+    "TPS001": "collective issue order may diverge across hosts: python "
+              "control flow on a host-varying value guards a collective",
+    "TPS002": "shard_map body uses an axis name the wrapping mesh/in_specs "
+              "never bind",
+    "TPS003": "PartitionSpec rank/axis mismatch against the array or mesh "
+              "it shards",
+    "TPS004": "non-commutative or dtype-unstable op inside a guarded "
+              "reduction (breaks the bit-identical merge contract)",
+    "TPS005": "collective issued while holding a lock (cross-host "
+              "deadlock bridge into the TPC lock graph)",
+    "TPS006": "lowered HLO contains a collective kind the jaxpr census "
+              "never declared (hidden resharding)",
+    "TPS007": "host-dependent shape feeds a collective (one compiled "
+              "program per host — recompile storm)",
+    "TPS008": "per-host collective tapes diverge or are unexplained by "
+              "the static census",
     # ---- TPC: concurrency analysis (analysis/concurrency.py + schedule.py)
     "TPC000": "file does not parse — the concurrency analyzer cannot scan it",
     "TPC001": "potential deadlock: cycle in the static lock-order graph",
@@ -253,13 +272,13 @@ class Report:
 import logging as _logging
 import re as _re
 
-_DIRECTIVE_PREFIXES = ("tp", "tplint", "tpc", "tpj")
+_DIRECTIVE_PREFIXES = ("tp", "tplint", "tpc", "tpj", "tps")
 _LEGACY_PREFIXES = ("tplint", "tpc")
 _DIR_RE = _re.compile(
     # disable codes are exact TPx-code tokens (comma-separated) so a
     # trailing uppercase rationale ("# tp: disable=TPL003 SEE DOCS")
     # can never corrupt the code being suppressed
-    r"#\s*(tp|tplint|tpc|tpj):\s*"
+    r"#\s*(tp|tplint|tpc|tpj|tps):\s*"
     r"(ok|disable=[A-Z]{3}\d+(?:\s*,\s*[A-Z]{3}\d+)*"
     r"|(?:lock|guarded|type)\(\s*[^)]+?\s*\))"
 )
@@ -299,7 +318,7 @@ def parse_directives(line: str) -> list[tuple[str, str, str]]:
 
 
 #: analyser code family -> the legacy per-analyser prefix it honours
-_FAMILY_PREFIX = {"TPL": "tplint", "TPC": "tpc", "TPJ": "tpj"}
+_FAMILY_PREFIX = {"TPL": "tplint", "TPC": "tpc", "TPJ": "tpj", "TPS": "tps"}
 
 
 def suppressed(line: str, code: str) -> bool:
@@ -333,7 +352,7 @@ def annotations(line: str, verb: str, family: str | None = None) -> list[str]:
 def attr_chain(node) -> list[str]:
     """``['np', 'random', 'choice']`` for ``np.random.choice`` — ``[]``
     when the expression is not a plain name/attribute chain. The one AST
-    helper every analyser shares (lint, concurrency, program)."""
+    helper every analyser shares (lint, concurrency, program, spmd)."""
     import ast as _ast
 
     parts: list[str] = []
@@ -344,3 +363,17 @@ def attr_chain(node) -> list[str]:
         parts.append(node.id)
         return list(reversed(parts))
     return []
+
+
+def lock_guarded_expr(expr) -> bool:
+    """True when a ``with``-item context expression looks like a lock
+    acquisition (any chain part mentions "lock"). ONE heuristic shared by
+    TPL001 (unlocked shared state) and TPS005 (collective under lock) so
+    the two families can never silently diverge on what counts as a
+    lock."""
+    import ast as _ast
+
+    chain = attr_chain(expr)
+    if isinstance(expr, _ast.Call):
+        chain = attr_chain(expr.func)
+    return any("lock" in part.lower() for part in chain)

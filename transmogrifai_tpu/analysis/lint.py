@@ -55,12 +55,14 @@ __all__ = [
 #: insights/ joined when the attribution ledger/drift monitor went in
 #: front of concurrent explain sweeps; local/ joined when scoring closures
 #: started carrying service-shared breaker/guard/quarantine state and the
-#: fused-program holder in front of concurrent service workers).
+#: fused-program holder in front of concurrent service workers; parallel/
+#: joined when the guarded-collective seam grew the per-host tape — the
+#: collective tracer records from whatever thread dispatches a reduction).
 #: The concurrency analyzer (analysis/concurrency.py, TPC0xx) scopes its
 #: whole-repo lock-order pass to this same list.
 _LOCKED_SUBSYSTEMS = (
     "featurize/", "compiler/", "utils/aot.py", "telemetry/", "serving/",
-    "resilience/", "insights/", "local/",
+    "resilience/", "insights/", "local/", "parallel/",
 )
 
 _MUTATORS = {
@@ -137,11 +139,7 @@ def _module_mutable_globals(tree: ast.Module) -> set[str]:
     return names
 
 
-def _lock_guarded(expr: ast.expr) -> bool:
-    chain = _attr_chain(expr)
-    if isinstance(expr, ast.Call):
-        chain = _attr_chain(expr.func)
-    return any("lock" in part.lower() for part in chain)
+from .findings import lock_guarded_expr as _lock_guarded  # shared heuristic
 
 
 class _SharedStateVisitor(ast.NodeVisitor):

@@ -88,6 +88,13 @@ SPEC_MODULES = (
     "transmogrifai_tpu.models.solvers",
     "transmogrifai_tpu.ops.embeddings",
     "transmogrifai_tpu.compiler.fused",
+    # the SPMD plane's shard_map kernels (PR 15): traced over device-free
+    # AbstractMeshes so the TPJ IR lints and the TPS collective census
+    # (analysis/spmd.py) inspect the exact collective programs
+    "transmogrifai_tpu.parallel.reductions",
+    "transmogrifai_tpu.parallel.multihost",
+    "transmogrifai_tpu.parallel.ring",
+    "transmogrifai_tpu.parallel.segments",
 )
 
 #: source trees the tracing-hazard AST lint (TPJ007-009) covers

@@ -20,6 +20,7 @@ Everything is expressed against a `jax.sharding.Mesh`, so the same code runs
 on one chip, a v5e pod slice over ICI, or a multi-host DCN mesh — XLA inserts
 the collectives.
 """
+from .guarded import guarded_collective  # noqa: F401
 from .mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,

@@ -28,3 +28,25 @@ def shard_map(f=None, **kwargs):
     if f is None:
         return partial(impl, **kwargs)
     return impl(f, **kwargs)
+
+
+def abstract_mesh(*axes: tuple):
+    """A device-free ``AbstractMesh`` over ``(name, size)`` axes — the
+    SPMD auditor's trace substrate: shard_map kernels trace and lower
+    over it on ANY host (a 1-chip CI runner included), no real 2x4 mesh
+    required. Returns None when this jax generation has no AbstractMesh
+    (the auditor degrades to a real-device mesh or a TPS000 finding)."""
+    try:
+        from jax.sharding import AbstractMesh
+    except ImportError:
+        return None
+    try:
+        return AbstractMesh(tuple((str(n), int(s)) for n, s in axes))
+    except TypeError:
+        # older keyword-style constructor
+        names = tuple(str(n) for n, _ in axes)
+        sizes = tuple(int(s) for _, s in axes)
+        try:
+            return AbstractMesh(axis_sizes=sizes, axis_names=names)
+        except TypeError:
+            return None

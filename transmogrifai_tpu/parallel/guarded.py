@@ -1,0 +1,220 @@
+"""The canonical guarded-collective seam — ONE entry point for every
+sharded reduction, and the dynamic side of the SPMD contract auditor.
+
+Every collective-dispatching reduction in the parallel plane
+(``pcolumn_stats`` / ``pxtx`` / ``phistogram`` / ``global_column_stats``)
+funnels through :func:`guarded_collective`. Historically the seam was a
+private ``_guarded`` in ``reductions.py`` that ``multihost.py`` imported
+at call time; promoting it here gives the resilience layer, the SPMD
+analyzer (:mod:`~transmogrifai_tpu.analysis.spmd`) and the collective
+tracer a single module to instrument.
+
+Two duties, layered so the hot path stays free:
+
+* **resilience** — when a ``FailoverController`` is installed
+  (``resilience/distributed.py``), the call runs behind its
+  ``CollectiveGuard``: straggler deadline + bounded retry, then
+  ``HostLostError``. No controller = direct call.
+* **tracing** — under ``TPTPU_COLLECTIVE_TRACE=1`` (default OFF: zero
+  wrappers, the env var is latched at import exactly like
+  ``analysis/schedule.py``'s lock tracing) every ISSUE of a collective —
+  retries included, the wrapper sits below the guard's retry loop —
+  appends ``(sequence#, name)`` to the tape of every live simulated
+  host. ``analysis.spmd.reconcile_collective_orders`` then asserts all
+  hosts' tapes are identical (a lost host's tape must be a prefix of the
+  survivors') and every entry is explained by the static seam census —
+  the third static-vs-runtime reconciler after the transfer census and
+  the lock-order graph. The classic SPMD deadlock is precisely a tape
+  divergence: one host issuing a collective the others never reach.
+
+Cross-process capture mirrors the lock tracer: set
+``TPTPU_COLLECTIVE_TRACE_OUT=<path>`` and an atexit hook dumps the tapes
+as JSON for the parent to reconcile.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Any, Callable
+
+__all__ = [
+    "TRACE_ENV",
+    "TRACE_OUT_ENV",
+    "guarded_collective",
+    "trace_enabled",
+    "set_tracing",
+    "collective_tapes",
+    "reset_tapes",
+    "mark_host_lost",
+    "dump_tapes",
+    "load_tapes",
+]
+
+TRACE_ENV = "TPTPU_COLLECTIVE_TRACE"
+TRACE_OUT_ENV = "TPTPU_COLLECTIVE_TRACE_OUT"
+
+#: host -> [(seq, name), ...]; writes hold _TAPE_LOCK (TPL001)
+_TAPES: dict[int, list] = {}
+#: hosts that stopped recording mid-run (failover) — their tape is
+#: expected to be a PREFIX of the survivors'
+_LOST: set = set()
+_TAPE_LOCK = threading.Lock()
+#: participant count, latched on the first recorded collective so a
+#: mid-run env change cannot fork the host set
+_N_HOSTS: int | None = None
+_DUMP_REGISTERED = False
+
+
+def _env_on() -> bool:
+    return os.environ.get(TRACE_ENV, "0").strip().lower() not in (
+        "", "0", "false", "off",
+    )
+
+
+#: latched at import (the zero-wrappers contract): with tracing off,
+#: guarded_collective is the exact pre-promotion ``_guarded`` body —
+#: tests flip it through set_tracing(), subprocess suites set the env
+#: var before the interpreter starts
+_TRACING = _env_on()
+
+
+def trace_enabled() -> bool:
+    """True when collective-tape recording is active."""
+    return _TRACING
+
+
+def set_tracing(on: bool) -> bool:
+    """Test seam: flip tracing in-process (the env-var latch is
+    import-time). Returns the previous state. Does not clear tapes —
+    call :func:`reset_tapes` for isolation."""
+    global _TRACING
+    prev = _TRACING
+    _TRACING = bool(on)
+    if on:
+        _register_dump()
+    return prev
+
+
+def _register_dump() -> None:
+    global _DUMP_REGISTERED
+    if _DUMP_REGISTERED:
+        return
+    with _TAPE_LOCK:
+        if not _DUMP_REGISTERED:
+            out = os.environ.get(TRACE_OUT_ENV)
+            if out:
+                atexit.register(dump_tapes, out)
+            _DUMP_REGISTERED = True
+
+
+def _live_hosts() -> list[int]:
+    """Participants still recording. The count is latched on first use;
+    the CPU simulation issues each collective once on behalf of every
+    live host, so every live tape advances together — which is exactly
+    the invariant the reconciler later asserts."""
+    global _N_HOSTS
+    if _N_HOSTS is None:
+        from ..resilience.distributed import simulated_host_count
+
+        _N_HOSTS = simulated_host_count()
+    return [h for h in range(_N_HOSTS) if h not in _LOST]
+
+
+def _record(name: str) -> None:
+    with _TAPE_LOCK:
+        for h in _live_hosts():
+            tape = _TAPES.setdefault(h, [])
+            tape.append((len(tape), name))
+
+
+def mark_host_lost(host: Any) -> None:
+    """Close ``host``'s tape (failover pulse — called by the
+    FailoverController when it declares a host lost under tracing).
+    The lost tape stops advancing; the reconciler requires it to be a
+    prefix of every survivor's tape."""
+    if not _TRACING:
+        return
+    with _TAPE_LOCK:
+        try:
+            _LOST.add(int(host))
+        except (TypeError, ValueError):
+            return
+
+
+def guarded_collective(name: str, fn: Callable, *args: Any) -> Any:
+    """Run one sharded reduction through the canonical seam.
+
+    No installed FailoverController and tracing off = direct call, zero
+    extra work on the hot path. With a controller, the call runs behind
+    its CollectiveGuard (straggler deadline + bounded retry, then
+    HostLostError). With tracing on, every ATTEMPT records onto the live
+    hosts' tapes — the recorder sits below the guard so a retried
+    collective tapes once per issue, matching what real transports do.
+    """
+    from ..resilience import distributed
+
+    run = fn
+    if _TRACING:
+        def run(*a):  # noqa: E306 - the traced twin of fn
+            _record(name)
+            return fn(*a)
+
+    guard = distributed.active_collective_guard()
+    if guard is None:
+        return run(*args)
+    return guard.run(name, run, *args)
+
+
+# ------------------------------------------------------------------ tapes
+def collective_tapes() -> dict[str, Any]:
+    """JSON-able snapshot of the per-host collective tapes (the shape
+    :func:`~transmogrifai_tpu.analysis.spmd.reconcile_collective_orders`
+    consumes)."""
+    with _TAPE_LOCK:
+        hosts = {
+            str(h): [[s, n] for s, n in tape]
+            for h, tape in sorted(_TAPES.items())
+        }
+        lost = sorted(_LOST)
+        n = _N_HOSTS
+    return {
+        "traced": _TRACING,
+        "nHosts": n if n is not None else len(hosts),
+        "hosts": hosts,
+        "lost": lost,
+    }
+
+
+def reset_tapes() -> None:
+    """Drop every recorded tape and re-latch the host count (test
+    isolation)."""
+    global _N_HOSTS
+    with _TAPE_LOCK:
+        _TAPES.clear()
+        _LOST.clear()
+        _N_HOSTS = None
+
+
+def dump_tapes(path: str) -> None:
+    """Write the tape snapshot as JSON (the atexit hook of a traced
+    subprocess run)."""
+    doc = collective_tapes()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_tapes(path: str) -> dict[str, Any]:
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# the env-latched registration runs at the BOTTOM: the atexit hook needs
+# dump_tapes bound, and a traced subprocess imports this module exactly
+# once before any collective fires
+if _TRACING:
+    _register_dump()

@@ -8,6 +8,8 @@ and everything degenerates to plain jit.
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 DATA_AXIS = "data"
@@ -95,19 +97,24 @@ class use_execution_mesh:
 
 
 _AUTO_MESH_CACHE: list = []
+_AUTO_MESH_LOCK = threading.Lock()
 
 
 def default_execution_mesh():
     """The mesh Workflow installs when the user didn't pick one: all devices
     data-parallel when >1 device is visible (cached — Mesh identity matters
     for the lru_cached shard_map kernels), else None. Set TPTPU_MESH=0 to
-    force single-device execution everywhere."""
+    force single-device execution everywhere. Thread-safe: concurrent
+    first callers (service workers racing a train) must agree on ONE
+    mesh object, or the lru_cached kernels fork per identity."""
     import os
 
     if os.environ.get("TPTPU_MESH", "") == "0":
         return None
     if not _AUTO_MESH_CACHE:
-        _AUTO_MESH_CACHE.append(auto_mesh())
+        with _AUTO_MESH_LOCK:
+            if not _AUTO_MESH_CACHE:
+                _AUTO_MESH_CACHE.append(auto_mesh())
     return _AUTO_MESH_CACHE[0]
 
 

@@ -293,11 +293,45 @@ def global_column_stats(x_local: np.ndarray, mesh, num_rows: int) -> dict:
     per-column partials per pass — never the data. Runs behind the active
     CollectiveGuard when a FailoverController is installed.
     """
-    from .reductions import _guarded
+    from .guarded import guarded_collective
 
-    return _guarded(
+    return guarded_collective(
         "global_column_stats", _global_column_stats, x_local, mesh, num_rows
     )
+
+
+def program_trace_specs():
+    """Register the DCN-spanning stats kernels with the program auditor:
+    traced over a device-free 2x4 ("dcn", "data") AbstractMesh — two
+    hosts of four chips — so the TPJ IR lints and the TPS collective
+    census inspect the exact cross-host programs without a pod."""
+    import jax
+
+    from .compat import abstract_mesh
+
+    mesh = abstract_mesh((DCN_AXIS, 2), (DATA_AXIS, 4), (MODEL_AXIS, 1))
+    if mesh is None:  # ancient jax: fall back to the real-device mesh
+        mesh = make_multihost_mesh()
+    total = 1
+    for name in mesh.axis_names:
+        total *= int(mesh.shape[name])
+    f = 4
+
+    def mat(b, cols):
+        return jax.ShapeDtypeStruct((b * total, cols), np.float32)
+
+    pass1, pass2 = _global_stats_kernels(mesh)
+    mean = jax.ShapeDtypeStruct((f,), np.float32)
+    return [
+        dict(
+            name="global_stats_pass1", fn=pass1, buckets=(8, 16),
+            build=lambda b: ((mat(b, f + 1),), {}),
+        ),
+        dict(
+            name="global_stats_pass2", fn=pass2, buckets=(8, 16),
+            build=lambda b: ((mat(b, f + 1), mean), {}),
+        ),
+    ]
 
 
 def _global_column_stats(x_local: np.ndarray, mesh, num_rows: int) -> dict:

@@ -16,6 +16,7 @@ from functools import lru_cache, partial
 
 import numpy as np
 
+from .guarded import guarded_collective
 from .mesh import DATA_AXIS, pad_rows, shard_rows
 
 
@@ -25,17 +26,11 @@ def _data_spec(*trailing):
     return P(DATA_AXIS, *trailing)
 
 
-def _guarded(name, fn, *args):
-    """Run one reduction behind the active CollectiveGuard when a
-    FailoverController is installed (resilience/distributed.py): straggler
-    deadline + bounded retry, then HostLostError. No controller = direct
-    call, zero extra work on the hot path."""
-    from ..resilience import distributed
-
-    guard = distributed.active_collective_guard()
-    if guard is None:
-        return fn(*args)
-    return guard.run(name, fn, *args)
+#: the canonical guarded-collective seam now lives in parallel/guarded.py
+#: (one module for the resilience guard, the SPMD analyzer and the
+#: collective tracer to instrument); the old private name stays importable
+#: for callers that grew around it
+_guarded = guarded_collective
 
 
 # Jitted shard_map kernels are built once per mesh (jax.sharding.Mesh is
@@ -127,8 +122,13 @@ def pcentered_gram(x: np.ndarray, mesh) -> tuple[np.ndarray, np.ndarray, float]:
     The covariance/correlation building block: per-shard mean-subtraction
     (mask-aware for padding) keeps float32 matmuls numerically safe where a
     raw-moment XᵀX would cancel (see pcolumn_stats). One MXU matmul + psum
-    per pass over ICI.
+    per pass over ICI. Runs behind the active CollectiveGuard when a
+    FailoverController is installed.
     """
+    return guarded_collective("pcentered_gram", _pcentered_gram, x, mesh)
+
+
+def _pcentered_gram(x: np.ndarray, mesh) -> tuple[np.ndarray, np.ndarray, float]:
     n_shards = mesh.shape[DATA_AXIS]
     xp, n = pad_rows(np.asarray(x, dtype=np.float32), n_shards)
     valid = np.zeros((xp.shape[0], 1), dtype=np.float32)
@@ -277,7 +277,17 @@ def pcontingency(
 
     Counts within one device round stay below float32's 2^24 integer limit;
     rounds are summed in float64 host-side, so large-N tables are exact.
+    Runs behind the active CollectiveGuard when a FailoverController is
+    installed.
     """
+    return guarded_collective(
+        "pcontingency", _pcontingency, group_onehot, label_onehot, mesh
+    )
+
+
+def _pcontingency(
+    group_onehot: np.ndarray, label_onehot: np.ndarray, mesh
+) -> np.ndarray:
     n_shards = mesh.shape[DATA_AXIS]
     fn = _contingency_kernel(mesh)
     total = np.zeros(
@@ -312,3 +322,82 @@ def _contingency_kernel(mesh):
         return jax.lax.psum(gs.T @ ls, DATA_AXIS)
 
     return jax.jit(body)
+
+
+# --------------------------------------------------------------------------
+# trace-spec registration (analysis/program.py TPJ + analysis/spmd.py TPS)
+# --------------------------------------------------------------------------
+def _spec_trace_mesh():
+    """The auditors' 8-way data mesh: device-free AbstractMesh when this
+    jax has one (traces anywhere), else a real mesh over the visible
+    devices. The lru_cached kernel factories accept either — both are
+    hashable and shard_map traces over both."""
+    from .compat import abstract_mesh
+
+    mesh = abstract_mesh((DATA_AXIS, 8), ("model", 1))
+    if mesh is not None:
+        return mesh
+    import jax
+
+    from .mesh import make_mesh
+
+    return make_mesh(n_data=len(jax.devices()), n_model=1)
+
+
+def program_trace_specs():
+    """Register the sharded-reduction kernels with the program auditor
+    (same contract as models/gbdt.py etc.): each entry traces the jitted
+    shard_map kernel over representative row buckets, so the TPJ IR
+    lints AND the TPS static collective census see exactly the programs
+    the stats plane dispatches."""
+    import jax
+    import numpy as np
+
+    mesh = _spec_trace_mesh()
+    n_shards = int(mesh.shape[DATA_AXIS])
+    f = 4  # representative column count (+1 validity appended by callers)
+
+    def rows(b):
+        return b * n_shards
+
+    def mat(b, cols, dtype=np.float32):
+        return jax.ShapeDtypeStruct((rows(b), cols), dtype)
+
+    pass1, pass2 = _stats_kernels(mesh)
+    sums, gram = _gram_kernels(mesh)
+    mean = jax.ShapeDtypeStruct((f,), np.float32)
+    return [
+        dict(
+            name="pstats_pass1", fn=pass1, buckets=(8, 16),
+            build=lambda b: ((mat(b, f + 1),), {}),
+        ),
+        dict(
+            name="pstats_pass2", fn=pass2, buckets=(8, 16),
+            build=lambda b: ((mat(b, f + 1), mean), {}),
+        ),
+        dict(
+            name="pgram_sums", fn=sums, buckets=(8, 16),
+            build=lambda b: ((mat(b, f + 1),), {}),
+        ),
+        dict(
+            name="pgram_centered", fn=gram, buckets=(8, 16),
+            build=lambda b: ((mat(b, f + 1), mean), {}),
+        ),
+        dict(
+            name="pxtx", fn=_xtx_kernel(mesh), buckets=(8, 16),
+            build=lambda b: ((mat(b, f),), {}),
+        ),
+        dict(
+            name="phistogram", fn=_hist_kernel(mesh, 16), buckets=(8, 16),
+            build=lambda b: (
+                (mat(b, f, np.int32),
+                 jax.ShapeDtypeStruct((rows(b),), np.float32)),
+                {},
+            ),
+        ),
+        dict(
+            name="pcontingency", fn=_contingency_kernel(mesh),
+            buckets=(8, 16),
+            build=lambda b: ((mat(b, 3), mat(b, 2)), {}),
+        ),
+    ]

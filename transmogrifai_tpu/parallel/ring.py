@@ -89,6 +89,32 @@ def _ring_gram_kernel(mesh):
     return jax.jit(body)
 
 
+def program_trace_specs():
+    """Register the ring-gram kernel with the program auditor: the one
+    ppermute-based program in the plane — tracing it keeps the TPS
+    collective census honest about permute collectives, not just
+    psum-family reductions. AbstractMesh traces device-free; the ring
+    step count is mesh-static so the kernel traces at any column width."""
+    import jax
+
+    from .compat import abstract_mesh
+    from .mesh import make_mesh
+
+    mesh = abstract_mesh((DATA_AXIS, 8), ("model", 1))
+    if mesh is None:
+        mesh = make_mesh(n_data=len(jax.devices()), n_model=1)
+    d = int(mesh.shape[DATA_AXIS])
+    return [
+        dict(
+            name="ring_gram", fn=_ring_gram_kernel(mesh), buckets=(1, 2),
+            bucket_axis="cols",
+            build=lambda b: (
+                (jax.ShapeDtypeStruct((32, b * d), np.float32),), {},
+            ),
+        ),
+    ]
+
+
 def ring_gram(x: np.ndarray, mesh) -> np.ndarray:
     """XᵀX [F, F] of a column-sharded matrix via ring passes over ICI.
 
@@ -96,10 +122,15 @@ def ring_gram(x: np.ndarray, mesh) -> np.ndarray:
     feature axis, not row axis, is the long one (hashed text planes); rows
     stay resident, columns ride the ring.
     """
+    from .guarded import guarded_collective
+
     d = mesh.shape[DATA_AXIS]
     xp, f = pad_cols(np.asarray(x, dtype=np.float32), d)
     xs = shard_cols(mesh, xp)
-    g = np.asarray(_ring_gram_kernel(mesh)(xs), dtype=np.float64)
+    g = np.asarray(
+        guarded_collective("ring_gram", _ring_gram_kernel(mesh), xs),
+        dtype=np.float64,
+    )
     return g[:f, :f]
 
 

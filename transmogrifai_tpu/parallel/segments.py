@@ -68,6 +68,41 @@ def _segment_kernels(mesh, num_segments: int, op: str):
     return jax.jit(reduce_shard)
 
 
+def program_trace_specs():
+    """Register the segment-reduce kernels (sum + max — the psum and the
+    pmax lowering families) with the program auditor."""
+    import jax
+
+    from .compat import abstract_mesh
+    from .mesh import make_mesh
+
+    mesh = abstract_mesh((DATA_AXIS, 8), ("model", 1))
+    if mesh is None:
+        mesh = make_mesh(n_data=len(jax.devices()), n_model=1)
+    total = 1
+    for name in mesh.axis_names:
+        total *= int(mesh.shape[name])
+
+    def build(b):
+        n = b * total
+        return (
+            (jax.ShapeDtypeStruct((n,), np.float32),
+             jax.ShapeDtypeStruct((n,), np.int32)),
+            {},
+        )
+
+    return [
+        dict(
+            name="psegment_sum", fn=_segment_kernels(mesh, 16, "sum"),
+            buckets=(8, 16), build=build,
+        ),
+        dict(
+            name="psegment_max", fn=_segment_kernels(mesh, 16, "max"),
+            buckets=(8, 16), build=build,
+        ),
+    ]
+
+
 def psegment_reduce(
     values: np.ndarray,
     seg_ids: np.ndarray,
@@ -121,10 +156,17 @@ def psegment_reduce(
     # key count), so pad it to the next power of two — the jitted kernel set
     # stays O(log max-segments) instead of one program per distinct count
     padded_segments = 1 << max(int(num_segments) - 1, 0).bit_length()
+    from .guarded import guarded_collective
+
     kernel = _segment_kernels(
         mesh, padded_segments, "sum" if op in ("count", "or") else op
     )
-    out = np.asarray(kernel(jnp.asarray(values), jnp.asarray(seg_ids)))
+    out = np.asarray(
+        guarded_collective(
+            "psegment_reduce", kernel, jnp.asarray(values),
+            jnp.asarray(seg_ids),
+        )
+    )
     out = out[:num_segments]
 
     if op == "or":

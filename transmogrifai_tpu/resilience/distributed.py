@@ -474,6 +474,16 @@ class FailoverController:
         if host is None:
             raise err
         self.sentinel.declare_lost(host)
+        # failover pulse for the collective tape (parallel/guarded.py,
+        # TPTPU_COLLECTIVE_TRACE=1): the lost host's tape freezes here, so
+        # the SPMD reconciler can require it to be a PREFIX of the
+        # survivors' — a no-op when tracing is off
+        try:
+            from ..parallel import guarded as _guarded_seam
+
+            _guarded_seam.mark_host_lost(host)
+        except Exception:  # pragma: no cover - tracing must never break failover
+            pass
         self.counters["hostsLost"] += 1
         survivors = self._surviving_devices()
         if not survivors:

@@ -906,14 +906,21 @@ class WorkflowModel:
         }
         analysis = self.analysis
         if analysis is not None:
-            # the TPC static-concurrency summary rides beside the TPA/TPX
-            # reports (lru-cached per process; contained — a broken
-            # analyzer must never break a training summary)
+            # the TPC static-concurrency and TPS SPMD summaries ride
+            # beside the TPA/TPX reports (lru-cached per process;
+            # contained — a broken analyzer must never break a training
+            # summary)
             analysis = dict(analysis)
             try:
                 from ..analysis.concurrency import package_summary
 
                 analysis["concurrency"] = package_summary()
+            except Exception:  # pragma: no cover - defensive
+                pass
+            try:
+                from ..analysis.spmd import package_summary as spmd_summary
+
+                analysis["spmd"] = spmd_summary()
             except Exception:  # pragma: no cover - defensive
                 pass
         return {
