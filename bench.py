@@ -183,6 +183,19 @@ def validate_bench_report(doc) -> list[str]:
                     problems.append(
                         f"collectiveAudit missing boolean {key!r}"
                     )
+    # additive envelope: the fleet resilience stamp (r09) is validated
+    # WHEN PRESENT — artifacts predating it stay valid forever
+    fleet = doc.get("fleet") if isinstance(doc, dict) else None
+    if fleet is not None:
+        if not isinstance(fleet, dict):
+            problems.append("fleet is not an object")
+        else:
+            for key in ("reconciled", "zeroDropped"):
+                if not isinstance(fleet.get(key), bool):
+                    problems.append(f"fleet missing boolean {key!r}")
+            for key in ("replicas", "scalingX"):
+                if not isinstance(fleet.get(key), (int, float)):
+                    problems.append(f"fleet missing numeric {key!r}")
     return problems
 
 
@@ -1190,6 +1203,134 @@ def bench_serve_loadtest(
     }
 
 
+def bench_serve_fleet(
+    replicas: int = 8,
+    base_rate: float = 4000.0,
+    duration: float = 2.0,
+    seed: int = 6,
+    deadline: float = 0.25,
+    service_time: float = 0.01,
+    max_queue_rows: int = 256,
+    max_batch_rows: int = 32,
+    kill_demo: bool = True,
+) -> dict:
+    """Fleet scaling + resilience bench (serving/fleet.py): the open-loop
+    virtual-clock loadtest over 1 and ``replicas`` workers at MATCHED
+    per-replica chaos (every replica gets the same slow-stage storm the
+    single-worker BENCH_r06 run saw, keyed via ``slow_stage(replica=r)``),
+    offered rate scaling with the worker count — the BENCH_r09.json
+    regression shape. Headline value: goodput at ``replicas`` workers;
+    ``scaling_x`` is the ratio against this run's own single-worker
+    goodput. ``kill_demo`` adds a seeded ``kill_replica`` mid-run and
+    records that the fleet-level typed ledger still reconciles with zero
+    dropped requests and exactly-once outcomes."""
+    from transmogrifai_tpu.local.scoring import score_function
+    from transmogrifai_tpu.resilience import FaultPlan, installed
+    from transmogrifai_tpu.serving import (
+        FleetConfig,
+        ServiceConfig,
+        run_fleet_loadtest,
+    )
+
+    fixed = float(service_time)
+    svc_time = lambda n: fixed  # noqa: E731
+    model, rows = _serve_loadtest_model()
+    fn = score_function(model)
+    fn.batch(rows[:max_batch_rows])
+    fn.batch(rows[:1])
+    cfg = ServiceConfig(
+        max_queue_rows=max_queue_rows, max_batch_rows=max_batch_rows
+    )
+    # hedge late and only on a WIDE score gap: under symmetric overload
+    # every duplicate hedge is wasted batch budget (the default margin
+    # tolerates queue-depth noise that this saturated bench turns into
+    # pure duplicate work); gray-failure hedging is exercised by the
+    # fleet test suite, the bench measures scaling
+    fleet_cfg = FleetConfig(
+        hedge_after_fraction=0.8, hedge_score_margin=0.3
+    )
+
+    def _chaos_plan(n: int) -> FaultPlan:
+        plan = FaultPlan(seed=seed)
+        for r in range(n):
+            # the r06 chaos storm, replicated per worker: each replica
+            # eats the same simulated slow-stage budget the single
+            # worker did, so the scaling comparison is chaos-matched
+            plan.slow_stage(delay=0.005, times=200, replica=r)
+        plan.fail_stage_transform(target="modelSelector", times=10 * n)
+        return plan
+
+    def _run(n: int, extra=None) -> dict:
+        plan = _chaos_plan(n)
+        if extra is not None:
+            extra(plan)
+        with installed(plan):
+            return run_fleet_loadtest(
+                fn, rows, rate=base_rate * n, duration=duration,
+                replicas=n, seed=seed, deadline=deadline, config=cfg,
+                service_time=svc_time, plan=plan, reconcile_every=64,
+                fleet_config=fleet_cfg,
+            )
+
+    single = _run(1)
+    full = _run(replicas)
+    scaling = (
+        round(full["goodput_rows_per_s"] / single["goodput_rows_per_s"], 3)
+        if single["goodput_rows_per_s"] else None
+    )
+    kill = None
+    if kill_demo:
+        kill = _run(
+            max(2, replicas // 2),
+            extra=lambda p: p.kill_replica(1, at=duration * 0.3),
+        )
+    metrics = {
+        "goodput_1_rows_per_s": single["goodput_rows_per_s"],
+        f"goodput_{replicas}_rows_per_s": full["goodput_rows_per_s"],
+        "scaling_x": scaling,
+        "hedges_fired": full["hedges_fired"],
+        "hedge_duplicates": full["hedge_duplicates"],
+        "reconciled": full["reconciled"] and single["reconciled"],
+        "reconciled_every_instant": full["reconciled_every_instant"],
+        "dropped": full["dropped"] + single["dropped"],
+    }
+    if kill is not None:
+        metrics.update({
+            "kill_replicas_lost": kill["replicas_lost"],
+            "kill_orphans_adopted": kill["orphans_adopted"],
+            "kill_reconciled": kill["reconciled"],
+            "kill_dropped": kill["dropped"],
+        })
+    return make_bench_report(
+        metric="fleet_goodput_rows_per_s",
+        value=full["goodput_rows_per_s"],
+        unit=f"rows/s goodput at {replicas} replicas under matched chaos",
+        seed=seed,
+        metrics=metrics,
+        duration_s=duration,
+        deadline_s=deadline,
+        service_time_s=fixed,
+        base_rate=base_rate,
+        config=(
+            f"synthetic Real+Real+PickList LR flow (512 fit rows), "
+            f"{max_queue_rows} queue rows + {max_batch_rows} batch rows "
+            f"per replica, fixed {fixed * 1e3:g} ms batch cost, "
+            f"per-replica slow_stage chaos"
+        ),
+        fleet={
+            "replicas": replicas,
+            "scalingX": scaling,
+            "reconciled": bool(metrics["reconciled"]),
+            "zeroDropped": metrics["dropped"] == 0,
+        },
+        runs={
+            "single": single,
+            "full": full,
+            **({"kill": kill} if kill is not None else {}),
+        },
+    )
+
+
 def bench_explain(
     rows: int = 256,
     k: int = 3,
@@ -1485,6 +1626,48 @@ def _build_parser():
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH",
     )
+    fl = sub.add_parser(
+        "serve-fleet",
+        help=(
+            "fleet scaling + resilience bench: the open-loop virtual-"
+            "clock loadtest over 1 and N replicas at matched per-replica "
+            "chaos, plus a seeded replica-kill reconciliation demo (the "
+            "BENCH_r09.json regression shape)"
+        ),
+    )
+    fl.add_argument(
+        "--replicas", type=int, default=8,
+        help="fleet size for the scaling measurement (default 8)",
+    )
+    fl.add_argument(
+        "--base-rate", type=float, default=4000.0,
+        help="offered arrivals per virtual second PER REPLICA "
+             "(default 4000)",
+    )
+    fl.add_argument(
+        "--duration", type=float, default=2.0,
+        help="virtual seconds of arrivals per run (default 2.0)",
+    )
+    fl.add_argument("--seed", type=int, default=6, help="schedule seed")
+    fl.add_argument(
+        "--deadline", type=float, default=0.25,
+        help="per-request latency budget in seconds (default 0.25)",
+    )
+    fl.add_argument(
+        "--service-time", type=float, default=0.01, metavar="SECS",
+        help="fixed virtual seconds per micro-batch (deterministic, "
+             "machine-independent; default 0.01)",
+    )
+    fl.add_argument("--max-queue-rows", type=int, default=256)
+    fl.add_argument("--max-batch-rows", type=int, default=32)
+    fl.add_argument(
+        "--no-kill-demo", action="store_true",
+        help="skip the seeded replica-kill reconciliation run",
+    )
+    fl.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the JSON report to PATH",
+    )
     mc = sub.add_parser(
         "multichip",
         help=(
@@ -1759,6 +1942,19 @@ def _dispatch(ns) -> None:
         dump_bench_report(
             bench_serve_fused(
                 rows=ns.rows, k=ns.k, median_of=ns.median_of
+            ),
+            ns.out, echo=True,
+        )
+        return
+    if mode == "serve-fleet":
+        dump_bench_report(
+            bench_serve_fleet(
+                replicas=ns.replicas, base_rate=ns.base_rate,
+                duration=ns.duration, seed=ns.seed, deadline=ns.deadline,
+                service_time=ns.service_time,
+                max_queue_rows=ns.max_queue_rows,
+                max_batch_rows=ns.max_batch_rows,
+                kill_demo=not ns.no_kill_demo,
             ),
             ns.out, echo=True,
         )

@@ -1,0 +1,650 @@
+"""Fleet serving suite (transmogrifai_tpu/serving/fleet.py + router.py +
+registry.py): N replicas behind health × load dispatch, hedged retries
+with idempotent de-dup, replica-loss drain + orphan adoption, the fleet
+chaos soak on the virtual-clock loadtest harness, and versioned rollout
+(shadow scoring, sentinel-gated canary promotion / rollback).
+
+Everything runs on injectable/virtual clocks — zero real sleeps.
+Markers: serving, fleet, faults.
+"""
+import threading
+
+import pytest
+
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.serving import (
+    FleetConfig,
+    FleetService,
+    ModelRegistry,
+    RejectedByAdmission,
+    ScoringService,
+    ServiceConfig,
+    ShedConfig,
+    run_fleet_loadtest,
+)
+from transmogrifai_tpu.telemetry import events as tevents
+from transmogrifai_tpu.telemetry import export as texport
+from transmogrifai_tpu.telemetry.runlog import RunTolerances
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet, pytest.mark.faults]
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class _Stage:
+    """The minimal stage shape the fault plan's duration seam matches."""
+
+    uid = "FakeStage_000000000001"
+    operation_name = "fakeOp"
+    output_name = "fakeStage"
+
+
+_STAGE = _Stage()
+
+
+class Fn:
+    """Score-function double: one result row per input row with a
+    ``prediction`` scalar at ``offset + x1``, plus the stage-duration
+    seam every real scoring loop has — so ``slow_stage`` /
+    ``slow_replica`` chaos injects simulated seconds exactly as it does
+    through local/scoring."""
+
+    def __init__(self, offset=0.0):
+        self.offset = float(offset)
+        self.calls = 0
+        self.rows_seen = 0
+
+    def batch(self, rows, explain=0):
+        plan = faults.active()
+        if plan is not None:
+            plan.on_stage_duration(_STAGE)
+        self.calls += 1
+        self.rows_seen += len(rows)
+        return [
+            {"pred": {"prediction": self.offset + float(r.get("x1", 0.0))}}
+            for r in rows
+        ]
+
+
+def _cfg(**kw):
+    kw.setdefault("workers", 0)
+    kw.setdefault("max_queue_rows", 64)
+    return ServiceConfig(**kw)
+
+
+def _fleet(n=2, clock=None, fn=None, service=None, **fleet_kw):
+    fc = FleetConfig(replicas=n, service=service or _cfg(), **fleet_kw)
+    fleet = FleetService(fn or Fn(), config=fc, clock=clock or FakeClock())
+    return fleet.start()
+
+
+def _rows(n):
+    return [{"x1": float(i)} for i in range(n)]
+
+
+# ------------------------------------------------------- replica fault keying
+class TestReplicaFaultKeying:
+    def test_slow_stage_keyed_to_one_replica(self, fault_plan):
+        fault_plan.slow_stage(delay=0.5, replica=1)
+        with faults.replica_scope(0):
+            assert fault_plan.on_stage_duration(_STAGE) == 0.0
+        with faults.replica_scope(1):
+            assert fault_plan.on_stage_duration(_STAGE) == 0.5
+        # unkeyed context (no replica scope) never matches a keyed fault
+        assert fault_plan.on_stage_duration(_STAGE) == 0.0
+        assert ("slow", "fakeStage") in fault_plan.fired
+
+    def test_slow_replica_sugar(self, fault_plan):
+        fault_plan.slow_replica(2, delay=0.25)
+        with faults.replica_scope(2):
+            assert fault_plan.on_stage_duration(_STAGE) == 0.25
+        with faults.replica_scope(0):
+            assert fault_plan.on_stage_duration(_STAGE) == 0.0
+
+    def test_replica_scope_nesting_restores(self):
+        assert faults.current_replica() is None
+        with faults.replica_scope(0):
+            assert faults.current_replica() == 0
+            with faults.replica_scope(1):
+                assert faults.current_replica() == 1
+            assert faults.current_replica() == 0
+        assert faults.current_replica() is None
+
+    def test_burst_replica_pinning(self, fault_plan):
+        fault_plan.burst_arrivals(1.0, 0.5, multiplier=4.0, replica=1)
+        assert fault_plan.burst_replica(1.2) == 1
+        assert fault_plan.burst_replica(0.5) is None
+        assert fault_plan.burst_replica(1.5) is None
+        # the rate multiplier is unchanged by replica keying
+        assert fault_plan.arrival_multiplier(1.2) == 4.0
+        assert fault_plan.arrival_multiplier(0.5) == 1.0
+
+    def test_kill_replica_fires_once(self, fault_plan):
+        fault_plan.kill_replica(1, at=2.0)
+        assert fault_plan.replicas_to_kill(1.0) == []
+        assert fault_plan.replicas_to_kill(2.0) == [1]
+        assert fault_plan.replicas_to_kill(3.0) == []
+        assert ("kill_replica", "1@t=2") in fault_plan.fired
+
+    def test_partition_window(self, fault_plan):
+        fault_plan.partition_replica(0, start=1.0, duration=2.0)
+        assert not fault_plan.replica_partitioned(0, 0.5)
+        assert fault_plan.replica_partitioned(0, 1.5)
+        assert not fault_plan.replica_partitioned(0, 3.0)
+        assert not fault_plan.replica_partitioned(1, 1.5)
+        assert ("partition", "0@t=1") in fault_plan.fired
+
+    def test_partition_needs_positive_duration(self, fault_plan):
+        with pytest.raises(ValueError):
+            fault_plan.partition_replica(0, duration=0.0)
+
+
+# ------------------------------------------------------------------ stop mode
+class TestStopMode:
+    def test_unknown_mode_rejected(self):
+        svc = ScoringService(Fn(), config=_cfg(), clock=FakeClock()).start()
+        with pytest.raises(ValueError, match="unknown stop mode"):
+            svc.stop(mode="bogus")
+        svc.stop()
+
+    def test_reject_new_then_drain_returns_typed_orphans(self):
+        svc = ScoringService(Fn(), config=_cfg(), clock=FakeClock()).start()
+        handles = [svc.submit({"x1": float(i)}) for i in range(3)]
+        orphans = svc.stop(mode="reject_new_then_drain")
+        assert len(orphans) == 3
+        for h in handles:
+            assert h.done() and h.outcome == "stopped"
+            assert isinstance(h.error, RejectedByAdmission)
+            assert h.error.reason == "stopped"
+        s = svc.stats()
+        assert s["admitted"] == 3 and s["shed"]["stopped"] == 3
+        assert s["outstanding"] == 0  # the dying replica's ledger reconciles
+
+    def test_default_drain_mode_executes_queued_work(self):
+        svc = ScoringService(Fn(), config=_cfg(), clock=FakeClock()).start()
+        handles = [svc.submit({"x1": float(i)}) for i in range(3)]
+        assert svc.stop() == []
+        assert all(h.outcome == "completed" for h in handles)
+        assert svc.stats()["completed"] == 3
+
+    def test_stop_vs_submit_hammer(self):
+        """8 threads race reject_new_then_drain against submits: every
+        submit either settles with a typed outcome or raises the typed
+        ``RejectedByAdmission("stopped")`` — never silence, never an
+        untyped error — and the ledger reconciles after the dust."""
+        svc = ScoringService(
+            Fn(), config=_cfg(max_queue_rows=10_000), clock=FakeClock()
+        ).start()
+        barrier = threading.Barrier(8)
+        handles, rejects, errors = [], [], []
+        lock = threading.Lock()
+
+        def submitter():
+            barrier.wait()
+            for i in range(50):
+                try:
+                    h = svc.submit({"x1": float(i)})
+                    with lock:
+                        handles.append(h)
+                except RejectedByAdmission as e:
+                    assert e.reason == "stopped"
+                    with lock:
+                        rejects.append(e)
+                except BaseException as e:  # pragma: no cover - the trap
+                    with lock:
+                        errors.append(e)
+
+        def stopper():
+            barrier.wait()
+            svc.stop(mode="reject_new_then_drain")
+
+        threads = [threading.Thread(target=submitter) for _ in range(7)]
+        threads.append(threading.Thread(target=stopper))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        for h in handles:
+            assert h.done() and h.outcome == "stopped"
+        s = svc.stats()
+        assert s["admitted"] == len(handles)
+        assert s["outstanding"] == 0
+        assert s["rejected"].get("stopped", 0) == len(rejects)
+
+
+# --------------------------------------------------------------------- router
+class TestRouter:
+    def test_deterministic_tie_break_then_load_aware(self):
+        clock = FakeClock()
+        fleet = _fleet(n=3, clock=clock)
+        try:
+            r = fleet.router
+            assert r.order() == [0, 1, 2]  # idle fleet: index tie-break
+            for _ in range(3):
+                fleet.submit({"x1": 1.0}, pin=0)
+            # replica 0 now carries queued rows; the others are idle
+            assert r.load(0) > 0.0 and r.load(1) == 0.0
+            assert r.order() == [1, 2, 0]
+            fleet.pump_until_quiet()
+        finally:
+            fleet.stop()
+
+    def test_lost_and_partitioned_replicas_unroutable(self, fault_plan):
+        clock = FakeClock()
+        fleet = _fleet(n=3, clock=clock)
+        try:
+            fleet.lose_replica(1)
+            assert not fleet.router.routable(1)
+            fault_plan.partition_replica(2, start=0.0, duration=10.0)
+            clock.now = 1.0
+            assert not fleet.router.routable(2)
+            assert fleet.router.score(2) == float("-inf")
+            assert fleet.router.order() == [0]
+            assert fleet.router.pick() == 0
+        finally:
+            fleet.stop()
+
+
+# --------------------------------------------------------- dispatch + ledger
+class TestFleetDispatchAndLedger:
+    def test_exactly_once_balanced_dispatch(self):
+        fleet = _fleet(n=3)
+        try:
+            handles = [fleet.submit(r) for r in _rows(9)]
+            fleet.pump_until_quiet()
+            for i, h in enumerate(handles):
+                assert h.outcome == "completed"
+                assert h.results[0]["pred"]["prediction"] == float(i)
+            s = fleet.stats()
+            assert s["admitted"] == 9 and s["completed"] == 9
+            assert s["outstanding"] == 0
+            dispatched = s["router"]["dispatched"]
+            assert sum(dispatched.values()) == 9
+            assert all(dispatched.get(i, 0) >= 1 for i in range(3))
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+    def test_queue_full_falls_through_the_order(self):
+        fleet = _fleet(n=2, service=_cfg(max_queue_rows=2))
+        try:
+            fleet.submit({"x1": 0.0}, pin=0)
+            fleet.submit({"x1": 1.0}, pin=0)  # replica 0 now full
+            h = fleet.submit({"x1": 2.0}, pin=0)  # falls through to 1
+            assert fleet.router.stats()["dispatched"].get(1, 0) == 1
+            assert fleet.stats()["rejected"]["queue_full"] == 0
+            fleet.pump_until_quiet()
+            assert h.outcome == "completed"
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+    def test_every_replica_full_is_a_typed_rejection(self):
+        # shed thresholds pushed out of reach so the bound itself rejects
+        shed = ShedConfig(reject_enter=9.0, reject_exit=8.0)
+        fleet = _fleet(n=2, service=_cfg(max_queue_rows=1, shed=shed))
+        try:
+            fleet.submit({"x1": 0.0})
+            fleet.submit({"x1": 1.0})
+            with pytest.raises(RejectedByAdmission) as ei:
+                fleet.submit({"x1": 2.0})
+            assert ei.value.reason == "queue_full"
+            s = fleet.stats()
+            # the rejected admission never entered the ledger
+            assert s["admitted"] == 2
+            assert s["rejected"]["queue_full"] == 1
+            fleet.pump_until_quiet()
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+    def test_no_routable_replicas_is_stopped(self):
+        fleet = _fleet(n=2)
+        fleet.lose_replica(0)
+        fleet.lose_replica(1)
+        with pytest.raises(RejectedByAdmission) as ei:
+            fleet.submit({"x1": 0.0})
+        assert ei.value.reason == "stopped"
+        assert fleet.stats()["rejected"]["stopped"] == 1
+        fleet.stop()
+
+    def test_fleet_prometheus_source(self):
+        fleet = _fleet(n=2)
+        try:
+            for r in _rows(4):
+                fleet.submit(r)
+            fleet.pump_until_quiet()
+            text = texport.render_prometheus()
+            lines = {
+                ln.split(" ")[0]: ln.split(" ")[1]
+                for ln in text.splitlines()
+                if ln.startswith("tptpu_fleet_") and not ln.startswith("#")
+            }
+            assert float(lines["tptpu_fleet_fleets"]) >= 1
+            assert float(lines["tptpu_fleet_replicas"]) >= 2
+            assert float(lines["tptpu_fleet_admitted"]) >= 4
+            assert float(lines["tptpu_fleet_completed"]) >= 4
+            assert "tptpu_fleet_hedges_fired" in lines
+            assert "tptpu_fleet_replicas_lost" in lines
+        finally:
+            fleet.stop()
+
+
+# -------------------------------------------------------------------- hedging
+class TestHedging:
+    def test_partition_triggers_hedge_then_dedup(self, fault_plan):
+        clock = FakeClock()
+        # heartbeat timeout out of reach: the gray replica must stay
+        # formally alive so the HEDGE (not replica loss) re-dispatches
+        fleet = _fleet(n=2, clock=clock, heartbeat_timeout=1e9)
+        try:
+            tevents.reset_for_tests()
+            fault_plan.partition_replica(0, start=5.0, duration=100.0)
+            h = fleet.submit({"x1": 3.0}, deadline=10.0, pin=0)
+            clock.now = 6.0  # past the 50% deadline checkpoint, 0 is gray
+            fleet.tick()
+            assert fleet.hedges_fired == 1
+            evts = [
+                e for e in tevents.recent(10) if e["kind"] == "hedge_fired"
+            ]
+            assert evts and evts[-1]["fromReplica"] == 0
+            assert evts[-1]["toReplica"] == 1
+            # the partitioned replica keeps executing (gray failure) —
+            # BOTH attempts settle, exactly one wins the logical handle
+            fleet.pump_until_quiet()
+            assert h.outcome == "completed"
+            assert h.results[0]["pred"]["prediction"] == 3.0
+            assert fleet.hedge_duplicates == 1
+            assert fleet.stats()["completed"] == 1  # not double-counted
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+    def test_no_hedge_without_score_margin(self):
+        clock = FakeClock()
+        fleet = _fleet(n=2, clock=clock)
+        try:
+            fleet.submit({"x1": 0.0}, deadline=10.0, pin=0)
+            clock.now = 6.0  # symmetric fleet: every score is equal
+            fleet.tick()
+            assert fleet.hedges_fired == 0
+            fleet.pump_until_quiet()
+        finally:
+            fleet.stop()
+
+    def test_hedge_fires_at_most_once_per_request(self, fault_plan):
+        clock = FakeClock()
+        fleet = _fleet(n=3, clock=clock, heartbeat_timeout=1e9)
+        try:
+            fault_plan.partition_replica(0, start=1.0, duration=100.0)
+            fleet.submit({"x1": 0.0}, deadline=10.0, pin=0)
+            clock.now = 6.0
+            fleet.tick()
+            clock.now = 7.0
+            fleet.tick()  # the hedged flag blocks a second hedge
+            assert fleet.hedges_fired == 1
+            fleet.pump_until_quiet()
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+
+# --------------------------------------------------------------- replica loss
+class TestReplicaLoss:
+    def test_kill_adopts_orphans_exactly_once(self):
+        fleet = _fleet(n=2)
+        try:
+            tevents.reset_for_tests()
+            handles = [fleet.submit(r, pin=0) for r in _rows(3)]
+            adopted = fleet.lose_replica(0, reason="killed")
+            assert adopted == 3 and fleet.orphans_adopted == 3
+            fleet.pump_until_quiet()
+            for h in handles:
+                assert h.outcome == "completed"  # zero dropped
+            s = fleet.stats()
+            assert s["completed"] == 3 and s["outstanding"] == 0
+            assert s["lostReplicas"] == [0] and s["replicasLost"] == 1
+            # the dying replica's OWN ledger reconciled: its queued work
+            # shed as stopped, nothing left outstanding
+            r0 = s["perReplica"][0]
+            assert r0["shed"]["stopped"] == 3 and r0["outstanding"] == 0
+            evts = [
+                e for e in tevents.recent(10) if e["kind"] == "replica_lost"
+            ]
+            assert evts and evts[-1]["replica"] == 0
+            assert evts[-1]["orphans"] == 3
+            assert fleet.lose_replica(0) == 0  # idempotent
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+    def test_scripted_kill_fires_via_tick(self, fault_plan):
+        clock = FakeClock()
+        fleet = _fleet(n=2, clock=clock)
+        try:
+            fault_plan.kill_replica(1, at=2.0)
+            fleet.tick()
+            assert fleet.lost == set()
+            clock.now = 2.5
+            fleet.tick()
+            assert fleet.lost == {1}
+            assert ("kill_replica", "1@t=2") in fault_plan.fired
+        finally:
+            fleet.stop()
+
+    def test_heartbeat_timeout_declares_loss(self, fault_plan):
+        clock = FakeClock()
+        fleet = _fleet(n=2, clock=clock, heartbeat_timeout=5.0)
+        try:
+            fleet.tick()  # both beat at t=0
+            fault_plan.partition_replica(1, start=0.5, duration=100.0)
+            clock.now = 1.0
+            fleet.tick()  # replica 1's beats stop arriving
+            assert fleet.lost == set()
+            clock.now = 7.0
+            fleet.tick()  # 1 is now stale beyond the timeout
+            assert fleet.lost == {1}
+        finally:
+            fleet.stop()
+
+    def test_adoption_dead_end_settles_typed(self):
+        fleet = _fleet(n=2, service=_cfg(max_queue_rows=1))
+        try:
+            h0 = fleet.submit({"x1": 0.0}, pin=0)
+            h1 = fleet.submit({"x1": 1.0}, pin=1)  # survivor is now full
+            fleet.lose_replica(0)
+            # no survivor could take the orphan: typed outcome, no silence
+            assert h0.done() and h0.outcome == "stopped"
+            assert isinstance(h0.error, RejectedByAdmission)
+            fleet.pump_until_quiet()
+            assert h1.outcome == "completed"
+            s = fleet.stats()
+            assert s["shed"]["stopped"] == 1 and s["outstanding"] == 0
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+
+# ------------------------------------------------------------- fleet loadtest
+class TestFleetLoadtest:
+    def _soak(self, seed=0):
+        plan = faults.FaultPlan(seed=seed)
+        plan.kill_replica(1, at=0.4)
+        plan.slow_replica(2, delay=0.002)
+        plan.burst_arrivals(0.2, 0.2, multiplier=2.0, replica=0)
+        with faults.installed(plan):
+            report = run_fleet_loadtest(
+                Fn(),
+                rows=_rows(32),
+                rate=300.0,
+                duration=1.0,
+                replicas=3,
+                seed=seed,
+                deadline=0.25,
+                service_time=lambda n: 0.002,
+                plan=plan,
+                reconcile_every=1,
+            )
+        return report
+
+    def test_chaos_soak_zero_drop_reconciled(self):
+        report = self._soak()
+        assert report["dropped"] == 0
+        assert report["reconciled"]
+        assert report["reconciled_every_instant"]
+        assert report["replicas_lost"] == 1
+        assert report["lost_replicas"] == [1]
+        assert report["completed"] > 0
+        # every admitted request has exactly one typed outcome
+        settled = (
+            report["completed"] + report["quarantined"] + report["errors"]
+            + report["shed_total"]
+        )
+        assert report["admitted"] == settled
+
+    def test_deterministic_twin(self):
+        assert self._soak(seed=7) == self._soak(seed=7)
+
+    def test_two_replicas_scale_goodput(self):
+        def run(n):
+            plan = faults.FaultPlan()
+            with faults.installed(plan):
+                return run_fleet_loadtest(
+                    Fn(),
+                    rows=_rows(16),
+                    rate=200.0 * n,
+                    duration=1.0,
+                    replicas=n,
+                    seed=3,
+                    deadline=0.5,
+                    service_time=lambda k: 0.004,
+                    plan=plan,
+                )
+
+        g1 = run(1)["goodput_rows_per_s"]
+        g2 = run(2)["goodput_rows_per_s"]
+        assert g2 > 1.5 * g1
+
+
+# ----------------------------------------------------------- registry rollout
+class TestRegistryRollout:
+    def test_shadow_compares_and_never_serves(self):
+        fleet = _fleet(n=2)
+        try:
+            reg = ModelRegistry(fleet).register("v2", Fn(offset=0.6))
+            reg.start_shadow("v2")
+            handles = [fleet.submit({"x1": 0.0}) for _ in range(5)]
+            fleet.pump_until_quiet()
+            # served results come from the CONTROL model, always
+            for h in handles:
+                assert h.results[0]["pred"]["prediction"] == 0.0
+            rep = reg.stop_shadow()
+            assert rep["seen"] == 5 and rep["compared"] == 5
+            assert rep["agreement"] == 0.0
+            assert rep["meanAbsDelta"] == pytest.approx(0.6)
+        finally:
+            fleet.stop()
+
+    def test_canary_quality_regression_rolls_back(self):
+        fleet = _fleet(n=2)
+        try:
+            tevents.reset_for_tests()
+            reg = ModelRegistry(fleet).register("bad", Fn(offset=0.6))
+            reg.start_canary("bad", replicas=(0,))
+            handles = []
+            for i in range(8):
+                handles.append(fleet.submit({"x1": 0.0}, pin=i % 2))
+                fleet.pump_until_quiet()
+            decision = reg.evaluate_canary()
+            assert decision["decision"] == "rollback"
+            assert "TPR004" in decision["codes"]
+            assert reg.rollbacks == 1
+            # the rollout itself dropped nothing: every request settled
+            assert all(h.outcome == "completed" for h in handles)
+            # the control model is back on the canary replica
+            h = fleet.submit({"x1": 0.0}, pin=0)
+            fleet.pump_until_quiet()
+            assert h.results[0]["pred"]["prediction"] == 0.0
+            evts = [
+                e for e in tevents.recent(10)
+                if e["kind"] == "canary_rollback"
+            ]
+            assert evts and evts[-1]["version"] == "bad"
+            assert "TPR004" in evts[-1]["codes"]
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+    def test_clean_canary_promotes_fleet_wide(self):
+        fleet = _fleet(n=2)
+        try:
+            tevents.reset_for_tests()
+            good = Fn(offset=0.0)
+            reg = ModelRegistry(fleet).register("v2", good)
+            reg.start_canary("v2", replicas=(0,))
+            for i in range(8):
+                fleet.submit({"x1": 0.0}, pin=i % 2)
+                fleet.pump_until_quiet()
+            decision = reg.evaluate_canary()
+            assert decision["decision"] == "promote"
+            assert decision["codes"] == []
+            assert reg.serving == "v2" and reg.promotions == 1
+            assert all(svc.score_fn is good for svc in fleet.services)
+            assert any(
+                e["kind"] == "canary_promoted" for e in tevents.recent(10)
+            )
+        finally:
+            fleet.stop()
+
+    def test_canary_latency_regression_rolls_back(self, fault_plan):
+        clock = FakeClock()
+        fleet = _fleet(n=2, clock=clock)
+        try:
+            # the canary replica is 0.3 simulated seconds slower per
+            # batch; replica completion stamps advance on the shared
+            # clock so per-side latency diverges
+            fault_plan.slow_replica(0, delay=0.3)
+            for svc in fleet.services:
+                svc.on_batch_cost = (
+                    lambda real, sim, n: setattr(
+                        clock, "now", clock.now + 0.01 + sim
+                    )
+                )
+            reg = ModelRegistry(fleet).register("slow", Fn(offset=0.0))
+            reg.start_canary(
+                "slow", replicas=(0,),
+                tolerances=RunTolerances(phase_min_seconds=0.01),
+            )
+            for i in range(8):
+                fleet.submit({"x1": 0.0}, pin=i % 2)
+                fleet.pump_until_quiet()
+            decision = reg.evaluate_canary()
+            assert decision["decision"] == "rollback"
+            assert "TPR001" in decision["codes"]
+            assert decision["canaryLatency"] > decision["controlLatency"]
+        finally:
+            fleet.stop()
+
+    def test_attribution_drift_gates_the_canary(self):
+        from transmogrifai_tpu.insights import ledger as iledger
+
+        fleet = _fleet(n=2)
+        try:
+            reg = ModelRegistry(fleet).register("v2", Fn(offset=0.0))
+            reg.start_canary("v2", replicas=(0,))
+            for i in range(4):
+                fleet.submit({"x1": 0.0}, pin=i % 2)
+                fleet.pump_until_quiet()
+            iledger.stats().count_drift_alert()
+            decision = reg.evaluate_canary()
+            assert decision["decision"] == "rollback"
+            assert "attribution_drift" in decision["codes"]
+        finally:
+            fleet.stop()

@@ -35,6 +35,32 @@ class SimulatedCrash(BaseException):
     and other broad handlers cannot swallow it."""
 
 
+# ------------------------------------------------------------ replica scope
+# Replica-keyed faults (slow_stage(replica=...), partition_replica, ...)
+# need to know WHICH fleet replica is executing the current stage. The
+# fleet's ScoringService wraps each batch execution in replica_scope(i);
+# the hooks below read the ambient value through current_replica(). Thread-
+# local on purpose: replicas execute on arbitrary threads and the scope
+# must not leak across concurrent batch executions.
+_REPLICA_TLS = threading.local()
+
+
+def current_replica() -> Any | None:
+    """The replica executing on this thread, or None outside a fleet."""
+    return getattr(_REPLICA_TLS, "replica", None)
+
+
+@contextlib.contextmanager
+def replica_scope(replica: Any | None) -> "Iterator[None]":
+    """Declare the ambient replica for fault matching on this thread."""
+    prev = getattr(_REPLICA_TLS, "replica", None)
+    _REPLICA_TLS.replica = replica
+    try:
+        yield
+    finally:
+        _REPLICA_TLS.replica = prev
+
+
 def _matches(stage: Any, target: str) -> bool:
     """A target names a stage by uid, class name, operation name, or output
     column name."""
@@ -76,6 +102,8 @@ class FaultPlan:
         self._straggle_faults: list[dict[str, Any]] = []
         self._heartbeat_faults: list[dict[str, Any]] = []
         self._shard_faults: list[dict[str, Any]] = []
+        self._replica_kill_faults: list[dict[str, Any]] = []
+        self._replica_partitions: list[dict[str, Any]] = []
         #: chronological record of fired faults: (kind, detail)
         self.fired: list[tuple[str, str]] = []
 
@@ -148,6 +176,7 @@ class FaultPlan:
         target: str | None = None,
         delay: float = 0.1,
         times: int | None = None,
+        replica: Any | None = None,
     ) -> "FaultPlan":
         """Inflate a matching scoring stage's observed duration by
         ``delay`` SIMULATED seconds (no real sleep): the scoring loop adds
@@ -155,27 +184,73 @@ class FaultPlan:
         latency seconds, and consumes it from any active per-request
         deadline budget (serving/deadline.py), so slow-stage chaos drives
         deadline rejections and breaker overruns deterministically.
-        Unlimited by default — a degraded stage stays slow."""
+        Unlimited by default — a degraded stage stays slow. ``replica``
+        keys the fault to one fleet replica (matched against the ambient
+        :func:`replica_scope`); None hits every replica."""
         self._slow_faults.append(
             {"target": target, "delay": float(delay), "times": times,
-             "count": 0}
+             "count": 0, "replica": replica}
         )
         return self
 
+    def slow_replica(
+        self, replica: Any, delay: float = 0.1, times: int | None = None
+    ) -> "FaultPlan":
+        """Slow EVERY scoring stage on one fleet replica by ``delay``
+        simulated seconds — sugar over :meth:`slow_stage` with a replica
+        key and no stage target (the degraded-worker scenario the hedging
+        tests script)."""
+        return self.slow_stage(
+            target=None, delay=delay, times=times, replica=replica
+        )
+
     def burst_arrivals(
-        self, start: float, duration: float, multiplier: float = 10.0
+        self,
+        start: float,
+        duration: float,
+        multiplier: float = 10.0,
+        replica: Any | None = None,
     ) -> "FaultPlan":
         """Declare an arrival-rate burst window for the open-loop
         serve-loadtest harness: between ``start`` and ``start + duration``
         (harness virtual seconds) the nominal arrival rate multiplies by
-        ``multiplier``. Queried via :meth:`arrival_multiplier` while
-        generating the seeded schedule — the burst is part of the plan, so
-        the same plan replays the same overload every run."""
+        ``multiplier``. Queried via :meth:`arrival_multiplier` at EVERY
+        arrival step (not just at schedule build), so windows compose with
+        whatever the clock does at run time — the burst is part of the
+        plan, and the same plan replays the same overload every run.
+        ``replica`` additionally pins arrivals inside the window to one
+        fleet replica (queried via :meth:`burst_replica` by the fleet
+        harness) — a sticky hot-spot aimed at a single worker."""
         if duration <= 0 or multiplier <= 0:
             raise ValueError("burst_arrivals needs duration > 0, multiplier > 0")
         self._burst_windows.append(
             {"start": float(start), "end": float(start) + float(duration),
-             "multiplier": float(multiplier), "fired": False}
+             "multiplier": float(multiplier), "fired": False,
+             "replica": replica}
+        )
+        return self
+
+    def kill_replica(self, replica: Any, at: float = 0.0) -> "FaultPlan":
+        """Kill one fleet replica at harness-virtual time ``at``: the
+        fleet's tick consults :meth:`replicas_to_kill` and decommissions
+        the replica (stop + orphan adoption by survivors). Fires once."""
+        self._replica_kill_faults.append(
+            {"replica": replica, "at": float(at), "fired": False}
+        )
+        return self
+
+    def partition_replica(
+        self, replica: Any, start: float = 0.0, duration: float = 1e9
+    ) -> "FaultPlan":
+        """Network-partition one fleet replica for ``[start, start +
+        duration)`` harness-virtual seconds: its heartbeats stop reaching
+        the fleet sentinel and the router scores it unroutable, but the
+        replica itself keeps executing (the gray-failure scenario)."""
+        if duration <= 0:
+            raise ValueError("partition_replica needs duration > 0")
+        self._replica_partitions.append(
+            {"replica": replica, "start": float(start),
+             "end": float(start) + float(duration), "fired": False}
         )
         return self
 
@@ -440,12 +515,15 @@ class FaultPlan:
         (``slow_stage``). Fires per execution; only the FIRST firing per
         fault lands in ``fired`` (a standing service executes thousands of
         batches)."""
+        replica = current_replica()
         with self._lock:
             extra = 0.0
             for f in self._slow_faults:
                 if f["times"] is not None and f["count"] >= f["times"]:
                     continue
                 if f["target"] is not None and not _matches(stage, f["target"]):
+                    continue
+                if f.get("replica") is not None and f["replica"] != replica:
                     continue
                 f["count"] += 1
                 if f["count"] == 1:
@@ -468,6 +546,50 @@ class FaultPlan:
                         self.fired.append(("burst", f"t={f['start']:g}"))
                     mult *= f["multiplier"]
             return mult
+
+    def burst_replica(self, t: float) -> Any | None:
+        """The replica a burst window covering ``t`` pins arrivals to
+        (first keyed window wins), or None — the fleet loadtest harness
+        bypasses the router for pinned arrivals so one replica takes the
+        whole hot-spot."""
+        with self._lock:
+            for f in self._burst_windows:
+                if f.get("replica") is None:
+                    continue
+                if f["start"] <= t < f["end"]:
+                    return f["replica"]
+            return None
+
+    def replicas_to_kill(self, now: float) -> list[Any]:
+        """Replica kills due at harness-virtual time ``now`` (each fires
+        exactly once; firings land in ``fired``)."""
+        with self._lock:
+            due = []
+            for f in self._replica_kill_faults:
+                if f["fired"] or f["at"] > now:
+                    continue
+                f["fired"] = True
+                due.append(f["replica"])
+                self.fired.append(
+                    ("kill_replica", f"{f['replica']}@t={f['at']:g}")
+                )
+            return due
+
+    def replica_partitioned(self, replica: Any, now: float) -> bool:
+        """True while ``replica`` sits inside a scripted partition window.
+        The first positive query per fault lands in ``fired``."""
+        with self._lock:
+            for f in self._replica_partitions:
+                if f["replica"] != replica:
+                    continue
+                if f["start"] <= now < f["end"]:
+                    if not f["fired"]:
+                        f["fired"] = True
+                        self.fired.append(
+                            ("partition", f"{replica}@t={f['start']:g}")
+                        )
+                    return True
+            return False
 
     def on_score_row(self, row: dict, index: int) -> dict | None:
         """Return a corrupted copy of an incoming row, or None to keep it."""

@@ -13,14 +13,28 @@ assemble here into a service that stays up under overload and faults:
 * :mod:`.shedding` — backpressure + tiered load shedding with hysteresis,
 * :mod:`.service` — the service loop (:class:`ScoringService`),
 * :mod:`.loadtest` — the seeded open-loop arrival harness on a virtual
-  clock (``bench.py serve-loadtest``).
+  clock (``bench.py serve-loadtest`` / ``serve-fleet``),
+* :mod:`.fleet` / :mod:`.router` — N replicas behind health × load
+  dispatch with hedged retries and replica-loss drain
+  (:class:`FleetService`),
+* :mod:`.registry` — versioned rollout: shadow scoring and
+  sentinel-gated canary promotion (:class:`ModelRegistry`).
 
-See docs/serving.md ("Overload & graceful degradation").
+See docs/serving.md ("Overload & graceful degradation", "Fleet
+operation").
 """
 from .batcher import BatchPlan, MicroBatcher
 from .deadline import DeadlineBudget, DeadlineExceeded
-from .loadtest import LoadSchedule, VirtualClock, run_loadtest
+from .fleet import FleetConfig, FleetRequest, FleetService
+from .loadtest import (
+    LoadSchedule,
+    VirtualClock,
+    run_fleet_loadtest,
+    run_loadtest,
+)
 from .queue import AdmissionQueue, RejectedByAdmission
+from .registry import ModelRegistry
+from .router import Router, RouterConfig
 from .service import PendingScore, ScoringService, ServiceConfig
 from .shedding import LoadShedder, ShedConfig
 
@@ -29,14 +43,21 @@ __all__ = [
     "BatchPlan",
     "DeadlineBudget",
     "DeadlineExceeded",
+    "FleetConfig",
+    "FleetRequest",
+    "FleetService",
     "LoadSchedule",
     "LoadShedder",
     "MicroBatcher",
+    "ModelRegistry",
     "PendingScore",
     "RejectedByAdmission",
+    "Router",
+    "RouterConfig",
     "ScoringService",
     "ServiceConfig",
     "ShedConfig",
     "VirtualClock",
+    "run_fleet_loadtest",
     "run_loadtest",
 ]

@@ -36,7 +36,9 @@ from . import deadline as _deadline
 from .queue import RejectedByAdmission
 from .service import ScoringService, ServiceConfig
 
-__all__ = ["VirtualClock", "LoadSchedule", "run_loadtest"]
+__all__ = [
+    "VirtualClock", "LoadSchedule", "run_loadtest", "run_fleet_loadtest",
+]
 
 
 class VirtualClock:
@@ -217,4 +219,200 @@ def run_loadtest(
         "reconciled": (
             stats["admitted"] == settled and stats["outstanding"] == 0
         ),
+    }
+
+
+def run_fleet_loadtest(
+    score_fn: Callable,
+    rows: list[dict],
+    rate: float,
+    duration: float,
+    replicas: int = 2,
+    seed: int = 0,
+    deadline: float | None = None,
+    config: ServiceConfig | None = None,
+    service_time: Callable[[int], float] | None = None,
+    plan: "_faults.FaultPlan | None" = None,
+    fleet_config: "FleetConfig | None" = None,
+    reconcile_every: int = 1,
+) -> dict[str, Any]:
+    """Open-loop loadtest over a :class:`~.fleet.FleetService`: the same
+    seeded arrival schedule, dispatched through the router onto
+    ``replicas`` workers, each on its OWN virtual clock (a replica's
+    clock is its busy timeline; the shared fleet clock is arrival time).
+    Every arrival instant also ticks the fleet control plane, so scripted
+    ``kill_replica`` / ``partition_replica`` faults, heartbeat aging, and
+    hedge checkpoints all fire in virtual time. ``reconcile_every=k``
+    checks the fleet-level typed invariant at every k-th arrival
+    (``reconciled_every_instant`` in the report); ``dropped`` counts
+    logical requests that finished with NO typed outcome and must be 0."""
+    from .fleet import FleetConfig, FleetService
+
+    if replicas < 1:
+        raise ValueError("need replicas >= 1")
+    rng = np.random.default_rng(seed)
+    gclock = VirtualClock()
+    rclocks = [VirtualClock() for _ in range(replicas)]
+    # deterministic mode virtualizes the telemetry clock onto the FLEET
+    # clock (see run_loadtest) — family seconds then reflect only the
+    # plan's simulated charges, never host speed
+    prev_spans_clock = _tspans.get_clock()
+    if service_time is not None:
+        _tspans.set_clock(gclock)
+    cfg = config or ServiceConfig()
+    cfg = dataclasses.replace(cfg, workers=0)
+    if deadline is not None:
+        cfg = dataclasses.replace(cfg, default_deadline=deadline)
+    fc = dataclasses.replace(
+        fleet_config or FleetConfig(), replicas=replicas, service=cfg
+    )
+    fleet = FleetService(
+        score_fn, fc, clock=gclock, replica_clocks=rclocks
+    )
+    for i, svc in enumerate(fleet.services):
+        def _advance(real: float, sim: float, n: int, _c=rclocks[i]) -> None:
+            base = service_time(n) if service_time is not None else real
+            _c.advance(base + sim)
+
+        svc.on_batch_cost = _advance
+    fleet.start()
+    schedule = LoadSchedule(rate=rate, duration=duration, seed=seed)
+    arrivals = schedule.arrivals(plan)
+    idx = rng.integers(0, len(rows), size=max(1, len(arrivals)))
+    handles = []
+    max_depth = 0
+    reconciled_every_instant = True
+
+    def _serve_replica_until(i: int, horizon: float | None) -> None:
+        """Run replica ``i``'s batches whose start lands before
+        ``horizon`` (None = one pump pass only happens in the drain
+        loop; here we drain until the horizon)."""
+        svc = fleet.services[i]
+        c = rclocks[i]
+        while svc.queue.depth_requests() > 0:
+            if horizon is not None and c.now >= horizon:
+                break
+            if not svc.pump():  # everything left expired/settled
+                break
+
+    try:
+        for k, t in enumerate(arrivals):
+            for i in fleet.live_replicas():
+                _serve_replica_until(i, t)
+            gclock.advance(max(0.0, t - gclock.now))
+            # idle time passes for replicas with an empty queue
+            for i in fleet.live_replicas():
+                c = rclocks[i]
+                if (
+                    fleet.services[i].queue.depth_requests() == 0
+                    and c.now < t
+                ):
+                    c.advance(t - c.now)
+            fleet.tick(t)
+            pin = plan.burst_replica(t) if plan is not None else None
+            try:
+                handles.append(
+                    fleet.submit(dict(rows[int(idx[k])]), pin=pin)
+                )
+            except (RejectedByAdmission, _deadline.DeadlineExceeded):
+                pass  # counted in the fleet's typed rejection taxonomy
+            max_depth = max(
+                max_depth,
+                sum(s.queue.depth_rows() for s in fleet.services),
+            )
+            if reconcile_every and k % reconcile_every == 0:
+                if not fleet.reconcile()["reconciled"]:
+                    reconciled_every_instant = False
+        # arrivals over: drain, still ticking (hedges and scripted kills
+        # keep firing in virtual time until the fleet is quiet)
+        while True:
+            settled = 0
+            for i in fleet.live_replicas():
+                settled += fleet.services[i].pump()
+            t = max([gclock.now] + [c.now for c in rclocks])
+            gclock.advance(t - gclock.now)
+            fleet.tick(t)
+            if settled == 0 and all(
+                fleet.services[i].queue.depth_requests() == 0
+                for i in fleet.live_replicas()
+            ):
+                break
+        fleet.stop()
+    finally:
+        _tspans.set_clock(prev_spans_clock)
+    end = max([gclock.now] + [c.now for c in rclocks])
+
+    stats = fleet.stats()
+    recon = fleet.reconcile()
+    latencies = sorted(
+        h.latency() for h in handles
+        if h.outcome in ("completed", "quarantined")
+        and h.latency() is not None
+    )
+
+    def _pct(q: float) -> float | None:
+        if not latencies:
+            return None
+        return round(
+            float(np.percentile(latencies, q, method="nearest")) * 1e3, 3
+        )
+
+    shed_total = sum(stats["shed"].values())
+    rejected_total = sum(stats["rejected"].values())
+    settled_total = (
+        stats["completed"] + stats["quarantined"] + stats["errors"]
+        + shed_total
+    )
+    offered = len(arrivals)
+    dropped = sum(1 for h in handles if h.outcome is None)
+    return {
+        "rate": rate,
+        "duration_s": duration,
+        "seed": seed,
+        "replicas": replicas,
+        "offered": offered,
+        "admitted": stats["admitted"],
+        "completed": stats["completed"],
+        "quarantined": stats["quarantined"],
+        "errors": stats["errors"],
+        "shed": dict(stats["shed"]),
+        "rejected": dict(stats["rejected"]),
+        "shed_total": shed_total,
+        "rejected_total": rejected_total,
+        "shed_rate": (
+            round((shed_total + rejected_total) / offered, 4)
+            if offered else 0.0
+        ),
+        "latency_ms": {"p50": _pct(50), "p95": _pct(95), "p99": _pct(99)},
+        "goodput_rows_per_s": (
+            round(stats["completed"] / end, 2) if end > 0 else 0.0
+        ),
+        "max_queue_depth_rows": max_depth,
+        "virtual_end_s": round(end, 4),
+        "hedges_fired": stats["hedgesFired"],
+        "hedge_duplicates": stats["hedgeDuplicates"],
+        "orphans_adopted": stats["orphansAdopted"],
+        "replicas_lost": stats["replicasLost"],
+        "lost_replicas": stats["lostReplicas"],
+        "router_dispatched": stats["router"]["dispatched"],
+        "per_replica": [
+            {
+                "admitted": s["admitted"],
+                "completed": s["completed"],
+                "shed": dict(s["shed"]),
+                "rejected": dict(s["rejected"]),
+                "outstanding": s["outstanding"],
+                "batches": s["batches"],
+            }
+            for s in stats["perReplica"]
+        ],
+        # exactly-once accounting: no logical request may end silent
+        "dropped": dropped,
+        "reconciled": (
+            stats["admitted"] == settled_total
+            and stats["outstanding"] == 0
+            and recon["reconciled"]
+            and dropped == 0
+        ),
+        "reconciled_every_instant": reconciled_every_instant,
     }

@@ -102,7 +102,9 @@ class PendingScore:
 
 
 class ScoreRequest:
-    __slots__ = ("rows", "budget", "handle", "enqueued_at", "explain")
+    __slots__ = (
+        "rows", "budget", "handle", "enqueued_at", "explain", "on_settled",
+    )
 
     def __init__(
         self,
@@ -111,12 +113,16 @@ class ScoreRequest:
         handle: PendingScore,
         enqueued_at: float,
         explain: int = 0,
+        on_settled: Callable[["ScoreRequest"], None] | None = None,
     ):
         self.rows = rows
         self.budget = budget
         self.handle = handle
         self.enqueued_at = enqueued_at
         self.explain = explain
+        # fleet seam: called with the settled request AFTER its outcome is
+        # stamped and its event set, outside every service lock
+        self.on_settled = on_settled
 
 
 class ScoringService:
@@ -127,10 +133,14 @@ class ScoringService:
         score_fn: Callable,
         config: ServiceConfig | None = None,
         clock: Callable[[], float] | None = None,
+        replica: Any = None,
     ):
         self.score_fn = score_fn
         self.config = config or ServiceConfig()
         self.clock = clock if clock is not None else time.monotonic
+        # fleet identity: replica-keyed faults match against this via the
+        # ambient replica_scope the batch loop installs (None = standalone)
+        self.replica = replica
         self.queue = AdmissionQueue(self.config.max_queue_rows)
         self.batcher = MicroBatcher(
             self.queue, self.config.max_batch_rows, clock=self.clock
@@ -206,14 +216,29 @@ class ScoringService:
             th.start()
         return self
 
-    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+    def stop(
+        self,
+        drain: bool = True,
+        timeout: float = 30.0,
+        mode: str = "drain",
+    ) -> list[ScoreRequest]:
         """Quiesce: close admissions, drain (or shed) the queue, join
         workers. After stop() the queue is empty, every admitted request
         has a typed outcome, and no service thread is alive. The
         queue-depth / in-flight gauges reset to zero on EVERY exit path
         (including the worker-leak alarm) — a stopped service must not
         freeze its last pre-quiesce value into the Prometheus exposition
-        as if rows were still in flight."""
+        as if rows were still in flight.
+
+        ``mode="reject_new_then_drain"`` is the fleet decommission path: a
+        submit racing the stop gets the typed ``RejectedByAdmission
+        ("stopped")`` the instant admissions close, queued requests are
+        NOT executed here — each is settled ``stopped`` (so this replica's
+        own ledger reconciles) and returned for the fleet to adopt onto
+        survivors. The default mode returns ``[]``."""
+        if mode not in ("drain", "reject_new_then_drain"):
+            raise ValueError(f"unknown stop mode {mode!r}")
+        orphans: list[ScoreRequest] = []
         try:
             self.queue.close()
             self._stop.set()
@@ -222,17 +247,20 @@ class ScoringService:
                 if th.is_alive():  # pragma: no cover - the deadlock alarm
                     raise RuntimeError(f"service worker {th.name} leaked")
             self._threads.clear()
-            if drain:
+            if drain and mode == "drain":
                 while self.pump():
                     pass
             for req in self.queue.drain():
                 self._finish(
                     req, "stopped", error=RejectedByAdmission("stopped")
                 )
+                if mode == "reject_new_then_drain":
+                    orphans.append(req)
             self.shedder.reset()
         finally:
             _tm.REGISTRY.gauge("tptpu_serve_queue_depth").set(0)
             _tm.REGISTRY.gauge("tptpu_serve_in_flight_rows").set(0)
+        return orphans
 
     def __enter__(self) -> "ScoringService":
         return self.start()
@@ -246,6 +274,7 @@ class ScoringService:
         rows: dict | list[dict],
         deadline: float | None = None,
         explain: int = 0,
+        on_settled: Callable[[ScoreRequest], None] | None = None,
     ) -> PendingScore:
         """Admit one request (one row dict, or a small list scored as a
         unit). ``explain=k`` asks for top-k LOCO attributions beside each
@@ -295,7 +324,8 @@ class ScoringService:
                 )
         handle = PendingScore(submitted_at=now)
         req = ScoreRequest(
-            list(rows), budget, handle, enqueued_at=now, explain=explain
+            list(rows), budget, handle, enqueued_at=now, explain=explain,
+            on_settled=on_settled,
         )
         try:
             # offer + admitted count under ONE critical section: a worker
@@ -395,7 +425,8 @@ class ScoringService:
             out: list[dict] | None = None
             error: BaseException | None = None
             try:
-                with _deadline.active(budget):
+                with _faults.replica_scope(self.replica), \
+                        _deadline.active(budget):
                     out = (
                         self.score_fn.batch(rows, explain=explain_k)
                         if explain_k
@@ -502,6 +533,14 @@ class ScoringService:
         elif outcome in ("deadline_exceeded", "stopped"):
             _tm.REGISTRY.counter("tptpu_serve_shed_total").inc()
         h._event.set()
+        cb = req.on_settled
+        if cb is not None:
+            # outside every service lock (the callback may take the fleet
+            # lock; lock-order discipline forbids nesting it under ours)
+            try:
+                cb(req)
+            except Exception:  # a broken observer must not kill the loop
+                log.exception("on_settled callback failed")
 
     # -------------------------------------------------------------- signals
     def _breaker_open_fraction(self) -> float:

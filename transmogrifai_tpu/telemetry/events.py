@@ -14,6 +14,8 @@ Wired event kinds (see docs/observability.md for the catalogue):
 * ``drift_alert`` — resilience/sentinel.py drift sentinel
 * ``checkpoint_save`` — resilience/checkpoint.py layer saves
 * ``warmup_complete`` — compiler/warmup.py background bank loads
+* ``replica_lost`` / ``hedge_fired`` — serving/fleet.py fleet plane
+* ``canary_rollback`` / ``canary_promoted`` — serving/registry.py
 
 The log is a bounded in-memory deque (``TPTPU_EVENT_BUFFER``, default
 4096) exportable as JSONL (:func:`to_jsonl` / :func:`write`); set
