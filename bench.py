@@ -196,6 +196,21 @@ def validate_bench_report(doc) -> list[str]:
             for key in ("replicas", "scalingX"):
                 if not isinstance(fleet.get(key), (int, float)):
                     problems.append(f"fleet missing numeric {key!r}")
+    # additive envelope: the continuous-retraining stamp (r10) is
+    # validated WHEN PRESENT — artifacts predating it stay valid forever
+    retrain = doc.get("retrain") if isinstance(doc, dict) else None
+    if retrain is not None:
+        if not isinstance(retrain, dict):
+            problems.append("retrain is not an object")
+        else:
+            for key in ("zeroDropped", "reconciled"):
+                if not isinstance(retrain.get(key), bool):
+                    problems.append(f"retrain missing boolean {key!r}")
+            for key in ("triggered", "promoted", "rolledBack"):
+                if not isinstance(retrain.get(key), int) or isinstance(
+                    retrain.get(key), bool
+                ):
+                    problems.append(f"retrain missing integer {key!r}")
     return problems
 
 
@@ -1331,6 +1346,287 @@ def bench_serve_fleet(
     )
 
 
+class _RegressedFn:
+    """Deterministically broken serving closure: delegates everything to
+    the wrapped score function but FLIPS every rendered binary prediction
+    — the seeded 'bad retrain' the serve-retrain bench ships into the
+    canary so the registry's agreement gate provably rolls it back."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def batch(self, rows, **kw):
+        out = self._inner.batch(rows, **kw)
+        for row in out:
+            for v in row.values():
+                if isinstance(v, dict) and "prediction" in v:
+                    try:
+                        v["prediction"] = 1.0 - float(v["prediction"])
+                    except (TypeError, ValueError):
+                        pass
+        return out
+
+
+def _retrain_build_workflow(chunks, ctx):
+    """Rebuild the serve-loadtest flow over the collected traffic window
+    (the ``build_workflow`` seam of ``warm_start_workflow_trainer``).
+    Labels come from the bench generator's noiseless decision rule — the
+    synthetic stand-in for a production label-join pipeline. uids reset
+    before each build so every attempt constructs the SAME feature graph
+    (stable dag signature — a crashed attempt's layer checkpoints resume
+    on the rebuilt twin)."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.types.columns import column_from_values
+    from transmogrifai_tpu.utils import uid as uid_util
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    rows = [r for chunk in chunks for r in chunk]
+    x1 = np.array([float(r["x1"]) for r in rows])
+    x2 = np.array([float(r["x2"]) for r in rows])
+    city = [str(r["city"]) for r in rows]
+    label = (x1 + 0.5 * x2 > 0).astype(float)
+    uid_util.reset()
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+        "city": column_from_values(T.PickList, city),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    )
+    pred = selector.set_input(resp, vec).get_output()
+    return Workflow().set_result_features(pred).set_input_dataset(ds)
+
+
+def bench_serve_retrain(
+    replicas: int = 2,
+    rate: float = 600.0,
+    duration: float = 4.0,
+    seed: int = 17,
+    deadline: float = 0.25,
+    service_time: float = 0.002,
+    max_queue_rows: int = 256,
+    max_batch_rows: int = 32,
+) -> dict:
+    """Continuous-retraining E2E on virtual clocks (resilience/retrain.py
+    + serving/): a live fleet under seeded load eats a scripted
+    ``shift_feature`` drift ramp; the drift sentinel alerts; the
+    RetrainController collects a chunked traffic window (one chunk torn
+    by ``corrupt_new_chunk`` and quarantined), warm-start retrains —
+    crashing ONCE mid-fit (``crash_retrain``) and resuming from its own
+    layer checkpoints — passes the run-ledger gate, canaries on one
+    replica, and promotes fleet-wide. The still-drifting stream then
+    triggers a SECOND retrain whose closure is deterministically
+    regressed; the canary agreement gate rolls it back. The whole loop
+    runs inside one ``run_fleet_loadtest`` on virtual time: zero dropped
+    requests, the fleet ledger reconciled at every checked instant — the
+    BENCH_r10.json regression shape."""
+    import tempfile
+
+    from transmogrifai_tpu.local.scoring import score_function
+    from transmogrifai_tpu.resilience import (
+        FaultPlan,
+        RetrainConfig,
+        RetrainController,
+        installed,
+        warm_start_workflow_trainer,
+    )
+    from transmogrifai_tpu.resilience.retry import RetryPolicy
+    from transmogrifai_tpu.serving import (
+        FleetConfig,
+        ModelRegistry,
+        ServiceConfig,
+        run_fleet_loadtest,
+    )
+    from transmogrifai_tpu.telemetry.runlog import RunTolerances
+
+    if replicas < 2:
+        raise SystemExit("serve-retrain needs >= 2 replicas "
+                         "(one canary + one control)")
+    fixed = float(service_time)
+    svc_time = lambda n: fixed  # noqa: E731
+    model, rows = _serve_loadtest_model()
+    fn = score_function(model)
+    fn.batch(rows[:max_batch_rows])
+    fn.batch(rows[:1])
+    cfg = ServiceConfig(
+        max_queue_rows=max_queue_rows, max_batch_rows=max_batch_rows
+    )
+    fleet_cfg = FleetConfig(
+        hedge_after_fraction=0.8, hedge_score_margin=0.3
+    )
+    tolerances = RunTolerances(
+        # small-window retrain vs the 512-row baseline: keep the latency/
+        # compile/transfer gates, widen only the 0/1-prediction quality
+        # channels (agreement + disagreement-rate scoreError) so a clean
+        # refresh promotes while the flipped closure (agreement ~0) is
+        # still refused by a mile
+        quality_drop=0.25,
+    )
+    plan = FaultPlan(seed=seed)
+    # the drift injection: x1 shifts by 3 sigma and keeps ramping, so the
+    # sentinel alerts early and the REFRESHED sentinel (post-promotion)
+    # alerts again — that re-alert is what arms the second, regressive
+    # retrain
+    plan.shift_feature("x1", offset=3.0, ramp=0.002)
+    plan.crash_retrain(after_layer=0, times=1)
+    plan.corrupt_new_chunk(times=1)
+
+    state: dict = {}
+
+    with tempfile.TemporaryDirectory(prefix="retrain_ckpt_") as ckpt_dir:
+        base_trainer = warm_start_workflow_trainer(
+            _retrain_build_workflow, checkpoint_dir=ckpt_dir
+        )
+
+        def trainer(chunks, ctx):
+            version, new_fn, run_doc = base_trainer(chunks, ctx)
+            if int(ctx.get("retrainIndex", 0)) >= 2:
+                new_fn = _RegressedFn(new_fn)
+                version += "-regressed"
+            return version, new_fn, run_doc
+
+        class _LiveDriftSource:
+            """Polls the drift sentinel of the CURRENT control-side
+            closure — after a promotion that is the refreshed model's
+            OWN sentinel, so a still-drifting stream re-alerts."""
+
+            def __init__(self, fleet):
+                self.fleet = fleet
+
+            def report(self):
+                drift = getattr(
+                    self.fleet.services[-1].score_fn, "drift", None
+                )
+                if drift is not None:
+                    drift.report()
+
+        def _setup(fleet):
+            registry = ModelRegistry(fleet, tolerances=tolerances)
+            registry.register("base", fn)
+            controller = RetrainController(
+                fleet, registry, trainer,
+                config=RetrainConfig(
+                    quorum=1,
+                    quorum_window=10.0,
+                    cooldown=1.5,
+                    collect_rows=96,
+                    chunk_rows=32,
+                    min_canary_served=24,
+                    canary_replicas=(0,),
+                    canary_timeout=3.0,
+                    max_retrains=2,
+                    backoff=RetryPolicy(
+                        max_attempts=4, base_delay=0.5, max_delay=2.0,
+                        jitter=0.0,
+                    ),
+                    tolerances=tolerances,
+                    drift_check_every=0.1,
+                    seed=seed,
+                ),
+                baseline_run={"run": model.run_report or {}},
+                drift_source=_LiveDriftSource(fleet),
+            )
+            state["registry"] = registry
+            state["controller"] = controller
+            return controller.tick
+
+        with installed(plan):
+            run = run_fleet_loadtest(
+                fn, rows, rate=rate, duration=duration,
+                replicas=replicas, seed=seed, deadline=deadline,
+                config=cfg, service_time=svc_time, plan=plan,
+                reconcile_every=32, fleet_config=fleet_cfg,
+                on_fleet=_setup,
+            )
+
+    controller = state["controller"]
+    registry = state["registry"]
+    ledger = controller.ledger()
+    controller.close()
+    fired = {}
+    for kind, _detail in plan.fired:
+        fired[kind] = fired.get(kind, 0) + 1
+    metrics = {
+        "retrains_triggered": ledger["retrainsTriggered"],
+        "retrains_promoted": ledger["retrainsPromoted"],
+        "retrains_rolled_back": ledger["retrainsRolledBack"],
+        "retrains_gated": ledger["retrainsGated"],
+        "retrain_crashes": ledger["retrainCrashes"],
+        "retrain_resumes": ledger["retrainResumes"],
+        "chunks_collected": ledger["chunksCollected"],
+        "chunks_corrupted": ledger["chunksCorrupted"],
+        "alerts_seen": ledger["alertsSeen"],
+        "serving_version": registry.serving,
+        "final_state": ledger["state"],
+        "goodput_rows_per_s": run["goodput_rows_per_s"],
+        "dropped": run["dropped"],
+        "reconciled": run["reconciled"],
+        "reconciled_every_instant": run["reconciled_every_instant"],
+    }
+    ok = (
+        ledger["retrainsPromoted"] == 1
+        and ledger["retrainsRolledBack"] == 1
+        and ledger["retrainCrashes"] >= 1
+        and ledger["retrainResumes"] >= 1
+        and ledger["chunksCorrupted"] >= 1
+        and run["dropped"] == 0
+        and run["reconciled_every_instant"]
+    )
+    return make_bench_report(
+        metric="serve_retrain_loop_outcomes",
+        value=f"{ledger['retrainsPromoted']} promoted / "
+              f"{ledger['retrainsRolledBack']} rolled back",
+        unit="drift-triggered retrains through the canary gate",
+        seed=seed,
+        metrics=metrics,
+        ok=ok,
+        duration_s=duration,
+        deadline_s=deadline,
+        service_time_s=fixed,
+        rate=rate,
+        replicas=replicas,
+        config=(
+            f"synthetic Real+Real+PickList LR flow (512 fit rows), "
+            f"{replicas} replicas, scripted x1 drift ramp + one "
+            f"mid-retrain crash + one torn chunk; warm-start retrain "
+            f"over a {96}-row served window, canary on replica 0"
+        ),
+        retrain={
+            "triggered": ledger["retrainsTriggered"],
+            "promoted": ledger["retrainsPromoted"],
+            "rolledBack": ledger["retrainsRolledBack"],
+            "crashResumes": ledger["retrainResumes"],
+            "zeroDropped": run["dropped"] == 0,
+            "reconciled": bool(run["reconciled_every_instant"]),
+            "servingVersion": registry.serving,
+        },
+        history=controller.history,
+        chaos_fired=fired,
+        retrain_ledger=ledger,
+        run={
+            k: run[k] for k in (
+                "rate", "duration_s", "offered", "completed", "shed",
+                "rejected", "errors", "quarantined", "dropped",
+                "goodput_rows_per_s", "reconciled",
+                "reconciled_every_instant", "p50_ms", "p95_ms", "p99_ms",
+            ) if k in run
+        },
+    )
+
+
 def bench_explain(
     rows: int = 256,
     k: int = 3,
@@ -1668,6 +1964,46 @@ def _build_parser():
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH",
     )
+    rt = sub.add_parser(
+        "serve-retrain",
+        help=(
+            "continuous-retraining E2E: fleet under seeded load + "
+            "scripted drift ramp -> detect -> warm-start retrain (one "
+            "crash+resume) -> gate -> canary -> promote, then a seeded "
+            "regressive retrain the canary rolls back — all on virtual "
+            "clocks (the BENCH_r10.json regression shape)"
+        ),
+    )
+    rt.add_argument(
+        "--replicas", type=int, default=2,
+        help="fleet size; replica 0 canaries, the rest stay control "
+             "(default 2)",
+    )
+    rt.add_argument(
+        "--rate", type=float, default=600.0,
+        help="offered arrivals per virtual second (default 600)",
+    )
+    rt.add_argument(
+        "--duration", type=float, default=4.0,
+        help="virtual seconds of arrivals (default 4.0 — both retrains "
+             "complete well inside it)",
+    )
+    rt.add_argument("--seed", type=int, default=17, help="schedule seed")
+    rt.add_argument(
+        "--deadline", type=float, default=0.25,
+        help="per-request latency budget in seconds (default 0.25)",
+    )
+    rt.add_argument(
+        "--service-time", type=float, default=0.002, metavar="SECS",
+        help="fixed virtual seconds per micro-batch (deterministic, "
+             "machine-independent; default 0.002)",
+    )
+    rt.add_argument("--max-queue-rows", type=int, default=256)
+    rt.add_argument("--max-batch-rows", type=int, default=32)
+    rt.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the JSON report to PATH",
+    )
     mc = sub.add_parser(
         "multichip",
         help=(
@@ -1959,6 +2295,16 @@ def _dispatch(ns) -> None:
             ns.out, echo=True,
         )
         return
+    if mode == "serve-retrain":
+        doc = bench_serve_retrain(
+            replicas=ns.replicas, rate=ns.rate, duration=ns.duration,
+            seed=ns.seed, deadline=ns.deadline,
+            service_time=ns.service_time,
+            max_queue_rows=ns.max_queue_rows,
+            max_batch_rows=ns.max_batch_rows,
+        )
+        dump_bench_report(doc, ns.out, echo=True)
+        raise SystemExit(0 if doc["ok"] else 1)
     if mode == "serve-loadtest":
         dump_bench_report(
             bench_serve_loadtest(

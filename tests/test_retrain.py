@@ -1,0 +1,917 @@
+"""Continuous-retraining control loop suite (resilience/retrain.py):
+drift-alert quorum/debounce, chunked traffic collection with torn-chunk
+quarantine, warm-start retrain with crash-resume across seeded
+``crash_retrain``, the run-ledger gate BEFORE the canary, canary
+promote / rollback / timeout through the real ModelRegistry, the
+provable ``max_retrains`` + backoff bound, the ``drift_cleared``
+hysteresis pairing, the events subscriber seam, and the ``retrain`` /
+streaming-chunk ledger exposure.
+
+Everything runs on injectable/virtual clocks — zero real sleeps.
+Markers: retrain, serving, faults.
+"""
+import csv
+import os
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.resilience.faults import SimulatedCrash
+from transmogrifai_tpu.resilience.retrain import (
+    RetrainConfig,
+    RetrainController,
+    chunk_fit_stats,
+    ledger_snapshot,
+    warm_start_workflow_trainer,
+)
+from transmogrifai_tpu.resilience.retry import RetryPolicy, TransientError
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.serving import (
+    FleetConfig,
+    FleetService,
+    ModelRegistry,
+    ServiceConfig,
+)
+from transmogrifai_tpu.telemetry import events as tevents
+from transmogrifai_tpu.telemetry import metrics as tmetrics
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+pytestmark = [pytest.mark.retrain, pytest.mark.serving, pytest.mark.faults]
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class Fn:
+    """Score-function double: ``prediction = offset + x1`` per row."""
+
+    def __init__(self, offset=0.0):
+        self.offset = float(offset)
+
+    def batch(self, rows, explain=0):
+        return [
+            {"pred": {"prediction": self.offset + float(r.get("x1", 0.0))}}
+            for r in rows
+        ]
+
+
+class FakeFleet:
+    """The minimal fleet surface the controller integrates with: the
+    ``on_served`` seam plus a services list for registry doubles."""
+
+    def __init__(self, n=2):
+        self.on_served = None
+        self.services = [ScoringStub() for _ in range(n)]
+
+    def serve(self, rows, replica=0, latency=0.01):
+        hook = self.on_served
+        results = self.services[replica].score_fn.batch(rows)
+        if hook is not None:
+            hook(rows, results, replica, latency)
+
+
+class ScoringStub:
+    def __init__(self):
+        self.score_fn = Fn()
+
+
+class FakeRegistry:
+    """Scripted registry double recording the rollout calls the
+    controller makes; ``decision`` scripts evaluate_canary."""
+
+    def __init__(self, decision="promote", compared=10):
+        self.decision = decision
+        self.compared = compared
+        self.calls = []
+        self.serving = None
+        self._canary = None
+
+    def register(self, version, fn):
+        self.calls.append(("register", version))
+
+    def start_canary(self, version, replicas=(0,), tolerances=None):
+        if self._canary is not None:
+            raise RuntimeError("a canary is already running")
+        self._canary = version
+        self.calls.append(("start_canary", version, tuple(replicas)))
+
+    def canary_report(self):
+        if self._canary is None:
+            raise RuntimeError("no canary running")
+        return {"compared": self.compared, "version": self._canary}
+
+    def evaluate_canary(self):
+        version, self._canary = self._canary, None
+        self.calls.append(("evaluate_canary", version))
+        if self.decision == "promote":
+            self.serving = version
+            return {
+                "decision": "promote", "compared": self.compared,
+                "agreement": 1.0, "codes": [],
+            }
+        return {
+            "decision": "rollback", "compared": self.compared,
+            "agreement": 0.0, "codes": ["TPR004"],
+        }
+
+    def rollback(self, codes=()):
+        if self._canary is None:
+            raise RuntimeError("no canary running")
+        self._canary = None
+        self.calls.append(("rollback", tuple(codes)))
+
+
+def _run_doc(auroc=0.9, serve_s=0.01):
+    return {
+        "run": {
+            "phases": {"serve": {"seconds": serve_s}},
+            "quality": {"auroc": auroc},
+            "deviceMemory": {"deviceBytesInUse": 1024,
+                             "devicePeakBytes": 4096},
+        }
+    }
+
+
+def _scripted_trainer(script):
+    """``script`` is a list: each entry is an Exception instance to raise
+    or an (version, fn, run_doc) tuple to return, consumed per call."""
+    calls = []
+
+    def trainer(chunks, ctx):
+        calls.append(dict(ctx, chunks=len(chunks)))
+        step = script.pop(0)
+        if isinstance(step, BaseException):
+            raise step
+        return step
+
+    trainer.calls = calls
+    return trainer
+
+
+def _controller(trainer, clock=None, fleet=None, registry=None,
+                baseline=None, **cfg_kw):
+    clock = clock or FakeClock()
+    fleet = fleet or FakeFleet()
+    registry = registry if registry is not None else FakeRegistry()
+    cfg_kw.setdefault("quorum", 1)
+    cfg_kw.setdefault("cooldown", 0.0)
+    cfg_kw.setdefault("collect_rows", 8)
+    cfg_kw.setdefault("chunk_rows", 4)
+    cfg_kw.setdefault("min_canary_served", 1)
+    cfg_kw.setdefault(
+        "backoff",
+        RetryPolicy(max_attempts=4, base_delay=10.0, max_delay=80.0,
+                    jitter=0.0),
+    )
+    ctl = RetrainController(
+        fleet, registry, trainer, config=RetrainConfig(**cfg_kw),
+        clock=clock, baseline_run=baseline,
+    )
+    return ctl, clock, fleet, registry
+
+
+def _alert(feature="x1"):
+    tevents.emit("drift_alert", feature=feature)
+
+
+def _collect(fleet, ctl, clock, rows=None, n=8):
+    rows = rows or [{"x1": float(i), "city": "a"} for i in range(n)]
+    for r in rows:
+        fleet.serve([r])
+    return ctl.tick(clock.now)
+
+
+@pytest.fixture(autouse=True)
+def _detach(request):
+    """Every test detaches its controllers (the events subscriber list is
+    process-global)."""
+    ctls = []
+    request.node._retrain_ctls = ctls
+    yield
+    for c in ctls:
+        c.close()
+
+
+def _track(request, ctl):
+    request.node._retrain_ctls.append(ctl)
+    return ctl
+
+
+# ----------------------------------------------------------- trigger/debounce
+class TestTriggerDebounce:
+    def test_quorum_of_distinct_features(self, request):
+        trainer = _scripted_trainer([])
+        ctl, clock, fleet, _ = _controller(trainer, quorum=2)
+        _track(request, ctl)
+        _alert("x1")
+        assert ctl.tick(0.0) == "idle"
+        _alert("x1")  # same feature — still one distinct alerter
+        assert ctl.tick(0.0) == "idle"
+        _alert("x2")
+        assert ctl.tick(0.0) == "collecting"
+        assert ctl.stats.snapshot()["retrainsTriggered"] == 1
+        assert ctl.stats.snapshot()["alertsSeen"] == 3
+
+    def test_alert_window_prunes_stale_alerts(self, request):
+        ctl, clock, _, _ = _controller(
+            _scripted_trainer([]), quorum=2, quorum_window=30.0
+        )
+        _track(request, ctl)
+        _alert("x1")
+        clock.now = 100.0
+        _alert("x2")  # x1's alert is now 100 s old — outside the window
+        assert ctl.tick(100.0) == "idle"
+        _alert("x1")
+        assert ctl.tick(100.0) == "collecting"
+
+    def test_cooldown_blocks_refire_backoff_delays(self, request):
+        trainer = _scripted_trainer([
+            TransientError("boom"), ("v2", Fn(), _run_doc()),
+        ])
+        ctl, clock, fleet, _ = _controller(trainer, cooldown=50.0)
+        _track(request, ctl)
+        _alert("x1")
+        assert ctl.tick(1.0) == "collecting"
+        _collect(fleet, ctl, clock)  # window full -> retraining
+        ctl.tick(1.0)  # trainer fails -> backoff, idle
+        assert ctl.state == "idle"
+        assert ctl.stats.snapshot()["retrainFailures"] == 1
+        led = ctl.ledger()
+        assert led["backoffUntil"] > 1.0
+        _alert("x1")
+        assert ctl.tick(2.0) == "idle"  # x1 still in cooldown
+        clock.now = 60.0
+        _alert("x1")  # cooldown (51) AND backoff (11) both expired
+        assert ctl.tick(60.0) == "collecting"
+        assert ctl.stats.snapshot()["retrainsTriggered"] == 2
+
+    def test_trigger_event_emitted(self, request):
+        tevents.reset_for_tests()
+        ctl, clock, _, _ = _controller(_scripted_trainer([]))
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        kinds = [e["kind"] for e in tevents.recent(10)]
+        assert "retrain_triggered" in kinds
+
+
+# ------------------------------------------------------------ collect + chunk
+class TestCollection:
+    def test_window_seals_chunks_and_fit_stats(self, request):
+        trainer = _scripted_trainer([("v2", Fn(), _run_doc())])
+        ctl, clock, fleet, _ = _controller(
+            trainer, collect_rows=8, chunk_rows=4
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock, n=8)
+        ctl.tick(0.0)  # retraining runs
+        assert trainer.calls and trainer.calls[0]["chunks"] == 2
+        assert trainer.calls[0]["rows"] == 8
+        stats = trainer.calls[0]["fitStats"]
+        assert "x1" in stats and stats["x1"].total_count == 8
+        assert ctl.stats.snapshot()["chunksCollected"] == 2
+
+    def test_corrupt_chunk_quarantined_never_trained(
+        self, request, fault_plan
+    ):
+        fault_plan.corrupt_new_chunk(times=1)
+        trainer = _scripted_trainer([("v2", Fn(), _run_doc())])
+        ctl, clock, fleet, _ = _controller(
+            trainer, collect_rows=8, chunk_rows=4
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        # 12 rows: the first sealed chunk (rows 0-3) is torn and must
+        # not count toward the window — clean rows refill it
+        _collect(fleet, ctl, clock, n=12)
+        ctl.tick(0.0)
+        s = ctl.stats.snapshot()
+        assert s["chunksCorrupted"] == 1
+        assert ("retrain_chunk", "chunk-1") in fault_plan.fired
+        assert trainer.calls[0]["chunks"] == 2  # torn chunk excluded
+        trained_rows = trainer.calls[0]["rows"]
+        assert trained_rows == 8
+
+    def test_chunk_fit_stats_monoid_merge(self):
+        chunks = [
+            [{"x1": 1.0, "city": "a"}, {"x1": 2.0}],
+            [{"x1": 3.0, "x2": 7.0}],
+        ]
+        stats = chunk_fit_stats(chunks, max_bins=8)
+        assert stats["x1"].total_count == 3
+        assert stats["x2"].total_count == 1
+        assert "city" not in stats  # non-numeric fields skipped
+
+
+# --------------------------------------------------------- retrain + resume
+class TestRetrainResume:
+    def test_crash_leaves_machine_in_retraining_then_resumes(self, request):
+        trainer = _scripted_trainer([
+            SimulatedCrash("mid-fit kill"),
+            ("v2", Fn(), _run_doc()),
+        ])
+        ctl, clock, fleet, reg = _controller(trainer)
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)  # crash
+        assert ctl.state == "retraining"
+        assert trainer.calls[0]["resume"] is False
+        ctl.tick(1.0)  # resume attempt
+        assert trainer.calls[1]["resume"] is True
+        s = ctl.stats.snapshot()
+        assert s["retrainCrashes"] == 1 and s["retrainResumes"] == 1
+        # crash is NOT a failed attempt: no backoff, loop continued
+        assert s["retrainFailures"] == 0
+        assert ctl.state == "validating"
+
+    def test_trainer_error_backs_off_to_idle(self, request):
+        tevents.reset_for_tests()
+        trainer = _scripted_trainer([TransientError("io")])
+        ctl, clock, fleet, reg = _controller(trainer)
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)
+        assert ctl.state == "idle"
+        assert ctl.stats.snapshot()["retrainFailures"] == 1
+        assert ctl.history[-1]["outcome"] == "failed"
+        assert ctl.ledger()["backoffUntil"] > 0.0
+        kinds = [e["kind"] for e in tevents.recent(20)]
+        assert "retrain_rolled_back" in kinds
+        # the failed attempt never touched the registry
+        assert reg.calls == []
+
+    def test_warm_start_workflow_resumes_from_layer_checkpoints(
+        self, request, fault_plan, tmp_path
+    ):
+        """The real thing: ``crash_retrain`` kills the warm-start
+        ``Workflow.train`` after layer 0; the next tick rebuilds the
+        same graph and ``resume=True`` restores the layer-checkpoint
+        prefix — retrain-scoped faults never touch non-retrain fits."""
+        rng = np.random.default_rng(5)
+        n = 48
+
+        def build(chunks, ctx):
+            rows = [r for c in chunks for r in c]
+            x1 = np.array([float(r["x1"]) for r in rows])
+            x2 = np.array([float(r["x2"]) for r in rows])
+            label = (x1 + 0.5 * x2 > 0).astype(float)
+            uid_util.reset()
+            ds = Dataset.of({
+                "label": column_from_values(T.RealNN, label),
+                "x1": column_from_values(T.Real, x1),
+                "x2": column_from_values(T.Real, x2),
+            })
+            resp, preds = from_dataset(ds, response="label")
+            vec = transmogrify(list(preds))
+            selector = BinaryClassificationModelSelector(
+                seed=7,
+                models=[(LogisticRegression(), {"reg_param": [0.01]})],
+                num_folds=2,
+            )
+            pred = selector.set_input(resp, vec).get_output()
+            return (
+                Workflow().set_result_features(pred).set_input_dataset(ds)
+            )
+
+        trainer = warm_start_workflow_trainer(
+            build, checkpoint_dir=str(tmp_path / "ckpt")
+        )
+        fault_plan.crash_retrain(after_layer=0, times=1)
+        ctl, clock, fleet, reg = _controller(
+            trainer, collect_rows=n, chunk_rows=16
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        rows = [
+            {"x1": float(a), "x2": float(b)}
+            for a, b in zip(rng.normal(size=n), rng.normal(size=n))
+        ]
+        _collect(fleet, ctl, clock, rows=rows)
+        ctl.tick(0.0)  # crashes after layer 0, stays in retraining
+        assert ctl.state == "retraining"
+        assert ("retrain_crash", "layer-0") in fault_plan.fired
+        ctl.tick(1.0)  # rebuild + resume from the checkpointed prefix
+        assert ctl.state == "validating"
+        s = ctl.stats.snapshot()
+        assert s["retrainCrashes"] == 1 and s["retrainResumes"] == 1
+        ctl.tick(2.0)  # no baseline -> gate passes -> canary
+        ctl.tick(3.0)
+        assert reg.serving == "retrain-001"
+        assert ctl.history[-1]["outcome"] == "promoted"
+        assert ctl.ledger()["deviceMemoryHighWater"] >= 0
+
+
+# ------------------------------------------------------------------ the gate
+class TestRunLedgerGate:
+    def test_worse_model_gated_before_canary(self, request):
+        tevents.reset_for_tests()
+        trainer = _scripted_trainer([("v2", Fn(), _run_doc(auroc=0.5))])
+        ctl, clock, fleet, reg = _controller(
+            trainer, baseline=_run_doc(auroc=0.9)
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)  # retrain ok -> validating
+        ctl.tick(0.0)  # the gate refuses
+        assert ctl.state == "idle"
+        s = ctl.stats.snapshot()
+        assert s["retrainsGated"] == 1
+        assert ctl.history[-1]["outcome"] == "gated"
+        assert "TPR004" in ctl.history[-1]["codes"]
+        # the canary NEVER started: a provably-worse model saw no traffic
+        assert all(c[0] != "start_canary" for c in reg.calls)
+        evts = [e for e in tevents.recent(20)
+                if e["kind"] == "retrain_gated"]
+        assert evts and evts[-1]["codes"] == ["TPR004"]
+        assert ctl.ledger()["backoffUntil"] > 0.0
+
+    def test_clean_diff_reaches_canary_and_repins_baseline(self, request):
+        good = _run_doc(auroc=0.92)
+        trainer = _scripted_trainer([("v2", Fn(), good)])
+        ctl, clock, fleet, reg = _controller(
+            trainer, baseline=_run_doc(auroc=0.9)
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)
+        ctl.tick(0.0)  # validating -> canarying
+        assert ctl.state == "canarying"
+        ctl.tick(0.0)  # evaluate -> promote
+        assert ctl.state == "idle"
+        assert ctl.stats.snapshot()["retrainsPromoted"] == 1
+        assert reg.serving == "v2"
+        assert ctl.baseline_run is good  # the gate baseline re-pinned
+
+
+# ------------------------------------------------------------------- canary
+class TestCanary:
+    def test_rollback_counts_and_backs_off(self, request):
+        tevents.reset_for_tests()
+        trainer = _scripted_trainer([("v2", Fn(), _run_doc())])
+        reg = FakeRegistry(decision="rollback")
+        ctl, clock, fleet, _ = _controller(trainer, registry=reg)
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)
+        ctl.tick(0.0)
+        ctl.tick(0.0)
+        assert ctl.state == "idle"
+        s = ctl.stats.snapshot()
+        assert s["retrainsRolledBack"] == 1 and s["retrainsPromoted"] == 0
+        assert ctl.history[-1]["outcome"] == "rolled_back"
+        assert ctl.ledger()["backoffUntil"] > 0.0
+        kinds = [e["kind"] for e in tevents.recent(20)]
+        assert "retrain_rolled_back" in kinds
+
+    def test_canary_waits_for_min_served(self, request):
+        trainer = _scripted_trainer([("v2", Fn(), _run_doc())])
+        reg = FakeRegistry(compared=0)
+        ctl, clock, fleet, _ = _controller(
+            trainer, registry=reg, min_canary_served=5, canary_timeout=60.0
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)
+        ctl.tick(0.0)
+        assert ctl.state == "canarying"
+        ctl.tick(1.0)  # not enough evidence, not timed out -> wait
+        assert ctl.state == "canarying"
+        assert all(c[0] != "evaluate_canary" for c in reg.calls)
+        reg.compared = 5
+        ctl.tick(2.0)
+        assert ctl.state == "idle"
+        assert ctl.stats.snapshot()["retrainsPromoted"] == 1
+
+    def test_canary_timeout_never_promotes_on_silence(self, request):
+        trainer = _scripted_trainer([("v2", Fn(), _run_doc())])
+        reg = FakeRegistry(compared=0)
+        ctl, clock, fleet, _ = _controller(
+            trainer, registry=reg, min_canary_served=5, canary_timeout=10.0
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)
+        ctl.tick(0.0)
+        ctl.tick(50.0)  # starved past the timeout
+        assert ctl.state == "idle"
+        assert ("rollback", ("canary_timeout",)) in reg.calls
+        assert ctl.stats.snapshot()["retrainsRolledBack"] == 1
+        assert ctl.history[-1]["codes"] == ["canary_timeout"]
+
+    def test_kill_replica_mid_canary_does_not_wedge_evaluation(
+        self, fault_plan
+    ):
+        """Satellite: a seeded ``kill_replica`` takes the canary replica
+        down mid-evaluation — orphans are adopted, the fleet ledger still
+        reconciles, and ``evaluate_canary()`` completes with a decision
+        instead of wedging."""
+        clock = FakeClock()
+        fc = FleetConfig(
+            replicas=2,
+            service=ServiceConfig(workers=0, max_queue_rows=64),
+        )
+        fleet = FleetService(Fn(), config=fc, clock=clock).start()
+        try:
+            reg = ModelRegistry(fleet).register("v2", Fn(offset=0.0))
+            reg.start_canary("v2", replicas=(0,))
+            handles = []
+            for i in range(6):
+                handles.append(fleet.submit({"x1": 0.0}, pin=i % 2))
+                fleet.pump_until_quiet()
+            assert reg.canary_report()["compared"] >= 3
+            fault_plan.kill_replica(0, at=2.0)
+            h = fleet.submit({"x1": 1.0}, pin=0)  # in flight on the canary
+            handles.append(h)
+            clock.now = 2.5
+            fleet.tick()  # the scripted kill fires mid-evaluation
+            assert 0 in fleet.lost
+            fleet.pump_until_quiet()
+            decision = reg.evaluate_canary()  # must not wedge or raise
+            assert decision["decision"] in ("promote", "rollback")
+            assert all(h.outcome is not None for h in handles)  # zero drops
+            assert fleet.reconcile()["reconciled"]
+        finally:
+            fleet.stop()
+
+    def test_external_rollback_is_recorded_not_fatal(self, request):
+        trainer = _scripted_trainer([("v2", Fn(), _run_doc())])
+        reg = FakeRegistry()
+        ctl, clock, fleet, _ = _controller(trainer, registry=reg)
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)
+        ctl.tick(0.0)
+        assert ctl.state == "canarying"
+        reg._canary = None  # an operator rolled the canary back under us
+        ctl.tick(1.0)
+        assert ctl.state == "idle"
+        assert ctl.history[-1]["codes"] == ["canary_vanished"]
+
+
+# --------------------------------------------------------- bounding the loop
+class TestBoundedLoop:
+    def test_max_retrains_suppresses_further_triggers(self, request):
+        trainer = _scripted_trainer([
+            TransientError("a"), TransientError("b"),
+        ])
+        ctl, clock, fleet, _ = _controller(
+            trainer, max_retrains=2, cooldown=0.0,
+            backoff=RetryPolicy(max_attempts=2, base_delay=1.0,
+                                max_delay=2.0, jitter=0.0),
+        )
+        _track(request, ctl)
+        for round_at in (0.0, 100.0, 200.0, 300.0):
+            clock.now = round_at
+            _alert("x1")
+            ctl.tick(round_at)
+            _collect(fleet, ctl, clock)
+            ctl.tick(round_at)
+            assert ctl.state == "idle"
+        s = ctl.stats.snapshot()
+        # an infinite alert storm produced EXACTLY max_retrains attempts
+        assert s["retrainsTriggered"] == 2
+        assert s["triggersSuppressed"] >= 1
+        assert len(trainer.calls) == 2
+
+    def test_backoff_schedule_escalates(self, request):
+        trainer = _scripted_trainer([
+            TransientError("1"), TransientError("2"), TransientError("3"),
+        ])
+        ctl, clock, fleet, _ = _controller(
+            trainer, max_retrains=10, cooldown=0.0,
+            backoff=RetryPolicy(max_attempts=6, base_delay=10.0,
+                                max_delay=100.0, jitter=0.0),
+        )
+        _track(request, ctl)
+        waits = []
+        t = 0.0
+        for _ in range(3):
+            clock.now = t
+            _alert("x1")
+            ctl.tick(t)
+            _collect(fleet, ctl, clock)
+            ctl.tick(t)
+            waits.append(ctl.ledger()["backoffUntil"] - t)
+            t = ctl.ledger()["backoffUntil"] + 1.0
+        # exponential: each failed attempt waits longer than the last
+        assert waits[0] < waits[1] < waits[2]
+
+    def test_backoff_gates_retrigger_until_expiry(self, request):
+        trainer = _scripted_trainer([
+            TransientError("x"), ("v2", Fn(), _run_doc()),
+        ])
+        ctl, clock, fleet, _ = _controller(trainer, cooldown=0.0)
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        _collect(fleet, ctl, clock)
+        ctl.tick(0.0)  # fails; backoff = 10s (base_delay... attempt 1)
+        until = ctl.ledger()["backoffUntil"]
+        assert until > 0.0
+        clock.now = until - 1.0
+        _alert("x1")
+        assert ctl.tick(clock.now) == "idle"  # quorum formed, backing off
+        clock.now = until + 1.0
+        assert ctl.tick(clock.now) == "collecting"
+
+
+# ----------------------------------------------------- drift_cleared pairing
+class TestDriftClearedHysteresis:
+    @pytest.fixture(scope="class")
+    def trained(self):
+        uid_util.reset()
+        rng = np.random.default_rng(3)
+        n = 160
+        x1 = rng.normal(size=n)
+        x2 = rng.normal(size=n)
+        label = (x1 + 0.5 * x2 + 0.3 * rng.normal(size=n) > 0).astype(float)
+        ds = Dataset.of({
+            "label": column_from_values(T.RealNN, label),
+            "x1": column_from_values(T.Real, x1),
+            "x2": column_from_values(T.Real, x2),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        selector = BinaryClassificationModelSelector(
+            seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+            num_folds=2,
+        )
+        pred = selector.set_input(resp, vec).get_output()
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds)
+            .train()
+        )
+        return ds, model
+
+    def test_cleared_emitted_once_on_recovery(self, trained):
+        from transmogrifai_tpu.local.scoring import score_function
+        from transmogrifai_tpu.resilience import DriftConfig
+
+        ds, model = trained
+        tevents.reset_for_tests()
+        cfg = DriftConfig(window=40, chunks=4, min_rows=20,
+                          js_threshold=0.35)
+        fn = score_function(model, drift=cfg)
+        plan = faults.FaultPlan().shift_feature("x1", offset=25.0, times=40)
+        with faults.installed(plan):
+            for r in ds.rows()[:40]:
+                fn(r)
+        fn.drift.report()  # the sweep emits the alert
+        alerts = [e for e in tevents.recent(50)
+                  if e["kind"] == "drift_alert"]
+        assert [e["feature"] for e in alerts] == ["x1"]
+        # the stream recovers: shifted chunks age out of the window
+        for r in ds.rows()[40:120]:
+            fn(r)
+        fn.drift.report()
+        cleared = [e for e in tevents.recent(50)
+                   if e["kind"] == "drift_cleared"]
+        assert [e["feature"] for e in cleared] == ["x1"]
+        # hysteresis: further healthy reports do NOT re-emit cleared
+        for r in ds.rows()[120:160]:
+            fn(r)
+        fn.drift.report()
+        fn.drift.report()
+        cleared = [e for e in tevents.recent(80)
+                   if e["kind"] == "drift_cleared"]
+        assert len(cleared) == 1
+
+    def test_controller_tracks_drifting_set(self, trained, request):
+        ctl, clock, _, _ = _controller(_scripted_trainer([]), quorum=99)
+        _track(request, ctl)
+        tevents.emit("drift_alert", feature="f1")
+        assert "f1" in ctl._drifting
+        tevents.emit("drift_cleared", feature="f1")
+        assert "f1" not in ctl._drifting
+        assert ctl.stats.snapshot()["driftCleared"] == 1
+
+    def test_metadata_carries_retrain_ledger(self, trained, request):
+        from transmogrifai_tpu.local.scoring import score_function
+
+        ds, model = trained
+        ctl, clock, _, _ = _controller(_scripted_trainer([]))
+        _track(request, ctl)
+        fn = score_function(model)
+        led = fn.metadata()["retrainLedger"]
+        assert led is not None and led["state"] == "idle"
+        assert model.summary_json()["retrainLedger"]["state"] == "idle"
+
+
+# ------------------------------------------------------- events subscriber
+class TestEventsSubscriberSeam:
+    def test_subscribe_receives_after_lock_release(self):
+        got = []
+        tevents.subscribe(got.append)
+        try:
+            tevents.emit("drift_alert", feature="zz")
+            assert got and got[-1]["kind"] == "drift_alert"
+            assert got[-1]["feature"] == "zz"
+        finally:
+            tevents.unsubscribe(got.append)
+
+    def test_broken_subscriber_never_breaks_emit(self):
+        def boom(rec):
+            raise RuntimeError("subscriber bug")
+
+        got = []
+        tevents.subscribe(boom)
+        tevents.subscribe(got.append)
+        try:
+            tevents.emit("drift_alert", feature="ok")
+            assert got  # the healthy subscriber still ran
+        finally:
+            tevents.unsubscribe(boom)
+            tevents.unsubscribe(got.append)
+
+    def test_unsubscribe_stops_delivery(self):
+        got = []
+        tevents.subscribe(got.append)
+        tevents.unsubscribe(got.append)
+        tevents.emit("drift_alert", feature="gone")
+        assert got == []
+
+
+# ------------------------------------------------------------ ledger surface
+class TestLedgerExposure:
+    def test_retrain_source_registered_with_full_catalogue(self):
+        snaps = tmetrics.REGISTRY.source_snapshots()
+        assert "retrain" in snaps
+        led = ledger_snapshot()
+        for key in ("retrainsTriggered", "retrainsPromoted",
+                    "retrainsRolledBack", "retrainCrashes",
+                    "triggersSuppressed", "state", "backoffUntil",
+                    "deviceMemoryHighWater"):
+            assert key in led
+
+    def test_prometheus_renders_retrain_gauges(self, request):
+        from transmogrifai_tpu.telemetry import render_prometheus
+
+        ctl, clock, fleet, _ = _controller(
+            _scripted_trainer([("v", Fn(), _run_doc())])
+        )
+        _track(request, ctl)
+        _alert("x1")
+        ctl.tick(0.0)
+        text = render_prometheus()
+        import re
+
+        m = re.search(
+            r"^tptpu_retrain_retrains_triggered (\S+)$", text, re.M
+        )
+        assert m and float(m.group(1)) == 1.0
+        m = re.search(
+            r"^tptpu_retrain_retrains_promoted (\S+)$", text, re.M
+        )
+        assert m and float(m.group(1)) == 0.0
+
+    def test_stream_chunk_retry_counters_in_resilience_source(
+        self, tmp_path
+    ):
+        """Satellite: streaming chunk-fetch retries surface in the
+        ``resilience`` ledger source (and therefore the Prometheus
+        exposition)."""
+        from transmogrifai_tpu.readers import FileStreamingReader
+        from transmogrifai_tpu.readers.streaming import CHUNK_STATS
+        from transmogrifai_tpu.resilience.distributed import (
+            _resilience_source,
+        )
+
+        CHUNK_STATS.reset_for_tests()
+        p = tmp_path / "batch1.csv"
+        with open(p, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["a", "b"])
+            w.writerow([1, 2])
+        old = time.time() - 10
+        os.utime(p, (old, old))
+        reader = FileStreamingReader(
+            str(tmp_path), pattern="*.csv", poll=False
+        )
+        sleeps = []
+        reader.retry_policy = RetryPolicy(
+            max_attempts=3, base_delay=0.0, jitter=0.0,
+            sleep=sleeps.append,
+        )
+        plan = faults.FaultPlan().fail_chunk_read(times=1)
+        with faults.installed(plan):
+            batches = list(reader._batches_iter())
+        assert len(batches) == 1
+        src = _resilience_source()
+        assert src["streamChunkFetches"] == 1
+        assert src["streamChunkRetries"] == 1
+        assert src["streamChunkAttempts"] == 2
+        assert src["streamChunkExhausted"] == 0
+        CHUNK_STATS.reset_for_tests()
+
+    def test_exhausted_fetch_counted(self, tmp_path):
+        from transmogrifai_tpu.readers import FileStreamingReader
+        from transmogrifai_tpu.readers.streaming import CHUNK_STATS
+
+        CHUNK_STATS.reset_for_tests()
+        p = tmp_path / "batch1.csv"
+        with open(p, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["a"])
+            w.writerow([1])
+        old = time.time() - 10
+        os.utime(p, (old, old))
+        reader = FileStreamingReader(
+            str(tmp_path), pattern="*.csv", poll=False
+        )
+        reader.retry_policy = RetryPolicy(
+            max_attempts=2, base_delay=0.0, jitter=0.0,
+            sleep=lambda s: None,
+        )
+        plan = faults.FaultPlan().fail_chunk_read(times=5)
+        with faults.installed(plan):
+            batches = list(reader._batches_iter())
+        # the reader defers then drops the unreadable file (no raise) —
+        # but the exhausted retry budgets landed in the ledger: once for
+        # the first pass, once for the final settle retry
+        assert batches == []
+        snap = CHUNK_STATS.snapshot()
+        assert snap["streamChunkExhausted"] == 2
+        assert snap["streamChunkFetches"] == 0
+        CHUNK_STATS.reset_for_tests()
+
+
+# ------------------------------------------------------- integration (fleet)
+class TestFleetIntegration:
+    def test_on_served_chains_registry_mirror_hook(self, request):
+        """The controller wraps the registry's on_served hook instead of
+        replacing it: canary mirror comparisons still happen while the
+        controller buffers."""
+        clock = FakeClock()
+        fc = FleetConfig(
+            replicas=2,
+            service=ServiceConfig(workers=0, max_queue_rows=64),
+        )
+        fleet = FleetService(Fn(), config=fc, clock=clock).start()
+        try:
+            reg = ModelRegistry(fleet).register("v2", Fn(offset=0.0))
+            trainer = _scripted_trainer([])
+            ctl = RetrainController(
+                fleet, reg, trainer,
+                config=RetrainConfig(collect_rows=4, chunk_rows=2),
+                clock=clock,
+            )
+            _track(request, ctl)
+            reg.start_canary("v2", replicas=(0,))
+            # force collecting so BOTH hooks have work on the same rows
+            with ctl._lock:
+                ctl.state = "collecting"
+            for i in range(4):
+                fleet.submit({"x1": float(i)}, pin=i % 2)
+                fleet.pump_until_quiet()
+            assert reg.canary_report()["compared"] >= 1  # mirror ran
+            assert ctl.ledger()["rowsCollected"] == 4  # buffer ran
+            reg.evaluate_canary()
+        finally:
+            fleet.stop()
+
+    def test_close_detaches_hook_and_subscription(self):
+        fleet = FakeFleet()
+        reg = FakeRegistry()
+        ctl = RetrainController(fleet, reg, _scripted_trainer([]))
+        # bound-method EQUALITY (identity differs per attribute access)
+        assert fleet.on_served == ctl._on_served
+        ctl.close()
+        assert fleet.on_served is None
+        before = ctl.stats.snapshot()["alertsSeen"]
+        _alert("x1")
+        assert ctl.stats.snapshot()["alertsSeen"] == before
+        ctl.close()  # idempotent

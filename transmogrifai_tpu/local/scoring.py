@@ -120,6 +120,18 @@ def _serving_source() -> dict[str, Any]:
 _tm.REGISTRY.register_source("serving", _serving_source)
 
 
+def _retrain_ledger() -> dict[str, Any] | None:
+    """The continuous-retraining ledger (resilience/retrain.py) — None
+    when the module is unavailable; monitoring must never break
+    scoring."""
+    try:
+        from ..resilience.retrain import ledger_snapshot
+
+        return ledger_snapshot()
+    except Exception:
+        return None
+
+
 def _all_null(col) -> bool:
     """True when every row of the column is missing (validity mask all
     False, or every object value None for mask-less column types)."""
@@ -1483,6 +1495,7 @@ def score_function(
                 "drift": attribution_drift_report,
             },
             "distributed": getattr(model, "dist_summary", None),
+            "retrainLedger": _retrain_ledger(),
             "telemetry": serving_snapshot(),
         }
 

@@ -15,9 +15,52 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 from ..dataset import Dataset
 from ..features.feature import Feature
 from ..resilience.retry import TransientError
+from ..telemetry import metrics as _tmetrics
 from .core import SimpleReader
 
 log = logging.getLogger(__name__)
+
+
+class _ChunkFetchStats(_tmetrics.LedgerCore):
+    """Process-wide chunk-fetch ledger: every ``_fetch_chunk`` attempt
+    count lands here (the RetryPolicy returns how many attempts one fetch
+    took, but until now that number only reached a log line). Snapshotted
+    into the ``resilience`` ledger source (resilience/distributed.py), so
+    the counters reach ``score_fn.metadata()`` and the Prometheus
+    exposition like every other resilience counter."""
+
+    KEYS = (
+        "streamChunkFetches",     # successful fetches (post-retry)
+        "streamChunkRetries",     # fetches that needed more than 1 attempt
+        "streamChunkAttempts",    # total attempts across all fetches
+        "streamChunkExhausted",   # fetches whose retry budget ran out
+    )
+
+    def __init__(self) -> None:
+        super().__init__(self.KEYS)
+
+    def record_fetch(self, attempts: int) -> None:
+        with self._lock:
+            self._counts["streamChunkFetches"] += 1
+            self._counts["streamChunkAttempts"] += int(attempts)
+            if attempts > 1:
+                self._counts["streamChunkRetries"] += 1
+
+    def record_exhausted(self, attempts: int = 0) -> None:
+        with self._lock:
+            self._counts["streamChunkExhausted"] += 1
+            self._counts["streamChunkAttempts"] += int(attempts)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._reset_counts()
+
+
+CHUNK_STATS = _ChunkFetchStats()
 
 
 class StreamingReader:
@@ -107,7 +150,15 @@ class FileStreamingReader(StreamingReader):
             return self._read_file(path)
 
         policy = self.retry_policy or default_io_policy()
-        records, attempts = policy.call(fetch)
+        try:
+            records, attempts = policy.call(fetch)
+        except Exception as e:
+            # the policy attaches the burned attempt count to the final
+            # exception — land it in the ledger before re-raising so an
+            # exhausted retry budget is visible, not just a log line
+            CHUNK_STATS.record_exhausted(getattr(e, "_retry_attempts", 1))
+            raise
+        CHUNK_STATS.record_fetch(attempts)
         if attempts > 1:
             log.warning(
                 "stream chunk %s fetched after %d attempts", path, attempts

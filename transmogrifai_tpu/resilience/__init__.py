@@ -22,7 +22,11 @@ Six pieces, wired through the workflow stack:
   ``HostSentinel`` heartbeats + p99-adaptive straggler deadlines,
   ``CollectiveGuard`` timeout/retry around the sharded reductions, and
   the ``FailoverController`` driving elastic degraded-mesh failover with
-  checkpoint resume in ``Workflow.train``.
+  checkpoint resume in ``Workflow.train``;
+* :mod:`.retrain` — ``RetrainController``: the continuous-retraining
+  control loop (drift-alert quorum → chunked collection → warm-start
+  resume-capable retrain → run-ledger gate → registry canary), driven
+  entirely by ``tick()`` on injectable clocks.
 """
 from .checkpoint import (  # noqa: F401
     CheckpointError,
@@ -44,6 +48,11 @@ from .distributed import (  # noqa: F401
 )
 from .faults import FaultPlan, SimulatedCrash, installed  # noqa: F401
 from .guards import ScoreGuard, ScoreGuardError  # noqa: F401
+from .retrain import (  # noqa: F401
+    RetrainConfig,
+    RetrainController,
+    warm_start_workflow_trainer,
+)
 from .retry import (  # noqa: F401
     FatalError,
     RetryPolicy,

@@ -563,19 +563,36 @@ _LAST_BOUND: Callable[[], "FailoverController | None"] | None = None
 _ZERO_LEDGER = {
     "hostsLost": 0, "failovers": 0, "reshardEvents": 0, "hosts": 0,
     "heartbeatsDropped": 0, "stragglersDetected": 0, "collectivesRetried": 0,
+    "streamChunkFetches": 0, "streamChunkRetries": 0,
+    "streamChunkAttempts": 0, "streamChunkExhausted": 0,
 }
+
+
+def _stream_chunk_counters() -> dict[str, int]:
+    """The readers/streaming.py chunk-fetch retry ledger — imported lazily
+    (readers imports resilience for its retry types; eager import here
+    would be a cycle)."""
+    try:
+        from ..readers.streaming import CHUNK_STATS
+
+        return CHUNK_STATS.snapshot()
+    except Exception:
+        return {}
 
 
 def _resilience_source() -> dict[str, Any]:
     """The distributed-resilience ledger as a telemetry source: the
     installed controller's merged counters (or the most recently bound
-    one's — a finished train keeps reporting until the next bind)."""
+    one's — a finished train keeps reporting until the next bind), plus
+    the streaming chunk-fetch retry counters (previously the attempt
+    counts burned inside readers/streaming.py never reached metadata()
+    or the Prometheus exposition)."""
     c = _CONTROLLER
     if c is None and _LAST_BOUND is not None:
         c = _LAST_BOUND()
-    if c is None:
-        return dict(_ZERO_LEDGER)
-    return {**_ZERO_LEDGER, **c.summary()}
+    base = dict(_ZERO_LEDGER) if c is None else {**_ZERO_LEDGER, **c.summary()}
+    base.update(_stream_chunk_counters())
+    return base
 
 
 _tm.REGISTRY.register_source("resilience", _resilience_source)

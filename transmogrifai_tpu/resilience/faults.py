@@ -104,6 +104,13 @@ class FaultPlan:
         self._shard_faults: list[dict[str, Any]] = []
         self._replica_kill_faults: list[dict[str, Any]] = []
         self._replica_partitions: list[dict[str, Any]] = []
+        self._retrain_fail_faults: list[dict[str, Any]] = []
+        self._retrain_crash_faults: list[dict[str, Any]] = []
+        self._retrain_chunk_faults: list[dict[str, Any]] = []
+        # >0 while a RetrainController drives a warm-start fit: retrain-
+        # scoped layer faults only fire inside this window, so a plan can
+        # script "the RETRAIN crashes" without touching the initial train
+        self._retrain_depth = 0
         #: chronological record of fired faults: (kind, detail)
         self.fired: list[tuple[str, str]] = []
 
@@ -281,13 +288,17 @@ class FaultPlan:
         return self
 
     def shift_feature(
-        self, feature: str, offset: float, times: int | None = None
+        self, feature: str, offset: float, times: int | None = None,
+        ramp: float = 0.0,
     ) -> "FaultPlan":
         """Shift every observed value of ``feature`` at the drift sentinel's
-        intake — a deterministic drifted stream without regenerating data."""
+        intake — a deterministic drifted stream without regenerating data.
+        ``ramp`` adds ``ramp * (firings so far)`` on top of ``offset``, so a
+        stream can KEEP drifting (e.g. while a retrain is in flight) instead
+        of jumping once to a new plateau."""
         self._drift_faults.append(
             {"feature": feature, "offset": float(offset), "times": times,
-             "count": 0}
+             "ramp": float(ramp), "count": 0}
         )
         return self
 
@@ -298,6 +309,48 @@ class FaultPlan:
         exercises the chunk-level RetryPolicy."""
         self._chunk_faults.append(
             {"times": times, "count": 0, "transient": transient}
+        )
+        return self
+
+    # --------------------------------------------------- retrain faults
+    def fail_retrain(
+        self,
+        after_layer: int | None = None,
+        times: int = 1,
+        transient: bool = True,
+    ) -> "FaultPlan":
+        """Fail a RetrainController warm-start fit: at retrain START
+        (``after_layer=None``) or after DAG layer ``after_layer`` finished.
+        Only fires inside a retrain scope — the initial train is untouched.
+        The controller treats any such failure as a failed attempt
+        (rolled_back + backoff), NOT a resumable crash."""
+        self._retrain_fail_faults.append(
+            {"layer": after_layer, "times": times, "count": 0,
+             "transient": transient}
+        )
+        return self
+
+    def crash_retrain(
+        self, after_layer: int = 0, times: int = 1
+    ) -> "FaultPlan":
+        """Raise ``SimulatedCrash`` after retrain DAG layer ``after_layer``
+        finished (and its layer checkpoint was persisted) — the mid-retrain
+        kill. The controller stays in ``retraining`` and the next tick
+        resumes the fit from its own layer checkpoints."""
+        self._retrain_crash_faults.append(
+            {"layer": after_layer, "times": times, "count": 0}
+        )
+        return self
+
+    def corrupt_new_chunk(
+        self, times: int = 1, nth: int | None = None
+    ) -> "FaultPlan":
+        """Corrupt a freshly-collected retrain data chunk at seal time
+        (``nth`` selects by the 1-based global chunk counter). The
+        controller must quarantine the chunk — drop it from the retrain
+        window, count it — rather than train on torn rows."""
+        self._retrain_chunk_faults.append(
+            {"nth": nth, "times": times, "count": 0}
         )
         return self
 
@@ -401,6 +454,28 @@ class FaultPlan:
                 raise SimulatedCrash(
                     f"injected crash after layer {layer_index}"
                 )
+            if self._retrain_depth > 0:
+                for f in self._retrain_crash_faults:
+                    if f["count"] >= f["times"] or f["layer"] != layer_index:
+                        continue
+                    f["count"] += 1
+                    self.fired.append(
+                        ("retrain_crash", f"layer-{layer_index}")
+                    )
+                    raise SimulatedCrash(
+                        f"injected retrain crash after layer {layer_index}"
+                    )
+                for f in self._retrain_fail_faults:
+                    if f["count"] >= f["times"] or f["layer"] != layer_index:
+                        continue
+                    f["count"] += 1
+                    self.fired.append(
+                        ("retrain_fail", f"layer-{layer_index}")
+                    )
+                    exc = TransientError if f["transient"] else FatalError
+                    raise exc(
+                        f"injected retrain failure after layer {layer_index}"
+                    )
             for f in self._host_faults:
                 if f["count"] >= f["times"] or f["layer"] != layer_index:
                     continue
@@ -641,10 +716,53 @@ class FaultPlan:
                 if f["count"] == 1:
                     self.fired.append(("drift", name))
                 try:
-                    value = float(value) + f["offset"]
+                    # ramp grows per firing: a scripted stream that keeps
+                    # moving instead of stepping once to a new plateau
+                    value = (
+                        float(value) + f["offset"]
+                        + f.get("ramp", 0.0) * (f["count"] - 1)
+                    )
                 except (TypeError, ValueError):
                     pass
         return value
+
+    # ---------------------------------------------- retrain-scoped hooks
+    def begin_retrain(self) -> None:
+        """Enter the retrain scope (RetrainController, around its
+        warm-start fit): retrain-scoped layer faults fire only inside."""
+        with self._lock:
+            self._retrain_depth += 1
+
+    def end_retrain(self) -> None:
+        with self._lock:
+            self._retrain_depth = max(0, self._retrain_depth - 1)
+
+    def on_retrain_start(self) -> None:
+        """Consulted by the RetrainController right before it invokes the
+        warm-start trainer — ``fail_retrain(after_layer=None)`` fires
+        here."""
+        with self._lock:
+            for f in self._retrain_fail_faults:
+                if f["count"] >= f["times"] or f["layer"] is not None:
+                    continue
+                f["count"] += 1
+                self.fired.append(("retrain_fail", "start"))
+                exc = TransientError if f["transient"] else FatalError
+                raise exc("injected retrain failure at start")
+
+    def corrupts_new_chunk(self, chunk_index: int) -> bool:
+        """True when the ``chunk_index``-th (1-based) freshly-collected
+        retrain chunk should arrive torn — the controller quarantines it."""
+        with self._lock:
+            for f in self._retrain_chunk_faults:
+                if f["count"] >= f["times"]:
+                    continue
+                if f["nth"] is not None and f["nth"] != chunk_index:
+                    continue
+                f["count"] += 1
+                self.fired.append(("retrain_chunk", f"chunk-{chunk_index}"))
+                return True
+        return False
 
     def on_stream_chunk(self, path: str) -> None:
         """Streaming-reader chunk fetch hook (readers/streaming.py)."""

@@ -911,7 +911,26 @@ class DriftSentinel:
                     )
             else:
                 with self._report_lock:
+                    recovered = name in self._alerting
                     self._alerting.discard(name)
+                if recovered:
+                    # hysteresis pair of the once-per-episode drift_alert
+                    # above: emitted exactly once when the window returns
+                    # under threshold, so the retrain loop (and operators)
+                    # can tell "still drifting" from "recovered on its own"
+                    _tevents.emit(
+                        "drift_cleared", feature=name,
+                        fillRatio=(
+                            None if math.isinf(fill_ratio) else
+                            round(fill_ratio, 4)
+                        ),
+                        jsDivergence=None if js is None else round(js, 4),
+                    )
+                    log.info(
+                        "drift sentinel: feature '%s' recovered (fillRatio="
+                        "%.3g, js=%s)", name, fill_ratio,
+                        "n/a" if js is None else f"{js:.3f}",
+                    )
         with self._report_lock:
             return {
                 "enabled": self.enabled,

@@ -235,6 +235,7 @@ def run_fleet_loadtest(
     plan: "_faults.FaultPlan | None" = None,
     fleet_config: "FleetConfig | None" = None,
     reconcile_every: int = 1,
+    on_fleet: Callable[[Any], Callable[[float], None] | None] | None = None,
 ) -> dict[str, Any]:
     """Open-loop loadtest over a :class:`~.fleet.FleetService`: the same
     seeded arrival schedule, dispatched through the router onto
@@ -245,7 +246,15 @@ def run_fleet_loadtest(
     hedge checkpoints all fire in virtual time. ``reconcile_every=k``
     checks the fleet-level typed invariant at every k-th arrival
     (``reconciled_every_instant`` in the report); ``dropped`` counts
-    logical requests that finished with NO typed outcome and must be 0."""
+    logical requests that finished with NO typed outcome and must be 0.
+
+    ``on_fleet`` is the control-plane integration seam: called once with
+    the started fleet (build a ModelRegistry, attach a
+    RetrainController, ...); its optional return value is a per-instant
+    callback invoked right after every ``fleet.tick(t)`` — in the
+    arrival loop AND the drain loop — so a supervised control loop (the
+    retrain state machine) advances at every virtual instant the fleet
+    does."""
     from .fleet import FleetConfig, FleetService
 
     if replicas < 1:
@@ -276,6 +285,7 @@ def run_fleet_loadtest(
 
         svc.on_batch_cost = _advance
     fleet.start()
+    tick_hook = on_fleet(fleet) if on_fleet is not None else None
     schedule = LoadSchedule(rate=rate, duration=duration, seed=seed)
     arrivals = schedule.arrivals(plan)
     idx = rng.integers(0, len(rows), size=max(1, len(arrivals)))
@@ -309,6 +319,8 @@ def run_fleet_loadtest(
                 ):
                     c.advance(t - c.now)
             fleet.tick(t)
+            if tick_hook is not None:
+                tick_hook(t)
             pin = plan.burst_replica(t) if plan is not None else None
             try:
                 handles.append(
@@ -332,6 +344,8 @@ def run_fleet_loadtest(
             t = max([gclock.now] + [c.now for c in rclocks])
             gclock.advance(t - gclock.now)
             fleet.tick(t)
+            if tick_hook is not None:
+                tick_hook(t)
             if settled == 0 and all(
                 fleet.services[i].queue.depth_requests() == 0
                 for i in fleet.live_replicas()

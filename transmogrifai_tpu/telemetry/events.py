@@ -11,16 +11,28 @@ Wired event kinds (see docs/observability.md for the catalogue):
 
 * ``failover`` / ``straggler`` — resilience/distributed.py
 * ``breaker_transition`` — resilience/sentinel.py circuit breakers
-* ``drift_alert`` — resilience/sentinel.py drift sentinel
+* ``drift_alert`` / ``drift_cleared`` — resilience/sentinel.py drift
+  sentinel (one ``drift_alert`` per episode; the paired
+  ``drift_cleared`` fires when that feature's window returns under
+  threshold, so "still drifting" and "recovered on its own" are
+  distinguishable downstream)
 * ``checkpoint_save`` — resilience/checkpoint.py layer saves
 * ``warmup_complete`` — compiler/warmup.py background bank loads
 * ``replica_lost`` / ``hedge_fired`` — serving/fleet.py fleet plane
 * ``canary_rollback`` / ``canary_promoted`` — serving/registry.py
+* ``retrain_triggered`` / ``retrain_gated`` / ``retrain_promoted`` /
+  ``retrain_rolled_back`` — resilience/retrain.py continuous-retraining
+  control loop (trigger quorum met; refreshed model refused by the
+  run-ledger gate before canary; canary promoted; canary rolled back)
 
 The log is a bounded in-memory deque (``TPTPU_EVENT_BUFFER``, default
 4096) exportable as JSONL (:func:`to_jsonl` / :func:`write`); set
 ``TPTPU_EVENT_LOG=/path/file.jsonl`` to also append each record to disk
 as it is emitted.
+
+In-process consumers can :func:`subscribe` a callback; subscribers are
+invoked AFTER the log lock is released (an event subscriber may take
+its own leaf lock, but no lock-graph edge ever leaves the events lock).
 """
 from __future__ import annotations
 
@@ -34,11 +46,24 @@ from typing import Any
 from . import spans as _spans
 from .spans import _env_int
 
-__all__ = ["emit", "recent", "count", "to_jsonl", "write", "reset_for_tests"]
+__all__ = [
+    "emit",
+    "recent",
+    "count",
+    "to_jsonl",
+    "write",
+    "subscribe",
+    "unsubscribe",
+    "reset_for_tests",
+]
 
 _LOCK = threading.Lock()
 _BUFFER: deque = deque(maxlen=_env_int("TPTPU_EVENT_BUFFER", 4096))
 _STATE: dict[str, int] = {"seq": 0}
+# registered under _LOCK, SNAPSHOT under _LOCK, but always INVOKED after
+# the lock is released — a subscriber that takes its own lock therefore
+# never creates an edge out of the events lock
+_SUBSCRIBERS: list = []
 
 
 def emit(kind: str, **fields: Any) -> dict[str, Any]:
@@ -70,7 +95,32 @@ def emit(kind: str, **fields: Any) -> dict[str, Any]:
                     f.write(json.dumps(rec, default=str) + "\n")
             except OSError:
                 pass  # a full disk must not take scoring down
+        subs = list(_SUBSCRIBERS)
+    for fn in subs:
+        try:
+            fn(rec)
+        except Exception:
+            pass  # a broken subscriber must not take the emitter down
     return rec
+
+
+def subscribe(fn) -> None:
+    """Register ``fn(record)`` to be called for every emitted event.
+
+    Callbacks run on the emitting thread, after the log lock is
+    released, in registration order; exceptions are swallowed. Keep
+    subscribers cheap — record-and-return, decide later."""
+    with _LOCK:
+        if fn not in _SUBSCRIBERS:
+            _SUBSCRIBERS.append(fn)
+
+
+def unsubscribe(fn) -> None:
+    with _LOCK:
+        try:
+            _SUBSCRIBERS.remove(fn)
+        except ValueError:
+            pass
 
 
 def recent(n: int | None = None) -> list[dict[str, Any]]:
@@ -102,3 +152,4 @@ def reset_for_tests() -> None:
     with _LOCK:
         _BUFFER.clear()
         _STATE["seq"] = 0
+        _SUBSCRIBERS.clear()

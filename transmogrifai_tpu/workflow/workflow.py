@@ -923,6 +923,12 @@ class WorkflowModel:
                 analysis["spmd"] = spmd_summary()
             except Exception:  # pragma: no cover - defensive
                 pass
+        try:
+            from ..resilience.retrain import ledger_snapshot
+
+            retrain_ledger = ledger_snapshot()
+        except Exception:  # pragma: no cover - defensive
+            retrain_ledger = None
         return {
             "trainRows": self.train_rows,
             "holdoutRows": self.holdout_rows,
@@ -934,6 +940,7 @@ class WorkflowModel:
             "modelSelectorSummary": sel_summary,
             "stageMetadata": stage_meta,
             "distributedResilience": self.dist_summary,
+            "retrainLedger": retrain_ledger,
             "analysis": analysis,
             "run": getattr(self, "run_report", None),
         }
