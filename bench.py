@@ -211,6 +211,20 @@ def validate_bench_report(doc) -> list[str]:
                     retrain.get(key), bool
                 ):
                     problems.append(f"retrain missing integer {key!r}")
+    # additive envelope: the out-of-core streaming-fit stamp (r11) is
+    # validated WHEN PRESENT — artifacts predating it stay valid forever
+    fit_stream = doc.get("fitStream") if isinstance(doc, dict) else None
+    if fit_stream is not None:
+        if not isinstance(fit_stream, dict):
+            problems.append("fitStream is not an object")
+        else:
+            for key in ("auprIdentical", "statsBitIdentical", "bounded"):
+                if not isinstance(fit_stream.get(key), bool):
+                    problems.append(f"fitStream missing boolean {key!r}")
+            if not isinstance(
+                fit_stream.get("highWaterRatio"), (int, float)
+            ):
+                problems.append("fitStream missing numeric 'highWaterRatio'")
     return problems
 
 
@@ -1627,6 +1641,176 @@ def bench_serve_retrain(
     )
 
 
+def _fit_stream_records(n: int, rng) -> list[dict]:
+    """Synthetic flagship-flow records (x1/x2/city, noiseless label) —
+    the same shape the retrain bench trains on, generated chunk-by-chunk
+    so the out-of-core demo below never holds the whole dataset."""
+    out = []
+    for _ in range(n):
+        a, b = float(rng.normal()), float(rng.normal())
+        out.append({
+            "x1": a, "x2": b,
+            "city": ("sf", "nyc", "ber")[int(rng.integers(0, 3))],
+            "label": float(a + 0.5 * b > 0),
+        })
+    return out
+
+
+def bench_fit_stream(
+    rows: int = 1600,
+    chunk_rows: int = 160,
+    seed: int = 0,
+    x10: int = 10,
+    out_run_dir: str | None = None,
+) -> dict:
+    """Out-of-core streaming fit A/B (workflow/stream.py):
+
+    1. **Parity** — the flagship synthetic flow trains twice, once
+       materialized (``SimpleReader``) and once streamed
+       (``StreamingReader`` → chunked monoid ingest); holdout AuPR must
+       be IDENTICAL (under the buffer cap the streamed fit consumes the
+       exact same rows) and the streamed fit-time stats bit-identical to
+       a one-shot ``ChunkStatsReducer`` pass.
+    2. **Bounded memory** — the ingest engine runs over generator-backed
+       chunk streams (never materializable as a list) at N and 10×N
+       chunks with a fixed buffer cap; the per-chunk host-RSS high-water
+       must stay flat (ratio ≈ 1) across the 10× scale-up.
+
+    The report lands the ``fitStream`` stamp (validated when present by
+    ``validate_bench_report``) — the BENCH_r11.json regression shape."""
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.readers.core import SimpleReader
+    from transmogrifai_tpu.readers.streaming import StreamingReader
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.telemetry.runlog import RunRecorder
+    from transmogrifai_tpu.utils import uid as uid_util
+    from transmogrifai_tpu.workflow.stream import (
+        ChunkStatsReducer,
+        stream_ingest,
+    )
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    def features():
+        uid_util.reset()
+        x1 = FeatureBuilder.Real("x1").extract(
+            lambda r: r["x1"]).as_predictor()
+        x2 = FeatureBuilder.Real("x2").extract(
+            lambda r: r["x2"]).as_predictor()
+        city = FeatureBuilder.PickList("city").extract(
+            lambda r: r["city"]).as_predictor()
+        lab = FeatureBuilder.RealNN("label").extract(
+            lambda r: r["label"]).as_response()
+        return lab, x1, x2, city
+
+    def build(reader):
+        lab, x1, x2, city = features()
+        vec = transmogrify([x1, x2, city])
+        pred = BinaryClassificationModelSelector(
+            seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+            num_folds=2,
+        ).set_input(lab, vec).get_output()
+        return Workflow().set_result_features(pred).set_reader(reader)
+
+    records = _fit_stream_records(rows, np.random.default_rng(seed))
+    chunks = [
+        records[i:i + chunk_rows] for i in range(0, rows, chunk_rows)
+    ]
+
+    t0 = time.perf_counter()
+    m_mat = build(SimpleReader(records)).train(run_dir="")
+    mat_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m_st = build(StreamingReader(chunks)).train(
+        run_dir=out_run_dir if out_run_dir is not None else ""
+    )
+    stream_s = time.perf_counter() - t0
+    aupr_mat = m_mat.run_report["metrics"].get("quality_AuPR")
+    aupr_st = m_st.run_report["metrics"].get("quality_AuPR")
+    ingest_s = m_st.run_report["metrics"].get("phase_ingest_s") or 0.0
+    stream_rows_s = rows / ingest_s if ingest_s > 0 else 0.0
+
+    # fit-stats bit-identity: streamed monoid fold vs one-shot reducer
+    feats = list(features())
+    _, summary = stream_ingest(StreamingReader(chunks), feats, seed=seed)
+    oneshot = ChunkStatsReducer(64)
+    oneshot.fold_dataset(SimpleReader(records).generate_dataset(feats))
+    stats_identical = (
+        json.dumps(summary["fitStats"], sort_keys=True)
+        == json.dumps(oneshot.finalize(), sort_keys=True)
+    )
+
+    # bounded-memory demo: generator chunks (cannot materialize), fixed
+    # buffer cap, N then 10×N — per-chunk RSS high-water must stay flat
+    n_chunks = len(chunks)
+    cap = chunk_rows * 4
+
+    def chunk_gen(n, gseed):
+        rng = np.random.default_rng(gseed)
+        for _ in range(n):
+            yield _fit_stream_records(chunk_rows, rng)
+
+    def rss_high_water(n):
+        rec = RunRecorder().start()
+        _, s = stream_ingest(
+            StreamingReader(chunk_gen(n, seed + 1)), feats,
+            recorder=rec, max_buffer_rows=cap, inflight=2, seed=seed,
+        )
+        series = [p["hostRssBytes"] for p in rec._chunk_mem]
+        return max(series), s["rowsSeen"]
+
+    hw_1x, rows_1x = rss_high_water(n_chunks)
+    hw_10x, rows_10x = rss_high_water(n_chunks * x10)
+    ratio = hw_10x / hw_1x if hw_1x else 0.0
+    bounded = 0.0 < ratio < 1.25
+
+    metrics = {
+        "aupr_materialized": aupr_mat,
+        "aupr_streamed": aupr_st,
+        "train_materialized_s": round(mat_s, 3),
+        "train_streamed_s": round(stream_s, 3),
+        "stream_ingest_rows_per_s": round(stream_rows_s),
+        "chunks": n_chunks,
+        "chunks_x10": n_chunks * x10,
+        "rows_x10": rows_10x,
+        "rss_high_water_1x_bytes": hw_1x,
+        "rss_high_water_10x_bytes": hw_10x,
+        "rss_high_water_ratio": round(ratio, 4),
+        "stats_bit_identical": stats_identical,
+    }
+    ok = (
+        aupr_mat is not None
+        and aupr_st == aupr_mat
+        and stats_identical
+        and bounded
+        and rows_10x == rows_1x * x10
+    )
+    return make_bench_report(
+        metric="fit_stream_rss_high_water_ratio_10x",
+        value=round(ratio, 4),
+        unit="x (10x chunks vs 1x, flat = bounded)",
+        seed=seed,
+        metrics=metrics,
+        ok=ok,
+        config=(
+            f"synthetic Real+Real+PickList LR flow, {rows} rows in "
+            f"{n_chunks} chunks of {chunk_rows}; out-of-core demo: "
+            f"generator chunks, buffer cap {cap} rows, inflight 2, "
+            f"{n_chunks} vs {n_chunks * x10} chunks"
+        ),
+        fitStream={
+            "auprIdentical": bool(
+                aupr_mat is not None and aupr_st == aupr_mat
+            ),
+            "statsBitIdentical": bool(stats_identical),
+            "bounded": bool(bounded),
+            "highWaterRatio": round(ratio, 4),
+            "chunksFolded": summary["chunksFolded"],
+        },
+    )
+
+
 def bench_explain(
     rows: int = 256,
     k: int = 3,
@@ -2004,6 +2188,38 @@ def _build_parser():
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH",
     )
+    fs = sub.add_parser(
+        "fit-stream",
+        help=(
+            "out-of-core streaming fit A/B: materialized vs streamed "
+            "train (AuPR identical, stats bit-identical) + bounded "
+            "per-chunk RSS high-water across a 10x chunk scale-up "
+            "(the BENCH_r11.json regression shape)"
+        ),
+    )
+    fs.add_argument(
+        "--rows", type=int, default=1600,
+        help="rows in the parity flow (default 1600)",
+    )
+    fs.add_argument(
+        "--chunk-rows", type=int, default=160,
+        help="rows per stream chunk (default 160)",
+    )
+    fs.add_argument("--seed", type=int, default=0, help="data seed")
+    fs.add_argument(
+        "--x10", type=int, default=10, metavar="FACTOR",
+        help="chunk-count scale-up factor for the bounded-memory demo "
+             "(default 10)",
+    )
+    fs.add_argument(
+        "--run-dir", default=None, metavar="DIR",
+        help="also persist the streamed train's RUN_*.json artifact "
+             "(with the per-chunk memory series) to DIR",
+    )
+    fs.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the JSON report to PATH",
+    )
     mc = sub.add_parser(
         "multichip",
         help=(
@@ -2295,6 +2511,13 @@ def _dispatch(ns) -> None:
             ns.out, echo=True,
         )
         return
+    if mode == "fit-stream":
+        doc = bench_fit_stream(
+            rows=ns.rows, chunk_rows=ns.chunk_rows, seed=ns.seed,
+            x10=ns.x10, out_run_dir=ns.run_dir,
+        )
+        dump_bench_report(doc, ns.out, echo=True)
+        raise SystemExit(0 if doc["ok"] else 1)
     if mode == "serve-retrain":
         doc = bench_serve_retrain(
             replicas=ns.replicas, rate=ns.rate, duration=ns.duration,

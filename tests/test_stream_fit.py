@@ -1,0 +1,742 @@
+"""Out-of-core streaming fit suite (workflow/stream.py + the train()
+``stream=True`` path): exact-monoid stat folding (streamed ≡ one-shot,
+bit for bit), pipelined ingest under a bounded in-flight window,
+torn/corrupt-chunk quarantine, seeded memory-pressure window halving,
+mid-ingest crash + cursor resume with < 1 chunk of rework, the typed
+``StreamExhausted`` fetch contract, chaos determinism twins (same seed →
+identical census), per-chunk memory polling in the run report, and the
+streamed event-time readers' materialized-twin parity.
+
+Everything is seeded and clock-free — zero real sleeps.
+Markers: faults, dist.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.readers.aggregate import (
+    AggregateParams,
+    AggregateReader,
+    ConditionalParams,
+    ConditionalReader,
+    CutOffTime,
+    StreamingAggregateReader,
+    StreamingConditionalReader,
+    TimeStampToKeep,
+    event_parity_oracle,
+)
+from transmogrifai_tpu.readers.core import SimpleReader
+from transmogrifai_tpu.readers.streaming import (
+    CHUNK_STATS,
+    StreamExhausted,
+    StreamingReader,
+)
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.resilience.checkpoint import CheckpointManager
+from transmogrifai_tpu.resilience.faults import FaultPlan, SimulatedCrash
+from transmogrifai_tpu.resilience.retry import RetryPolicy, TransientError
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.telemetry.runlog import (
+    RunRecorder,
+    poll_host_rss,
+    validate_run_report,
+)
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.stream import (
+    STREAM_STATS,
+    ChunkStatsReducer,
+    ColumnStat,
+    ExactSum,
+    stream_ingest,
+    stream_signature,
+)
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+pytestmark = [pytest.mark.faults, pytest.mark.dist]
+
+
+# ------------------------------------------------------------------ helpers
+def _features():
+    x1 = FeatureBuilder.Real("x1").extract(lambda r: r["x1"]).as_predictor()
+    x2 = FeatureBuilder.Real("x2").extract(lambda r: r["x2"]).as_predictor()
+    city = FeatureBuilder.PickList("city").extract(
+        lambda r: r["city"]).as_predictor()
+    lab = FeatureBuilder.RealNN("label").extract(
+        lambda r: r["label"]).as_response()
+    return [lab, x1, x2, city]
+
+
+def _records(n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        a, b = float(rng.normal()), float(rng.normal())
+        out.append({
+            "x1": a, "x2": b,
+            "city": ("sf", "nyc", "ber")[int(rng.integers(0, 3))],
+            "label": float(a + 0.5 * b > 0),
+        })
+    return out
+
+
+def _chunked(records, size):
+    return [records[i:i + size] for i in range(0, len(records), size)]
+
+
+def _flow(reader):
+    uid_util.reset()
+    feats = _features()
+    vec = transmogrify(feats[1:])
+    pred = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    ).set_input(feats[0], vec).get_output()
+    return Workflow().set_result_features(pred).set_reader(reader)
+
+
+@pytest.fixture(autouse=True)
+def _reset_ledgers():
+    STREAM_STATS.reset_for_tests()
+    CHUNK_STATS.reset_for_tests()
+    yield
+    STREAM_STATS.reset_for_tests()
+    CHUNK_STATS.reset_for_tests()
+
+
+# ---------------------------------------------------------------- ExactSum
+def test_exact_sum_is_split_and_permutation_invariant():
+    # values chosen to break naive float summation: huge + tiny cancel
+    vals = [1e16, 1.0, -1e16, 1e-3, 0.1, -0.1, 3.7e5, 1e-9] * 7
+    import math
+    expect = math.fsum(vals)
+    whole = ExactSum()
+    for v in vals:
+        whole.add(v)
+    assert whole.value() == expect
+    rng = np.random.default_rng(5)
+    for _ in range(10):
+        order = rng.permutation(len(vals))
+        cut = int(rng.integers(1, len(vals)))
+        a, b = ExactSum(), ExactSum()
+        for i in order[:cut]:
+            a.add(vals[i])
+        for i in order[cut:]:
+            b.add(vals[i])
+        a.merge(b)
+        assert a.value() == expect  # BIT-identical, not approximately
+
+
+def test_exact_sum_json_round_trip_is_exact():
+    s = ExactSum()
+    for v in (1e16, 1.0, -1e16, 0.1, 1e-9):
+        s.add(v)
+    back = ExactSum.from_json(json.loads(json.dumps(s.to_json())))
+    assert back.partials == s.partials
+    assert back.value() == s.value()
+
+
+def test_column_stat_serialization_round_trip():
+    feats = _features()
+    ds = SimpleReader(_records(100)).generate_dataset(feats)
+    red = ChunkStatsReducer(32)
+    red.fold_dataset(ds)
+    back = ChunkStatsReducer.from_json(
+        json.loads(json.dumps(red.to_json()))
+    )
+    assert json.dumps(back.finalize(), sort_keys=True) == json.dumps(
+        red.finalize(), sort_keys=True
+    )
+
+
+def test_column_stat_counts_non_finite_separately():
+    st = ColumnStat(numeric=True)
+    from transmogrifai_tpu.types.columns import NumericColumn
+    import transmogrifai_tpu.types as T
+    col = NumericColumn(
+        T.Real,
+        np.array([1.0, float("nan"), float("inf"), 2.0]),
+        np.array([True, True, True, True]),
+    )
+    st.update_column(col)
+    out = st.finalize()
+    assert out["nonFinite"] == 2
+    assert out["sum"] == 3.0 and out["count"] == 4
+
+
+# ---------------------------------------------------- streamed ≡ one-shot
+def test_streamed_stats_bit_identical_to_one_shot():
+    feats = _features()
+    records = _records(500, seed=3)
+    oneshot = ChunkStatsReducer(64)
+    oneshot.fold_dataset(SimpleReader(records).generate_dataset(feats))
+    expect = json.dumps(oneshot.finalize(), sort_keys=True)
+    for size in (1, 7, 50, 500):
+        _, summary = stream_ingest(
+            StreamingReader(_chunked(records, size)), feats, seed=0
+        )
+        got = json.dumps(summary["fitStats"], sort_keys=True)
+        assert got == expect, f"chunk size {size} broke bit-identity"
+
+
+def test_stream_ingest_dataset_matches_materialized_under_cap():
+    feats = _features()
+    records = _records(300, seed=1)
+    ds, summary = stream_ingest(
+        StreamingReader(_chunked(records, 64)), feats, seed=0
+    )
+    full = SimpleReader(records).generate_dataset(feats)
+    assert not summary["sampled"]
+    for name in full.columns:
+        assert ds[name].to_list() == full[name].to_list()
+
+
+def test_stream_ingest_reservoir_bounds_buffer_beyond_cap():
+    feats = _features()
+    records = _records(400, seed=2)
+    ds, summary = stream_ingest(
+        StreamingReader(_chunked(records, 50)), feats,
+        max_buffer_rows=120, seed=0,
+    )
+    assert ds.num_rows == 120
+    assert summary["sampled"] and summary["rowsSeen"] == 400
+    # fit stats still cover EVERY folded row, not just the sample
+    assert summary["fitStats"]["x1"]["count"] == 400
+    # deterministic: same seed → same sample
+    ds2, _ = stream_ingest(
+        StreamingReader(_chunked(records, 50)), feats,
+        max_buffer_rows=120, seed=0,
+    )
+    assert ds["x1"].to_list() == ds2["x1"].to_list()
+
+
+# ----------------------------------------------------------- fault matrix
+def test_torn_and_corrupt_chunks_quarantine_not_fold():
+    feats = _features()
+    chunks = _chunked(_records(600, seed=4), 100)
+    plan = FaultPlan()
+    plan.tear_stream_chunk(chunk_index=2)
+    plan.corrupt_chunk(chunk_index=4)
+    with faults.installed(plan):
+        _, s = stream_ingest(StreamingReader(chunks), feats, seed=0)
+    assert s["chunksQuarantined"] == {"torn": [2], "corrupt": [4]}
+    assert s["quarantinedTotal"] == 2
+    assert s["chunksFolded"] == 4 and s["rowsSeen"] == 400
+    snap = STREAM_STATS.snapshot()
+    assert snap["streamChunksTorn"] == 1
+    assert snap["streamChunksCorrupt"] == 1
+    assert snap["streamChunksQuarantined"] == 2
+    assert snap["streamRowsFolded"] == 400
+    assert ("stream_torn", "chunk-2") in plan.fired
+    assert ("stream_corrupt", "chunk-4") in plan.fired
+    # quarantined rows are really absent from the folded stats
+    assert s["fitStats"]["x1"]["count"] == 400
+
+
+def test_oom_chunk_halves_inflight_window_and_still_folds():
+    feats = _features()
+    chunks = _chunked(_records(600, seed=4), 100)
+    plan = FaultPlan()
+    plan.oom_chunk(chunk_index=1)
+    plan.oom_chunk(chunk_index=3)
+    with faults.installed(plan):
+        _, s = stream_ingest(
+            StreamingReader(chunks), feats, seed=0, inflight=8
+        )
+    assert s["window"] == {"initial": 8, "final": 2, "halvings": 2}
+    assert s["oomEvents"] == 2
+    # degradation, not data loss: every chunk still folded
+    assert s["chunksFolded"] == 6 and s["rowsSeen"] == 600
+    snap = STREAM_STATS.snapshot()
+    assert snap["streamOomEvents"] == 2
+    assert snap["streamWindowHalvings"] == 2
+
+
+def test_oom_at_window_one_stops_halving():
+    feats = _features()
+    chunks = _chunked(_records(200, seed=4), 100)
+    plan = FaultPlan()
+    plan.oom_chunk(chunk_index=0)
+    plan.oom_chunk(chunk_index=1)
+    with faults.installed(plan):
+        _, s = stream_ingest(
+            StreamingReader(chunks), feats, seed=0, inflight=1
+        )
+    assert s["window"]["final"] == 1
+    assert s["window"]["halvings"] == 0  # already at the floor
+    assert s["oomEvents"] == 2
+
+
+# --------------------------------------------------- StreamExhausted / fetch
+def test_stream_exhausted_typed_fields_and_quarantine():
+    calls = {"n": 0}
+
+    def flaky_fetch(batch):
+        calls["n"] += 1
+        raise TransientError(f"flaky storage (call {calls['n']})")
+
+    reader = StreamingReader([[{"a": 1}], [{"a": 2}]], fetch_fn=flaky_fetch)
+    reader.retry_policy = RetryPolicy(
+        max_attempts=3, base_delay=0.0, sleep=lambda s: None
+    )
+    batches = list(reader.stream_batches())
+    assert batches == []  # both chunks quarantined, stream survived
+    snap = CHUNK_STATS.snapshot()
+    assert snap["streamChunkExhausted"] == 2
+    assert snap["streamChunkAttempts"] == 6
+
+
+def test_stream_exhausted_carries_chunk_attempts_last_error():
+    def always_fails(batch):
+        raise TransientError("the disk is on fire")
+
+    reader = StreamingReader([[{"a": 1}]], fetch_fn=always_fails)
+    reader.retry_policy = RetryPolicy(
+        max_attempts=2, base_delay=0.0, sleep=lambda s: None
+    )
+    with pytest.raises(StreamExhausted) as ei:
+        reader._fetch_batch(0, [{"a": 1}])
+    e = ei.value
+    assert e.chunk == "chunk-0"
+    assert e.attempts == 2
+    assert isinstance(e.last_error, TransientError)
+    assert "chunk-0" in str(e) and "2 attempts" in str(e)
+    assert isinstance(e, TransientError)  # the defer/drop contract
+
+
+def test_fatal_fetch_error_raises_as_itself():
+    def fatal(batch):
+        raise ValueError("bad format")
+
+    reader = StreamingReader([[{"a": 1}]], fetch_fn=fatal)
+    reader.retry_policy = RetryPolicy(
+        max_attempts=3, base_delay=0.0, sleep=lambda s: None
+    )
+    with pytest.raises(ValueError):
+        reader._fetch_batch(0, [{"a": 1}])
+
+
+def test_fetch_exhaustion_skips_chunk_in_ingest():
+    feats = _features()
+    records = _records(300, seed=6)
+    chunks = _chunked(records, 100)
+    fails = {"left": 5}
+
+    def fetch(batch):
+        # chunk 1 exhausts its 3-attempt budget; others fetch clean
+        if batch is chunks[1] and fails["left"] > 0:
+            fails["left"] -= 1
+            raise TransientError("flaky")
+        return batch
+
+    reader = StreamingReader(chunks, fetch_fn=fetch)
+    reader.retry_policy = RetryPolicy(
+        max_attempts=3, base_delay=0.0, sleep=lambda s: None
+    )
+    _, s = stream_ingest(reader, feats, seed=0)
+    assert s["rowsSeen"] == 200  # the exhausted chunk never reached the fold
+    assert CHUNK_STATS.snapshot()["streamChunkExhausted"] == 1
+
+
+# ------------------------------------------------------- crash + resume
+def test_crash_resume_costs_less_than_one_chunk_of_rework(tmp_path):
+    feats = _features()
+    records = _records(600, seed=7)
+    chunks = _chunked(records, 100)
+    ckpt = CheckpointManager(str(tmp_path))
+    plan = FaultPlan()
+    plan.crash_after_chunk(3)
+    with faults.installed(plan):
+        with pytest.raises(SimulatedCrash):
+            stream_ingest(
+                StreamingReader(chunks), feats, seed=0, checkpoint=ckpt
+            )
+    pre = STREAM_STATS.snapshot()
+    assert pre["streamChunksFolded"] == 4  # chunks 0-3 folded + cursored
+    STREAM_STATS.reset_for_tests()
+    ds, s = stream_ingest(
+        StreamingReader(chunks), feats, seed=0, checkpoint=ckpt,
+        resume=True,
+    )
+    post = STREAM_STATS.snapshot()
+    assert s["resumed"] and post["streamResumes"] == 1
+    # < 1 chunk of rework: the 4 folded chunks are skipped, never re-folded
+    assert post["streamChunksSkipped"] == 4
+    assert post["streamChunksFolded"] == 2
+    # the resumed result is bit-identical to an uninterrupted run
+    oneshot = ChunkStatsReducer(64)
+    oneshot.fold_dataset(SimpleReader(records).generate_dataset(feats))
+    assert json.dumps(s["fitStats"], sort_keys=True) == json.dumps(
+        oneshot.finalize(), sort_keys=True
+    )
+    assert s["rowsSeen"] == 600 and ds.num_rows == 600
+
+
+def test_stream_cursor_signature_mismatch_restarts_clean(tmp_path):
+    feats = _features()
+    chunks = _chunked(_records(300, seed=8), 100)
+    ckpt = CheckpointManager(str(tmp_path))
+    plan = FaultPlan()
+    plan.crash_after_chunk(1)
+    with faults.installed(plan):
+        with pytest.raises(SimulatedCrash):
+            stream_ingest(
+                StreamingReader(chunks), feats, seed=0, checkpoint=ckpt
+            )
+    # different schema → the cursor must not restore
+    uid_util.reset()
+    other = [
+        FeatureBuilder.RealNN("label").extract(
+            lambda r: r["label"]).as_response(),
+        FeatureBuilder.Real("x1").extract(lambda r: r["x1"]).as_predictor(),
+    ]
+    STREAM_STATS.reset_for_tests()
+    _, s = stream_ingest(
+        StreamingReader(chunks), other, seed=0, checkpoint=ckpt,
+        resume=True,
+    )
+    assert not s["resumed"]
+    assert s["chunksFolded"] == 3  # full re-ingest, nothing skipped
+
+
+def test_stream_cursor_is_torn_write_safe(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path))
+    ckpt.save_stream_cursor({"signature": "abc", "chunksDone": 1})
+    with open(ckpt.stream_cursor_path(), "w") as fh:
+        fh.write('{"signature": "abc", "chunksDo')  # torn mid-write
+    assert ckpt.load_stream_cursor("abc") is None
+
+
+def test_stream_signature_covers_schema_and_seed():
+    feats = _features()
+    a = stream_signature(feats, 0)
+    assert a == stream_signature(feats, 0)
+    assert a != stream_signature(feats, 1)
+    assert a != stream_signature(list(reversed(feats)), 0)
+
+
+# ------------------------------------------------------ chaos determinism
+def test_chaos_determinism_twin_same_seed_identical_census():
+    feats = _features()
+    chunks = _chunked(_records(500, seed=9), 50)
+
+    def run():
+        STREAM_STATS.reset_for_tests()
+        plan = FaultPlan()
+        plan.tear_stream_chunk(chunk_index=1)
+        plan.corrupt_chunk(chunk_index=5)
+        plan.oom_chunk(chunk_index=7)
+        with faults.installed(plan):
+            _, s = stream_ingest(
+                StreamingReader(chunks), feats, seed=3, inflight=4
+            )
+        return s, sorted(plan.fired), STREAM_STATS.snapshot()
+
+    s1, fired1, snap1 = run()
+    s2, fired2, snap2 = run()
+    assert fired1 == fired2
+    assert snap1 == snap2
+    assert json.dumps(s1, sort_keys=True) == json.dumps(s2, sort_keys=True)
+
+
+# ----------------------------------------------------- workflow integration
+def test_train_auto_streams_unbounded_reader_aupr_parity():
+    records = _records(300, seed=10)
+    chunks = _chunked(records, 50)
+    m_stream = _flow(StreamingReader(chunks)).train(run_dir="")
+    m_mat = _flow(SimpleReader(records)).train(run_dir="")
+    ms, mm = m_stream.run_report["metrics"], m_mat.run_report["metrics"]
+    assert ms["quality_AuPR"] == mm["quality_AuPR"]
+    run = m_stream.run_report["run"]
+    s = run["stream"]
+    assert s["chunksFolded"] == 6 and s["rowsSeen"] == 300
+    assert not s["sampled"]
+    # per-chunk memory series landed (satellite: poll per CHUNK)
+    series = run["deviceMemory"]["chunkSeries"]
+    assert len(series) == 6
+    assert all(p["hostRssBytes"] > 0 for p in series)
+    assert ms["host_rss_high_water_bytes"] > 0
+    assert ms["stream_chunks_folded"] == 6
+    assert validate_run_report(m_stream.run_report) == []
+    sel = m_stream.summary_json()["modelSelectorSummary"]
+    assert sel["streamIngest"]["chunksFolded"] == 6
+    assert "fitStats" not in sel["streamIngest"]
+
+
+def test_train_stream_false_forces_materialization():
+    class BothWays(SimpleReader):
+        def is_unbounded(self):
+            return True  # would auto-stream...
+
+    records = _records(120, seed=11)
+    m = _flow(BothWays(records)).train(run_dir="", stream=False)
+    # ...but stream=False overrides the reader's declaration
+    assert m.run_report["run"].get("stream") is None
+
+
+def test_train_stream_true_requires_chunked_reader():
+    with pytest.raises(ValueError, match="stream_batches"):
+        _flow(SimpleReader(_records(50))).train(stream=True)
+
+
+def test_train_stream_quarantine_rides_report():
+    records = _records(300, seed=12)
+    plan = FaultPlan()
+    plan.tear_stream_chunk(chunk_index=2)
+    with faults.installed(plan):
+        m = _flow(StreamingReader(_chunked(records, 50))).train(run_dir="")
+    s = m.run_report["run"]["stream"]
+    assert s["chunksQuarantined"]["torn"] == [2]
+    assert s["rowsSeen"] == 250
+    assert m.run_report["metrics"]["stream_chunks_quarantined"] == 1
+
+
+def test_train_crash_resume_mid_ingest(tmp_path):
+    records = _records(300, seed=13)
+    chunks = _chunked(records, 50)
+    plan = FaultPlan()
+    plan.crash_after_chunk(2)
+    with faults.installed(plan):
+        with pytest.raises(SimulatedCrash):
+            _flow(StreamingReader(chunks)).train(
+                checkpoint_dir=str(tmp_path), run_dir=""
+            )
+    STREAM_STATS.reset_for_tests()
+    m = _flow(StreamingReader(chunks)).train(
+        checkpoint_dir=str(tmp_path), resume=True, run_dir=""
+    )
+    snap = STREAM_STATS.snapshot()
+    assert snap["streamChunksSkipped"] == 3
+    assert snap["streamChunksFolded"] == 3
+    s = m.run_report["run"]["stream"]
+    assert s["resumed"] and s["rowsSeen"] == 300
+    # and the model is sound: parity against a clean materialized train
+    m2 = _flow(SimpleReader(records)).train(run_dir="")
+    assert (
+        m.run_report["metrics"]["quality_AuPR"]
+        == m2.run_report["metrics"]["quality_AuPR"]
+    )
+
+
+def test_fresh_train_clears_stale_stream_cursor(tmp_path):
+    records = _records(200, seed=14)
+    chunks = _chunked(records, 50)
+    plan = FaultPlan()
+    plan.crash_after_chunk(1)
+    with faults.installed(plan):
+        with pytest.raises(SimulatedCrash):
+            _flow(StreamingReader(chunks)).train(
+                checkpoint_dir=str(tmp_path), run_dir=""
+            )
+    # fresh (non-resume) train: the stale cursor must NOT restore
+    STREAM_STATS.reset_for_tests()
+    m = _flow(StreamingReader(chunks)).train(
+        checkpoint_dir=str(tmp_path), run_dir=""
+    )
+    assert not m.run_report["run"]["stream"]["resumed"]
+    assert STREAM_STATS.snapshot()["streamChunksSkipped"] == 0
+
+
+# ------------------------------------------------------- resilience ledger
+def test_stream_counters_reach_resilience_source():
+    from transmogrifai_tpu.resilience.distributed import _resilience_source
+
+    base = _resilience_source()
+    for key in (
+        "streamChunksFolded", "streamChunksQuarantined",
+        "streamWindowHalvings", "streamCursorSaves", "streamResumes",
+    ):
+        assert key in base
+    feats = _features()
+    stream_ingest(
+        StreamingReader(_chunked(_records(100, seed=15), 50)), feats,
+        seed=0,
+    )
+    assert _resilience_source()["streamChunksFolded"] == 2
+
+
+# ------------------------------------------------------ per-chunk memory
+def test_poll_host_rss_positive():
+    assert poll_host_rss() > 0
+
+
+def test_chunk_memory_series_decimates_bounded(monkeypatch):
+    rec = RunRecorder(clock=lambda: 0.0).start()
+    for i in range(40):
+        rec.poll_chunk_memory(i)
+    assert len(rec._chunk_mem) == 40  # under the cap: every chunk kept
+    monkeypatch.setattr(RunRecorder, "_CHUNK_SERIES_CAP", 8)
+    rec2 = RunRecorder(clock=lambda: 0.0).start()
+    for i in range(64):
+        rec2.poll_chunk_memory(i)
+    assert len(rec2._chunk_mem) < 16  # bounded despite 64 chunks
+    assert rec2._chunk_stride > 1
+    kept = [p["chunk"] for p in rec2._chunk_mem]
+    assert kept == sorted(kept)  # decimation preserves chunk order
+
+
+# ------------------------------------------------- streamed event-time
+def _events(n=400, seed=21):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        out.append({
+            "user": f"u{int(rng.integers(0, 30)):02d}",
+            "ts": int(rng.integers(0, 1000)) * 1000,
+            "amount": float(rng.normal()),
+            "tag": ("a", "b", "c")[int(rng.integers(0, 3))],
+            "buy": bool(rng.integers(0, 4) == 0),
+        })
+    return out
+
+
+def _event_features():
+    amount = FeatureBuilder.Real("amount").extract(
+        lambda r: r["amount"]).as_predictor()
+    tag = FeatureBuilder.PickList("tag").extract(
+        lambda r: r["tag"]).as_predictor()
+    resp = FeatureBuilder.RealNN("resp").extract(
+        lambda r: r["amount"]).as_response()
+    return [resp, amount, tag]
+
+
+def test_streaming_aggregate_reader_matches_materialized_twin():
+    records = _events()
+    chunks = _chunked(records, 64)
+    params = AggregateParams(
+        timestamp_fn=lambda r: r["ts"],
+        cutoff_time=CutOffTime.unix_epoch(500_000),
+        response_window_ms=200_000,
+        predictor_window_ms=300_000,
+    )
+    key = lambda r: r["user"]  # noqa: E731
+    feats = _event_features()
+    mat = AggregateReader(records, key, params).generate_dataset(feats)
+    st = StreamingAggregateReader(chunks, key, params).generate_dataset(
+        feats
+    )
+    verdict = event_parity_oracle(st, mat)
+    assert verdict["identical"], verdict["mismatches"]
+
+
+def test_streaming_aggregate_chunking_invariant():
+    records = _events(seed=22)
+    params = AggregateParams(
+        timestamp_fn=lambda r: r["ts"],
+        cutoff_time=CutOffTime.unix_epoch(600_000),
+    )
+    key = lambda r: r["user"]  # noqa: E731
+    feats = _event_features()
+    base = StreamingAggregateReader(
+        _chunked(records, 1000), key, params
+    ).generate_dataset(feats)
+    for size in (1, 13, 100):
+        other = StreamingAggregateReader(
+            _chunked(records, size), key, params
+        ).generate_dataset(feats)
+        verdict = event_parity_oracle(other, base)
+        assert verdict["identical"], (size, verdict["mismatches"])
+
+
+@pytest.mark.parametrize("keep", list(TimeStampToKeep))
+def test_streaming_conditional_reader_matches_materialized_twin(keep):
+    records = _events(seed=23)
+    chunks = _chunked(records, 64)
+    params = ConditionalParams(
+        timestamp_fn=lambda r: r["ts"],
+        target_condition=lambda r: r["buy"],
+        timestamp_to_keep=keep,
+        seed=11,
+        now_ms=999_000,
+        response_window_ms=250_000,
+        predictor_window_ms=250_000,
+    )
+    key = lambda r: r["user"]  # noqa: E731
+    feats = _event_features()
+    mat = ConditionalReader(records, key, params).generate_dataset(feats)
+    st = StreamingConditionalReader(chunks, key, params).generate_dataset(
+        feats
+    )
+    verdict = event_parity_oracle(st, mat)
+    assert verdict["identical"], (keep, verdict["mismatches"])
+
+
+def test_streaming_conditional_drop_unmet_parity():
+    records = _events(seed=24)
+    params = ConditionalParams(
+        timestamp_fn=lambda r: r["ts"],
+        target_condition=lambda r: r["buy"] and r["ts"] > 800_000,
+        timestamp_to_keep=TimeStampToKeep.MIN,
+        seed=1,
+        now_ms=999_000,
+        drop_if_target_condition_not_met=True,
+    )
+    key = lambda r: r["user"]  # noqa: E731
+    feats = _event_features()
+    mat = ConditionalReader(records, key, params).generate_dataset(feats)
+    st = StreamingConditionalReader(
+        _chunked(records, 50), key, params
+    ).generate_dataset(feats)
+    verdict = event_parity_oracle(st, mat)
+    assert verdict["identical"], verdict["mismatches"]
+    assert st.num_rows < 30  # the drop really dropped
+
+
+def test_streaming_conditional_rejects_cutoff_time_fn():
+    with pytest.raises(ValueError, match="cutoff_time_fn"):
+        StreamingConditionalReader(
+            [], lambda r: "k",
+            ConditionalParams(
+                timestamp_fn=lambda r: 0,
+                target_condition=lambda r: True,
+                cutoff_time_fn=lambda k, evs: CutOffTime.no_cutoff(),
+            ),
+        )
+
+
+def test_streaming_conditional_callable_chunks_two_passes():
+    records = _events(seed=25)
+    calls = {"n": 0}
+
+    def chunk_source():
+        calls["n"] += 1
+        return iter(_chunked(records, 64))
+
+    params = ConditionalParams(
+        timestamp_fn=lambda r: r["ts"],
+        target_condition=lambda r: r["buy"],
+        timestamp_to_keep=TimeStampToKeep.MAX,
+        seed=2,
+        now_ms=999_000,
+    )
+    key = lambda r: r["user"]  # noqa: E731
+    feats = _event_features()
+    st = StreamingConditionalReader(
+        chunk_source, key, params
+    ).generate_dataset(feats)
+    assert calls["n"] == 2  # pass 1 (cutoffs) + pass 2 (folds)
+    mat = ConditionalReader(records, key, params).generate_dataset(feats)
+    assert event_parity_oracle(st, mat)["identical"]
+
+
+def test_event_parity_oracle_names_the_break():
+    import dataclasses
+
+    records = _events(seed=26)
+    params = AggregateParams(
+        timestamp_fn=lambda r: r["ts"],
+        cutoff_time=CutOffTime.unix_epoch(500_000),
+    )
+    moved = dataclasses.replace(
+        params, cutoff_time=CutOffTime.unix_epoch(700_000)
+    )
+    key = lambda r: r["user"]  # noqa: E731
+    feats = _event_features()
+    a = AggregateReader(records, key, params).generate_dataset(feats)
+    b = AggregateReader(records, key, moved).generate_dataset(feats)
+    verdict = event_parity_oracle(a, b)
+    assert not verdict["identical"]
+    assert verdict["mismatches"]

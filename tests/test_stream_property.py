@@ -1,0 +1,142 @@
+"""Property tests for the out-of-core fit's exactness contract
+(workflow/stream.py): for ANY chunk split and ANY chunk permutation of
+the same rows, the streamed monoid statistics are bit-identical to the
+one-shot pass. Hypothesis searches the split/permutation space; the
+deterministic twins of these properties live in tests/test_stream_fit.py
+so coverage survives environments without hypothesis (this module skips
+wholesale there).
+"""
+import json
+import math
+
+import pytest
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ModuleNotFoundError:
+    pytest.skip("hypothesis not installed", allow_module_level=True)
+
+import numpy as np
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.readers.core import SimpleReader
+from transmogrifai_tpu.workflow.stream import ChunkStatsReducer, ExactSum
+
+pytestmark = [pytest.mark.faults, pytest.mark.dist]
+
+SETTINGS = settings(max_examples=60, deadline=None)
+
+finite_floats = st.floats(
+    allow_nan=False, allow_infinity=False, width=64, min_value=-1e12,
+    max_value=1e12,
+)
+
+
+def _split(vals, cuts):
+    """Split ``vals`` at the (sorted, deduped) cut points."""
+    idx = sorted({c % (len(vals) + 1) for c in cuts})
+    bounds = [0] + idx + [len(vals)]
+    return [
+        vals[a:b] for a, b in zip(bounds, bounds[1:]) if a < b
+    ]
+
+
+@SETTINGS
+@given(
+    vals=st.lists(finite_floats, min_size=1, max_size=80),
+    cuts=st.lists(st.integers(min_value=0, max_value=1000), max_size=8),
+    perm_seed=st.integers(min_value=0, max_value=2**31),
+)
+def test_exact_sum_invariant_under_split_and_permutation(
+    vals, cuts, perm_seed
+):
+    whole = ExactSum()
+    for v in vals:
+        whole.add(v)
+    expect = whole.value()
+    assert expect == math.fsum(vals)
+
+    chunks = _split(vals, cuts)
+    rng = np.random.default_rng(perm_seed)
+    order = rng.permutation(len(chunks))
+    acc = ExactSum()
+    for i in order:
+        part = ExactSum()
+        for v in chunks[i]:
+            part.add(v)
+        # round-trip each partial through JSON like the stream cursor does
+        part = ExactSum.from_json(json.loads(json.dumps(part.to_json())))
+        acc.merge(part)
+    assert acc.value() == expect  # BIT-identical, not approximately
+
+
+@SETTINGS
+@given(
+    rows=st.lists(
+        st.tuples(finite_floats, st.sampled_from(["a", "b", "c", None])),
+        min_size=1,
+        max_size=60,
+    ),
+    cuts=st.lists(st.integers(min_value=0, max_value=1000), max_size=6),
+)
+def test_chunked_stats_bit_identical_to_one_shot_for_any_split(rows, cuts):
+    records = [{"x": x, "cat": c} for x, c in rows]
+    feats = _features()
+    oneshot = ChunkStatsReducer(32)
+    oneshot.fold_dataset(SimpleReader(records).generate_dataset(feats))
+    expect = json.dumps(oneshot.finalize(), sort_keys=True)
+
+    streamed = ChunkStatsReducer(32)
+    for chunk in _split(records, cuts):
+        streamed.fold_dataset(SimpleReader(chunk).generate_dataset(feats))
+    got = json.dumps(streamed.finalize(), sort_keys=True)
+    assert got == expect
+
+
+@SETTINGS
+@given(
+    vals=st.lists(finite_floats, min_size=1, max_size=60),
+    cuts=st.lists(st.integers(min_value=0, max_value=1000), max_size=6),
+    perm_seed=st.integers(min_value=0, max_value=2**31),
+)
+def test_count_sum_moment_plane_permutation_invariant(
+    vals, cuts, perm_seed
+):
+    """The count/sum/mean/variance/min/max plane is invariant under chunk
+    PERMUTATION too (histogram bins can differ once merges approximate,
+    so this property checks the exact plane only)."""
+    records = [{"x": v, "cat": "a"} for v in vals]
+    feats = _features()
+    oneshot = ChunkStatsReducer(32)
+    oneshot.fold_dataset(SimpleReader(records).generate_dataset(feats))
+    expect = {
+        k: v
+        for k, v in oneshot.finalize()["x"].items()
+        if k != "histogram"
+    }
+
+    chunks = _split(records, cuts)
+    rng = np.random.default_rng(perm_seed)
+    streamed = ChunkStatsReducer(32)
+    for i in rng.permutation(len(chunks)):
+        streamed.fold_dataset(
+            SimpleReader(chunks[i]).generate_dataset(feats)
+        )
+    got = {
+        k: v
+        for k, v in streamed.finalize()["x"].items()
+        if k != "histogram"
+    }
+    assert json.dumps(got, sort_keys=True) == json.dumps(
+        expect, sort_keys=True
+    )
+
+
+def _features():
+    from transmogrifai_tpu.utils import uid as uid_util
+
+    uid_util.reset()
+    x = FeatureBuilder.Real("x").extract(lambda r: r["x"]).as_predictor()
+    cat = FeatureBuilder.PickList("cat").extract(
+        lambda r: r["cat"]).as_predictor()
+    return [x, cat]

@@ -20,7 +20,7 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Sequence
+from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
@@ -115,6 +115,72 @@ def run_tasks(tasks: Sequence[Callable[[], object]]) -> list:
         len(tasks), sum(busy), wall, featurize_threads()
     )
     return results
+
+
+def pipeline_tasks(thunks, window) -> "Iterator[object]":
+    """Sliding-window pipeline over an ITERATOR of thunks: at most
+    ``window`` tasks are submitted ahead on the featurize pool while
+    earlier results are consumed, and results yield in submission order.
+    This is the out-of-core ingest's backpressure primitive
+    (workflow/stream.py): chunk k+1 featurizes on the pool while chunk k
+    reduces on the caller's thread, and the bounded window keeps host RSS
+    flat regardless of how many chunks the source produces.
+
+    ``window`` may be a callable re-read before every refill, so the
+    caller can SHRINK the in-flight window mid-stream (the memory-
+    pressure degradation path) and the change takes effect on the next
+    submission. Sequential fallback when the pool is disabled or the
+    caller already runs on it (same nested-call rule as ``run_tasks``).
+    Pulling the next thunk from ``thunks`` happens on the caller's
+    thread, so source-side effects (fetch retries, fault hooks) stay
+    deterministic."""
+    win = window if callable(window) else (lambda: window)
+    it = iter(thunks)
+    if not pool_enabled() or getattr(_ON_POOL, "active", False):
+        for t in it:
+            yield t()
+        return
+
+    import collections
+
+    def _on_pool(t):
+        _ON_POOL.active = True
+        try:
+            return t()
+        finally:
+            _ON_POOL.active = False
+
+    pending: collections.deque = collections.deque()
+    done = False
+    tasks = 0
+    busy = 0.0
+    t0 = time.perf_counter()
+    try:
+        while True:
+            target = max(1, int(win()))
+            while not done and len(pending) < target:
+                try:
+                    t = next(it)
+                except StopIteration:
+                    done = True
+                    break
+                pending.append(_pool().submit(_on_pool, t))
+                tasks += 1
+            if not pending:
+                break
+            f = pending.popleft()
+            b0 = time.perf_counter()
+            out = f.result()
+            busy += time.perf_counter() - b0
+            yield out
+    finally:
+        # an abandoned generator must not leak queued work
+        for f in pending:
+            f.cancel()
+        if tasks:
+            fstats.stats().record_pool(
+                tasks, busy, time.perf_counter() - t0, featurize_threads()
+            )
 
 
 _ON_POOL = threading.local()

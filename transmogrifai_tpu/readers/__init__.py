@@ -7,7 +7,10 @@ from .aggregate import (  # noqa: F401
     ConditionalParams,
     ConditionalReader,
     CutOffTime,
+    StreamingAggregateReader,
+    StreamingConditionalReader,
     TimeStampToKeep,
+    event_parity_oracle,
 )
 from .joins import (  # noqa: F401
     JoinedAggregateReader,
@@ -18,7 +21,11 @@ from .joins import (  # noqa: F401
     TimeColumn,
     join_datasets,
 )
-from .streaming import FileStreamingReader, StreamingReader  # noqa: F401
+from .streaming import (  # noqa: F401
+    FileStreamingReader,
+    StreamExhausted,
+    StreamingReader,
+)
 from .parquet import (  # noqa: F401
     AvroReader,
     ParquetReader,

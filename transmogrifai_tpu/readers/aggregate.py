@@ -222,6 +222,10 @@ class ConditionalParams:
     cutoff_time_fn: Callable[[str, Sequence[Any]], CutOffTime] | None = None
     drop_if_target_condition_not_met: bool = False
     seed: int | None = None  # the reference's Random is unseeded; we seed
+    #: injectable "now" for the unmet-condition fallback cutoff
+    #: (DataReader.scala:325 calls now()); pinning it makes streamed and
+    #: materialized twins bit-comparable and keeps tests clock-free
+    now_ms: int | None = None
 
 
 class ConditionalReader(DataReader):
@@ -264,7 +268,9 @@ class ConditionalReader(DataReader):
         for r in self._read_records_with_retry():
             groups.setdefault(self.key_fn(r), []).append((p.timestamp_fn(r), r))
         keys, cutoffs = [], []
-        now_ms = int(time.time() * 1000)
+        now_ms = (
+            p.now_ms if p.now_ms is not None else int(time.time() * 1000)
+        )
         for k in sorted(groups):
             cutoff = self._cutoff_for(k, groups[k], rng)
             if cutoff is None:
@@ -295,3 +301,246 @@ def _key_type() -> type:
     from .. import types as T
 
     return T.ID
+
+
+# ----------------------------------------------------- streamed event-time
+class _FeatureFold:
+    """Incremental per-feature event fold — ``_aggregate_feature`` turned
+    into monoid state so the streamed readers never hold a key's event
+    list. Monoid aggregators fold ``plus`` per event; a plain-callable
+    ``aggregate_fn`` has no incremental form, so only its FILTERED
+    extracted values buffer (bounded by in-window events, not the
+    stream)."""
+
+    def __init__(self, feature: Feature):
+        stage = feature.origin_stage
+        assert isinstance(stage, FeatureGeneratorStage)
+        self.stage = stage
+        self.agg = stage.aggregate_fn or aggregator_of(feature.ftype)
+        self.monoid = hasattr(self.agg, "plus")
+
+    def zero(self) -> Any:
+        return self.agg.zero if self.monoid else []
+
+    def fold(self, acc: Any, ts: int, record: Any) -> Any:
+        value = (
+            self.stage.extract_fn(record)
+            if self.stage.extract_fn else record
+        )
+        if not self.monoid:
+            acc.append(value)
+            return acc
+        if isinstance(self.agg, LastAggregator):
+            prepared = self.agg.prepare_event(value, ts)
+        else:
+            prepared = self.agg.prepare(value)
+        return self.agg.plus(acc, prepared)
+
+    def present(self, acc: Any) -> Any:
+        return self.agg.present(acc) if self.monoid else self.agg(acc)
+
+
+class _StreamedEventReader(DataReader):
+    """Shared chunk plumbing for the streamed event-time readers. The
+    source is an iterable of record chunks OR a zero-arg callable
+    producing one (a callable is REQUIRED wherever two passes are needed
+    — a plain generator would be empty on the second)."""
+
+    def __init__(
+        self,
+        chunks: Iterable[Sequence[Any]] | Callable[[], Iterable[Sequence[Any]]],
+        key_fn: Callable[[Any], str],
+    ):
+        super().__init__(key_fn)
+        self._chunks = chunks
+
+    def _chunk_iter(self) -> Iterable[Sequence[Any]]:
+        return self._chunks() if callable(self._chunks) else self._chunks
+
+    def read_records(self) -> Iterable[Any]:
+        for chunk in self._chunk_iter():
+            yield from chunk
+
+
+class StreamingAggregateReader(_StreamedEventReader):
+    """Point-in-time-correct aggregate reader over a chunked event
+    stream: one pass, per-entity monoid accumulators — memory is bounded
+    by ENTITIES, never events. Semantically identical to
+    :class:`AggregateReader` over the concatenated chunks (the parity
+    oracle in tests/bench pins column-exact equality): predictors fold
+    events strictly before the cutoff, responses at/after it, each within
+    its window — no future leakage regardless of how the stream is
+    chunked."""
+
+    def __init__(
+        self,
+        chunks: Iterable[Sequence[Any]] | Callable[[], Iterable[Sequence[Any]]],
+        key_fn: Callable[[Any], str],
+        aggregate_params: AggregateParams,
+    ):
+        super().__init__(chunks, key_fn)
+        self.params = aggregate_params
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        p = self.params
+        ts_fn = p.timestamp_fn
+        cutoff = p.cutoff_time.time_ms
+        folds = [_FeatureFold(f) for f in raw_features]
+        windows = [
+            p.response_window_ms if f.is_response else p.predictor_window_ms
+            for f in raw_features
+        ]
+        state: dict[str, list[Any]] = {}
+        for chunk in self._chunk_iter():
+            for r in chunk:
+                k = self.key_fn(r)
+                ts = ts_fn(r) if ts_fn else 0
+                accs = state.get(k)
+                if accs is None:
+                    accs = [fold.zero() for fold in folds]
+                    state[k] = accs
+                for i, f in enumerate(raw_features):
+                    if _in_window(ts, cutoff, f.is_response, windows[i]):
+                        accs[i] = folds[i].fold(accs[i], ts, r)
+        keys = sorted(state)
+        cols: dict[str, Any] = {
+            _KEY_COLUMN: column_from_values(_key_type(), keys)
+        }
+        for i, f in enumerate(raw_features):
+            vals = [folds[i].present(state[k][i]) for k in keys]
+            cols[f.name] = _column_for(f, vals)
+        return Dataset.of(cols)
+
+
+class StreamingConditionalReader(_StreamedEventReader):
+    """Per-entity cutoff-time semantics over a chunked event stream, in
+    two streamed passes: pass 1 folds each key's target-event times
+    (min/max incremental; RANDOM keeps the target times only), pass 2
+    folds the windowed aggregates against the per-key cutoffs. Chunks
+    must therefore come from a re-iterable source (sequence or callable)
+    that replays the SAME records in the SAME order. Bit-identical to
+    :class:`ConditionalReader` over the concatenated chunks given the
+    same ``seed`` (pin ``now_ms`` when keys can miss the target
+    condition). ``cutoff_time_fn`` needs a key's full event list and is
+    not supported streamed."""
+
+    def __init__(
+        self,
+        chunks: Iterable[Sequence[Any]] | Callable[[], Iterable[Sequence[Any]]],
+        key_fn: Callable[[Any], str],
+        conditional_params: ConditionalParams,
+    ):
+        super().__init__(chunks, key_fn)
+        if conditional_params.cutoff_time_fn is not None:
+            raise ValueError(
+                "cutoff_time_fn requires each key's full event list and "
+                "cannot stream; use ConditionalReader or precompute "
+                "cutoffs"
+            )
+        self.params = conditional_params
+
+    def _cutoffs(self) -> dict[str, int | None]:
+        """Pass 1 → per-key cutoff. Consumes the rng over sorted keys
+        exactly like ``ConditionalReader._cutoff_for`` so the streamed
+        and materialized twins draw identical RANDOM cutoffs."""
+        p = self.params
+        keep = p.timestamp_to_keep
+        # MIN/MAX fold to one int; RANDOM needs the times (arrival order,
+        # matching the materialized group lists) for index selection
+        times: dict[str, Any] = {}
+        seen: set[str] = set()
+        for chunk in self._chunk_iter():
+            for r in chunk:
+                k = self.key_fn(r)
+                seen.add(k)
+                if not p.target_condition(r):
+                    continue
+                ts = p.timestamp_fn(r)
+                if keep is TimeStampToKeep.RANDOM:
+                    times.setdefault(k, []).append(ts)
+                elif keep is TimeStampToKeep.MIN:
+                    times[k] = min(times.get(k, ts), ts)
+                else:
+                    times[k] = max(times.get(k, ts), ts)
+        rng = random.Random(p.seed)
+        out: dict[str, int | None] = {}
+        for k in sorted(seen):
+            t = times.get(k)
+            if t is None:
+                out[k] = None
+            elif keep is TimeStampToKeep.RANDOM:
+                out[k] = t[rng.randrange(len(t))]
+            else:
+                out[k] = t
+        return out
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        p = self.params
+        cutoffs = self._cutoffs()
+        now_ms = (
+            p.now_ms if p.now_ms is not None else int(time.time() * 1000)
+        )
+        keys = []
+        for k in sorted(cutoffs):
+            if cutoffs[k] is None:
+                if p.drop_if_target_condition_not_met:
+                    continue
+                cutoffs[k] = now_ms  # DataReader.scala:325: now() when unmet
+            keys.append(k)
+        kept = set(keys)
+        folds = [_FeatureFold(f) for f in raw_features]
+        windows = [
+            p.response_window_ms if f.is_response else p.predictor_window_ms
+            for f in raw_features
+        ]
+        state: dict[str, list[Any]] = {
+            k: [fold.zero() for fold in folds] for k in keys
+        }
+        for chunk in self._chunk_iter():
+            for r in chunk:
+                k = self.key_fn(r)
+                if k not in kept:
+                    continue
+                ts = p.timestamp_fn(r)
+                cutoff = cutoffs[k]
+                accs = state[k]
+                for i, f in enumerate(raw_features):
+                    if _in_window(ts, cutoff, f.is_response, windows[i]):
+                        accs[i] = folds[i].fold(accs[i], ts, r)
+        cols: dict[str, Any] = {
+            _KEY_COLUMN: column_from_values(_key_type(), keys)
+        }
+        for i, f in enumerate(raw_features):
+            vals = [folds[i].present(state[k][i]) for k in keys]
+            cols[f.name] = _column_for(f, vals)
+        return Dataset.of(cols)
+
+
+def event_parity_oracle(streamed: Dataset, materialized: Dataset) -> dict:
+    """Column-exact comparison of a streamed event-time frame against its
+    materialized twin — the acceptance oracle for the streamed readers
+    (and ``bench.py fit-stream``). Returns ``{"identical": bool,
+    "mismatches": [...]}`` naming every differing column (or a shape/
+    schema difference) instead of a bare boolean, so a parity break is
+    diagnosable from the report."""
+    mismatches: list[str] = []
+    a, b = streamed.columns, materialized.columns
+    if sorted(a) != sorted(b):
+        mismatches.append(
+            f"columns differ: {sorted(a)} vs {sorted(b)}"
+        )
+        return {"identical": False, "mismatches": mismatches}
+    for name in sorted(a):
+        va, vb = a[name].to_list(), b[name].to_list()
+        if len(va) != len(vb):
+            mismatches.append(
+                f"{name}: {len(va)} rows vs {len(vb)}"
+            )
+        elif va != vb:
+            bad = next(
+                i for i, (x, y) in enumerate(zip(va, vb)) if x != y
+            )
+            mismatches.append(
+                f"{name}: row {bad}: {va[bad]!r} != {vb[bad]!r}"
+            )
+    return {"identical": not mismatches, "mismatches": mismatches}

@@ -28,6 +28,13 @@ class DataReader:
     def __init__(self, key_fn: Callable[[Any], str] | None = None):
         self.key_fn = key_fn
 
+    def is_unbounded(self) -> bool:
+        """Whether this source declares no known size. Materializing
+        readers are bounded; streaming sources (readers/streaming.py)
+        return True and ``Workflow.train`` auto-routes them through the
+        out-of-core chunked fit (workflow/stream.py)."""
+        return False
+
     def read_records(self) -> Iterable[Any]:  # pragma: no cover - abstract
         raise NotImplementedError
 

@@ -21,6 +21,26 @@ from .core import SimpleReader
 log = logging.getLogger(__name__)
 
 
+class StreamExhausted(TransientError):
+    """A chunk fetch burned its whole retry budget on transient errors:
+    names the chunk, the attempts spent, and the last underlying error
+    (docs/faq.md). Subclasses ``TransientError`` on purpose — the
+    file-stream defer/drop path and the out-of-core ingest quarantine
+    both treat it as the bounded transient failure it is, while typed
+    callers can read ``chunk``/``attempts``/``last_error`` instead of
+    parsing a log line. Fatal errors (bad format, permissions) still
+    raise as themselves: retries never ran, so nothing was exhausted."""
+
+    def __init__(self, chunk: str, attempts: int, last_error: BaseException):
+        super().__init__(
+            f"stream chunk {chunk!r} exhausted after {attempts} "
+            f"attempts: {type(last_error).__name__}: {last_error}"
+        )
+        self.chunk = chunk
+        self.attempts = int(attempts)
+        self.last_error = last_error
+
+
 class _ChunkFetchStats(_tmetrics.LedgerCore):
     """Process-wide chunk-fetch ledger: every ``_fetch_chunk`` attempt
     count lands here (the RetryPolicy returns how many attempts one fetch
@@ -64,23 +84,88 @@ CHUNK_STATS = _ChunkFetchStats()
 
 
 class StreamingReader:
-    """An iterator of micro-batches, each a list of records."""
+    """An iterator of micro-batches, each a list of records.
+
+    ``fetch_fn`` (optional) materializes each raw batch — a remote read,
+    a decode, a validation pass — behind the chunk ``RetryPolicy``: a
+    transient failure backs off and retries inside the fetch, and a
+    budget that runs dry raises the typed :class:`StreamExhausted`
+    (``stream_batches`` quarantines such a chunk — counted on
+    ``CHUNK_STATS`` — instead of killing the stream). Without
+    ``fetch_fn`` batches pass through untouched, exactly as before."""
+
+    #: chunk retry policy — None picks resilience.retry.default_io_policy
+    retry_policy = None
 
     def __init__(
         self,
         batches: Iterable[Sequence[Any]],
         key_fn: Callable[[Any], str] | None = None,
+        fetch_fn: Callable[[Sequence[Any]], Sequence[Any]] | None = None,
     ):
         self._batches = batches
         self.key_fn = key_fn
+        self.fetch_fn = fetch_fn
+
+    def is_unbounded(self) -> bool:
+        """Streaming sources declare no known size — ``Workflow.train``
+        auto-routes them through the out-of-core chunked fit
+        (workflow/stream.py) instead of materializing."""
+        return True
+
+    def _fetch_batch(self, index: int, batch: Sequence[Any]) -> Sequence[Any]:
+        """One chunk fetch behind the RetryPolicy + fault hooks; raises
+        ``StreamExhausted`` when transient retries run dry."""
+        from ..resilience import faults
+        from ..resilience.retry import default_io_policy, is_transient
+
+        chunk_name = f"chunk-{index}"
+
+        def fetch() -> Sequence[Any]:
+            plan = faults.active()
+            if plan is not None:
+                plan.on_stream_chunk(chunk_name)
+            return self.fetch_fn(batch) if self.fetch_fn else batch
+
+        policy = self.retry_policy or default_io_policy()
+        try:
+            records, attempts = policy.call(fetch)
+        except Exception as e:
+            attempts = getattr(e, "_retry_attempts", 1)
+            CHUNK_STATS.record_exhausted(attempts)
+            if is_transient(e):
+                raise StreamExhausted(chunk_name, attempts, e) from e
+            raise
+        CHUNK_STATS.record_fetch(attempts)
+        return records
+
+    def stream_batches(self) -> Iterator[Sequence[Any]]:
+        """Yield record batches in arrival order. With a ``fetch_fn``,
+        each batch rides the retry policy; an exhausted budget quarantines
+        that chunk (``streamChunkExhausted`` on the resilience ledger)
+        and the stream continues — bounded badness, never a dead train."""
+        for i, batch in enumerate(self._batches):
+            if not batch:
+                continue
+            if self.fetch_fn is None:
+                yield batch
+                continue
+            try:
+                records = self._fetch_batch(i, batch)
+            except StreamExhausted as e:
+                log.error(
+                    "stream chunk %s quarantined after %d attempts: %s",
+                    e.chunk, e.attempts, e.last_error,
+                )
+                continue
+            if records:
+                yield records
 
     def stream_datasets(
         self, raw_features: Sequence[Feature]
     ) -> Iterator[Dataset]:
         """Yield one columnar Dataset per micro-batch."""
-        for batch in self._batches:
-            if not batch:
-                continue
+        for batch in self.stream_batches():
             yield SimpleReader(batch, self.key_fn).generate_dataset(raw_features)
 
 
@@ -149,6 +234,8 @@ class FileStreamingReader(StreamingReader):
                 plan.on_stream_chunk(path)
             return self._read_file(path)
 
+        from ..resilience.retry import is_transient
+
         policy = self.retry_policy or default_io_policy()
         try:
             records, attempts = policy.call(fetch)
@@ -156,7 +243,13 @@ class FileStreamingReader(StreamingReader):
             # the policy attaches the burned attempt count to the final
             # exception — land it in the ledger before re-raising so an
             # exhausted retry budget is visible, not just a log line
-            CHUNK_STATS.record_exhausted(getattr(e, "_retry_attempts", 1))
+            attempts = getattr(e, "_retry_attempts", 1)
+            CHUNK_STATS.record_exhausted(attempts)
+            if is_transient(e):
+                # retries genuinely ran dry: surface the typed exception
+                # naming chunk + attempts + last error (still a
+                # TransientError, so the defer/drop path below is intact)
+                raise StreamExhausted(path, attempts, e) from e
             raise
         CHUNK_STATS.record_fetch(attempts)
         if attempts > 1:
@@ -279,6 +372,9 @@ class FileStreamingReader(StreamingReader):
             if polls >= self.max_polls:
                 return
             time.sleep(self.poll_interval_s)
+
+    def stream_batches(self) -> Iterator[Sequence[Any]]:
+        return self._batches_iter()
 
     def stream_datasets(
         self, raw_features: Sequence[Feature]

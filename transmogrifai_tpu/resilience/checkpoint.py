@@ -143,6 +143,10 @@ class CheckpointManager:
         for d in (self.layers_dir, self.cv_dir):
             shutil.rmtree(d, ignore_errors=True)
             os.makedirs(d, exist_ok=True)
+        try:
+            os.remove(self.stream_cursor_path())
+        except OSError:
+            pass
 
     # ---------------------------------------------------------- layer side
     def layer_path(self, index: int) -> str:
@@ -330,6 +334,44 @@ class CheckpointManager:
             # that the caller truncates and refits was never resharded
             self.reshard_events += 1
         return out
+
+    # ------------------------------------------------------- stream cursor
+    def stream_cursor_path(self) -> str:
+        return os.path.join(self.root, "stream_cursor.json")
+
+    def save_stream_cursor(self, payload: dict[str, Any]) -> None:
+        """Persist the out-of-core ingest cursor (workflow/stream.py):
+        chunks folded so far + the reducer/buffer state snapshot, written
+        atomically (temp + rename) like every other checkpoint member, so
+        a kill mid-write leaves the previous cursor intact and a resume
+        never stitches a torn one."""
+        path = self.stream_cursor_path()
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)
+
+    def load_stream_cursor(self, signature: str) -> dict[str, Any] | None:
+        """The last persisted stream cursor, or None when missing, torn,
+        or written for a different raw-feature schema / chunk source
+        (``signature`` mismatch — a changed pipeline must re-ingest from
+        chunk 0, not resume into the wrong reducer state)."""
+        path = self.stream_cursor_path()
+        try:
+            with open(path) as fh:
+                cur = json.load(fh)
+        except FileNotFoundError:
+            return None
+        except (OSError, json.JSONDecodeError) as e:
+            log.warning("stream cursor %s unusable (%s); re-ingesting", path, e)
+            return None
+        if cur.get("signature") != signature:
+            log.warning(
+                "stream cursor signature mismatch (%s != %s); re-ingesting",
+                cur.get("signature"), signature,
+            )
+            return None
+        return cur
 
     # ------------------------------------------------------------- CV side
     def candidate_path(self, key: str) -> str:

@@ -565,6 +565,11 @@ _ZERO_LEDGER = {
     "heartbeatsDropped": 0, "stragglersDetected": 0, "collectivesRetried": 0,
     "streamChunkFetches": 0, "streamChunkRetries": 0,
     "streamChunkAttempts": 0, "streamChunkExhausted": 0,
+    "streamChunksFolded": 0, "streamChunksTorn": 0,
+    "streamChunksCorrupt": 0, "streamChunksQuarantined": 0,
+    "streamOomEvents": 0, "streamWindowHalvings": 0,
+    "streamRowsFolded": 0, "streamCursorSaves": 0,
+    "streamResumes": 0, "streamChunksSkipped": 0,
 }
 
 
@@ -576,6 +581,18 @@ def _stream_chunk_counters() -> dict[str, int]:
         from ..readers.streaming import CHUNK_STATS
 
         return CHUNK_STATS.snapshot()
+    except Exception:
+        return {}
+
+
+def _stream_ingest_counters() -> dict[str, int]:
+    """The workflow/stream.py out-of-core ingest ledger (folded /
+    quarantined chunks, window halvings, cursor saves) — lazy for the
+    same cycle reason."""
+    try:
+        from ..workflow.stream import STREAM_STATS
+
+        return STREAM_STATS.snapshot()
     except Exception:
         return {}
 
@@ -592,6 +609,7 @@ def _resilience_source() -> dict[str, Any]:
         c = _LAST_BOUND()
     base = dict(_ZERO_LEDGER) if c is None else {**_ZERO_LEDGER, **c.summary()}
     base.update(_stream_chunk_counters())
+    base.update(_stream_ingest_counters())
     return base
 
 

@@ -35,6 +35,22 @@ class SimulatedCrash(BaseException):
     and other broad handlers cannot swallow it."""
 
 
+class TornChunkError(RuntimeError):
+    """A stream ingest chunk arrived torn (truncated mid-write) — the
+    out-of-core fit quarantines it instead of folding partial rows."""
+
+
+class CorruptChunkError(RuntimeError):
+    """A stream ingest chunk decoded to garbage — quarantined, never
+    folded into the streaming fit stats."""
+
+
+class MemoryPressure(RuntimeError):
+    """Seeded memory-pressure signal on a stream ingest chunk: the
+    out-of-core fit must degrade (halve its in-flight window) instead of
+    dying."""
+
+
 # ------------------------------------------------------------ replica scope
 # Replica-keyed faults (slow_stage(replica=...), partition_replica, ...)
 # need to know WHICH fleet replica is executing the current stage. The
@@ -107,6 +123,8 @@ class FaultPlan:
         self._retrain_fail_faults: list[dict[str, Any]] = []
         self._retrain_crash_faults: list[dict[str, Any]] = []
         self._retrain_chunk_faults: list[dict[str, Any]] = []
+        self._stream_fold_faults: list[dict[str, Any]] = []
+        self._stream_crash_faults: list[dict[str, Any]] = []
         # >0 while a RetrainController drives a warm-start fit: retrain-
         # scoped layer faults only fire inside this window, so a plan can
         # script "the RETRAIN crashes" without touching the initial train
@@ -309,6 +327,51 @@ class FaultPlan:
         exercises the chunk-level RetryPolicy."""
         self._chunk_faults.append(
             {"times": times, "count": 0, "transient": transient}
+        )
+        return self
+
+    def tear_stream_chunk(
+        self, chunk_index: int | None = None, times: int = 1
+    ) -> "FaultPlan":
+        """Tear the ``chunk_index``-th (0-based) stream ingest chunk at
+        fold time — the out-of-core fit must quarantine it (counted,
+        never folded). ``None`` tears the next ``times`` chunks folded."""
+        self._stream_fold_faults.append(
+            {"kind": "torn", "chunk": chunk_index, "times": times, "count": 0}
+        )
+        return self
+
+    def corrupt_chunk(
+        self, chunk_index: int | None = None, times: int = 1
+    ) -> "FaultPlan":
+        """Corrupt the ``chunk_index``-th (0-based) stream ingest chunk at
+        fold time — quarantined like a torn chunk, counted separately."""
+        self._stream_fold_faults.append(
+            {"kind": "corrupt", "chunk": chunk_index, "times": times,
+             "count": 0}
+        )
+        return self
+
+    def oom_chunk(
+        self, chunk_index: int | None = None, times: int = 1
+    ) -> "FaultPlan":
+        """Signal memory pressure while folding the ``chunk_index``-th
+        (0-based) stream ingest chunk — the out-of-core fit must halve its
+        in-flight window and keep going, not die."""
+        self._stream_fold_faults.append(
+            {"kind": "oom", "chunk": chunk_index, "times": times, "count": 0}
+        )
+        return self
+
+    def crash_after_chunk(
+        self, chunk_index: int, times: int = 1
+    ) -> "FaultPlan":
+        """Raise ``SimulatedCrash`` after stream ingest chunk
+        ``chunk_index`` (0-based) was folded AND its stream cursor was
+        persisted — the mid-ingest kill whose resume must cost < 1 chunk
+        of rework."""
+        self._stream_crash_faults.append(
+            {"chunk": chunk_index, "times": times, "count": 0}
         )
         return self
 
@@ -774,6 +837,52 @@ class FaultPlan:
                 self.fired.append(("chunk", path))
                 exc = TransientError if f["transient"] else FatalError
                 raise exc(f"injected chunk-read failure on {path}")
+
+    def on_stream_fold(self, chunk_index: int) -> None:
+        """Stream ingest fold hook (workflow/stream.py), consulted before
+        chunk ``chunk_index`` (0-based) is folded into the streaming fit
+        stats: armed ``tear_stream_chunk`` / ``corrupt_chunk`` faults
+        raise the typed quarantine errors, ``oom_chunk`` raises
+        ``MemoryPressure`` (the engine halves its window and folds the
+        chunk anyway)."""
+        with self._lock:
+            for f in self._stream_fold_faults:
+                if f["count"] >= f["times"]:
+                    continue
+                if f["chunk"] is not None and f["chunk"] != chunk_index:
+                    continue
+                f["count"] += 1
+                kind = f["kind"]
+                self.fired.append(
+                    (f"stream_{kind}", f"chunk-{chunk_index}")
+                )
+                if kind == "torn":
+                    raise TornChunkError(
+                        f"injected torn stream chunk {chunk_index}"
+                    )
+                if kind == "corrupt":
+                    raise CorruptChunkError(
+                        f"injected corrupt stream chunk {chunk_index}"
+                    )
+                raise MemoryPressure(
+                    f"injected memory pressure on stream chunk {chunk_index}"
+                )
+
+    def on_stream_chunk_end(self, chunk_index: int) -> None:
+        """Fires after chunk ``chunk_index`` was folded and its stream
+        cursor persisted — ``crash_after_chunk`` raises here, so a resume
+        restores everything up to and including this chunk."""
+        with self._lock:
+            for f in self._stream_crash_faults:
+                if f["count"] >= f["times"] or f["chunk"] != chunk_index:
+                    continue
+                f["count"] += 1
+                self.fired.append(
+                    ("stream_crash", f"chunk-{chunk_index}")
+                )
+                raise SimulatedCrash(
+                    f"injected crash after stream chunk {chunk_index}"
+                )
 
     def on_stage_output(self, stage: Any, column: Any) -> Any | None:
         """Return a corrupted replacement column, or None to keep the

@@ -219,6 +219,28 @@ def poll_device_memory() -> dict[str, Any]:
     return out
 
 
+def poll_host_rss() -> int:
+    """Current host resident-set size in bytes (the out-of-core ingest's
+    bounded-memory evidence rides this gauge per chunk). Reads
+    ``/proc/self/status`` VmRSS; falls back to ``resource.getrusage``
+    (peak, in KiB on Linux) where /proc is unavailable. Never raises —
+    a broken poll reports 0."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except Exception:
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception as e:
+        log.debug("host rss poll failed: %s", e)
+        return 0
+
+
 # ------------------------------------------------------------------------ ETA
 class EtaEstimator:
     """Seconds-per-unit EWMA → remaining-time estimate. With a constant
@@ -298,7 +320,15 @@ class RunRecorder:
             "deviceBytesInUse": 0,
             "devicePeakBytes": 0,
             "liveArrayBytes": 0,
+            "hostRssBytes": 0,
         }
+        #: per-ingest-chunk memory samples (out-of-core fit) — bounded:
+        #: past _CHUNK_SERIES_CAP the series decimates by doubling the
+        #: sampling stride, so a million-chunk ingest still reports a
+        #: few hundred points
+        self._chunk_mem: list[dict[str, Any]] = []
+        self._chunk_stride = 1
+        self.stream: dict[str, Any] | None = None
         self._run_before: dict | None = None
         self._compile_before: dict | None = None
         self._featurize_before: dict | None = None
@@ -330,20 +360,64 @@ class RunRecorder:
         self.poll_memory()
         return self
 
-    def poll_memory(self) -> None:
-        """Fold one device-memory poll into the run's high-water marks."""
+    def poll_memory(self) -> dict[str, Any] | None:
+        """Fold one device-memory + host-RSS poll into the run's
+        high-water marks; returns the point-in-time sample."""
         try:
             now = poll_device_memory()
+            now["hostRssBytes"] = poll_host_rss()
             with self._lock:
                 self._mem_polls += 1
                 if now["backend"] != "unknown":
                     self._mem_high["backend"] = now["backend"]
                 for k in (
-                    "deviceBytesInUse", "devicePeakBytes", "liveArrayBytes",
+                    "deviceBytesInUse", "devicePeakBytes",
+                    "liveArrayBytes", "hostRssBytes",
                 ):
                     self._mem_high[k] = max(self._mem_high[k], now[k])
+            return now
         except Exception as e:
             log.debug("run recorder memory poll failed: %s", e)
+            return None
+
+    _CHUNK_SERIES_CAP = 512
+
+    def poll_chunk_memory(self, chunk_index: int) -> None:
+        """One memory sample per ingest CHUNK (not just per phase/layer):
+        the per-chunk series is the flatness evidence for the out-of-core
+        fit — high-water must not grow with chunk count. Bounded: when
+        the series hits the cap it decimates (keep every 2nd point,
+        double the stride), so memory for the memory log stays O(cap)."""
+        try:
+            with self._lock:
+                stride = self._chunk_stride
+            if chunk_index % stride:
+                return
+            now = self.poll_memory()
+            if now is None:
+                return
+            with self._lock:
+                self._chunk_mem.append({
+                    "chunk": int(chunk_index),
+                    "deviceBytesInUse": now["deviceBytesInUse"],
+                    "liveArrayBytes": now["liveArrayBytes"],
+                    "hostRssBytes": now["hostRssBytes"],
+                })
+                if len(self._chunk_mem) >= self._CHUNK_SERIES_CAP:
+                    self._chunk_mem = self._chunk_mem[::2]
+                    self._chunk_stride *= 2
+        except Exception as e:
+            log.debug("run recorder chunk memory poll failed: %s", e)
+
+    def set_stream_summary(self, summary: dict[str, Any]) -> None:
+        """Attach the out-of-core ingest summary (workflow/stream.py) —
+        chunk/quarantine/window accounting, minus the bulky fitStats."""
+        try:
+            self.stream = {
+                k: v for k, v in summary.items() if k != "fitStats"
+            }
+        except Exception as e:
+            log.debug("run recorder stream summary failed: %s", e)
 
     def _emit_progress(self, event: dict[str, Any]) -> None:
         if self.progress is None:
@@ -619,6 +693,9 @@ def build_report(
     mem["highWaterBytes"] = max(
         mem["deviceBytesInUse"], mem["devicePeakBytes"]
     )
+    if rec._chunk_mem:
+        mem["chunkSeries"] = list(rec._chunk_mem)
+        mem["chunkSeriesStride"] = rec._chunk_stride
     metrics: dict[str, Any] = {
         "wall_s": round(wall, 4),
         "train_rows": rec.train_rows,
@@ -639,7 +716,14 @@ def build_report(
         "d2h_bytes": census["deviceToHost"]["bytes"],
         "device_high_water_bytes": mem["highWaterBytes"],
         "live_array_high_water_bytes": mem["liveArrayBytes"],
+        "host_rss_high_water_bytes": mem["hostRssBytes"],
     }
+    if rec.stream is not None:
+        metrics["stream_chunks_folded"] = rec.stream.get("chunksFolded", 0)
+        metrics["stream_chunks_quarantined"] = rec.stream.get(
+            "quarantinedTotal", 0
+        )
+        metrics["stream_rows_seen"] = rec.stream.get("rowsSeen", 0)
     for name, cell in rec.phases.items():
         metrics[f"phase_{name}_s"] = cell["seconds"]
     if rec.quality:
@@ -675,6 +759,9 @@ def build_report(
             "transferCensus": census,
             "deviceMemory": mem,
             "quality": rec.quality,
+            # out-of-core ingest accounting — only when train streamed
+            # (additive: validate_run_report checks it when present)
+            **({"stream": rec.stream} if rec.stream is not None else {}),
         },
     }
 
@@ -802,6 +889,15 @@ def validate_run_report(doc: Any) -> list[str]:
                 for k in ("count", "bytes", "seconds")
             ):
                 problems.append(f"run.transferCensus.{side} invalid")
+    # out-of-core ingest block: additive, validated WHEN PRESENT
+    stream = run.get("stream")
+    if stream is not None:
+        if not isinstance(stream, dict):
+            problems.append("run.stream not a map")
+        else:
+            for key in ("chunksFolded", "rowsSeen", "quarantinedTotal"):
+                if not isinstance(stream.get(key), int):
+                    problems.append(f"run.stream.{key} missing or invalid")
     return problems
 
 

@@ -225,6 +225,7 @@ class Workflow:
         on_mesh_mismatch: str = "reshard",
         progress: Any = None,
         run_dir: str | None = None,
+        stream: bool | None = None,
     ) -> "WorkflowModel":
         """Fit the DAG. With ``checkpoint_dir``, every completed layer (and
         every finished CV candidate sweep) is persisted atomically there;
@@ -254,7 +255,18 @@ class Workflow:
         persists the report as a ``RUN_*.json`` artifact and auto-diffs
         it against the directory's latest run, warning on TPR-coded
         regressions (``python -m transmogrifai_tpu runs --diff`` compares
-        any two)."""
+        any two).
+
+        ``stream=True`` (or automatically when the reader declares
+        ``is_unbounded()``) routes ingest through the out-of-core chunked
+        fit (workflow/stream.py): fit-time stats fold through streaming
+        monoid aggregation chunk by chunk, the featurize pool pipelines
+        chunk k+1 while chunk k reduces under a bounded in-flight window
+        (``TPTPU_STREAM_INFLIGHT``), torn/corrupt chunks quarantine
+        instead of folding, and with ``checkpoint_dir`` a per-chunk
+        stream cursor makes a mid-ingest crash resume with < 1 chunk of
+        rework. ``stream=False`` forces full materialization even for an
+        unbounded reader. See docs/robustness.md "Out-of-core fit"."""
         if not self.result_features:
             raise ValueError("setResultFeatures must be called before train")
         if self.reader is None:
@@ -303,10 +315,52 @@ class Workflow:
         selector = selectors[0] if selectors else None
 
         raw_features = raw_features_of(self.result_features)
-        with recorder.phase("ingest"):
-            with _tspans.span("train/ingest", features=len(raw_features)):
-                raw = self.reader.generate_dataset(raw_features)
-        recorder.set_phase_rows("ingest", raw.num_rows)
+        use_stream = (
+            stream if stream is not None else self.reader.is_unbounded()
+        )
+        ckpt = None
+        stream_summary = None
+        if use_stream:
+            if not hasattr(self.reader, "stream_batches"):
+                raise ValueError(
+                    "stream=True requires a chunked reader exposing "
+                    "stream_batches() (readers/streaming.py); "
+                    f"{type(self.reader).__name__} does not"
+                )
+            if checkpoint_dir is not None:
+                # created BEFORE ingest: the stream cursor persists per
+                # chunk so a mid-ingest crash resumes instead of
+                # re-ingesting; a fresh train wipes stale state once here
+                from ..resilience.checkpoint import CheckpointManager
+
+                ckpt = CheckpointManager(checkpoint_dir)
+                if not resume:
+                    ckpt.clear()
+            from .stream import stream_ingest
+
+            with recorder.phase("ingest"):
+                with _tspans.span(
+                    "train/ingest", features=len(raw_features), stream=1
+                ):
+                    raw, stream_summary = stream_ingest(
+                        self.reader, raw_features,
+                        recorder=recorder, checkpoint=ckpt, resume=resume,
+                    )
+            recorder.set_phase_rows("ingest", stream_summary["rowsSeen"])
+            recorder.set_stream_summary(stream_summary)
+            log.info(
+                "Streamed raw data: %d rows over %d chunks "
+                "(%d quarantined), %d buffered for fit",
+                stream_summary["rowsSeen"], stream_summary["chunksDone"],
+                stream_summary["quarantinedTotal"], raw.num_rows,
+            )
+        else:
+            with recorder.phase("ingest"):
+                with _tspans.span(
+                    "train/ingest", features=len(raw_features)
+                ):
+                    raw = self.reader.generate_dataset(raw_features)
+            recorder.set_phase_rows("ingest", raw.num_rows)
         if raw.num_rows == 0:
             raise ValueError("Input dataset cannot be empty")
         log.info("Generated raw data: %d rows, %d features", raw.num_rows, len(raw_features))
@@ -355,7 +409,6 @@ class Workflow:
 
         # checkpoint/resume (resilience/): completed layers restore into the
         # prefitted warm-start dict; the selector checkpoints CV candidates
-        ckpt = None
         signature = None
         dag_layers = None
         base_prefitted = dict(self._prefitted)
@@ -366,12 +419,14 @@ class Workflow:
                 dataset_fingerprint,
             )
 
-            ckpt = CheckpointManager(checkpoint_dir)
+            fresh_ckpt = ckpt is None  # stream mode created + cleared it
+            if fresh_ckpt:
+                ckpt = CheckpointManager(checkpoint_dir)
             dag_layers = compute_dag(self.result_features)
             signature = dag_signature(
                 dag_layers, dataset_fingerprint(train_data)
             )
-            if not resume:
+            if fresh_ckpt and not resume:
                 # fresh train: stale entries from a previous run in the
                 # same dir must never mix into a later crash + resume
                 ckpt.clear()
@@ -508,6 +563,14 @@ class Workflow:
                 sel_stage.summary["featurizeStats"] = _fstats.delta(
                     featurize_baseline
                 )
+                if stream_summary is not None:
+                    # the reduced fit stats are large (per-field exact
+                    # partials); the selector summary carries the chunk /
+                    # quarantine / window accounting only
+                    sel_stage.summary["streamIngest"] = {
+                        k: v for k, v in stream_summary.items()
+                        if k != "fitStats"
+                    }
 
         holdout_metrics = None
         if selector is not None and holdout_data is not None:
