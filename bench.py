@@ -225,6 +225,31 @@ def validate_bench_report(doc) -> list[str]:
                 fit_stream.get("highWaterRatio"), (int, float)
             ):
                 problems.append("fitStream missing numeric 'highWaterRatio'")
+    # additive envelope: the sharded-sweep scaling stamp (r07 multichip)
+    # is validated WHEN PRESENT — artifacts predating it stay valid forever
+    sweep = doc.get("sweepScaling") if isinstance(doc, dict) else None
+    if sweep is not None:
+        if not isinstance(sweep, dict):
+            problems.append("sweepScaling is not an object")
+        else:
+            if not isinstance(sweep.get("nearLinear"), bool):
+                problems.append("sweepScaling missing boolean 'nearLinear'")
+            if not isinstance(sweep.get("scalingX"), (int, float)):
+                problems.append("sweepScaling missing numeric 'scalingX'")
+            if not isinstance(sweep.get("curve"), list) or not sweep.get(
+                "curve"
+            ):
+                problems.append("sweepScaling missing non-empty 'curve'")
+            else:
+                for pt in sweep["curve"]:
+                    if not isinstance(pt, dict) or not isinstance(
+                        pt.get("goodputLanesPerSec"), (int, float)
+                    ):
+                        problems.append(
+                            "sweepScaling curve point missing numeric "
+                            "'goodputLanesPerSec'"
+                        )
+                        break
     return problems
 
 
@@ -552,8 +577,136 @@ def _multichip_child(sim_hosts: int) -> None:
     )
 
 
+def _multichip_sweep_child(lanes: int, with_cv: bool = False) -> None:
+    """The sharded-sweep scaling probe (run in a SUBPROCESS per forced
+    device count): time the pjit'd GLM lane sweep over the full mesh and
+    the single-partition critical path (one device's ``bucket/N`` lanes),
+    then emit one machine-readable line for the parent's goodput curve.
+
+    On a forced-CPU mesh every "device" shares one host core, so the
+    full-mesh wall *serializes* the partitions — it measures correctness,
+    not speedup. The goodput estimate therefore uses the per-partition
+    critical path (lanes are embarrassingly parallel across the model
+    axis; a real N-chip mesh runs the partitions concurrently), which is
+    a strong-scaling estimate and is labeled as such in the artifact.
+
+    With ``with_cv`` it also runs a miniature 2-fold workflow CV through
+    the real pipelined fold loop (workflow/cv.py) under a flight
+    recorder, so the artifact carries fold-level lane occupancy and
+    pad-waste straight from the run ledger."""
+    import json
+
+    import numpy as np
+
+    import jax
+    from transmogrifai_tpu.compiler import bucketing
+    from transmogrifai_tpu.models.solvers import fit_logistic_binary_batched
+    from transmogrifai_tpu.parallel.fit import sweep_parallel_fit
+    from transmogrifai_tpu.parallel.mesh import make_mesh, use_execution_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh(n_data=1, n_model=n)
+    bucket = bucketing.mesh_lane_bucket(lanes, n)
+    rng = np.random.default_rng(11)
+    rows, dim = 8192, 32
+    x = rng.normal(size=(rows, dim)).astype(np.float32)
+    w = rng.normal(size=dim)
+    y = (x @ w > 0).astype(np.float32)
+    regs = np.linspace(0.001, 0.3, lanes).astype(np.float32)
+    ens = np.zeros(lanes, dtype=np.float32)
+    mask = np.ones((lanes, rows), dtype=np.float32)
+    statics = dict(num_iters=300, fit_intercept=True, standardization=True)
+
+    def sharded():
+        return sweep_parallel_fit(
+            fit_logistic_binary_batched, "bench_sweep_logistic", mesh,
+            x, y, mask, regs, ens, **statics,
+        )
+
+    jax.block_until_ready(sharded())  # compile + bank warm-up
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(sharded())
+        walls.append(time.perf_counter() - t0)
+    sweep_wall = sorted(walls)[1]
+
+    # single-partition critical path: the bucket/N lanes one device owns,
+    # run as a plain single-device program (mesh_lane_bucket guarantees
+    # the bucket divides evenly)
+    kpart = bucket // n
+    pregs = np.linspace(0.001, 0.3, kpart).astype(np.float32)
+    pens = np.zeros(kpart, dtype=np.float32)
+    pmask = np.ones((kpart, rows), dtype=np.float32)
+
+    def partition():
+        return fit_logistic_binary_batched(
+            x, y, pmask, pregs, pens, **statics
+        )
+
+    jax.block_until_ready(partition())
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(partition())
+        walls.append(time.perf_counter() - t0)
+    part_wall = sorted(walls)[1]
+
+    fold_records = None
+    if with_cv:
+        import transmogrifai_tpu.types as T
+        from transmogrifai_tpu.dataset import Dataset
+        from transmogrifai_tpu.features import from_dataset
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector,
+        )
+        from transmogrifai_tpu.telemetry import runlog
+        from transmogrifai_tpu.types.columns import column_from_values
+        from transmogrifai_tpu.workflow.cv import workflow_cv_results
+
+        nrows = 240
+        x1 = rng.normal(size=nrows)
+        x2 = rng.normal(size=nrows)
+        label = (
+            x1 + 0.5 * x2 + 0.3 * rng.normal(size=nrows) > 0
+        ).astype(float)
+        ds = Dataset.of({
+            "label": column_from_values(T.RealNN, label),
+            "x1": column_from_values(T.Real, x1),
+            "x2": column_from_values(T.Real, x2),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        selector = BinaryClassificationModelSelector(
+            models=[(
+                LogisticRegression(),
+                {"reg_param": [float(v) for v in np.linspace(0.0, 0.3, 8)]},
+            )],
+            num_folds=2, seed=3,
+        )
+        selector.set_input(resp, vec)
+        rec = runlog.RunRecorder()
+        with runlog.recording(rec), use_execution_mesh(mesh):
+            workflow_cv_results(selector, ds)
+        fold_records = rec.folds
+
+    print("MULTICHIP_SWEEP_JSON: " + json.dumps({
+        "devices": n,
+        "lanes": lanes,
+        "bucket": bucket,
+        "padLanes": bucket - lanes,
+        "sweepWallMs": round(sweep_wall * 1e3, 3),
+        "partitionWallMs": round(part_wall * 1e3, 3),
+        "goodputLanesPerSec": round(lanes / part_wall, 2),
+        "folds": fold_records,
+    }))
+
+
 def bench_multichip(
-    devices: int = 8, sim_hosts: int = 4, full: bool = False
+    devices: int = 8, sim_hosts: int = 4, full: bool = False,
+    sweep_devices: tuple = (1, 2, 4, 8), sweep_lanes: int = 64,
 ) -> dict:
     """The ``multichip`` mode: run the traced collective exercise (and,
     with ``--full``, the whole ``dryrun_multichip`` parity train when
@@ -626,10 +779,66 @@ def bench_multichip(
         )
     except (OSError, ValueError, KeyError) as e:
         tail += f"\ntape load/reconcile failed: {e}"
+
+    # ---- the sharded-sweep scaling curve (one subprocess per forced
+    # device count; the collective-trace env is dropped so these runs
+    # can't clobber the exercise child's tapes)
+    import json as _json
+
+    curve: list = []
+    fold_records = None
+    sweep_rc = 0
+    max_nd = max(sweep_devices) if sweep_devices else 0
+    for nd in sweep_devices:
+        envn = dict(os.environ)
+        envn.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={nd}"
+            ).strip(),
+        })
+        envn.pop(G.TRACE_ENV, None)
+        envn.pop(G.TRACE_OUT_ENV, None)
+        cmdn = [
+            sys.executable, os.path.abspath(__file__),
+            "multichip-sweep-child", "--lanes", str(sweep_lanes),
+        ] + (["--cv"] if nd == max_nd else [])
+        pn = subprocess.run(
+            cmdn, capture_output=True, text=True, timeout=1800, env=envn,
+            cwd=here,
+        )
+        sweep_rc = sweep_rc or pn.returncode
+        marker = [
+            ln for ln in pn.stdout.splitlines()
+            if ln.startswith("MULTICHIP_SWEEP_JSON: ")
+        ]
+        if pn.returncode != 0 or not marker:
+            tail += (
+                f"\nsweep child ({nd} devices) failed:\n"
+                + (pn.stdout + pn.stderr)[-1000:]
+            )
+            continue
+        point = _json.loads(marker[-1].split(": ", 1)[1])
+        fold_records = point.pop("folds", None) or fold_records
+        curve.append(point)
+
+    by_devices = {c["devices"]: c for c in curve}
+    g1 = (by_devices.get(1) or {}).get("goodputLanesPerSec")
+    gN = (by_devices.get(max_nd) or {}).get("goodputLanesPerSec")
+    scaling_x = round(gN / g1, 2) if g1 and gN else None
+    # near-linear bar: ≥ 60% of ideal — per-lane GEMMs shrink with the
+    # partition, so perfect scaling is unreachable even in the estimate
+    near_linear = (
+        scaling_x is not None and max_nd > 1 and scaling_x >= 0.6 * max_nd
+    )
     return {
         "n_devices": devices,
         "rc": rc,
-        "ok": rc == 0 and tapes_agree and explained and not tps_codes,
+        "ok": (
+            rc == 0 and sweep_rc == 0 and tapes_agree and explained
+            and not tps_codes and near_linear
+        ),
         "skipped": False,
         "tail": tail,
         "collectiveAudit": {
@@ -639,6 +848,21 @@ def bench_multichip(
             "tapesExplained": explained,
             "simHosts": sim_hosts,
             "reconciliation": reconciliation,
+        },
+        "sweepScaling": {
+            "deviceCounts": list(sweep_devices),
+            "lanes": sweep_lanes,
+            "curve": curve,
+            "scalingX": scaling_x,
+            "nearLinear": near_linear,
+            "method": (
+                "per-partition critical path: each forced-CPU device "
+                "shares one host core, so goodput is lanes over the "
+                "single-partition (bucket/N lanes) wall — a "
+                "strong-scaling estimate; sweepWallMs is the measured "
+                "full-mesh wall (partitions serialized on one core)"
+            ),
+            "folds": fold_records,
         },
     }
 
@@ -2243,6 +2467,16 @@ def _build_parser():
              "(needs the reference test data)",
     )
     mc.add_argument(
+        "--sweep-devices", type=int, action="append", default=None,
+        metavar="N",
+        help="forced device counts for the sharded-sweep scaling curve "
+             "(repeatable; default 1 2 4 8)",
+    )
+    mc.add_argument(
+        "--lanes", type=int, default=64,
+        help="candidate lanes in the scaling sweep (default 64)",
+    )
+    mc.add_argument(
         "--out", default=None, metavar="PATH",
         help="also write the JSON artifact to PATH (MULTICHIP_rXX.json)",
     )
@@ -2252,6 +2486,17 @@ def _build_parser():
              "multichip runs in a subprocess",
     )
     mcc.add_argument("--sim-hosts", type=int, default=4)
+    msc = sub.add_parser(
+        "multichip-sweep-child",
+        help="(internal) the sharded-sweep scaling probe bench.py "
+             "multichip runs per forced device count",
+    )
+    msc.add_argument("--lanes", type=int, default=64)
+    msc.add_argument(
+        "--cv", action="store_true",
+        help="also run the miniature recorded workflow CV for the "
+             "fold-level lane occupancy block",
+    )
     vr = sub.add_parser(
         "validate-reports",
         help=(
@@ -2474,12 +2719,17 @@ def _dispatch(ns) -> None:
         return
     if mode == "multichip":
         doc = bench_multichip(
-            devices=ns.devices, sim_hosts=ns.sim_hosts, full=ns.full
+            devices=ns.devices, sim_hosts=ns.sim_hosts, full=ns.full,
+            sweep_devices=tuple(ns.sweep_devices or (1, 2, 4, 8)),
+            sweep_lanes=ns.lanes,
         )
         dump_bench_report(doc, ns.out, echo=True)
         raise SystemExit(0 if doc["ok"] else 1)
     if mode == "multichip-child":
         _multichip_child(ns.sim_hosts)
+        return
+    if mode == "multichip-sweep-child":
+        _multichip_sweep_child(ns.lanes, with_cv=ns.cv)
         return
     if mode == "validate-reports":
         bad = validate_reports(ns.root)

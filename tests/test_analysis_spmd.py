@@ -407,6 +407,7 @@ def test_collective_census_traces_all_kernels_under_30s():
         "pstats_pass1", "pstats_pass2", "pgram_sums", "pgram_centered",
         "pxtx", "phistogram", "pcontingency", "global_stats_pass1",
         "global_stats_pass2", "ring_gram", "psegment_sum", "psegment_max",
+        "sweep_linear_sharded", "sweep_logistic_binary_sharded",
     }
     assert expected <= set(census), sorted(census)
 
@@ -424,8 +425,19 @@ def test_collective_census_traces_all_kernels_under_30s():
     # the DCN kernels reduce over BOTH host and chip axes
     assert census["global_stats_pass1"]["collectives"][0]["axes"] == \
         "dcn,data"
-    # every declared program's HLO reconciled (no TPS006 above)
-    assert all(v["hloKinds"] for v in census.values())
+    # the sharded sweep programs are lane-parallel by construction: every
+    # lane owns its whole fit, so the pre-partition IR carries NO
+    # collectives — an all_reduce appearing here would mean the layout
+    # resharded behind our backs (TPS006)
+    assert prims("sweep_linear_sharded") == set()
+    assert census["sweep_linear_sharded"]["hloKinds"] == []
+    assert prims("sweep_logistic_binary_sharded") == set()
+    assert census["sweep_logistic_binary_sharded"]["hloKinds"] == []
+    # every declared program's HLO reconciled (no TPS006 above); programs
+    # with no declared collectives reconcile to an empty kind set
+    assert all(
+        v["hloKinds"] or not v["collectives"] for v in census.values()
+    )
 
 
 def test_tps006_hidden_hlo_collective_positive():

@@ -95,6 +95,10 @@ SPEC_MODULES = (
     "transmogrifai_tpu.parallel.multihost",
     "transmogrifai_tpu.parallel.ring",
     "transmogrifai_tpu.parallel.segments",
+    # the sharded CV candidate sweep (explicit SweepLayout PartitionSpecs
+    # + fold-level donation): registered so the TPJ bank gate audits the
+    # pjit'd sweep programs and the TPS census proves no hidden reshard
+    "transmogrifai_tpu.parallel.sweep",
 )
 
 #: source trees the tracing-hazard AST lint (TPJ007-009) covers

@@ -45,18 +45,39 @@ def lane_bucket(k: int) -> int:
     return -(-k // _STEP) * _STEP
 
 
-def bucket_sweep_lanes(*arrays: np.ndarray) -> tuple[int, tuple]:
+def mesh_lane_bucket(k: int, multiple: int = 1) -> int:
+    """Smallest lane bucket >= k that ``multiple`` divides evenly — the
+    sharded sweep's variant of :func:`lane_bucket`: lanes shard over the
+    mesh's model axis, so the padded lane count must split into equal
+    per-device blocks. With padding disabled the bucket degrades to the
+    plain ceiling multiple (divisibility is a correctness requirement of
+    the sharded dispatch, not an optimization)."""
+    multiple = max(1, int(multiple))
+    b = max(lane_bucket(k), multiple)
+    while b % multiple:
+        nb = lane_bucket(b + 1)
+        b = nb if nb > b else b + 1
+    return b
+
+
+def bucket_sweep_lanes(
+    *arrays: np.ndarray, multiple: int = 1
+) -> tuple[int, tuple]:
     """The whole per-sweep sequence in one place (shared by the logistic
     and linear batched-masks sweeps, so the pad/record semantics cannot
-    drift between them): bucket the lane count of axis 0, pad every array
-    onto it by replicating lane 0, and record (lanes, padded) in the
-    compileStats ledger. Returns ``(k, padded_arrays)`` — callers slice
-    program outputs back with ``[:k]``."""
+    drift between them): bucket the lane count of axis 0 (rounded up to
+    ``multiple`` when the lanes shard over a model axis of that size),
+    pad every array onto it by replicating lane 0, and record
+    (lanes, padded) in the compileStats ledger. Returns
+    ``(k, padded_arrays)`` — callers slice program outputs back with
+    ``[:k]``."""
     from . import stats
 
     arrays = tuple(np.asarray(a) for a in arrays)
     k = arrays[0].shape[0]
-    bucket = lane_bucket(k)
+    bucket = (
+        mesh_lane_bucket(k, multiple) if multiple > 1 else lane_bucket(k)
+    )
     stats.stats().record_sweep(lanes=k, padded=max(0, bucket - k))
     return k, pad_lane_arrays(bucket, *arrays)
 

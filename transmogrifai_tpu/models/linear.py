@@ -91,16 +91,27 @@ class LinearRegression(PredictorEstimator):
         ("reg_param", "elastic_net_param", "fit_intercept", "max_iter")
     )
 
-    def fit_arrays_batched_masks(self, x, y, masks, grid_points):
-        """Folds x grid in as few programs as the grid's static params
-        allow (validators._sweep_family hook; the sequential path paid a
-        tunnel dispatch per fold x point for microseconds of FLOPs).
+    #: GLM lanes pad onto shape buckets and shard over the mesh's model
+    #: axis; the pipelined fold schedule (workflow/cv.py) overlaps tree
+    #: fits with these dispatches
+    lane_family = "glm"
+
+    def sweep_dispatch_masks(self, x, y, masks, grid_points):
+        """Dispatch the folds × grid sweep, return a collector closure.
+
         Same-(fit_intercept, max_iter) groups batch (fold-mask, reg,
         elastic-net) triples onto the fit axis of fit_linear_batched;
-        points with unknown params fall back to sequential fits. Lane
-        counts pad onto shape buckets (compiler.bucketing) so near-miss
-        sweeps share one banked executable."""
+        points with unknown params fall back to sequential fits (inside
+        the collector). Under an active execution mesh the lanes route
+        through the pjit'd SweepLayout path (parallel/fit.py) — explicit
+        PartitionSpecs, donated fold buffers; otherwise lane counts pad
+        onto shape buckets (compiler.bucketing) so near-miss sweeps share
+        one banked executable. Device work is async after dispatch —
+        calling the closure materializes the models, so tree-family fits
+        can run in between (the pipelined lane schedule in
+        workflow/cv.py)."""
         from ..compiler import bucketing, dispatch
+        from ..parallel.mesh import execution_mesh
         from ..utils.aot import aot_call
         from .base import group_grid_by_statics
         from .solvers import fit_linear_batched
@@ -114,9 +125,10 @@ class LinearRegression(PredictorEstimator):
                 int(p.get("max_iter", self.max_iter)),
             ),
         )
-        models: list[list] = [[None] * len(grid_points) for _ in masks]
         import jax.numpy as jnp
 
+        mesh = execution_mesh()
+        stacked_groups: list[tuple[list[int], int, object]] = []
         for (fit_intercept, max_iter), idxs in groups.items():
             pts = [grid_points[i] for i in idxs] * n_masks
             regs = np.asarray(
@@ -129,26 +141,68 @@ class LinearRegression(PredictorEstimator):
                 dtype=np.float32,
             )
             rm = np.repeat(np.stack(masks), len(idxs), axis=0)  # mask-major
-            k, (rm, regs, ens) = bucketing.bucket_sweep_lanes(rm, regs, ens)
-            stacked = aot_call(
-                "linear_batched", fit_linear_batched,
-                (
-                    dispatch.device_f32(x),
-                    jnp.asarray(y, dtype=jnp.float32),
-                    jnp.asarray(rm), jnp.asarray(regs), jnp.asarray(ens),
-                ),
-                dict(num_iters=max(max_iter * 4, 200),
-                     fit_intercept=fit_intercept),
+            statics = dict(
+                num_iters=max(max_iter * 4, 200),
+                fit_intercept=fit_intercept,
             )
-            w = np.asarray(stacked.weights)[:k]
-            b = np.asarray(stacked.intercept)[:k]
-            for mi in range(n_masks):
-                for j, i in enumerate(idxs):
-                    models[mi][i] = LinearRegressionModel(
-                        w[mi * len(idxs) + j], b[mi * len(idxs) + j]
-                    )
-        for i in sequential:
-            est = self.with_params(**grid_points[i])
-            for mi, m in enumerate(masks):
-                models[mi][i] = est.fit_arrays(x, y, m)
-        return models
+            if mesh is not None:
+                from ..parallel.fit import sweep_parallel_fit
+
+                k = rm.shape[0]
+                stacked = sweep_parallel_fit(
+                    fit_linear_batched, "sweep_linear_sharded", mesh,
+                    x, y, rm, regs, ens, **statics,
+                )
+            else:
+                k, (rm, regs, ens) = bucketing.bucket_sweep_lanes(
+                    rm, regs, ens
+                )
+                fit_fn = dispatch.donating(
+                    "linear_batched", fit_linear_batched,
+                    donate_argnums=(3, 4),
+                    static_argnames=("num_iters", "fit_intercept"),
+                )
+                stacked = aot_call(
+                    "linear_batched", fit_fn,
+                    (
+                        dispatch.device_f32(x),
+                        jnp.asarray(y, dtype=jnp.float32),
+                        jnp.asarray(rm), jnp.asarray(regs),
+                        jnp.asarray(ens),
+                    ),
+                    statics,
+                )
+            stacked_groups.append((idxs, k, stacked))
+
+        def collect() -> list[list]:
+            models: list[list] = [
+                [None] * len(grid_points) for _ in masks
+            ]
+            for idxs, k, stacked in stacked_groups:
+                w = np.asarray(stacked.weights)[:k]
+                b = np.asarray(stacked.intercept)[:k]
+                for mi in range(n_masks):
+                    for j, i in enumerate(idxs):
+                        models[mi][i] = LinearRegressionModel(
+                            w[mi * len(idxs) + j], b[mi * len(idxs) + j]
+                        )
+            for i in sequential:
+                est = self.with_params(**grid_points[i])
+                for mi, m in enumerate(masks):
+                    models[mi][i] = est.fit_arrays(x, y, m)
+            return models
+
+        return collect
+
+    def fit_arrays_batched_masks(self, x, y, masks, grid_points):
+        """Folds x grid in as few programs as the grid's static params
+        allow (validators._sweep_family hook) — dispatch + immediate
+        collect of :meth:`sweep_dispatch_masks`."""
+        return self.sweep_dispatch_masks(x, y, masks, grid_points)()
+
+    def fit_arrays_batched(self, x, y, row_mask, grid_points):
+        """One mask, many grid points (workflow/cv.py's per-fold hook —
+        linear previously fit sequentially there)."""
+        return self.fit_arrays_batched_masks(
+            x, y, [row_mask], grid_points
+        )[0]

@@ -210,17 +210,39 @@ class LogisticRegression(PredictorEstimator):
         present = y[any_mask > 0]
         return max(int(present.max()) + 1 if len(present) else 2, 2)
 
+    #: GLM lanes pad onto shape buckets and shard over the mesh's model
+    #: axis; the pipelined fold schedule (workflow/cv.py) overlaps tree
+    #: fits with these dispatches
+    lane_family = "glm"
+
     def fit_arrays_batched(self, x, y, row_mask, grid_points):
         """One mask, many grid points — same-static groups batch into one
         program each; points with unknown params fit sequentially."""
         return self.fit_arrays_batched_masks(x, y, [row_mask], grid_points)[0]
 
-    def _batched_fit(self, xp, yp, rm, regs, ens, num_classes, statics):
+    def _batched_fit(self, xp, yp, rm, regs, ens, num_classes, statics,
+                     mesh=None):
         fit_intercept, max_iter, standardization = statics
         if num_classes == 2:
             from ..compiler import bucketing, dispatch
             from ..utils.aot import aot_call
 
+            statics_kw = dict(
+                num_iters=max_iter,
+                fit_intercept=fit_intercept,
+                standardization=standardization,
+            )
+            if mesh is not None:
+                # the sharded sweep: lanes over MODEL_AXIS, rows over
+                # DATA_AXIS, on the explicit SweepLayout PartitionSpecs,
+                # with fold-level buffer donation (parallel/fit.py)
+                from ..parallel.fit import sweep_parallel_fit
+
+                return sweep_parallel_fit(
+                    fit_logistic_binary_batched,
+                    "sweep_logistic_binary_sharded", mesh,
+                    xp, yp, rm, regs, ens, **statics_kw,
+                )
             # cross-candidate dedup: every lane of this sweep shares ONE
             # program, and the lane count pads onto a shape bucket so a
             # near-miss sweep (one more grid point, one more fold) reuses
@@ -229,15 +251,20 @@ class LogisticRegression(PredictorEstimator):
             # shared-x GEMM sweep (see fit_logistic_binary_batched); the x
             # upload reuses the transfer the DAG fit prefetched, when one
             # is in flight (compiler.dispatch)
-            out = aot_call(
+            fit_fn = dispatch.donating(
                 "logistic_binary_batched", fit_logistic_binary_batched,
+                donate_argnums=(3, 4),
+                static_argnames=(
+                    "num_iters", "fit_intercept", "standardization"
+                ),
+            )
+            out = aot_call(
+                "logistic_binary_batched", fit_fn,
                 (
                     dispatch.device_f32(xp), jnp.asarray(yp),
                     jnp.asarray(rm), jnp.asarray(regs), jnp.asarray(ens),
                 ),
-                dict(num_iters=max_iter,
-                     fit_intercept=fit_intercept,
-                     standardization=standardization),
+                statics_kw,
             )
             if rm.shape[0] > k:
                 from .solvers import GLMParams
@@ -254,19 +281,38 @@ class LogisticRegression(PredictorEstimator):
             )
         )(regs, ens, rm)
 
-    def fit_arrays_batched_masks(self, x, y, masks, grid_points):
-        """Folds × grid in as few programs as the grid's static params
-        allow: each same-(fit_intercept, max_iter, standardization) group
-        batches (fold-mask, reg, elastic-net) triples onto the fit axis
-        (binary: shared-x GEMM FISTA); points with unknown params fall back
-        to sequential fits."""
+    def sweep_dispatch_masks(self, x, y, masks, grid_points):
+        """Dispatch the folds × grid sweep, return a collector closure.
+
+        Each same-(fit_intercept, max_iter, standardization) group batches
+        (fold-mask, reg, elastic-net) triples onto the fit axis (binary:
+        shared-x GEMM FISTA); points with unknown params fall back to
+        sequential fits inside the collector. Binary groups under an
+        active execution mesh route through the pjit'd SweepLayout path —
+        explicit per-axis PartitionSpecs, donated fold buffers. Dispatch
+        is async; the closure materializes the models, so tree-family
+        fits can overlap (the pipelined lane schedule in workflow/cv.py)."""
         masks = [np.asarray(m, dtype=np.float32) for m in masks]
         groups, sequential = self._static_groups(grid_points)
         num_classes = self._num_classes(y, np.max(np.stack(masks), axis=0))
         n_masks = len(masks)
-        models: list[list] = [[None] * len(grid_points) for _ in masks]
+        stacked_groups: list[tuple[tuple, list[int], object]] = []
         if groups:
-            xp, yp, masksp = self._mesh_rows(x, y, np.stack(masks))
+            from ..parallel.mesh import execution_mesh
+
+            mesh = execution_mesh() if num_classes == 2 else None
+            if mesh is not None:
+                # the sharded path pads + places rows itself — handing it
+                # raw host arrays keeps the donated buffers private to
+                # one dispatch (a shared pre-sharded x could be consumed
+                # out from under the next static group)
+                xp, yp, masksp = (
+                    np.asarray(x, dtype=np.float32),
+                    np.asarray(y, dtype=np.float32),
+                    np.stack(masks),
+                )
+            else:
+                xp, yp, masksp = self._mesh_rows(x, y, np.stack(masks))
             for statics, idxs in groups.items():
                 pts = [grid_points[i] for i in idxs]
                 regs, ens = self._grid_values(pts * n_masks)
@@ -274,18 +320,33 @@ class LogisticRegression(PredictorEstimator):
                     masksp, len(pts), axis=0
                 )  # [K, N], mask-major to match regs/ens tiling
                 stacked = self._batched_fit(
-                    xp, yp, rm, regs, ens, num_classes, statics
+                    xp, yp, rm, regs, ens, num_classes, statics, mesh=mesh
                 )
+                stacked_groups.append((idxs, len(pts), stacked))
+
+        def collect() -> list[list]:
+            models: list[list] = [
+                [None] * len(grid_points) for _ in masks
+            ]
+            for idxs, n_pts, stacked in stacked_groups:
                 w = np.asarray(stacked.weights)
                 b = np.asarray(stacked.intercept)
                 for mi in range(n_masks):
                     for j, i in enumerate(idxs):
                         models[mi][i] = LogisticRegressionModel(
-                            w[mi * len(pts) + j], b[mi * len(pts) + j],
+                            w[mi * n_pts + j], b[mi * n_pts + j],
                             num_classes,
                         )
-        for i in sequential:
-            est = self.with_params(**grid_points[i])
-            for mi, m in enumerate(masks):
-                models[mi][i] = est.fit_arrays(x, y, m)
-        return models
+            for i in sequential:
+                est = self.with_params(**grid_points[i])
+                for mi, m in enumerate(masks):
+                    models[mi][i] = est.fit_arrays(x, y, m)
+            return models
+
+        return collect
+
+    def fit_arrays_batched_masks(self, x, y, masks, grid_points):
+        """Folds × grid in as few programs as the grid's static params
+        allow — dispatch + immediate collect of
+        :meth:`sweep_dispatch_masks`."""
+        return self.sweep_dispatch_masks(x, y, masks, grid_points)()

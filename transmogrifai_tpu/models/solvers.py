@@ -808,6 +808,10 @@ def program_trace_specs():
             jax.ShapeDtypeStruct((k,), f32),      # elastic_nets
         )
 
+    # donation contract of the lane sweep (mirrored by the sharded twins
+    # in parallel/sweep.py): the per-lane hyperparam vectors [K] alias
+    # into the output intercept [K] — TPJ003 lowers this donating twin
+    # and requires the aliasing to land in the StableHLO
     return [
         dict(
             name="linear_batched",
@@ -817,6 +821,9 @@ def program_trace_specs():
             ),
             buckets=(8, 64, 96),
             bucket_axis="lanes",
+            donate_argnums=(3, 4),
+            base_fn=getattr(fit_linear_batched, "__wrapped__", None),
+            static_argnames=("num_iters", "fit_intercept"),
         ),
         dict(
             name="logistic_binary_batched",
@@ -827,5 +834,12 @@ def program_trace_specs():
             ),
             buckets=(8, 64, 96),
             bucket_axis="lanes",
+            donate_argnums=(3, 4),
+            base_fn=getattr(
+                fit_logistic_binary_batched, "__wrapped__", None
+            ),
+            static_argnames=(
+                "num_iters", "fit_intercept", "standardization"
+            ),
         ),
     ]

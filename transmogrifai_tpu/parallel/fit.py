@@ -8,6 +8,15 @@ scaling-book recipe; replaces Spark's row-partitioned fits).
 `grid_parallel_fit` vmaps a solver over stacked hyperparameter arrays and
 shards the stacked axis over "model" — the reference's 8-thread candidate
 pool (OpValidator.scala:363-367) becomes one compiled sweep.
+
+`sweep_parallel_fit` is the CV candidate sweep's pjit path: the batched
+GLM solvers (models/solvers.py) already stack candidates on a lane axis,
+so instead of vmapping a scalar solver this route places the lane tensors
+on the explicit per-axis PartitionSpecs of ``parallel.sweep.SweepLayout``
+(lanes over "model", rows over "data") and dispatches ONE donated compiled
+program per fold — fold k+1's dispatch releases fold k's X/y/mask device
+buffers, and the lane-param buffers alias straight into the output
+intercept (TPJ003-verified).
 """
 from __future__ import annotations
 
@@ -26,6 +35,117 @@ def _jitted_fit(fit_fn, _mesh, static_names: tuple):
     import jax
 
     return jax.jit(fit_fn, static_argnames=static_names)
+
+
+@lru_cache(maxsize=None)
+def _jitted_lane_sweep(fit_fn, mesh, layout, static_items: tuple,
+                       donate: bool):
+    """The pjit'd lane-sweep twin of ``fit_fn`` (a batched GLM solver):
+    in/out shardings from ``layout`` over ``mesh``, statics baked into the
+    closure (pjit rejects kwargs alongside in_shardings), and — when
+    ``donate`` — every input buffer donated (SWEEP_DONATE_ARGNUMS)."""
+    import jax
+
+    from .sweep import SWEEP_DONATE_ARGNUMS
+
+    base = getattr(fit_fn, "__wrapped__", fit_fn)
+    statics = dict(static_items)
+
+    def sweep(x, y, row_masks, reg_params, elastic_nets):
+        return base(x, y, row_masks, reg_params, elastic_nets, **statics)
+
+    return jax.jit(
+        sweep,
+        in_shardings=layout.in_shardings(mesh),
+        out_shardings=layout.out_shardings(mesh),
+        donate_argnums=SWEEP_DONATE_ARGNUMS if donate else (),
+    )
+
+
+def sweep_parallel_fit(
+    fit_fn: Callable[..., Any],
+    name: str,
+    mesh,
+    x: np.ndarray,
+    y: np.ndarray,
+    row_masks: np.ndarray,
+    reg_params: np.ndarray,
+    elastic_nets: np.ndarray,
+    **static_kwargs: Any,
+):
+    """One sharded, donated GLM sweep dispatch over ``mesh``.
+
+    ``fit_fn`` is a batched solver taking ``(x [N,D], y [N], masks [K,N],
+    regs [K], ens [K], **statics) -> GLMParams``. Lanes pad onto the
+    shared ``compiler.bucketing`` buckets rounded up to the model-axis
+    size (pads recorded in compileStats → the run ledger's per-fold lane
+    occupancy); rows zero-pad to the data-axis multiple with mask-0
+    padding (inert in every mask-weighted solver). Inputs are placed
+    explicitly on the SweepLayout PartitionSpecs — no implicit reshard —
+    and the program is admitted through the TPJ bank gate (aot_call).
+
+    All five input buffers are donated (``TPTPU_DONATE=0`` opts out):
+    they are freshly device_put here, so the caller's host arrays stay
+    valid while fold k's device buffers free at fold k+1's dispatch.
+    Returns GLMParams sliced back to the real lane count."""
+    import os
+    import warnings
+
+    from ..compiler import bucketing
+    from ..utils.aot import aot_call
+    from .sweep import SweepLayout, mesh_lane_capacity
+
+    layout = SweepLayout()
+    n_model = mesh_lane_capacity(mesh)
+    n_data = int(np.prod(list(mesh.shape.values()))) // n_model
+
+    x = np.asarray(x, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    row_masks = np.asarray(row_masks, dtype=np.float32)
+    reg_params = np.asarray(reg_params, dtype=np.float32)
+    elastic_nets = np.asarray(elastic_nets, dtype=np.float32)
+
+    # lane padding onto the shared buckets (recorded for the run ledger)
+    k, (row_masks, reg_params, elastic_nets) = bucketing.bucket_sweep_lanes(
+        row_masks, reg_params, elastic_nets, multiple=n_model
+    )
+    # row padding to the data-axis multiple; mask-0 pad rows are inert
+    xp, _ = pad_rows(x, n_data)
+    yp, _ = pad_rows(y, n_data)
+    rpad = xp.shape[0] - row_masks.shape[1]
+    if rpad:
+        row_masks = np.pad(row_masks, ((0, 0), (0, rpad)))
+
+    donate = os.environ.get("TPTPU_DONATE", "1") != "0"
+    if donate and mesh.devices.flat[0].platform == "cpu":
+        # CPU device_put can be zero-copy: the placed shard may alias the
+        # caller's numpy memory, and donating an aliased buffer lets XLA
+        # write sweep outputs straight into the caller's arrays. Place
+        # private copies instead — the donated scribble then lands in
+        # memory only the output Array owns. (Real accelerators copy
+        # host→device anyway, so this is CPU-only.)
+        xp, yp = xp.copy(), yp.copy()
+        row_masks = row_masks.copy()
+        reg_params = reg_params.copy()
+        elastic_nets = elastic_nets.copy()
+    jitted = _jitted_lane_sweep(
+        fit_fn, mesh, layout,
+        tuple(sorted(static_kwargs.items())), donate,
+    )
+    placed = layout.place(mesh, xp, yp, row_masks, reg_params, elastic_nets)
+    with warnings.catch_warnings():
+        # x/y/mask donations that cannot alias the (smaller) outputs
+        # still free at dispatch; jax's "not usable" warning is the
+        # expected half of the contract, not a defect signal here
+        warnings.filterwarnings("ignore", message=".*donated buffers.*")
+        out = aot_call(
+            f"{name}@{n_data}x{n_model}", jitted, placed, {}
+        )
+    if out.weights.shape[0] > k:
+        out = type(out)(
+            weights=out.weights[:k], intercept=out.intercept[:k]
+        )
+    return out
 
 
 @lru_cache(maxsize=None)

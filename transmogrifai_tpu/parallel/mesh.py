@@ -126,6 +126,15 @@ def data_row_multiple() -> int:
     return 1 if mesh is None else mesh.shape[DATA_AXIS]
 
 
+def model_lane_multiple() -> int:
+    """Lane-count multiple required to shard candidate lanes over the
+    ambient mesh's model axis (1 when no mesh is active). The sharded
+    sweep (parallel/fit.py::sweep_parallel_fit) pads lane counts onto
+    ``compiler.bucketing`` buckets rounded up to this multiple."""
+    mesh = execution_mesh()
+    return 1 if mesh is None else mesh.shape[MODEL_AXIS]
+
+
 def shard_rows_if_active(x):
     """Row-shard ``x`` over the ambient execution mesh (rows must already be
     a multiple of data_row_multiple()) — identity when no mesh is active.

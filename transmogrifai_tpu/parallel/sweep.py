@@ -1,0 +1,219 @@
+"""SweepLayout — explicit per-axis PartitionSpecs for the CV candidate sweep.
+
+The candidate sweep's tensors fall into three roles (SURVEY.md §2.6: the
+reference's 8-thread driver pool becomes a batch axis of one compiled
+program; here that axis additionally shards over the mesh):
+
+* **plane** — the fold's shared feature matrix ``x [N, D]`` and target
+  ``y [N]``: rows shard over ``DATA_AXIS``, features replicate.
+* **lane** — per-candidate tensors stacked on axis 0 (``row_masks [K, N]``,
+  ``reg_params [K]``, ``elastic_nets [K]``): candidate lanes shard over
+  ``MODEL_AXIS``; the mask's row axis additionally shards over
+  ``DATA_AXIS`` so each device holds only its (lane-block × row-block)
+  tile.
+* **fold outputs** — the fitted ``GLMParams`` (``weights [K, D]``,
+  ``intercept [K]``): lanes shard over ``MODEL_AXIS``, mirroring the lane
+  inputs so no gather is needed before the caller slices real lanes out.
+
+Declaring the layout explicitly (instead of letting GSPMD infer it from
+one device_put) is what makes the TPS story auditable: inputs land exactly
+on the declared specs, the lowered program carries those annotations, and
+the TPS006 census can prove no hidden resharding was inserted.
+
+The donated, pjit'd program built over this layout lives in
+``parallel/fit.py::sweep_parallel_fit``; this module also registers the
+sweep programs with the TPJ/TPS auditors (``program_trace_specs``).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+#: positional donation contract of the sharded sweep program: every input
+#: buffer (x, y, row_masks, reg_params, elastic_nets) is declared donated,
+#: so fold k's device buffers are released at fold k+1's dispatch and the
+#: lane-param buffers alias directly into the output intercept lane vector
+#: (the aliasing TPJ003 verifies in the lowered StableHLO).
+SWEEP_DONATE_ARGNUMS = (0, 1, 2, 3, 4)
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepLayout:
+    """Per-axis PartitionSpecs for one GLM sweep dispatch.
+
+    Frozen + hashable so jitted-program caches can key on it; axis names
+    default to the canonical 2-D ("data", "model") mesh vocabulary."""
+
+    data_axis: str = DATA_AXIS
+    model_axis: str = MODEL_AXIS
+
+    # ---- per-tensor specs ------------------------------------------------
+    def plane_spec(self):
+        """x [N, D]: rows over data, features replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.data_axis, None)
+
+    def target_spec(self):
+        """y [N]: rows over data."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.data_axis)
+
+    def lane_mask_spec(self):
+        """row_masks [K, N]: lanes over model, rows over data."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.model_axis, self.data_axis)
+
+    def lane_spec(self):
+        """per-lane hyperparams [K]: lanes over model."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.model_axis)
+
+    def out_weights_spec(self):
+        """weights [K, D]: lanes over model, features replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.model_axis, None)
+
+    def out_lane_spec(self):
+        """intercept [K]: lanes over model."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.model_axis)
+
+    # ---- sharding bundles ------------------------------------------------
+    def in_shardings(self, mesh) -> tuple:
+        """NamedShardings for ``(x, y, row_masks, reg_params,
+        elastic_nets)`` — the GLM batched-solver argument order."""
+        from jax.sharding import NamedSharding
+
+        return (
+            NamedSharding(mesh, self.plane_spec()),
+            NamedSharding(mesh, self.target_spec()),
+            NamedSharding(mesh, self.lane_mask_spec()),
+            NamedSharding(mesh, self.lane_spec()),
+            NamedSharding(mesh, self.lane_spec()),
+        )
+
+    def out_shardings(self, mesh):
+        """GLMParams-shaped sharding pytree for the sweep outputs."""
+        from jax.sharding import NamedSharding
+
+        from ..models.solvers import GLMParams
+
+        return GLMParams(
+            weights=NamedSharding(mesh, self.out_weights_spec()),
+            intercept=NamedSharding(mesh, self.out_lane_spec()),
+        )
+
+    def place(self, mesh, x, y, row_masks, reg_params, elastic_nets):
+        """device_put every input on its declared sharding — explicit
+        placement, so dispatch never triggers an implicit reshard."""
+        import jax
+
+        return tuple(
+            jax.device_put(a, s)
+            for a, s in zip(
+                (x, y, row_masks, reg_params, elastic_nets),
+                self.in_shardings(mesh),
+            )
+        )
+
+
+def mesh_lane_capacity(mesh) -> int:
+    """Model-axis size of ``mesh`` (1 when mesh is None) — the lane-count
+    multiple the sweep pads onto so lanes shard evenly."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape[MODEL_AXIS])
+
+
+# --------------------------------------------------------------------------
+# compiled-program contract audit (analysis/program.py TPJ0xx +
+# analysis/spmd.py TPS006 census — this module is listed in SPEC_MODULES)
+# --------------------------------------------------------------------------
+def _spec_mesh():
+    """The auditors' sweep mesh: all visible devices on the MODEL axis.
+
+    Unlike the shard_map kernels, the pjit'd sweep carries its layout as
+    jit in/out shardings, and this jax generation cannot lower those over
+    a device-free AbstractMesh — so the spec substrate is a real mesh
+    (1 × n_devices; on a one-chip CI runner that is the degenerate 1×1
+    mesh, which traces and lowers the same program family)."""
+    import jax
+
+    from .mesh import make_mesh
+
+    return make_mesh(n_data=1, n_model=len(jax.devices()))
+
+
+def program_trace_specs():
+    """Register the sharded GLM sweep programs with the program auditor.
+
+    Buckets cross the ``compiler.bucketing`` pow2(<=64) / 32-multiple
+    boundary (all multiples of 8, so an 8-wide model axis divides every
+    bucket). Statics are baked into the pjit closure (pjit rejects kwargs
+    when in_shardings are given) so ``build`` returns empty statics;
+    ``base_fn``/``static_argnames``/``donate_argnums`` give TPJ003 the
+    donation twin to lower — the lane-param → intercept alias must land
+    as ``tf.aliasing_output`` in the StableHLO."""
+    import jax
+
+    from ..models.solvers import (
+        fit_linear_batched,
+        fit_logistic_binary_batched,
+    )
+    from .fit import _jitted_lane_sweep
+
+    mesh = _spec_mesh()
+    layout = SweepLayout()
+
+    def _glm_args(k: int):
+        f32 = "float32"
+        return (
+            jax.ShapeDtypeStruct((16, 3), f32),   # x
+            jax.ShapeDtypeStruct((16,), f32),     # y
+            jax.ShapeDtypeStruct((k, 16), f32),   # row_masks
+            jax.ShapeDtypeStruct((k,), f32),      # reg_params
+            jax.ShapeDtypeStruct((k,), f32),      # elastic_nets
+        )
+
+    lin_statics = (("fit_intercept", True), ("num_iters", 2))
+    log_statics = (
+        ("fit_intercept", True), ("num_iters", 2),
+        ("standardization", True),
+    )
+    return [
+        dict(
+            name="sweep_linear_sharded",
+            fn=_jitted_lane_sweep(
+                fit_linear_batched, mesh, layout, lin_statics, True
+            ),
+            build=lambda k: (_glm_args(k), {}),
+            buckets=(8, 64, 96),
+            bucket_axis="lanes",
+            donate_argnums=SWEEP_DONATE_ARGNUMS,
+            base_fn=getattr(fit_linear_batched, "__wrapped__", None),
+            static_argnames=("num_iters", "fit_intercept"),
+        ),
+        dict(
+            name="sweep_logistic_binary_sharded",
+            fn=_jitted_lane_sweep(
+                fit_logistic_binary_batched, mesh, layout, log_statics, True
+            ),
+            build=lambda k: (_glm_args(k), {}),
+            buckets=(8, 64, 96),
+            bucket_axis="lanes",
+            donate_argnums=SWEEP_DONATE_ARGNUMS,
+            base_fn=getattr(
+                fit_logistic_binary_batched, "__wrapped__", None
+            ),
+            static_argnames=(
+                "num_iters", "fit_intercept", "standardization"
+            ),
+        ),
+    ]

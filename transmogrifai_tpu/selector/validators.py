@@ -343,7 +343,16 @@ class Validator:
             # plan, so "fails twice then succeeds" scripts exactly
             plan.on_candidate_fit(est)
         per_point_values: list[list[float]] = [[] for _ in points]
-        batched_masks = getattr(est, "fit_arrays_batched_masks", None)
+        batched_masks = getattr(est, "sweep_dispatch_masks", None)
+        if batched_masks is not None:
+            # dispatch + collect: validator-level sweeps have no other
+            # host work to overlap, so the collector runs immediately —
+            # but GLM lanes still go through the one sharded/bucketed
+            # program the dispatcher builds (SweepLayout, donation)
+            dispatcher = batched_masks
+            batched_masks = lambda *a: dispatcher(*a)()  # noqa: E731
+        else:
+            batched_masks = getattr(est, "fit_arrays_batched_masks", None)
         if os.environ.get("TPTPU_BATCHED_FITS") == "0":
             # sequential fallback would pay len(points) extra full-data
             # fits per family for lanes only the winner ever uses — the
@@ -390,9 +399,22 @@ class Validator:
                     ]
             val_idx = np.nonzero(val_mask)[0]
             for gi, model in enumerate(models):
-                pred, prob, _ = model.predict_arrays(x[val_idx])
-                metrics = evaluator.evaluate_arrays(y[val_idx], pred, prob)
-                per_point_values[gi].append(evaluator.metric_of(metrics))
+                # lane-granular isolation: one lane's scoring failure
+                # poisons only its own grid point (NaN metric — ``best``
+                # filters non-finite means), not the whole family. Fit
+                # failures above still propagate: the retry machinery
+                # scripts those at the candidate level.
+                try:
+                    pred, prob, _ = model.predict_arrays(x[val_idx])
+                    metrics = evaluator.evaluate_arrays(y[val_idx], pred, prob)
+                    value = evaluator.metric_of(metrics)
+                except Exception as e:  # lane-level isolation
+                    log.warning(
+                        "Lane %d (%s) of %s failed scoring in fold %d: %s",
+                        gi, points[gi], type(est).__name__, fi, e,
+                    )
+                    value = float("nan")
+                per_point_values[gi].append(value)
         return [
             CandidateResult(
                 model_name=type(est).__name__,

@@ -563,7 +563,11 @@ class RunRecorder:
             log.debug("run recorder fold_start failed: %s", e)
 
     def on_fold_end(
-        self, fold: int, total: int | None = None, rows: int | None = None
+        self,
+        fold: int,
+        total: int | None = None,
+        rows: int | None = None,
+        sweep: dict | None = None,
     ) -> None:
         try:
             now = self._now()
@@ -574,15 +578,21 @@ class RunRecorder:
                 0.0 if mark is None
                 else now - mark[0] + (sim_now - mark[1])
             )
+            record = {
+                "fold": fold,
+                "seconds": round(secs, 4),
+                "rows": rows,
+                "rowsPerSec": (
+                    round(rows / secs) if rows and secs > 0 else None
+                ),
+            }
+            if sweep is not None:
+                # fold-scoped lane occupancy / pad waste: the caller hands
+                # the compileStats delta across its fold (workflow/cv.py),
+                # so each fold record carries its own sweep accounting
+                record["sweep"] = _sweep_summary(sweep)
             with self._lock:
-                self.folds.append({
-                    "fold": fold,
-                    "seconds": round(secs, 4),
-                    "rows": rows,
-                    "rowsPerSec": (
-                        round(rows / secs) if rows and secs > 0 else None
-                    ),
-                })
+                self.folds.append(record)
             _STATS.bump("foldsTimed")
             self._emit_progress({
                 "event": "fold",

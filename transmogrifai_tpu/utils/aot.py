@@ -411,7 +411,17 @@ def aot_call(
         # _FAILED is the negative cache; the tmp suffix is unique per
         # thread so racing writers can't interleave one file.
         t_direct = _time.monotonic()
-        out = jit_fn(*args, **statics)
+        import warnings
+
+        with warnings.catch_warnings():
+            # donated lane params ([K] reg/elastic-net) alias the [K]
+            # intercept output; the [K'] bucketed twin of a sweep whose
+            # shapes DON'T line up is expected to fall back to copy —
+            # jax warns per-compile, which would spam every sweep
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers.*"
+            )
+            out = jit_fn(*args, **statics)
         log.info(
             "AOT miss %s (%s): direct call %.2f s", name, key,
             _time.monotonic() - t_direct,
