@@ -225,6 +225,26 @@ def validate_bench_report(doc) -> list[str]:
                 fit_stream.get("highWaterRatio"), (int, float)
             ):
                 problems.append("fitStream missing numeric 'highWaterRatio'")
+    # additive envelope: the quantized serving-plane stamp (r12) is
+    # validated WHEN PRESENT — artifacts predating it stay valid forever
+    quant = doc.get("quantized") if isinstance(doc, dict) else None
+    if quant is not None:
+        if not isinstance(quant, dict):
+            problems.append("quantized is not an object")
+        else:
+            for key in ("parityOk", "reconciled", "textFlowFused"):
+                if not isinstance(quant.get(key), bool):
+                    problems.append(f"quantized missing boolean {key!r}")
+            for key in (
+                "upBytesPerRowF32", "upBytesPerRowQuant", "reductionX",
+            ):
+                if not isinstance(quant.get(key), (int, float)):
+                    problems.append(f"quantized missing numeric {key!r}")
+            hits = quant.get("textFlowUnfuseableHits")
+            if not isinstance(hits, int) or isinstance(hits, bool):
+                problems.append(
+                    "quantized missing integer 'textFlowUnfuseableHits'"
+                )
     # additive envelope: the sharded-sweep scaling stamp (r07 multichip)
     # is validated WHEN PRESENT — artifacts predating it stay valid forever
     sweep = doc.get("sweepScaling") if isinstance(doc, dict) else None
@@ -2113,10 +2133,55 @@ def bench_explain(
     )
 
 
+def _serve_text_flow_model(n: int = 128):
+    """Small Real + high-cardinality Text flow (SmartTextVectorizer
+    decides HASH): the witness that a previously-Unfuseable text flow now
+    serves fused via the device-side hashing plane."""
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import from_dataset
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.types.columns import column_from_values
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    rng = np.random.default_rng(29)
+    words = [
+        "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+        "hotel", "india", "juliet",
+    ]
+    x1 = rng.normal(size=n)
+    texts = []
+    for i in range(n):
+        ks = 1 + int(rng.integers(0, 4))
+        toks = [words[int(j)] for j in rng.integers(0, len(words), ks)]
+        texts.append(" ".join(toks) + f" id{i}")
+    label = (x1 + 0.2 * rng.normal(size=n) > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "desc": column_from_values(T.Text, texts),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    selector = BinaryClassificationModelSelector(
+        seed=7, models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        num_folds=2,
+    )
+    pred = selector.set_input(resp, vec).get_output()
+    model = Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    rows_ = [
+        {"x1": float(a), "desc": t} for a, t in zip(x1, texts)
+    ]
+    return model, rows_
+
+
 def bench_serve_fused(
     rows: int = 2048,
     k: int = 3,
     median_of: int = 5,
+    quantized: bool = False,
 ) -> dict:
     """Fused-vs-staged serving A/B (ROADMAP item 1): score the SAME
     batch through the fused end-to-end scoring graph (compiler/fused.py —
@@ -2203,6 +2268,63 @@ def bench_serve_fused(
             round(fused_rps / skl["batch_rows_per_sec"], 4) if skl else None
         )
         md = fn.metadata()["fused"]
+        quant_block = None
+        if quantized:
+            # quantized A/B arm (device residency, BENCH_r12): the SAME
+            # closure with the uint8/bin-aligned ingest — upload bytes per
+            # row vs the f32 plane, score parity, reconciled census, and
+            # the device-side text-hashing witness (a previously
+            # Unfuseable HASH flow serving fused with zero unfuseable
+            # fallback-reason hits)
+            qfn = score_function(model, quantized=True)
+            qfn.prime_fused()
+            for _ in range(2):
+                qfn.batch(batch)
+            quant_s = median_timed(
+                lambda: qfn.batch(batch), reps=median_of, warmups=0
+            )
+            q_census_before = rl.snapshot()
+            quant_out = qfn.batch(batch)
+            q_census = rl.delta(q_census_before)
+            q_audit = qfn.audit().to_json()
+            q_static = q_audit["transferCensus"]
+            q_rec = rl.reconcile_transfer_census(
+                q_census, q_static, rows=rows, batches=1,
+                check_uploads=True,
+            )
+            q_parity = max(
+                abs(a[key][score_key] - b[key][score_key])
+                for a, b in zip(quant_out, fused_out)
+            )
+            q_md = qfn.metadata()["fused"]
+            up_f32 = float(static["upBytesPerRow"])
+            up_q = float(q_static["upBytesPerRow"])
+            t_model, t_rows = _serve_text_flow_model()
+            t_fn = score_function(t_model)
+            t_fused = bool(t_fn.prime_fused())
+            t_fn.batch(t_rows)
+            t_md = t_fn.metadata()["fused"]
+            quant_block = {
+                "upBytesPerRowF32": up_f32,
+                "upBytesPerRowQuant": up_q,
+                "reductionX": round(up_f32 / up_q, 4) if up_q else None,
+                "quantizedRowsPerSec": round(rows / quant_s),
+                "parityMaxDelta": float(q_parity),
+                "parityOk": bool(q_parity <= 2e-2),
+                "reconciled": bool(q_rec["consistent"]),
+                "dispatches": q_md["dispatches"],
+                "fallbacks": q_md["fallbacks"],
+                "fingerprint": q_md["fingerprint"],
+                "quantError": q_audit.get("fusedProgram", {}).get(
+                    "quantError"
+                ),
+                "textFlowFused": bool(
+                    t_fused and t_md["dispatches"] >= 1
+                ),
+                "textFlowUnfuseableHits": int(
+                    t_md["fallbackReasons"].get("unfuseable", 0)
+                ),
+            }
         return make_bench_report(
             metric="serve_fused_vs_staged_throughput",
             value=round(fused_rps / staged_rps, 4),
@@ -2246,8 +2368,15 @@ def bench_serve_fused(
                 f"the staged loop on the same closure; sklearn anchor = "
                 f"BASELINE_CPU 'serving' (titanic RF pipeline, "
                 f"different flow — directional only)"
+                + (
+                    "; quantized arm = same closure with uint8/bin-aligned"
+                    " ingest + in-graph dequant epilogue, plus a"
+                    " hashed-text flow served fused"
+                    if quantized else ""
+                )
             ),
             fused_program=audit.get("fusedProgram"),
+            **({"quantized": quant_block} if quant_block else {}),
         )
     finally:
         if prev_cutoff is None:
@@ -2556,6 +2685,13 @@ def _build_parser():
         help="timed reps per measurement, median reported (default 5)",
     )
     sf.add_argument(
+        "--quantized", action="store_true",
+        help="add the quantized A/B arm: uint8/bin-aligned ingest vs the "
+             "f32 plane (upload bytes per row, parity, reconciled census) "
+             "plus the device-side hashed-text fused witness (the "
+             "BENCH_r12.json 'quantized' block)",
+    )
+    sf.add_argument(
         "--out", default=None, metavar="PATH",
         help="also write the JSON report to PATH (the BENCH_r08.json "
              "regression shape)",
@@ -2743,7 +2879,8 @@ def _dispatch(ns) -> None:
     if mode == "serve-fused":
         dump_bench_report(
             bench_serve_fused(
-                rows=ns.rows, k=ns.k, median_of=ns.median_of
+                rows=ns.rows, k=ns.k, median_of=ns.median_of,
+                quantized=ns.quantized,
             ),
             ns.out, echo=True,
         )

@@ -1,0 +1,200 @@
+"""Device-side text hashing suite (compiler/fused.py
+``hashed_text_member`` + ops/text.py ``SmartTextModel.fused_member_spec``):
+a high-cardinality HASH text flow must serve FUSED — host tokenize +
+murmur3 to int32 codes, device scatter — with scores matching the staged
+path and ZERO ``unfuseable`` hits in the fallback-reason map; the
+``TPTPU_TEXT_FUSED_TOKENS`` per-row token cap must degrade through the
+COUNTED fallback seam (correct scores via the staged loop, fallback
+recorded); the pure-Python hashing fallback (``TPTPU_DISABLE_NATIVE=1``)
+must produce identical planes; and all-PIVOT text flows keep riding the
+one-hot member. Markers: ``residency`` + ``fused``.
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+pytestmark = [pytest.mark.residency, pytest.mark.fused]
+
+_WORDS = [
+    "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+    "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+]
+
+
+def _text_rows(n=160, seed=11, max_tokens=4):
+    """Unique multi-token strings: cardinality n >> max_cardinality, so
+    SmartTextVectorizer decides HASH for the column."""
+    rng = np.random.default_rng(seed)
+    texts = []
+    for i in range(n):
+        k = 1 + int(rng.integers(0, max_tokens))
+        toks = [str(_WORDS[int(j)]) for j in rng.integers(0, len(_WORDS), k)]
+        texts.append(" ".join(toks) + f" id{i}")
+    return texts
+
+
+def _train_text_flow(n=160, seed=11, max_tokens=4):
+    uid_util.reset()
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    texts = _text_rows(n, seed, max_tokens)
+    label = (x1 + 0.2 * rng.normal(size=n) > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "desc": column_from_values(T.Text, texts),
+    })
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    sel = BinaryClassificationModelSelector(
+        seed=7, num_folds=2,
+        models=[(LogisticRegression(), {"reg_param": [0.01]})],
+    )
+    pred = sel.set_input(resp, vec).get_output()
+    model = (
+        Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    )
+    rows = [
+        {"x1": float(a), "desc": t} for a, t in zip(x1, texts)
+    ]
+    # serving traffic includes nulls and unseen tokens
+    rows[3] = {"x1": 0.1, "desc": None}
+    rows[5] = {"x1": -0.4, "desc": "zulu yankee xray"}
+    return model, rows
+
+
+def _probs(out):
+    return np.array(
+        [next(iter(r.values()))["probability_1"] for r in out]
+    )
+
+
+@pytest.fixture
+def no_host_predict(monkeypatch):
+    monkeypatch.setenv("TPTPU_HOST_PREDICT_MAX", "0")
+
+
+class TestHashedTextFusion:
+    def test_hash_flow_serves_fused_zero_unfuseable(
+        self, no_host_predict, monkeypatch,
+    ):
+        model, rows = _train_text_flow()
+        # staged reference
+        monkeypatch.setenv("TPTPU_FUSED", "0")
+        staged = _probs(score_function(model).batch(rows))
+        monkeypatch.delenv("TPTPU_FUSED")
+        fn = score_function(model)
+        fn.prime_fused()
+        md = fn.metadata()["fused"]
+        # the tentpole claim: text flows no longer raise Unfuseable
+        assert md["active"], md["reason"]
+        fused = _probs(fn.batch(rows))
+        np.testing.assert_allclose(fused, staged, atol=1e-5)
+        md = fn.metadata()["fused"]
+        assert md["dispatches"] >= 1
+        assert "unfuseable" not in md["fallbackReasons"]
+        assert md["fallbacks"] == 0
+
+    def test_token_cap_degrades_through_counted_seam(
+        self, no_host_predict, monkeypatch,
+    ):
+        # cap the per-row distinct-token budget below the corpus: the
+        # batch must still score CORRECTLY (staged loop), and the miss
+        # must be a counted fallback, not an exception
+        monkeypatch.setenv("TPTPU_FUSED", "0")
+        model, rows = _train_text_flow()
+        staged = _probs(score_function(model).batch(rows))
+        monkeypatch.delenv("TPTPU_FUSED")
+        monkeypatch.setenv("TPTPU_TEXT_FUSED_TOKENS", "1")
+        fn = score_function(model)
+        fn.prime_fused()
+        out = _probs(fn.batch(rows))
+        np.testing.assert_allclose(out, staged, atol=1e-5)
+        md = fn.metadata()["fused"]
+        assert md["fallbacks"] >= 1
+        assert sum(md["fallbackReasons"].values()) >= 1
+
+    def test_python_hash_fallback_parity(
+        self, no_host_predict, monkeypatch,
+    ):
+        # same model, native tokenize/murmur kernels disabled: the pure
+        # Python host encode must produce the identical fused plane
+        model, rows = _train_text_flow()
+        fn = score_function(model)
+        fn.prime_fused()
+        with_native = _probs(fn.batch(rows))
+        monkeypatch.setenv("TPTPU_DISABLE_NATIVE", "1")
+        fn2 = score_function(model)
+        fn2.prime_fused()
+        without = _probs(fn2.batch(rows))
+        md = fn2.metadata()["fused"]
+        assert md["active"] and md["fallbacks"] == 0
+        np.testing.assert_array_equal(with_native, without)
+
+    def test_hash_flow_quantized_narrows_codes(
+        self, no_host_predict, monkeypatch,
+    ):
+        # the hashed-code member advertises its code range; quantization
+        # narrows the int32 wire format and must keep score parity
+        model, rows = _train_text_flow()
+        base = score_function(model)
+        base.prime_fused()
+        p0 = _probs(base.batch(rows))
+        up0 = base.audit().to_json()["transferCensus"]["upBytesPerRow"]
+        quant = score_function(model, quantized=True)
+        quant.prime_fused()
+        p1 = _probs(quant.batch(rows))
+        up1 = quant.audit().to_json()["transferCensus"]["upBytesPerRow"]
+        md = quant.metadata()["fused"]
+        assert md["quantized"] is True and md["fallbacks"] == 0
+        # affine dequant on the GLM's numeric member moves probabilities
+        # by at most the advertised scale/2 epilogue error — small, not
+        # zero (the AUPR-budget test lives in test_quantize.py)
+        np.testing.assert_allclose(p1, p0, atol=2e-2)
+        assert up1 < up0
+
+    def test_pivot_flow_still_fuses_onehot(
+        self, no_host_predict,
+    ):
+        # low-cardinality text decides PIVOT for every slot and keeps the
+        # one-hot member (no hashing plane involved)
+        uid_util.reset()
+        rng = np.random.default_rng(23)
+        n = 160
+        x1 = rng.normal(size=n)
+        cats = [["red", "green", "blue"][i % 3] for i in range(n)]
+        label = (x1 > 0).astype(float)
+        ds = Dataset.of({
+            "label": column_from_values(T.RealNN, label),
+            "x1": column_from_values(T.Real, x1),
+            "color": column_from_values(T.Text, cats),
+        })
+        resp, preds = from_dataset(ds, response="label")
+        vec = transmogrify(list(preds))
+        sel = BinaryClassificationModelSelector(
+            seed=7, num_folds=2,
+            models=[(LogisticRegression(), {"reg_param": [0.01]})],
+        )
+        pred = sel.set_input(resp, vec).get_output()
+        model = (
+            Workflow().set_result_features(pred).set_input_dataset(ds)
+            .train()
+        )
+        rows = [
+            {"x1": float(a), "color": c} for a, c in zip(x1[:32], cats[:32])
+        ]
+        fn = score_function(model)
+        fn.prime_fused()
+        assert fn.metadata()["fused"]["active"]
+        fn.batch(rows)
+        assert fn.metadata()["fused"]["fallbacks"] == 0

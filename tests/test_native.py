@@ -97,3 +97,64 @@ class TestTreePredictSumValidation:
         expect = TR._traverse_host(binned, stack).sum(axis=0)
         got = native.tree_predict_sum(binned, sf, sb, lv)
         np.testing.assert_allclose(got, expect)
+
+
+class TestPreparedStackValidation:
+    """The per-call bounds check is HOISTED to model-load time: a corrupt
+    stack raises IndexError when the serving plan prepares it, the hot
+    loop keeps only an O(1) plane-width guard, and the native kernel runs
+    prevalidated (env TPTPU_NATIVE_VALIDATE=1 restores the per-call
+    check)."""
+
+    def _stack(self):
+        rng = np.random.default_rng(4)
+        depth, t, f, b = 3, 4, 5, 8
+        w = 1 << depth
+        sf = rng.integers(-1, f, size=(t, depth, w)).astype(np.int32)
+        sb = rng.integers(0, b, size=(t, depth, w)).astype(np.int32)
+        lv = rng.normal(size=(t, w)).astype(np.float32)
+        binned = rng.integers(0, b, size=(20, f)).astype(np.int32)
+        return binned, sf, sb, lv
+
+    def test_corrupt_leaf_table_raises_at_prepare(self):
+        from transmogrifai_tpu.models import trees as TR
+
+        binned, sf, sb, lv = self._stack()
+        bad = TR.Tree(split_feat=sf, split_bin=sb, leaf_value=lv[:, :4])
+        with pytest.raises(IndexError, match="leaf table width"):
+            TR.prepare_host_stack(bad)
+
+    def test_oob_split_feature_raises_before_native(self):
+        from transmogrifai_tpu.models import trees as TR
+
+        binned, sf, sb, lv = self._stack()
+        sf = sf.copy()
+        sf[0, 0, 0] = 99
+        ps = TR.prepare_host_stack(
+            TR.Tree(split_feat=sf, split_bin=sb, leaf_value=lv)
+        )
+        assert ps.max_feat == 99  # cached once at prepare time
+        with pytest.raises(IndexError, match="split feature index"):
+            TR._leaf_sum(binned, ps)
+
+    def test_prevalidated_skips_recheck(self, monkeypatch):
+        # prevalidated=True must not re-run the stack scan... unless the
+        # belt-and-braces env flag asks for it
+        binned, sf, sb, lv = self._stack()
+        sf = sf.copy()
+        sf[0, 0, 0] = 99
+        lib = native._load()
+        if lib is None or not hasattr(lib, "tp_tree_predict_sum"):
+            pytest.skip("native library unavailable")
+        monkeypatch.setenv("TPTPU_NATIVE_VALIDATE", "1")
+        with pytest.raises(IndexError, match="split feature index"):
+            native.tree_predict_sum(binned, sf, sb, lv, prevalidated=True)
+
+    def test_good_stack_serves_identically(self):
+        from transmogrifai_tpu.models import trees as TR
+
+        binned, sf, sb, lv = self._stack()
+        stack = TR.Tree(split_feat=sf, split_bin=sb, leaf_value=lv)
+        ps = TR.prepare_host_stack(stack)
+        expect = TR._traverse_host(binned, ps).sum(axis=0)
+        np.testing.assert_allclose(TR._leaf_sum(binned, ps), expect, rtol=1e-6)

@@ -1,0 +1,269 @@
+"""Quantized serving-plane suite (featurize/quantize.py + the fused
+quantize pass in compiler/fused.py): golden codec round-trips per mode
+(affine grid, bin-aligned, constant, all-null, ±Inf clamps), bin-edge
+bit-identity under the exact device re-bin semantics, manifest
+round-trip determinism, and the end-to-end budgets the tentpole claims —
+tree predictions BIT-IDENTICAL through the quantized plane, GLM AuPR
+within 1e-3, upload bytes per row cut ≥2× vs the f32 plane.
+Markers: ``residency`` + ``fused``.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.evaluators.binary import aupr
+from transmogrifai_tpu.features import from_dataset
+from transmogrifai_tpu.featurize.quantize import (
+    N_CODES,
+    ColumnQuant,
+    QuantPlan,
+    dequantize,
+)
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.models.gbdt import XGBoostClassifier
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types.columns import column_from_values
+from transmogrifai_tpu.utils import uid as uid_util
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+pytestmark = [pytest.mark.residency, pytest.mark.fused]
+
+
+# ---------------------------------------------------------------- codec
+class TestColumnQuant:
+    def test_affine_golden_roundtrip(self):
+        cq = ColumnQuant.affine(-2.0, 6.0)
+        assert cq.mode == "affine"
+        rng = np.random.default_rng(0)
+        vals = rng.uniform(-2.0, 6.0, size=500).astype(np.float32)
+        codes = cq.encode(vals)
+        assert codes.dtype == np.uint8
+        decoded = cq.reps[codes.astype(np.int64)]
+        # in-range values reconstruct within the advertised ledger bound
+        assert np.abs(decoded - vals).max() <= cq.quant_error + 1e-7
+        # the grid endpoints are exact
+        assert cq.reps[0] == np.float32(-2.0)
+        assert cq.reps[N_CODES - 1] == np.float32(6.0)
+
+    def test_affine_out_of_range_clamps(self):
+        cq = ColumnQuant.affine(0.0, 1.0)
+        codes = cq.encode(np.array([-5.0, 7.0, np.inf, -np.inf], np.float32))
+        assert list(codes) == [0, N_CODES - 1, N_CODES - 1, 0]
+
+    def test_affine_nan_encodes_lo(self):
+        cq = ColumnQuant.affine(3.0, 9.0)
+        codes = cq.encode(np.array([np.nan], np.float32))
+        assert codes[0] == 0
+        assert cq.reps[0] == np.float32(3.0)
+
+    def test_nonfinite_fit_range_is_clamped(self):
+        # ±Inf range edges (a column that saw only ±Inf at fit) must not
+        # produce a NaN-scaled grid
+        cq = ColumnQuant.affine(-np.inf, np.inf)
+        assert np.isfinite(cq.reps).all()
+        assert cq.quant_error == 0.0
+
+    def test_constant_column_exact(self):
+        cq = ColumnQuant.affine(4.25, 4.25)
+        assert cq.mode == "constant"
+        assert cq.quant_error == 0.0
+        codes = cq.encode(np.array([4.25, 0.0, np.nan], np.float32))
+        assert (codes == 0).all()
+        assert (cq.reps == np.float32(4.25)).all()
+
+    def test_all_null_column_exact(self):
+        # an all-null column fits a degenerate [0, 0] range
+        cq = ColumnQuant.affine(0.0, 0.0)
+        assert cq.mode == "constant"
+        assert (cq.encode(np.array([np.nan, np.nan], np.float32)) == 0).all()
+
+    def test_bins_bit_identity_both_sides_of_edge(self):
+        # the exact contract: for values straddling every bin edge the
+        # decoded representative re-bins to the SAME code under device
+        # semantics (count of thresholds strictly below)
+        thr = np.array([-1.5, 0.0, 0.25, 3.0], np.float32)
+        cq = ColumnQuant.bins(thr)
+        assert cq is not None and cq.mode == "bins"
+        assert cq.quant_error == 0.0
+        probes = []
+        for d in thr:
+            probes += [
+                float(np.nextafter(d, -np.inf)),  # just below the edge
+                float(d),                         # at the edge
+                float(np.nextafter(d, np.inf)),   # just above the edge
+            ]
+        probes += [-100.0, 100.0, np.nan]
+        v = np.array(probes, np.float32)
+        codes = cq.encode(v).astype(np.int64)
+        want = (np.where(np.isnan(v), -np.inf, v)[:, None] > thr).sum(1)
+        assert (codes == want).all()
+        # decode then re-bin: bit-identical codes
+        decoded = cq.reps[codes]
+        rebinned = (decoded[:, None] > thr).sum(axis=1)
+        assert (rebinned == codes).all()
+
+    def test_bins_duplicate_thresholds(self):
+        # repeated edges make some codes unreachable; reachable codes
+        # must still round-trip exactly
+        thr = np.array([1.0, 1.0, 2.0], np.float32)
+        cq = ColumnQuant.bins(thr)
+        assert cq is not None
+        v = np.array([0.5, 1.0, 1.5, 2.0, 2.5], np.float32)
+        codes = cq.encode(v).astype(np.int64)
+        rebinned = (cq.reps[codes][:, None] > thr).sum(axis=1)
+        assert (rebinned == codes).all()
+
+    def test_bins_too_many_falls_back(self):
+        assert ColumnQuant.bins(np.arange(N_CODES, dtype=np.float32)) is None
+
+    def test_plan_json_roundtrip_is_deterministic(self):
+        thr = np.array([0.0, 1.0], np.float32)
+        plan = QuantPlan([
+            ColumnQuant.affine(-1.0, 1.0),
+            ColumnQuant.bins(thr),
+            ColumnQuant.affine(2.0, 2.0),
+        ])
+        clone = QuantPlan.from_json(plan.to_json())
+        assert clone.descriptor() == plan.descriptor() == "q8abc"
+        np.testing.assert_array_equal(clone.reps_table(), plan.reps_table())
+        assert clone.errors() == plan.errors()
+
+    def test_dequantize_gather(self):
+        plan = QuantPlan([
+            ColumnQuant.affine(0.0, 10.0), ColumnQuant.affine(-4.0, 4.0),
+        ])
+        vals = np.array([[0.0, -4.0], [10.0, 4.0]], np.float32)
+        codes = plan.encode(vals)
+        out = np.asarray(dequantize(codes, plan.reps_table()))
+        np.testing.assert_allclose(out, vals, atol=1e-6)
+
+
+# ------------------------------------------------------------ end-to-end
+def _mixed_ds(n=192, seed=17):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    city = [["a", "b", "c", "d"][i % 4] for i in range(n)]
+    label = (x1 + 0.5 * x2 + 0.2 * rng.normal(size=n) > 0).astype(float)
+    ds = Dataset.of({
+        "label": column_from_values(T.RealNN, label),
+        "x1": column_from_values(T.Real, x1),
+        "x2": column_from_values(T.Real, x2),
+        "city": column_from_values(T.PickList, city),
+    })
+    rows = [
+        {"x1": float(a), "x2": float(b), "city": c}
+        for a, b, c in zip(x1, x2, city)
+    ]
+    return ds, rows, label
+
+
+def _train(models):
+    uid_util.reset()
+    ds, rows, label = _mixed_ds()
+    resp, preds = from_dataset(ds, response="label")
+    vec = transmogrify(list(preds))
+    sel = BinaryClassificationModelSelector(
+        seed=7, models=models, num_folds=2,
+    )
+    pred = sel.set_input(resp, vec).get_output()
+    model = (
+        Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    )
+    return model, rows, label
+
+
+def _probs(out):
+    return np.array(
+        [next(iter(r.values()))["probability_1"] for r in out]
+    )
+
+
+@pytest.fixture
+def no_host_predict(monkeypatch):
+    monkeypatch.setenv("TPTPU_HOST_PREDICT_MAX", "0")
+
+
+class TestQuantizedFlows:
+    def test_tree_predictions_bit_identical(self, no_host_predict):
+        model, rows, _ = _train(
+            [(XGBoostClassifier(num_round=3, max_depth=3), {"eta": [0.3]})]
+        )
+        base = score_function(model)
+        base.prime_fused()
+        quant = score_function(model, quantized=True)
+        quant.prime_fused()
+        assert quant.metadata()["fused"]["quantized"] is True
+        p0 = _probs(base.batch(rows))
+        p1 = _probs(quant.batch(rows))
+        # bin-aligned codes re-bin identically in-graph: BIT-identical
+        np.testing.assert_array_equal(p0, p1)
+        # and the ledger proves it: bins/constant columns carry zero error
+        prog = quant.audit().to_json()["fusedProgram"]
+        for errs in prog["quantError"].values():
+            assert all(e == 0.0 for e in errs)
+
+    def test_glm_aupr_within_budget(self, no_host_predict):
+        model, rows, label = _train(
+            [(LogisticRegression(), {"reg_param": [0.01]})]
+        )
+        base = score_function(model)
+        base.prime_fused()
+        quant = score_function(model, quantized=True)
+        quant.prime_fused()
+        p0 = _probs(base.batch(rows))
+        p1 = _probs(quant.batch(rows))
+        a0 = aupr(label, p0)
+        a1 = aupr(label, p1)
+        assert abs(a0 - a1) <= 1e-3
+        # affine ledger: bounded, non-degenerate error advertised
+        prog = quant.audit().to_json()["fusedProgram"]
+        assert prog["quantized"] is True
+        errs = [e for v in prog["quantError"].values() for e in v]
+        assert all(0.0 <= e < 0.1 for e in errs)
+
+    def test_upload_bytes_cut_at_least_2x(self, no_host_predict):
+        model, rows, _ = _train(
+            [(LogisticRegression(), {"reg_param": [0.01]})]
+        )
+        ups = {}
+        for name, kw in (("f32", {}), ("quant", {"quantized": True})):
+            fn = score_function(model, **kw)
+            fn.prime_fused()
+            fn.batch(rows)
+            ups[name] = fn.audit().to_json()["transferCensus"][
+                "upBytesPerRow"
+            ]
+        assert ups["quant"] * 2 <= ups["f32"]
+
+    def test_quant_plan_persisted_in_describe(self, no_host_predict):
+        model, rows, _ = _train(
+            [(LogisticRegression(), {"reg_param": [0.01]})]
+        )
+        fn = score_function(model, quantized=True)
+        fn.prime_fused()
+        fn.batch(rows[:8])
+        prog = fn.audit().to_json()["fusedProgram"]
+        # the manifest payload round-trips to the identical plan
+        for plan_json in prog["quantPlans"].values():
+            clone = QuantPlan.from_json(plan_json)
+            assert clone.to_json() == plan_json
+
+    def test_quantized_fingerprint_differs(self, no_host_predict):
+        model, rows, _ = _train(
+            [(LogisticRegression(), {"reg_param": [0.01]})]
+        )
+        fps = {}
+        for name, kw in (("f32", {}), ("quant", {"quantized": True})):
+            fn = score_function(model, **kw)
+            fn.prime_fused()
+            fps[name] = fn.metadata()["fused"]["fingerprint"]
+        assert fps["f32"] and fps["quant"]
+        # rewritten members change the structural descriptor — the bank
+        # must never replay an f32 executable for a quantized plan
+        assert fps["f32"] != fps["quant"]

@@ -85,6 +85,7 @@ __all__ = [
 SPEC_MODULES = (
     "transmogrifai_tpu.models.gbdt",
     "transmogrifai_tpu.models.trees",
+    "transmogrifai_tpu.models.serve_pallas",
     "transmogrifai_tpu.models.solvers",
     "transmogrifai_tpu.ops.embeddings",
     "transmogrifai_tpu.compiler.fused",

@@ -75,7 +75,13 @@ class Unfuseable(Exception):
 class MemberPlan:
     """One combiner member's device twin: host ``ingest`` (codecs /
     interning only), traced ``kernel`` rebuilding the member's dense block
-    on device, and its fit-static ``params`` arrays."""
+    on device, and its fit-static ``params`` arrays. ``quant`` is the
+    builder's hint to the quantized-plane pass (``build_fused_plan(...,
+    quantize=True)``): ``kind="numeric"`` members carry fit ranges so the
+    value upload can shrink to uint8 codes + an in-graph dequant, and
+    ``kind="codes"`` members advertise their code range so the int32
+    upload can narrow to int8/int16. ``None`` means the member always
+    ships as built."""
 
     stage: Any
     width: int
@@ -85,6 +91,7 @@ class MemberPlan:
     params: dict
     dummy: Callable[[int], dict]            # n -> ShapeDtype-correct zeros
     descriptor: str = ""
+    quant: dict | None = None
 
     @property
     def output_name(self) -> str:
@@ -184,6 +191,7 @@ def build_fused_plan(
     raw_features,
     result_names: Sequence[str],
     fusion=None,
+    quantize: bool = False,
 ) -> "FusedServingProgram":
     """Compile the fitted serving ``plan`` into a :class:`FusedServingProgram`
     or raise :class:`Unfuseable` naming the obstruction.
@@ -192,7 +200,16 @@ def build_fused_plan(
     ``VectorsCombiner`` plane (every member exposing ``fused_member_spec``),
     an optional chain of ``FeatureRemovalModel`` gathers, and ONE terminal
     predictor exposing ``fused_predict_spec``. ``fusion`` (the closure's
-    FusionPlanner) cross-checks learned widths when it has any."""
+    FusionPlanner) cross-checks learned widths when it has any.
+
+    ``quantize=True`` rewrites eligible members onto the quantized plane
+    (``featurize/quantize.py``): numeric value columns upload as uint8
+    codes with a traced reps-table dequant ahead of the member kernel —
+    bin-aligned against a tree predictor's ``fused_bin_thresholds`` (bit
+    identical), affine over the fit ranges otherwise — and code-typed
+    members narrow their int32 codes to the smallest integer dtype. A
+    member that cannot be quantized keeps its f32 plane; the program
+    still builds."""
     from ..models.base import PredictorModel
     from ..ops.combiner import VectorsCombiner
     from ..prep.derived_filter import FeatureRemovalModel
@@ -296,6 +313,41 @@ def build_fused_plan(
             f"{width}"
         )
 
+    quant_plans: dict[str, Any] = {}
+    quantized_members: list[str] = []
+    if quantize:
+        # map plane columns through the composed gather chain to the
+        # predictor's input positions — a tree predictor's per-input
+        # thresholds then give exact bin-aligned codes for the value
+        # columns that survive the feature removals
+        composed = np.arange(plane_width)
+        for idx in gathers:
+            composed = composed[idx]
+        plane_to_pred = {int(p): k for k, p in enumerate(composed)}
+        thr_fn = getattr(predictor, "fused_bin_thresholds", None)
+        pred_thr = thr_fn() if thr_fn is not None else None
+        out_members: list[MemberPlan] = []
+        off = 0
+        for m in members:
+            kind = (m.quant or {}).get("kind")
+            if kind == "numeric":
+                new_m, qp = _quantize_numeric_member(
+                    m, off, plane_to_pred, pred_thr
+                )
+                if qp is not None:
+                    quant_plans[m.output_name] = qp
+                    quantized_members.append(m.output_name)
+                out_members.append(new_m)
+            elif kind == "codes":
+                new_m, changed = _shrink_codes_member(m)
+                if changed:
+                    quantized_members.append(m.output_name)
+                out_members.append(new_m)
+            else:
+                out_members.append(m)
+            off += m.width
+        members = out_members
+
     descriptor = "|".join(
         [m.descriptor or f"{type(m.stage).__name__}:{m.width}"
          for m in members]
@@ -315,7 +367,117 @@ def build_fused_plan(
         plane_width=plane_width,
         width=width,
         fingerprint=fingerprint,
+        quant_plans=quant_plans,
+        quantized_members=tuple(quantized_members),
     )
+
+
+def _quantize_numeric_member(member, offset, plane_to_pred, pred_thr):
+    """Rewrite one numeric member onto uint8 codes + in-graph dequant.
+    Per value column (plane col = offset + j·stride): bin-aligned codes
+    when the gather chain maps it onto a predictor input with thresholds,
+    affine over the fit range otherwise; a column the gathers DROP decodes
+    to an exact constant (nothing downstream reads it). Returns
+    ``(member, None)`` unchanged when any column has neither thresholds
+    nor a fit range — partial members would split the upload for no win."""
+    from ..featurize.quantize import ColumnQuant, QuantPlan, dequantize
+
+    hint = member.quant
+    n_feats = int(hint["n_feats"])
+    track_nulls = bool(hint["track_nulls"])
+    ranges = hint.get("ranges")
+    stride = 2 if track_nulls else 1
+    cols: list = []
+    for j in range(n_feats):
+        k = plane_to_pred.get(offset + j * stride)
+        cq = None
+        if k is not None and pred_thr is not None and k < pred_thr.shape[0]:
+            cq = ColumnQuant.bins(pred_thr[k])
+        if cq is None and ranges is not None:
+            cq = ColumnQuant.affine(float(ranges[j][0]), float(ranges[j][1]))
+        if cq is None and k is None:
+            cq = ColumnQuant.affine(0.0, 0.0)
+        if cq is None:
+            return member, None
+        cols.append(cq)
+    qplan = QuantPlan(cols)
+    orig_ingest = member.ingest
+    orig_kernel = member.kernel
+    orig_dummy = member.dummy
+
+    def ingest(raw_cols: list) -> dict:
+        d = orig_ingest(raw_cols)
+        return {"codes": qplan.encode(d["vals"]), "mask": d["mask"]}
+
+    def kernel(ing: dict, p: dict):
+        vals = dequantize(ing["codes"], p["qreps"])
+        return orig_kernel({"vals": vals, "mask": ing["mask"]}, p)
+
+    def dummy(n: int) -> dict:
+        d = orig_dummy(n)
+        return {
+            "codes": np.zeros(d["vals"].shape, dtype=np.uint8),
+            "mask": d["mask"],
+        }
+
+    return dataclasses.replace(
+        member,
+        # 1 B code + 1 B mask per feature (was 4 + 1)
+        up_bytes_per_row=float(n_feats * 2),
+        ingest=ingest, kernel=kernel,
+        params={**member.params, "qreps": qplan.reps_table()},
+        dummy=dummy,
+        descriptor=member.descriptor + ":" + qplan.descriptor(),
+        quant=None,
+    ), qplan
+
+
+def _shrink_codes_member(member):
+    """Narrow a code-typed member's int32 upload to the smallest integer
+    dtype its advertised code range fits (the kernel widens back to int32
+    before the original kernel runs, so the trace is unchanged past the
+    cast). Returns ``(member, False)`` when int32 is already required."""
+    import jax.numpy as jnp
+
+    hint = member.quant
+    lo = int(hint.get("min_code", 0))
+    hi = int(hint["max_code"])
+    if -128 <= lo and hi <= 127:
+        dt = np.int8
+    elif -32768 <= lo and hi <= 32767:
+        dt = np.int16
+    else:
+        return member, False
+    itemsize = int(np.dtype(dt).itemsize)
+    codes_per_row = int(hint["codes_per_row"])
+    orig_ingest = member.ingest
+    orig_kernel = member.kernel
+    orig_dummy = member.dummy
+
+    def ingest(raw_cols: list) -> dict:
+        d = orig_ingest(raw_cols)
+        d["codes"] = d["codes"].astype(dt)
+        return d
+
+    def kernel(ing: dict, p: dict):
+        ing = dict(ing)
+        ing["codes"] = ing["codes"].astype(jnp.int32)
+        return orig_kernel(ing, p)
+
+    def dummy(n: int) -> dict:
+        d = orig_dummy(n)
+        d["codes"] = d["codes"].astype(dt)
+        return d
+
+    return dataclasses.replace(
+        member,
+        up_bytes_per_row=float(
+            member.up_bytes_per_row - codes_per_row * (4 - itemsize)
+        ),
+        ingest=ingest, kernel=kernel, dummy=dummy,
+        descriptor=member.descriptor + f":qi{8 * itemsize}",
+        quant=None,
+    ), True
 
 
 class FusedServingProgram:
@@ -325,6 +487,7 @@ class FusedServingProgram:
     def __init__(
         self, members, prefix, fused_stages, combiner, chain, predictor,
         pspec, gathers, plane_width, width, fingerprint,
+        quant_plans=None, quantized_members=(),
     ):
         self.members = members
         self.prefix = prefix
@@ -337,6 +500,12 @@ class FusedServingProgram:
         self.plane_width = plane_width
         self.width = width
         self.fingerprint = fingerprint
+        #: member output -> featurize.quantize.QuantPlan (numeric members
+        #: rewritten onto uint8 codes); code-narrowed members appear in
+        #: quantized_members without a plan
+        self.quant_plans = dict(quant_plans or {})
+        self.quantized_members = tuple(quantized_members)
+        self.quantized = bool(self.quantized_members)
         self.covered = frozenset(t.output_name for t in fused_stages)
         self.up_bytes_per_row = float(
             sum(m.up_bytes_per_row for m in members)
@@ -392,7 +561,7 @@ class FusedServingProgram:
         return _meta_of(producer)
 
     def describe(self) -> dict[str, Any]:
-        return {
+        out = {
             "fingerprint": self.fingerprint,
             "members": [
                 {"stage": m.stage.operation_name, "output": m.output_name,
@@ -406,7 +575,19 @@ class FusedServingProgram:
             "downBytesPerRow": self.down_bytes_per_row,
             "coveredStages": sorted(self.covered),
             "hostPrefixStages": [t.output_name for t in self.prefix],
+            "quantized": self.quantized,
         }
+        if self.quantized:
+            out["quantizedMembers"] = list(self.quantized_members)
+            # per-column max reconstruction error ledger (0.0 for
+            # bin-aligned / constant columns — predictions unaffected)
+            out["quantError"] = {
+                nm: qp.errors() for nm, qp in self.quant_plans.items()
+            }
+            out["quantPlans"] = {
+                nm: qp.to_json() for nm, qp in self.quant_plans.items()
+            }
+        return out
 
     # ------------------------------------------------------------- dispatch
     def _device_params(self):
@@ -534,10 +715,14 @@ class FusedServingProgram:
 # --------------------------------------------------------------------------
 # member-plan builders (called by the stage classes' fused_member_spec)
 # --------------------------------------------------------------------------
-def numeric_member(stage, fills: np.ndarray, track_nulls: bool) -> MemberPlan:
+def numeric_member(
+    stage, fills: np.ndarray, track_nulls: bool, ranges=None
+) -> MemberPlan:
     """Impute + null-track on device. Host ingest = f32 values + validity
     mask; ``where(mask, value, fill)`` matches the staged
-    ``_impute_block`` bit for bit once both land in the f32 plane."""
+    ``_impute_block`` bit for bit once both land in the f32 plane.
+    ``ranges`` (per-column fit-time [lo, hi]) rides the quant hint so a
+    quantized build can shrink the value upload to uint8 codes."""
     fills = np.asarray(fills, dtype=np.float32)
     n_feats = int(fills.shape[0])
     width = n_feats * (2 if track_nulls else 1)
@@ -576,6 +761,10 @@ def numeric_member(stage, fills: np.ndarray, track_nulls: bool) -> MemberPlan:
         descriptor=(
             f"numeric:{n_feats}:{'nulls' if track_nulls else 'plain'}"
         ),
+        quant={
+            "kind": "numeric", "n_feats": n_feats,
+            "track_nulls": track_nulls, "ranges": ranges,
+        },
     )
 
 
@@ -664,6 +853,194 @@ def onehot_member(stage, vocabs, track_nulls, clean_text) -> MemberPlan:
             "onehot:" + ",".join(map(str, widths))
             + (":nulls" if track_nulls else "")
         ),
+        quant={
+            "kind": "codes", "min_code": -2,
+            "max_code": max(len(v) for v in vocabs) - 1,
+            "codes_per_row": n_feats,
+        },
+    )
+
+
+def hashed_text_member(
+    stage, methods, num_hashes: int, track_nulls: bool, binary_freq: bool,
+    to_lowercase: bool, min_token_length: int, seed: int,
+) -> MemberPlan:
+    """HashingTF text planes rebuilt as a device scatter (leg of ROADMAP
+    item 1 that previously raised :class:`Unfuseable` and forced text
+    flows back to the staged loop). The host side stays a codec — tokenize
+    + murmur3 yields at most ``TPTPU_TEXT_FUSED_TOKENS`` (default 16)
+    DISTINCT hash buckets per row per slot as int32 codes with f32
+    occurrence weights — and the kernel scatters them into the
+    ``num_hashes``-wide block in-graph, exactly like the OneHot code
+    path. Binary term frequency applies ``> 0`` after the scatter so
+    duplicate-bucket collisions match the staged set semantics; rows with
+    more distinct buckets than the cap raise at ingest, which the serving
+    seam counts as a dispatch fallback (the batch degrades, the program
+    stays). ``Pivot`` slots are not handled here — the SmartText wrapper
+    composes those separately or refuses."""
+    import os
+
+    from ..ops import text as _text_ops
+
+    hash_slots = [
+        i for i, m in enumerate(methods) if m == _text_ops.HASH
+    ]
+    if not hash_slots:
+        raise Unfuseable("smart-text member has no hashed slots")
+    if any(m == _text_ops.PIVOT for m in methods):
+        raise Unfuseable(
+            "smart-text member mixes Pivot and Hash slots — not fuseable"
+        )
+    n_slots = len(methods)
+    n_hash = len(hash_slots)
+    k_cap = int(os.environ.get("TPTPU_TEXT_FUSED_TOKENS", "16"))
+    widths = [
+        (num_hashes if m == _text_ops.HASH else 0)
+        + (1 if track_nulls else 0)
+        for m in methods
+    ]
+    total = int(sum(widths))
+    if total <= 0:
+        raise Unfuseable("smart-text member has zero fused width")
+
+    def _slot_codes(values, n: int):
+        """One slot's (codes [n, k_cap] int32, weights [n, k_cap] f32,
+        null flags [n] uint8). Sentinel code ``num_hashes`` routes to a
+        dump column sliced off after the scatter."""
+        from .. import native as _native
+        from ..utils import text as _text_util
+
+        texts, rows_idx = _text_ops._partition_nulls(values)
+        nulls = np.ones(n, dtype=np.uint8)
+        nulls[rows_idx] = 0
+        coo = None
+        if texts:
+            coo = _native.tokenize_hash_coo(
+                texts, rows_idx, num_hashes, seed=seed, binary=binary_freq,
+                to_lowercase=to_lowercase, min_token_length=min_token_length,
+                prefix="",
+            )
+        if coo is not None:
+            rows, hcols = coo
+            rows = np.asarray(rows, dtype=np.int64)
+            hcols = np.asarray(hcols, dtype=np.int64)
+        else:
+            r_parts, c_parts = [], []
+            for raw, row in zip(texts, rows_idx):
+                toks = _text_util.tokenize(
+                    raw, to_lowercase=to_lowercase,
+                    min_token_length=min_token_length,
+                )
+                if not toks:
+                    continue
+                h = _native.murmur3_batch(toks, seed=seed)
+                j = (h % np.uint32(num_hashes)).astype(np.int64)
+                if binary_freq:
+                    j = np.unique(j)
+                r_parts.append(np.full(j.shape[0], row, dtype=np.int64))
+                c_parts.append(j)
+            rows = (
+                np.concatenate(r_parts) if r_parts
+                else np.zeros(0, dtype=np.int64)
+            )
+            hcols = (
+                np.concatenate(c_parts) if c_parts
+                else np.zeros(0, dtype=np.int64)
+            )
+        codes = np.full((n, k_cap), num_hashes, dtype=np.int32)
+        weights = np.zeros((n, k_cap), dtype=np.float32)
+        if rows.size:
+            # collapse duplicate (row, bucket) pairs to one slot with an
+            # occurrence count; rank-within-row via the sorted row runs
+            pair = rows * np.int64(num_hashes) + hcols
+            uniq, counts = np.unique(pair, return_counts=True)
+            ur = uniq // np.int64(num_hashes)
+            uc = uniq % np.int64(num_hashes)
+            pos = np.arange(uniq.size) - np.searchsorted(ur, ur)
+            k_max = int(pos.max()) + 1
+            if k_max > k_cap:
+                raise Unfuseable(
+                    f"text row needs {k_max} distinct hash buckets "
+                    f"(> TPTPU_TEXT_FUSED_TOKENS={k_cap})"
+                )
+            codes[ur, pos] = uc.astype(np.int32)
+            weights[ur, pos] = counts.astype(np.float32)
+        return codes, weights, nulls
+
+    def ingest(cols: list) -> dict:
+        from ..types.columns import TextColumn
+
+        n = len(cols[0])
+        raw = [
+            c.values if isinstance(c, TextColumn) else c.to_list()
+            for c in cols
+        ]
+        codes = np.empty((n, n_hash, k_cap), dtype=np.int32)
+        weights = np.empty((n, n_hash, k_cap), dtype=np.float32)
+        nulls = np.zeros((n, n_slots), dtype=np.uint8)
+        hs = 0
+        for s in range(n_slots):
+            if methods[s] == _text_ops.HASH:
+                codes[:, hs], weights[:, hs], nulls[:, s] = _slot_codes(
+                    raw[s], n
+                )
+                hs += 1
+            else:  # Ignore: null indicator only
+                _, rows_idx = _text_ops._partition_nulls(raw[s])
+                nulls[:, s] = 1
+                nulls[rows_idx, s] = 0
+        out = {"codes": codes, "weights": weights}
+        if track_nulls:
+            out["nulls"] = nulls
+        return out
+
+    def kernel(ing: dict, p: dict):
+        import jax.numpy as jnp
+
+        n = ing["codes"].shape[0]
+        rows = jnp.arange(n)[:, None]
+        blocks = []
+        hs = 0
+        for s in range(n_slots):
+            if methods[s] == _text_ops.HASH:
+                acc = jnp.zeros((n, num_hashes + 1), jnp.float32).at[
+                    rows, ing["codes"][:, hs, :]
+                ].add(ing["weights"][:, hs, :])
+                block = acc[:, :num_hashes]
+                if binary_freq:
+                    block = (block > 0).astype(jnp.float32)
+                blocks.append(block)
+                hs += 1
+            if track_nulls:
+                blocks.append(ing["nulls"][:, s:s + 1].astype(jnp.float32))
+        return blocks[0] if len(blocks) == 1 else jnp.concatenate(
+            blocks, axis=1
+        )
+
+    def dummy(n: int) -> dict:
+        out = {
+            "codes": np.full((n, n_hash, k_cap), num_hashes, np.int32),
+            "weights": np.zeros((n, n_hash, k_cap), np.float32),
+        }
+        if track_nulls:
+            out["nulls"] = np.zeros((n, n_slots), np.uint8)
+        return out
+
+    return MemberPlan(
+        stage=stage, width=total,
+        up_bytes_per_row=float(
+            n_hash * k_cap * 8 + (n_slots if track_nulls else 0)
+        ),
+        ingest=ingest, kernel=kernel, params={}, dummy=dummy,
+        descriptor=(
+            f"hashtext:{num_hashes}x{n_hash}:k{k_cap}"
+            + (":bin" if binary_freq else "")
+            + (":nulls" if track_nulls else "")
+        ),
+        quant={
+            "kind": "codes", "min_code": 0, "max_code": num_hashes,
+            "codes_per_row": n_hash * k_cap,
+        },
     )
 
 

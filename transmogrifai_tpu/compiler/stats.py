@@ -58,6 +58,10 @@ class CompileStats(_tm.LedgerCore):
         #: per-program-name compile counts — lets tests pin "this sweep
         #: compiled exactly one logistic program" without global noise
         self._compiled_by_name: dict[str, int] = {}
+        #: per-reason fused degradations — keys are the fallback reason
+        #: strings the serving seam counts (``unfuseable``,
+        #: ``dispatch_error``, ``prefix_degraded``, ...)
+        self._fused_fallback_reasons: dict[str, int] = {}
 
     # ------------------------------------------------------------ recording
     def record_compile(self, name: str) -> None:
@@ -86,9 +90,23 @@ class CompileStats(_tm.LedgerCore):
             if lanes > 0:
                 self._counts["fusedExplainLanes"] += lanes
 
-    def record_fused_fallback(self) -> None:
+    def record_fused_fallback(self, reason: str | None = None) -> None:
         with self._lock:
             self._counts["fusedFallbacks"] += 1
+            if reason:
+                self._fused_fallback_reasons[reason] = (
+                    self._fused_fallback_reasons.get(reason, 0) + 1
+                )
+
+    def record_unfused_batch(self, reason: str) -> None:
+        """A batch that *could not even attempt* the fused graph (the plan
+        raised ``Unfuseable`` at build) — counted only in the per-reason
+        sub-map so the global ``fusedFallbacks`` counter keeps its
+        degraded-at-dispatch semantics."""
+        with self._lock:
+            self._fused_fallback_reasons[reason] = (
+                self._fused_fallback_reasons.get(reason, 0) + 1
+            )
 
     def record_warmup(self, programs: int, overlap_s: float) -> None:
         with self._lock:
@@ -104,6 +122,7 @@ class CompileStats(_tm.LedgerCore):
             out: dict = dict(self._counts)
             out["warmupOverlapSeconds"] = round(self._warmup_overlap_s, 3)
             out["programsCompiledByName"] = dict(self._compiled_by_name)
+            out["fusedFallbackReasons"] = dict(self._fused_fallback_reasons)
         out["compileCacheHitRate"] = _hit_rate(out)
         return out
 
@@ -112,6 +131,7 @@ class CompileStats(_tm.LedgerCore):
             self._reset_counts()
             self._warmup_overlap_s = 0.0
             self._compiled_by_name = {}
+            self._fused_fallback_reasons = {}
 
 
 def _hit_rate(counts: dict) -> float | None:
@@ -142,6 +162,10 @@ def delta(before: dict) -> dict:
     out["programsCompiledByName"] = _tm.named_delta(
         now["programsCompiledByName"],
         before.get("programsCompiledByName", {}),
+    )
+    out["fusedFallbackReasons"] = _tm.named_delta(
+        now["fusedFallbackReasons"],
+        before.get("fusedFallbackReasons", {}),
     )
     out["compileCacheHitRate"] = _hit_rate(out)
     return out

@@ -27,13 +27,15 @@ _LOCK = threading.Lock()
 _STARTED: dict[tuple, threading.Thread] = {}
 
 #: programs the serving path dispatches (tree predicts bin + traverse on
-#: device above the host-predict cutoff; stack_lane materializes a sweep
-#: winner's lane; fused_serve* are the end-to-end fused scoring graphs of
-#: compiler/fused.py — banked per structural fingerprint)
+#: device above the host-predict cutoff; serve_trees is the Pallas
+#: multi-tree traversal kernel of models/serve_pallas.py; stack_lane
+#: materializes a sweep winner's lane; fused_serve* are the end-to-end
+#: fused scoring graphs of compiler/fused.py — banked per structural
+#: fingerprint)
 SCORE_PROGRAMS = frozenset(
     {
         "predict_boosted", "predict_forest", "bin_data", "stack_lane",
-        "fused_serve", "fused_serve_explain",
+        "serve_trees", "fused_serve", "fused_serve_explain",
     }
 )
 

@@ -1,0 +1,223 @@
+"""Quantized serving planes (ROADMAP item 4, device residency leg a).
+
+BENCH_r08 pins the fused serving graph's remaining boundary cost: 14 B/row
+still crosses host→device in full f32 per batch. For a FITTED model that
+payload is overdescribed — every numeric column has a fit-time value range
+(the vectorizers' monoid min/max), and a tree predictor immediately
+re-bins the plane into at most ``max_bins`` codes anyway. This module
+compresses each numeric value column to ONE uint8 code per row with a
+per-column decode table traced into the fused program:
+
+* **bin-aligned** (tree predictors): the host encodes each value to its
+  EXACT bin under the predictor's thresholds (``bin_data_host``
+  semantics: count of thresholds strictly below, f32 compare), and the
+  decode table holds one representative value per bin chosen (and
+  self-verified at build) to re-bin to the same code in-graph — tree
+  predictions stay **bit-identical** to the f32 plane;
+* **affine** (GLMs and any column without thresholds): code =
+  ``rint((v - lo) / scale)`` over the fit range ``[lo, hi]``, decode =
+  ``lo + code·scale`` — a dequant epilogue traced in-graph ahead of the
+  predictor core, with the max reconstruction error ``scale/2`` surfaced
+  on the per-column ``quantError`` ledger (serve-time values outside the
+  fit range clamp; ±Inf clamps to the range edge, NaN encodes as ``lo``
+  and is masked by the imputation ``where`` anyway);
+* **constant / all-null** columns (no usable range) decode exactly to
+  ``lo`` with zero error.
+
+Both modes share ONE in-graph decode: a ``[F, 256]`` f32 reps-table
+gather (:func:`dequantize`), uploaded once with the model params at
+program bring-up — the per-batch upload is the uint8 codes alone. The
+plan is deterministic from the persisted fit ranges + model arrays
+(``to_json``/``from_json`` round-trips it for the manifest), so a
+reloaded model rebuilds the identical plan.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+__all__ = ["ColumnQuant", "QuantPlan", "N_CODES", "dequantize"]
+
+#: uint8 code space — one byte per value per row on the wire
+N_CODES = 256
+
+
+@dataclasses.dataclass
+class ColumnQuant:
+    """One column's code↔value contract: ``mode`` ∈ {affine, bins,
+    constant}, a 256-entry f32 decode table ``reps``, and the encode
+    parameters for its mode. ``quant_error`` bounds the absolute
+    reconstruction error for in-range values (0.0 when predictions are
+    provably unaffected: bins / constant)."""
+
+    mode: str
+    lo: float
+    hi: float
+    scale: float
+    reps: np.ndarray
+    quant_error: float
+    thresholds: np.ndarray | None = None  # sorted f32, bins mode only
+
+    @classmethod
+    def affine(cls, lo: float, hi: float) -> "ColumnQuant":
+        """Uniform uint8 grid over the fit range [lo, hi]. Non-finite
+        range edges clamp to a finite span; a degenerate range becomes a
+        constant column (codes all 0, decode exact)."""
+        lo = float(np.float32(lo))
+        hi = float(np.float32(hi))
+        if not np.isfinite(lo):
+            lo = 0.0
+        if not np.isfinite(hi):
+            hi = lo
+        if hi <= lo:
+            reps = np.full(N_CODES, np.float32(lo))
+            return cls("constant", lo, lo, 0.0, reps, 0.0)
+        scale = (hi - lo) / (N_CODES - 1)
+        reps = (
+            np.float32(lo)
+            + np.float32(scale) * np.arange(N_CODES, dtype=np.float32)
+        ).astype(np.float32)
+        # the grid is f32; the realized half-step bounds the error
+        err = float(np.max(np.diff(reps))) / 2.0
+        return cls("affine", lo, hi, float(scale), reps, err)
+
+    @classmethod
+    def bins(cls, thresholds: np.ndarray) -> "ColumnQuant | None":
+        """Bin-aligned codes for one predictor column: code = number of
+        thresholds strictly below the value (``bin_data`` semantics, f32
+        compare), decode = a representative that re-bins to the same
+        code. Returns None when the column cannot be represented (more
+        than 256 bins, or the self-verification fails) — the caller
+        falls back to affine."""
+        thr = np.asarray(thresholds, dtype=np.float32).ravel()
+        finite = np.sort(thr[np.isfinite(thr)])
+        n_bins = int(thr.shape[0]) + 1
+        if n_bins > N_CODES:
+            return None
+        reps = np.zeros(N_CODES, dtype=np.float32)
+        if finite.size == 0:
+            # every value bins to 0 (x > NaN is False on device)
+            return cls("bins", 0.0, 0.0, 0.0, reps, 0.0, finite)
+        # bin 0: any value ≤ the smallest threshold (strictly-below count
+        # is 0 at the threshold itself)
+        reps[0] = finite[0]
+        achievable = {0}
+        last = reps[0]
+        uniq = np.unique(finite)
+        for b in range(1, n_bins):
+            # bin b is reachable iff some distinct edge d has exactly b
+            # thresholds ≤ d; the next representable f32 above d then has
+            # exactly b thresholds strictly below it
+            cand = None
+            for d in uniq:
+                if int((finite <= d).sum()) == b:
+                    cand = np.nextafter(np.float32(d), np.float32(np.inf))
+                    break
+            if cand is not None:
+                achievable.add(b)
+                last = np.float32(cand)
+            reps[b] = last
+        reps[n_bins:] = last
+        # self-verify: every achievable code's rep re-bins to itself
+        # under the exact device semantics
+        rebinned = (reps[:n_bins, None] > finite[None, :]).sum(axis=1)
+        for b in achievable:
+            if int(rebinned[b]) != b:
+                return None
+        return cls("bins", float(finite[0]), float(finite[-1]), 0.0,
+                   reps, 0.0, finite)
+
+    def encode(self, vals: np.ndarray) -> np.ndarray:
+        """Host codec: f32 values → uint8 codes (the only per-batch
+        upload for this column)."""
+        v = np.asarray(vals, dtype=np.float32)
+        if self.mode == "constant":
+            return np.zeros(v.shape, dtype=np.uint8)
+        if self.mode == "bins":
+            thr = self.thresholds
+            if thr is None or thr.size == 0:
+                return np.zeros(v.shape, dtype=np.uint8)
+            # count of thresholds strictly below = searchsorted-left over
+            # the sorted edges; NaN routes to bin 0 like bin_data_host
+            x = np.where(np.isnan(v), np.float32(-np.inf), v)
+            return np.searchsorted(thr, x, side="left").astype(np.uint8)
+        # affine: NaN → lo (masked by imputation anyway); ±Inf rides the
+        # clip to the range edges
+        x = np.where(np.isnan(v), np.float32(self.lo), v)
+        with np.errstate(invalid="ignore"):
+            q = np.rint(
+                (x - np.float32(self.lo)) / np.float32(self.scale)
+            )
+        return np.clip(q, 0, N_CODES - 1).astype(np.uint8)
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "mode": self.mode,
+            "lo": self.lo,
+            "hi": self.hi,
+            "scale": self.scale,
+            "quantError": self.quant_error,
+        }
+        if self.thresholds is not None:
+            out["thresholds"] = [float(t) for t in self.thresholds]
+        return out
+
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "ColumnQuant":
+        if d["mode"] == "bins":
+            got = cls.bins(np.asarray(d.get("thresholds", []), np.float32))
+            if got is not None:
+                return got
+        if d["mode"] == "constant":
+            return cls.affine(d["lo"], d["lo"])
+        return cls.affine(d["lo"], d["hi"])
+
+
+class QuantPlan:
+    """Per-column quantization of one member's value columns. The encode
+    side runs in the member's host ingest; the reps table is a model
+    param the traced :func:`dequantize` gathers from in-graph."""
+
+    def __init__(self, columns: list[ColumnQuant]):
+        self.columns = list(columns)
+
+    def reps_table(self) -> np.ndarray:
+        """[F, 256] f32 decode table (uploaded once with model params)."""
+        return np.stack([c.reps for c in self.columns]).astype(np.float32)
+
+    def encode(self, vals: np.ndarray) -> np.ndarray:
+        """[N, F] f32 → [N, F] uint8 (4× fewer bytes on the wire)."""
+        out = np.empty(vals.shape, dtype=np.uint8)
+        for j, c in enumerate(self.columns):
+            out[:, j] = c.encode(vals[:, j])
+        return out
+
+    def errors(self) -> list[float]:
+        """Per-column max reconstruction error (the quantError ledger)."""
+        return [float(c.quant_error) for c in self.columns]
+
+    def descriptor(self) -> str:
+        """Structural fingerprint contribution: per-column modes only —
+        the reps table is a traced param, so same-shaped plans share
+        executables like every other model array."""
+        tags = {"affine": "a", "bins": "b", "constant": "c"}
+        return "q8" + "".join(tags[c.mode] for c in self.columns)
+
+    def to_json(self) -> dict[str, Any]:
+        return {"columns": [c.to_json() for c in self.columns]}
+
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "QuantPlan":
+        return cls([ColumnQuant.from_json(c) for c in d["columns"]])
+
+
+def dequantize(codes, reps):
+    """In-graph decode (the dequant epilogue): codes [N, F] uint8 +
+    reps [F, 256] f32 → values [N, F] f32 via one per-column table
+    gather. Traced inside the member kernels of ``compiler/fused.py``."""
+    import jax.numpy as jnp
+
+    f = reps.shape[0]
+    return reps[jnp.arange(f)[None, :], codes.astype(jnp.int32)]

@@ -162,6 +162,7 @@ def score_function(
     breaker: BreakerConfig | bool | None = None,
     drift: DriftConfig | bool | None = None,
     isolation: str = "degrade",
+    quantized: bool | None = None,
 ) -> Callable[[dict[str, Any]], dict[str, Any]]:
     """Returns ``row_dict -> result_dict`` (model.scoreFunction,
     OpWorkflowModelLocal.scala:79). Result keys are the result-feature names;
@@ -180,7 +181,14 @@ def score_function(
     error over silent default predictions. The installed components are
     exposed as ``score_fn.guard`` / ``.sentinel`` / ``.breakers`` /
     ``.drift`` / ``.quarantine`` and their counters via
-    ``score_fn.metadata()``."""
+    ``score_fn.metadata()``.
+
+    ``quantized=True`` builds the fused serving program over the
+    quantized feature plane (featurize/quantize.py): numeric value
+    columns cross the boundary as uint8 codes with an in-graph dequant
+    epilogue, categorical code columns shrink to their narrowest dtype.
+    ``None`` (the default) defers to the ``TPTPU_FUSED_QUANT`` env knob;
+    staged scoring and parity seams are unaffected either way."""
     from ..compiler import warmup as _warmup
     from ..models.base import PredictorModel
     from ..workflow.dag import compute_dag
@@ -318,8 +326,14 @@ def score_function(
     }
     fused_counters: dict[str, Any] = {
         "dispatches": 0, "fallbacks": 0, "lastFallback": None,
-        "consecutiveErrors": 0,
+        "consecutiveErrors": 0, "fallbackReasons": {},
     }
+    #: quantized-plane opt-in resolves once at closure build: the arg
+    #: wins, else TPTPU_FUSED_QUANT=1
+    _fused_quantized = (
+        quantized if quantized is not None
+        else os.environ.get("TPTPU_FUSED_QUANT", "0") == "1"
+    )
     _fused_lock = threading.Lock()
     #: consecutive dispatch errors that disable the fused program for this
     #: closure — a deterministically-broken program must not re-pay a
@@ -343,7 +357,8 @@ def score_function(
 
                 try:
                     fused_holder["program"] = _fused.build_fused_plan(
-                        plan, raw_features, result_names, fusion=fusion
+                        plan, raw_features, result_names, fusion=fusion,
+                        quantize=_fused_quantized,
                     )
                     log.info(
                         "fused scoring graph ready (%s): %d member(s), "
@@ -376,6 +391,9 @@ def score_function(
         with _fused_lock:
             fused_counters["fallbacks"] += 1
             fused_counters["lastFallback"] = reason
+            fused_counters["fallbackReasons"][reason] = (
+                fused_counters["fallbackReasons"].get(reason, 0) + 1
+            )
             if reason == "dispatch_error":
                 fused_counters["consecutiveErrors"] += 1
                 if (
@@ -395,7 +413,7 @@ def score_function(
                         f"{type(exc).__name__ if exc else reason})"
                     )
                     disabled = True
-        cstats.stats().record_fused_fallback()
+        cstats.stats().record_fused_fallback(reason)
         _tevents.emit("fused_fallback", reason=reason)
         log.warning(
             "fused dispatch degraded to the staged loop (%s%s)%s",
@@ -740,6 +758,22 @@ def score_function(
             and b > _device_predict_min
         ):
             prog = _fused_program()
+            if prog is None and fused_holder["built"]:
+                # the batch was fused-eligible but the plan never
+                # admitted a program: count it per-reason (leg (c)'s
+                # coverage gain is exactly this sub-map shrinking) without
+                # touching the degraded-at-dispatch fusedFallbacks counter
+                why = _fused_reason()
+                if why is not None and why != "TPTPU_FUSED=0":
+                    from ..compiler import stats as cstats
+
+                    with _fused_lock:
+                        fused_counters["fallbackReasons"]["unfuseable"] = (
+                            fused_counters["fallbackReasons"].get(
+                                "unfuseable", 0
+                            ) + 1
+                        )
+                    cstats.stats().record_unfused_batch("unfuseable")
             if prog is not None and any(
                 br.state != "closed"
                 for nm, br in breakers.items() if nm in prog.covered
@@ -1471,15 +1505,22 @@ def score_function(
         with _fused_lock:
             prog = fused_holder["program"]
             fused_snap = dict(fused_counters)
+            fused_snap["fallbackReasons"] = dict(
+                fused_counters["fallbackReasons"]
+            )
         return {
             "analysis": analysis,
             "fused": {
                 "active": prog is not None,
                 "reason": _fused_reason(),
                 "fingerprint": None if prog is None else prog.fingerprint,
+                "quantized": (
+                    prog is not None and getattr(prog, "quantized", False)
+                ),
                 "dispatches": fused_snap["dispatches"],
                 "fallbacks": fused_snap["fallbacks"],
                 "lastFallback": fused_snap["lastFallback"],
+                "fallbackReasons": fused_snap["fallbackReasons"],
             },
             "compileStats": compile_snap,
             "featurizeStats": featurize_snap,

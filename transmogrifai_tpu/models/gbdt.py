@@ -284,6 +284,10 @@ class _BinnedModel(PredictorModel):
         ``predict_*_raw`` programs the staged device path banks, traced
         over the in-graph plane — tree predictions stay bit-identical."""
         from ..compiler.fused import PredictorPlan
+        from .serve_pallas import (
+            predict_boosted_pallas, predict_forest_pallas, serve_impl,
+            serve_interpret,
+        )
 
         trees, boosted = self._tree_stacks()
         ds = self._dev(trees)
@@ -295,9 +299,30 @@ class _BinnedModel(PredictorModel):
         if boosted:
             params["eta"] = np.float32(self.eta)
             params["base"] = np.float32(self.base_score)
+        # implementation is resolved HERE, at spec-build time, never inside
+        # the traced core — the choice is baked into the program and salts
+        # the fused fingerprint (":pl") so the bank never replays a gather
+        # executable for a pallas plan or vice versa
+        pallas = serve_impl() == "pallas"
+        interp = serve_interpret()
 
         def core(plane, p):
-            if boosted:
+            if pallas:
+                binned = TR.bin_data(plane, p["thr"])
+                if boosted:
+                    outs = [
+                        predict_boosted_pallas(
+                            binned, t, p["eta"], p["base"],
+                            interpret=interp,
+                        )
+                        for t in p["trees"]
+                    ]
+                else:
+                    outs = [
+                        predict_forest_pallas(binned, t, interpret=interp)
+                        for t in p["trees"]
+                    ]
+            elif boosted:
                 outs = [
                     TR.predict_boosted_raw(
                         plane, p["thr"], t, p["eta"], p["base"]
@@ -316,8 +341,16 @@ class _BinnedModel(PredictorModel):
             core=core, epilogue=self.predictions_from_core,
             descriptor=(
                 f"{'boost' if boosted else 'forest'}:{len(ds)}"
+                + (":pl" if pallas else "")
             ),
         )
+
+    def fused_bin_thresholds(self) -> np.ndarray:
+        """Per-input bin edges for the quantized fused plane: the
+        quantizer emits bin-aligned uint8 codes that re-bin IDENTICALLY
+        in-graph, so quantized tree predictions stay bit-identical to the
+        f32 plane (``featurize/quantize.py``)."""
+        return np.asarray(self.thresholds, dtype=np.float32)
 
     def detach_from_sweep(self):
         """Cut every reference to the stacked sweep arrays: materialize this

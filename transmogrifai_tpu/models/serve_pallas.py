@@ -1,0 +1,245 @@
+"""Pallas TPU kernel: serve-side vectorized multi-tree traversal.
+
+Serving a fitted ensemble is a traversal, not a matmul: ``predict_tree``
+walks the level arrays with per-row gathers (``lax.scan`` over levels of
+small-table lookups), which lowers to serialized dynamic-gathers on TPU —
+fine at fit time where the histogram build dominates, but at serve time
+the traversal IS the program. This kernel reformulates the whole
+ensemble's traversal as level-synchronous one-hot linear algebra over the
+quantized/binned plane, the same trick the fit-side histogram kernel
+(``models/hist_pallas.py``) uses for its scatter:
+
+    code[r, t, m]  = Σ_f binned[r, f] · 1[split_feat[t, l, m] = f]   (MXU)
+    right[r, t, m] = 1[code > split_bin] · 1[split_feat ≥ 0]          (VPU)
+    p_{l+1}[r, t, 2m + right] = p_l[r, t, m] · selector               (VPU)
+
+i.e. per level one [R, F] x [F, Tt·2^l] matmul routes every (row, tree)
+pair one level down; after ``depth`` levels the node one-hot ``p`` picks
+each row's leaf in one fused multiply-reduce against the leaf table. All
+arithmetic is exact (one-hots and small-int codes in f32), so predictions
+are BIT-IDENTICAL to the gather traversal — parity is pinned by the
+interpret-mode CPU twin in the unit tests, the same twin pattern as
+``hist_pallas``.
+
+Grid: (row tiles, tree tiles); each program touches one [row_tile, F]
+code block and one tree tile's level arrays, VMEM-budgeted like the
+fit-side kernels (~6 MB model, Mosaic double-buffering headroom
+included). Padded rows produce garbage sliced off by the wrapper; padded
+trees carry ``split_feat = -1`` and a zero leaf table so they contribute
+exactly 0 to every sum.
+
+``serve_impl()`` picks the implementation (env ``TPTPU_SERVE_TREES``
+overrides; Pallas on real TPU backends, the gather scan elsewhere), and
+``program_trace_specs()`` registers the kernel with the TPJ program bank
+gate so admissions get bucket-stable fingerprints like every other
+serving program.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _serve_kernel(codes_ref, sf_ref, sb_ref, lv_ref, out_ref, *, depth,
+                  leaf_w):
+    """One (row-tile, tree-tile) step: route the block's rows through the
+    tile's trees level-by-level and emit per-(row, tree) leaf values."""
+    import jax.lax as lax
+
+    codes = codes_ref[...]                      # [R, F] f32 (exact ints)
+    r, f = codes.shape
+    tt = sf_ref.shape[0]
+    noh = jnp.ones((r, tt, 1), jnp.float32)     # node one-hot, root only
+    for lvl in range(depth):
+        nl = 1 << lvl
+        sf_l = sf_ref[:, lvl, :nl]              # [Tt, nl] int32 (-1 leaf)
+        sb_l = sb_ref[:, lvl, :nl]
+        # per-(tree, node) feature one-hot; sf = -1 selects nothing
+        g = (
+            sf_l[:, :, None]
+            == lax.broadcasted_iota(jnp.int32, (tt, nl, f), 2)
+        ).astype(jnp.float32)
+        # routed code per (row, tree, node) — ONE MXU dot per level
+        c = lax.dot_general(
+            codes, g.reshape(tt * nl, f),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(r, tt, nl)
+        go_right = (
+            (c > sb_l[None, :, :].astype(jnp.float32))
+            & (sf_l[None, :, :] >= 0)
+        ).astype(jnp.float32)
+        # children interleave [left0, right0, left1, right1, ...] —
+        # exactly node·2 + go_right of the gather traversal
+        noh = jnp.stack(
+            [noh * (1.0 - go_right), noh * go_right], axis=-1
+        ).reshape(r, tt, 2 * nl)
+    out_ref[...] = jnp.sum(noh * lv_ref[:, :leaf_w][None, :, :], axis=-1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("row_tile", "tree_tile", "interpret")
+)
+def serve_trees_pallas(
+    binned: jax.Array,      # [N, F] int32 bin codes (bin_data output)
+    split_feat: jax.Array,  # [T, depth, 2^depth] int32, -1 = leaf
+    split_bin: jax.Array,   # [T, depth, 2^depth] int32
+    leaf_value: jax.Array,  # [T, 2^depth] f32
+    row_tile: int | None = None,
+    tree_tile: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-tree leaf value for every row -> [N, T] f32, bit-identical to
+    ``vmap(predict_tree)``. Callers reduce (sum for boosting, mean for
+    forests) outside — the reduction is where the families differ."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, f = binned.shape
+    t, depth, w = split_feat.shape
+    leaf_w = int(leaf_value.shape[1])
+    f_pad = _round_up(max(f, 8), 8)
+    w_pad = _round_up(w, 128)
+
+    if row_tile is None:
+        row_tile = 256
+    if tree_tile is None:
+        # big VMEM temporaries per program: the [R, Tt, 2^depth] node
+        # one-hot pair, the level-max [Tt, 2^(depth-1), F] feature
+        # one-hot, and the tree tile's level arrays — budget ~6 MB
+        # (Mosaic double-buffers blocks; measured safe for the fit-side
+        # kernels at this model)
+        def vmem(tt: int) -> int:
+            return (
+                row_tile * f_pad * 4
+                + tt * w_pad * (2 * depth + 1) * 4
+                + 3 * row_tile * tt * w * 4
+                + tt * max(w // 2, 1) * f_pad * 4
+            )
+
+        tree_tile = 8
+        while tree_tile * 2 <= _round_up(t, 8) and vmem(tree_tile * 2) <= (
+            6 << 20
+        ):
+            tree_tile *= 2
+        while vmem(tree_tile) > (6 << 20) and row_tile > 64:
+            row_tile //= 2
+    n_pad = _round_up(max(n, row_tile), row_tile)
+    t_pad = _round_up(max(t, tree_tile), tree_tile)
+
+    codes_p = jnp.zeros((n_pad, f_pad), jnp.float32)
+    codes_p = codes_p.at[:n, :f].set(binned.astype(jnp.float32))
+    sf_p = jnp.full((t_pad, depth, w_pad), -1, jnp.int32)
+    sf_p = sf_p.at[:t, :, :w].set(split_feat)
+    sb_p = jnp.zeros((t_pad, depth, w_pad), jnp.int32)
+    sb_p = sb_p.at[:t, :, :w].set(split_bin)
+    lv_p = jnp.zeros((t_pad, w_pad), jnp.float32)
+    lv_p = lv_p.at[:t, :leaf_w].set(leaf_value)
+
+    grid = (n_pad // row_tile, t_pad // tree_tile)
+    out = pl.pallas_call(
+        functools.partial(_serve_kernel, depth=depth, leaf_w=leaf_w),
+        out_shape=jax.ShapeDtypeStruct((n_pad, t_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (row_tile, f_pad), lambda i, j: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (tree_tile, depth, w_pad), lambda i, j: (j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (tree_tile, depth, w_pad), lambda i, j: (j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (tree_tile, w_pad), lambda i, j: (j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (row_tile, tree_tile), lambda i, j: (i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        interpret=interpret,
+    )(codes_p, sf_p, sb_p, lv_p)
+    return out[:n, :t]
+
+
+def predict_forest_pallas(binned, trees, interpret: bool = False):
+    """Mean leaf value across the stacked forest -> [N] (the
+    ``predict_forest`` contract over the Pallas traversal)."""
+    per_tree = serve_trees_pallas(
+        binned, trees.split_feat, trees.split_bin, trees.leaf_value,
+        interpret=interpret,
+    )
+    return per_tree.mean(axis=1)
+
+
+def predict_boosted_pallas(binned, trees, eta, base_score,
+                           interpret: bool = False):
+    """base + eta·Σ rounds -> [N] (the ``predict_boosted`` contract)."""
+    per_tree = serve_trees_pallas(
+        binned, trees.split_feat, trees.split_bin, trees.leaf_value,
+        interpret=interpret,
+    )
+    return base_score + eta * per_tree.sum(axis=1)
+
+
+def serve_impl() -> str:
+    """'pallas' on real TPU backends, 'gather' (the lax.scan traversal)
+    elsewhere; env ``TPTPU_SERVE_TREES`` forces either. CPU callers that
+    force 'pallas' run the kernel in interpret mode — the CPU twin the
+    unit tests pin parity with."""
+    import os
+
+    forced = os.environ.get("TPTPU_SERVE_TREES")
+    if forced:
+        return forced
+    return "pallas" if jax.default_backend() == "tpu" else "gather"
+
+
+def serve_interpret() -> bool:
+    """Interpret-mode flag for the current backend (True off-TPU)."""
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------
+# compiled-program contract audit (analysis/program.py, TPJ0xx)
+# --------------------------------------------------------------------------
+def program_trace_specs():
+    """The serve-side traversal kernel over a representative small
+    ensemble, bucketed on the BATCH axis like the fused serving programs
+    (TPJ bank gate + TPJ005 bucket-fingerprint stability)."""
+    i32, f32 = "int32", "float32"
+    depth, w, t, f = 3, 8, 5, 6
+
+    def _build(n: int):
+        return (
+            (
+                jax.ShapeDtypeStruct((n, f), i32),
+                jax.ShapeDtypeStruct((t, depth, w), i32),
+                jax.ShapeDtypeStruct((t, depth, w), i32),
+                jax.ShapeDtypeStruct((t, w), f32),
+            ),
+            dict(row_tile=128, tree_tile=8, interpret=True),
+        )
+
+    return [
+        dict(
+            name="serve_trees",
+            fn=serve_trees_pallas,
+            build=_build,
+            buckets=(8, 16),
+            static_argnames=("row_tile", "tree_tile", "interpret"),
+            scoring=True,
+        ),
+    ]

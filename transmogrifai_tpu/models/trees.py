@@ -859,12 +859,24 @@ class _PreparedStack:
     final shift) are built LAZILY so the native path never holds a second
     copy of the split arrays."""
 
-    __slots__ = ("raw", "r", "depth", "width", "leaf_width",
+    __slots__ = ("raw", "r", "depth", "width", "leaf_width", "max_feat",
                  "_levels", "_tail_shift", "leaf_flat")
 
     def __init__(self, sf: np.ndarray, sb: np.ndarray, lv: np.ndarray):
         self.raw = (sf, sb, lv)
         self.r, self.depth, self.width = sf.shape
+        # stack-shape validation happens HERE, once per model load — a
+        # corrupt manifest fails at prepare time with the same IndexError
+        # the traversals would raise, and the serving hot loop keeps only
+        # the O(1) plane-width guard in _leaf_sum (native.tree_predict_sum
+        # runs prevalidated; env TPTPU_NATIVE_VALIDATE restores the
+        # per-call check)
+        if lv.ndim != 2 or lv.shape[1] != (1 << self.depth):
+            raise IndexError(
+                f"tree stack: leaf table width {lv.shape[1:]} does not "
+                f"match depth {self.depth} (expected {1 << self.depth})"
+            )
+        self.max_feat = int(sf.max()) if sf.size else -1
         self.leaf_width = lv.shape[1]
         self.leaf_flat = lv.ravel()  # contiguous -> view, not a copy
         self._levels = None
@@ -935,7 +947,12 @@ def _leaf_sum(binned: np.ndarray, stack) -> np.ndarray:
     from .. import native
 
     ps = stack if isinstance(stack, _PreparedStack) else prepare_host_stack(stack)
-    out = native.tree_predict_sum(binned, *ps.raw)
+    if ps.max_feat >= binned.shape[1]:
+        raise IndexError(
+            f"tree stack: split feature index {ps.max_feat} out of bounds "
+            f"for {binned.shape[1]} binned feature(s)"
+        )
+    out = native.tree_predict_sum(binned, *ps.raw, prevalidated=True)
     if out is not None:
         return out
     return _traverse_host(binned, ps).sum(axis=0)

@@ -490,26 +490,13 @@ def _scatter_py(tokens, rows, num_buckets, seed, binary, out, col_offset):
         np.add.at(out, (rows, j), 1.0)
 
 
-def tree_predict_sum(
-    binned: np.ndarray, sf: np.ndarray, sb: np.ndarray, lv: np.ndarray,
-) -> np.ndarray | None:
-    """Per-row sum of leaf values across R stacked trees (serving predict
-    hot loop — see trees._traverse_host for the layout and semantics).
-    Returns float32 [n], or None when the library is unavailable (caller
-    falls back to the numpy traversal)."""
-    lib = _load()
-    if lib is None or not hasattr(lib, "tp_tree_predict_sum"):
-        return None
-    binned = np.ascontiguousarray(binned, dtype=np.int32)
-    sf = np.ascontiguousarray(sf, dtype=np.int32)
-    sb = np.ascontiguousarray(sb, dtype=np.int32)
-    lv = np.ascontiguousarray(lv, dtype=np.float32)
-    n, num_f = binned.shape
-    r, depth, width = sf.shape
-    # validate BEFORE handing pointers to C: the kernel gathers
-    # binned[i, sf[...]] and lv[t, node << (depth - eff)] unchecked, so a
-    # malformed stack (corrupt manifest, truncated arrays) would read out
-    # of bounds instead of raising like the numpy traversal does
+def validate_tree_stack(sf: np.ndarray, lv: np.ndarray, num_f: int) -> None:
+    """Bounds-check a host tree stack against a binned plane width BEFORE
+    any pointer reaches C: the kernel gathers binned[i, sf[...]] and
+    lv[t, node << (depth - eff)] unchecked, so a malformed stack (corrupt
+    manifest, truncated arrays) would read out of bounds instead of
+    raising like the numpy traversal does. Raises IndexError."""
+    depth = sf.shape[1]
     if sf.size and int(sf.max()) >= num_f:
         raise IndexError(
             f"tree_predict_sum: split feature index {int(sf.max())} out of "
@@ -520,6 +507,36 @@ def tree_predict_sum(
             f"tree_predict_sum: leaf table width {lv.shape[1:]} does not "
             f"match depth {depth} (expected {1 << depth})"
         )
+
+
+def tree_predict_sum(
+    binned: np.ndarray, sf: np.ndarray, sb: np.ndarray, lv: np.ndarray,
+    prevalidated: bool = False,
+) -> np.ndarray | None:
+    """Per-row sum of leaf values across R stacked trees (serving predict
+    hot loop — see trees._traverse_host for the layout and semantics).
+    Returns float32 [n], or None when the library is unavailable (caller
+    falls back to the numpy traversal).
+
+    ``prevalidated=True`` skips the per-call stack bounds check: the
+    serving path validates ONCE at model-load time (_PreparedStack) and
+    keeps only an O(1) plane-width guard in the hot loop. Set env
+    ``TPTPU_NATIVE_VALIDATE=1`` to force the full check back on every
+    call (belt-and-braces when debugging a suspect manifest)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tp_tree_predict_sum"):
+        return None
+    binned = np.ascontiguousarray(binned, dtype=np.int32)
+    sf = np.ascontiguousarray(sf, dtype=np.int32)
+    sb = np.ascontiguousarray(sb, dtype=np.int32)
+    lv = np.ascontiguousarray(lv, dtype=np.float32)
+    n, num_f = binned.shape
+    r, depth, width = sf.shape
+    if (
+        not prevalidated
+        or os.environ.get("TPTPU_NATIVE_VALIDATE", "0") == "1"
+    ):
+        validate_tree_stack(sf, lv, num_f)
     out = np.empty(n, dtype=np.float32)
     lib.tp_tree_predict_sum(
         binned, n, num_f, sf, sb, lv, r, depth, width, lv.shape[1], out,

@@ -49,11 +49,38 @@ def _impute_block(
     return vals[:, None]
 
 
+def _fit_ranges(cols: list[NumericColumn]) -> list[list[float]]:
+    """Per-column finite [lo, hi] value ranges — the fit-time statistics
+    the quantized serving plane's per-column scales derive from
+    (featurize/quantize.py). Monoid (min/max over finite present values),
+    so the reduction is shard-order-invariant like the fill statistics;
+    an all-null / all-non-finite column yields the degenerate [0, 0]."""
+    ranges = []
+    for col in cols:
+        present = np.asarray(col.values, dtype=np.float64)[col.mask]
+        finite = present[np.isfinite(present)]
+        if finite.size:
+            ranges.append([float(finite.min()), float(finite.max())])
+        else:
+            ranges.append([0.0, 0.0])
+    return ranges
+
+
 class NumericVectorizerModel(VectorizerModel):
-    def __init__(self, fills: list[float], track_nulls: bool, **kw):
+    def __init__(
+        self,
+        fills: list[float],
+        track_nulls: bool,
+        value_ranges: list[list[float]] | None = None,
+        **kw,
+    ):
         super().__init__("vecNumeric", **kw)
         self.fills = fills
         self.track_nulls = track_nulls
+        #: fit-time per-column [lo, hi] (quantized-plane scales); None on
+        #: models persisted before the quantization plane existed — those
+        #: simply keep their f32 member in a quantized fused build
+        self.value_ranges = value_ranges
 
     def blocks_for(self, cols: Sequence[Column], num_rows: int):
         blocks, metas = [], []
@@ -69,16 +96,22 @@ class NumericVectorizerModel(VectorizerModel):
         return {"fills": np.asarray(self.fills, dtype=np.float64)}
 
     def get_params(self):
-        return {"fills": list(map(float, self.fills)), "track_nulls": self.track_nulls}
+        return {
+            "fills": list(map(float, self.fills)),
+            "track_nulls": self.track_nulls,
+            "value_ranges": self.value_ranges,
+        }
 
     def fused_member_spec(self):
         """Device twin for the fused scoring graph (compiler/fused.py):
         ingest = f32 values + validity mask, impute + null-track traced
-        in-graph."""
+        in-graph. The fit ranges ride along so a quantized build can
+        swap the value upload to uint8 codes."""
         from ..compiler.fused import numeric_member
 
         return numeric_member(
-            self, np.asarray(self.fills, dtype=np.float32), self.track_nulls
+            self, np.asarray(self.fills, dtype=np.float32),
+            self.track_nulls, ranges=self.value_ranges,
         )
 
 
@@ -118,7 +151,10 @@ class RealVectorizer(VectorizerEstimator):
             else:
                 fills.append(float(self.fill_value))
         self.metadata["fills"] = fills
-        return NumericVectorizerModel(fills, self.track_nulls)
+        ranges = _fit_ranges([dataset[n] for n in self.input_names])
+        return NumericVectorizerModel(
+            fills, self.track_nulls, value_ranges=ranges
+        )
 
 
 class IntegralVectorizer(VectorizerEstimator):
@@ -156,7 +192,10 @@ class IntegralVectorizer(VectorizerEstimator):
             else:
                 fills.append(float(self.fill_value))
         self.metadata["fills"] = fills
-        return NumericVectorizerModel(fills, self.track_nulls)
+        ranges = _fit_ranges([dataset[n] for n in self.input_names])
+        return NumericVectorizerModel(
+            fills, self.track_nulls, value_ranges=ranges
+        )
 
 
 class BinaryVectorizer(VectorizerTransformer):
@@ -182,11 +221,13 @@ class BinaryVectorizer(VectorizerTransformer):
     def fused_member_spec(self):
         from ..compiler.fused import numeric_member
 
-        fills = np.full(
-            len(self.input_features), float(self.fill_value),
-            dtype=np.float32,
+        n = len(self.input_features)
+        fills = np.full(n, float(self.fill_value), dtype=np.float32)
+        # Binary values are statically {0, 1} — no fit pass needed for
+        # the quantized plane's ranges
+        return numeric_member(
+            self, fills, self.track_nulls, ranges=[[0.0, 1.0]] * n
         )
-        return numeric_member(self, fills, self.track_nulls)
 
 
 class RealNNVectorizer(VectorizerTransformer):

@@ -403,6 +403,25 @@ class SmartTextModel(VectorizerModel):
             "seed": self.seed,
         }
 
+    def fused_member_spec(self):
+        """Device twin for the fused scoring graph. All-pivot smart-text
+        members ride the OneHot code scatter; members with hashed slots
+        ride the device-side HashingTF scatter (codes + weights upload,
+        in-graph scatter — previously these raised ``Unfuseable`` and
+        forced the whole flow back to the staged loop). Mixed
+        pivot-and-hash members still refuse."""
+        from ..compiler.fused import hashed_text_member, onehot_member
+
+        if self.methods and all(m == PIVOT for m in self.methods):
+            return onehot_member(
+                self, self.vocabs, self.track_nulls, self.clean_text
+            )
+        return hashed_text_member(
+            self, self.methods, self.num_hashes, self.track_nulls,
+            self.binary_freq, self.to_lowercase, self.min_token_length,
+            self.seed,
+        )
+
     def blocks_for(self, cols: Sequence[Column], num_rows: int):
         nulls = 1 if self.track_nulls else 0
         widths = []

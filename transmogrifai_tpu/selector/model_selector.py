@@ -216,6 +216,13 @@ class SelectedModel(PredictorModel):
             )
         return spec_fn()
 
+    def fused_bin_thresholds(self):
+        """Delegate the quantized plane's bin-alignment source to the
+        winner (None when the winning family has no binning — the
+        quantizer then uses affine fit-range codes)."""
+        thr_fn = getattr(self.best_model, "fused_bin_thresholds", None)
+        return thr_fn() if thr_fn is not None else None
+
     def get_arrays(self):
         return {f"best__{k}": v for k, v in self.best_model.get_arrays().items()}
 

@@ -1159,6 +1159,21 @@ class WorkflowModel:
                 f"{comp.get('warmupPrograms', 0)} warmed "
                 f"({comp.get('warmupOverlapSeconds', 0.0):.2f}s overlapped)"
             )
+        if comp.get("fusedDispatches") or comp.get("fusedFallbacks") or \
+                comp.get("fusedFallbackReasons"):
+            reasons = comp.get("fusedFallbackReasons") or {}
+            reason_s = ""
+            if reasons:
+                top = sorted(reasons.items(), key=lambda kv: -kv[1])[:3]
+                reason_s = " (" + ", ".join(
+                    f"{k}: {v}" for k, v in top
+                ) + ")"
+            lines.append(
+                f"Fused serving: {comp.get('fusedDispatches', 0)} "
+                f"dispatch(es), {comp.get('fusedExplainLanes', 0)} "
+                f"explain lane(s), {comp.get('fusedFallbacks', 0)} "
+                f"fallback(s){reason_s}"
+            )
         feat = (sel or {}).get("featurizeStats") or {}
         if feat.get("rowsFeaturized") or feat.get("poolTasks"):
             util = feat.get("poolUtilization")
